@@ -1,0 +1,26 @@
+"""elastic-tpu-agent: a TPU-native Kubernetes node agent.
+
+Built from scratch with the capability set of elastic-ai/elastic-gpu-agent
+(see SURVEY.md): a privileged DaemonSet that discovers Cloud TPU chips /
+TensorCores / HBM, registers ``elasticgpu.io/tpu-core`` and
+``elasticgpu.io/tpu-memory`` as fractional extended resources through the
+kubelet device-plugin v1beta1 API, binds allocations to pods placed by an
+external elastic scheduler via pod annotations, materializes hash-named
+virtual device nodes, injects TPU device nodes + env through an OCI prestart
+hook, persists bindings for restart recovery, and garbage-collects leaked
+allocations.
+
+Layer map (mirrors reference SURVEY.md §1, re-designed TPU-first):
+
+  cli.py        L1  process entry (flags, signals)
+  manager.py    L2  lifecycle wiring + Restore()
+  plugins/      L3  kubelet device-plugin servers (the core)
+  tpu/          L4  physical device layer (chip discovery + /dev nodes)
+  kube/         L5  k8s adapters (pod informer + device->pod locator)
+  storage/      L6  checkpoint persistence (pod->container->device map)
+  types.py      L7  Device / PodInfo value types
+  native/ (C/C++, repo root)  L8  container-runtime integration
+  deploy/ (repo root)         L9  DaemonSet + RBAC + CRD
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,132 @@
+"""Constants and small shared helpers.
+
+Capability parity with the reference's ``pkg/common`` (SURVEY.md §1 L7):
+resource names, annotation keys, granularity constants, signal handling.
+Resource and annotation *keys* keep the ``elasticgpu.io`` group so the
+external elastic scheduler contract carries over unchanged; the resources
+themselves are TPU-native.
+"""
+
+from __future__ import annotations
+
+import datetime
+import faulthandler
+import os
+import signal
+import sys
+import threading
+
+# -- Extended resource names (TPU-native; reference: vendor types.go:105-112) --
+ResourceTPUCore = "elasticgpu.io/tpu-core"
+ResourceTPUMemory = "elasticgpu.io/tpu-memory"
+
+# Core-share granularity: 100 units per chip => 1% steps
+# (reference: pkg/common/const.go:4 GPUPercentEachCard).
+TPUPercentEachChip = 100
+
+# Memory-share granularity: 1 fake device per MiB of HBM
+# (reference: gpushare.go:161).
+BytesPerMemoryUnit = 1024 * 1024
+
+# -- Scheduler contract: pod annotations (reference: const.go:5-6) -----------
+AnnotationAssumed = "elasticgpu.io/assumed"
+AnnotationContainerPrefix = "elasticgpu.io/container-"
+
+# Multi-host slice annotations (TPU-native addition; SURVEY.md §2 note on
+# slice enablement / BASELINE config 5).
+AnnotationSliceName = "elasticgpu.io/tpu-slice"
+AnnotationSliceWorkerID = "elasticgpu.io/tpu-slice-worker-id"
+AnnotationSliceWorkerHosts = "elasticgpu.io/tpu-slice-hosts"
+
+# -- Container env contract ---------------------------------------------------
+# Env carrying the allocation hash into the container; the OCI hook resolves
+# it back to physical chips (reference used "GPU", main.go:200 — we accept
+# both; see native/elastic_tpu_hook.cc).
+EnvAllocationHash = "TPU"
+EnvAllocationHashCompat = "GPU"
+# Visibility env consumed by libtpu/JAX inside the container.
+EnvTPUVisibleChips = "TPU_VISIBLE_CHIPS"
+EnvTPUVisibleDevices = "TPU_VISIBLE_DEVICES"
+
+# -- Virtual device node naming ----------------------------------------------
+# /dev/elastic-tpu-<hash>-<i> -> /dev/accel<chip_index>
+# (reference scheme: /dev/elastic-gpu-<id> -> /dev/nvidiaN, gpushare.go:9-16)
+VirtualDevPrefix = "elastic-tpu-"
+
+# Host /dev as mounted into the agent container (deploy manifest hostPath).
+HostDevRoot = os.environ.get("ELASTIC_TPU_HOST_DEV", "/host/dev")
+
+# Sentinel index for delete paths that ignore the index
+# (reference: common.go:4 UselessNumber).
+USELESS_NUMBER = -1
+
+NEVER_STOP: "threading.Event" = threading.Event()  # never set: wait forever
+
+
+def container_annotation(container: str) -> str:
+    """Annotation key holding the chip indexes for one container,
+    e.g. elasticgpu.io/container-train -> "0,1"."""
+    return AnnotationContainerPrefix + container
+
+
+def install_dump_signal(log_dir: str = "/var/log") -> None:
+    """SIGUSR1 -> dump all thread stacks to a timestamped log file
+    (reference: SIGUSR1 goroutine dump, pkg/common/util.go:58-97)."""
+
+    def _dump(signum, frame):  # noqa: ARG001
+        ts = datetime.datetime.now().strftime("%Y%m%d-%H%M%S")
+        path = os.path.join(log_dir, f"thread-stacks-{ts}.log")
+        try:
+            with open(path, "w") as f:
+                faulthandler.dump_traceback(file=f)
+        except OSError:
+            faulthandler.dump_traceback(file=sys.stderr)
+
+    signal.signal(signal.SIGUSR1, _dump)
+
+
+def wait_for_exit_signal() -> int:
+    """Block until SIGTERM/SIGINT/SIGQUIT; return the signal number
+    (reference: pkg/common/util.go:52-66)."""
+    received: list = []
+    ev = threading.Event()
+
+    def _handler(signum, frame):  # noqa: ARG001
+        received.append(signum)
+        ev.set()
+
+    for s in (signal.SIGTERM, signal.SIGINT, signal.SIGQUIT):
+        signal.signal(s, _handler)
+    ev.wait()
+    return received[0] if received else 0
+
+
+class FileWatcher:
+    """Poll-based watch for file creation/replacement.
+
+    Replaces the reference's fsnotify watcher (util.go:99-114) for the one
+    thing it was used for: noticing that kubelet.sock was re-created after a
+    kubelet restart (SURVEY.md §3.4). Polling by (st_ino, st_dev, st_ctime)
+    is dependency-free and race-robust; 1s cadence matches the reference's
+    reaction latency.
+    """
+
+    def __init__(self, path: str) -> None:
+        self._path = path
+        self._sig = self._stat_sig()
+
+    def _stat_sig(self):
+        try:
+            st = os.stat(self._path)
+            return (st.st_ino, st.st_dev, st.st_ctime_ns)
+        except OSError:
+            return None
+
+    def changed(self) -> bool:
+        """True when the file appeared, vanished, or was replaced since the
+        last call that returned True (or construction)."""
+        sig = self._stat_sig()
+        if sig != self._sig:
+            self._sig = sig
+            return True
+        return False

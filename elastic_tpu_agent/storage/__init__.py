@@ -1,0 +1,3 @@
+from .store import Storage, StorageError
+
+__all__ = ["Storage", "StorageError"]

@@ -1,0 +1,162 @@
+"""Checkpoint store: pod -> container -> device bindings, on-disk.
+
+Capability parity with the reference's ``pkg/storage/storage.go`` (BoltDB
+single bucket ``root``, key ``namespace/name``, JSON value — SURVEY.md §1
+L6). We use SQLite (stdlib, ACID, single file, WAL) as the embedded KV
+engine; the DB file lives on a hostPath so state survives agent restarts,
+enabling Restore() (which the reference declared but never implemented,
+manager.go:17-21).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import sqlite3
+import threading
+from typing import Callable, Iterator, Optional, Tuple
+
+from ..types import PodInfo
+
+logger = logging.getLogger(__name__)
+
+
+class StorageError(Exception):
+    pass
+
+
+_SCHEMA = """
+CREATE TABLE IF NOT EXISTS pods (
+    key   TEXT PRIMARY KEY,   -- "namespace/name"
+    value TEXT NOT NULL       -- PodInfo JSON
+);
+"""
+
+
+class Storage:
+    """Thread-safe persistent map of pod key -> PodInfo.
+
+    Interface parity with the reference Storage (storage.go:15-22):
+    save / load / load_or_create / delete / for_each / close.
+    """
+
+    def __init__(self, path: str) -> None:
+        directory = os.path.dirname(os.path.abspath(path))
+        os.makedirs(directory, exist_ok=True)
+        self._path = path
+        self._lock = threading.RLock()
+        try:
+            self._db = sqlite3.connect(path, check_same_thread=False)
+            self._db.execute("PRAGMA journal_mode=WAL")
+            self._db.execute("PRAGMA synchronous=NORMAL")
+            self._db.execute(_SCHEMA)
+            self._db.commit()
+        except sqlite3.Error as e:
+            raise StorageError(f"open {path}: {e}") from e
+
+    # Exceptions meaning "this stored value does not parse as a PodInfo".
+    _CORRUPT = (json.JSONDecodeError, KeyError, TypeError, AttributeError)
+
+    # -- CRUD ----------------------------------------------------------------
+
+    def save(self, pod: PodInfo) -> None:
+        with self._lock:
+            try:
+                self._db.execute(
+                    "INSERT INTO pods(key, value) VALUES(?, ?) "
+                    "ON CONFLICT(key) DO UPDATE SET value=excluded.value",
+                    (pod.key, pod.to_json()),
+                )
+                self._db.commit()
+            except sqlite3.Error as e:
+                raise StorageError(f"save {pod.key}: {e}") from e
+
+    def load(self, namespace: str, name: str) -> Optional[PodInfo]:
+        """Return the stored PodInfo, or None when absent (reference returns
+        a not-found error; None is the idiomatic Python shape)."""
+        with self._lock:
+            try:
+                row = self._db.execute(
+                    "SELECT value FROM pods WHERE key=?",
+                    (f"{namespace}/{name}",),
+                ).fetchone()
+            except sqlite3.Error as e:
+                raise StorageError(f"load {namespace}/{name}: {e}") from e
+        if row is None:
+            return None
+        try:
+            return PodInfo.from_json(row[0])
+        except self._CORRUPT as e:
+            raise StorageError(
+                f"corrupt record for {namespace}/{name}: {e}"
+            ) from e
+
+    def load_or_create(self, namespace: str, name: str) -> PodInfo:
+        with self._lock:
+            existing = self.load(namespace, name)
+            if existing is not None:
+                return existing
+            pod = PodInfo(namespace=namespace, name=name)
+            self.save(pod)
+            return pod
+
+    def delete(self, namespace: str, name: str) -> None:
+        with self._lock:
+            try:
+                self._db.execute(
+                    "DELETE FROM pods WHERE key=?", (f"{namespace}/{name}",)
+                )
+                self._db.commit()
+            except sqlite3.Error as e:
+                raise StorageError(f"delete {namespace}/{name}: {e}") from e
+
+    def for_each(self, fn: Callable[[PodInfo], None]) -> None:
+        """Invoke fn on a snapshot of every stored PodInfo.
+
+        Snapshot first so fn may call save/delete without deadlocking or
+        invalidating the cursor (the reference iterates inside one Bolt
+        transaction and therefore could not; our GC deletes during
+        iteration). Corrupt records are logged and skipped — GC must keep
+        making progress past one bad row; use load() for loud point reads.
+        """
+        for _, pod in self.items():
+            fn(pod)
+
+    def _rows(self) -> Iterator[Tuple[str, Optional[PodInfo]]]:
+        """Snapshot all rows; parse each to PodInfo or None when corrupt."""
+        with self._lock:
+            try:
+                rows = self._db.execute(
+                    "SELECT key, value FROM pods"
+                ).fetchall()
+            except sqlite3.Error as e:
+                raise StorageError(f"scan: {e}") from e
+        for key, value in rows:
+            try:
+                yield key, PodInfo.from_json(value)
+            except self._CORRUPT:
+                yield key, None
+
+    def items(self) -> Iterator[Tuple[str, PodInfo]]:
+        for key, pod in self._rows():
+            if pod is None:
+                logger.warning("skipping corrupt storage record %r", key)
+            else:
+                yield key, pod
+
+    def corrupt_keys(self) -> list:
+        """Keys whose records fail to parse (for Restore() reporting)."""
+        return [key for key, pod in self._rows() if pod is None]
+
+    def close(self) -> None:
+        with self._lock:
+            self._db.close()
+
+    # -- context manager -----------------------------------------------------
+
+    def __enter__(self) -> "Storage":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

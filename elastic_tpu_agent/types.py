@@ -1,0 +1,169 @@
+"""Core value types: Device identity and pod binding records.
+
+Capability parity with the reference's ``pkg/types/device.go`` and
+``pkg/types/pod.go`` (see SURVEY.md §1 L7): a Device is a *sorted* set of
+fake-device IDs plus the first 8 hex chars of sha256 over ``":".join(ids)``.
+That hash is the join key of the whole system — it names the virtual device
+nodes under /dev, the env var handed to the container, and what the OCI hook
+resolves back to physical chip indexes.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, Iterator, List, Optional, Tuple
+
+
+def device_hash(ids: Iterable[str]) -> str:
+    """First 8 hex chars of sha256 over the sorted, ':'-joined ID set.
+
+    Stable across processes and restarts; collision-safe enough for the
+    per-node population of live allocations (reference: device.go:49-54).
+    """
+    joined = ":".join(sorted(ids))
+    return hashlib.sha256(joined.encode("utf-8")).hexdigest()[:8]
+
+
+@dataclass(frozen=True, eq=False)
+class Device:
+    """An allocation identity: a sorted fake-device-ID set + resource name.
+
+    ``ids`` are the kubelet-visible fake device IDs (e.g. 100 per chip for
+    tpu-core, one per MiB for tpu-memory). Two Devices are equal iff their
+    sorted ID sets are equal; the resource name is carried metadata and is
+    excluded from __eq__/__hash__.
+    """
+
+    ids: Tuple[str, ...]
+    resource: str = ""
+
+    def __init__(self, ids: Iterable[str], resource: str = "") -> None:
+        object.__setattr__(self, "ids", tuple(sorted(ids)))
+        object.__setattr__(self, "resource", resource)
+
+    @property
+    def hash(self) -> str:
+        return device_hash(self.ids)
+
+    def __eq__(self, other: object) -> bool:
+        return isinstance(other, Device) and self.ids == other.ids
+
+    def __hash__(self) -> int:
+        return hash(self.ids)
+
+    def equals(self, other: "Device") -> bool:
+        return self.ids == other.ids
+
+    def __len__(self) -> int:
+        return len(self.ids)
+
+    def to_dict(self) -> dict:
+        return {"ids": list(self.ids), "resource": self.resource}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "Device":
+        return cls(d.get("ids", []), d.get("resource", ""))
+
+
+@dataclass(frozen=True)
+class PodContainer:
+    """Addresses one container of one pod (reference: pod.go:10-16)."""
+
+    namespace: str
+    name: str
+    container: str
+
+    @property
+    def pod_key(self) -> str:
+        return f"{self.namespace}/{self.name}"
+
+
+# Maps container name -> Device (reference ContainerDeviceMap, pod.go:51-62).
+ContainerDeviceMap = Dict[str, Device]
+
+
+@dataclass
+class AllocationRecord:
+    """Extra per-container binding state beyond the Device identity.
+
+    The reference persisted only the Device; its GC then had to *guess* how
+    many /dev links PreStartContainer created, which leaks links for
+    cross-chip core splits (SURVEY.md §7 "known defects"). We persist the
+    exact created node IDs and the physical chip indexes so GC and Restore
+    are exact.
+    """
+
+    device: Device
+    chip_indexes: List[int] = field(default_factory=list)
+    created_node_ids: List[str] = field(default_factory=list)
+
+    def to_dict(self) -> dict:
+        return {
+            "device": self.device.to_dict(),
+            "chip_indexes": list(self.chip_indexes),
+            "created_node_ids": list(self.created_node_ids),
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "AllocationRecord":
+        return cls(
+            device=Device.from_dict(d["device"]),
+            chip_indexes=list(d.get("chip_indexes", [])),
+            created_node_ids=list(d.get("created_node_ids", [])),
+        )
+
+
+@dataclass
+class PodInfo:
+    """Pod binding record: namespace/name + container -> allocation map.
+
+    JSON-(de)serializable; this is the value stored in the checkpoint store
+    (reference: pod.go:24-62 persisted as JSON in BoltDB).
+    """
+
+    namespace: str
+    name: str
+    allocations: Dict[str, AllocationRecord] = field(default_factory=dict)
+
+    @property
+    def key(self) -> str:
+        return f"{self.namespace}/{self.name}"
+
+    def device_of(self, container: str) -> Optional[Device]:
+        rec = self.allocations.get(container)
+        return rec.device if rec else None
+
+    def containers(self) -> Iterator[str]:
+        return iter(self.allocations)
+
+    def to_json(self) -> str:
+        return json.dumps(
+            {
+                "namespace": self.namespace,
+                "name": self.name,
+                "allocations": {
+                    c: rec.to_dict() for c, rec in self.allocations.items()
+                },
+            },
+            sort_keys=True,
+        )
+
+    @classmethod
+    def from_json(cls, raw: str) -> "PodInfo":
+        d = json.loads(raw)
+        return cls(
+            namespace=d["namespace"],
+            name=d["name"],
+            allocations={
+                c: AllocationRecord.from_dict(rd)
+                for c, rd in d.get("allocations", {}).items()
+            },
+        )
+
+
+def parse_pod_key(key: str) -> Tuple[str, str]:
+    """Split "namespace/name" into its parts."""
+    namespace, _, name = key.partition("/")
+    return namespace, name

@@ -1,0 +1,76 @@
+"""Device identity + PodInfo serialization tests.
+
+Spec source: reference pkg/types/device.go + pod.go behavior (SURVEY.md §1
+L7). These also serve as the *fixed* version of the reference's stale test
+suite (SURVEY.md §4: storage_test.go called NewDevice with the wrong arity).
+"""
+
+import hashlib
+
+from elastic_tpu_agent.types import (
+    AllocationRecord,
+    Device,
+    PodContainer,
+    PodInfo,
+    device_hash,
+    parse_pod_key,
+)
+
+
+def test_device_ids_sorted_and_hash_stable():
+    d1 = Device(["b", "a", "c"], "elasticgpu.io/tpu-core")
+    d2 = Device(["c", "b", "a"], "elasticgpu.io/tpu-core")
+    assert d1.ids == ("a", "b", "c")
+    assert d1.hash == d2.hash
+    assert d1.equals(d2)
+    # The exact hash contract: sha256 over ':'-joined sorted ids, first 8 hex.
+    expect = hashlib.sha256(b"a:b:c").hexdigest()[:8]
+    assert d1.hash == expect
+    assert device_hash(["b", "c", "a"]) == expect
+
+
+def test_device_hash_differs_for_different_sets():
+    assert Device(["a"]).hash != Device(["b"]).hash
+    assert Device(["a", "b"]).hash != Device(["a"]).hash
+
+
+def test_device_resource_not_part_of_identity():
+    assert Device(["x"], "r1").equals(Device(["x"], "r2"))
+
+
+def test_device_roundtrip():
+    d = Device(["id2", "id1"], "elasticgpu.io/tpu-memory")
+    assert Device.from_dict(d.to_dict()) == d
+
+
+def test_pod_container_key():
+    pc = PodContainer("ns", "pod", "main")
+    assert pc.pod_key == "ns/pod"
+
+
+def test_podinfo_json_roundtrip():
+    pod = PodInfo(
+        namespace="default",
+        name="train-0",
+        allocations={
+            "jax": AllocationRecord(
+                device=Device(["tpu-core-0-1", "tpu-core-0-0"], "elasticgpu.io/tpu-core"),
+                chip_indexes=[0],
+                created_node_ids=["abc12345-0"],
+            )
+        },
+    )
+    back = PodInfo.from_json(pod.to_json())
+    assert back.namespace == "default"
+    assert back.name == "train-0"
+    assert back.key == "default/train-0"
+    rec = back.allocations["jax"]
+    assert rec.device.ids == ("tpu-core-0-0", "tpu-core-0-1")
+    assert rec.chip_indexes == [0]
+    assert rec.created_node_ids == ["abc12345-0"]
+    assert back.device_of("jax") is not None
+    assert back.device_of("absent") is None
+
+
+def test_parse_pod_key():
+    assert parse_pod_key("ns/name") == ("ns", "name")
