@@ -1,0 +1,104 @@
+"""Agent process entry point.
+
+Capability parity with ``cmd/main.go`` (SURVEY.md §1 L1): flags -> manager
+-> run -> block on exit signals, with a SIGUSR1 stack-dump side channel.
+The reference's broken default (-gpuPluginName=qgpu, unsupported by its own
+factory) is not replicated: defaults here are runnable.
+
+Usage:
+    python -m elastic_tpu_agent.cli --node-name $NODE_NAME \
+        --db-file /host/var/lib/elastic-tpu/meta.db --operator tpuvm
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import sys
+import threading
+
+from .common import install_dump_signal, wait_for_exit_signal
+from .manager import ManagerOptions, TPUManager
+
+
+def parse_args(argv=None) -> argparse.Namespace:
+    p = argparse.ArgumentParser(prog="elastic-tpu-agent")
+    p.add_argument("--node-name", default="", help="k8s node this agent runs on")
+    p.add_argument(
+        "--db-file",
+        default="/host/var/lib/elastic-tpu/meta.db",
+        help="checkpoint db path (hostPath-mounted to survive restarts)",
+    )
+    p.add_argument("--kubeconf", default="", help="kubeconfig path (default: in-cluster)")
+    p.add_argument(
+        "--plugin", default="tpushare", help="plugin kind (tpushare)"
+    )
+    p.add_argument(
+        "--operator",
+        default="tpuvm",
+        help="device operator: tpuvm | stub | stub:<accel-type>",
+    )
+    p.add_argument("--dev-root", default="/host/dev", help="host /dev mount")
+    p.add_argument(
+        "--device-plugin-dir",
+        default="/var/lib/kubelet/device-plugins",
+        help="kubelet device-plugin socket dir",
+    )
+    p.add_argument(
+        "--pod-resources-socket",
+        default="/var/lib/kubelet/pod-resources/kubelet.sock",
+        help="kubelet pod-resources socket",
+    )
+    p.add_argument(
+        "--alloc-spec-dir",
+        default="/host/var/lib/elastic-tpu/alloc",
+        help="where allocation specs for the OCI hook are written",
+    )
+    p.add_argument("--metrics-port", type=int, default=9478,
+                   help="prometheus metrics port (0 = off)")
+    p.add_argument("-v", "--verbose", action="count", default=0)
+    return p.parse_args(argv)
+
+
+def main(argv=None) -> int:
+    args = parse_args(argv)
+    logging.basicConfig(
+        level=logging.DEBUG if args.verbose else logging.INFO,
+        format="%(asctime)s %(levelname).1s %(name)s %(message)s",
+        stream=sys.stderr,
+    )
+    install_dump_signal()
+
+    metrics = None
+    if args.metrics_port:
+        from .metrics import AgentMetrics
+
+        metrics = AgentMetrics()
+        metrics.serve(args.metrics_port)
+
+    manager = TPUManager(
+        ManagerOptions(
+            node_name=args.node_name,
+            db_path=args.db_file,
+            kubeconfig=args.kubeconf,
+            plugin_kind=args.plugin,
+            operator_kind=args.operator,
+            dev_root=args.dev_root,
+            device_plugin_dir=args.device_plugin_dir,
+            pod_resources_socket=args.pod_resources_socket,
+            alloc_spec_dir=args.alloc_spec_dir,
+            metrics=metrics,
+        )
+    )
+    run_thread = threading.Thread(
+        target=manager.run, kwargs={"block": True}, daemon=True, name="manager"
+    )
+    run_thread.start()
+    sig = wait_for_exit_signal()
+    logging.getLogger(__name__).info("exiting on signal %s", sig)
+    manager.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

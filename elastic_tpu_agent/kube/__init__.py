@@ -1,0 +1,4 @@
+from .locator import DeviceLocator, KubeletDeviceLocator, LocateError
+from .sitter import Sitter
+
+__all__ = ["DeviceLocator", "KubeletDeviceLocator", "LocateError", "Sitter"]

@@ -1,0 +1,172 @@
+"""Minimal Kubernetes API client: exactly what the agent needs.
+
+The reference used client-go (clientset + informer factory). This image has
+no kubernetes Python package, and the agent touches a tiny API surface —
+get/list/watch pods filtered to one node, get node — so a small REST client
+over ``requests`` is the honest dependency-free equivalent
+(reference client construction: pkg/common/util.go:20-50, in-cluster or
+kubeconfig).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+from typing import Dict, Iterator, Optional, Tuple
+
+import requests
+
+logger = logging.getLogger(__name__)
+
+SERVICE_ACCOUNT_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
+
+
+class KubeError(Exception):
+    pass
+
+
+class KubeClient:
+    def __init__(
+        self,
+        base_url: str,
+        token: Optional[str] = None,
+        ca_cert: Optional[str] = None,
+        session: Optional[requests.Session] = None,
+    ) -> None:
+        self._base = base_url.rstrip("/")
+        self._session = session or requests.Session()
+        if token:
+            self._session.headers["Authorization"] = f"Bearer {token}"
+        self._verify = ca_cert if ca_cert else False
+
+    # -- constructors ---------------------------------------------------------
+
+    @classmethod
+    def in_cluster(cls) -> "KubeClient":
+        host = os.environ.get("KUBERNETES_SERVICE_HOST")
+        port = os.environ.get("KUBERNETES_SERVICE_PORT", "443")
+        if not host:
+            raise KubeError("not running in-cluster (no KUBERNETES_SERVICE_HOST)")
+        token_path = os.path.join(SERVICE_ACCOUNT_DIR, "token")
+        ca_path = os.path.join(SERVICE_ACCOUNT_DIR, "ca.crt")
+        with open(token_path) as f:
+            token = f.read().strip()
+        ca = ca_path if os.path.exists(ca_path) else None
+        return cls(f"https://{host}:{port}", token=token, ca_cert=ca)
+
+    @classmethod
+    def from_kubeconfig(cls, path: str) -> "KubeClient":
+        """Supports the common kubeconfig shapes: token / token-file /
+        client-cert auth, with both file-path and inline base64 ``*-data``
+        variants (kind and GKE kubeconfigs embed the data forms)."""
+        import base64
+        import tempfile
+
+        import yaml
+
+        def materialize(data_b64: str, suffix: str) -> str:
+            f = tempfile.NamedTemporaryFile(
+                prefix="elastic-tpu-kubeconfig-", suffix=suffix, delete=False
+            )
+            f.write(base64.b64decode(data_b64))
+            f.close()
+            return f.name
+
+        with open(path) as f:
+            cfg = yaml.safe_load(f)
+        ctx_name = cfg.get("current-context")
+        ctx = next(
+            c["context"] for c in cfg["contexts"] if c["name"] == ctx_name
+        )
+        cluster = next(
+            c["cluster"] for c in cfg["clusters"] if c["name"] == ctx["cluster"]
+        )
+        user = next(u["user"] for u in cfg["users"] if u["name"] == ctx["user"])
+        session = requests.Session()
+        token = user.get("token")
+        if not token and user.get("token-file"):
+            with open(user["token-file"]) as tf:
+                token = tf.read().strip()
+        cert = user.get("client-certificate")
+        key = user.get("client-key")
+        if not cert and user.get("client-certificate-data"):
+            cert = materialize(user["client-certificate-data"], ".crt")
+        if not key and user.get("client-key-data"):
+            key = materialize(user["client-key-data"], ".key")
+        if cert and key:
+            session.cert = (cert, key)
+        ca = cluster.get("certificate-authority")
+        if not ca and cluster.get("certificate-authority-data"):
+            ca = materialize(cluster["certificate-authority-data"], ".ca.crt")
+        return cls(
+            cluster["server"], token=token, ca_cert=ca, session=session
+        )
+
+    @classmethod
+    def auto(cls, kubeconfig: str = "") -> "KubeClient":
+        if kubeconfig:
+            return cls.from_kubeconfig(kubeconfig)
+        return cls.in_cluster()
+
+    # -- request plumbing -----------------------------------------------------
+
+    def _get(self, path: str, params: Optional[Dict] = None, **kw):
+        return self._session.get(
+            self._base + path, params=params, verify=self._verify, **kw
+        )
+
+    # -- API surface ----------------------------------------------------------
+
+    def get_pod(self, namespace: str, name: str) -> Optional[dict]:
+        """Pod manifest dict, or None on 404 (apiserver-NotFound is a GC
+        decision input, reference: base.go:266-277)."""
+        r = self._get(f"/api/v1/namespaces/{namespace}/pods/{name}")
+        if r.status_code == 404:
+            return None
+        if r.status_code != 200:
+            raise KubeError(f"get pod {namespace}/{name}: {r.status_code}")
+        return r.json()
+
+    def get_node(self, name: str) -> Optional[dict]:
+        r = self._get(f"/api/v1/nodes/{name}")
+        if r.status_code == 404:
+            return None
+        if r.status_code != 200:
+            raise KubeError(f"get node {name}: {r.status_code}")
+        return r.json()
+
+    def list_pods(self, node_name: str) -> Tuple[list, str]:
+        """All pods bound to ``node_name`` + the list resourceVersion
+        (fieldSelector parity: sitter.go:73-77)."""
+        r = self._get(
+            "/api/v1/pods",
+            params={"fieldSelector": f"spec.nodeName={node_name}"},
+        )
+        if r.status_code != 200:
+            raise KubeError(f"list pods: {r.status_code}")
+        body = r.json()
+        rv = body.get("metadata", {}).get("resourceVersion", "")
+        return body.get("items", []), rv
+
+    def watch_pods(
+        self, node_name: str, resource_version: str, timeout_s: int = 60
+    ) -> Iterator[dict]:
+        """Stream watch events ({"type": ..., "object": pod}) until the
+        server closes the window. Caller re-lists on error/410."""
+        r = self._get(
+            "/api/v1/pods",
+            params={
+                "watch": "true",
+                "fieldSelector": f"spec.nodeName={node_name}",
+                "resourceVersion": resource_version,
+                "timeoutSeconds": str(timeout_s),
+            },
+            stream=True,
+            timeout=timeout_s + 10,
+        )
+        if r.status_code != 200:
+            raise KubeError(f"watch pods: {r.status_code}")
+        for line in r.iter_lines():
+            if line:
+                yield json.loads(line)

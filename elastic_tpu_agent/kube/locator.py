@@ -1,0 +1,89 @@
+"""Device->pod locator: which pod/container owns this fake-device set?
+
+Capability parity with the reference's ``pkg/kube/locator.go`` (SURVEY.md §1
+L5): PreStartContainer only receives device IDs, so the agent asks the
+kubelet pod-resources API for the full node dump and matches the sorted
+ID set. Both response shapes are handled: k8s ≤1.20 returned all IDs of a
+resource in one ContainerDevices entry, ≥1.21 one entry per ID
+(locator.go:69-89) — we simply merge every entry of the target resource per
+container before comparing.
+
+Perf (this is the Allocate/PreStart p50 hot path, BASELINE.md): the
+reference issued a full-node List per Locate call, O(pods x containers x
+devices) each time. We keep a hash-indexed cache of the last List and only
+re-List on a cache miss, so steady-state repeat locates are O(1) and a
+single List serves all misses in one PreStart burst.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from abc import ABC, abstractmethod
+from typing import Dict, Optional
+
+from ..rpc import PodResourcesClient
+from ..types import Device, PodContainer, device_hash
+
+logger = logging.getLogger(__name__)
+
+
+class LocateError(Exception):
+    pass
+
+
+class DeviceLocator(ABC):
+    @abstractmethod
+    def locate(self, device: Device) -> PodContainer:
+        """Resolve the owner of this device set; raises LocateError."""
+
+
+class KubeletDeviceLocator(DeviceLocator):
+    """One locator per extended resource (reference: base.go:56-58)."""
+
+    def __init__(self, resource: str, client: PodResourcesClient) -> None:
+        self._resource = resource
+        self._client = client
+        self._lock = threading.Lock()
+        self._cache: Dict[str, PodContainer] = {}  # device-set hash -> owner
+
+    def _refresh(self) -> None:
+        """Full List -> rebuild hash index for our resource."""
+        resp = self._client.list()
+        fresh: Dict[str, PodContainer] = {}
+        for pod in resp.pod_resources:
+            for container in pod.containers:
+                ids = []
+                for dev in container.devices:
+                    if dev.resource_name == self._resource:
+                        # merges both the ≤1.20 one-entry-many-ids and the
+                        # ≥1.21 one-id-per-entry shapes
+                        ids.extend(dev.device_ids)
+                if ids:
+                    fresh[device_hash(ids)] = PodContainer(
+                        pod.namespace, pod.name, container.name
+                    )
+        with self._lock:
+            self._cache = fresh
+
+    def locate(self, device: Device) -> PodContainer:
+        key = device.hash
+        with self._lock:
+            hit = self._cache.get(key)
+        if hit is not None:
+            return hit
+        try:
+            self._refresh()
+        except Exception as e:  # noqa: BLE001 - client re-dials next call
+            raise LocateError(f"pod-resources List failed: {e}") from e
+        with self._lock:
+            hit = self._cache.get(key)
+        if hit is None:
+            raise LocateError(
+                f"no pod owns device set {key} for {self._resource}"
+            )
+        return hit
+
+    def invalidate(self) -> None:
+        with self._lock:
+            self._cache = {}

@@ -1,0 +1,128 @@
+"""Sitter: node-filtered pod cache with a delete hook feeding GC.
+
+Capability parity with the reference's ``pkg/kube/sitter.go`` (SURVEY.md §1
+L5): an informer-style list+watch over the pods bound to this node, a read
+cache (get_pod), apiserver fallbacks (get_pod_from_api /
+get_node_from_api), has_synced, and a DeleteFunc hook that forwards pod
+deletions to the manager's GC channel.
+
+Instead of the reference's 1-second full resync (sitter.go:61, papering
+over watch staleness), we run a real watch with re-list on expiry plus a
+periodic safety re-list.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Callable, Dict, Optional, Tuple
+
+from .client import KubeClient, KubeError
+
+logger = logging.getLogger(__name__)
+
+DeleteHook = Callable[[dict], None]
+
+
+class Sitter:
+    def __init__(
+        self,
+        client: KubeClient,
+        node_name: str,
+        on_delete: Optional[DeleteHook] = None,
+        relist_interval_s: float = 30.0,
+    ) -> None:
+        self._client = client
+        self._node = node_name
+        self._on_delete = on_delete
+        self._relist_s = relist_interval_s
+        self._lock = threading.RLock()
+        self._cache: Dict[Tuple[str, str], dict] = {}
+        self._synced = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- cache reads ----------------------------------------------------------
+
+    def has_synced(self) -> bool:
+        return self._synced.is_set()
+
+    def wait_synced(self, timeout: Optional[float] = None) -> bool:
+        return self._synced.wait(timeout)
+
+    def get_pod(self, namespace: str, name: str) -> Optional[dict]:
+        with self._lock:
+            return self._cache.get((namespace, name))
+
+    def pods(self) -> list:
+        with self._lock:
+            return list(self._cache.values())
+
+    # -- apiserver fallbacks (reference: sitter.go GetPodFromApiServer) -------
+
+    def get_pod_from_api(self, namespace: str, name: str) -> Optional[dict]:
+        return self._client.get_pod(namespace, name)
+
+    def get_node_from_api(self, name: str) -> Optional[dict]:
+        return self._client.get_node(name)
+
+    # -- list+watch loop ------------------------------------------------------
+
+    @staticmethod
+    def _key(pod: dict) -> Tuple[str, str]:
+        md = pod.get("metadata", {})
+        return md.get("namespace", ""), md.get("name", "")
+
+    def _relist(self) -> str:
+        items, rv = self._client.list_pods(self._node)
+        fresh = {self._key(p): p for p in items}
+        with self._lock:
+            gone = set(self._cache) - set(fresh)
+            gone_pods = [self._cache[k] for k in gone]
+            self._cache = fresh
+        # Deletions that happened while we were not watching still reach GC.
+        for pod in gone_pods:
+            self._fire_delete(pod)
+        self._synced.set()
+        return rv
+
+    def _fire_delete(self, pod: dict) -> None:
+        if self._on_delete is not None:
+            try:
+                self._on_delete(pod)
+            except Exception:  # noqa: BLE001
+                logger.exception("delete hook failed")
+
+    def _handle_event(self, event: dict) -> None:
+        etype = event.get("type")
+        pod = event.get("object", {})
+        key = self._key(pod)
+        if etype in ("ADDED", "MODIFIED"):
+            with self._lock:
+                self._cache[key] = pod
+        elif etype == "DELETED":
+            with self._lock:
+                self._cache.pop(key, None)
+            self._fire_delete(pod)
+        elif etype == "ERROR":
+            raise KubeError(f"watch error event: {pod}")
+
+    def _run(self, stop: threading.Event) -> None:
+        while not stop.is_set():
+            try:
+                rv = self._relist()
+                watch_timeout = max(1, int(self._relist_s))
+                for event in self._client.watch_pods(
+                    self._node, rv, timeout_s=watch_timeout
+                ):
+                    self._handle_event(event)
+                    if stop.is_set():
+                        return
+            except Exception as e:  # noqa: BLE001
+                logger.warning("sitter list/watch failed (%s); retrying", e)
+                stop.wait(1.0)
+
+    def start(self, stop: threading.Event) -> None:
+        self._thread = threading.Thread(
+            target=self._run, args=(stop,), daemon=True, name="sitter"
+        )
+        self._thread.start()

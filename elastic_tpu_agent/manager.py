@@ -1,0 +1,175 @@
+"""Manager: lifecycle wiring + the Restore() the reference never wrote.
+
+Capability parity with ``pkg/manager/manager.go`` (SURVEY.md §1 L2):
+construct clients, open storage, start the sitter with a delete hook
+feeding the GC queue, build the plugin bundle, then Run(). The reference
+declared ``GC(); Restore()`` on its interface and implemented neither
+(manager.go:17-21); both are real here:
+
+- restore(): at boot, reconcile the checkpoint store against the world —
+  re-create missing virtual nodes for live pods (the host's /dev may have
+  been wiped), drop state for pods that no longer exist (SURVEY.md §3.5).
+- GC runs event-driven from sitter deletions plus a 60s reconcile tick.
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+from dataclasses import dataclass, field
+from typing import Optional
+
+from . import rpc
+from .kube.client import KubeClient
+from .kube.locator import KubeletDeviceLocator
+from .kube.sitter import Sitter
+from .plugins.base import PluginConfig
+from .plugins.tpushare import DEFAULT_ALLOC_SPEC_DIR, TPUSharePlugin
+from .storage import Storage
+from .tpu import StubOperator, TPUVMOperator
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class ManagerOptions:
+    """Functional-options equivalent (reference: manager.go:33-57)."""
+
+    node_name: str = ""
+    db_path: str = "/host/var/lib/elastic-tpu/meta.db"
+    kubeconfig: str = ""
+    plugin_kind: str = "tpushare"
+    operator_kind: str = "tpuvm"  # tpuvm | stub | stub:<type>
+    dev_root: str = "/host/dev"
+    device_plugin_dir: str = rpc.DEVICE_PLUGIN_DIR
+    pod_resources_socket: str = rpc.POD_RESOURCES_SOCKET
+    alloc_spec_dir: str = DEFAULT_ALLOC_SPEC_DIR
+    metrics_port: int = 0  # 0 = disabled
+    # test seams
+    kube_client: Optional[KubeClient] = None
+    operator: object = None
+    metrics: object = None
+    extra: dict = field(default_factory=dict)
+
+
+def build_operator(opts: ManagerOptions):
+    if opts.operator is not None:
+        return opts.operator
+    kind = opts.operator_kind
+    if kind == "tpuvm":
+        return TPUVMOperator(opts.dev_root)
+    if kind.startswith("stub"):
+        acc = kind.partition(":")[2] or "v5litepod-4"
+        return StubOperator(opts.dev_root, acc)
+    raise ValueError(f"unknown operator kind {kind!r}")
+
+
+class TPUManager:
+    def __init__(self, opts: ManagerOptions) -> None:
+        self._opts = opts
+        self.storage = Storage(opts.db_path)
+        self.client = opts.kube_client or KubeClient.auto(opts.kubeconfig)
+        self.gc_queue: "queue.Queue" = queue.Queue()
+        self.sitter = Sitter(
+            self.client,
+            opts.node_name,
+            on_delete=self.gc_queue.put,
+        )
+        self.operator = build_operator(opts)
+        self.metrics = opts.metrics
+        if self.metrics is not None:
+            try:
+                self.metrics.chips.set(len(self.operator.devices()))
+            except Exception:  # noqa: BLE001 - discovery failure: gauge stays 0
+                logger.exception("chip discovery for metrics failed")
+        pr_client = rpc.PodResourcesClient(opts.pod_resources_socket)
+        self.config = PluginConfig(
+            node_name=opts.node_name,
+            device_plugin_dir=opts.device_plugin_dir,
+            pod_resources_socket=opts.pod_resources_socket,
+            operator=self.operator,
+            sitter=self.sitter,
+            storage=self.storage,
+            locator_factory=lambda res: KubeletDeviceLocator(res, pr_client),
+            metrics=self.metrics,
+            extra={"alloc_spec_dir": opts.alloc_spec_dir, **opts.extra},
+        )
+        from .plugins.base import plugin_factory
+
+        self.plugin = plugin_factory(opts.plugin_kind, self.config)
+        self._stop = threading.Event()
+
+    # -- Restore (SURVEY.md §3.5: declared-but-unimplemented upstream) --------
+
+    def restore(self) -> dict:
+        """Reconcile checkpoint state with reality at boot; returns a small
+        report (also exported via metrics)."""
+        report = {"restored_links": 0, "reclaimed_pods": 0, "kept_pods": 0,
+                  "corrupt_records": 0}
+        report["corrupt_records"] = len(self.storage.corrupt_keys())
+        for _, info in list(self.storage.items()):
+            pod = self.sitter.get_pod(info.namespace, info.name)
+            if pod is None:
+                try:
+                    pod = self.sitter.get_pod_from_api(info.namespace, info.name)
+                except Exception as e:  # noqa: BLE001
+                    logger.warning(
+                        "restore: apiserver check failed for %s (%s); keeping",
+                        info.key, e,
+                    )
+                    report["kept_pods"] += 1
+                    continue
+            if pod is None:
+                # Pod is gone: reclaim now rather than waiting for GC.
+                for record in info.records():
+                    for link_id in record.created_node_ids:
+                        try:
+                            self.operator.delete(link_id)
+                        except Exception:  # noqa: BLE001
+                            logger.warning("restore: delete %s failed", link_id)
+                self.storage.delete(info.namespace, info.name)
+                report["reclaimed_pods"] += 1
+                continue
+            # Pod lives: ensure its virtual nodes exist (Check -> Create).
+            report["kept_pods"] += 1
+            for record in info.records():
+                for pos, link_id in enumerate(record.created_node_ids):
+                    if not self.operator.check(link_id):
+                        try:
+                            idx = record.chip_indexes[pos]
+                            self.operator.create(idx, link_id)
+                            report["restored_links"] += 1
+                        except Exception:  # noqa: BLE001
+                            logger.exception(
+                                "restore: re-create %s failed", link_id
+                            )
+        logger.info("restore report: %s", report)
+        if self.metrics is not None:
+            self.metrics.restored_links.inc(report["restored_links"])
+            self.metrics.bound_allocations.set(
+                sum(1 for _ in self.storage.items())
+            )
+        return report
+
+    # -- Run ------------------------------------------------------------------
+
+    def run(self, block: bool = True) -> None:
+        """Start sitter, wait for sync, restore, start plugins + GC
+        (reference: manager.go:145-156 — restore added)."""
+        self.sitter.start(self._stop)
+        if not self.sitter.wait_synced(timeout=60.0):
+            logger.warning("sitter not synced after 60s; continuing anyway")
+        self.restore()
+        self.plugin.run(self._stop)
+        gc_thread = self.plugin.start_gc(self.gc_queue, self._stop)
+        if block:
+            gc_thread.join()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self.gc_queue.put(None)  # wake GC so it can observe stop
+        if hasattr(self.plugin, "core"):
+            self.plugin.core.stop_streams()
+            self.plugin.memory.stop_streams()
+        self.storage.close()

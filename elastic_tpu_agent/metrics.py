@@ -1,0 +1,66 @@
+"""Prometheus metrics endpoint.
+
+The reference had none (SURVEY.md §5.5 — klog only, RBAC granted events it
+never recorded). BASELINE.md's north-star metric is Allocate() p50 latency
+plus chip utilization, so both are first-class here.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from prometheus_client import (
+    Counter,
+    Gauge,
+    Histogram,
+    start_http_server,
+)
+
+_BUCKETS = (
+    0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
+)
+
+
+class AgentMetrics:
+    def __init__(self, registry=None) -> None:
+        kw = {"registry": registry} if registry is not None else {}
+        self.allocate_latency = Histogram(
+            "elastic_tpu_allocate_seconds",
+            "Device-plugin Allocate() handler latency",
+            buckets=_BUCKETS,
+            **kw,
+        )
+        self.prestart_latency = Histogram(
+            "elastic_tpu_prestart_seconds",
+            "Device-plugin PreStartContainer() handler latency "
+            "(includes pod-resources Locate)",
+            buckets=_BUCKETS,
+            **kw,
+        )
+        self.chips = Gauge(
+            "elastic_tpu_chips", "Physical TPU chips discovered", **kw
+        )
+        self.bound_allocations = Gauge(
+            "elastic_tpu_bound_allocations",
+            "Live pod->chip bindings recorded in storage",
+            **kw,
+        )
+        self.gc_reclaimed = Counter(
+            "elastic_tpu_gc_reclaimed_total",
+            "Allocations reclaimed by GC",
+            **kw,
+        )
+        self.restored_links = Counter(
+            "elastic_tpu_restored_links_total",
+            "Virtual device nodes re-created by restore()",
+            **kw,
+        )
+
+    def observe_allocate(self, seconds: float) -> None:
+        self.allocate_latency.observe(seconds)
+
+    def observe_prestart(self, seconds: float) -> None:
+        self.prestart_latency.observe(seconds)
+
+    def serve(self, port: int) -> None:
+        start_http_server(port)

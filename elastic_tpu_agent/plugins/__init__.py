@@ -1,0 +1,9 @@
+from .base import DevicePluginServer, PluginConfig, plugin_factory
+from .tpushare import TPUSharePlugin
+
+__all__ = [
+    "DevicePluginServer",
+    "PluginConfig",
+    "plugin_factory",
+    "TPUSharePlugin",
+]

@@ -1,0 +1,168 @@
+"""Device-plugin server lifecycle: serve, probe, register, re-register.
+
+Capability parity with the reference's ``pkg/plugins/base.go``
+(SURVEY.md §1 L3, §3.4): one gRPC server per extended resource on a unix
+socket under the kubelet device-plugins dir; after serving, dial-probe the
+socket, register with kubelet.sock, then watch for kubelet restarts
+(socket re-creation) and run the whole restart loop again. Any error →
+back off and retry (reference: ``goto restart``, base.go:117-127).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+from concurrent import futures
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+import grpc
+
+from .. import rpc
+from ..common import FileWatcher
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class PluginConfig:
+    """Wiring config for the plugin layer (reference GPUPluginConfig,
+    base.go:32-43)."""
+
+    node_name: str = ""
+    device_plugin_dir: str = rpc.DEVICE_PLUGIN_DIR
+    pod_resources_socket: str = rpc.POD_RESOURCES_SOCKET
+    restart_backoff_s: float = 1.0
+    # seams injected by the manager:
+    operator: object = None
+    sitter: object = None
+    storage: object = None
+    locator_factory: Optional[Callable[[str], object]] = None
+    metrics: object = None
+    extra: dict = field(default_factory=dict)
+
+
+class DevicePluginServer:
+    """Registration lifecycle for ONE extended resource.
+
+    States per iteration: serve socket -> probe -> register -> watch.
+    A kubelet restart (kubelet.sock re-created) or any serve/register error
+    tears the server down and re-enters the loop after a short backoff.
+    """
+
+    def __init__(
+        self,
+        servicer: rpc.DevicePluginServicer,
+        resource_name: str,
+        endpoint: str,
+        config: PluginConfig,
+        pre_start_required: bool = True,
+    ) -> None:
+        self._servicer = servicer
+        self._resource = resource_name
+        self._endpoint = endpoint  # socket file name, e.g. elastic-tpushare-core.sock
+        self._config = config
+        self._pre_start_required = pre_start_required
+        self._server: Optional[grpc.Server] = None
+        self._thread: Optional[threading.Thread] = None
+        self.registrations = 0  # observability: how many times we registered
+
+    # -- single lifecycle steps ----------------------------------------------
+
+    @property
+    def socket_path(self) -> str:
+        return os.path.join(self._config.device_plugin_dir, self._endpoint)
+
+    @property
+    def kubelet_socket(self) -> str:
+        return os.path.join(
+            self._config.device_plugin_dir, rpc.KUBELET_SOCKET_NAME
+        )
+
+    def _serve(self) -> None:
+        if os.path.exists(self.socket_path):
+            os.unlink(self.socket_path)  # stale socket from a previous run
+        server = grpc.server(futures.ThreadPoolExecutor(max_workers=8))
+        rpc.add_device_plugin_servicer(server, self._servicer)
+        server.add_insecure_port(rpc.unix_target(self.socket_path))
+        server.start()
+        self._server = server
+
+    def _stop_server(self) -> None:
+        if self._server is not None:
+            self._server.stop(grace=0.5)
+            self._server = None
+
+    def _probe(self, timeout_s: float = 5.0) -> None:
+        rpc.dial(self.socket_path, timeout_s).close()
+
+    def _register(self) -> None:
+        rpc.RegistrationClient(self.kubelet_socket).register(
+            endpoint=self._endpoint,
+            resource_name=self._resource,
+            pre_start_required=self._pre_start_required,
+        )
+        self.registrations += 1
+        logger.info(
+            "registered %s via %s with kubelet", self._resource, self._endpoint
+        )
+
+    # -- the loop -------------------------------------------------------------
+
+    def run(self, stop: threading.Event) -> None:
+        """Blocking serve/register/watch loop until ``stop`` is set."""
+        while not stop.is_set():
+            try:
+                self._serve()
+                self._probe()
+                # Snapshot the kubelet socket BEFORE registering: a kubelet
+                # restart racing the Register call must still be detected,
+                # else this server never re-registers.
+                watcher = FileWatcher(self.kubelet_socket)
+                self._register()
+            except Exception as e:  # noqa: BLE001
+                logger.warning(
+                    "%s: serve/register failed (%s); retrying", self._resource, e
+                )
+                self._stop_server()
+                stop.wait(self._config.restart_backoff_s)
+                continue
+            # Registered: watch for kubelet restarts.
+            restarted = False
+            while not stop.is_set():
+                if watcher.changed():
+                    logger.info(
+                        "%s: kubelet socket changed; re-registering",
+                        self._resource,
+                    )
+                    restarted = True
+                    break
+                stop.wait(1.0)
+            self._stop_server()
+            if restarted:
+                stop.wait(self._config.restart_backoff_s)
+        self._stop_server()
+
+    def start(self, stop: threading.Event) -> None:
+        self._thread = threading.Thread(
+            target=self.run, args=(stop,), daemon=True,
+            name=f"dp-server-{self._resource}",
+        )
+        self._thread.start()
+
+    def join(self, timeout: Optional[float] = None) -> None:
+        if self._thread is not None:
+            self._thread.join(timeout)
+
+
+def plugin_factory(kind: str, config: PluginConfig):
+    """Build the plugin bundle for ``kind`` (reference PluginFactory,
+    base.go:52-62; its unsupported default "qgpu" defect is not replicated —
+    unknown kinds fail loudly)."""
+    from .tpushare import TPUSharePlugin
+
+    if kind in ("tpushare", "gpushare"):
+        return TPUSharePlugin(config)
+    raise ValueError(f"unsupported plugin kind {kind!r} (want 'tpushare')")

@@ -1,0 +1,9 @@
+#!/bin/sh
+# Regenerate protobuf message modules into elastic_tpu_agent/gen/.
+# Only messages are generated (protoc --python_out); gRPC service stubs are
+# hand-wired in elastic_tpu_agent/rpc.py against grpcio's generic API, so
+# grpcio-tools is not required in the image.
+set -e
+cd "$(dirname "$0")"
+protoc --python_out=../gen deviceplugin.proto podresources.proto
+echo "generated: ../gen/deviceplugin_pb2.py ../gen/podresources_pb2.py"

@@ -1,0 +1,296 @@
+"""Hand-wired gRPC service plumbing for the kubelet APIs.
+
+grpcio-tools is not available in the runtime image, so instead of generated
+``*_pb2_grpc.py`` stubs we bind the kubelet method paths explicitly against
+grpcio's generic handler API. Method paths are part of the kubelet wire
+contract: ``/v1beta1.Registration/Register``, ``/v1beta1.DevicePlugin/*``,
+``/v1alpha1.PodResourcesLister/List`` (reference consumed the same services
+via generated Go stubs — SURVEY.md §2 components 3/9).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Iterable, Optional
+
+import grpc
+
+from .gen import deviceplugin_pb2 as dp
+from .gen import podresources_pb2 as pr
+
+# -- kubelet filesystem contract (upstream constants) -------------------------
+DEVICE_PLUGIN_VERSION = "v1beta1"
+DEVICE_PLUGIN_DIR = "/var/lib/kubelet/device-plugins"
+KUBELET_SOCKET_NAME = "kubelet.sock"
+POD_RESOURCES_SOCKET = "/var/lib/kubelet/pod-resources/kubelet.sock"
+
+HEALTHY = "Healthy"
+UNHEALTHY = "Unhealthy"
+
+# pod-resources List is a full-node dump; match the reference's 16 MiB cap
+# (locator.go:34).
+MAX_MSG_BYTES = 16 * 1024 * 1024
+
+_CHANNEL_OPTS = [
+    ("grpc.max_receive_message_length", MAX_MSG_BYTES),
+    ("grpc.max_send_message_length", MAX_MSG_BYTES),
+]
+
+
+def unix_target(path: str) -> str:
+    return f"unix:{path}"
+
+
+def dial(path: str, timeout_s: float = 5.0) -> grpc.Channel:
+    """Dial a unix socket and block until connected (the reference's
+    dial-probe, base.go:185-196); raises on timeout."""
+    ch = grpc.insecure_channel(unix_target(path), options=_CHANNEL_OPTS)
+    grpc.channel_ready_future(ch).result(timeout=timeout_s)
+    return ch
+
+
+# -- DevicePlugin service (server side) ---------------------------------------
+
+
+class DevicePluginServicer:
+    """Override the five kubelet RPCs (reference base impls: base.go:64-96)."""
+
+    def GetDevicePluginOptions(self, request, context):  # noqa: N802
+        return dp.DevicePluginOptions()
+
+    def ListAndWatch(self, request, context):  # noqa: N802
+        raise NotImplementedError
+
+    def GetPreferredAllocation(self, request, context):  # noqa: N802
+        return dp.PreferredAllocationResponse()
+
+    def Allocate(self, request, context):  # noqa: N802
+        raise NotImplementedError
+
+    def PreStartContainer(self, request, context):  # noqa: N802
+        return dp.PreStartContainerResponse()
+
+
+def add_device_plugin_servicer(server: grpc.Server, servicer: DevicePluginServicer) -> None:
+    handlers = {
+        "GetDevicePluginOptions": grpc.unary_unary_rpc_method_handler(
+            servicer.GetDevicePluginOptions,
+            request_deserializer=dp.Empty.FromString,
+            response_serializer=dp.DevicePluginOptions.SerializeToString,
+        ),
+        "ListAndWatch": grpc.unary_stream_rpc_method_handler(
+            servicer.ListAndWatch,
+            request_deserializer=dp.Empty.FromString,
+            response_serializer=dp.ListAndWatchResponse.SerializeToString,
+        ),
+        "GetPreferredAllocation": grpc.unary_unary_rpc_method_handler(
+            servicer.GetPreferredAllocation,
+            request_deserializer=dp.PreferredAllocationRequest.FromString,
+            response_serializer=dp.PreferredAllocationResponse.SerializeToString,
+        ),
+        "Allocate": grpc.unary_unary_rpc_method_handler(
+            servicer.Allocate,
+            request_deserializer=dp.AllocateRequest.FromString,
+            response_serializer=dp.AllocateResponse.SerializeToString,
+        ),
+        "PreStartContainer": grpc.unary_unary_rpc_method_handler(
+            servicer.PreStartContainer,
+            request_deserializer=dp.PreStartContainerRequest.FromString,
+            response_serializer=dp.PreStartContainerResponse.SerializeToString,
+        ),
+    }
+    server.add_generic_rpc_handlers(
+        (grpc.method_handlers_generic_handler("v1beta1.DevicePlugin", handlers),)
+    )
+
+
+class DevicePluginClient:
+    """Client for a device-plugin server (used by the fake kubelet in tests
+    and by bench.py to play the kubelet role)."""
+
+    def __init__(self, channel: grpc.Channel) -> None:
+        p = "/v1beta1.DevicePlugin/"
+        self._options = channel.unary_unary(
+            p + "GetDevicePluginOptions",
+            request_serializer=dp.Empty.SerializeToString,
+            response_deserializer=dp.DevicePluginOptions.FromString,
+        )
+        self._law = channel.unary_stream(
+            p + "ListAndWatch",
+            request_serializer=dp.Empty.SerializeToString,
+            response_deserializer=dp.ListAndWatchResponse.FromString,
+        )
+        self._alloc = channel.unary_unary(
+            p + "Allocate",
+            request_serializer=dp.AllocateRequest.SerializeToString,
+            response_deserializer=dp.AllocateResponse.FromString,
+        )
+        self._prestart = channel.unary_unary(
+            p + "PreStartContainer",
+            request_serializer=dp.PreStartContainerRequest.SerializeToString,
+            response_deserializer=dp.PreStartContainerResponse.FromString,
+        )
+        self._preferred = channel.unary_unary(
+            p + "GetPreferredAllocation",
+            request_serializer=dp.PreferredAllocationRequest.SerializeToString,
+            response_deserializer=dp.PreferredAllocationResponse.FromString,
+        )
+
+    def get_options(self) -> dp.DevicePluginOptions:
+        return self._options(dp.Empty())
+
+    def list_and_watch(self) -> Iterable[dp.ListAndWatchResponse]:
+        return self._law(dp.Empty())
+
+    def allocate(self, device_ids: Iterable[str]) -> dp.AllocateResponse:
+        return self._alloc(
+            dp.AllocateRequest(
+                container_requests=[
+                    dp.ContainerAllocateRequest(devicesIDs=list(device_ids))
+                ]
+            )
+        )
+
+    def pre_start_container(self, device_ids: Iterable[str]) -> dp.PreStartContainerResponse:
+        return self._prestart(
+            dp.PreStartContainerRequest(devicesIDs=list(device_ids))
+        )
+
+    def get_preferred_allocation(
+        self, available: Iterable[str], must_include: Iterable[str], size: int
+    ) -> dp.PreferredAllocationResponse:
+        return self._preferred(
+            dp.PreferredAllocationRequest(
+                container_requests=[
+                    dp.ContainerPreferredAllocationRequest(
+                        available_deviceIDs=list(available),
+                        must_include_deviceIDs=list(must_include),
+                        allocation_size=size,
+                    )
+                ]
+            )
+        )
+
+
+# -- Registration service ------------------------------------------------------
+
+
+class RegistrationClient:
+    """Register a plugin endpoint with kubelet (reference: base.go:141-160)."""
+
+    def __init__(self, kubelet_socket: str) -> None:
+        self._socket = kubelet_socket
+
+    def register(
+        self,
+        endpoint: str,
+        resource_name: str,
+        pre_start_required: bool = True,
+        timeout_s: float = 10.0,
+    ) -> None:
+        ch = dial(self._socket, timeout_s)
+        try:
+            method = ch.unary_unary(
+                "/v1beta1.Registration/Register",
+                request_serializer=dp.RegisterRequest.SerializeToString,
+                response_deserializer=dp.Empty.FromString,
+            )
+            method(
+                dp.RegisterRequest(
+                    version=DEVICE_PLUGIN_VERSION,
+                    endpoint=endpoint,
+                    resource_name=resource_name,
+                    options=dp.DevicePluginOptions(
+                        pre_start_required=pre_start_required
+                    ),
+                ),
+                timeout=timeout_s,
+            )
+        finally:
+            ch.close()
+
+
+def add_registration_servicer(
+    server: grpc.Server, register_fn: Callable[[dp.RegisterRequest], None]
+) -> None:
+    """Server side of Registration — the agent never serves this (kubelet
+    does); the fake kubelet in tests does."""
+
+    def _register(request, context):  # noqa: ARG001
+        register_fn(request)
+        return dp.Empty()
+
+    handlers = {
+        "Register": grpc.unary_unary_rpc_method_handler(
+            _register,
+            request_deserializer=dp.RegisterRequest.FromString,
+            response_serializer=dp.Empty.SerializeToString,
+        )
+    }
+    server.add_generic_rpc_handlers(
+        (grpc.method_handlers_generic_handler("v1beta1.Registration", handlers),)
+    )
+
+
+# -- PodResourcesLister service ------------------------------------------------
+
+
+class PodResourcesClient:
+    """List() of pod->container->devices (reference: podresources/client.go
+    + locator.go:32-41). Lazily re-dials on failure."""
+
+    def __init__(self, socket_path: str = POD_RESOURCES_SOCKET) -> None:
+        self._socket = socket_path
+        self._channel: Optional[grpc.Channel] = None
+        self._list = None
+
+    def _ensure(self, timeout_s: float) -> None:
+        if self._channel is None:
+            self._channel = grpc.insecure_channel(
+                unix_target(self._socket), options=_CHANNEL_OPTS
+            )
+            grpc.channel_ready_future(self._channel).result(timeout=timeout_s)
+            self._list = self._channel.unary_unary(
+                "/v1alpha1.PodResourcesLister/List",
+                request_serializer=pr.ListPodResourcesRequest.SerializeToString,
+                response_deserializer=pr.ListPodResourcesResponse.FromString,
+            )
+
+    def reset(self) -> None:
+        if self._channel is not None:
+            self._channel.close()
+        self._channel = None
+        self._list = None
+
+    def list(self, timeout_s: float = 5.0) -> pr.ListPodResourcesResponse:
+        try:
+            self._ensure(timeout_s)
+            return self._list(pr.ListPodResourcesRequest(), timeout=timeout_s)
+        except grpc.RpcError:
+            self.reset()  # re-dial next call (reference: locator.go:47-53)
+            raise
+
+    def close(self) -> None:
+        self.reset()
+
+
+def add_pod_resources_servicer(
+    server: grpc.Server,
+    list_fn: Callable[[], pr.ListPodResourcesResponse],
+) -> None:
+    """Server side of pod-resources — served by kubelet in production, by
+    the fake kubelet in tests (the reference shipped an unused server impl
+    it never wired up as a fake; we use ours, SURVEY.md §4)."""
+
+    def _list(request, context):  # noqa: ARG001
+        return list_fn()
+
+    handlers = {
+        "List": grpc.unary_unary_rpc_method_handler(
+            _list,
+            request_deserializer=pr.ListPodResourcesRequest.FromString,
+            response_serializer=pr.ListPodResourcesResponse.SerializeToString,
+        )
+    }
+    server.add_generic_rpc_handlers(
+        (grpc.method_handlers_generic_handler("v1alpha1.PodResourcesLister", handlers),)
+    )

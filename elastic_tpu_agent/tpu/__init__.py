@@ -1,0 +1,17 @@
+from .operator import TPUChip, TPUOperator, OperatorError
+from .stub import StubOperator
+from .tpuvm import TPUVMOperator
+from .exclusive import ExclusiveOperator
+from .topology import ChipSpec, TopologyInfo, parse_accelerator_type
+
+__all__ = [
+    "TPUChip",
+    "TPUOperator",
+    "OperatorError",
+    "StubOperator",
+    "TPUVMOperator",
+    "ExclusiveOperator",
+    "ChipSpec",
+    "TopologyInfo",
+    "parse_accelerator_type",
+]

@@ -1,0 +1,30 @@
+"""Exclusive (whole-chip) operator: no virtual nodes needed.
+
+Parity with the reference's NvidiaOperator no-op passthrough
+(pkg/operator/nvidia.go:1-22): in whole-chip mode the kubelet's own
+device-plugin device list already maps 1:1 to physical chips, so
+create/delete/check are no-ops and only discovery matters.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from .operator import TPUOperator, TPUChip
+
+
+class ExclusiveOperator(TPUOperator):
+    def __init__(self, inner: TPUOperator) -> None:
+        self._inner = inner
+
+    def devices(self) -> List[TPUChip]:
+        return self._inner.devices()
+
+    def create(self, index: int, link_id: str) -> None:  # noqa: ARG002
+        return None
+
+    def delete(self, link_id: str) -> None:  # noqa: ARG002
+        return None
+
+    def check(self, link_id: str) -> bool:  # noqa: ARG002
+        return True

@@ -1,0 +1,144 @@
+"""TPU operator contract + shared virtual-device-node mechanics.
+
+Capability parity with the reference's ``pkg/operator`` (SURVEY.md §1 L4):
+``GPUOperator{Devices, Create, Delete, Check}`` becomes ``TPUOperator``.
+The virtual-device scheme carries over: a hash-named symlink under the
+host's /dev whose *target* encodes the physical chip, so the OCI prestart
+hook can resolve allocations with nothing but readlink
+(reference: /dev/elastic-gpu-<id> -> /dev/nvidiaN, operator/gpushare.go:31-55;
+hook resolve at elastic-gpu-hook/main.go:122-158).
+
+TPU-native differences:
+- targets are ``/dev/accel<index>`` (TPU-VM chardevs) instead of
+  ``/dev/nvidiaN``; there is no per-node "ctl" device to mirror, so one
+  link per chip (no elastic-gpuctl-* analogue).
+- chips carry HBM size, TensorCore count, and (optionally) vfio paths from
+  discovery, since fractional tpu-memory advertisement needs HBM and slice
+  env needs topology (SURVEY.md §2 native item 3).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import re
+from abc import ABC, abstractmethod
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+from ..common import VirtualDevPrefix
+
+logger = logging.getLogger(__name__)
+
+
+class OperatorError(Exception):
+    pass
+
+
+@dataclass(frozen=True)
+class TPUChip:
+    """One physical TPU chip as discovered on this host."""
+
+    uuid: str              # stable id (e.g. "tpu-v5e-<host>-3" or metadata id)
+    index: int             # host-local chip index (the N of /dev/accelN)
+    device_path: str       # host path of the chardev, e.g. "/dev/accel3"
+    hbm_bytes: int         # HBM capacity of this chip
+    cores: int             # TensorCores on this chip
+    extra_paths: List[str] = field(default_factory=list)  # e.g. vfio nodes
+
+
+class TPUOperator(ABC):
+    """Physical device layer: discovery + virtual node lifecycle."""
+
+    @abstractmethod
+    def devices(self) -> List[TPUChip]:
+        """Enumerate this host's chips (reference: Devices(), base.go:19-45)."""
+
+    @abstractmethod
+    def create(self, index: int, link_id: str) -> None:
+        """Materialize virtual node ``elastic-tpu-<link_id>`` -> chip <index>."""
+
+    @abstractmethod
+    def delete(self, link_id: str) -> None:
+        """Remove the virtual node; missing nodes are not an error."""
+
+    @abstractmethod
+    def check(self, link_id: str) -> bool:
+        """True when the virtual node exists."""
+
+
+# -- shared symlink mechanics -------------------------------------------------
+
+_ACCEL_RE = re.compile(r"accel(\d+)$")
+
+
+def chip_index_from_target(target: str) -> Optional[int]:
+    """Parse the chip index out of a link target like "/dev/accel3"
+    (reference parsed N from /dev/nvidiaN, hook main.go:122-130)."""
+    m = _ACCEL_RE.search(target)
+    return int(m.group(1)) if m else None
+
+
+class LinkingOperator(TPUOperator):
+    """Base for operators that realize virtual devices as symlinks.
+
+    ``dev_root`` is the host's /dev as mounted into the agent container
+    (default /host/dev — deploy manifest hostPath). Link *targets* are
+    host-namespace paths (/dev/accelN): they may dangle inside the agent
+    container, which is fine — only the host-side hook resolves them.
+    """
+
+    def __init__(self, dev_root: str, target_root: str = "/dev") -> None:
+        self._dev_root = dev_root
+        self._target_root = target_root
+
+    def link_path(self, link_id: str) -> str:
+        return os.path.join(self._dev_root, VirtualDevPrefix + link_id)
+
+    def target_path(self, index: int) -> str:
+        return os.path.join(self._target_root, f"accel{index}")
+
+    def create(self, index: int, link_id: str) -> None:
+        link = self.link_path(link_id)
+        target = self.target_path(index)
+        try:
+            if os.path.islink(link):
+                if os.readlink(link) == target:
+                    return  # idempotent re-create (Restore path)
+                os.unlink(link)
+            os.symlink(target, link)
+        except OSError as e:
+            raise OperatorError(f"create {link} -> {target}: {e}") from e
+        logger.info("created virtual TPU node %s -> %s", link, target)
+
+    def delete(self, link_id: str) -> None:
+        link = self.link_path(link_id)
+        try:
+            os.unlink(link)
+            logger.info("removed virtual TPU node %s", link)
+        except FileNotFoundError:
+            pass
+        except OSError as e:
+            raise OperatorError(f"delete {link}: {e}") from e
+
+    def check(self, link_id: str) -> bool:
+        return os.path.islink(self.link_path(link_id))
+
+    def resolve(self, link_id: str) -> Optional[int]:
+        """Chip index a virtual node points at, or None."""
+        try:
+            return chip_index_from_target(os.readlink(self.link_path(link_id)))
+        except OSError:
+            return None
+
+    def list_links(self) -> List[str]:
+        """All virtual-node link ids currently present (Restore/GC sweep)."""
+        try:
+            names = os.listdir(self._dev_root)
+        except OSError:
+            return []
+        return [
+            n[len(VirtualDevPrefix):]
+            for n in names
+            if n.startswith(VirtualDevPrefix)
+        ]

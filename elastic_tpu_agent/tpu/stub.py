@@ -1,0 +1,50 @@
+"""Stub operator: fake chips for hermetic CI (BASELINE config 1).
+
+The reference had no fake backend at all (SURVEY.md §4); this operator is
+the deliberate seam that lets the whole control plane — plugins, manager,
+GC, Restore, e2e fake-kubelet tests — run on a CPU-only kind node or in CI
+with zero TPU hardware.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from .operator import LinkingOperator, TPUChip
+from .topology import TopologyInfo, parse_accelerator_type
+
+
+class StubOperator(LinkingOperator):
+    """N fake chips with table-accurate HBM/core counts."""
+
+    def __init__(
+        self,
+        dev_root: str,
+        accelerator_type: str = "v5litepod-4",
+        num_chips: Optional[int] = None,
+        hostname: str = "stub-host",
+    ) -> None:
+        super().__init__(dev_root)
+        topo = parse_accelerator_type(accelerator_type)
+        if topo is None:
+            raise ValueError(f"unknown accelerator type {accelerator_type!r}")
+        self._topo = topo
+        self._num = num_chips if num_chips is not None else topo.chips_per_host
+        self._hostname = hostname
+
+    @property
+    def topology(self) -> TopologyInfo:
+        return self._topo
+
+    def devices(self) -> List[TPUChip]:
+        spec = self._topo.spec
+        return [
+            TPUChip(
+                uuid=f"stub-{spec.family}-{self._hostname}-{i}",
+                index=i,
+                device_path=self.target_path(i),
+                hbm_bytes=spec.hbm_bytes,
+                cores=spec.cores_per_chip,
+            )
+            for i in range(self._num)
+        ]

@@ -1,0 +1,131 @@
+"""Static TPU generation table + accelerator-type parsing.
+
+The reference had NVML to answer "how many devices, how much memory"
+(pkg/operator/base.go:19-75). TPU has no NVML analogue (SURVEY.md §7 "hard
+parts"): inventory is assembled from /dev/accel*, /sys, the TPU-VM metadata
+server, and this static per-generation table. The table carries the facts
+that are intrinsic to the silicon — TensorCores per chip, HBM per chip,
+chips per host — keyed by accelerator-type strings like ``v5litepod-8``,
+``v4-16``, ``v5p-16``, ``v6e-8``.
+
+Naming convention note (public Cloud TPU docs): the numeric suffix counts
+*TensorCores* for v2/v3/v4/v5p (2 cores/chip) and *chips* for
+v5litepod/v6e (1 core/chip).
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass
+from typing import Dict, Optional, Tuple
+
+GiB = 1024 * 1024 * 1024
+
+
+@dataclass(frozen=True)
+class ChipSpec:
+    """Per-chip facts for one TPU generation."""
+
+    family: str            # "v4", "v5e", ...
+    cores_per_chip: int    # TensorCores per chip
+    hbm_bytes: int         # HBM per chip
+    max_chips_per_host: int
+    suffix_counts_cores: bool  # accelerator-type suffix unit (see module doc)
+
+
+# Generation table. Sources: public Cloud TPU system-architecture docs.
+_SPECS: Dict[str, ChipSpec] = {
+    "v2": ChipSpec("v2", 2, 16 * GiB, 4, True),
+    "v3": ChipSpec("v3", 2, 32 * GiB, 4, True),
+    "v4": ChipSpec("v4", 2, 32 * GiB, 4, True),
+    "v5e": ChipSpec("v5e", 1, 16 * GiB, 8, False),
+    "v5p": ChipSpec("v5p", 2, 95 * GiB, 4, True),
+    "v6e": ChipSpec("v6e", 1, 32 * GiB, 8, False),
+}
+
+# Accepted accelerator-type spellings -> family key.
+_FAMILY_ALIASES = {
+    "v2": "v2",
+    "v3": "v3",
+    "v4": "v4",
+    "v5litepod": "v5e",
+    "v5e": "v5e",
+    "v5p": "v5p",
+    "v6e": "v6e",
+}
+
+_TYPE_RE = re.compile(r"^(?P<family>[a-z0-9]+?)-(?P<count>\d+)$")
+
+
+@dataclass(frozen=True)
+class TopologyInfo:
+    """Parsed accelerator-type: slice-wide and per-host chip facts."""
+
+    accelerator_type: str
+    spec: ChipSpec
+    total_chips: int       # chips in the whole slice
+    total_cores: int       # TensorCores in the whole slice
+    chips_per_host: int
+    num_hosts: int
+
+    @property
+    def is_multi_host(self) -> bool:
+        return self.num_hosts > 1
+
+
+def parse_accelerator_type(acc_type: str) -> Optional[TopologyInfo]:
+    """Parse e.g. "v5litepod-8" / "v4-16" / "v5p-128"; None when unknown."""
+    m = _TYPE_RE.match(acc_type.strip().lower())
+    if not m:
+        return None
+    family = _FAMILY_ALIASES.get(m.group("family"))
+    if family is None:
+        return None
+    spec = _SPECS[family]
+    count = int(m.group("count"))
+    if count <= 0:
+        return None
+    if spec.suffix_counts_cores:
+        total_cores = count
+        total_chips = max(1, count // spec.cores_per_chip)
+    else:
+        total_chips = count
+        total_cores = count * spec.cores_per_chip
+    chips_per_host = min(total_chips, spec.max_chips_per_host)
+    num_hosts = max(1, (total_chips + chips_per_host - 1) // chips_per_host)
+    return TopologyInfo(
+        accelerator_type=acc_type,
+        spec=spec,
+        total_chips=total_chips,
+        total_cores=total_cores,
+        chips_per_host=chips_per_host,
+        num_hosts=num_hosts,
+    )
+
+
+def spec_for_family(family: str) -> Optional[ChipSpec]:
+    key = _FAMILY_ALIASES.get(family.lower())
+    return _SPECS.get(key) if key else None
+
+
+def host_bounds(topo: TopologyInfo) -> Tuple[str, str]:
+    """(TPU_CHIPS_PER_HOST_BOUNDS, TPU_HOST_BOUNDS) env values for
+    jax.distributed slice formation (BASELINE config 5).
+
+    Physical ICI layouts vary per shape; we emit the standard defaults:
+    chips on one host form an x,y grid with z=1, hosts tile the remaining
+    dimension. Matches the conventions libtpu expects for the common
+    v4/v5p pod-slice shapes and degenerates to flat grids for v5e/v6e.
+    """
+    cph = topo.chips_per_host
+    if cph >= 4:
+        chip_bounds = f"2,{cph // 2},1"
+    else:
+        chip_bounds = f"{cph},1,1"
+    n = topo.num_hosts
+    # Tile hosts as close to a square as divisibility allows.
+    best = (1, n)
+    for a in range(1, int(n**0.5) + 1):
+        if n % a == 0:
+            best = (a, n // a)
+    return chip_bounds, f"{best[0]},{best[1]},1"

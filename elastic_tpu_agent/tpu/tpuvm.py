@@ -1,0 +1,179 @@
+"""TPU-VM operator: real chip discovery on a Cloud TPU host.
+
+Replaces the reference's NVML enumeration (pkg/operator/base.go:19-75,
+cgo → driver) with the TPU-native inventory sources (SURVEY.md §2 native
+item 3, §7 "hard parts" — there is no NVML analogue, so we assemble from
+partial information and tolerate every source being absent):
+
+1. ``/dev/accel*`` (and ``/dev/vfio/*`` on vfio-based stacks) — which
+   chardevs exist, i.e. how many chips this host exposes.
+2. GCE metadata server — ``accelerator-type`` (e.g. "v5litepod-8") and
+   ``agent-worker-number`` / ``tpu-env`` for multi-host slice identity.
+3. Environment (``TPU_ACCELERATOR_TYPE``, ``TPU_WORKER_ID``) — GKE and
+   test overrides.
+4. The static generation table (topology.py) — HBM/TensorCores per chip.
+"""
+
+from __future__ import annotations
+
+import glob
+import logging
+import os
+import re
+from typing import Callable, Dict, List, Optional
+
+from .operator import LinkingOperator, TPUChip
+from .topology import GiB, TopologyInfo, parse_accelerator_type
+
+logger = logging.getLogger(__name__)
+
+_METADATA_URL = (
+    "http://metadata.google.internal/computeMetadata/v1/instance/attributes/"
+)
+_METADATA_HEADERS = {"Metadata-Flavor": "Google"}
+_METADATA_TIMEOUT_S = 2.0
+
+# Conservative fallback when the generation cannot be determined: assume the
+# smallest HBM of any supported generation so fractional tpu-memory is never
+# over-advertised.
+_FALLBACK_HBM_BYTES = 16 * GiB
+_FALLBACK_CORES = 1
+
+MetadataFetcher = Callable[[str], Optional[str]]
+
+
+def _default_metadata_fetcher(attribute: str) -> Optional[str]:
+    try:
+        import requests
+
+        resp = requests.get(
+            _METADATA_URL + attribute,
+            headers=_METADATA_HEADERS,
+            timeout=_METADATA_TIMEOUT_S,
+        )
+        if resp.status_code == 200:
+            return resp.text.strip()
+    except Exception:  # noqa: BLE001 - any transport failure = "absent"
+        pass
+    return None
+
+
+def parse_tpu_env(raw: str) -> Dict[str, str]:
+    """Parse the metadata ``tpu-env`` attribute: lines of KEY: 'value'."""
+    out: Dict[str, str] = {}
+    for line in raw.splitlines():
+        m = re.match(r"^\s*([A-Z0-9_]+)\s*:\s*'?([^']*)'?\s*$", line)
+        if m:
+            out[m.group(1)] = m.group(2)
+    return out
+
+
+class TPUVMOperator(LinkingOperator):
+    """Discovery against a real (or faked-in-tests) TPU-VM host."""
+
+    def __init__(
+        self,
+        dev_root: str,
+        host_dev_scan_root: Optional[str] = None,
+        metadata: MetadataFetcher = _default_metadata_fetcher,
+        env: Optional[Dict[str, str]] = None,
+    ) -> None:
+        # dev_root: where virtual links are created (host /dev mount).
+        # host_dev_scan_root: where to look for accel* chardevs (defaults to
+        # the same mount — tests point both at a fixture dir).
+        super().__init__(dev_root)
+        self._scan_root = host_dev_scan_root or dev_root
+        self._metadata = metadata
+        self._env = env if env is not None else dict(os.environ)
+        self._topology: Optional[TopologyInfo] = None
+
+    # -- inventory sources ---------------------------------------------------
+
+    def _accel_indexes(self) -> List[int]:
+        found = []
+        for path in glob.glob(os.path.join(self._scan_root, "accel[0-9]*")):
+            m = re.search(r"accel(\d+)$", path)
+            if m:
+                found.append(int(m.group(1)))
+        return sorted(found)
+
+    def _vfio_paths(self) -> List[str]:
+        return sorted(glob.glob(os.path.join(self._scan_root, "vfio", "*")))
+
+    def accelerator_type(self) -> Optional[str]:
+        for key in ("TPU_ACCELERATOR_TYPE", "ACCELERATOR_TYPE"):
+            if self._env.get(key):
+                return self._env[key]
+        val = self._metadata("accelerator-type")
+        if val:
+            return val
+        raw = self._metadata("tpu-env")
+        if raw:
+            parsed = parse_tpu_env(raw)
+            if parsed.get("ACCELERATOR_TYPE"):
+                return parsed["ACCELERATOR_TYPE"]
+        return None
+
+    def worker_id(self) -> int:
+        for key in ("TPU_WORKER_ID",):
+            if self._env.get(key):
+                try:
+                    return int(self._env[key])
+                except ValueError:
+                    pass
+        val = self._metadata("agent-worker-number")
+        if val:
+            try:
+                return int(val)
+            except ValueError:
+                pass
+        return 0
+
+    def worker_hostnames(self) -> List[str]:
+        raw = self._env.get("TPU_WORKER_HOSTNAMES")
+        if not raw:
+            meta = self._metadata("worker-network-endpoints")
+            if meta:
+                # comma-separated list of ip:port:... triples; keep the ips
+                raw = ",".join(p.split(":")[2] if p.count(":") >= 2 else p
+                               for p in meta.split(","))
+        return [h for h in (raw or "").split(",") if h]
+
+    @property
+    def topology(self) -> Optional[TopologyInfo]:
+        if self._topology is None:
+            acc = self.accelerator_type()
+            if acc:
+                self._topology = parse_accelerator_type(acc)
+                if self._topology is None:
+                    logger.warning("unrecognized accelerator-type %r", acc)
+        return self._topology
+
+    # -- TPUOperator ---------------------------------------------------------
+
+    def devices(self) -> List[TPUChip]:
+        indexes = self._accel_indexes()
+        vfio = self._vfio_paths()
+        topo = self.topology
+        if topo is not None:
+            hbm, cores = topo.spec.hbm_bytes, topo.spec.cores_per_chip
+            family = topo.spec.family
+        else:
+            hbm, cores, family = _FALLBACK_HBM_BYTES, _FALLBACK_CORES, "tpu"
+            if indexes:
+                logger.warning(
+                    "accelerator-type unknown; advertising conservative "
+                    "%d GiB HBM / %d core per chip", hbm // GiB, cores,
+                )
+        worker = self.worker_id()
+        return [
+            TPUChip(
+                uuid=f"{family}-w{worker}-chip{i}",
+                index=i,
+                device_path=self.target_path(i),
+                hbm_bytes=hbm,
+                cores=cores,
+                extra_paths=vfio,
+            )
+            for i in indexes
+        ]

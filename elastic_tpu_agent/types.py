@@ -80,10 +80,6 @@ class PodContainer:
         return f"{self.namespace}/{self.name}"
 
 
-# Maps container name -> Device (reference ContainerDeviceMap, pod.go:51-62).
-ContainerDeviceMap = Dict[str, Device]
-
-
 @dataclass
 class AllocationRecord:
     """Extra per-container binding state beyond the Device identity.
@@ -117,23 +113,37 @@ class AllocationRecord:
 
 @dataclass
 class PodInfo:
-    """Pod binding record: namespace/name + container -> allocation map.
+    """Pod binding record: namespace/name + container -> resource -> record.
 
     JSON-(de)serializable; this is the value stored in the checkpoint store
-    (reference: pod.go:24-62 persisted as JSON in BoltDB).
+    (reference: pod.go:24-62 persisted as JSON in BoltDB). Unlike the
+    reference's flat container->Device map, allocations are keyed by
+    container THEN resource: a container normally holds both a tpu-core and
+    a tpu-memory binding, and the reference's flat map let one overwrite
+    the other, leaking the loser's /dev links at GC (SURVEY.md §7 defects).
     """
 
     namespace: str
     name: str
-    allocations: Dict[str, AllocationRecord] = field(default_factory=dict)
+    # container name -> resource name -> record
+    allocations: Dict[str, Dict[str, AllocationRecord]] = field(
+        default_factory=dict
+    )
 
     @property
     def key(self) -> str:
         return f"{self.namespace}/{self.name}"
 
-    def device_of(self, container: str) -> Optional[Device]:
-        rec = self.allocations.get(container)
+    def set_allocation(self, container: str, rec: AllocationRecord) -> None:
+        self.allocations.setdefault(container, {})[rec.device.resource] = rec
+
+    def device_of(self, container: str, resource: str) -> Optional[Device]:
+        rec = self.allocations.get(container, {}).get(resource)
         return rec.device if rec else None
+
+    def records(self) -> Iterator["AllocationRecord"]:
+        for by_resource in self.allocations.values():
+            yield from by_resource.values()
 
     def containers(self) -> Iterator[str]:
         return iter(self.allocations)
@@ -144,7 +154,8 @@ class PodInfo:
                 "namespace": self.namespace,
                 "name": self.name,
                 "allocations": {
-                    c: rec.to_dict() for c, rec in self.allocations.items()
+                    c: {r: rec.to_dict() for r, rec in by_res.items()}
+                    for c, by_res in self.allocations.items()
                 },
             },
             sort_keys=True,
@@ -157,8 +168,11 @@ class PodInfo:
             namespace=d["namespace"],
             name=d["name"],
             allocations={
-                c: AllocationRecord.from_dict(rd)
-                for c, rd in d.get("allocations", {}).items()
+                c: {
+                    r: AllocationRecord.from_dict(rd)
+                    for r, rd in by_res.items()
+                }
+                for c, by_res in d.get("allocations", {}).items()
             },
         )
 

@@ -1,0 +1,203 @@
+"""Fake Kubernetes apiserver: the tiny surface the agent touches.
+
+Serves list/watch/get pods (node fieldSelector honored) and get node over
+plain HTTP, enough to drive the Sitter's informer loop and the GC's
+apiserver-NotFound checks hermetically.
+"""
+
+from __future__ import annotations
+
+import json
+import queue
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional, Tuple
+from urllib.parse import parse_qs, urlparse
+
+
+class FakeAPIServer:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._pods: Dict[Tuple[str, str], dict] = {}
+        self._nodes: Dict[str, dict] = {}
+        self._rv = 0
+        self._events: List[tuple] = []  # (rv, event) log for watch replay
+        self._watchers: List[queue.Queue] = []
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    # -- state manipulation (test driver side) --------------------------------
+
+    def upsert_pod(self, pod: dict) -> None:
+        key = (pod["metadata"]["namespace"], pod["metadata"]["name"])
+        with self._lock:
+            self._rv += 1
+            pod.setdefault("metadata", {})["resourceVersion"] = str(self._rv)
+            etype = "MODIFIED" if key in self._pods else "ADDED"
+            self._pods[key] = pod
+            self._notify({"type": etype, "object": pod})
+
+    def delete_pod(self, namespace: str, name: str) -> None:
+        with self._lock:
+            pod = self._pods.pop((namespace, name), None)
+            if pod is not None:
+                self._rv += 1
+                self._notify({"type": "DELETED", "object": pod})
+
+    def add_node(self, name: str) -> None:
+        with self._lock:
+            self._nodes[name] = {"metadata": {"name": name}}
+
+    def _notify(self, event: dict) -> None:
+        self._events.append((self._rv, event))
+        del self._events[:-1000]
+        for q in list(self._watchers):
+            q.put(event)
+
+    # -- lifecycle ------------------------------------------------------------
+
+    def start(self) -> str:
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *args):  # noqa: D102
+                pass
+
+            def _json(self, code: int, body: dict) -> None:
+                raw = json.dumps(body).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(raw)))
+                self.end_headers()
+                self.wfile.write(raw)
+
+            def do_GET(self):  # noqa: N802
+                parsed = urlparse(self.path)
+                params = {k: v[0] for k, v in parse_qs(parsed.query).items()}
+                parts = [p for p in parsed.path.split("/") if p]
+                # /api/v1/pods
+                if parts[:3] == ["api", "v1", "pods"]:
+                    node = params.get("fieldSelector", "").partition("=")[2]
+                    if params.get("watch") == "true":
+                        return self._watch(node, params)
+                    with outer._lock:
+                        items = [
+                            p
+                            for p in outer._pods.values()
+                            if not node
+                            or p.get("spec", {}).get("nodeName") == node
+                        ]
+                        rv = str(outer._rv)
+                    return self._json(
+                        200,
+                        {
+                            "kind": "PodList",
+                            "items": items,
+                            "metadata": {"resourceVersion": rv},
+                        },
+                    )
+                # /api/v1/namespaces/{ns}/pods/{name}
+                if (
+                    len(parts) == 6
+                    and parts[:3] == ["api", "v1", "namespaces"]
+                    and parts[4] == "pods"
+                ):
+                    ns, name = parts[3], parts[5]
+                    with outer._lock:
+                        pod = outer._pods.get((ns, name))
+                    if pod is None:
+                        return self._json(404, {"kind": "Status", "code": 404})
+                    return self._json(200, pod)
+                # /api/v1/nodes/{name}
+                if len(parts) == 4 and parts[:3] == ["api", "v1", "nodes"]:
+                    with outer._lock:
+                        node_obj = outer._nodes.get(parts[3])
+                    if node_obj is None:
+                        return self._json(404, {"kind": "Status", "code": 404})
+                    return self._json(200, node_obj)
+                return self._json(404, {"kind": "Status", "code": 404})
+
+            def _watch(self, node: str, params: dict) -> None:
+                timeout = float(params.get("timeoutSeconds", "30"))
+                try:
+                    since_rv = int(params.get("resourceVersion", "0") or 0)
+                except ValueError:
+                    since_rv = 0
+                q: queue.Queue = queue.Queue()
+                with outer._lock:
+                    # Replay events after the client's resourceVersion so
+                    # nothing falls in the list->watch gap (real apiserver
+                    # semantics).
+                    for rv, event in outer._events:
+                        if rv > since_rv:
+                            q.put(event)
+                    outer._watchers.append(q)
+                try:
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Transfer-Encoding", "chunked")
+                    self.end_headers()
+
+                    def send_chunk(data: bytes) -> None:
+                        self.wfile.write(hex(len(data))[2:].encode())
+                        self.wfile.write(b"\r\n")
+                        self.wfile.write(data)
+                        self.wfile.write(b"\r\n")
+                        self.wfile.flush()
+
+                    import time
+
+                    end = time.monotonic() + timeout
+                    while time.monotonic() < end:
+                        try:
+                            event = q.get(timeout=0.2)
+                        except queue.Empty:
+                            continue
+                        obj = event.get("object", {})
+                        if node and obj.get("spec", {}).get("nodeName") != node:
+                            continue
+                        send_chunk(
+                            (json.dumps(event) + "\n").encode()
+                        )
+                    send_chunk(b"")  # terminating chunk
+                except (BrokenPipeError, ConnectionResetError):
+                    pass
+                finally:
+                    with outer._lock:
+                        if q in outer._watchers:
+                            outer._watchers.remove(q)
+
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True, name="fake-apiserver"
+        )
+        self._thread.start()
+        host, port = self._httpd.server_address
+        return f"http://{host}:{port}"
+
+    def stop(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+
+
+def make_pod(
+    namespace: str,
+    name: str,
+    node: str,
+    annotations: Optional[dict] = None,
+    containers: Optional[list] = None,
+) -> dict:
+    return {
+        "metadata": {
+            "namespace": namespace,
+            "name": name,
+            "annotations": annotations or {},
+        },
+        "spec": {
+            "nodeName": node,
+            "containers": containers or [{"name": "main"}],
+        },
+    }

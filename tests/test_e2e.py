@@ -1,0 +1,252 @@
+"""End-to-end: full manager against fake kubelet + fake apiserver + stub
+operator — BASELINE config 1 ("1-pod exclusive alloc via null/stub operator
+on CPU-only node") plus restart-recovery and GC, all over real gRPC/HTTP.
+
+Flow under test (reference SURVEY.md §3.2):
+  scheduler annotates pod -> kubelet Allocate -> PreStartContainer
+  -> virtual nodes + env + alloc spec -> pod delete -> GC reclaim.
+"""
+
+import json
+import os
+import threading
+import time
+
+import pytest
+
+from elastic_tpu_agent.common import (
+    AnnotationAssumed,
+    ResourceTPUCore,
+    ResourceTPUMemory,
+    container_annotation,
+)
+from elastic_tpu_agent.kube.client import KubeClient
+from elastic_tpu_agent.manager import ManagerOptions, TPUManager
+from elastic_tpu_agent.plugins.tpushare import (
+    CORE_ENDPOINT,
+    MEM_ENDPOINT,
+    core_device_id,
+    mem_device_id,
+)
+from elastic_tpu_agent.types import Device
+
+from fake_apiserver import FakeAPIServer, make_pod
+from fake_kubelet import FakeKubelet
+
+
+def wait_until(fn, timeout=10.0):
+    end = time.monotonic() + timeout
+    while time.monotonic() < end:
+        if fn():
+            return True
+        time.sleep(0.02)
+    return fn()
+
+
+class Cluster:
+    """One fully-wired agent instance with fake control plane around it."""
+
+    def __init__(self, tmp_path, node="node-a"):
+        self.node = node
+        self.apiserver = FakeAPIServer()
+        url = self.apiserver.start()
+        self.kubelet = FakeKubelet(
+            str(tmp_path / "dp"), str(tmp_path / "pr" / "kubelet.sock")
+        )
+        self.kubelet.start()
+        self.tmp = tmp_path
+        self.opts = ManagerOptions(
+            node_name=node,
+            db_path=str(tmp_path / "meta.db"),
+            operator_kind="stub:v5litepod-4",
+            dev_root=self._mkdir("dev"),
+            device_plugin_dir=str(tmp_path / "dp"),
+            pod_resources_socket=str(tmp_path / "pr" / "kubelet.sock"),
+            alloc_spec_dir=str(tmp_path / "alloc"),
+            kube_client=KubeClient(url),
+        )
+        self.manager = TPUManager(self.opts)
+
+    def _mkdir(self, name):
+        p = self.tmp / name
+        p.mkdir(exist_ok=True)
+        return str(p)
+
+    def start(self):
+        self.manager.run(block=False)
+        assert self.kubelet.wait_registrations(2), "agent did not register"
+
+    def stop(self):
+        self.manager.stop()
+        self.kubelet.stop()
+        self.apiserver.stop()
+
+
+@pytest.fixture()
+def cluster(tmp_path):
+    c = Cluster(tmp_path)
+    c.start()
+    yield c
+    c.stop()
+
+
+def test_config1_exclusive_allocation_lifecycle(cluster):
+    """A pod requesting an exclusive chip (tpu-core: 100): Allocate ->
+    PreStart -> nodes + env -> delete -> GC."""
+    # scheduler: place + annotate the pod
+    cluster.apiserver.upsert_pod(
+        make_pod(
+            "default", "train-0", cluster.node,
+            annotations={
+                AnnotationAssumed: "true",
+                container_annotation("jax"): "1",
+            },
+            containers=[{"name": "jax"}],
+        )
+    )
+    assert wait_until(
+        lambda: cluster.manager.sitter.get_pod("default", "train-0") is not None
+    )
+    # kubelet: allocate 100 core units on chip 1 and run prestart
+    ids = [core_device_id(1, i) for i in range(100)]
+    resp = cluster.kubelet.kubelet_allocate_flow(
+        CORE_ENDPOINT, "default", "train-0", "jax", ResourceTPUCore, ids
+    )
+    env = dict(resp.container_responses[0].envs)
+    dev_hash = Device(ids, ResourceTPUCore).hash
+    assert env["TPU"] == dev_hash
+    assert env["TPU_VISIBLE_CHIPS"] == "0"
+    # the virtual node exists and resolves to the annotated chip
+    link = os.path.join(cluster.opts.dev_root, f"elastic-tpu-{dev_hash}-0")
+    assert os.readlink(link) == "/dev/accel1"
+    # the container-visible device spec points through the virtual node
+    spec = resp.container_responses[0].devices[0]
+    assert spec.container_path == "/dev/accel0"
+    # alloc spec for the hook
+    with open(os.path.join(str(cluster.tmp / "alloc"), f"{dev_hash}.json")) as f:
+        assert json.load(f)["chip_indexes"] == [1]
+    # binding persisted
+    assert cluster.manager.storage.load("default", "train-0") is not None
+
+    # pod deleted -> informer delete event -> GC reclaims
+    cluster.apiserver.delete_pod("default", "train-0")
+    cluster.kubelet.unassign_pod("default", "train-0")
+    assert wait_until(
+        lambda: cluster.manager.storage.load("default", "train-0") is None,
+        timeout=15.0,
+    ), "GC did not reclaim the deleted pod"
+    assert not os.path.lexists(link)
+
+
+def test_config3_two_pods_fractional_memory_share(cluster):
+    """Two pods 50/50 tpu-memory on one chip (BASELINE config 3 shape)."""
+    half_gib_units = 8 * 1024  # 8 GiB of the chip's 16 GiB
+    for i, pod_name in enumerate(["share-a", "share-b"]):
+        cluster.apiserver.upsert_pod(
+            make_pod(
+                "default", pod_name, cluster.node,
+                annotations={
+                    AnnotationAssumed: "true",
+                    container_annotation("jax"): "2",
+                },
+                containers=[{"name": "jax"}],
+            )
+        )
+        assert wait_until(
+            lambda: cluster.manager.sitter.get_pod("default", pod_name)
+            is not None
+        )
+        ids = [
+            mem_device_id(2, u)
+            for u in range(i * half_gib_units, (i + 1) * half_gib_units)
+        ]
+        resp = cluster.kubelet.kubelet_allocate_flow(
+            MEM_ENDPOINT, "default", pod_name, "jax", ResourceTPUMemory, ids
+        )
+        env = dict(resp.container_responses[0].envs)
+        assert env["ELASTIC_TPU_HBM_LIMIT_BYTES"] == str(
+            half_gib_units * 1024 * 1024
+        )
+    # both pods bound to the same chip, distinct hashes
+    links = cluster.manager.operator.list_links()
+    assert len(links) == 2
+    for link_id in links:
+        assert cluster.manager.operator.resolve(link_id) == 2
+
+
+def test_agent_restart_restores_links(tmp_path):
+    """Agent dies, /dev is wiped, agent restarts: bindings and virtual
+    nodes come back (the reference declared Restore() and never wrote it)."""
+    c = Cluster(tmp_path)
+    c.start()
+    c.apiserver.upsert_pod(
+        make_pod(
+            "default", "survivor", c.node,
+            annotations={
+                AnnotationAssumed: "true",
+                container_annotation("jax"): "3",
+            },
+            containers=[{"name": "jax"}],
+        )
+    )
+    assert wait_until(
+        lambda: c.manager.sitter.get_pod("default", "survivor") is not None
+    )
+    ids = [core_device_id(3, i) for i in range(100)]
+    c.kubelet.kubelet_allocate_flow(
+        CORE_ENDPOINT, "default", "survivor", "jax", ResourceTPUCore, ids
+    )
+    dev_hash = Device(ids, ResourceTPUCore).hash
+    link = os.path.join(c.opts.dev_root, f"elastic-tpu-{dev_hash}-0")
+    assert os.path.islink(link)
+
+    # Kill the agent; wipe /dev (host reboot semantics); keep the db file.
+    c.manager.stop()
+    os.unlink(link)
+    assert not os.path.lexists(link)
+
+    # Second agent generation over the same db + cluster state.
+    mgr2 = TPUManager(c.opts)
+    mgr2.run(block=False)
+    report_link_back = wait_until(lambda: os.path.islink(link), timeout=10.0)
+    assert report_link_back, "restore() did not re-create the virtual node"
+    assert os.readlink(link) == "/dev/accel3"
+    mgr2.stop()
+    c.kubelet.stop()
+    c.apiserver.stop()
+
+
+def test_restart_reclaims_dead_pods(tmp_path):
+    """Pod vanished while the agent was down -> restore() reclaims at boot."""
+    c = Cluster(tmp_path)
+    c.start()
+    c.apiserver.upsert_pod(
+        make_pod(
+            "default", "gone", c.node,
+            annotations={
+                AnnotationAssumed: "true",
+                container_annotation("jax"): "0",
+            },
+            containers=[{"name": "jax"}],
+        )
+    )
+    assert wait_until(
+        lambda: c.manager.sitter.get_pod("default", "gone") is not None
+    )
+    ids = [core_device_id(0, i) for i in range(10)]
+    c.kubelet.kubelet_allocate_flow(
+        CORE_ENDPOINT, "default", "gone", "jax", ResourceTPUCore, ids
+    )
+    c.manager.stop()
+    # pod deleted while agent is down
+    c.apiserver.delete_pod("default", "gone")
+
+    mgr2 = TPUManager(c.opts)
+    mgr2.run(block=False)
+    assert wait_until(
+        lambda: mgr2.storage.load("default", "gone") is None, timeout=10.0
+    ), "restore() did not reclaim the dead pod"
+    assert mgr2.operator.list_links() == []
+    mgr2.stop()
+    c.kubelet.stop()
+    c.apiserver.stop()

@@ -1,0 +1,78 @@
+"""Regression: kubelet reuses a fake-device-id set for a NEW pod after the
+old owner died — the locator's cache must not pin the dead owner forever."""
+
+import os
+import threading
+
+import pytest
+
+from elastic_tpu_agent import rpc
+from elastic_tpu_agent.common import (
+    AnnotationAssumed,
+    ResourceTPUCore,
+    container_annotation,
+)
+from elastic_tpu_agent.kube.locator import KubeletDeviceLocator
+from elastic_tpu_agent.plugins.base import PluginConfig
+from elastic_tpu_agent.plugins.tpushare import (
+    CORE_ENDPOINT,
+    TPUSharePlugin,
+    core_device_id,
+)
+from elastic_tpu_agent.storage import Storage
+from elastic_tpu_agent.tpu import StubOperator
+
+from fake_kubelet import FakeKubelet, FakeSitter
+
+
+def test_reused_device_ids_bind_to_new_pod(tmp_path):
+    dp_dir = str(tmp_path / "dp")
+    pr_sock = str(tmp_path / "pr" / "kubelet.sock")
+    dev_root = str(tmp_path / "dev")
+    os.makedirs(dev_root)
+    kubelet = FakeKubelet(dp_dir, pr_sock)
+    kubelet.start()
+    sitter = FakeSitter()
+    storage = Storage(str(tmp_path / "meta.db"))
+    pr_client = rpc.PodResourcesClient(pr_sock)
+    config = PluginConfig(
+        device_plugin_dir=dp_dir,
+        pod_resources_socket=pr_sock,
+        operator=StubOperator(dev_root, "v5litepod-4"),
+        sitter=sitter,
+        storage=storage,
+        locator_factory=lambda res: KubeletDeviceLocator(res, pr_client),
+        extra={"alloc_spec_dir": str(tmp_path / "alloc")},
+    )
+    plugin = TPUSharePlugin(config)
+    stop = threading.Event()
+    plugin.run(stop)
+    assert kubelet.wait_registrations(2)
+    try:
+        ann = {AnnotationAssumed: "true", container_annotation("jax"): "0"}
+        ids = [core_device_id(0, i) for i in range(100)]
+
+        # pod A binds with the full id set (locator caches hash -> A)
+        sitter.add_pod("default", "pod-a", ann)
+        kubelet.kubelet_allocate_flow(
+            CORE_ENDPOINT, "default", "pod-a", "jax", ResourceTPUCore, ids
+        )
+        assert storage.load("default", "pod-a") is not None
+
+        # pod A dies; kubelet hands the SAME ids to pod B
+        sitter.remove_pod("default", "pod-a")
+        kubelet.unassign_pod("default", "pod-a")
+        plugin.gc_once()
+        sitter.add_pod("default", "pod-b", ann)
+        kubelet.kubelet_allocate_flow(
+            CORE_ENDPOINT, "default", "pod-b", "jax", ResourceTPUCore, ids
+        )
+        assert storage.load("default", "pod-b") is not None, (
+            "stale locator cache prevented rebinding of reused device ids"
+        )
+    finally:
+        stop.set()
+        plugin.core.stop_streams()
+        plugin.memory.stop_streams()
+        kubelet.stop()
+        storage.close()

@@ -1,0 +1,175 @@
+"""Operator layer tests: stub, tpu-vm discovery, exclusive, link mechanics.
+
+Spec source: reference pkg/operator behavior (SURVEY.md §1 L4) — symlink
+create/delete/check with hash-named nodes whose targets encode the physical
+device — plus the TPU-native discovery sources.
+"""
+
+import os
+
+import pytest
+
+from elastic_tpu_agent.tpu import (
+    ExclusiveOperator,
+    StubOperator,
+    TPUVMOperator,
+)
+from elastic_tpu_agent.tpu.operator import chip_index_from_target
+from elastic_tpu_agent.tpu.tpuvm import parse_tpu_env
+
+
+@pytest.fixture()
+def dev_root(tmp_path):
+    d = tmp_path / "dev"
+    d.mkdir()
+    return str(d)
+
+
+# -- link mechanics ----------------------------------------------------------
+
+
+def test_create_check_delete_roundtrip(dev_root):
+    op = StubOperator(dev_root, "v5litepod-4")
+    op.create(2, "deadbeef-0")
+    link = os.path.join(dev_root, "elastic-tpu-deadbeef-0")
+    assert os.path.islink(link)
+    assert os.readlink(link) == "/dev/accel2"
+    assert op.check("deadbeef-0")
+    assert op.resolve("deadbeef-0") == 2
+    op.delete("deadbeef-0")
+    assert not op.check("deadbeef-0")
+    op.delete("deadbeef-0")  # idempotent
+
+
+def test_create_idempotent_and_retarget(dev_root):
+    op = StubOperator(dev_root, "v5litepod-4")
+    op.create(1, "aaaa-0")
+    op.create(1, "aaaa-0")  # same target: no-op (Restore path)
+    assert op.resolve("aaaa-0") == 1
+    op.create(3, "aaaa-0")  # stale link to different chip: retargeted
+    assert op.resolve("aaaa-0") == 3
+
+
+def test_list_links(dev_root):
+    op = StubOperator(dev_root, "v5litepod-4")
+    op.create(0, "h1-0")
+    op.create(1, "h2-0")
+    (os.path.join(dev_root, "unrelated"))
+    open(os.path.join(dev_root, "unrelated"), "w").close()
+    assert sorted(op.list_links()) == ["h1-0", "h2-0"]
+
+
+def test_chip_index_from_target():
+    assert chip_index_from_target("/dev/accel7") == 7
+    assert chip_index_from_target("/dev/accel12") == 12
+    assert chip_index_from_target("/dev/nvidia3") is None
+    assert chip_index_from_target("garbage") is None
+
+
+# -- stub discovery ----------------------------------------------------------
+
+
+def test_stub_devices_match_table(dev_root):
+    op = StubOperator(dev_root, "v5litepod-4")
+    chips = op.devices()
+    assert len(chips) == 4
+    assert chips[0].hbm_bytes == 16 * 1024**3
+    assert chips[0].cores == 1
+    assert chips[2].device_path == "/dev/accel2"
+    assert len({c.uuid for c in chips}) == 4  # unique ids
+
+
+def test_stub_v5p(dev_root):
+    op = StubOperator(dev_root, "v5p-8")
+    chips = op.devices()
+    assert len(chips) == 4
+    assert chips[0].cores == 2
+    assert chips[0].hbm_bytes == 95 * 1024**3
+
+
+def test_stub_rejects_unknown_type(dev_root):
+    with pytest.raises(ValueError):
+        StubOperator(dev_root, "h100-8")
+
+
+# -- exclusive wrapper -------------------------------------------------------
+
+
+def test_exclusive_noop(dev_root):
+    op = ExclusiveOperator(StubOperator(dev_root, "v5litepod-4"))
+    assert len(op.devices()) == 4
+    op.create(0, "x")  # no link materialized
+    assert os.listdir(dev_root) == []
+    assert op.check("x") is True
+    op.delete("x")
+
+
+# -- tpu-vm discovery --------------------------------------------------------
+
+
+def fake_dev(tmp_path, n, vfio=0):
+    d = tmp_path / "hostdev"
+    d.mkdir(exist_ok=True)
+    for i in range(n):
+        (d / f"accel{i}").touch()
+    if vfio:
+        (d / "vfio").mkdir()
+        for i in range(vfio):
+            (d / "vfio" / str(i)).touch()
+    return str(d)
+
+
+def test_tpuvm_discovery_with_metadata(tmp_path):
+    root = fake_dev(tmp_path, 4, vfio=2)
+    meta = {"accelerator-type": "v5litepod-4", "agent-worker-number": "0"}
+    op = TPUVMOperator(root, metadata=meta.get, env={})
+    chips = op.devices()
+    assert [c.index for c in chips] == [0, 1, 2, 3]
+    assert chips[0].hbm_bytes == 16 * 1024**3
+    assert chips[0].uuid == "v5e-w0-chip0"
+    assert len(chips[0].extra_paths) == 2
+    assert op.topology.accelerator_type == "v5litepod-4"
+
+
+def test_tpuvm_env_overrides_metadata(tmp_path):
+    root = fake_dev(tmp_path, 2)
+    meta = {"accelerator-type": "v5litepod-4"}
+    op = TPUVMOperator(
+        root, metadata=meta.get, env={"TPU_ACCELERATOR_TYPE": "v5p-8"}
+    )
+    assert op.devices()[0].hbm_bytes == 95 * 1024**3
+
+
+def test_tpuvm_no_metadata_conservative_fallback(tmp_path):
+    root = fake_dev(tmp_path, 2)
+    op = TPUVMOperator(root, metadata=lambda a: None, env={})
+    chips = op.devices()
+    assert len(chips) == 2
+    assert chips[0].hbm_bytes == 16 * 1024**3  # conservative floor
+    assert op.topology is None
+
+
+def test_tpuvm_tpu_env_attribute(tmp_path):
+    root = fake_dev(tmp_path, 4)
+    raw = "ACCELERATOR_TYPE: 'v5litepod-8'\nWORKER_ID: '1'\n"
+    meta = {"tpu-env": raw}
+    op = TPUVMOperator(root, metadata=meta.get, env={})
+    assert op.accelerator_type() == "v5litepod-8"
+    assert parse_tpu_env(raw)["WORKER_ID"] == "1"
+
+
+def test_tpuvm_no_devices(tmp_path):
+    d = tmp_path / "empty"
+    d.mkdir()
+    op = TPUVMOperator(str(d), metadata=lambda a: None, env={})
+    assert op.devices() == []
+
+
+def test_tpuvm_worker_hostnames_env(tmp_path):
+    root = fake_dev(tmp_path, 1)
+    op = TPUVMOperator(
+        root, metadata=lambda a: None,
+        env={"TPU_WORKER_HOSTNAMES": "h0,h1", "TPU_WORKER_ID": "1"},
+    )
+    assert op.worker_hostnames() == ["h0", "h1"]
+    assert op.worker_id() == 1

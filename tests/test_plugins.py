@@ -1,0 +1,350 @@
+"""Plugin layer tests: the reference's §3.2 hot path, driven by a fake
+kubelet over real gRPC unix sockets, with the stub operator and a real
+on-disk storage — BASELINE config 1's control-plane correctness, hermetic.
+"""
+
+import json
+import os
+import queue
+import threading
+
+import pytest
+
+from elastic_tpu_agent import rpc
+from elastic_tpu_agent.common import (
+    AnnotationAssumed,
+    ResourceTPUCore,
+    ResourceTPUMemory,
+    container_annotation,
+)
+from elastic_tpu_agent.kube.locator import KubeletDeviceLocator, LocateError
+from elastic_tpu_agent.plugins.base import PluginConfig
+from elastic_tpu_agent.plugins.tpushare import (
+    CORE_ENDPOINT,
+    MEM_ENDPOINT,
+    TPUSharePlugin,
+    core_device_id,
+    mem_device_id,
+)
+from elastic_tpu_agent.storage import Storage
+from elastic_tpu_agent.tpu import StubOperator
+from elastic_tpu_agent.types import Device
+
+from fake_kubelet import FakeKubelet, FakeSitter
+
+
+@pytest.fixture()
+def harness(tmp_path):
+    """Fake kubelet + stub operator + plugin bundle, fully wired."""
+    dp_dir = str(tmp_path / "dp")
+    pr_sock = str(tmp_path / "pr" / "kubelet.sock")
+    dev_root = str(tmp_path / "dev")
+    os.makedirs(dev_root)
+    kubelet = FakeKubelet(dp_dir, pr_sock)
+    kubelet.start()
+    sitter = FakeSitter()
+    storage = Storage(str(tmp_path / "meta.db"))
+    operator = StubOperator(dev_root, "v5litepod-4")
+    pr_client = rpc.PodResourcesClient(pr_sock)
+    config = PluginConfig(
+        node_name="test-node",
+        device_plugin_dir=dp_dir,
+        pod_resources_socket=pr_sock,
+        operator=operator,
+        sitter=sitter,
+        storage=storage,
+        locator_factory=lambda res: KubeletDeviceLocator(res, pr_client),
+        extra={"alloc_spec_dir": str(tmp_path / "alloc")},
+    )
+    plugin = TPUSharePlugin(config)
+    stop = threading.Event()
+    plugin.run(stop)
+    assert kubelet.wait_registrations(2), "plugins failed to register"
+
+    class H:
+        pass
+
+    h = H()
+    h.kubelet, h.sitter, h.storage, h.operator = kubelet, sitter, storage, operator
+    h.plugin, h.stop, h.tmp = plugin, stop, tmp_path
+    h.dev_root = dev_root
+    h.alloc_dir = str(tmp_path / "alloc")
+    yield h
+    stop.set()
+    plugin.core.stop_streams()
+    plugin.memory.stop_streams()
+    kubelet.stop()
+    storage.close()
+
+
+def assumed_annotations(container="jax", chips="0"):
+    return {
+        AnnotationAssumed: "true",
+        container_annotation(container): chips,
+    }
+
+
+# -- registration lifecycle ---------------------------------------------------
+
+
+def test_both_resources_registered(harness):
+    resources = {r.resource_name for r in harness.kubelet.registrations}
+    assert resources == {ResourceTPUCore, ResourceTPUMemory}
+    for r in harness.kubelet.registrations:
+        assert r.version == rpc.DEVICE_PLUGIN_VERSION
+        assert r.options.pre_start_required
+        assert r.endpoint in (CORE_ENDPOINT, MEM_ENDPOINT)
+
+
+def test_reregisters_after_kubelet_restart(harness):
+    before = len(harness.kubelet.registrations)
+    harness.kubelet.restart_registration()
+    assert harness.kubelet.wait_registrations(before + 2, timeout=15.0), (
+        "plugins did not re-register after kubelet restart"
+    )
+
+
+# -- ListAndWatch -------------------------------------------------------------
+
+
+def test_core_advertises_100_per_chip(harness):
+    client = harness.kubelet.plugin_client(CORE_ENDPOINT)
+    stream = client.list_and_watch()
+    first = next(iter(stream))
+    assert len(first.devices) == 400  # 4 chips x 100 units
+    ids = {d.ID for d in first.devices}
+    assert core_device_id(0, 0) in ids
+    assert core_device_id(3, 99) in ids
+    assert all(d.health == rpc.HEALTHY for d in first.devices)
+
+
+def test_memory_advertises_mib_per_chip(harness):
+    client = harness.kubelet.plugin_client(MEM_ENDPOINT)
+    first = next(iter(client.list_and_watch()))
+    # 4 chips x 16 GiB = 65536 MiB
+    assert len(first.devices) == 4 * 16 * 1024
+    assert mem_device_id(2, 0) in {d.ID for d in first.devices}
+
+
+# -- Allocate -----------------------------------------------------------------
+
+
+def test_allocate_fractional_core(harness):
+    client = harness.kubelet.plugin_client(CORE_ENDPOINT)
+    ids = [core_device_id(0, i) for i in range(50)]
+    resp = client.allocate(ids)
+    assert len(resp.container_responses) == 1
+    c = resp.container_responses[0]
+    dev_hash = Device(ids, ResourceTPUCore).hash
+    assert c.envs["TPU"] == dev_hash
+    assert c.envs["TPU_VISIBLE_CHIPS"] == "0"
+    assert c.envs["ELASTIC_TPU_CORE_UNITS"] == "50"
+    assert len(c.devices) == 1
+    assert c.devices[0].host_path == f"/dev/elastic-tpu-{dev_hash}-0"
+    assert c.devices[0].container_path == "/dev/accel0"
+
+
+def test_allocate_150_core_exposes_two_chips(harness):
+    """The reference's leak case: 150 cores spans 2 chips but its Allocate
+    exposed len/100=1 node and GC deleted 1 (SURVEY.md §7). We expose
+    ceil(150/100)=2 and GC deletes exactly what PreStart created."""
+    client = harness.kubelet.plugin_client(CORE_ENDPOINT)
+    ids = [core_device_id(0, i) for i in range(100)] + [
+        core_device_id(1, i) for i in range(50)
+    ]
+    resp = client.allocate(ids)
+    c = resp.container_responses[0]
+    assert len(c.devices) == 2
+    assert c.envs["TPU_VISIBLE_CHIPS"] == "0,1"
+
+
+def test_allocate_memory_sets_hbm_limit(harness):
+    client = harness.kubelet.plugin_client(MEM_ENDPOINT)
+    ids = [mem_device_id(0, i) for i in range(8192)]  # 8 GiB
+    resp = client.allocate(ids)
+    c = resp.container_responses[0]
+    assert c.envs["ELASTIC_TPU_HBM_LIMIT_BYTES"] == str(8192 * 1024 * 1024)
+    assert len(c.devices) == 0  # memory carries env only
+
+
+# -- PreStartContainer: the full binding flow ---------------------------------
+
+
+def test_prestart_binds_and_persists(harness):
+    harness.sitter.add_pod("default", "train-0", assumed_annotations("jax", "2"))
+    ids = [core_device_id(2, i) for i in range(50)]
+    harness.kubelet.kubelet_allocate_flow(
+        CORE_ENDPOINT, "default", "train-0", "jax", ResourceTPUCore, ids
+    )
+    dev_hash = Device(ids, ResourceTPUCore).hash
+    # virtual node exists and points at annotated chip 2
+    link = os.path.join(harness.dev_root, f"elastic-tpu-{dev_hash}-0")
+    assert os.path.islink(link)
+    assert os.readlink(link) == "/dev/accel2"
+    # binding persisted (restart recovery source)
+    info = harness.storage.load("default", "train-0")
+    rec = info.allocations["jax"][ResourceTPUCore]
+    assert rec.chip_indexes == [2]
+    assert rec.created_node_ids == [f"{dev_hash}-0"]
+    # alloc spec written for the OCI hook
+    spec_path = os.path.join(harness.alloc_dir, f"{dev_hash}.json")
+    with open(spec_path) as f:
+        spec = json.load(f)
+    assert spec["chip_indexes"] == [2]
+    assert spec["device_paths"] == ["/dev/accel2"]
+    assert spec["env"]["TPU_VISIBLE_CHIPS"] == "0"
+    assert spec["container"] == "jax"
+
+
+def test_prestart_core_and_memory_keep_both_records(harness):
+    """Reference defect: flat container->Device map let mem overwrite core.
+    Both bindings must survive."""
+    ann = {
+        AnnotationAssumed: "true",
+        container_annotation("jax"): "1",
+    }
+    harness.sitter.add_pod("default", "both-0", ann)
+    core_ids = [core_device_id(1, i) for i in range(100)]
+    mem_ids = [mem_device_id(1, i) for i in range(1024)]
+    harness.kubelet.kubelet_allocate_flow(
+        CORE_ENDPOINT, "default", "both-0", "jax", ResourceTPUCore, core_ids
+    )
+    harness.kubelet.kubelet_allocate_flow(
+        MEM_ENDPOINT, "default", "both-0", "jax", ResourceTPUMemory, mem_ids
+    )
+    info = harness.storage.load("default", "both-0")
+    assert set(info.allocations["jax"].keys()) == {
+        ResourceTPUCore,
+        ResourceTPUMemory,
+    }
+    # two virtual links exist (one per resource hash)
+    links = harness.operator.list_links()
+    assert len(links) == 2
+
+
+def test_prestart_rejects_unassumed_pod(harness):
+    harness.sitter.add_pod("default", "rogue", {})  # no scheduler annotations
+    ids = [core_device_id(0, i) for i in range(10)]
+    client = harness.kubelet.plugin_client(CORE_ENDPOINT)
+    client.allocate(ids)
+    harness.kubelet.assign("default", "rogue", "jax", ResourceTPUCore, ids)
+    import grpc
+
+    with pytest.raises(grpc.RpcError):
+        client.pre_start_container(ids)
+    # nothing leaked
+    assert harness.operator.list_links() == []
+    assert harness.storage.load("default", "rogue") is None
+
+
+def test_prestart_rollback_on_unknown_chip(harness):
+    """Annotation names chip 9 which does not exist -> error, no links."""
+    harness.sitter.add_pod("default", "bad-chip", assumed_annotations("jax", "0,9"))
+    ids = [core_device_id(0, i) for i in range(10)]
+    client = harness.kubelet.plugin_client(CORE_ENDPOINT)
+    client.allocate(ids)
+    harness.kubelet.assign("default", "bad-chip", "jax", ResourceTPUCore, ids)
+    import grpc
+
+    with pytest.raises(grpc.RpcError):
+        client.pre_start_container(ids)
+    assert harness.operator.list_links() == []
+
+
+def test_prestart_multi_chip_annotation(harness):
+    harness.sitter.add_pod(
+        "default", "big-0", assumed_annotations("jax", "1,3")
+    )
+    ids = [core_device_id(1, i) for i in range(100)] + [
+        core_device_id(3, i) for i in range(100)
+    ]
+    harness.kubelet.kubelet_allocate_flow(
+        CORE_ENDPOINT, "default", "big-0", "jax", ResourceTPUCore, ids
+    )
+    dev_hash = Device(ids, ResourceTPUCore).hash
+    assert harness.operator.resolve(f"{dev_hash}-0") == 1
+    assert harness.operator.resolve(f"{dev_hash}-1") == 3
+
+
+# -- locator shapes -----------------------------------------------------------
+
+
+def test_locator_handles_split_entries(harness):
+    """k8s >=1.21 returns one device id per ContainerDevices entry."""
+    harness.kubelet.split_device_entries = True
+    harness.sitter.add_pod("default", "split-0", assumed_annotations("jax", "0"))
+    ids = [core_device_id(0, i) for i in range(25)]
+    harness.kubelet.kubelet_allocate_flow(
+        CORE_ENDPOINT, "default", "split-0", "jax", ResourceTPUCore, ids
+    )
+    info = harness.storage.load("default", "split-0")
+    assert info is not None
+
+
+# -- GC -----------------------------------------------------------------------
+
+
+def test_gc_reclaims_deleted_pod(harness):
+    harness.sitter.add_pod("default", "dead-0", assumed_annotations("jax", "0"))
+    ids = [core_device_id(0, i) for i in range(50)]
+    harness.kubelet.kubelet_allocate_flow(
+        CORE_ENDPOINT, "default", "dead-0", "jax", ResourceTPUCore, ids
+    )
+    dev_hash = Device(ids, ResourceTPUCore).hash
+    assert harness.operator.check(f"{dev_hash}-0")
+    # pod vanishes from cache AND apiserver
+    harness.sitter.remove_pod("default", "dead-0")
+    reclaimed = harness.plugin.gc_once()
+    assert reclaimed == 1
+    assert not harness.operator.check(f"{dev_hash}-0")
+    assert harness.storage.load("default", "dead-0") is None
+    assert not os.path.exists(
+        os.path.join(harness.alloc_dir, f"{dev_hash}.json")
+    )
+
+
+def test_gc_keeps_live_pod(harness):
+    harness.sitter.add_pod("default", "alive-0", assumed_annotations("jax", "0"))
+    ids = [core_device_id(0, i) for i in range(50)]
+    harness.kubelet.kubelet_allocate_flow(
+        CORE_ENDPOINT, "default", "alive-0", "jax", ResourceTPUCore, ids
+    )
+    assert harness.plugin.gc_once() == 0
+    assert harness.storage.load("default", "alive-0") is not None
+
+
+def test_gc_event_driven(harness):
+    harness.sitter.add_pod("default", "evt-0", assumed_annotations("jax", "1"))
+    ids = [core_device_id(1, i) for i in range(10)]
+    harness.kubelet.kubelet_allocate_flow(
+        CORE_ENDPOINT, "default", "evt-0", "jax", ResourceTPUCore, ids
+    )
+    q = queue.Queue()
+    stop = threading.Event()
+    t = harness.plugin.start_gc(q, stop)
+    harness.sitter.remove_pod("default", "evt-0")
+    q.put({"metadata": {"namespace": "default", "name": "evt-0"}})
+    deadline = threading.Event()
+    for _ in range(100):
+        if harness.storage.load("default", "evt-0") is None:
+            break
+        deadline.wait(0.05)
+    stop.set()
+    q.put(None)
+    t.join(timeout=2)
+    assert harness.storage.load("default", "evt-0") is None
+
+
+# -- GetPreferredAllocation ---------------------------------------------------
+
+
+def test_preferred_allocation_packs_densely(harness):
+    client = harness.kubelet.plugin_client(CORE_ENDPOINT)
+    # 30 free on chip 0, 100 free on chip 1; ask for 50 -> all from chip 1
+    available = [core_device_id(0, i) for i in range(30)] + [
+        core_device_id(1, i) for i in range(100)
+    ]
+    resp = client.get_preferred_allocation(available, [], 50)
+    chosen = resp.container_responses[0].deviceIDs
+    assert len(chosen) == 50
+    assert all(did.startswith("tpu-core-1-") for did in chosen)

@@ -26,11 +26,13 @@ def make_pod(ns="default", name="pod-a", container="main", ids=("d1", "d2")):
         namespace=ns,
         name=name,
         allocations={
-            container: AllocationRecord(
-                device=Device(ids, "elasticgpu.io/tpu-core"),
-                chip_indexes=[0],
-                created_node_ids=[],
-            )
+            container: {
+                "elasticgpu.io/tpu-core": AllocationRecord(
+                    device=Device(ids, "elasticgpu.io/tpu-core"),
+                    chip_indexes=[0],
+                    created_node_ids=[],
+                )
+            }
         },
     )
 
@@ -41,7 +43,9 @@ def test_save_load_roundtrip(store):
     got = store.load("default", "pod-a")
     assert got is not None
     assert got.key == pod.key
-    assert got.allocations["main"].device.equals(pod.allocations["main"].device)
+    assert got.allocations["main"]["elasticgpu.io/tpu-core"].device.equals(
+        pod.allocations["main"]["elasticgpu.io/tpu-core"].device
+    )
 
 
 def test_load_miss_returns_none(store):
@@ -63,7 +67,7 @@ def test_save_overwrites(store):
     store.save(make_pod(ids=("a",)))
     store.save(make_pod(ids=("b", "c")))
     got = store.load("default", "pod-a")
-    assert got.allocations["main"].device.ids == ("b", "c")
+    assert got.allocations["main"]["elasticgpu.io/tpu-core"].device.ids == ("b", "c")
 
 
 def test_delete(store):

@@ -1,0 +1,68 @@
+"""Accelerator-type parsing + generation table tests."""
+
+import pytest
+
+from elastic_tpu_agent.tpu.topology import (
+    GiB,
+    host_bounds,
+    parse_accelerator_type,
+    spec_for_family,
+)
+
+
+@pytest.mark.parametrize(
+    "acc,chips,cores,hosts,cph",
+    [
+        ("v5litepod-4", 4, 4, 1, 4),
+        ("v5litepod-8", 8, 8, 1, 8),
+        ("v5litepod-16", 16, 16, 2, 8),
+        ("v5e-8", 8, 8, 1, 8),
+        ("v4-8", 4, 8, 1, 4),
+        ("v4-16", 8, 16, 2, 4),
+        ("v5p-8", 4, 8, 1, 4),
+        ("v5p-16", 8, 16, 2, 4),
+        ("v6e-8", 8, 8, 1, 8),
+        ("v3-8", 4, 8, 1, 4),
+        ("v2-8", 4, 8, 1, 4),
+    ],
+)
+def test_parse_known_types(acc, chips, cores, hosts, cph):
+    topo = parse_accelerator_type(acc)
+    assert topo is not None, acc
+    assert topo.total_chips == chips
+    assert topo.total_cores == cores
+    assert topo.num_hosts == hosts
+    assert topo.chips_per_host == cph
+    assert topo.is_multi_host == (hosts > 1)
+
+
+@pytest.mark.parametrize("bad", ["", "gpu-8", "v5litepod", "v5litepod-0", "v9z-8"])
+def test_parse_rejects_unknown(bad):
+    assert parse_accelerator_type(bad) is None
+
+
+def test_hbm_table():
+    assert parse_accelerator_type("v5litepod-8").spec.hbm_bytes == 16 * GiB
+    assert parse_accelerator_type("v5p-16").spec.hbm_bytes == 95 * GiB
+    assert parse_accelerator_type("v4-8").spec.hbm_bytes == 32 * GiB
+    assert parse_accelerator_type("v6e-8").spec.hbm_bytes == 32 * GiB
+
+
+def test_spec_for_family_aliases():
+    assert spec_for_family("v5litepod").family == "v5e"
+    assert spec_for_family("V5E").family == "v5e"
+    assert spec_for_family("nope") is None
+
+
+def test_host_bounds_v5p_16():
+    topo = parse_accelerator_type("v5p-16")  # 8 chips over 2 hosts
+    chip_b, host_b = host_bounds(topo)
+    assert chip_b == "2,2,1"
+    assert host_b == "1,2,1"
+
+
+def test_host_bounds_single_host():
+    topo = parse_accelerator_type("v5litepod-8")
+    chip_b, host_b = host_bounds(topo)
+    assert chip_b == "2,4,1"
+    assert host_b == "1,1,1"

@@ -53,23 +53,25 @@ def test_podinfo_json_roundtrip():
         namespace="default",
         name="train-0",
         allocations={
-            "jax": AllocationRecord(
-                device=Device(["tpu-core-0-1", "tpu-core-0-0"], "elasticgpu.io/tpu-core"),
-                chip_indexes=[0],
-                created_node_ids=["abc12345-0"],
-            )
+            "jax": {
+                "elasticgpu.io/tpu-core": AllocationRecord(
+                    device=Device(["tpu-core-0-1", "tpu-core-0-0"], "elasticgpu.io/tpu-core"),
+                    chip_indexes=[0],
+                    created_node_ids=["abc12345-0"],
+                )
+            }
         },
     )
     back = PodInfo.from_json(pod.to_json())
     assert back.namespace == "default"
     assert back.name == "train-0"
     assert back.key == "default/train-0"
-    rec = back.allocations["jax"]
+    rec = back.allocations["jax"]["elasticgpu.io/tpu-core"]
     assert rec.device.ids == ("tpu-core-0-0", "tpu-core-0-1")
     assert rec.chip_indexes == [0]
     assert rec.created_node_ids == ["abc12345-0"]
-    assert back.device_of("jax") is not None
-    assert back.device_of("absent") is None
+    assert back.device_of("jax", "elasticgpu.io/tpu-core") is not None
+    assert back.device_of("absent", "elasticgpu.io/tpu-core") is None
 
 
 def test_parse_pod_key():
