@@ -1,0 +1,276 @@
+"""Benchmark driver. Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, "extra": {...}}
+
+Headline: the allocation hot path (BASELINE.md north star — "Allocate()
+p50 latency"): kubelet-side Allocate + PreStartContainer end-to-end over
+real gRPC against the in-process agent (stub operator, fake kubelet +
+apiserver — BASELINE config 1's topology, the only one that runs without a
+cluster).
+
+vs_baseline: the reference publishes no numbers (BASELINE.md: "None"), so
+the comparison is against a faithful re-enactment of the reference's
+algorithm on the same stack: its Locate() issued a full-node pod-resources
+List per PreStart call with no caching (locator.go:43-93, SURVEY.md §6).
+We run the same flow with our locator's cache disabled to reproduce that
+cost. vs_baseline = reference_style_p50 / our_p50 (>1 = faster).
+
+Extra: single-chip flagship-transformer throughput when a real TPU is
+attached (tokens/s, step time, estimated MXU utilization).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import statistics
+import sys
+import tempfile
+import threading
+import time
+
+REPO = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.join(REPO, "tests"))
+
+N_PODS = 150
+WARMUP = 10
+
+
+def build_cluster(tmp, disable_locator_cache=False):
+    from elastic_tpu_agent import rpc
+    from elastic_tpu_agent.kube.client import KubeClient
+    from elastic_tpu_agent.kube.locator import KubeletDeviceLocator
+    from elastic_tpu_agent.manager import ManagerOptions, TPUManager
+
+    from fake_apiserver import FakeAPIServer
+    from fake_kubelet import FakeKubelet
+
+    api = FakeAPIServer()
+    url = api.start()
+    kubelet = FakeKubelet(
+        os.path.join(tmp, "dp"), os.path.join(tmp, "pr", "kubelet.sock")
+    )
+    kubelet.start()
+    os.makedirs(os.path.join(tmp, "dev"), exist_ok=True)
+
+    opts = ManagerOptions(
+        node_name="bench-node",
+        db_path=os.path.join(tmp, "meta.db"),
+        operator_kind="stub:v5litepod-8",
+        dev_root=os.path.join(tmp, "dev"),
+        device_plugin_dir=os.path.join(tmp, "dp"),
+        pod_resources_socket=os.path.join(tmp, "pr", "kubelet.sock"),
+        alloc_spec_dir=os.path.join(tmp, "alloc"),
+        kube_client=KubeClient(url),
+    )
+    manager = TPUManager(opts)
+
+    if disable_locator_cache:
+        # Reference behavior: full pod-resources List inline on every
+        # Locate, no cache, no prefetch (locator.go:43-93).
+        for plugin in (manager.plugin.core, manager.plugin.memory):
+            locator = plugin._locator
+            original = locator.locate
+
+            def uncached(device, _loc=locator, _orig=original):
+                _loc.invalidate()
+                return _orig(device)
+
+            locator.locate = uncached
+            locator.prefetch_async = lambda: None
+
+    manager.run(block=False)
+    if not kubelet.wait_registrations(2, timeout=20):
+        raise RuntimeError("agent failed to register with fake kubelet")
+    return api, kubelet, manager
+
+
+def run_control_plane(disable_locator_cache=False):
+    from elastic_tpu_agent.common import (
+        AnnotationAssumed,
+        ResourceTPUCore,
+        container_annotation,
+    )
+    from elastic_tpu_agent.plugins.tpushare import (
+        CORE_ENDPOINT,
+        core_device_id,
+    )
+
+    from fake_apiserver import make_pod
+
+    with tempfile.TemporaryDirectory(prefix="etpu-bench") as tmp:
+        api, kubelet, manager = build_cluster(tmp, disable_locator_cache)
+        client = kubelet.plugin_client(CORE_ENDPOINT)
+        allocate_ms, prestart_ms, e2e_ms = [], [], []
+        try:
+            for i in range(N_PODS + WARMUP):
+                pod, chip = f"bench-{i}", i % 8
+                api.upsert_pod(
+                    make_pod(
+                        "bench", pod, "bench-node",
+                        annotations={
+                            AnnotationAssumed: "true",
+                            container_annotation("jax"): str(chip),
+                        },
+                        containers=[{"name": "jax"}],
+                    )
+                )
+                deadline = time.monotonic() + 10
+                while (
+                    manager.sitter.get_pod("bench", pod) is None
+                    and time.monotonic() < deadline
+                ):
+                    time.sleep(0.001)
+                # 25 fractional core units, distinct ids per pod
+                ids = [core_device_id(chip, (i * 29 + j) % 100) for j in range(25)]
+                t0 = time.perf_counter()
+                client.allocate(ids)
+                t1 = time.perf_counter()
+                kubelet.assign("bench", pod, "jax", ResourceTPUCore, ids)
+                # Between recording the assignment and PreStartContainer a
+                # real kubelet does sandbox setup (typically 10-100+ ms);
+                # model a conservative 5 ms so Allocate-time prefetching
+                # gets the same overlap window it has in production. Both
+                # variants get the identical gap; it is excluded from the
+                # timed sections.
+                time.sleep(0.005)
+                t2 = time.perf_counter()
+                client.pre_start_container(ids)
+                t3 = time.perf_counter()
+                if i >= WARMUP:
+                    allocate_ms.append((t1 - t0) * 1000)
+                    prestart_ms.append((t3 - t2) * 1000)
+                    e2e_ms.append((t1 - t0 + t3 - t2) * 1000)
+        finally:
+            manager.stop()
+            kubelet.stop()
+            api.stop()
+        return {
+            "allocate_p50_ms": statistics.median(allocate_ms),
+            "prestart_p50_ms": statistics.median(prestart_ms),
+            "bind_p50_ms": statistics.median(e2e_ms),
+            "bind_p99_ms": sorted(e2e_ms)[int(len(e2e_ms) * 0.99) - 1],
+        }
+
+
+# Peak bf16 TFLOP/s per chip (public spec sheet numbers).
+PEAK_TFLOPS = {"v2": 23, "v3": 61, "v4": 137.5, "v5e": 197, "v5p": 229.5,
+               "v6e": 459}
+
+
+def run_tpu_throughput():
+    try:
+        import jax
+
+        # Persistent compile cache: remote TPU compiles cost minutes; the
+        # driver re-runs bench every round with identical shapes.
+        jax.config.update(
+            "jax_compilation_cache_dir", os.path.join(REPO, ".jax_cache")
+        )
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+        devices = jax.devices()
+        platform = devices[0].platform
+        if platform == "cpu":
+            return None
+        import jax.numpy as jnp
+        import optax
+
+        from elastic_tpu_agent.workloads.transformer import (
+            ModelConfig,
+            forward,
+            init_params,
+        )
+
+        cfg = ModelConfig(
+            vocab=32768, d_model=1024, n_heads=16, n_layers=8, d_ff=4096,
+            max_seq=1024,
+        )
+        optimizer = optax.adamw(1e-3)
+
+        def loss_fn(params, tokens):
+            logits = forward(params, tokens[:, :-1], cfg)
+            targets = tokens[:, 1:]
+            logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+            return jnp.mean(
+                -jnp.take_along_axis(logp, targets[..., None], axis=-1)
+            )
+
+        def one_step(carry, _):
+            params, opt_state, tokens = carry
+            loss, grads = jax.value_and_grad(loss_fn)(params, tokens)
+            updates, opt_state = optimizer.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return (params, opt_state, tokens), loss
+
+        steps = 10
+
+        # K steps inside ONE jit (lax.scan): per-call dispatch through a
+        # remote/relayed runtime costs ~1s, which would swamp the ~100ms
+        # step — the scan measures the chip, not the wire.
+        @jax.jit
+        def run_steps(params, opt_state, tokens):
+            (params, opt_state, _), losses = jax.lax.scan(
+                one_step, (params, opt_state, tokens), None, length=steps
+            )
+            return params, opt_state, losses[-1]
+
+        params = init_params(cfg, jax.random.key(0))
+        opt_state = optimizer.init(params)
+        batch, seq = 8, 1024
+        tokens = jax.random.randint(
+            jax.random.key(1), (batch, seq + 1), 0, cfg.vocab
+        )
+        params, opt_state, loss = run_steps(params, opt_state, tokens)
+        float(loss)  # compile + warmup; host transfer is the real barrier
+        t0 = time.perf_counter()
+        params, opt_state, loss = run_steps(params, opt_state, tokens)
+        final_loss = float(loss)  # block_until_ready alone does not
+        dt = time.perf_counter() - t0  # synchronize through the relay
+
+        n_params = sum(
+            p.size for p in jax.tree_util.tree_leaves(params)
+        )
+        tokens_per_step = batch * seq
+        flops_per_step = 6 * n_params * tokens_per_step  # fwd+bwd estimate
+        achieved_tflops = flops_per_step * steps / dt / 1e12
+        gen = os.environ.get("PALLAS_AXON_TPU_GEN", "v5e")
+        peak = PEAK_TFLOPS.get(gen, 197)
+        return {
+            "platform": platform,
+            "tpu_gen": gen,
+            "step_time_ms": dt / steps * 1000,
+            "tokens_per_s": tokens_per_step * steps / dt,
+            "achieved_tflops": achieved_tflops,
+            "mxu_util_pct": 100 * achieved_tflops / peak,
+            "final_loss": final_loss,
+            "n_params_m": n_params / 1e6,
+        }
+    except Exception as e:  # noqa: BLE001
+        return {"error": f"{type(e).__name__}: {e}"}
+
+
+def main():
+    ours = run_control_plane(disable_locator_cache=False)
+    ref = run_control_plane(disable_locator_cache=True)
+    tpu = run_tpu_throughput()
+    vs_baseline = ref["bind_p50_ms"] / ours["bind_p50_ms"]
+    result = {
+        "metric": "alloc_bind_p50_ms",
+        "value": round(ours["bind_p50_ms"], 3),
+        "unit": "ms",
+        "vs_baseline": round(vs_baseline, 3),
+        "extra": {
+            "ours": {k: round(v, 3) for k, v in ours.items()},
+            "reference_style_uncached": {
+                k: round(v, 3) for k, v in ref.items()
+            },
+            "pods": N_PODS,
+            "tpu": tpu,
+        },
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import logging
 import threading
+import time
 from abc import ABC, abstractmethod
 from typing import Dict, Optional
 
@@ -46,9 +47,20 @@ class KubeletDeviceLocator(DeviceLocator):
         self._client = client
         self._lock = threading.Lock()
         self._cache: Dict[str, PodContainer] = {}  # device-set hash -> owner
+        self._refresh_seq = 0       # ordering guard: a slow, stale List
+        self._installed_seq = 0     # must never replace a newer snapshot
+        self._prefetch_wake = threading.Event()
+        self._prefetch_thread: Optional[threading.Thread] = None
+        self._prefetch_debounce_s = 0.002
 
-    def _refresh(self) -> None:
-        """Full List -> rebuild hash index for our resource."""
+    def _refresh(self) -> Dict[str, PodContainer]:
+        """Full List -> rebuild hash index for our resource. Returns the
+        fresh snapshot; installs it into the shared cache only if no
+        later-started refresh already installed its result (a slow stale
+        prefetch must never clobber a newer inline refresh)."""
+        with self._lock:
+            self._refresh_seq += 1
+            seq = self._refresh_seq
         resp = self._client.list()
         fresh: Dict[str, PodContainer] = {}
         for pod in resp.pod_resources:
@@ -64,7 +76,10 @@ class KubeletDeviceLocator(DeviceLocator):
                         pod.namespace, pod.name, container.name
                     )
         with self._lock:
-            self._cache = fresh
+            if seq > self._installed_seq:
+                self._installed_seq = seq
+                self._cache = fresh
+        return fresh
 
     def locate(self, device: Device) -> PodContainer:
         key = device.hash
@@ -72,18 +87,65 @@ class KubeletDeviceLocator(DeviceLocator):
             hit = self._cache.get(key)
         if hit is not None:
             return hit
-        try:
-            self._refresh()
-        except Exception as e:  # noqa: BLE001 - client re-dials next call
-            raise LocateError(f"pod-resources List failed: {e}") from e
-        with self._lock:
-            hit = self._cache.get(key)
-        if hit is None:
+        # Miss: refresh inline, consulting OUR OWN snapshot (the shared
+        # cache may be concurrently replaced by a prefetch). One retry
+        # absorbs transient channel resets from concurrent users.
+        last_error: Optional[Exception] = None
+        for _ in range(2):
+            try:
+                fresh = self._refresh()
+            except Exception as e:  # noqa: BLE001 - client re-dials next call
+                last_error = e
+                continue
+            hit = fresh.get(key)
+            if hit is not None:
+                return hit
+            last_error = None
+            break
+        if last_error is not None:
             raise LocateError(
-                f"no pod owns device set {key} for {self._resource}"
-            )
-        return hit
+                f"pod-resources List failed: {last_error}"
+            ) from last_error
+        raise LocateError(
+            f"no pod owns device set {key} for {self._resource}"
+        )
 
     def invalidate(self) -> None:
         with self._lock:
             self._cache = {}
+
+    def prefetch_async(self) -> None:
+        """Refresh the hash index in the background.
+
+        Called at Allocate time: kubelet records the assignment right after
+        the Allocate RPC returns and then spends sandbox-setup time before
+        PreStartContainer, so the full pod-resources List overlaps work we
+        are not on the critical path for — PreStart's locate() then hits
+        the warm cache instead of paying the O(node pods) List inline (the
+        reference paid it on every PreStart, locator.go:43-93).
+
+        A single persistent worker debounces bursts: the wake flag
+        coalesces any number of prefetch requests into one List, and the
+        small debounce delay lets kubelet's assignment record land before
+        the snapshot is taken. A miss at PreStart still falls back to a
+        fresh inline List, so this is purely an overlap optimization.
+        """
+        with self._lock:
+            if self._prefetch_thread is None:
+                self._prefetch_thread = threading.Thread(
+                    target=self._prefetch_loop,
+                    daemon=True,
+                    name=f"locator-prefetch-{self._resource}",
+                )
+                self._prefetch_thread.start()
+        self._prefetch_wake.set()
+
+    def _prefetch_loop(self) -> None:
+        while True:
+            self._prefetch_wake.wait()
+            time.sleep(self._prefetch_debounce_s)
+            self._prefetch_wake.clear()
+            try:
+                self._refresh()
+            except Exception:  # noqa: BLE001 - locate() retries inline
+                pass

@@ -54,6 +54,8 @@ from ..common import (
 )
 from ..gen import deviceplugin_pb2 as dp
 from ..kube.locator import DeviceLocator, LocateError
+from ..qos import qos_env
+from ..slice_env import slice_env_for_pod
 from ..types import AllocationRecord, Device, PodInfo
 from .base import DevicePluginServer, PluginConfig
 
@@ -155,7 +157,12 @@ class _TPUSharePluginBase(_ListAndWatchMixin, rpc.DevicePluginServicer):
         raise NotImplementedError
 
     def _alloc_envs(self, device: Device, n_chips: int) -> Dict[str, str]:
-        return {EnvAllocationHash: device.hash}
+        # qos_env derives the quota/units values from _qos_kwargs — the
+        # single source shared with the PreStart alloc spec, so the
+        # Allocate-time env and the hook-injected env can never disagree.
+        envs = {EnvAllocationHash: device.hash}
+        envs.update(qos_env({}, **self._qos_kwargs(device)))
+        return envs
 
     def _alloc_device_specs(self, device: Device, n_chips: int) -> List[dp.DeviceSpec]:
         return []
@@ -187,6 +194,10 @@ class _TPUSharePluginBase(_ListAndWatchMixin, rpc.DevicePluginServicer):
         resp = dp.AllocateResponse(container_responses=responses)
         if self._metrics is not None:
             self._metrics.observe_allocate(time.monotonic() - t0)
+        # Warm the locate cache while kubelet sets up the sandbox, so the
+        # upcoming PreStartContainer skips the O(node pods) List.
+        if hasattr(self._locator, "prefetch_async"):
+            self._locator.prefetch_async()
         return resp
 
     # -- GetPreferredAllocation ----------------------------------------------
@@ -292,7 +303,7 @@ class _TPUSharePluginBase(_ListAndWatchMixin, rpc.DevicePluginServicer):
                 link_id = f"{device.hash}-{p}"
                 self._operator.create(idx, link_id)
                 created.append(link_id)
-            self._write_alloc_spec(device, owner, chip_indexes, annotations)
+            self._write_alloc_spec(device, owner, chip_indexes, annotations, pod)
         except Exception:
             for link_id in created:
                 try:
@@ -320,9 +331,39 @@ class _TPUSharePluginBase(_ListAndWatchMixin, rpc.DevicePluginServicer):
 
     # -- allocation spec for the OCI hook -------------------------------------
 
+    def _qos_kwargs(self, device: Device) -> Dict:
+        """Per-resource inputs for qos_env (overridden by subclasses)."""
+        return {}
+
+    def _host_slice_facts(self):
+        """(topology, worker_id, hostnames) from the operator when it knows
+        them (tpu-vm/stub operators do; exclusive wrapper may not)."""
+        op = self._operator
+        topo = getattr(op, "topology", None)
+        worker_id = op.worker_id() if hasattr(op, "worker_id") else 0
+        hostnames = (
+            op.worker_hostnames() if hasattr(op, "worker_hostnames") else []
+        )
+        return topo, worker_id, hostnames
+
     def _spec_payload(
-        self, device: Device, owner, chip_indexes: List[int], annotations: Dict
+        self,
+        device: Device,
+        owner,
+        chip_indexes: List[int],
+        annotations: Dict,
+        pod: Optional[dict] = None,
     ) -> Dict:
+        env = {
+            EnvTPUVisibleChips: ",".join(
+                str(p) for p in range(len(chip_indexes))
+            ),
+        }
+        env.update(qos_env(annotations, pod_spec=pod, **self._qos_kwargs(device)))
+        topo, worker_id, hostnames = self._host_slice_facts()
+        env.update(
+            slice_env_for_pod(annotations, topo, worker_id, hostnames)
+        )
         return {
             "hash": device.hash,
             "resource": self.resource,
@@ -333,22 +374,24 @@ class _TPUSharePluginBase(_ListAndWatchMixin, rpc.DevicePluginServicer):
             "device_paths": [
                 self._chips[i].device_path for i in chip_indexes
             ],
-            "env": {
-                EnvTPUVisibleChips: ",".join(
-                    str(p) for p in range(len(chip_indexes))
-                ),
-            },
+            "env": env,
         }
 
     def _write_alloc_spec(
-        self, device: Device, owner, chip_indexes: List[int], annotations: Dict
+        self,
+        device: Device,
+        owner,
+        chip_indexes: List[int],
+        annotations: Dict,
+        pod: Optional[dict] = None,
     ) -> None:
         os.makedirs(self._alloc_dir, exist_ok=True)
         path = os.path.join(self._alloc_dir, f"{device.hash}.json")
         tmp = path + ".tmp"
         with open(tmp, "w") as f:
             json.dump(
-                self._spec_payload(device, owner, chip_indexes, annotations), f
+                self._spec_payload(device, owner, chip_indexes, annotations, pod),
+                f,
             )
         os.replace(tmp, path)
 
@@ -381,7 +424,6 @@ class TPUShareCorePlugin(_TPUSharePluginBase):
     def _alloc_envs(self, device: Device, n_chips: int) -> Dict[str, str]:
         envs = super()._alloc_envs(device, n_chips)
         envs[EnvTPUVisibleChips] = ",".join(str(p) for p in range(n_chips))
-        envs["ELASTIC_TPU_CORE_UNITS"] = str(len(device.ids))
         return envs
 
     def _alloc_device_specs(self, device: Device, n_chips: int) -> List[dp.DeviceSpec]:
@@ -395,6 +437,9 @@ class TPUShareCorePlugin(_TPUSharePluginBase):
             )
             for p in range(n_chips)
         ]
+
+    def _qos_kwargs(self, device: Device) -> Dict:
+        return {"core_units": len(device.ids)}
 
 
 class TPUShareMemoryPlugin(_TPUSharePluginBase):
@@ -426,16 +471,20 @@ class TPUShareMemoryPlugin(_TPUSharePluginBase):
             return 1
         return max(1, math.ceil(n_ids / self._mib_per_chip))
 
-    def _alloc_envs(self, device: Device, n_chips: int) -> Dict[str, str]:
-        envs = super()._alloc_envs(device, n_chips)
-        envs["ELASTIC_TPU_HBM_LIMIT_BYTES"] = str(
-            len(device.ids) * BytesPerMemoryUnit
-        )
-        return envs
+    def _hbm_limit_bytes(self, device: Device) -> int:
+        return len(device.ids) * BytesPerMemoryUnit
 
-    def _spec_payload(self, device, owner, chip_indexes, annotations):
-        payload = super()._spec_payload(device, owner, chip_indexes, annotations)
-        payload["hbm_limit_bytes"] = len(device.ids) * BytesPerMemoryUnit
+    def _qos_kwargs(self, device: Device) -> Dict:
+        return {
+            "hbm_limit_bytes": self._hbm_limit_bytes(device),
+            "chip_hbm_bytes": self._mib_per_chip * BytesPerMemoryUnit,
+        }
+
+    def _spec_payload(self, device, owner, chip_indexes, annotations, pod=None):
+        payload = super()._spec_payload(
+            device, owner, chip_indexes, annotations, pod
+        )
+        payload["hbm_limit_bytes"] = self._hbm_limit_bytes(device)
         return payload
 
 

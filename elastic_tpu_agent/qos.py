@@ -1,0 +1,48 @@
+"""QoS env computation: HBM quota + priority co-location (BASELINE config 4).
+
+There is no CUDA-style driver interception on TPU (SURVEY.md §7 "hard
+parts"): chip-level partition comes from device visibility; *sub-chip*
+core% and HBM quota are cooperative, enforced through env consumed by
+libtpu/XLA/JAX inside the container. The honest boundary:
+
+- ``ELASTIC_TPU_HBM_LIMIT_BYTES`` / ``ELASTIC_TPU_HBM_FRACTION`` — hard
+  quota for the workload runtime; our workloads package maps it onto
+  JAX/XLA client memory limits; any JAX image can apply it via
+  /run/elastic-tpu/env.
+- ``ELASTIC_TPU_CORE_UNITS`` — core share in 1% units (duty-cycle hint;
+  TensorCore time-slicing is not enforceable from outside libtpu).
+- ``ELASTIC_TPU_PRIORITY`` — high|low, from the scheduler's annotation or
+  the pod priorityClassName; low-priority workloads should enable
+  preemptible/donation behavior.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+AnnotationQoSPriority = "elasticgpu.io/qos-priority"
+
+
+def qos_env(
+    annotations: Dict[str, str],
+    pod_spec: Optional[dict] = None,
+    hbm_limit_bytes: Optional[int] = None,
+    chip_hbm_bytes: Optional[int] = None,
+    core_units: Optional[int] = None,
+) -> Dict[str, str]:
+    env: Dict[str, str] = {}
+    if hbm_limit_bytes:
+        env["ELASTIC_TPU_HBM_LIMIT_BYTES"] = str(hbm_limit_bytes)
+        if chip_hbm_bytes:
+            frac = min(1.0, hbm_limit_bytes / chip_hbm_bytes)
+            env["ELASTIC_TPU_HBM_FRACTION"] = f"{frac:.4f}"
+    if core_units is not None:
+        env["ELASTIC_TPU_CORE_UNITS"] = str(core_units)
+    priority = annotations.get(AnnotationQoSPriority, "")
+    if not priority and pod_spec:
+        pc = (pod_spec.get("spec") or {}).get("priorityClassName", "")
+        if pc:
+            priority = "high" if "high" in pc.lower() else "low"
+    if priority in ("high", "low"):
+        env["ELASTIC_TPU_PRIORITY"] = priority
+    return env

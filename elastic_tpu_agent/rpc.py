@@ -10,6 +10,7 @@ via generated Go stubs — SURVEY.md §2 components 3/9).
 
 from __future__ import annotations
 
+import threading
 from typing import Callable, Iterable, Optional
 
 import grpc
@@ -240,31 +241,48 @@ class PodResourcesClient:
 
     def __init__(self, socket_path: str = POD_RESOURCES_SOCKET) -> None:
         self._socket = socket_path
-        self._channel: Optional[grpc.Channel] = None
-        self._list = None
+        self._lock = threading.Lock()  # one client is shared by multiple
+        self._channel: Optional[grpc.Channel] = None  # locators + prefetch
+        self._list = None  # threads
 
-    def _ensure(self, timeout_s: float) -> None:
-        if self._channel is None:
-            self._channel = grpc.insecure_channel(
-                unix_target(self._socket), options=_CHANNEL_OPTS
-            )
-            grpc.channel_ready_future(self._channel).result(timeout=timeout_s)
-            self._list = self._channel.unary_unary(
-                "/v1alpha1.PodResourcesLister/List",
-                request_serializer=pr.ListPodResourcesRequest.SerializeToString,
-                response_deserializer=pr.ListPodResourcesResponse.FromString,
-            )
+    def _ensure(self, timeout_s: float):
+        """Return the List callable, dialing if needed (thread-safe)."""
+        with self._lock:
+            if self._list is None:
+                channel = grpc.insecure_channel(
+                    unix_target(self._socket), options=_CHANNEL_OPTS
+                )
+                grpc.channel_ready_future(channel).result(timeout=timeout_s)
+                self._channel = channel
+                self._list = channel.unary_unary(
+                    "/v1alpha1.PodResourcesLister/List",
+                    request_serializer=(
+                        pr.ListPodResourcesRequest.SerializeToString
+                    ),
+                    response_deserializer=(
+                        pr.ListPodResourcesResponse.FromString
+                    ),
+                )
+            return self._list
 
     def reset(self) -> None:
-        if self._channel is not None:
-            self._channel.close()
-        self._channel = None
-        self._list = None
+        """Drop the channel so the next call re-dials. The old channel is
+        closed after a grace period, NOT immediately: other threads
+        (locator prefetch + inline locate share this client) may have RPCs
+        in flight on it, and close() would cancel them."""
+        with self._lock:
+            old = self._channel
+            self._channel = None
+            self._list = None
+        if old is not None:
+            timer = threading.Timer(5.0, old.close)
+            timer.daemon = True
+            timer.start()
 
     def list(self, timeout_s: float = 5.0) -> pr.ListPodResourcesResponse:
         try:
-            self._ensure(timeout_s)
-            return self._list(pr.ListPodResourcesRequest(), timeout=timeout_s)
+            list_fn = self._ensure(timeout_s)
+            return list_fn(pr.ListPodResourcesRequest(), timeout=timeout_s)
         except grpc.RpcError:
             self.reset()  # re-dial next call (reference: locator.go:47-53)
             raise

@@ -1,0 +1,88 @@
+"""Multi-host TPU slice env (BASELINE config 5).
+
+The reference's "distributed" surface was control-plane only (SURVEY.md
+§2: no NCCL/MPI anywhere); on TPU the data plane (ICI within a slice, DCN
+between slices) is wired by libtpu/XLA. The agent's multi-host job is
+exactly this: every agent instance on a v5p-16 (or larger) pod-slice must
+emit a *consistent* worker identity + topology env so ``jax.distributed``
+can form the slice — derived from the metadata server and pod annotations
+only, never from agent-to-agent coordination (SURVEY.md §7 hard parts).
+
+Env contract (the names libtpu/JAX read on Cloud TPU VMs):
+  TPU_WORKER_ID            this host's index within the slice
+  TPU_WORKER_HOSTNAMES     comma-separated hosts, index-ordered
+  TPU_CHIPS_PER_HOST_BOUNDS  x,y,z chips-per-host grid
+  TPU_HOST_BOUNDS          x,y,z host grid
+  TPU_ACCELERATOR_TYPE     e.g. v5p-16
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from .common import (
+    AnnotationSliceName,
+    AnnotationSliceWorkerHosts,
+    AnnotationSliceWorkerID,
+)
+from .tpu.topology import TopologyInfo, host_bounds, parse_accelerator_type
+
+
+def slice_env_from_topology(
+    topo: TopologyInfo,
+    worker_id: int,
+    worker_hostnames: List[str],
+) -> Dict[str, str]:
+    chip_bounds, hbounds = host_bounds(topo)
+    env = {
+        "TPU_ACCELERATOR_TYPE": topo.accelerator_type,
+        "TPU_CHIPS_PER_HOST_BOUNDS": chip_bounds,
+        "TPU_HOST_BOUNDS": hbounds,
+        "TPU_WORKER_ID": str(worker_id),
+    }
+    if worker_hostnames:
+        env["TPU_WORKER_HOSTNAMES"] = ",".join(worker_hostnames)
+    return env
+
+
+def slice_env_for_pod(
+    annotations: Dict[str, str],
+    topo: Optional[TopologyInfo],
+    host_worker_id: int = 0,
+    host_worker_hostnames: Optional[List[str]] = None,
+) -> Dict[str, str]:
+    """Slice env for one pod binding.
+
+    Pod annotations override host-level facts (a pod-slice scheduled by the
+    elastic scheduler carries its own worker numbering); host metadata
+    (``host_worker_id``/``hostnames`` from the TPU-VM metadata server) is
+    the default for plain single-slice jobs. No slice annotation and a
+    single-host topology -> empty (nothing to coordinate).
+    """
+    ann_type = annotations.get(AnnotationSliceName, "")
+    ann_id = annotations.get(AnnotationSliceWorkerID, "")
+    ann_hosts = annotations.get(AnnotationSliceWorkerHosts, "")
+
+    topo_for_pod = topo
+    if ann_type:
+        parsed = parse_accelerator_type(ann_type)
+        if parsed is not None:
+            topo_for_pod = parsed
+    if topo_for_pod is None:
+        return {}
+
+    worker_id = host_worker_id
+    if ann_id:
+        try:
+            worker_id = int(ann_id)
+        except ValueError:
+            pass
+    hostnames = (
+        [h for h in ann_hosts.split(",") if h]
+        if ann_hosts
+        else list(host_worker_hostnames or [])
+    )
+
+    if not topo_for_pod.is_multi_host and not ann_type:
+        return {}
+    return slice_env_from_topology(topo_for_pod, worker_id, hostnames)

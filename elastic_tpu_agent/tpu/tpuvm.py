@@ -86,6 +86,10 @@ class TPUVMOperator(LinkingOperator):
         self._metadata = metadata
         self._env = env if env is not None else dict(os.environ)
         self._topology: Optional[TopologyInfo] = None
+        # Worker identity is fixed for the host's lifetime; memoize so the
+        # PreStart hot path never re-hits the metadata server.
+        self._worker_id: Optional[int] = None
+        self._worker_hostnames: Optional[List[str]] = None
 
     # -- inventory sources ---------------------------------------------------
 
@@ -115,21 +119,27 @@ class TPUVMOperator(LinkingOperator):
         return None
 
     def worker_id(self) -> int:
-        for key in ("TPU_WORKER_ID",):
-            if self._env.get(key):
-                try:
-                    return int(self._env[key])
-                except ValueError:
-                    pass
-        val = self._metadata("agent-worker-number")
-        if val:
+        if self._worker_id is not None:
+            return self._worker_id
+        result = 0
+        if self._env.get("TPU_WORKER_ID"):
             try:
-                return int(val)
+                result = int(self._env["TPU_WORKER_ID"])
             except ValueError:
-                pass
-        return 0
+                result = 0
+        else:
+            val = self._metadata("agent-worker-number")
+            if val:
+                try:
+                    result = int(val)
+                except ValueError:
+                    result = 0
+        self._worker_id = result
+        return result
 
     def worker_hostnames(self) -> List[str]:
+        if self._worker_hostnames is not None:
+            return self._worker_hostnames
         raw = self._env.get("TPU_WORKER_HOSTNAMES")
         if not raw:
             meta = self._metadata("worker-network-endpoints")
@@ -137,7 +147,8 @@ class TPUVMOperator(LinkingOperator):
                 # comma-separated list of ip:port:... triples; keep the ips
                 raw = ",".join(p.split(":")[2] if p.count(":") >= 2 else p
                                for p in meta.split(","))
-        return [h for h in (raw or "").split(",") if h]
+        self._worker_hostnames = [h for h in (raw or "").split(",") if h]
+        return self._worker_hostnames
 
     @property
     def topology(self) -> Optional[TopologyInfo]:

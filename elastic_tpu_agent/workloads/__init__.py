@@ -1,0 +1,17 @@
+from .transformer import (
+    ModelConfig,
+    forward,
+    init_params,
+    make_mesh,
+    make_train_step,
+    param_shardings,
+)
+
+__all__ = [
+    "ModelConfig",
+    "forward",
+    "init_params",
+    "make_mesh",
+    "make_train_step",
+    "param_shardings",
+]

@@ -1,0 +1,135 @@
+"""In-pod workload runner: what a JAX pod executes under the agent.
+
+Reads the env contract the hook injected (/run/elastic-tpu/env — visible
+chips, HBM quota, priority, slice topology), applies it, forms the device
+mesh (joining the multi-host slice via jax.distributed when slice env is
+present), runs the flagship transformer train loop, and reports
+throughput. This is the measurable payload for BASELINE configs 2-5.
+
+Usage (inside the container):
+    python -m elastic_tpu_agent.workloads.runner --steps 20 --batch 8 \
+        --seq 256 --preset small
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+ENV_FILE = "/run/elastic-tpu/env"
+
+PRESETS = {
+    "tiny": dict(vocab=2048, d_model=256, n_heads=4, n_layers=2, d_ff=1024),
+    "small": dict(vocab=32768, d_model=512, n_heads=8, n_layers=8, d_ff=2048),
+    "medium": dict(vocab=32768, d_model=1024, n_heads=16, n_layers=12,
+                   d_ff=4096),
+}
+
+
+def load_alloc_env(path: str = ENV_FILE) -> dict:
+    """Apply the hook-written env file (KEY=VALUE lines) to this process."""
+    applied = {}
+    if not os.path.exists(path):
+        return applied
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line or "=" not in line:
+                continue
+            key, _, value = line.partition("=")
+            os.environ.setdefault(key, value)
+            applied[key] = value
+    return applied
+
+
+def apply_hbm_quota() -> None:
+    """Cooperative HBM quota (BASELINE config 4): on TPU there is no driver
+    interception, so translate the agent's quota into the libtpu/XLA knobs
+    that exist and expose it for the training code's own budgeting."""
+    frac = os.environ.get("ELASTIC_TPU_HBM_FRACTION")
+    if frac:
+        # libtpu honors TPU_MEM_FRACTION on recent releases; keep the
+        # generic knob set either way so workloads can self-limit.
+        os.environ.setdefault("TPU_MEM_FRACTION", frac)
+
+
+def maybe_join_slice() -> None:
+    """Multi-host slice: when the agent injected TPU_WORKER_ID/HOSTNAMES,
+    initialize jax.distributed so the hosts form one slice (config 5)."""
+    hostnames = os.environ.get("TPU_WORKER_HOSTNAMES", "")
+    if "," not in hostnames:
+        return  # single host
+    import jax
+
+    worker_id = int(os.environ.get("TPU_WORKER_ID", "0"))
+    coordinator = hostnames.split(",")[0] + ":8476"
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=len(hostnames.split(",")),
+        process_id=worker_id,
+    )
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--steps", type=int, default=20)
+    parser.add_argument("--batch", type=int, default=8)
+    parser.add_argument("--seq", type=int, default=256)
+    parser.add_argument("--preset", choices=sorted(PRESETS), default="small")
+    parser.add_argument("--dp", type=int, default=None)
+    parser.add_argument("--sp", type=int, default=1)
+    parser.add_argument("--tp", type=int, default=None)
+    args = parser.parse_args(argv)
+
+    applied = load_alloc_env()
+    apply_hbm_quota()
+    maybe_join_slice()
+
+    import jax
+
+    # Honor JAX_PLATFORMS even when something imported jax before this
+    # process's env was in place (e.g. an image-level sitecustomize): the
+    # config snapshot would otherwise win over the user's env.
+    if os.environ.get("JAX_PLATFORMS"):
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+    from .transformer import ModelConfig, make_mesh, make_train_step
+
+    cfg = ModelConfig(max_seq=args.seq, **PRESETS[args.preset])
+    mesh = make_mesh(dp=args.dp, sp=args.sp, tp=args.tp)
+    train_step, init_all, _ = make_train_step(cfg, mesh)
+    params, opt_state = init_all(jax.random.key(0))
+    tokens = jax.random.randint(
+        jax.random.key(1), (args.batch, args.seq + 1), 0, cfg.vocab
+    )
+
+    # compile + warmup
+    params, opt_state, loss = train_step(params, opt_state, tokens)
+    jax.block_until_ready(loss)
+
+    t0 = time.perf_counter()
+    for _ in range(args.steps):
+        params, opt_state, loss = train_step(params, opt_state, tokens)
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+
+    tokens_per_step = args.batch * args.seq
+    report = {
+        "platform": jax.devices()[0].platform,
+        "devices": len(jax.devices()),
+        "mesh": dict(mesh.shape),
+        "steps": args.steps,
+        "final_loss": float(loss),
+        "step_time_ms": dt / args.steps * 1000,
+        "tokens_per_s": tokens_per_step * args.steps / dt,
+        "alloc_env": applied,
+    }
+    print(json.dumps(report))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

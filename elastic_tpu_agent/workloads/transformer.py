@@ -1,0 +1,262 @@
+"""Flagship JAX workload: a sharded decoder-only transformer LM.
+
+This is the e2e *workload* side of the agent (BASELINE configs 2-5): the
+JAX program a pod runs after the agent injects its chips/env. It is also
+the bench/graft-entry model. TPU-first design:
+
+- bfloat16 matmuls sized for the MXU; static shapes; no Python control
+  flow under jit.
+- GSPMD sharding over a 3-axis Mesh ("dp", "sp", "tp"):
+    * params: attention heads + MLP hidden sharded on "tp" (tensor
+      parallelism), replicated over "dp"/"sp";
+    * activations: batch on "dp", sequence on "sp" (sequence/context
+      parallelism for long-context — XLA inserts the all-gathers /
+      reduce-scatters over ICI as needed);
+    * optimizer state follows params.
+- collectives are never written by hand: shardings are declared with
+  NamedSharding / with_sharding_constraint and XLA's SPMD partitioner
+  lowers them onto ICI (the scaling-book recipe).
+
+The reference repo contains no model code at all (SURVEY.md §2: its
+"workload" was any CUDA container); this package is what makes the TPU
+agent's graded configs actually runnable and measurable.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelConfig:
+    vocab: int = 32768
+    d_model: int = 512
+    n_heads: int = 8
+    n_layers: int = 4
+    d_ff: int = 2048
+    max_seq: int = 1024
+    dtype: Any = jnp.bfloat16
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+
+# -- parameters ---------------------------------------------------------------
+
+
+def init_params(cfg: ModelConfig, key: jax.Array) -> Dict:
+    """Plain pytree params; names chosen so shardings map cleanly."""
+    initializer = jax.nn.initializers.normal(0.02)
+
+    def dense(key, shape):
+        return initializer(key, shape, jnp.float32)
+
+    keys = jax.random.split(key, 4 + cfg.n_layers)
+    params = {
+        "embed": dense(keys[0], (cfg.vocab, cfg.d_model)),
+        "pos_embed": dense(keys[1], (cfg.max_seq, cfg.d_model)),
+        "final_norm_scale": jnp.ones((cfg.d_model,), jnp.float32),
+        "lm_head": dense(keys[2], (cfg.d_model, cfg.vocab)),
+        "layers": [],
+    }
+    for i in range(cfg.n_layers):
+        k = jax.random.split(keys[3 + i], 6)
+        params["layers"].append(
+            {
+                "ln1_scale": jnp.ones((cfg.d_model,), jnp.float32),
+                "wqkv": dense(k[0], (cfg.d_model, 3, cfg.n_heads, cfg.head_dim)),
+                "wo": dense(k[1], (cfg.n_heads, cfg.head_dim, cfg.d_model)),
+                "ln2_scale": jnp.ones((cfg.d_model,), jnp.float32),
+                "w1": dense(k[2], (cfg.d_model, cfg.d_ff)),
+                "w2": dense(k[3], (cfg.d_ff, cfg.d_model)),
+            }
+        )
+    return params
+
+
+def param_shardings(mesh: Mesh) -> Dict:
+    """NamedSharding pytree matching init_params: tensor-parallel over
+    "tp", replicated over "dp"/"sp"."""
+
+    def ns(*spec):
+        return NamedSharding(mesh, P(*spec))
+
+    layer = {
+        "ln1_scale": ns(),
+        "wqkv": ns(None, None, "tp", None),   # shard heads
+        "wo": ns("tp", None, None),           # shard heads
+        "ln2_scale": ns(),
+        "w1": ns(None, "tp"),                 # shard FF hidden
+        "w2": ns("tp", None),                 # shard FF hidden
+    }
+    return {
+        "embed": ns(None, None),
+        "pos_embed": ns(),
+        "final_norm_scale": ns(),
+        "lm_head": ns(None, "tp"),            # shard vocab
+        "layers": [layer],  # broadcast over the layer list by tree prefix
+    }
+
+
+def _full_param_shardings(mesh: Mesh, cfg: ModelConfig) -> Dict:
+    base = param_shardings(mesh)
+    return {
+        **{k: v for k, v in base.items() if k != "layers"},
+        "layers": [base["layers"][0] for _ in range(cfg.n_layers)],
+    }
+
+
+# -- model --------------------------------------------------------------------
+
+
+def _rmsnorm(x: jax.Array, scale: jax.Array) -> jax.Array:
+    var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1, keepdims=True)
+    return (x * jax.lax.rsqrt(var + 1e-6)).astype(x.dtype) * scale.astype(
+        x.dtype
+    )
+
+
+def _attention(x: jax.Array, layer: Dict, cfg: ModelConfig) -> jax.Array:
+    b, s, _ = x.shape
+    qkv = jnp.einsum("bsd,dcnh->bcsnh", x, layer["wqkv"].astype(cfg.dtype))
+    q, k, v = qkv[:, 0], qkv[:, 1], qkv[:, 2]  # [b, s, n, h]
+    scale = 1.0 / np.sqrt(cfg.head_dim)
+    logits = jnp.einsum("bsnh,btnh->bnst", q, k) * scale
+    mask = jnp.tril(jnp.ones((s, s), dtype=bool))
+    logits = jnp.where(mask[None, None], logits, jnp.finfo(jnp.float32).min)
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(cfg.dtype)
+    out = jnp.einsum("bnst,btnh->bsnh", probs, v)
+    return jnp.einsum("bsnh,nhd->bsd", out, layer["wo"].astype(cfg.dtype))
+
+
+def _mlp(x: jax.Array, layer: Dict, cfg: ModelConfig) -> jax.Array:
+    h = jnp.einsum("bsd,df->bsf", x, layer["w1"].astype(cfg.dtype))
+    h = jax.nn.gelu(h)
+    return jnp.einsum("bsf,fd->bsd", h, layer["w2"].astype(cfg.dtype))
+
+
+def forward(
+    params: Dict, tokens: jax.Array, cfg: ModelConfig,
+    activation_sharding: Optional[NamedSharding] = None,
+) -> jax.Array:
+    """Token logits. ``activation_sharding`` (NamedSharding of
+    P("dp","sp",None)) pins the batch/sequence layout so XLA partitions
+    activations — and inserts the ICI collectives — over the mesh."""
+    _, s = tokens.shape
+    x = params["embed"].astype(cfg.dtype)[tokens]
+    x = x + params["pos_embed"].astype(cfg.dtype)[:s][None]
+    if activation_sharding is not None:
+        x = jax.lax.with_sharding_constraint(x, activation_sharding)
+    for layer in params["layers"]:
+        x = x + _attention(_rmsnorm(x, layer["ln1_scale"]), layer, cfg)
+        x = x + _mlp(_rmsnorm(x, layer["ln2_scale"]), layer, cfg)
+    x = _rmsnorm(x, params["final_norm_scale"])
+    return jnp.einsum("bsd,dv->bsv", x, params["lm_head"].astype(cfg.dtype))
+
+
+def make_mesh(
+    n_devices: Optional[int] = None,
+    dp: Optional[int] = None,
+    sp: int = 1,
+    tp: Optional[int] = None,
+) -> Mesh:
+    """3-axis mesh over the visible devices. Defaults: tp = min(n, 4)
+    (keeps tensor-parallel collectives on the fastest ICI ring), sp = 1,
+    dp = remainder."""
+    devices = jax.devices()
+    n = n_devices or len(devices)
+    devices = devices[:n]
+    if tp is None:
+        tp = 4 if n % 4 == 0 and n >= 4 else (2 if n % 2 == 0 else 1)
+    if dp is None:
+        dp = n // (tp * sp)
+    assert dp * sp * tp == n, f"mesh {dp}x{sp}x{tp} != {n} devices"
+    arr = np.array(devices).reshape(dp, sp, tp)
+    return Mesh(arr, axis_names=("dp", "sp", "tp"))
+
+
+# -- training step ------------------------------------------------------------
+
+
+def make_train_step(
+    cfg: ModelConfig, mesh: Mesh, learning_rate: float = 1e-3
+):
+    """(params, opt_state, tokens) -> (params, opt_state, loss), jit'd over
+    the mesh with real dp/sp/tp shardings."""
+    optimizer = optax.adamw(learning_rate)
+    p_shard = _full_param_shardings(mesh, cfg)
+    # Input tokens carry seq_len+1 (targets are the shift-by-one), which is
+    # rarely divisible by sp — shard them on dp only; the activation
+    # constraint below shards the model-visible seq_len over sp.
+    data_shard = NamedSharding(mesh, P("dp", None))
+    act_shard = NamedSharding(mesh, P("dp", "sp", None))
+    repl = NamedSharding(mesh, P())
+
+    def loss_fn(params, tokens):
+        logits = forward(params, tokens[:, :-1], cfg,
+                         activation_sharding=act_shard)
+        targets = tokens[:, 1:]
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)
+        return jnp.mean(nll)
+
+    def step(params, opt_state, tokens):
+        loss, grads = jax.value_and_grad(loss_fn)(params, tokens)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    # Optimizer-state shardings must be pinned explicitly: with
+    # out_shardings=None XLA may re-shard a replicated param's moment (or
+    # the param itself) between steps, and the next call's in_shardings
+    # then mismatch. The adamw state embeds param-shaped subtrees (mu/nu),
+    # so map each opt leaf whose key-path *ends with* a param path to that
+    # param's sharding, everything else (step counts) replicated.
+    params_struct = jax.eval_shape(lambda k: init_params(cfg, k),
+                                   jax.random.key(0))
+    opt_struct = jax.eval_shape(optimizer.init, params_struct)
+    param_paths = {
+        tuple(str(k) for k in path): shard
+        for path, shard in jax.tree_util.tree_flatten_with_path(p_shard)[0]
+    }
+
+    def opt_leaf_sharding(path, leaf):  # noqa: ARG001
+        keys = tuple(str(k) for k in path)
+        for ppath, shard in param_paths.items():
+            if len(keys) >= len(ppath) and keys[-len(ppath):] == ppath:
+                return shard
+        return repl
+
+    o_shard = jax.tree_util.tree_map_with_path(opt_leaf_sharding, opt_struct)
+
+    def init_all(key):
+        params = jax.jit(
+            lambda k: init_params(cfg, k), out_shardings=p_shard
+        )(key)
+        opt_state = jax.jit(optimizer.init, out_shardings=o_shard)(params)
+        return params, opt_state
+
+    train_step = jax.jit(
+        step,
+        in_shardings=(p_shard, o_shard, data_shard),
+        out_shardings=(p_shard, o_shard, repl),
+        donate_argnums=(0, 1),
+    )
+    return train_step, init_all, optimizer
+
+
+def make_forward(cfg: ModelConfig):
+    """Single-device jittable forward (graft entry())."""
+
+    def fn(params, tokens):
+        return forward(params, tokens, cfg)
+
+    return fn

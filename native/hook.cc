@@ -1,0 +1,132 @@
+// elastic-tpu-hook: OCI createRuntime/prestart hook.
+//
+// Capability parity with the reference's cmd/elastic-gpu-hook/main.go
+// (SURVEY.md §1 L8, §2 #14): the container runtime invokes this with the
+// OCI hook state on stdin; it loads the bundle's config.json, extracts the
+// allocation hash from the container env (TPU=<hash>; GPU=<hash> accepted
+// for scheduler compatibility, reference main.go:200), and delegates the
+// actual injection to elastic-tpu-container-toolkit (reference exec'd its
+// patched nvidia toolkit the same way, main.go:224-257). No hash env ->
+// passthrough exit 0 (main.go:202-209).
+//
+// TPU-native difference: injection targets the bundle *rootfs* (resolved
+// from config.json root.path) rather than an nsenter'd /dev — at
+// createRuntime time the rootfs is assembled but the container hasn't
+// started, so plain mknod/bind into it is race-free and works with both
+// runc and crun. The setns path lives in mount_elastic_tpu.c for attaching
+// to already-running containers.
+
+#include <limits.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <unistd.h>
+
+#include <iostream>
+#include <sstream>
+#include <string>
+#include <vector>
+
+#include "json.h"
+
+namespace {
+
+std::string ReadAll(std::istream& in) {
+  std::stringstream ss;
+  ss << in.rdbuf();
+  return ss.str();
+}
+
+std::string ReadFile(const std::string& path) {
+  FILE* f = fopen(path.c_str(), "rb");
+  if (!f) return "";
+  std::string out;
+  char buf[4096];
+  size_t n;
+  while ((n = fread(buf, 1, sizeof(buf), f)) > 0) out.append(buf, n);
+  fclose(f);
+  return out;
+}
+
+// Extract "<hash>" from env entries ["TPU=abc", ...]; TPU wins over GPU.
+std::string HashFromEnv(const etpu::JsonPtr& env_array) {
+  std::string gpu_compat;
+  if (!env_array || !env_array->is_array()) return "";
+  for (auto& e : env_array->items) {
+    const std::string& s = e->str_value;
+    if (s.rfind("TPU=", 0) == 0) return s.substr(4);
+    if (s.rfind("GPU=", 0) == 0) gpu_compat = s.substr(4);
+  }
+  return gpu_compat;
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  bool verbose = getenv("ELASTIC_TPU_HOOK_VERBOSE") != nullptr;
+  for (int i = 1; i < argc; i++) {
+    if (std::string(argv[i]) == "--verbose") verbose = true;
+  }
+
+  // 1. OCI hook state from stdin: {"id": ..., "pid": N, "bundle": DIR}.
+  etpu::JsonPtr state = etpu::Json::Parse(ReadAll(std::cin));
+  if (!state || !state->is_object()) {
+    fprintf(stderr, "elastic-tpu-hook: malformed hook state on stdin\n");
+    return 1;
+  }
+  etpu::JsonPtr bundle_v = state->get("bundle");
+  if (!bundle_v) bundle_v = state->get("bundlePath");  // older runtimes
+  std::string bundle = bundle_v ? bundle_v->str_or("") : "";
+  if (bundle.empty()) {
+    fprintf(stderr, "elastic-tpu-hook: hook state has no bundle path\n");
+    return 1;
+  }
+
+  // 2. The bundle's OCI config: env + rootfs (reference: loadSpec,
+  //    main.go:35-61).
+  std::string config_raw = ReadFile(bundle + "/config.json");
+  etpu::JsonPtr config = etpu::Json::Parse(config_raw);
+  if (!config || !config->is_object()) {
+    fprintf(stderr, "elastic-tpu-hook: cannot parse %s/config.json\n",
+            bundle.c_str());
+    return 1;
+  }
+  etpu::JsonPtr process = config->get("process");
+  std::string hash =
+      HashFromEnv(process ? process->get("env") : nullptr);
+  if (hash.empty()) {
+    if (verbose)
+      fprintf(stderr, "elastic-tpu-hook: no TPU/GPU env; passthrough\n");
+    return 0;  // not an elastic-TPU container
+  }
+
+  etpu::JsonPtr root = config->get("root");
+  std::string rootfs = root ? root->get("path")
+                                  ? root->get("path")->str_or("rootfs")
+                                  : "rootfs"
+                            : "rootfs";
+  if (!rootfs.empty() && rootfs[0] != '/') rootfs = bundle + "/" + rootfs;
+
+  // 3. Delegate injection to the toolkit (exec, reference: doPreStart).
+  const char* toolkit = getenv("ELASTIC_TPU_TOOLKIT");
+  std::string toolkit_path =
+      toolkit ? toolkit : "/usr/local/bin/elastic-tpu-container-toolkit";
+  const char* alloc_dir = getenv("ELASTIC_TPU_ALLOC_DIR");
+  const char* dev_dir = getenv("ELASTIC_TPU_DEV_DIR");
+  const char* libtpu = getenv("ELASTIC_TPU_LIBTPU");
+
+  std::vector<std::string> args = {toolkit_path, "inject", "--rootfs", rootfs,
+                                   "--hash", hash};
+  if (alloc_dir) { args.push_back("--alloc-dir"); args.push_back(alloc_dir); }
+  if (dev_dir) { args.push_back("--dev"); args.push_back(dev_dir); }
+  if (libtpu) { args.push_back("--libtpu"); args.push_back(libtpu); }
+  if (verbose) args.push_back("--verbose");
+
+  std::vector<char*> cargs;
+  for (auto& a : args) cargs.push_back(const_cast<char*>(a.c_str()));
+  cargs.push_back(nullptr);
+  execv(cargs[0], cargs.data());
+  fprintf(stderr, "elastic-tpu-hook: exec %s: %s\n", toolkit_path.c_str(),
+          strerror(errno));
+  return 1;
+}

@@ -1,0 +1,32 @@
+#!/bin/sh
+# Host installer (reference: tools/install.sh swapped nvidia hook binaries;
+# TPU hosts have no pre-existing hook to swap, so we install ours and
+# register it as an OCI createRuntime hook).
+#
+# Run from the DaemonSet init container with the host filesystem mounted at
+# $HOST_ROOT (default /host).
+set -e
+HOST_ROOT="${HOST_ROOT:-/host}"
+SRC_DIR="$(dirname "$0")"
+
+install -m 0755 "$SRC_DIR/elastic-tpu-hook" \
+    "$HOST_ROOT/usr/local/bin/elastic-tpu-hook"
+install -m 0755 "$SRC_DIR/elastic-tpu-container-toolkit" \
+    "$HOST_ROOT/usr/local/bin/elastic-tpu-container-toolkit"
+install -m 0755 "$SRC_DIR/mount_elastic_tpu" \
+    "$HOST_ROOT/usr/local/bin/mount_elastic_tpu"
+
+# OCI hooks dir consumed by CRI-O / podman directly; for containerd+runc,
+# reference this json from the runtime handler or use an NRI/base-spec that
+# includes it (see deploy/README).
+HOOK_DIR="$HOST_ROOT/usr/share/containers/oci/hooks.d"
+mkdir -p "$HOOK_DIR"
+cat > "$HOOK_DIR/10-elastic-tpu.json" <<'EOF'
+{
+  "version": "1.0.0",
+  "hook": {"path": "/usr/local/bin/elastic-tpu-hook"},
+  "when": {"env": ["TPU=.*", "GPU=.*"]},
+  "stages": ["createRuntime", "prestart"]
+}
+EOF
+echo "elastic-tpu host helpers installed under $HOST_ROOT/usr/local/bin"

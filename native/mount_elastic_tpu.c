@@ -1,0 +1,86 @@
+/* mount_elastic_tpu: attach a TPU device node to a RUNNING container.
+ *
+ * Capability parity with the reference's tools/mount_elastic_gpu.c
+ * (SURVEY.md §2 #15): enter the target pid's mount namespace and
+ * materialize a device node at the requested path. The reference
+ * created placeholder files and MS_BIND-mounted over /dev/nvidia*
+ * (mount_elastic_gpu.c:41-83); bind sources are namespace-relative
+ * though, so for TPU we stat the source chardev in the HOST namespace
+ * first, carry its major:minor across setns, and mknod inside — with the
+ * bind mount kept as fallback for nodev filesystems.
+ *
+ * Usage: mount_elastic_tpu <pid> <host-dev-path> <container-dev-path>
+ *   e.g. mount_elastic_tpu 12345 /dev/accel2 /dev/accel0
+ */
+#define _GNU_SOURCE
+#include <errno.h>
+#include <fcntl.h>
+#include <sched.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/mount.h>
+#include <sys/stat.h>
+#include <sys/sysmacros.h>
+#include <sys/types.h>
+#include <unistd.h>
+
+static void die(const char *what) {
+  fprintf(stderr, "mount_elastic_tpu: %s: %s\n", what, strerror(errno));
+  exit(1);
+}
+
+int main(int argc, char **argv) {
+  if (argc != 4) {
+    fprintf(stderr,
+            "usage: mount_elastic_tpu <pid> <host-dev-path> "
+            "<container-dev-path>\n");
+    return 2;
+  }
+  const char *pid = argv[1];
+  const char *source = argv[2];
+  const char *target = argv[3];
+
+  /* Resolve the device identity while still in the host namespace. */
+  struct stat st;
+  if (stat(source, &st) != 0) die("stat source");
+  int is_chardev = S_ISCHR(st.st_mode);
+
+  /* Keep a host-namespace fd of the source for the bind fallback. */
+  int srcfd = open(source, O_PATH | O_CLOEXEC);
+  if (srcfd < 0) die("open source");
+
+  char nspath[64];
+  snprintf(nspath, sizeof(nspath), "/proc/%s/ns/mnt", pid);
+  int nsfd = open(nspath, O_RDONLY | O_CLOEXEC);
+  if (nsfd < 0) die("open mount namespace");
+  if (setns(nsfd, CLONE_NEWNS) != 0) die("setns");
+  close(nsfd);
+
+  if (is_chardev) {
+    if (mknod(target, S_IFCHR | 0666, st.st_rdev) == 0) {
+      printf("mknod %s (dev %u:%u)\n", target, major(st.st_rdev),
+             minor(st.st_rdev));
+      return 0;
+    }
+    if (errno == EEXIST) {
+      struct stat cur;
+      if (lstat(target, &cur) == 0 && S_ISCHR(cur.st_mode) &&
+          cur.st_rdev == st.st_rdev) {
+        printf("%s already present\n", target);
+        return 0;
+      }
+    }
+    fprintf(stderr, "mount_elastic_tpu: mknod %s: %s; trying bind\n", target,
+            strerror(errno));
+  }
+
+  /* Bind fallback via the host-ns fd (visible as a magic-link path). */
+  int tfd = open(target, O_CREAT | O_WRONLY | O_CLOEXEC, 0666);
+  if (tfd >= 0) close(tfd);
+  char fdpath[64];
+  snprintf(fdpath, sizeof(fdpath), "/proc/self/fd/%d", srcfd);
+  if (mount(fdpath, target, NULL, MS_BIND, NULL) != 0) die("bind mount");
+  printf("bind %s -> %s\n", source, target);
+  return 0;
+}

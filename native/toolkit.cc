@@ -1,0 +1,298 @@
+// elastic-tpu-container-toolkit: inject TPU devices + env into a container
+// rootfs.
+//
+// TPU-native replacement for the reference's prebuilt patched
+// nvidia-container-toolkit ELF (tools/egpu-nvidia-container-toolkit,
+// SURVEY.md §2 #16, invoked from cmd/elastic-gpu-hook/main.go:224-257).
+// There is no libnvidia-container for TPU, so this binary owns the
+// injection mechanism outright:
+//
+//   1. Resolve the allocation hash to physical chips: first from the
+//      agent's allocation spec (/var/lib/elastic-tpu/alloc/<hash>.json,
+//      written at PreStartContainer), falling back to scanning
+//      /dev/elastic-tpu-<hash>-* symlinks and readlink-parsing the accel
+//      index (the reference hook's resolution scheme, main.go:132-158).
+//   2. Materialize each chip inside the container rootfs as a *dense*
+//      /dev/accel<p> (p = 0..n-1) chardev via mknod with the host node's
+//      rdev — device identity is major:minor, so this works without any
+//      mount-namespace gymnastics at create time. Bind-mount fallback for
+//      filesystems that refuse mknod.
+//   3. Write /run/elastic-tpu/env (KEY=VALUE lines) and a copy of the
+//      allocation spec into the rootfs so entrypoints and in-container
+//      tooling can read TPU_VISIBLE_CHIPS / HBM quota.
+//   4. Optionally copy libtpu.so into the rootfs when the image lacks one.
+//
+// Usage:
+//   elastic-tpu-container-toolkit inject --rootfs <dir> --hash <h>
+//       [--alloc-dir DIR] [--dev DIR] [--libtpu PATH] [--verbose]
+
+#include <dirent.h>
+#include <errno.h>
+#include <fcntl.h>
+#include <limits.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/mount.h>
+#include <sys/stat.h>
+#include <sys/sysmacros.h>
+#include <sys/types.h>
+#include <unistd.h>
+
+#include <algorithm>
+#include <fstream>
+#include <sstream>
+#include <string>
+#include <vector>
+
+#include "json.h"
+
+namespace {
+
+bool g_verbose = false;
+
+void vlog(const std::string& msg) {
+  if (g_verbose) fprintf(stderr, "elastic-tpu-toolkit: %s\n", msg.c_str());
+}
+
+std::string ReadFile(const std::string& path) {
+  std::ifstream f(path);
+  if (!f) return "";
+  std::stringstream ss;
+  ss << f.rdbuf();
+  return ss.str();
+}
+
+bool MkdirP(const std::string& path, mode_t mode) {
+  std::string cur;
+  std::stringstream ss(path);
+  std::string part;
+  if (!path.empty() && path[0] == '/') cur = "/";
+  while (std::getline(ss, part, '/')) {
+    if (part.empty()) continue;
+    cur += part + "/";
+    if (mkdir(cur.c_str(), mode) != 0 && errno != EEXIST) return false;
+  }
+  return true;
+}
+
+struct AllocSpec {
+  std::vector<int> chip_indexes;
+  std::vector<std::string> device_paths;         // host paths, e.g. /dev/accel3
+  std::vector<std::pair<std::string, std::string>> env;
+  bool valid = false;
+};
+
+// Parse the chip index out of "/dev/accel3" (reference equivalent:
+// getGPUIndex, main.go:122-130).
+int AccelIndex(const std::string& path) {
+  size_t pos = path.rfind("accel");
+  if (pos == std::string::npos) return -1;
+  const char* digits = path.c_str() + pos + 5;
+  if (*digits == '\0') return -1;
+  char* end = nullptr;
+  long idx = strtol(digits, &end, 10);
+  if (end == digits || *end != '\0') return -1;
+  return static_cast<int>(idx);
+}
+
+AllocSpec SpecFromFile(const std::string& alloc_dir, const std::string& hash) {
+  AllocSpec spec;
+  std::string raw = ReadFile(alloc_dir + "/" + hash + ".json");
+  if (raw.empty()) return spec;
+  etpu::JsonPtr root = etpu::Json::Parse(raw);
+  if (!root || !root->is_object()) return spec;
+  etpu::JsonPtr chips = root->get("chip_indexes");
+  etpu::JsonPtr paths = root->get("device_paths");
+  if (!chips || !chips->is_array()) return spec;
+  for (auto& c : chips->items) spec.chip_indexes.push_back((int)c->int_or(-1));
+  if (paths && paths->is_array()) {
+    for (auto& p : paths->items) spec.device_paths.push_back(p->str_or(""));
+  } else {
+    for (int idx : spec.chip_indexes)
+      spec.device_paths.push_back("/dev/accel" + std::to_string(idx));
+  }
+  etpu::JsonPtr env = root->get("env");
+  if (env && env->is_object()) {
+    for (auto& kv : env->members)
+      spec.env.emplace_back(kv.first, kv.second->str_or(""));
+  }
+  etpu::JsonPtr hbm = root->get("hbm_limit_bytes");
+  if (hbm && hbm->type == etpu::Json::kNumber) {
+    spec.env.emplace_back("ELASTIC_TPU_HBM_LIMIT_BYTES",
+                          std::to_string(hbm->int_or(0)));
+  }
+  spec.valid = !spec.chip_indexes.empty();
+  return spec;
+}
+
+// Fallback resolution: scan <dev>/elastic-tpu-<hash>-* symlinks, sorted by
+// the -<p> suffix, readlink each to the physical node (reference:
+// findGPUIndexes, main.go:132-158).
+AllocSpec SpecFromDevScan(const std::string& dev_dir, const std::string& hash) {
+  AllocSpec spec;
+  std::string prefix = "elastic-tpu-" + hash + "-";
+  DIR* d = opendir(dev_dir.c_str());
+  if (!d) return spec;
+  std::vector<std::pair<int, std::string>> found;  // (position, link path)
+  struct dirent* ent;
+  while ((ent = readdir(d)) != nullptr) {
+    std::string name = ent->d_name;
+    if (name.rfind(prefix, 0) != 0) continue;
+    int p = atoi(name.c_str() + prefix.size());
+    found.emplace_back(p, dev_dir + "/" + name);
+  }
+  closedir(d);
+  std::sort(found.begin(), found.end());
+  for (auto& [p, link] : found) {
+    char target[PATH_MAX];
+    ssize_t n = readlink(link.c_str(), target, sizeof(target) - 1);
+    if (n < 0) continue;
+    target[n] = '\0';
+    int idx = AccelIndex(target);
+    if (idx < 0) continue;
+    spec.chip_indexes.push_back(idx);
+    spec.device_paths.push_back(target);
+  }
+  if (!spec.chip_indexes.empty()) {
+    std::string visible;
+    for (size_t p = 0; p < spec.chip_indexes.size(); p++) {
+      if (p) visible += ",";
+      visible += std::to_string(p);
+    }
+    spec.env.emplace_back("TPU_VISIBLE_CHIPS", visible);
+    spec.valid = true;
+  }
+  return spec;
+}
+
+// Materialize one host chardev at rootfs_path: mknod with the host rdev,
+// bind-mount fallback.
+bool InjectDevice(const std::string& host_path, const std::string& rootfs_path) {
+  struct stat st;
+  if (stat(host_path.c_str(), &st) != 0) {  // follows the symlink
+    fprintf(stderr, "elastic-tpu-toolkit: stat %s: %s\n", host_path.c_str(),
+            strerror(errno));
+    return false;
+  }
+  if (!S_ISCHR(st.st_mode)) {
+    // Test/stub environments use regular files as fake chardevs; fall
+    // through to the bind path for those.
+    vlog(host_path + " is not a chardev; using bind mount");
+  } else if (mknod(rootfs_path.c_str(), S_IFCHR | 0666, st.st_rdev) == 0) {
+    vlog("mknod " + rootfs_path);
+    return true;
+  } else if (errno == EEXIST) {
+    struct stat cur;
+    if (lstat(rootfs_path.c_str(), &cur) == 0 && S_ISCHR(cur.st_mode) &&
+        cur.st_rdev == st.st_rdev)
+      return true;  // idempotent re-run
+    unlink(rootfs_path.c_str());
+    if (mknod(rootfs_path.c_str(), S_IFCHR | 0666, st.st_rdev) == 0) return true;
+  }
+  // Bind-mount fallback (mknod refused: user ns, nodev fs, ...). Mechanism
+  // proven by the reference's tools/mount_elastic_gpu.c:66-81.
+  int fd = open(rootfs_path.c_str(), O_CREAT | O_WRONLY, 0666);
+  if (fd >= 0) close(fd);
+  if (mount(host_path.c_str(), rootfs_path.c_str(), nullptr, MS_BIND, nullptr) == 0) {
+    vlog("bind " + host_path + " -> " + rootfs_path);
+    return true;
+  }
+  fprintf(stderr, "elastic-tpu-toolkit: inject %s -> %s failed: %s\n",
+          host_path.c_str(), rootfs_path.c_str(), strerror(errno));
+  return false;
+}
+
+bool CopyFile(const std::string& from, const std::string& to) {
+  std::ifstream src(from, std::ios::binary);
+  if (!src) return false;
+  std::ofstream dst(to, std::ios::binary);
+  if (!dst) return false;
+  dst << src.rdbuf();
+  return dst.good();
+}
+
+int Inject(const std::string& rootfs, const std::string& hash,
+           const std::string& alloc_dir, const std::string& dev_dir,
+           const std::string& libtpu) {
+  AllocSpec spec = SpecFromFile(alloc_dir, hash);
+  if (!spec.valid) spec = SpecFromDevScan(dev_dir, hash);
+  if (!spec.valid) {
+    fprintf(stderr,
+            "elastic-tpu-toolkit: no allocation found for hash %s "
+            "(checked %s and %s)\n",
+            hash.c_str(), alloc_dir.c_str(), dev_dir.c_str());
+    return 1;
+  }
+
+  if (!MkdirP(rootfs + "/dev", 0755)) return 1;
+  for (size_t p = 0; p < spec.device_paths.size(); p++) {
+    std::string target = rootfs + "/dev/accel" + std::to_string(p);
+    if (!InjectDevice(spec.device_paths[p], target)) return 1;
+  }
+
+  // vfio-based stacks also need /dev/vfio; inject whole dir if present.
+  struct stat st;
+  if (stat("/dev/vfio", &st) == 0 && S_ISDIR(st.st_mode)) {
+    MkdirP(rootfs + "/dev/vfio", 0755);
+    mount("/dev/vfio", (rootfs + "/dev/vfio").c_str(), nullptr, MS_BIND,
+          nullptr);
+  }
+
+  if (!MkdirP(rootfs + "/run/elastic-tpu", 0755)) return 1;
+  std::ofstream envf(rootfs + "/run/elastic-tpu/env");
+  for (auto& [k, v] : spec.env) envf << k << "=" << v << "\n";
+  envf.close();
+  CopyFile(alloc_dir + "/" + hash + ".json",
+           rootfs + "/run/elastic-tpu/alloc.json");
+
+  if (!libtpu.empty()) {
+    struct stat lst;
+    std::string dst = rootfs + "/usr/lib/libtpu.so";
+    if (stat(dst.c_str(), &lst) != 0 && stat(libtpu.c_str(), &lst) == 0) {
+      MkdirP(rootfs + "/usr/lib", 0755);
+      if (CopyFile(libtpu, dst)) vlog("installed libtpu.so");
+    }
+  }
+  vlog("injected " + std::to_string(spec.device_paths.size()) +
+       " chip(s) for " + hash);
+  return 0;
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  std::string cmd = argc > 1 ? argv[1] : "";
+  std::string rootfs, hash;
+  std::string alloc_dir = "/var/lib/elastic-tpu/alloc";
+  std::string dev_dir = "/dev";
+  std::string libtpu;
+  for (int i = 2; i < argc; i++) {
+    std::string a = argv[i];
+    auto next = [&](const char* flag) -> std::string {
+      if (i + 1 >= argc) {
+        fprintf(stderr, "missing value for %s\n", flag);
+        exit(2);
+      }
+      return argv[++i];
+    };
+    if (a == "--rootfs") rootfs = next("--rootfs");
+    else if (a == "--hash") hash = next("--hash");
+    else if (a == "--alloc-dir") alloc_dir = next("--alloc-dir");
+    else if (a == "--dev") dev_dir = next("--dev");
+    else if (a == "--libtpu") libtpu = next("--libtpu");
+    else if (a == "--verbose") g_verbose = true;
+    else {
+      fprintf(stderr, "unknown flag %s\n", a.c_str());
+      return 2;
+    }
+  }
+  if (cmd != "inject" || rootfs.empty() || hash.empty()) {
+    fprintf(stderr,
+            "usage: elastic-tpu-container-toolkit inject --rootfs DIR "
+            "--hash H [--alloc-dir DIR] [--dev DIR] [--libtpu PATH] "
+            "[--verbose]\n");
+    return 2;
+  }
+  return Inject(rootfs, hash, alloc_dir, dev_dir, libtpu);
+}

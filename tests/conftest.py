@@ -8,7 +8,9 @@
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force CPU even when the environment pre-sets JAX_PLATFORMS to a real TPU
+# backend — tests must never grab the chip (bench.py does, deliberately).
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
@@ -16,3 +18,10 @@ if "xla_force_host_platform_device_count" not in _flags:
     ).strip()
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# The image's sitecustomize imports jax at interpreter startup (TPU tunnel
+# plugin), which snapshots JAX_PLATFORMS before this file runs — override
+# through jax.config too.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

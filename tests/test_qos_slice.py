@@ -1,0 +1,161 @@
+"""QoS env + multi-host slice env tests (BASELINE configs 4 and 5)."""
+
+import json
+import os
+
+import pytest
+
+from elastic_tpu_agent.common import (
+    AnnotationAssumed,
+    AnnotationSliceName,
+    AnnotationSliceWorkerHosts,
+    AnnotationSliceWorkerID,
+    ResourceTPUMemory,
+    container_annotation,
+)
+from elastic_tpu_agent.qos import AnnotationQoSPriority, qos_env
+from elastic_tpu_agent.slice_env import slice_env_for_pod
+from elastic_tpu_agent.tpu.topology import parse_accelerator_type
+
+
+# -- unit: qos_env ------------------------------------------------------------
+
+
+def test_qos_env_hbm_quota_and_fraction():
+    env = qos_env(
+        {}, hbm_limit_bytes=8 * 1024**3, chip_hbm_bytes=16 * 1024**3
+    )
+    assert env["ELASTIC_TPU_HBM_LIMIT_BYTES"] == str(8 * 1024**3)
+    assert env["ELASTIC_TPU_HBM_FRACTION"] == "0.5000"
+
+
+def test_qos_env_priority_sources():
+    assert (
+        qos_env({AnnotationQoSPriority: "low"})["ELASTIC_TPU_PRIORITY"] == "low"
+    )
+    pod = {"spec": {"priorityClassName": "high-priority-training"}}
+    assert qos_env({}, pod_spec=pod)["ELASTIC_TPU_PRIORITY"] == "high"
+    assert "ELASTIC_TPU_PRIORITY" not in qos_env({})
+    assert "ELASTIC_TPU_PRIORITY" not in qos_env({AnnotationQoSPriority: "x"})
+
+
+def test_qos_env_fraction_capped_at_1():
+    env = qos_env(
+        {}, hbm_limit_bytes=32 * 1024**3, chip_hbm_bytes=16 * 1024**3
+    )
+    assert env["ELASTIC_TPU_HBM_FRACTION"] == "1.0000"
+
+
+# -- unit: slice_env ----------------------------------------------------------
+
+
+def test_slice_env_single_host_empty():
+    topo = parse_accelerator_type("v5litepod-4")
+    assert slice_env_for_pod({}, topo) == {}
+
+
+def test_slice_env_multi_host_from_metadata():
+    topo = parse_accelerator_type("v5p-16")  # 8 chips over 2 hosts
+    env = slice_env_for_pod({}, topo, host_worker_id=1,
+                            host_worker_hostnames=["h0", "h1"])
+    assert env["TPU_WORKER_ID"] == "1"
+    assert env["TPU_WORKER_HOSTNAMES"] == "h0,h1"
+    assert env["TPU_CHIPS_PER_HOST_BOUNDS"] == "2,2,1"
+    assert env["TPU_HOST_BOUNDS"] == "1,2,1"
+    assert env["TPU_ACCELERATOR_TYPE"] == "v5p-16"
+
+
+def test_slice_env_annotations_override():
+    topo = parse_accelerator_type("v5litepod-4")  # host thinks single-host
+    ann = {
+        AnnotationSliceName: "v5p-16",
+        AnnotationSliceWorkerID: "3",
+        AnnotationSliceWorkerHosts: "w0,w1,w2,w3",
+    }
+    env = slice_env_for_pod(ann, topo, host_worker_id=0)
+    assert env["TPU_ACCELERATOR_TYPE"] == "v5p-16"
+    assert env["TPU_WORKER_ID"] == "3"
+    assert env["TPU_WORKER_HOSTNAMES"] == "w0,w1,w2,w3"
+
+
+# -- integration: env lands in the alloc spec via PreStart --------------------
+
+
+@pytest.fixture()
+def harness(tmp_path):
+    # lightweight copy of the plugin harness (memory plugin only needed)
+    import threading
+
+    from elastic_tpu_agent import rpc
+    from elastic_tpu_agent.kube.locator import KubeletDeviceLocator
+    from elastic_tpu_agent.plugins.base import PluginConfig
+    from elastic_tpu_agent.plugins.tpushare import TPUSharePlugin
+    from elastic_tpu_agent.storage import Storage
+    from elastic_tpu_agent.tpu import StubOperator
+
+    from fake_kubelet import FakeKubelet, FakeSitter
+
+    dp_dir = str(tmp_path / "dp")
+    pr_sock = str(tmp_path / "pr" / "kubelet.sock")
+    dev_root = str(tmp_path / "dev")
+    os.makedirs(dev_root)
+    kubelet = FakeKubelet(dp_dir, pr_sock)
+    kubelet.start()
+    sitter = FakeSitter()
+    storage = Storage(str(tmp_path / "meta.db"))
+    pr_client = rpc.PodResourcesClient(pr_sock)
+    config = PluginConfig(
+        device_plugin_dir=dp_dir,
+        pod_resources_socket=pr_sock,
+        operator=StubOperator(dev_root, "v5litepod-4"),
+        sitter=sitter,
+        storage=storage,
+        locator_factory=lambda res: KubeletDeviceLocator(res, pr_client),
+        extra={"alloc_spec_dir": str(tmp_path / "alloc")},
+    )
+    plugin = TPUSharePlugin(config)
+    stop = threading.Event()
+    plugin.run(stop)
+    assert kubelet.wait_registrations(2)
+
+    class H:
+        pass
+
+    h = H()
+    h.kubelet, h.sitter, h.alloc_dir = kubelet, sitter, str(tmp_path / "alloc")
+    yield h
+    stop.set()
+    plugin.core.stop_streams()
+    plugin.memory.stop_streams()
+    kubelet.stop()
+    storage.close()
+
+
+def test_prestart_spec_carries_qos_and_slice_env(harness):
+    from elastic_tpu_agent.plugins.tpushare import MEM_ENDPOINT, mem_device_id
+    from elastic_tpu_agent.types import Device
+
+    ann = {
+        AnnotationAssumed: "true",
+        container_annotation("jax"): "0",
+        AnnotationQoSPriority: "low",
+        AnnotationSliceName: "v5p-16",
+        AnnotationSliceWorkerID: "1",
+        AnnotationSliceWorkerHosts: "w0,w1",
+    }
+    harness.sitter.add_pod("default", "qos-0", ann)
+    ids = [mem_device_id(0, i) for i in range(4096)]  # 4 GiB of 16 GiB
+    harness.kubelet.kubelet_allocate_flow(
+        MEM_ENDPOINT, "default", "qos-0", "jax", ResourceTPUMemory, ids
+    )
+    dev_hash = Device(ids, ResourceTPUMemory).hash
+    with open(os.path.join(harness.alloc_dir, f"{dev_hash}.json")) as f:
+        spec = json.load(f)
+    env = spec["env"]
+    assert env["ELASTIC_TPU_HBM_LIMIT_BYTES"] == str(4096 * 1024 * 1024)
+    assert env["ELASTIC_TPU_HBM_FRACTION"] == "0.2500"
+    assert env["ELASTIC_TPU_PRIORITY"] == "low"
+    assert env["TPU_ACCELERATOR_TYPE"] == "v5p-16"
+    assert env["TPU_WORKER_ID"] == "1"
+    assert env["TPU_WORKER_HOSTNAMES"] == "w0,w1"
+    assert spec["hbm_limit_bytes"] == 4096 * 1024 * 1024

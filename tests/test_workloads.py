@@ -1,0 +1,93 @@
+"""Sharded transformer workload tests on the virtual 8-device CPU mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from elastic_tpu_agent.workloads import (
+    ModelConfig,
+    forward,
+    init_params,
+    make_mesh,
+    make_train_step,
+)
+
+TINY = ModelConfig(
+    vocab=128, d_model=64, n_heads=4, n_layers=2, d_ff=128, max_seq=64
+)
+
+
+def test_eight_cpu_devices_available():
+    assert len(jax.devices()) == 8, (
+        "conftest must provide 8 virtual CPU devices"
+    )
+
+
+def test_forward_shapes_single_device():
+    params = init_params(TINY, jax.random.key(0))
+    tokens = jnp.zeros((2, 16), jnp.int32)
+    logits = jax.jit(lambda p, t: forward(p, t, TINY))(params, tokens)
+    assert logits.shape == (2, 16, TINY.vocab)
+
+
+def test_causality():
+    """Changing a future token must not affect earlier logits."""
+    params = init_params(TINY, jax.random.key(0))
+    t1 = jnp.array([[1, 2, 3, 4, 5, 6, 7, 8]], jnp.int32)
+    t2 = t1.at[0, -1].set(99)
+    l1 = forward(params, t1, TINY)
+    l2 = forward(params, t2, TINY)
+    np.testing.assert_allclose(
+        np.asarray(l1[0, :-1]), np.asarray(l2[0, :-1]), rtol=2e-2, atol=2e-2
+    )
+
+
+def test_mesh_shapes():
+    mesh = make_mesh(8)
+    assert mesh.shape == {"dp": 2, "sp": 1, "tp": 4}
+    mesh2 = make_mesh(8, dp=2, sp=2, tp=2)
+    assert mesh2.shape == {"dp": 2, "sp": 2, "tp": 2}
+
+
+def test_train_step_dp_tp_loss_decreases():
+    mesh = make_mesh(8, dp=2, sp=1, tp=4)
+    train_step, init_all, _ = make_train_step(TINY, mesh)
+    params, opt_state = init_all(jax.random.key(0))
+    tokens = jax.random.randint(jax.random.key(1), (4, 33), 0, TINY.vocab)
+    losses = []
+    for _ in range(5):
+        params, opt_state, loss = train_step(params, opt_state, tokens)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0], f"no learning: {losses}"
+    assert np.isfinite(losses).all()
+
+
+def test_train_step_with_sequence_parallelism():
+    """sp>1 shards the sequence axis — long-context layout compiles and
+    matches the sp=1 loss on the same data."""
+    tokens = jax.random.randint(jax.random.key(1), (4, 33), 0, TINY.vocab)
+
+    def one_loss(dp, sp, tp):
+        mesh = make_mesh(8, dp=dp, sp=sp, tp=tp)
+        train_step, init_all, _ = make_train_step(TINY, mesh)
+        params, opt_state = init_all(jax.random.key(0))
+        _, _, loss = train_step(params, opt_state, tokens)
+        return float(loss)
+
+    l_base = one_loss(2, 1, 4)
+    l_sp = one_loss(2, 2, 2)
+    assert abs(l_base - l_sp) < 0.05, (
+        f"sp-sharded loss diverged: {l_base} vs {l_sp}"
+    )
+
+
+def test_params_actually_sharded():
+    mesh = make_mesh(8, dp=2, sp=1, tp=4)
+    _, init_all, _ = make_train_step(TINY, mesh)
+    params, _ = init_all(jax.random.key(0))
+    w1 = params["layers"][0]["w1"]
+    # d_ff sharded 4-way over tp
+    assert w1.sharding.spec == jax.sharding.PartitionSpec(None, "tp")
+    shard_shapes = {s.data.shape for s in w1.addressable_shards}
+    assert shard_shapes == {(TINY.d_model, TINY.d_ff // 4)}
