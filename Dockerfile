@@ -1,0 +1,19 @@
+# elastic-tpu-agent image: agent + native host helpers.
+# (Reference: two-binary CGO build on debian-slim, Dockerfile:1-27; here
+# the native helpers build in a gcc stage and the agent is Python.)
+FROM gcc:13-bookworm AS native-build
+WORKDIR /src
+COPY native/ native/
+RUN make -C native
+
+FROM python:3.12-slim-bookworm
+RUN pip install --no-cache-dir grpcio protobuf requests pyyaml \
+    prometheus-client
+WORKDIR /opt/elastic-tpu
+COPY elastic_tpu_agent/ elastic_tpu_agent/
+COPY --from=native-build /src/native/elastic-tpu-hook \
+    /src/native/elastic-tpu-container-toolkit \
+    /src/native/mount_elastic_tpu native/
+COPY native/install.sh native/
+ENV PYTHONPATH=/opt/elastic-tpu
+ENTRYPOINT ["python3", "-m", "elastic_tpu_agent.cli"]

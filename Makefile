@@ -1,0 +1,25 @@
+# Build/test entry points (reference had image-build only, Makefile:1-11;
+# a test target was notably absent there).
+TAG ?= elastic-tpu-agent:latest
+
+.PHONY: all native test protos image bench clean
+
+all: native test
+
+native:
+	$(MAKE) -C native
+
+test: native
+	python -m pytest tests/ -q
+
+protos:
+	sh elastic_tpu_agent/protos/regen.sh
+
+image:
+	docker build -t $(TAG) .
+
+bench:
+	python3 bench.py
+
+clean:
+	$(MAKE) -C native clean

@@ -1,0 +1,154 @@
+"""ElasticTPU CRD types + typed client.
+
+Capability parity with the reference's vendored ElasticGPU CRD API and
+generated clientset (SURVEY.md §2 #19, vendor/elasticgpu.io/elastic-gpu):
+the agent can read/create cluster-level ElasticTPU inventory objects. As
+in the reference (where all CRD-writing paths were commented out,
+plugins/nvidia.go:28-137), the CRD surface is optional — the core
+allocation path never depends on it — but here it actually works and is
+exercised by tests.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from .kube.client import KubeClient, KubeError
+
+GROUP = "elasticgpu.io"
+VERSION = "v1alpha1"
+PLURAL = "elastictpus"
+
+# Canonical phases (reference types.go:49-57).
+PhasePending = "Pending"
+PhaseAvailable = "Available"
+PhaseBound = "Bound"
+PhaseReleased = "Released"
+PhaseFailed = "Failed"
+
+
+@dataclass
+class ElasticTPU:
+    name: str
+    node_name: str = ""
+    capacity: Dict[str, str] = field(default_factory=dict)
+    chip_indexes: List[int] = field(default_factory=list)
+    accelerator_type: str = ""
+    claim_namespace: str = ""
+    claim_name: str = ""
+    claim_container: str = ""
+    phase: str = PhasePending
+    message: str = ""
+
+    def to_manifest(self) -> dict:
+        return {
+            "apiVersion": f"{GROUP}/{VERSION}",
+            "kind": "ElasticTPU",
+            "metadata": {"name": self.name},
+            "spec": {
+                "nodeName": self.node_name,
+                "capacity": dict(self.capacity),
+                "source": {
+                    "physicalTPU": {"chipIndexes": list(self.chip_indexes)},
+                    "tpuShare": {
+                        "acceleratorType": self.accelerator_type,
+                    },
+                },
+                "claimRef": {
+                    "namespace": self.claim_namespace,
+                    "name": self.claim_name,
+                    "container": self.claim_container,
+                },
+            },
+            "status": {"phase": self.phase, "message": self.message},
+        }
+
+    @classmethod
+    def from_manifest(cls, m: dict) -> "ElasticTPU":
+        spec = m.get("spec", {}) or {}
+        source = spec.get("source", {}) or {}
+        claim = spec.get("claimRef", {}) or {}
+        status = m.get("status", {}) or {}
+        return cls(
+            name=m.get("metadata", {}).get("name", ""),
+            node_name=spec.get("nodeName", ""),
+            capacity=dict(spec.get("capacity", {}) or {}),
+            chip_indexes=list(
+                (source.get("physicalTPU", {}) or {}).get("chipIndexes", [])
+            ),
+            accelerator_type=(
+                (source.get("tpuShare", {}) or {}).get("acceleratorType", "")
+            ),
+            claim_namespace=claim.get("namespace", ""),
+            claim_name=claim.get("name", ""),
+            claim_container=claim.get("container", ""),
+            phase=status.get("phase", PhasePending),
+            message=status.get("message", ""),
+        )
+
+
+class ElasticTPUClient:
+    """Typed CRUD over the CRD endpoint (generated-clientset equivalent)."""
+
+    def __init__(self, kube: KubeClient) -> None:
+        self._kube = kube
+        self._base = f"/apis/{GROUP}/{VERSION}/{PLURAL}"
+
+    def create(self, obj: ElasticTPU, update_existing: bool = True) -> ElasticTPU:
+        """Create; on 409 AlreadyExists, update in place by default (the
+        agent republishes its chip inventory on every boot)."""
+        r = self._kube._session.post(
+            self._kube._base + self._base,
+            json=obj.to_manifest(),
+            verify=self._kube._verify,
+        )
+        if r.status_code == 409 and update_existing:
+            r = self._kube._session.put(
+                self._kube._base + f"{self._base}/{obj.name}",
+                json=obj.to_manifest(),
+                verify=self._kube._verify,
+            )
+        if r.status_code not in (200, 201):
+            raise KubeError(f"create elastictpu {obj.name}: {r.status_code}")
+        return ElasticTPU.from_manifest(r.json())
+
+    def get(self, name: str) -> Optional[ElasticTPU]:
+        r = self._kube._get(f"{self._base}/{name}")
+        if r.status_code == 404:
+            return None
+        if r.status_code != 200:
+            raise KubeError(f"get elastictpu {name}: {r.status_code}")
+        return ElasticTPU.from_manifest(r.json())
+
+    def list(self, node_name: str = "") -> List[ElasticTPU]:
+        r = self._kube._get(self._base)
+        if r.status_code != 200:
+            raise KubeError(f"list elastictpus: {r.status_code}")
+        items = [
+            ElasticTPU.from_manifest(m) for m in r.json().get("items", [])
+        ]
+        if node_name:
+            items = [i for i in items if i.node_name == node_name]
+        return items
+
+    def delete(self, name: str) -> None:
+        r = self._kube._session.delete(
+            self._kube._base + f"{self._base}/{name}",
+            verify=self._kube._verify,
+        )
+        if r.status_code not in (200, 404):
+            raise KubeError(f"delete elastictpu {name}: {r.status_code}")
+
+    def update_status(self, name: str, phase: str, message: str = "") -> None:
+        obj = self.get(name)
+        if obj is None:
+            raise KubeError(f"elastictpu {name} not found")
+        obj.phase, obj.message = phase, message
+        r = self._kube._session.put(
+            self._kube._base + f"{self._base}/{name}",
+            json=obj.to_manifest(),
+            verify=self._kube._verify,
+        )
+        if r.status_code != 200:
+            raise KubeError(f"update elastictpu {name}: {r.status_code}")

@@ -20,6 +20,7 @@ class FakeAPIServer:
         self._lock = threading.Lock()
         self._pods: Dict[Tuple[str, str], dict] = {}
         self._nodes: Dict[str, dict] = {}
+        self._crds: Dict[str, dict] = {}  # ElasticTPU objects by name
         self._rv = 0
         self._events: List[tuple] = []  # (rv, event) log for watch replay
         self._watchers: List[queue.Queue] = []
@@ -117,6 +118,75 @@ class FakeAPIServer:
                     if node_obj is None:
                         return self._json(404, {"kind": "Status", "code": 404})
                     return self._json(200, node_obj)
+                # /apis/elasticgpu.io/v1alpha1/elastictpus[/name]
+                if self._crd_parts(parts) is not None:
+                    name = self._crd_parts(parts)
+                    with outer._lock:
+                        if name == "":
+                            items = list(outer._crds.values())
+                        else:
+                            obj = outer._crds.get(name)
+                    if name == "":
+                        return self._json(200, {"items": items})
+                    if obj is None:
+                        return self._json(404, {"kind": "Status", "code": 404})
+                    return self._json(200, obj)
+                return self._json(404, {"kind": "Status", "code": 404})
+
+            @staticmethod
+            def _crd_parts(parts):
+                """For a CRD path return the resource name ("" for the
+                collection); None when this is not the elastictpus API."""
+                if parts[:4] != [
+                    "apis", "elasticgpu.io", "v1alpha1", "elastictpus",
+                ]:
+                    return None
+                if len(parts) == 4:
+                    return ""
+                if len(parts) == 5:
+                    return parts[4]
+                return None
+
+            def _read_body(self):
+                length = int(self.headers.get("Content-Length", "0"))
+                return json.loads(self.rfile.read(length)) if length else {}
+
+            def do_POST(self):  # noqa: N802
+                parts = [p for p in urlparse(self.path).path.split("/") if p]
+                # Creates go to the collection URL only; a real apiserver
+                # rejects POST-to-named-resource and duplicate creates.
+                if self._crd_parts(parts) == "":
+                    obj = self._read_body()
+                    name = obj.get("metadata", {}).get("name", "")
+                    with outer._lock:
+                        exists = name in outer._crds
+                        if not exists:
+                            outer._crds[name] = obj
+                    if exists:
+                        return self._json(
+                            409, {"kind": "Status", "code": 409,
+                                  "reason": "AlreadyExists"}
+                        )
+                    return self._json(201, obj)
+                return self._json(404, {"kind": "Status", "code": 404})
+
+            def do_PUT(self):  # noqa: N802
+                parts = [p for p in urlparse(self.path).path.split("/") if p]
+                name = self._crd_parts(parts)
+                if name:
+                    obj = self._read_body()
+                    with outer._lock:
+                        outer._crds[name] = obj
+                    return self._json(200, obj)
+                return self._json(404, {"kind": "Status", "code": 404})
+
+            def do_DELETE(self):  # noqa: N802
+                parts = [p for p in urlparse(self.path).path.split("/") if p]
+                name = self._crd_parts(parts)
+                if name:
+                    with outer._lock:
+                        outer._crds.pop(name, None)
+                    return self._json(200, {"kind": "Status", "code": 200})
                 return self._json(404, {"kind": "Status", "code": 404})
 
             def _watch(self, node: str, params: dict) -> None:

@@ -1,0 +1,74 @@
+"""ElasticTPU CRD types + client tests (reference component #19 parity)."""
+
+import pytest
+
+from elastic_tpu_agent.crd import (
+    ElasticTPU,
+    ElasticTPUClient,
+    PhaseAvailable,
+    PhaseBound,
+)
+from elastic_tpu_agent.kube.client import KubeClient
+
+from fake_apiserver import FakeAPIServer
+
+
+@pytest.fixture()
+def client():
+    server = FakeAPIServer()
+    url = server.start()
+    yield ElasticTPUClient(KubeClient(url))
+    server.stop()
+
+
+def test_manifest_roundtrip():
+    obj = ElasticTPU(
+        name="node-a-chip0",
+        node_name="node-a",
+        capacity={"elasticgpu.io/tpu-core": "100",
+                  "elasticgpu.io/tpu-memory": "16384"},
+        chip_indexes=[0],
+        accelerator_type="v5litepod-4",
+        claim_namespace="default",
+        claim_name="train-0",
+        claim_container="jax",
+        phase=PhaseBound,
+    )
+    back = ElasticTPU.from_manifest(obj.to_manifest())
+    assert back == obj
+
+
+def test_crud_lifecycle(client):
+    obj = ElasticTPU(
+        name="node-a-chip1", node_name="node-a", chip_indexes=[1],
+        phase=PhaseAvailable,
+    )
+    client.create(obj)
+    got = client.get("node-a-chip1")
+    assert got is not None
+    assert got.chip_indexes == [1]
+    assert got.phase == PhaseAvailable
+
+    client.update_status("node-a-chip1", PhaseBound, "claimed by train-0")
+    assert client.get("node-a-chip1").phase == PhaseBound
+
+    assert [o.name for o in client.list("node-a")] == ["node-a-chip1"]
+    assert client.list("node-b") == []
+
+    client.delete("node-a-chip1")
+    assert client.get("node-a-chip1") is None
+    client.delete("node-a-chip1")  # idempotent
+
+
+def test_create_or_update_on_conflict(client):
+    from elastic_tpu_agent.kube.client import KubeError
+
+    obj = ElasticTPU(name="dup", node_name="node-a", phase=PhaseAvailable)
+    client.create(obj)
+    # boot-time republish: same name, fresher content
+    obj2 = ElasticTPU(name="dup", node_name="node-a", phase=PhaseBound)
+    client.create(obj2)
+    assert client.get("dup").phase == PhaseBound
+    # strict mode surfaces the conflict
+    with pytest.raises(KubeError):
+        client.create(obj, update_existing=False)
