@@ -1,0 +1,356 @@
+"""Fused causal attention for TPU — Pallas flash-attention kernels.
+
+The reference repo has no model/kernel code at all (SURVEY.md §2: it is a
+k8s node agent); this module is part of the TPU-native *workload* stack
+that makes the agent's graded configs measurable. Design is TPU-first:
+
+- Flash attention (online softmax) as Pallas kernels: the s×s score
+  matrix never touches HBM, so long sequences fit in VMEM-sized tiles
+  and the HBM traffic drops from O(s²) to O(s·h) per head.
+- MXU-shaped tiles: block_q × head_dim and block_k × head_dim blocks
+  with head_dim a multiple of 128 (lane width), block sizes multiples
+  of the bf16 sublane tile.
+- Custom VJP: the backward pass recomputes scores from (q, k, lse) in
+  two more Pallas kernels (dkdv, dq) instead of saving probabilities.
+- `reference_attention` is the plain einsum path (used on CPU, for
+  unaligned shapes, and as the numerical oracle in tests).
+
+All kernels run in interpret mode on CPU for hermetic CI.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+NEG_INF = -1e30  # large-negative instead of -inf: keeps exp/where NaN-free
+
+
+@dataclasses.dataclass(frozen=True)
+class FlashConfig:
+    """Static kernel parameters (hashable: used as a nondiff argnum)."""
+
+    causal: bool = True
+    block_q: int = 256
+    block_k: int = 256
+    sm_scale: Optional[float] = None  # default 1/sqrt(head_dim)
+    interpret: bool = False  # run kernels interpreted (CPU/testing)
+
+
+def supports_flash(seq: int, head_dim: int, cfg: FlashConfig) -> bool:
+    """Shape gate: tiles must divide evenly and fill MXU lanes."""
+    return (
+        seq % cfg.block_q == 0
+        and seq % cfg.block_k == 0
+        and head_dim % 128 == 0
+    )
+
+
+# -- reference (oracle / fallback) path ---------------------------------------
+
+
+def reference_attention(
+    q: jax.Array, k: jax.Array, v: jax.Array, causal: bool = True,
+    sm_scale: Optional[float] = None,
+) -> jax.Array:
+    """Plain materialized-scores attention. [b, s, n, h] → [b, s, n, h]."""
+    scale = sm_scale if sm_scale is not None else 1.0 / np.sqrt(q.shape[-1])
+    logits = jnp.einsum("bsnh,btnh->bnst", q, k) * scale
+    if causal:
+        s, t = logits.shape[-2], logits.shape[-1]
+        mask = jnp.tril(jnp.ones((s, t), dtype=bool))
+        logits = jnp.where(mask[None, None], logits, NEG_INF)
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    return jnp.einsum(
+        "bnst,btnh->bsnh", probs.astype(v.dtype), v
+    )
+
+
+# -- forward kernel -----------------------------------------------------------
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, cfg: FlashConfig,
+                n_kv_blocks: int, scale: float):
+    """One (batch·head, q-block) grid cell: online-softmax over kv blocks."""
+    bq = q_ref.shape[1]
+    bk = cfg.block_k
+    h = q_ref.shape[2]
+    qi = pl.program_id(1)
+    q = q_ref[0]  # [bq, h]
+
+    def body(j, carry):
+        m, l, acc = carry
+        kj = k_ref[0, pl.ds(j * bk, bk), :]  # [bk, h]
+        vj = v_ref[0, pl.ds(j * bk, bk), :]
+        s_ij = jax.lax.dot_general(
+            q, kj, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale  # [bq, bk]
+        if cfg.causal:
+            rows = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+            cols = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+            s_ij = jnp.where(rows >= cols, s_ij, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s_ij, axis=-1, keepdims=True))
+        p = jnp.exp(s_ij - m_new)
+        corr = jnp.exp(m - m_new)
+        l_new = l * corr + jnp.sum(p, axis=-1, keepdims=True)
+        pv = jax.lax.dot_general(
+            p.astype(vj.dtype), vj, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return m_new, l_new, acc * corr + pv
+
+    m0 = jnp.full((bq, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((bq, 1), jnp.float32)
+    acc0 = jnp.zeros((bq, h), jnp.float32)
+    if cfg.causal and cfg.block_q == cfg.block_k:
+        # q block i only ever sees kv blocks 0..i
+        upper = qi + 1
+    else:
+        upper = n_kv_blocks
+    m, l, acc = jax.lax.fori_loop(0, upper, body, (m0, l0, acc0))
+    l = jnp.maximum(l, 1e-30)  # fully-masked rows: avoid 0/0
+    o_ref[0] = (acc / l).astype(o_ref.dtype)
+    lse_ref[0] = m + jnp.log(l)
+
+
+def _flash_fwd(
+    q: jax.Array, k: jax.Array, v: jax.Array, cfg: FlashConfig
+) -> Tuple[jax.Array, jax.Array]:
+    """q,k,v: [bn, s, h] → (o [bn, s, h], lse [bn, s] f32)."""
+    bn, s, h = q.shape
+    nq = s // cfg.block_q
+    nk = s // cfg.block_k
+    scale = (
+        cfg.sm_scale if cfg.sm_scale is not None else 1.0 / np.sqrt(h)
+    )
+    kernel = functools.partial(
+        _fwd_kernel, cfg=cfg, n_kv_blocks=nk, scale=scale
+    )
+    return pl.pallas_call(
+        kernel,
+        grid=(bn, nq),
+        in_specs=[
+            pl.BlockSpec((1, cfg.block_q, h), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, s, h), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, s, h), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, cfg.block_q, h), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, cfg.block_q, 1), lambda b, i: (b, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bn, s, h), q.dtype),
+            jax.ShapeDtypeStruct((bn, s, 1), jnp.float32),
+        ],
+        interpret=cfg.interpret,
+    )(q, k, v)
+
+
+# -- backward kernels ---------------------------------------------------------
+
+
+def _dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                 dk_ref, dv_ref, *, cfg: FlashConfig, n_q_blocks: int,
+                 scale: float):
+    """One (batch·head, kv-block) cell: accumulate dK,dV over q blocks."""
+    bk = k_ref.shape[1]
+    bq = cfg.block_q
+    h = k_ref.shape[2]
+    kj = pl.program_id(1)
+    kblk = k_ref[0]  # [bk, h]
+    vblk = v_ref[0]
+
+    def body(i, carry):
+        dk, dv = carry
+        qi = q_ref[0, pl.ds(i * bq, bq), :]  # [bq, h]
+        doi = do_ref[0, pl.ds(i * bq, bq), :]
+        lsei = lse_ref[0, pl.ds(i * bq, bq), 0]  # [bq]
+        deltai = delta_ref[0, pl.ds(i * bq, bq), 0]
+        s_ij = jax.lax.dot_general(
+            qi, kblk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale  # [bq, bk]
+        if cfg.causal:
+            rows = i * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+            cols = kj * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+            s_ij = jnp.where(rows >= cols, s_ij, NEG_INF)
+        p = jnp.exp(s_ij - lsei[:, None])  # [bq, bk]
+        dv_new = dv + jax.lax.dot_general(
+            p.astype(doi.dtype), doi, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [bk, h]
+        dp = jax.lax.dot_general(
+            doi, vblk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [bq, bk]
+        ds = p * (dp - deltai[:, None]) * scale
+        dk_new = dk + jax.lax.dot_general(
+            ds.astype(qi.dtype), qi, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [bk, h]
+        return dk_new, dv_new
+
+    if cfg.causal and cfg.block_q == cfg.block_k:
+        lower = kj  # q blocks before the diagonal are fully masked
+    else:
+        lower = 0
+    dk0 = jnp.zeros((bk, h), jnp.float32)
+    dv0 = jnp.zeros((bk, h), jnp.float32)
+    dk, dv = jax.lax.fori_loop(lower, n_q_blocks, body, (dk0, dv0))
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
+               cfg: FlashConfig, n_kv_blocks: int, scale: float):
+    """One (batch·head, q-block) cell: accumulate dQ over kv blocks."""
+    bq = q_ref.shape[1]
+    bk = cfg.block_k
+    h = q_ref.shape[2]
+    qi_idx = pl.program_id(1)
+    q = q_ref[0]
+    do = do_ref[0]
+    lse = lse_ref[0, :, 0]  # [bq]
+    delta = delta_ref[0, :, 0]
+
+    def body(j, dq):
+        kj = k_ref[0, pl.ds(j * bk, bk), :]
+        vj = v_ref[0, pl.ds(j * bk, bk), :]
+        s_ij = jax.lax.dot_general(
+            q, kj, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
+        if cfg.causal:
+            rows = qi_idx * bq + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, bk), 0
+            )
+            cols = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+            s_ij = jnp.where(rows >= cols, s_ij, NEG_INF)
+        p = jnp.exp(s_ij - lse[:, None])
+        dp = jax.lax.dot_general(
+            do, vj, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta[:, None]) * scale
+        return dq + jax.lax.dot_general(
+            ds.astype(kj.dtype), kj, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    if cfg.causal and cfg.block_q == cfg.block_k:
+        upper = qi_idx + 1
+    else:
+        upper = n_kv_blocks
+    dq0 = jnp.zeros((bq, h), jnp.float32)
+    dq = jax.lax.fori_loop(0, upper, body, dq0)
+    dq_ref[0] = dq.astype(dq_ref.dtype)
+
+
+def _flash_bwd(q, k, v, o, lse, do, cfg: FlashConfig):
+    bn, s, h = q.shape
+    nq = s // cfg.block_q
+    nk = s // cfg.block_k
+    scale = (
+        cfg.sm_scale if cfg.sm_scale is not None else 1.0 / np.sqrt(h)
+    )
+    # delta_i = rowsum(dO ⊙ O): cheap elementwise — let XLA fuse it.
+    delta = jnp.sum(
+        do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1,
+        keepdims=True,
+    )  # [bn, s, 1]
+
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _dkdv_kernel, cfg=cfg, n_q_blocks=nq, scale=scale
+        ),
+        grid=(bn, nk),
+        in_specs=[
+            pl.BlockSpec((1, s, h), lambda b, j: (b, 0, 0)),  # q
+            pl.BlockSpec((1, cfg.block_k, h), lambda b, j: (b, j, 0)),  # k
+            pl.BlockSpec((1, cfg.block_k, h), lambda b, j: (b, j, 0)),  # v
+            pl.BlockSpec((1, s, h), lambda b, j: (b, 0, 0)),  # do
+            pl.BlockSpec((1, s, 1), lambda b, j: (b, 0, 0)),  # lse
+            pl.BlockSpec((1, s, 1), lambda b, j: (b, 0, 0)),  # delta
+        ],
+        out_specs=[
+            pl.BlockSpec((1, cfg.block_k, h), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((1, cfg.block_k, h), lambda b, j: (b, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bn, s, h), k.dtype),
+            jax.ShapeDtypeStruct((bn, s, h), v.dtype),
+        ],
+        interpret=cfg.interpret,
+    )(q, k, v, do, lse, delta)
+
+    dq = pl.pallas_call(
+        functools.partial(
+            _dq_kernel, cfg=cfg, n_kv_blocks=nk, scale=scale
+        ),
+        grid=(bn, nq),
+        in_specs=[
+            pl.BlockSpec((1, cfg.block_q, h), lambda b, i: (b, i, 0)),  # q
+            pl.BlockSpec((1, s, h), lambda b, i: (b, 0, 0)),  # k
+            pl.BlockSpec((1, s, h), lambda b, i: (b, 0, 0)),  # v
+            pl.BlockSpec((1, cfg.block_q, h), lambda b, i: (b, i, 0)),  # do
+            pl.BlockSpec((1, cfg.block_q, 1), lambda b, i: (b, i, 0)),  # lse
+            pl.BlockSpec((1, cfg.block_q, 1), lambda b, i: (b, i, 0)),  # delta
+        ],
+        out_specs=pl.BlockSpec(
+            (1, cfg.block_q, h), lambda b, i: (b, i, 0)
+        ),
+        out_shape=jax.ShapeDtypeStruct((bn, s, h), q.dtype),
+        interpret=cfg.interpret,
+    )(q, k, v, do, lse, delta)
+    return dq, dk, dv
+
+
+# -- public op ----------------------------------------------------------------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def _flash_attention_bnsh(q, k, v, cfg: FlashConfig):
+    o, _ = _flash_fwd(q, k, v, cfg)
+    return o
+
+
+def _flash_attention_fwd_rule(q, k, v, cfg: FlashConfig):
+    o, lse = _flash_fwd(q, k, v, cfg)
+    return o, (q, k, v, o, lse)
+
+
+def _flash_attention_bwd_rule(cfg: FlashConfig, res, do):
+    q, k, v, o, lse = res
+    return _flash_bwd(q, k, v, o, lse, do, cfg)
+
+
+_flash_attention_bnsh.defvjp(
+    _flash_attention_fwd_rule, _flash_attention_bwd_rule
+)
+
+
+def flash_attention(
+    q: jax.Array, k: jax.Array, v: jax.Array,
+    cfg: FlashConfig = FlashConfig(),
+) -> jax.Array:
+    """Causal flash attention. [b, s, n, h] → [b, s, n, h].
+
+    Falls back to `reference_attention` when the shape gate fails (tile
+    misalignment) so callers never need their own dispatch.
+    """
+    b, s, n, h = q.shape
+    if not supports_flash(s, h, cfg):
+        return reference_attention(
+            q, k, v, causal=cfg.causal, sm_scale=cfg.sm_scale
+        )
+    def to_bnsh(x):
+        return x.transpose(0, 2, 1, 3).reshape(b * n, s, h)
+    o = _flash_attention_bnsh(to_bnsh(q), to_bnsh(k), to_bnsh(v), cfg)
+    return o.reshape(b, n, s, h).transpose(0, 2, 1, 3)
