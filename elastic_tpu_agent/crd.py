@@ -97,21 +97,33 @@ class ElasticTPUClient:
 
     def create(self, obj: ElasticTPU, update_existing: bool = True) -> ElasticTPU:
         """Create; on 409 AlreadyExists, update in place by default (the
-        agent republishes its chip inventory on every boot)."""
-        r = self._kube._session.post(
-            self._kube._base + self._base,
-            json=obj.to_manifest(),
-            verify=self._kube._verify,
-        )
+        agent republishes its chip inventory on every boot).
+
+        The CRD declares the status subresource (deploy/elastic-tpu-crd.yaml),
+        so a real apiserver strips ``status`` from main-endpoint writes; the
+        requested phase is applied with a second PUT to ``/status``."""
+        r = self._kube._post(self._base, obj.to_manifest())
         if r.status_code == 409 and update_existing:
-            r = self._kube._session.put(
-                self._kube._base + f"{self._base}/{obj.name}",
-                json=obj.to_manifest(),
-                verify=self._kube._verify,
+            r = self._kube._put(
+                f"{self._base}/{obj.name}", obj.to_manifest()
             )
         if r.status_code not in (200, 201):
             raise KubeError(f"create elastictpu {obj.name}: {r.status_code}")
-        return ElasticTPU.from_manifest(r.json())
+        self._put_status(ElasticTPU.from_manifest(r.json()),
+                         obj.phase, obj.message)
+        created = ElasticTPU.from_manifest(r.json())
+        created.phase, created.message = obj.phase, obj.message
+        return created
+
+    def _put_status(self, obj: ElasticTPU, phase: str, message: str) -> None:
+        obj.phase, obj.message = phase, message
+        r = self._kube._put(
+            f"{self._base}/{obj.name}/status", obj.to_manifest()
+        )
+        if r.status_code != 200:
+            raise KubeError(
+                f"update elastictpu {obj.name} status: {r.status_code}"
+            )
 
     def get(self, name: str) -> Optional[ElasticTPU]:
         r = self._kube._get(f"{self._base}/{name}")
@@ -133,10 +145,7 @@ class ElasticTPUClient:
         return items
 
     def delete(self, name: str) -> None:
-        r = self._kube._session.delete(
-            self._kube._base + f"{self._base}/{name}",
-            verify=self._kube._verify,
-        )
+        r = self._kube._delete(f"{self._base}/{name}")
         if r.status_code not in (200, 404):
             raise KubeError(f"delete elastictpu {name}: {r.status_code}")
 
@@ -144,11 +153,4 @@ class ElasticTPUClient:
         obj = self.get(name)
         if obj is None:
             raise KubeError(f"elastictpu {name} not found")
-        obj.phase, obj.message = phase, message
-        r = self._kube._session.put(
-            self._kube._base + f"{self._base}/{name}",
-            json=obj.to_manifest(),
-            verify=self._kube._verify,
-        )
-        if r.status_code != 200:
-            raise KubeError(f"update elastictpu {name}: {r.status_code}")
+        self._put_status(obj, phase, message)

@@ -1,0 +1,190 @@
+"""Async ElasticTPU lifecycle recorder: bind/release → CRD objects.
+
+The reference *intended* this: its plugins carried a full CRD-writing path
+(creating ElasticGPU objects per allocation) that was entirely commented
+out (reference pkg/plugins/nvidia.go:28-137, manager.go:59-88), and its
+RBAC still grants elasticgpu.io CRUD (deploy/elastic-gpu-agent.yaml). Here
+the path is real: every bound allocation is published as an `ElasticTPU`
+object (phase Bound, claimRef → pod/container, physical chip indexes),
+released allocations are marked Released and removed, and restore()
+reconciles cluster objects against the checkpoint store.
+
+Design constraints (why this is a worker thread, not inline calls):
+
+- The bind path is the latency SLO (BASELINE.md: Allocate/PreStart p50);
+  an apiserver round-trip there would add ~ms and couple the SLO to
+  apiserver health. All writes are enqueued and applied asynchronously.
+- CRD publication is *observability*, never load-bearing: failures are
+  logged and dropped; after ``_MAX_CONSECUTIVE_FAILURES`` (e.g. the CRD is
+  not installed, or RBAC denies us) the recorder disables itself so it
+  cannot spam the apiserver forever.
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+import time
+from typing import Dict, Iterable, List, Optional
+
+from .crd import ElasticTPU, ElasticTPUClient, PhaseBound, PhaseReleased
+
+logger = logging.getLogger(__name__)
+
+_MAX_CONSECUTIVE_FAILURES = 5
+_STOP = object()
+
+
+class CRDRecorder:
+    """Publishes allocation lifecycle to the ElasticTPU CRD, off the hot
+    path. All public methods are non-blocking and never raise."""
+
+    def __init__(
+        self,
+        client: ElasticTPUClient,
+        node_name: str,
+        accelerator_type: str = "",
+    ) -> None:
+        self._client = client
+        self._node = node_name
+        self._accelerator_type = accelerator_type
+        self._queue: "queue.Queue" = queue.Queue()
+        self._failures = 0
+        self._disabled = False
+        self._pending = 0
+        self._cond = threading.Condition()
+        self._thread = threading.Thread(
+            target=self._worker, daemon=True, name="crd-recorder"
+        )
+        self._thread.start()
+
+    # -- public API (called from plugin bind / GC / manager restore) ----------
+
+    def object_name(self, alloc_hash: str) -> str:
+        # DNS-1123: node names are already DNS labels, hash is lowercase hex.
+        return f"{self._node}-{alloc_hash}"
+
+    def record_bound(
+        self,
+        alloc_hash: str,
+        resource: str,
+        amount: int,
+        namespace: str,
+        pod: str,
+        container: str,
+        chip_indexes: List[int],
+    ) -> None:
+        obj = ElasticTPU(
+            name=self.object_name(alloc_hash),
+            node_name=self._node,
+            capacity={resource: str(amount)},
+            chip_indexes=list(chip_indexes),
+            accelerator_type=self._accelerator_type,
+            claim_namespace=namespace,
+            claim_name=pod,
+            claim_container=container,
+            phase=PhaseBound,
+            message=f"bound by elastic-tpu-agent on {self._node}",
+        )
+        self._submit(lambda: self._client.create(obj, update_existing=True))
+
+    def record_released(self, alloc_hash: str) -> None:
+        name = self.object_name(alloc_hash)
+
+        def release() -> None:
+            try:
+                self._client.update_status(
+                    name, PhaseReleased, "reclaimed by elastic-tpu-agent"
+                )
+            except Exception:  # noqa: BLE001 - already gone is fine
+                pass
+            self._client.delete(name)
+
+        self._submit(release)
+
+    def reconcile(self, live_hashes: Iterable[str]) -> None:
+        """Restore-time sweep: delete objects this node published for
+        allocations that no longer exist in the checkpoint store."""
+        live = {self.object_name(h) for h in live_hashes}
+
+        def sweep() -> None:
+            for obj in self._client.list(self._node):
+                if obj.name not in live:
+                    logger.info("crd reconcile: removing stale %s", obj.name)
+                    self._client.delete(obj.name)
+
+        self._submit(sweep)
+
+    # -- lifecycle ------------------------------------------------------------
+
+    def flush(self, timeout: float = 10.0) -> bool:
+        """Block until queued work has drained (tests / shutdown)."""
+        deadline = time.monotonic() + timeout
+        with self._cond:
+            while self._pending > 0:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+                self._cond.wait(timeout=remaining)
+        return True
+
+    def stop(self, timeout: float = 5.0) -> None:
+        self.flush(timeout=timeout)
+        self._queue.put(_STOP)
+        self._thread.join(timeout=timeout)
+
+    @property
+    def disabled(self) -> bool:
+        return self._disabled
+
+    # -- worker ---------------------------------------------------------------
+
+    def _submit(self, op) -> None:
+        if self._disabled:
+            return
+        with self._cond:
+            self._pending += 1
+        self._queue.put(op)
+
+    def _worker(self) -> None:
+        while True:
+            op = self._queue.get()
+            if op is _STOP:
+                return
+            try:
+                if not self._disabled:
+                    op()
+                    self._failures = 0
+            except Exception as e:  # noqa: BLE001 - observability must not wedge
+                self._failures += 1
+                if self._failures >= _MAX_CONSECUTIVE_FAILURES:
+                    self._disabled = True
+                    logger.warning(
+                        "CRD recorder disabled after %d consecutive failures "
+                        "(last: %s) — is the ElasticTPU CRD installed and "
+                        "RBAC granted?", self._failures, e,
+                    )
+                else:
+                    logger.warning("CRD write failed (%s); continuing", e)
+            finally:
+                with self._cond:
+                    self._pending -= 1
+                    if self._pending <= 0:
+                        self._cond.notify_all()
+
+
+def build_recorder(
+    kube_client, node_name: str, operator
+) -> Optional[CRDRecorder]:
+    """Manager-side constructor: a recorder bound to this node's client and
+    accelerator type; None when there is no kube client (hermetic runs)."""
+    if kube_client is None or not node_name:
+        return None
+    acc = ""
+    topo = getattr(operator, "topology", None)
+    if topo is not None:
+        acc = getattr(topo, "accelerator_type", "") or ""
+    return CRDRecorder(
+        ElasticTPUClient(kube_client), node_name, accelerator_type=acc
+    )

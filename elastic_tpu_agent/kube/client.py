@@ -27,6 +27,12 @@ class KubeError(Exception):
 
 
 class KubeClient:
+    # Default (connect, read) timeout for every request: a black-holed
+    # apiserver connection must surface as an exception, not a forever-hung
+    # thread (the CRD recorder's self-disable depends on failures raising).
+    # watch_pods passes its own window-sized timeout.
+    DEFAULT_TIMEOUT = (5.0, 30.0)
+
     def __init__(
         self,
         base_url: str,
@@ -112,8 +118,27 @@ class KubeClient:
     # -- request plumbing -----------------------------------------------------
 
     def _get(self, path: str, params: Optional[Dict] = None, **kw):
+        kw.setdefault("timeout", self.DEFAULT_TIMEOUT)
         return self._session.get(
             self._base + path, params=params, verify=self._verify, **kw
+        )
+
+    def _post(self, path: str, body: dict, **kw):
+        kw.setdefault("timeout", self.DEFAULT_TIMEOUT)
+        return self._session.post(
+            self._base + path, json=body, verify=self._verify, **kw
+        )
+
+    def _put(self, path: str, body: dict, **kw):
+        kw.setdefault("timeout", self.DEFAULT_TIMEOUT)
+        return self._session.put(
+            self._base + path, json=body, verify=self._verify, **kw
+        )
+
+    def _delete(self, path: str, **kw):
+        kw.setdefault("timeout", self.DEFAULT_TIMEOUT)
+        return self._session.delete(
+            self._base + path, verify=self._verify, **kw
         )
 
     # -- API surface ----------------------------------------------------------

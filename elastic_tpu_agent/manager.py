@@ -46,6 +46,10 @@ class ManagerOptions:
     pod_resources_socket: str = rpc.POD_RESOURCES_SOCKET
     alloc_spec_dir: str = DEFAULT_ALLOC_SPEC_DIR
     metrics_port: int = 0  # 0 = disabled
+    # Publish bound allocations as ElasticTPU CRD objects (the path the
+    # reference commented out; crd_recorder.py). Failures never affect
+    # binding; auto-disables if the CRD is absent.
+    enable_crd: bool = True
     # test seams
     kube_client: Optional[KubeClient] = None
     operator: object = None
@@ -83,6 +87,13 @@ class TPUManager:
                 self.metrics.chips.set(len(self.operator.devices()))
             except Exception:  # noqa: BLE001 - discovery failure: gauge stays 0
                 logger.exception("chip discovery for metrics failed")
+        self.crd_recorder = None
+        if opts.enable_crd:
+            from .crd_recorder import build_recorder
+
+            self.crd_recorder = build_recorder(
+                self.client, opts.node_name, self.operator
+            )
         pr_client = rpc.PodResourcesClient(opts.pod_resources_socket)
         self.config = PluginConfig(
             node_name=opts.node_name,
@@ -93,6 +104,7 @@ class TPUManager:
             storage=self.storage,
             locator_factory=lambda res: KubeletDeviceLocator(res, pr_client),
             metrics=self.metrics,
+            crd_recorder=self.crd_recorder,
             extra={"alloc_spec_dir": opts.alloc_spec_dir, **opts.extra},
         )
         from .plugins.base import plugin_factory
@@ -128,6 +140,8 @@ class TPUManager:
                             self.operator.delete(link_id)
                         except Exception:  # noqa: BLE001
                             logger.warning("restore: delete %s failed", link_id)
+                    if hasattr(self.plugin, "core"):
+                        self.plugin.core.remove_alloc_spec(record.device.hash)
                 self.storage.delete(info.namespace, info.name)
                 report["reclaimed_pods"] += 1
                 continue
@@ -144,6 +158,15 @@ class TPUManager:
                             logger.exception(
                                 "restore: re-create %s failed", link_id
                             )
+        if self.crd_recorder is not None:
+            # Sweep stale ElasticTPU objects this node published for
+            # allocations that no longer exist after the reconcile above.
+            live = [
+                record.device.hash
+                for _, info in self.storage.items()
+                for record in info.records()
+            ]
+            self.crd_recorder.reconcile(live)
         logger.info("restore report: %s", report)
         if self.metrics is not None:
             self.metrics.restored_links.inc(report["restored_links"])
@@ -162,14 +185,22 @@ class TPUManager:
             logger.warning("sitter not synced after 60s; continuing anyway")
         self.restore()
         self.plugin.run(self._stop)
-        gc_thread = self.plugin.start_gc(self.gc_queue, self._stop)
+        self._gc_thread = self.plugin.start_gc(self.gc_queue, self._stop)
         if block:
-            gc_thread.join()
+            self._gc_thread.join()
 
     def stop(self) -> None:
         self._stop.set()
         self.gc_queue.put(None)  # wake GC so it can observe stop
+        # Join GC before stopping the recorder: an in-flight gc_once() may
+        # still enqueue record_released, which would be silently dropped if
+        # the recorder worker had already consumed its stop sentinel.
+        gc_thread = getattr(self, "_gc_thread", None)
+        if gc_thread is not None:
+            gc_thread.join(timeout=10.0)
         if hasattr(self.plugin, "core"):
             self.plugin.core.stop_streams()
             self.plugin.memory.stop_streams()
+        if self.crd_recorder is not None:
+            self.crd_recorder.stop()
         self.storage.close()

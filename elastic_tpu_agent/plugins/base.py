@@ -41,6 +41,9 @@ class PluginConfig:
     storage: object = None
     locator_factory: Optional[Callable[[str], object]] = None
     metrics: object = None
+    # Optional ElasticTPU CRD publisher (crd_recorder.CRDRecorder); the
+    # plugin treats it as fire-and-forget observability.
+    crd_recorder: object = None
     extra: dict = field(default_factory=dict)
 
 

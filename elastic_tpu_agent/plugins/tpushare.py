@@ -146,6 +146,7 @@ class _TPUSharePluginBase(_ListAndWatchMixin, rpc.DevicePluginServicer):
         self._storage = config.storage
         self._locator: DeviceLocator = config.locator_factory(self.resource)
         self._metrics = config.metrics
+        self._crd = config.crd_recorder
         self._chips = {c.index: c for c in self._operator.devices()}
         self._alloc_dir = config.extra.get(
             "alloc_spec_dir", DEFAULT_ALLOC_SPEC_DIR
@@ -323,6 +324,11 @@ class _TPUSharePluginBase(_ListAndWatchMixin, rpc.DevicePluginServicer):
         if self._metrics is not None:
             self._metrics.bound_allocations.set(
                 sum(1 for _ in self._storage.items())
+            )
+        if self._crd is not None:
+            self._crd.record_bound(
+                device.hash, self.resource, len(device.ids),
+                owner.namespace, owner.name, owner.container, chip_indexes,
             )
         logger.info(
             "bound %s %s -> %s chips %s",
@@ -537,6 +543,10 @@ class TPUSharePlugin:
                     except Exception:  # noqa: BLE001
                         logger.warning("GC: failed deleting node %s", link_id)
                 self.core.remove_alloc_spec(record.device.hash)
+                if self._config.crd_recorder is not None:
+                    self._config.crd_recorder.record_released(
+                        record.device.hash
+                    )
             storage.delete(info.namespace, info.name)
             reclaimed += 1
             logger.info("GC: reclaimed %s", key)

@@ -147,6 +147,18 @@ class FakeAPIServer:
                     return parts[4]
                 return None
 
+            @staticmethod
+            def _crd_status_name(parts):
+                """Name for /apis/.../elastictpus/<name>/status, else None."""
+                if (
+                    len(parts) == 6
+                    and parts[:4]
+                    == ["apis", "elasticgpu.io", "v1alpha1", "elastictpus"]
+                    and parts[5] == "status"
+                ):
+                    return parts[4]
+                return None
+
             def _read_body(self):
                 length = int(self.headers.get("Content-Length", "0"))
                 return json.loads(self.rfile.read(length)) if length else {}
@@ -157,6 +169,10 @@ class FakeAPIServer:
                 # rejects POST-to-named-resource and duplicate creates.
                 if self._crd_parts(parts) == "":
                     obj = self._read_body()
+                    # Status subresource semantics (the CRD declares
+                    # `subresources: status: {}`): a real apiserver DROPS
+                    # status on main-endpoint creates.
+                    obj["status"] = {}
                     name = obj.get("metadata", {}).get("name", "")
                     with outer._lock:
                         exists = name in outer._crds
@@ -172,10 +188,30 @@ class FakeAPIServer:
 
             def do_PUT(self):  # noqa: N802
                 parts = [p for p in urlparse(self.path).path.split("/") if p]
+                status_name = self._crd_status_name(parts)
+                if status_name:
+                    # PUT /status: only the status field is applied.
+                    obj = self._read_body()
+                    with outer._lock:
+                        existing = outer._crds.get(status_name)
+                        if existing is None:
+                            return self._json(
+                                404, {"kind": "Status", "code": 404}
+                            )
+                        existing["status"] = obj.get("status", {})
+                        updated = existing
+                    return self._json(200, updated)
                 name = self._crd_parts(parts)
                 if name:
                     obj = self._read_body()
                     with outer._lock:
+                        # Main-endpoint update: status is PRESERVED from the
+                        # stored object, never taken from the request (real
+                        # apiserver behavior with the status subresource).
+                        prior = outer._crds.get(name)
+                        obj["status"] = (
+                            prior.get("status", {}) if prior else {}
+                        )
                         outer._crds[name] = obj
                     return self._json(200, obj)
                 return self._json(404, {"kind": "Status", "code": 404})

@@ -1,0 +1,165 @@
+"""ElasticTPU CRD lifecycle publication (crd_recorder.py).
+
+The reference carried this path entirely commented out
+(pkg/plugins/nvidia.go:28-137); here it is live: bind -> Bound object with
+claimRef, GC -> Released+removed, restore -> stale-object sweep, and the
+recorder is provably off the hot path (a broken apiserver never fails a
+bind, and the recorder self-disables after repeated failures).
+"""
+
+import time
+
+import pytest
+
+from elastic_tpu_agent.common import (
+    AnnotationAssumed,
+    ResourceTPUCore,
+    container_annotation,
+)
+from elastic_tpu_agent.crd import ElasticTPU, ElasticTPUClient, PhaseBound
+from elastic_tpu_agent.crd_recorder import (
+    _MAX_CONSECUTIVE_FAILURES,
+    CRDRecorder,
+)
+from elastic_tpu_agent.plugins.tpushare import CORE_ENDPOINT, core_device_id
+from elastic_tpu_agent.types import Device
+
+from test_e2e import Cluster, wait_until
+
+from fake_apiserver import make_pod
+
+
+@pytest.fixture()
+def cluster(tmp_path):
+    c = Cluster(tmp_path)
+    c.start()
+    yield c
+    c.stop()
+
+
+def _crd_client(cluster) -> ElasticTPUClient:
+    return ElasticTPUClient(cluster.opts.kube_client)
+
+
+def _bind_pod(cluster, pod_name: str, chip: int, n_units: int = 100) -> str:
+    cluster.apiserver.upsert_pod(
+        make_pod(
+            "default", pod_name, cluster.node,
+            annotations={
+                AnnotationAssumed: "true",
+                container_annotation("jax"): str(chip),
+            },
+            containers=[{"name": "jax"}],
+        )
+    )
+    assert wait_until(
+        lambda: cluster.manager.sitter.get_pod("default", pod_name) is not None
+    )
+    ids = [core_device_id(chip, i) for i in range(n_units)]
+    cluster.kubelet.kubelet_allocate_flow(
+        CORE_ENDPOINT, "default", pod_name, "jax", ResourceTPUCore, ids
+    )
+    return Device(ids, ResourceTPUCore).hash
+
+
+def test_bind_publishes_bound_object(cluster):
+    dev_hash = _bind_pod(cluster, "train-0", chip=1)
+    recorder = cluster.manager.crd_recorder
+    assert recorder is not None and recorder.flush()
+    obj = _crd_client(cluster).get(recorder.object_name(dev_hash))
+    assert obj is not None
+    assert obj.phase == PhaseBound
+    assert obj.node_name == cluster.node
+    assert obj.chip_indexes == [1]
+    assert (obj.claim_namespace, obj.claim_name, obj.claim_container) == (
+        "default", "train-0", "jax",
+    )
+    assert obj.capacity == {ResourceTPUCore: "100"}
+    assert obj.accelerator_type == "v5litepod-4"
+
+
+def test_gc_releases_object(cluster):
+    dev_hash = _bind_pod(cluster, "done-0", chip=2)
+    recorder = cluster.manager.crd_recorder
+    assert recorder.flush()
+    name = recorder.object_name(dev_hash)
+    assert _crd_client(cluster).get(name) is not None
+
+    cluster.apiserver.delete_pod("default", "done-0")
+    cluster.kubelet.unassign_pod("default", "done-0")
+    assert wait_until(
+        lambda: cluster.manager.storage.load("default", "done-0") is None,
+        timeout=15.0,
+    )
+    assert recorder.flush()
+    assert _crd_client(cluster).get(name) is None
+
+
+def test_restore_sweeps_stale_objects(cluster):
+    """An object left behind by a previous agent generation (e.g. crash
+    between link delete and CRD delete) is removed by restore()."""
+    client = _crd_client(cluster)
+    stale = ElasticTPU(
+        name=f"{cluster.node}-deadbeef", node_name=cluster.node,
+        chip_indexes=[0], phase=PhaseBound,
+    )
+    other_node = ElasticTPU(
+        name="node-b-cafef00d", node_name="node-b",
+        chip_indexes=[0], phase=PhaseBound,
+    )
+    client.create(stale)
+    client.create(other_node)
+    live_hash = _bind_pod(cluster, "live-0", chip=3)
+    recorder = cluster.manager.crd_recorder
+    assert recorder.flush()
+
+    cluster.manager.restore()
+    assert recorder.flush()
+    assert client.get(f"{cluster.node}-deadbeef") is None, "stale not swept"
+    assert client.get(recorder.object_name(live_hash)) is not None
+    # never touches other nodes' objects
+    assert client.get("node-b-cafef00d") is not None
+
+
+class _ExplodingClient:
+    """ElasticTPUClient stand-in whose every call fails (apiserver down /
+    CRD not installed)."""
+
+    def __init__(self):
+        self.calls = 0
+
+    def _boom(self, *a, **k):
+        self.calls += 1
+        raise RuntimeError("apiserver unavailable")
+
+    create = update_status = delete = list = _boom
+
+
+def test_recorder_self_disables_and_never_raises():
+    client = _ExplodingClient()
+    rec = CRDRecorder(client, "node-a")
+    for i in range(_MAX_CONSECUTIVE_FAILURES + 3):
+        rec.record_bound(f"hash{i}", ResourceTPUCore, 100,
+                         "default", "p", "c", [0])
+    assert rec.flush(timeout=5.0)
+    rec.stop()
+    assert rec.disabled
+    # ops after disablement were dropped, not attempted
+    assert client.calls == _MAX_CONSECUTIVE_FAILURES
+
+
+def test_bind_survives_broken_recorder(cluster):
+    """A wedged CRD path must never fail PreStartContainer."""
+    broken = CRDRecorder(_ExplodingClient(), cluster.node)
+    cluster.manager.plugin.core._crd = broken
+    dev_hash = _bind_pod(cluster, "tolerant-0", chip=0)
+    assert cluster.manager.storage.load("default", "tolerant-0") is not None
+    assert dev_hash  # bind completed end-to-end
+    broken.stop()
+
+
+def test_released_for_missing_object_is_noop(cluster):
+    recorder = cluster.manager.crd_recorder
+    recorder.record_released("feedface")  # nothing published under this hash
+    assert recorder.flush()
+    assert not recorder.disabled
