@@ -19,6 +19,7 @@ from .kube.client import KubeClient, KubeError
 GROUP = "elasticgpu.io"
 VERSION = "v1alpha1"
 PLURAL = "elastictpus"
+NodeLabel = "elasticgpu.io/node"
 
 # Canonical phases (reference types.go:49-57).
 PhasePending = "Pending"
@@ -40,12 +41,22 @@ class ElasticTPU:
     claim_container: str = ""
     phase: str = PhasePending
     message: str = ""
+    # Server-assigned; must round-trip into updates (a real apiserver
+    # rejects RV-less PUTs on custom resources).
+    resource_version: str = ""
 
     def to_manifest(self) -> dict:
+        metadata: dict = {"name": self.name}
+        if self.resource_version:
+            metadata["resourceVersion"] = self.resource_version
+        if self.node_name:
+            # Node-scoped label so agents can list with a labelSelector
+            # instead of downloading the cluster-wide collection.
+            metadata["labels"] = {NodeLabel: self.node_name}
         return {
             "apiVersion": f"{GROUP}/{VERSION}",
             "kind": "ElasticTPU",
-            "metadata": {"name": self.name},
+            "metadata": metadata,
             "spec": {
                 "nodeName": self.node_name,
                 "capacity": dict(self.capacity),
@@ -85,6 +96,7 @@ class ElasticTPU:
             claim_container=claim.get("container", ""),
             phase=status.get("phase", PhasePending),
             message=status.get("message", ""),
+            resource_version=m.get("metadata", {}).get("resourceVersion", ""),
         )
 
 
@@ -104,18 +116,22 @@ class ElasticTPUClient:
         requested phase is applied with a second PUT to ``/status``."""
         r = self._kube._post(self._base, obj.to_manifest())
         if r.status_code == 409 and update_existing:
+            existing = self.get(obj.name)
+            if existing is not None:
+                # Updates must carry the server's current resourceVersion.
+                obj.resource_version = existing.resource_version
             r = self._kube._put(
                 f"{self._base}/{obj.name}", obj.to_manifest()
             )
         if r.status_code not in (200, 201):
             raise KubeError(f"create elastictpu {obj.name}: {r.status_code}")
-        self._put_status(ElasticTPU.from_manifest(r.json()),
-                         obj.phase, obj.message)
         created = ElasticTPU.from_manifest(r.json())
-        created.phase, created.message = obj.phase, obj.message
+        self._put_status(created, obj.phase, obj.message)
         return created
 
     def _put_status(self, obj: ElasticTPU, phase: str, message: str) -> None:
+        """PUT to /status using obj's resourceVersion; obj is refreshed with
+        the server's new state on success."""
         obj.phase, obj.message = phase, message
         r = self._kube._put(
             f"{self._base}/{obj.name}/status", obj.to_manifest()
@@ -124,6 +140,9 @@ class ElasticTPUClient:
             raise KubeError(
                 f"update elastictpu {obj.name} status: {r.status_code}"
             )
+        obj.resource_version = (
+            r.json().get("metadata", {}).get("resourceVersion", "")
+        )
 
     def get(self, name: str) -> Optional[ElasticTPU]:
         r = self._kube._get(f"{self._base}/{name}")
@@ -134,13 +153,17 @@ class ElasticTPUClient:
         return ElasticTPU.from_manifest(r.json())
 
     def list(self, node_name: str = "") -> List[ElasticTPU]:
-        r = self._kube._get(self._base)
+        params = (
+            {"labelSelector": f"{NodeLabel}={node_name}"} if node_name else None
+        )
+        r = self._kube._get(self._base, params=params)
         if r.status_code != 200:
             raise KubeError(f"list elastictpus: {r.status_code}")
         items = [
             ElasticTPU.from_manifest(m) for m in r.json().get("items", [])
         ]
         if node_name:
+            # Belt-and-braces for objects created before the label existed.
             items = [i for i in items if i.node_name == node_name]
         return items
 

@@ -15,6 +15,7 @@ declared ``GC(); Restore()`` on its interface and implemented neither
 from __future__ import annotations
 
 import logging
+import os
 import queue
 import threading
 from dataclasses import dataclass, field
@@ -118,7 +119,7 @@ class TPUManager:
         """Reconcile checkpoint state with reality at boot; returns a small
         report (also exported via metrics)."""
         report = {"restored_links": 0, "reclaimed_pods": 0, "kept_pods": 0,
-                  "corrupt_records": 0}
+                  "corrupt_records": 0, "orphan_links": 0, "orphan_specs": 0}
         report["corrupt_records"] = len(self.storage.corrupt_keys())
         for _, info in list(self.storage.items()):
             pod = self.sitter.get_pod(info.namespace, info.name)
@@ -158,6 +159,7 @@ class TPUManager:
                             logger.exception(
                                 "restore: re-create %s failed", link_id
                             )
+        self._sweep_orphans(report)
         if self.crd_recorder is not None:
             # Sweep stale ElasticTPU objects this node published for
             # allocations that no longer exist after the reconcile above.
@@ -174,6 +176,55 @@ class TPUManager:
                 sum(1 for _ in self.storage.items())
             )
         return report
+
+    def _sweep_orphans(self, report: dict) -> None:
+        """Reclaim virtual nodes and alloc specs with no checkpoint record.
+
+        A bind creates nodes, writes the alloc spec, THEN checkpoints
+        (tpushare._bind); an agent crash inside that window leaves artifacts
+        no storage-driven path (GC, the restore loop above) will ever see.
+        Links created for live pods are recorded before kubelet starts the
+        container, so at boot time anything unrecorded is garbage."""
+        if self.storage.corrupt_keys():
+            # A corrupt checkpoint row may describe a LIVE allocation whose
+            # links/specs we can no longer enumerate; sweeping now would
+            # destroy state out from under a running container. Stay
+            # non-destructive (pre-sweep behavior) until the row is gone.
+            logger.warning(
+                "restore: skipping orphan sweep — %d corrupt checkpoint "
+                "record(s) present", len(self.storage.corrupt_keys()),
+            )
+            return
+        known_links = set()
+        known_hashes = set()
+        for _, info in self.storage.items():
+            for record in info.records():
+                known_links.update(record.created_node_ids)
+                known_hashes.add(record.device.hash)
+        if hasattr(self.operator, "list_links"):
+            for link_id in self.operator.list_links():
+                if link_id in known_links:
+                    continue
+                try:
+                    self.operator.delete(link_id)
+                    report["orphan_links"] += 1
+                except Exception:  # noqa: BLE001
+                    logger.warning("restore: orphan delete %s failed", link_id)
+        spec_dir = self._opts.alloc_spec_dir
+        try:
+            spec_files = os.listdir(spec_dir)
+        except FileNotFoundError:
+            return
+        for fname in spec_files:
+            if not fname.endswith(".json"):
+                continue
+            if fname[: -len(".json")] in known_hashes:
+                continue
+            try:
+                os.unlink(os.path.join(spec_dir, fname))
+                report["orphan_specs"] += 1
+            except OSError:
+                logger.warning("restore: orphan spec unlink %s failed", fname)
 
     # -- Run ------------------------------------------------------------------
 

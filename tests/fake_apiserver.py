@@ -127,6 +127,15 @@ class FakeAPIServer:
                         else:
                             obj = outer._crds.get(name)
                     if name == "":
+                        selector = params.get("labelSelector", "")
+                        if selector and "=" in selector:
+                            k, _, v = selector.partition("=")
+                            items = [
+                                m for m in items
+                                if m.get("metadata", {})
+                                .get("labels", {})
+                                .get(k) == v
+                            ]
                         return self._json(200, {"items": items})
                     if obj is None:
                         return self._json(404, {"kind": "Status", "code": 404})
@@ -177,6 +186,10 @@ class FakeAPIServer:
                     with outer._lock:
                         exists = name in outer._crds
                         if not exists:
+                            outer._rv += 1
+                            obj.setdefault("metadata", {})[
+                                "resourceVersion"
+                            ] = str(outer._rv)
                             outer._crds[name] = obj
                     if exists:
                         return self._json(
@@ -186,33 +199,76 @@ class FakeAPIServer:
                     return self._json(201, obj)
                 return self._json(404, {"kind": "Status", "code": 404})
 
+            @staticmethod
+            def _rv_error(body, existing):
+                """Custom resources never allow unconditional updates: a
+                missing resourceVersion is 422 Invalid, a stale one is 409
+                Conflict (apiextensions strategy semantics). Returns a
+                (code, body) error response, or None when the update may
+                proceed."""
+                rv = body.get("metadata", {}).get("resourceVersion", "")
+                if not rv:
+                    return (
+                        422, {"kind": "Status", "code": 422,
+                              "reason": "Invalid",
+                              "message": "metadata.resourceVersion: "
+                                         "must be specified for an update"},
+                    )
+                if rv != existing.get("metadata", {}).get(
+                    "resourceVersion", ""
+                ):
+                    return (
+                        409, {"kind": "Status", "code": 409,
+                              "reason": "Conflict"},
+                    )
+                return None
+
             def do_PUT(self):  # noqa: N802
                 parts = [p for p in urlparse(self.path).path.split("/") if p]
                 status_name = self._crd_status_name(parts)
                 if status_name:
                     # PUT /status: only the status field is applied.
                     obj = self._read_body()
+                    err = updated = None
                     with outer._lock:
                         existing = outer._crds.get(status_name)
                         if existing is None:
-                            return self._json(
-                                404, {"kind": "Status", "code": 404}
+                            err = (404, {"kind": "Status", "code": 404})
+                        else:
+                            err = self._rv_error(obj, existing)
+                        if err is None:
+                            outer._rv += 1
+                            existing["status"] = obj.get("status", {})
+                            existing["metadata"]["resourceVersion"] = str(
+                                outer._rv
                             )
-                        existing["status"] = obj.get("status", {})
-                        updated = existing
+                            updated = existing
+                    if err is not None:
+                        return self._json(*err)
                     return self._json(200, updated)
                 name = self._crd_parts(parts)
                 if name:
                     obj = self._read_body()
+                    err = None
                     with outer._lock:
-                        # Main-endpoint update: status is PRESERVED from the
-                        # stored object, never taken from the request (real
-                        # apiserver behavior with the status subresource).
                         prior = outer._crds.get(name)
-                        obj["status"] = (
-                            prior.get("status", {}) if prior else {}
-                        )
-                        outer._crds[name] = obj
+                        if prior is None:
+                            err = (404, {"kind": "Status", "code": 404})
+                        else:
+                            err = self._rv_error(obj, prior)
+                        if err is None:
+                            # Main-endpoint update: status is PRESERVED from
+                            # the stored object, never taken from the request
+                            # (real apiserver behavior with the status
+                            # subresource).
+                            obj["status"] = prior.get("status", {})
+                            outer._rv += 1
+                            obj.setdefault("metadata", {})[
+                                "resourceVersion"
+                            ] = str(outer._rv)
+                            outer._crds[name] = obj
+                    if err is not None:
+                        return self._json(*err)
                     return self._json(200, obj)
                 return self._json(404, {"kind": "Status", "code": 404})
 

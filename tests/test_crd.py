@@ -72,3 +72,36 @@ def test_create_or_update_on_conflict(client):
     # strict mode surfaces the conflict
     with pytest.raises(KubeError):
         client.create(obj, update_existing=False)
+
+
+def test_rv_less_update_rejected(client):
+    """The fake apiserver mirrors real apiextensions semantics: updates
+    (main or /status) without metadata.resourceVersion fail 422, stale ones
+    409 — so RV-handling bugs in the client/recorder fail loudly in CI."""
+    from elastic_tpu_agent.crd import PhaseReleased
+
+    obj = ElasticTPU(name="rv-check", node_name="node-a", phase=PhaseBound)
+    created = client.create(obj)
+    assert created.resource_version, "server did not assign resourceVersion"
+    assert client.get("rv-check").phase == PhaseBound  # /status path worked
+
+    r = client._kube._put(
+        "/apis/elasticgpu.io/v1alpha1/elastictpus/rv-check",
+        {"metadata": {"name": "rv-check"}, "spec": {}},
+    )
+    assert r.status_code == 422, "RV-less main PUT must be rejected"
+    r = client._kube._put(
+        "/apis/elasticgpu.io/v1alpha1/elastictpus/rv-check/status",
+        {"metadata": {"name": "rv-check", "resourceVersion": "999999"},
+         "status": {"phase": PhaseReleased}},
+    )
+    assert r.status_code == 409, "stale-RV status PUT must conflict"
+    assert client.get("rv-check").phase == PhaseBound
+
+
+def test_list_uses_node_label_selector(client):
+    """list(node) goes through a labelSelector (O(own objects) on real
+    clusters) and still returns exactly this node's objects."""
+    client.create(ElasticTPU(name="sel-a", node_name="node-a"))
+    client.create(ElasticTPU(name="sel-b", node_name="node-b"))
+    assert [o.name for o in client.list("node-a")] == ["sel-a"]

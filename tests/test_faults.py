@@ -1,0 +1,222 @@
+"""Fault injection + concurrency stress for the bind/GC/restore paths.
+
+The reference had no fault-injection tests at all (SURVEY.md §5.2-5.3);
+these cover the crash windows its design left open: partial multi-chip
+bind failure (rollback, gpushare.go:133-142 analogue), agent death inside
+the create-nodes→write-spec→checkpoint window (orphan sweep), operator
+failures during GC, and concurrent kubelet traffic racing the GC loop.
+"""
+
+import json
+import os
+import threading
+
+import grpc
+import pytest
+
+from elastic_tpu_agent.common import (
+    AnnotationAssumed,
+    ResourceTPUCore,
+    container_annotation,
+)
+from elastic_tpu_agent.manager import TPUManager
+from elastic_tpu_agent.plugins.tpushare import CORE_ENDPOINT, core_device_id
+from elastic_tpu_agent.types import Device
+
+from test_e2e import Cluster, wait_until
+
+from fake_apiserver import make_pod
+
+
+@pytest.fixture()
+def cluster(tmp_path):
+    c = Cluster(tmp_path)
+    c.start()
+    yield c
+    c.stop()
+
+
+def _annotate(cluster, pod_name: str, chips: str):
+    cluster.apiserver.upsert_pod(
+        make_pod(
+            "default", pod_name, cluster.node,
+            annotations={
+                AnnotationAssumed: "true",
+                container_annotation("jax"): chips,
+            },
+            containers=[{"name": "jax"}],
+        )
+    )
+    assert wait_until(
+        lambda: cluster.manager.sitter.get_pod("default", pod_name) is not None
+    )
+
+
+def test_bind_rolls_back_on_midway_create_failure(cluster):
+    """Second of two chip nodes fails to materialize: the first is deleted,
+    nothing is checkpointed, no alloc spec survives, and the kubelet sees
+    the PreStart error."""
+    _annotate(cluster, "twochip", "0,1")
+    operator = cluster.manager.operator
+    real_create = operator.create
+    calls = {"n": 0}
+
+    def failing_create(index, link_id):
+        calls["n"] += 1
+        if calls["n"] == 2:
+            raise OSError("injected: /dev unwritable")
+        real_create(index, link_id)
+
+    operator.create = failing_create
+    try:
+        ids = [core_device_id(c, u) for c in (0, 1) for u in range(100)]
+        with pytest.raises(grpc.RpcError):
+            cluster.kubelet.kubelet_allocate_flow(
+                CORE_ENDPOINT, "default", "twochip", "jax",
+                ResourceTPUCore, ids,
+            )
+    finally:
+        operator.create = real_create
+    assert operator.list_links() == [], "rollback left nodes behind"
+    assert cluster.manager.storage.load("default", "twochip") is None
+    dev_hash = Device(ids, ResourceTPUCore).hash
+    assert not os.path.exists(
+        os.path.join(str(cluster.tmp / "alloc"), f"{dev_hash}.json")
+    )
+
+
+def test_restore_sweeps_orphan_links_and_specs(tmp_path):
+    """Artifacts from a bind that died before its checkpoint write (nodes
+    created, spec written, no storage record) are reclaimed at boot;
+    recorded allocations of live pods are untouched."""
+    c = Cluster(tmp_path)
+    c.start()
+    _annotate(c, "live", "2")
+    ids = [core_device_id(2, i) for i in range(100)]
+    c.kubelet.kubelet_allocate_flow(
+        CORE_ENDPOINT, "default", "live", "jax", ResourceTPUCore, ids
+    )
+    live_hash = Device(ids, ResourceTPUCore).hash
+    live_link = os.path.join(c.opts.dev_root, f"elastic-tpu-{live_hash}-0")
+    assert os.path.islink(live_link)
+
+    # Simulated crash window: node + spec exist, checkpoint write never
+    # happened (the exact order in tpushare._bind).
+    c.manager.operator.create(0, "0badc0de-0")
+    orphan_spec = os.path.join(str(tmp_path / "alloc"), "0badc0de.json")
+    with open(orphan_spec, "w") as f:
+        json.dump({"hash": "0badc0de", "chip_indexes": [0]}, f)
+    c.manager.stop()
+
+    mgr2 = TPUManager(c.opts)
+    report = None
+    try:
+        mgr2.run(block=False)
+        assert not os.path.lexists(
+            os.path.join(c.opts.dev_root, "elastic-tpu-0badc0de-0")
+        ), "orphan node not swept"
+        assert not os.path.exists(orphan_spec), "orphan spec not swept"
+        assert os.path.islink(live_link), "live allocation was swept"
+        assert os.path.exists(
+            os.path.join(str(tmp_path / "alloc"), f"{live_hash}.json")
+        )
+        # Report counters from a second, now-clean restore pass.
+        report = mgr2.restore()
+    finally:
+        mgr2.stop()
+        c.kubelet.stop()
+        c.apiserver.stop()
+    assert report["orphan_links"] == 0 and report["orphan_specs"] == 0
+
+
+def test_gc_storage_cleanup_survives_operator_failure(cluster):
+    """A node delete that fails during GC must not wedge reclamation: the
+    checkpoint record still goes away (the link is retried-by-sweep at next
+    boot)."""
+    _annotate(cluster, "flaky", "3")
+    ids = [core_device_id(3, i) for i in range(50)]
+    cluster.kubelet.kubelet_allocate_flow(
+        CORE_ENDPOINT, "default", "flaky", "jax", ResourceTPUCore, ids
+    )
+    operator = cluster.manager.operator
+    real_delete = operator.delete
+
+    def failing_delete(link_id):
+        raise OSError("injected: EBUSY")
+
+    operator.delete = failing_delete
+    try:
+        cluster.apiserver.delete_pod("default", "flaky")
+        cluster.kubelet.unassign_pod("default", "flaky")
+        assert wait_until(
+            lambda: cluster.manager.storage.load("default", "flaky") is None,
+            timeout=15.0,
+        ), "GC wedged on operator failure"
+    finally:
+        operator.delete = real_delete
+    # the leaked link is exactly what restore()'s orphan sweep reclaims
+    assert len(operator.list_links()) == 1
+
+
+N_PODS = 12
+N_CHIPS = 4  # stub:v5litepod-4
+UNITS = 25
+
+
+def _pod_ids(i: int):
+    chip = i % N_CHIPS
+    base = (i // N_CHIPS) * UNITS
+    return chip, [core_device_id(chip, base + u) for u in range(UNITS)]
+
+
+def test_concurrent_binds_with_gc_churn(cluster):
+    """Many kubelet bind flows in flight at once while pods die and the GC
+    loop runs: every surviving pod ends bound and resolvable, every dead
+    pod ends fully reclaimed, and no extra nodes exist."""
+    errors = []
+
+    def bind_one(i: int):
+        try:
+            chip, ids = _pod_ids(i)
+            name = f"stress-{i}"
+            _annotate(cluster, name, str(chip))
+            cluster.kubelet.kubelet_allocate_flow(
+                CORE_ENDPOINT, "default", name, "jax", ResourceTPUCore, ids
+            )
+        except Exception as e:  # noqa: BLE001
+            errors.append((i, e))
+
+    threads = [
+        threading.Thread(target=bind_one, args=(i,)) for i in range(N_PODS)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    assert not errors, f"bind failures under concurrency: {errors}"
+
+    # kill the odd pods while GC is live
+    for i in range(1, N_PODS, 2):
+        cluster.apiserver.delete_pod("default", f"stress-{i}")
+        cluster.kubelet.unassign_pod("default", f"stress-{i}")
+    assert wait_until(
+        lambda: all(
+            cluster.manager.storage.load("default", f"stress-{i}") is None
+            for i in range(1, N_PODS, 2)
+        ),
+        timeout=20.0,
+    ), "GC did not reclaim all deleted pods"
+
+    operator = cluster.manager.operator
+    survivors = list(range(0, N_PODS, 2))
+    expected_links = set()
+    for i in survivors:
+        chip, ids = _pod_ids(i)
+        info = cluster.manager.storage.load("default", f"stress-{i}")
+        assert info is not None, f"survivor stress-{i} lost its record"
+        (record,) = list(info.records())
+        assert record.chip_indexes == [chip]
+        for link_id in record.created_node_ids:
+            assert operator.resolve(link_id) == chip
+            expected_links.add(link_id)
+    assert set(operator.list_links()) == expected_links
