@@ -32,6 +32,7 @@ records are keyed per resource.
 
 from __future__ import annotations
 
+import itertools
 import json
 import logging
 import math
@@ -56,6 +57,7 @@ from ..gen import deviceplugin_pb2 as dp
 from ..kube.locator import DeviceLocator, LocateError
 from ..qos import qos_env
 from ..slice_env import slice_env_for_pod
+from ..tpu.topology import chip_grid, ici_distance
 from ..types import AllocationRecord, Device, PodInfo
 from .base import DevicePluginServer, PluginConfig
 
@@ -85,6 +87,55 @@ def chip_of_device_id(device_id: str) -> Optional[int]:
         return int(parts[2])
     except (IndexError, ValueError):
         return None
+
+
+def _pick_chip_set(
+    by_chip: Dict[int, List[str]],
+    need: int,
+    chips_per_host: int,
+    pinned: Optional[set] = None,
+) -> List[int]:
+    """Order of chips to draw fake ids from for a request of ``need`` units.
+
+    Picks the minimal number of chips whose free units cover ``need``, and
+    among minimal sets the one with the smallest total pairwise ICI hop
+    distance over the chosen chips *plus* any ``pinned`` chips the request's
+    must-include ids already sit on (then most free capacity). Hosts cap at
+    8 chips, so exhaustive subset search is exact and cheap (<= C(8,k)).
+    """
+    pinned = pinned or set()
+    free = sorted(by_chip.items(), key=lambda kv: (-len(kv[1]), kv[0]))
+    # minimal chip count k: fullest-first prefix covering `need`
+    total, k = 0, 0
+    for _, ids in free:
+        total += len(ids)
+        k += 1
+        if total >= need:
+            break
+    if total < need:
+        # Not satisfiable from availables; fall back to fullest-first order
+        # (kubelet will fail the admission itself).
+        return [c for c, _ in free]
+    if k == 1 and not pinned:
+        return [c for c, _ in free]
+    grid = chip_grid(
+        max(chips_per_host, max(by_chip) + 1, max(pinned, default=0) + 1)
+    )
+    best: Optional[tuple] = None
+    for combo in itertools.combinations(sorted(by_chip), k):
+        cap = sum(len(by_chip[c]) for c in combo)
+        if cap < need:
+            continue
+        pod_chips = set(combo) | pinned
+        span = sum(
+            ici_distance(grid[a], grid[b])
+            for a, b in itertools.combinations(sorted(pod_chips), 2)
+        )
+        key = (span, -cap, combo)
+        if best is None or key < best:
+            best = key
+    chosen = best[2] if best else tuple(c for c, _ in free[:k])
+    return sorted(chosen, key=lambda c: (-len(by_chip[c]), c))
 
 
 def _parse_chip_annotation(value: str) -> List[int]:
@@ -204,10 +255,15 @@ class _TPUSharePluginBase(_ListAndWatchMixin, rpc.DevicePluginServicer):
     # -- GetPreferredAllocation ----------------------------------------------
 
     def GetPreferredAllocation(self, request, context):  # noqa: N802, ARG002
-        """Pack the allocation onto as few chips as possible. The reference
-        never implemented this (base.go:86-88 returns empty), which lets
-        kubelet scatter fake ids across chips arbitrarily; dense packing
-        keeps fractional allocations chip-aligned."""
+        """Pack the allocation onto as few, ICI-adjacent chips as possible.
+
+        The reference never implemented this (base.go:86-88 returns empty),
+        which lets kubelet scatter fake ids across chips arbitrarily. Dense
+        packing keeps fractional allocations chip-aligned; when a request
+        *must* span chips, the chip set is chosen for minimum ICI hop span
+        (topology.chip_grid) so intra-pod collectives ride the shortest
+        mesh paths — a TPU concern with no GPU analogue in the reference.
+        """
         responses = []
         for creq in request.container_requests:
             need = creq.allocation_size - len(creq.must_include_deviceIDs)
@@ -218,11 +274,16 @@ class _TPUSharePluginBase(_ListAndWatchMixin, rpc.DevicePluginServicer):
                     if did in chosen:
                         continue
                     by_chip.setdefault(chip_of_device_id(did) or 0, []).append(did)
-                # fullest chips first -> densest packing
-                for _, ids in sorted(
-                    by_chip.items(), key=lambda kv: -len(kv[1])
+                pinned = {
+                    c for c in (
+                        chip_of_device_id(did)
+                        for did in creq.must_include_deviceIDs
+                    ) if c is not None
+                }
+                for chip in _pick_chip_set(
+                    by_chip, need, len(self._chips), pinned
                 ):
-                    take = ids[:need]
+                    take = by_chip[chip][:need]
                     chosen.extend(take)
                     need -= len(take)
                     if need <= 0:

@@ -108,6 +108,26 @@ def spec_for_family(family: str) -> Optional[ChipSpec]:
     return _SPECS.get(key) if key else None
 
 
+def chip_grid(chips_per_host: int) -> Dict[int, Tuple[int, int]]:
+    """Host-local chip index -> (x, y) coordinate on the host's ICI grid.
+
+    Mirrors the chip-bounds convention emitted by :func:`host_bounds`
+    (``2,cph/2,1`` for >=4 chips, flat otherwise), with chips numbered
+    row-major — the same order /dev/accelN enumerates them on TPU-VMs.
+    """
+    if chips_per_host >= 4:
+        xs = 2
+    else:
+        xs = max(1, chips_per_host)
+    return {i: (i % xs, i // xs) for i in range(chips_per_host)}
+
+
+def ici_distance(a: Tuple[int, int], b: Tuple[int, int]) -> int:
+    """Hop count between two chips on the host grid (Manhattan: ICI links
+    run along the mesh axes; there is no host-internal wraparound)."""
+    return abs(a[0] - b[0]) + abs(a[1] - b[1])
+
+
 def host_bounds(topo: TopologyInfo) -> Tuple[str, str]:
     """(TPU_CHIPS_PER_HOST_BOUNDS, TPU_HOST_BOUNDS) env values for
     jax.distributed slice formation (BASELINE config 5).

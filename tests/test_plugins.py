@@ -348,3 +348,65 @@ def test_preferred_allocation_packs_densely(harness):
     chosen = resp.container_responses[0].deviceIDs
     assert len(chosen) == 50
     assert all(did.startswith("tpu-core-1-") for did in chosen)
+
+
+def _chips_used(device_ids):
+    return {int(did.split("-")[2]) for did in device_ids}
+
+
+def test_preferred_allocation_prefers_ici_adjacent_chips(harness):
+    """On the 2x2 host grid, chips 0 and 3 are diagonal (2 ICI hops).
+    Fullest-first packing would choose them; the topology-aware picker
+    must spend one unit of density to stay on a 1-hop pair."""
+    client = harness.kubelet.plugin_client(CORE_ENDPOINT)
+    free = {0: 60, 3: 60, 1: 50, 2: 40}
+    available = [
+        core_device_id(chip, i) for chip, n in free.items() for i in range(n)
+    ]
+    resp = client.get_preferred_allocation(available, [], 100)
+    chosen = resp.container_responses[0].deviceIDs
+    assert len(chosen) == 100
+    used = _chips_used(chosen)
+    assert len(used) == 2
+    a, b = sorted(used)
+    # 2x2 row-major grid: adjacent pairs are exactly those that are not
+    # the diagonals {0,3} / {1,2}
+    assert {a, b} not in ({0, 3}, {1, 2}), f"diagonal pair {used} chosen"
+
+
+def test_preferred_allocation_full_host_pair_is_adjacent(harness):
+    client = harness.kubelet.plugin_client(CORE_ENDPOINT)
+    available = [
+        core_device_id(chip, i) for chip in range(4) for i in range(100)
+    ]
+    resp = client.get_preferred_allocation(available, [], 200)
+    used = _chips_used(resp.container_responses[0].deviceIDs)
+    assert used not in ({0, 3}, {1, 2})
+
+
+def test_preferred_allocation_adjacent_to_pinned_chips(harness):
+    """must_include ids pin the pod to chip 3 at (1,1); the extra chip must
+    be one of its 1-hop neighbours (1 or 2), not the diagonal chip 0."""
+    client = harness.kubelet.plugin_client(CORE_ENDPOINT)
+    must = [core_device_id(3, i) for i in range(10)]
+    available = must + [
+        core_device_id(chip, i) for chip in (0, 1, 2) for i in range(100)
+    ]
+    resp = client.get_preferred_allocation(available, must, 50)
+    chosen = resp.container_responses[0].deviceIDs
+    assert len(chosen) == 50
+    used = _chips_used(chosen)
+    assert 3 in used
+    assert not (used - {3}) - {1, 2}, f"non-adjacent extra chips in {used}"
+
+
+def test_preferred_allocation_respects_must_include(harness):
+    client = harness.kubelet.plugin_client(CORE_ENDPOINT)
+    must = [core_device_id(2, i) for i in range(10)]
+    available = must + [
+        core_device_id(chip, i) for chip in (0, 1) for i in range(100)
+    ]
+    resp = client.get_preferred_allocation(available, must, 40)
+    chosen = resp.container_responses[0].deviceIDs
+    assert len(chosen) == 40
+    assert set(must) <= set(chosen)

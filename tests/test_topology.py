@@ -66,3 +66,31 @@ def test_host_bounds_single_host():
     chip_b, host_b = host_bounds(topo)
     assert chip_b == "2,4,1"
     assert host_b == "1,1,1"
+
+
+# -- ICI grid helpers ---------------------------------------------------------
+
+
+def test_chip_grid_2x2():
+    from elastic_tpu_agent.tpu.topology import chip_grid
+
+    assert chip_grid(4) == {0: (0, 0), 1: (1, 0), 2: (0, 1), 3: (1, 1)}
+
+
+def test_chip_grid_2x4_and_flat():
+    from elastic_tpu_agent.tpu.topology import chip_grid
+
+    g = chip_grid(8)
+    assert g[0] == (0, 0) and g[1] == (1, 0) and g[7] == (1, 3)
+    assert chip_grid(2) == {0: (0, 0), 1: (1, 0)}
+    assert chip_grid(1) == {0: (0, 0)}
+
+
+def test_ici_distance_manhattan():
+    from elastic_tpu_agent.tpu.topology import chip_grid, ici_distance
+
+    g = chip_grid(4)
+    assert ici_distance(g[0], g[1]) == 1
+    assert ici_distance(g[0], g[2]) == 1
+    assert ici_distance(g[0], g[3]) == 2
+    assert ici_distance(g[1], g[2]) == 2
