@@ -1,0 +1,96 @@
+"""Shared async fire-and-forget sink for observability writers.
+
+Both apiserver-facing observability paths (ElasticTPU CRD publication,
+k8s Event emission) have the same constraints: they must stay off the
+bind-latency hot path (BASELINE.md SLO), must never raise into callers,
+and must self-disable after consecutive failures so a missing CRD or
+denied RBAC can't spam the apiserver forever. This worker implements
+that contract once.
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+import time
+
+logger = logging.getLogger(__name__)
+
+_STOP = object()
+
+MAX_CONSECUTIVE_FAILURES = 5
+
+
+class AsyncSink:
+    """Single worker thread draining a queue of thunks; self-disables
+    after ``max_failures`` consecutive errors."""
+
+    def __init__(
+        self, name: str, max_failures: int = MAX_CONSECUTIVE_FAILURES
+    ) -> None:
+        self._name = name
+        self._max_failures = max_failures
+        self._queue: "queue.Queue" = queue.Queue()
+        self._failures = 0
+        self._disabled = False
+        self._pending = 0
+        self._cond = threading.Condition()
+        self._thread = threading.Thread(
+            target=self._worker, daemon=True, name=name
+        )
+        self._thread.start()
+
+    @property
+    def disabled(self) -> bool:
+        return self._disabled
+
+    def submit(self, op) -> None:
+        """Enqueue a thunk; non-blocking, never raises."""
+        if self._disabled:
+            return
+        with self._cond:
+            self._pending += 1
+        self._queue.put(op)
+
+    def flush(self, timeout: float = 10.0) -> bool:
+        """Block until queued work has drained (tests / shutdown)."""
+        deadline = time.monotonic() + timeout
+        with self._cond:
+            while self._pending > 0:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+                self._cond.wait(timeout=remaining)
+        return True
+
+    def stop(self, timeout: float = 5.0) -> None:
+        self.flush(timeout=timeout)
+        self._queue.put(_STOP)
+        self._thread.join(timeout=timeout)
+
+    def _worker(self) -> None:
+        while True:
+            op = self._queue.get()
+            if op is _STOP:
+                return
+            try:
+                if not self._disabled:
+                    op()
+                    self._failures = 0
+            except Exception as e:  # noqa: BLE001 - observability must not wedge
+                self._failures += 1
+                if self._failures >= self._max_failures:
+                    self._disabled = True
+                    logger.warning(
+                        "%s disabled after %d consecutive failures (last: %s)",
+                        self._name, self._failures, e,
+                    )
+                else:
+                    logger.warning("%s write failed (%s); continuing",
+                                   self._name, e)
+            finally:
+                with self._cond:
+                    self._pending -= 1
+                    if self._pending <= 0:
+                        self._cond.notify_all()

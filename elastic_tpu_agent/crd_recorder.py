@@ -9,31 +9,27 @@ object (phase Bound, claimRef → pod/container, physical chip indexes),
 released allocations are marked Released and removed, and restore()
 reconciles cluster objects against the checkpoint store.
 
-Design constraints (why this is a worker thread, not inline calls):
+Design constraints (why writes go through async_sink.AsyncSink, not
+inline calls):
 
 - The bind path is the latency SLO (BASELINE.md: Allocate/PreStart p50);
   an apiserver round-trip there would add ~ms and couple the SLO to
   apiserver health. All writes are enqueued and applied asynchronously.
 - CRD publication is *observability*, never load-bearing: failures are
-  logged and dropped; after ``_MAX_CONSECUTIVE_FAILURES`` (e.g. the CRD is
-  not installed, or RBAC denies us) the recorder disables itself so it
-  cannot spam the apiserver forever.
+  logged and dropped; after repeated consecutive failures (e.g. the CRD is
+  not installed, or RBAC denies us) the sink disables itself so it cannot
+  spam the apiserver forever.
 """
 
 from __future__ import annotations
 
 import logging
-import queue
-import threading
-import time
-from typing import Dict, Iterable, List, Optional
+from typing import Iterable, List, Optional
 
+from .async_sink import AsyncSink
 from .crd import ElasticTPU, ElasticTPUClient, PhaseBound, PhaseReleased
 
 logger = logging.getLogger(__name__)
-
-_MAX_CONSECUTIVE_FAILURES = 5
-_STOP = object()
 
 
 class CRDRecorder:
@@ -49,15 +45,7 @@ class CRDRecorder:
         self._client = client
         self._node = node_name
         self._accelerator_type = accelerator_type
-        self._queue: "queue.Queue" = queue.Queue()
-        self._failures = 0
-        self._disabled = False
-        self._pending = 0
-        self._cond = threading.Condition()
-        self._thread = threading.Thread(
-            target=self._worker, daemon=True, name="crd-recorder"
-        )
-        self._thread.start()
+        self._sink = AsyncSink("crd-recorder")
 
     # -- public API (called from plugin bind / GC / manager restore) ----------
 
@@ -119,59 +107,17 @@ class CRDRecorder:
     # -- lifecycle ------------------------------------------------------------
 
     def flush(self, timeout: float = 10.0) -> bool:
-        """Block until queued work has drained (tests / shutdown)."""
-        deadline = time.monotonic() + timeout
-        with self._cond:
-            while self._pending > 0:
-                remaining = deadline - time.monotonic()
-                if remaining <= 0:
-                    return False
-                self._cond.wait(timeout=remaining)
-        return True
+        return self._sink.flush(timeout=timeout)
 
     def stop(self, timeout: float = 5.0) -> None:
-        self.flush(timeout=timeout)
-        self._queue.put(_STOP)
-        self._thread.join(timeout=timeout)
+        self._sink.stop(timeout=timeout)
 
     @property
     def disabled(self) -> bool:
-        return self._disabled
-
-    # -- worker ---------------------------------------------------------------
+        return self._sink.disabled
 
     def _submit(self, op) -> None:
-        if self._disabled:
-            return
-        with self._cond:
-            self._pending += 1
-        self._queue.put(op)
-
-    def _worker(self) -> None:
-        while True:
-            op = self._queue.get()
-            if op is _STOP:
-                return
-            try:
-                if not self._disabled:
-                    op()
-                    self._failures = 0
-            except Exception as e:  # noqa: BLE001 - observability must not wedge
-                self._failures += 1
-                if self._failures >= _MAX_CONSECUTIVE_FAILURES:
-                    self._disabled = True
-                    logger.warning(
-                        "CRD recorder disabled after %d consecutive failures "
-                        "(last: %s) — is the ElasticTPU CRD installed and "
-                        "RBAC granted?", self._failures, e,
-                    )
-                else:
-                    logger.warning("CRD write failed (%s); continuing", e)
-            finally:
-                with self._cond:
-                    self._pending -= 1
-                    if self._pending <= 0:
-                        self._cond.notify_all()
+        self._sink.submit(op)
 
 
 def build_recorder(

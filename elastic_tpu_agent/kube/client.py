@@ -174,6 +174,14 @@ class KubeClient:
         rv = body.get("metadata", {}).get("resourceVersion", "")
         return body.get("items", []), rv
 
+    def create_event(self, namespace: str, event: dict) -> dict:
+        """POST a core/v1 Event (reference RBAC granted this and never
+        used it; see kube/events.py)."""
+        r = self._post(f"/api/v1/namespaces/{namespace}/events", event)
+        if r.status_code not in (200, 201):
+            raise KubeError(f"create event: {r.status_code}")
+        return r.json()
+
     def watch_pods(
         self, node_name: str, resource_version: str, timeout_s: int = 60
     ) -> Iterator[dict]:

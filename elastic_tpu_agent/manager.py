@@ -51,6 +51,9 @@ class ManagerOptions:
     # reference commented out; crd_recorder.py). Failures never affect
     # binding; auto-disables if the CRD is absent.
     enable_crd: bool = True
+    # Emit core/v1 Events on bind/reclaim/restore (kube/events.py) — the
+    # RBAC grant the reference carried but never exercised.
+    enable_events: bool = True
     # test seams
     kube_client: Optional[KubeClient] = None
     operator: object = None
@@ -95,6 +98,11 @@ class TPUManager:
             self.crd_recorder = build_recorder(
                 self.client, opts.node_name, self.operator
             )
+        self.events = None
+        if opts.enable_events:
+            from .kube.events import build_event_recorder
+
+            self.events = build_event_recorder(self.client, opts.node_name)
         pr_client = rpc.PodResourcesClient(opts.pod_resources_socket)
         self.config = PluginConfig(
             node_name=opts.node_name,
@@ -106,6 +114,7 @@ class TPUManager:
             locator_factory=lambda res: KubeletDeviceLocator(res, pr_client),
             metrics=self.metrics,
             crd_recorder=self.crd_recorder,
+            events=self.events,
             extra={"alloc_spec_dir": opts.alloc_spec_dir, **opts.extra},
         )
         from .plugins.base import plugin_factory
@@ -170,6 +179,20 @@ class TPUManager:
             ]
             self.crd_recorder.reconcile(live)
         logger.info("restore report: %s", report)
+        if self.events is not None and (
+            report["restored_links"] or report["reclaimed_pods"]
+            or report["orphan_links"] or report["orphan_specs"]
+        ):
+            from .kube.events import ReasonRestored
+
+            self.events.node_event(
+                ReasonRestored,
+                "agent restart reconcile: "
+                f"{report['restored_links']} link(s) restored, "
+                f"{report['reclaimed_pods']} dead pod(s) reclaimed, "
+                f"{report['orphan_links'] + report['orphan_specs']} "
+                "orphan artifact(s) swept",
+            )
         if self.metrics is not None:
             self.metrics.restored_links.inc(report["restored_links"])
             self.metrics.bound_allocations.set(
@@ -254,4 +277,6 @@ class TPUManager:
             self.plugin.memory.stop_streams()
         if self.crd_recorder is not None:
             self.crd_recorder.stop()
+        if self.events is not None:
+            self.events.stop()
         self.storage.close()

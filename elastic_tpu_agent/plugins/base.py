@@ -44,6 +44,9 @@ class PluginConfig:
     # Optional ElasticTPU CRD publisher (crd_recorder.CRDRecorder); the
     # plugin treats it as fire-and-forget observability.
     crd_recorder: object = None
+    # Optional k8s Event emitter (kube.events.EventRecorder); same
+    # fire-and-forget contract.
+    events: object = None
     extra: dict = field(default_factory=dict)
 
 

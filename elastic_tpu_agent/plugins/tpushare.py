@@ -54,6 +54,7 @@ from ..common import (
     container_annotation,
 )
 from ..gen import deviceplugin_pb2 as dp
+from ..kube.events import ReasonBindFailed, ReasonBound, ReasonReclaimed
 from ..kube.locator import DeviceLocator, LocateError
 from ..qos import qos_env
 from ..slice_env import slice_env_for_pod
@@ -198,6 +199,7 @@ class _TPUSharePluginBase(_ListAndWatchMixin, rpc.DevicePluginServicer):
         self._locator: DeviceLocator = config.locator_factory(self.resource)
         self._metrics = config.metrics
         self._crd = config.crd_recorder
+        self._events = config.events
         self._chips = {c.index: c for c in self._operator.devices()}
         self._alloc_dir = config.extra.get(
             "alloc_spec_dir", DEFAULT_ALLOC_SPEC_DIR
@@ -328,6 +330,18 @@ class _TPUSharePluginBase(_ListAndWatchMixin, rpc.DevicePluginServicer):
             pod = self._lookup_pod(owner)
         if pod is None:
             raise LocateError(f"pod {owner.pod_key} not found anywhere")
+        try:
+            self._bind_located(device, owner, pod)
+        except Exception as e:
+            if self._events is not None:
+                self._events.pod_event(
+                    owner.namespace, owner.name, ReasonBindFailed,
+                    f"{self.resource} {device.hash}: {e}", type_="Warning",
+                    uid=pod.get("metadata", {}).get("uid", ""),
+                )
+            raise
+
+    def _bind_located(self, device: Device, owner, pod: dict) -> None:
         annotations = pod.get("metadata", {}).get("annotations", {}) or {}
         if annotations.get(AnnotationAssumed) != "true":
             raise LocateError(
@@ -390,6 +404,13 @@ class _TPUSharePluginBase(_ListAndWatchMixin, rpc.DevicePluginServicer):
             self._crd.record_bound(
                 device.hash, self.resource, len(device.ids),
                 owner.namespace, owner.name, owner.container, chip_indexes,
+            )
+        if self._events is not None:
+            self._events.pod_event(
+                owner.namespace, owner.name, ReasonBound,
+                f"bound {self.resource} ({len(device.ids)} units) to TPU "
+                f"chip(s) {','.join(str(i) for i in chip_indexes)}",
+                uid=pod.get("metadata", {}).get("uid", ""),
             )
         logger.info(
             "bound %s %s -> %s chips %s",
@@ -610,6 +631,13 @@ class TPUSharePlugin:
                     )
             storage.delete(info.namespace, info.name)
             reclaimed += 1
+            events = self._config.events
+            if events is not None:
+                # The pod no longer exists, so the event lands on this Node.
+                events.node_event(
+                    ReasonReclaimed,
+                    f"reclaimed TPU allocation(s) of deleted pod {key}",
+                )
             logger.info("GC: reclaimed %s", key)
         metrics = self._config.metrics
         if metrics is not None:

@@ -23,6 +23,7 @@ class FakeAPIServer:
         self._crds: Dict[str, dict] = {}  # ElasticTPU objects by name
         self._rv = 0
         self._events: List[tuple] = []  # (rv, event) log for watch replay
+        self.core_events: List[dict] = []  # POSTed core/v1 Event objects
         self._watchers: List[queue.Queue] = []
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
@@ -174,6 +175,20 @@ class FakeAPIServer:
 
             def do_POST(self):  # noqa: N802
                 parts = [p for p in urlparse(self.path).path.split("/") if p]
+                # core/v1 Event create: /api/v1/namespaces/<ns>/events
+                if (
+                    len(parts) == 5
+                    and parts[:3] == ["api", "v1", "namespaces"]
+                    and parts[4] == "events"
+                ):
+                    obj = self._read_body()
+                    with outer._lock:
+                        outer._rv += 1
+                        obj.setdefault("metadata", {})[
+                            "resourceVersion"
+                        ] = str(outer._rv)
+                        outer.core_events.append(obj)
+                    return self._json(201, obj)
                 # Creates go to the collection URL only; a real apiserver
                 # rejects POST-to-named-resource and duplicate creates.
                 if self._crd_parts(parts) == "":

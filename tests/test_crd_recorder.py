@@ -17,8 +17,8 @@ from elastic_tpu_agent.common import (
     container_annotation,
 )
 from elastic_tpu_agent.crd import ElasticTPU, ElasticTPUClient, PhaseBound
+from elastic_tpu_agent.async_sink import MAX_CONSECUTIVE_FAILURES as _MAX_CONSECUTIVE_FAILURES
 from elastic_tpu_agent.crd_recorder import (
-    _MAX_CONSECUTIVE_FAILURES,
     CRDRecorder,
 )
 from elastic_tpu_agent.plugins.tpushare import CORE_ENDPOINT, core_device_id

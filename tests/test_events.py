@@ -1,0 +1,230 @@
+"""k8s Event emission (kube/events.py): the RBAC grant the reference
+carried but never exercised (SURVEY.md §5.5) is live here. Driven through
+the full manager + fake kubelet + fake apiserver, like test_e2e."""
+
+import grpc
+import pytest
+
+from elastic_tpu_agent.common import (
+    AnnotationAssumed,
+    ResourceTPUCore,
+    container_annotation,
+)
+from elastic_tpu_agent.kube.events import EventRecorder
+from elastic_tpu_agent.plugins.tpushare import CORE_ENDPOINT, core_device_id
+
+from fake_apiserver import make_pod
+from test_e2e import Cluster, wait_until
+
+
+@pytest.fixture()
+def cluster(tmp_path):
+    c = Cluster(tmp_path)
+    c.start()
+    yield c
+    c.stop()
+
+
+def _events(cluster, reason):
+    return [e for e in cluster.apiserver.core_events if e["reason"] == reason]
+
+
+def test_bind_emits_pod_event(cluster):
+    cluster.apiserver.upsert_pod(
+        make_pod(
+            "default", "ev-pod", cluster.node,
+            annotations={
+                AnnotationAssumed: "true",
+                container_annotation("jax"): "1",
+            },
+            containers=[{"name": "jax"}],
+        )
+    )
+    assert wait_until(
+        lambda: cluster.manager.sitter.get_pod("default", "ev-pod") is not None
+    )
+    ids = [core_device_id(1, i) for i in range(100)]
+    cluster.kubelet.kubelet_allocate_flow(
+        CORE_ENDPOINT, "default", "ev-pod", "jax", ResourceTPUCore, ids
+    )
+    assert cluster.manager.events.flush()
+    evs = _events(cluster, "TPUBound")
+    assert len(evs) == 1
+    ev = evs[0]
+    assert ev["type"] == "Normal"
+    assert ev["involvedObject"]["kind"] == "Pod"
+    assert ev["involvedObject"]["name"] == "ev-pod"
+    assert ev["metadata"]["namespace"] == "default"
+    assert "chip(s) 1" in ev["message"]
+    assert ev["source"]["component"] == "elastic-tpu-agent"
+
+
+def test_failed_bind_emits_warning(cluster):
+    # Pod exists but was never assumed by the scheduler -> bind must fail
+    # and the failure must surface on the pod.
+    cluster.apiserver.upsert_pod(
+        make_pod(
+            "default", "sad-pod", cluster.node,
+            annotations={}, containers=[{"name": "jax"}],
+        )
+    )
+    assert wait_until(
+        lambda: cluster.manager.sitter.get_pod("default", "sad-pod") is not None
+    )
+    ids = [core_device_id(0, i) for i in range(10)]
+    with pytest.raises(grpc.RpcError):
+        cluster.kubelet.kubelet_allocate_flow(
+            CORE_ENDPOINT, "default", "sad-pod", "jax", ResourceTPUCore, ids
+        )
+    assert cluster.manager.events.flush()
+    evs = _events(cluster, "TPUBindFailed")
+    assert len(evs) == 1
+    assert evs[0]["type"] == "Warning"
+    assert evs[0]["involvedObject"]["name"] == "sad-pod"
+    assert "not assumed" in evs[0]["message"]
+
+
+def test_gc_emits_node_event(cluster):
+    cluster.apiserver.upsert_pod(
+        make_pod(
+            "default", "doomed", cluster.node,
+            annotations={
+                AnnotationAssumed: "true",
+                container_annotation("jax"): "0",
+            },
+            containers=[{"name": "jax"}],
+        )
+    )
+    assert wait_until(
+        lambda: cluster.manager.sitter.get_pod("default", "doomed") is not None
+    )
+    ids = [core_device_id(0, i) for i in range(10)]
+    cluster.kubelet.kubelet_allocate_flow(
+        CORE_ENDPOINT, "default", "doomed", "jax", ResourceTPUCore, ids
+    )
+    cluster.apiserver.delete_pod("default", "doomed")
+    cluster.kubelet.unassign_pod("default", "doomed")
+    assert wait_until(
+        lambda: cluster.manager.storage.load("default", "doomed") is None,
+        timeout=15.0,
+    )
+    assert cluster.manager.events.flush()
+    evs = _events(cluster, "TPUReclaimed")
+    assert len(evs) == 1
+    assert evs[0]["involvedObject"]["kind"] == "Node"
+    assert evs[0]["involvedObject"]["name"] == cluster.node
+    assert "default/doomed" in evs[0]["message"]
+
+
+def test_restore_emits_node_event(tmp_path):
+    c = Cluster(tmp_path)
+    c.start()
+    c.apiserver.upsert_pod(
+        make_pod(
+            "default", "gone", c.node,
+            annotations={
+                AnnotationAssumed: "true",
+                container_annotation("jax"): "0",
+            },
+            containers=[{"name": "jax"}],
+        )
+    )
+    assert wait_until(
+        lambda: c.manager.sitter.get_pod("default", "gone") is not None
+    )
+    ids = [core_device_id(0, i) for i in range(10)]
+    c.kubelet.kubelet_allocate_flow(
+        CORE_ENDPOINT, "default", "gone", "jax", ResourceTPUCore, ids
+    )
+    c.manager.stop()
+    c.apiserver.delete_pod("default", "gone")
+
+    from elastic_tpu_agent.manager import TPUManager
+
+    mgr2 = TPUManager(c.opts)
+    mgr2.run(block=False)
+    assert wait_until(
+        lambda: mgr2.storage.load("default", "gone") is None, timeout=10.0
+    )
+    assert mgr2.events.flush()
+    evs = [
+        e for e in c.apiserver.core_events if e["reason"] == "TPURestored"
+    ]
+    assert len(evs) == 1
+    assert "1 dead pod(s) reclaimed" in evs[0]["message"]
+    mgr2.stop()
+    c.kubelet.stop()
+    c.apiserver.stop()
+
+
+class _CountingClient:
+    def __init__(self):
+        self.events = []
+
+    def create_event(self, namespace, event):
+        self.events.append(event)
+        return event
+
+
+def test_identical_events_aggregate_within_window():
+    """A crash-looping pod retrying PreStart must not churn etcd: identical
+    events inside the aggregation window fold into one object, and the next
+    emission after the window carries the folded count."""
+    import elastic_tpu_agent.kube.events as events_mod
+
+    client = _CountingClient()
+    rec = EventRecorder(client, "node-a")
+    for _ in range(5):
+        rec.pod_event("default", "looper", "TPUBindFailed", "same failure",
+                      type_="Warning")
+    assert rec.flush()
+    assert len(client.events) == 1
+    assert client.events[0]["count"] == 1
+
+    # Force the window to lapse; the next emit reports the folded count.
+    with rec._recent_lock:
+        key, (last, suppressed) = next(iter(rec._recent.items()))
+        assert suppressed == 4
+        rec._recent[key] = (last - events_mod.AGGREGATION_WINDOW_S - 1,
+                            suppressed)
+    rec.pod_event("default", "looper", "TPUBindFailed", "same failure",
+                  type_="Warning")
+    assert rec.flush()
+    assert len(client.events) == 2
+    assert client.events[1]["count"] == 5
+    rec.stop()
+
+
+def test_distinct_events_not_aggregated():
+    client = _CountingClient()
+    rec = EventRecorder(client, "node-a")
+    rec.pod_event("default", "a", "TPUBound", "msg")
+    rec.pod_event("default", "b", "TPUBound", "msg")
+    rec.pod_event("default", "a", "TPUBindFailed", "other", type_="Warning")
+    assert rec.flush()
+    assert len(client.events) == 3
+    rec.stop()
+
+
+def test_event_name_capped_for_long_pod_names():
+    client = _CountingClient()
+    rec = EventRecorder(client, "node-a")
+    rec.pod_event("default", "p" * 253, "TPUBound", "msg")
+    assert rec.flush()
+    assert len(client.events) == 1
+    assert len(client.events[0]["metadata"]["name"]) <= 253
+    rec.stop()
+
+
+def test_recorder_self_disables_without_apiserver():
+    class DeadClient:
+        def create_event(self, namespace, event):
+            raise RuntimeError("apiserver unreachable")
+
+    rec = EventRecorder(DeadClient(), "node-a")
+    for i in range(6):
+        # distinct messages so client-side aggregation doesn't fold them
+        rec.node_event("TPUBound", f"x{i}")
+    assert rec.flush()
+    assert rec.disabled
+    rec.stop()
