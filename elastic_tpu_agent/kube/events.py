@@ -101,6 +101,14 @@ class EventRecorder:
                 self._recent = {
                     k: v for k, v in self._recent.items() if v[0] >= cutoff
                 }
+                if len(self._recent) > _MAX_TRACKED_KEYS:
+                    # Event storm: every key is still inside the window.
+                    # Hard-cap by evicting the oldest emitters — an evicted
+                    # key re-emits early, which only costs one extra Event.
+                    keep = sorted(
+                        self._recent.items(), key=lambda kv: -kv[1][0]
+                    )[:_MAX_TRACKED_KEYS]
+                    self._recent = dict(keep)
             last, suppressed = self._recent.get(key, (0.0, 0))
             if last and now - last < AGGREGATION_WINDOW_S:
                 self._recent[key] = (last, suppressed + 1)

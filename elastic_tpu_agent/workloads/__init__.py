@@ -1,6 +1,8 @@
+from .moe import init_moe_params, moe_mlp, moe_param_shardings
 from .transformer import (
     ModelConfig,
     forward,
+    forward_with_aux,
     init_params,
     make_mesh,
     make_train_step,
@@ -10,8 +12,12 @@ from .transformer import (
 __all__ = [
     "ModelConfig",
     "forward",
+    "forward_with_aux",
+    "init_moe_params",
     "init_params",
     "make_mesh",
     "make_train_step",
+    "moe_mlp",
+    "moe_param_shardings",
     "param_shardings",
 ]

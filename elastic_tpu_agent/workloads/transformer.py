@@ -52,10 +52,22 @@ class ModelConfig:
     # more matmul FLOPs for O(n_layers) fewer saved activations — the
     # standard HBM-for-FLOPs trade that unlocks larger batches.
     remat: bool = False
+    # Mixture-of-Experts: with moe_experts > 0, every ``moe_every``-th
+    # layer replaces its dense MLP with an expert-parallel MoE layer
+    # (workloads/moe.py; experts sharded over the mesh "ep" axis).
+    moe_experts: int = 0
+    moe_every: int = 2
+    moe_capacity_factor: float = 1.25
+    moe_aux_coef: float = 0.01
 
     @property
     def head_dim(self) -> int:
         return self.d_model // self.n_heads
+
+    def is_moe_layer(self, i: int) -> bool:
+        return self.moe_experts > 0 and i % self.moe_every == (
+            self.moe_every - 1
+        )
 
 
 # -- parameters ---------------------------------------------------------------
@@ -78,16 +90,22 @@ def init_params(cfg: ModelConfig, key: jax.Array) -> Dict:
     }
     for i in range(cfg.n_layers):
         k = jax.random.split(keys[3 + i], 6)
-        params["layers"].append(
-            {
-                "ln1_scale": jnp.ones((cfg.d_model,), jnp.float32),
-                "wqkv": dense(k[0], (cfg.d_model, 3, cfg.n_heads, cfg.head_dim)),
-                "wo": dense(k[1], (cfg.n_heads, cfg.head_dim, cfg.d_model)),
-                "ln2_scale": jnp.ones((cfg.d_model,), jnp.float32),
-                "w1": dense(k[2], (cfg.d_model, cfg.d_ff)),
-                "w2": dense(k[3], (cfg.d_ff, cfg.d_model)),
-            }
-        )
+        layer = {
+            "ln1_scale": jnp.ones((cfg.d_model,), jnp.float32),
+            "wqkv": dense(k[0], (cfg.d_model, 3, cfg.n_heads, cfg.head_dim)),
+            "wo": dense(k[1], (cfg.n_heads, cfg.head_dim, cfg.d_model)),
+            "ln2_scale": jnp.ones((cfg.d_model,), jnp.float32),
+        }
+        if cfg.is_moe_layer(i):
+            from .moe import init_moe_params
+
+            layer["moe"] = init_moe_params(
+                k[2], cfg.d_model, cfg.d_ff, cfg.moe_experts
+            )
+        else:
+            layer["w1"] = dense(k[2], (cfg.d_model, cfg.d_ff))
+            layer["w2"] = dense(k[3], (cfg.d_ff, cfg.d_model))
+        params["layers"].append(layer)
     return params
 
 
@@ -117,9 +135,24 @@ def param_shardings(mesh: Mesh) -> Dict:
 
 def _full_param_shardings(mesh: Mesh, cfg: ModelConfig) -> Dict:
     base = param_shardings(mesh)
+    dense_layer = base["layers"][0]
+    layers = []
+    for i in range(cfg.n_layers):
+        if cfg.is_moe_layer(i):
+            from .moe import moe_param_shardings
+
+            layers.append(
+                {
+                    k: v for k, v in dense_layer.items()
+                    if k not in ("w1", "w2")
+                }
+                | {"moe": moe_param_shardings(mesh)}
+            )
+        else:
+            layers.append(dense_layer)
     return {
         **{k: v for k, v in base.items() if k != "layers"},
-        "layers": [base["layers"][0] for _ in range(cfg.n_layers)],
+        "layers": layers,
     }
 
 
@@ -202,13 +235,14 @@ def _mlp(x: jax.Array, layer: Dict, cfg: ModelConfig) -> jax.Array:
     return jnp.einsum("bsf,fd->bsd", h, layer["w2"].astype(cfg.dtype))
 
 
-def forward(
+def forward_with_aux(
     params: Dict, tokens: jax.Array, cfg: ModelConfig,
     activation_sharding: Optional[NamedSharding] = None,
-) -> jax.Array:
-    """Token logits. ``activation_sharding`` (NamedSharding of
-    P("dp","sp",None)) pins the batch/sequence layout so XLA partitions
-    activations — and inserts the ICI collectives — over the mesh."""
+) -> Tuple[jax.Array, jax.Array]:
+    """(token logits, summed MoE aux loss — 0.0 for dense models).
+    ``activation_sharding`` (NamedSharding of P("dp","sp",None)) pins the
+    batch/sequence layout so XLA partitions activations — and inserts the
+    ICI collectives — over the mesh."""
     _, s = tokens.shape
     x = params["embed"].astype(cfg.dtype)[tokens]
     x = x + params["pos_embed"].astype(cfg.dtype)[:s][None]
@@ -219,18 +253,39 @@ def forward(
         x = jax.lax.with_sharding_constraint(x, activation_sharding)
 
     def layer_fn(x, layer):
+        from .moe import moe_mlp
+
         x = x + _attention(
             _rmsnorm(x, layer["ln1_scale"]), layer, cfg, mesh
         )
-        x = x + _mlp(_rmsnorm(x, layer["ln2_scale"]), layer, cfg)
-        return x
+        h = _rmsnorm(x, layer["ln2_scale"])
+        if "moe" in layer:
+            y, aux = moe_mlp(
+                h, layer["moe"], cfg.moe_capacity_factor, mesh
+            )
+        else:
+            y, aux = _mlp(h, layer, cfg), jnp.float32(0.0)
+        return x + y, aux
 
     if cfg.remat:
         layer_fn = jax.checkpoint(layer_fn)
+    aux_total = jnp.float32(0.0)
     for layer in params["layers"]:
-        x = layer_fn(x, layer)
+        x, aux = layer_fn(x, layer)
+        aux_total = aux_total + aux
     x = _rmsnorm(x, params["final_norm_scale"])
-    return jnp.einsum("bsd,dv->bsv", x, params["lm_head"].astype(cfg.dtype))
+    logits = jnp.einsum(
+        "bsd,dv->bsv", x, params["lm_head"].astype(cfg.dtype)
+    )
+    return logits, aux_total
+
+
+def forward(
+    params: Dict, tokens: jax.Array, cfg: ModelConfig,
+    activation_sharding: Optional[NamedSharding] = None,
+) -> jax.Array:
+    """Token logits (aux loss discarded; see forward_with_aux)."""
+    return forward_with_aux(params, tokens, cfg, activation_sharding)[0]
 
 
 def make_mesh(
@@ -238,20 +293,24 @@ def make_mesh(
     dp: Optional[int] = None,
     sp: int = 1,
     tp: Optional[int] = None,
+    ep: int = 1,
 ) -> Mesh:
-    """3-axis mesh over the visible devices. Defaults: tp = min(n, 4)
-    (keeps tensor-parallel collectives on the fastest ICI ring), sp = 1,
-    dp = remainder."""
+    """4-axis mesh over the visible devices: data, sequence, tensor, and
+    expert parallelism. Defaults: tp = min(n, 4) (keeps tensor-parallel
+    collectives on the fastest ICI ring), sp = ep = 1, dp = remainder.
+    Axes a model doesn't use simply stay size 1 — PartitionSpecs refer to
+    axes by name, so dense and MoE models share one mesh shape."""
     devices = jax.devices()
     n = n_devices or len(devices)
     devices = devices[:n]
     if tp is None:
-        tp = 4 if n % 4 == 0 and n >= 4 else (2 if n % 2 == 0 else 1)
+        rest = n // (sp * ep)
+        tp = 4 if rest % 4 == 0 and rest >= 4 else (2 if rest % 2 == 0 else 1)
     if dp is None:
-        dp = n // (tp * sp)
-    assert dp * sp * tp == n, f"mesh {dp}x{sp}x{tp} != {n} devices"
-    arr = np.array(devices).reshape(dp, sp, tp)
-    return Mesh(arr, axis_names=("dp", "sp", "tp"))
+        dp = n // (tp * sp * ep)
+    assert dp * sp * tp * ep == n, f"mesh {dp}x{sp}x{tp}x{ep} != {n} devices"
+    arr = np.array(devices).reshape(dp, sp, tp, ep)
+    return Mesh(arr, axis_names=("dp", "sp", "tp", "ep"))
 
 
 # -- training step ------------------------------------------------------------
@@ -272,12 +331,12 @@ def make_train_step(
     repl = NamedSharding(mesh, P())
 
     def loss_fn(params, tokens):
-        logits = forward(params, tokens[:, :-1], cfg,
-                         activation_sharding=act_shard)
+        logits, aux = forward_with_aux(params, tokens[:, :-1], cfg,
+                                       activation_sharding=act_shard)
         targets = tokens[:, 1:]
         logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
         nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)
-        return jnp.mean(nll)
+        return jnp.mean(nll) + cfg.moe_aux_coef * aux
 
     def step(params, opt_state, tokens):
         loss, grads = jax.value_and_grad(loss_fn)(params, tokens)

@@ -1,0 +1,102 @@
+"""Mixture-of-Experts layer + expert-parallel sharding (workloads/moe.py).
+
+Runs on the 8-device CPU mesh from conftest; checks routing math against
+the dense MLP it degenerates to, static-capacity drop behavior, and the
+full sharded train step with experts on the "ep" axis.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from elastic_tpu_agent.workloads.moe import (
+    expert_capacity,
+    init_moe_params,
+    moe_mlp,
+)
+from elastic_tpu_agent.workloads.transformer import (
+    ModelConfig,
+    init_params,
+    make_mesh,
+    make_train_step,
+)
+
+
+def test_expert_capacity():
+    assert expert_capacity(64, 4, 1.0) == 16
+    assert expert_capacity(64, 4, 1.25) == 20
+    assert expert_capacity(3, 8, 1.0) == 1  # floor of one slot
+
+
+def test_moe_output_shape_and_aux():
+    params = init_moe_params(jax.random.key(0), d_model=32, d_ff=64,
+                             n_experts=4)
+    x = jax.random.normal(jax.random.key(1), (2, 16, 32), jnp.float32)
+    y, aux = moe_mlp(x, params, capacity_factor=2.0)
+    assert y.shape == x.shape
+    assert jnp.all(jnp.isfinite(y))
+    # Switch aux loss is >= 1 at/above perfect balance and positive always.
+    assert float(aux) > 0
+
+
+def test_single_expert_equals_dense_mlp():
+    """E=1 with ample capacity routes every token to the one expert with
+    gate prob 1.0 -> exactly gelu(x @ w1) @ w2."""
+    params = init_moe_params(jax.random.key(0), d_model=16, d_ff=32,
+                             n_experts=1)
+    x = jax.random.normal(jax.random.key(1), (2, 8, 16), jnp.float32)
+    y, _ = moe_mlp(x, params, capacity_factor=1.0)
+    expected = jnp.einsum(
+        "bsf,fd->bsd",
+        jax.nn.gelu(jnp.einsum("bsd,df->bsf", x, params["w1"][0])),
+        params["w2"][0],
+    )
+    np.testing.assert_allclose(np.asarray(y), np.asarray(expected),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_overflow_tokens_are_dropped_not_nan():
+    """capacity_factor far below 1: most tokens lose their slot; their MoE
+    output must be exactly zero (residual passthrough), never NaN."""
+    params = init_moe_params(jax.random.key(0), d_model=16, d_ff=32,
+                             n_experts=2)
+    x = jax.random.normal(jax.random.key(1), (1, 64, 16), jnp.float32)
+    y, _ = moe_mlp(x, params, capacity_factor=0.1)
+    yt = np.asarray(y).reshape(64, 16)
+    assert np.all(np.isfinite(yt))
+    zero_rows = np.sum(~np.any(yt != 0.0, axis=-1))
+    # cap = ceil(64*0.1/2) = 4 slots/expert -> at most 8 tokens kept
+    assert zero_rows >= 64 - 8
+
+
+def test_moe_transformer_params_and_shardings():
+    cfg = ModelConfig(vocab=128, d_model=32, n_heads=2, n_layers=4, d_ff=64,
+                      max_seq=32, moe_experts=4, moe_every=2)
+    params = init_params(cfg, jax.random.key(0))
+    # layers 1 and 3 are MoE, 0 and 2 dense
+    assert "moe" in params["layers"][1] and "moe" in params["layers"][3]
+    assert "w1" in params["layers"][0] and "w1" in params["layers"][2]
+    assert "w1" not in params["layers"][1]
+
+
+def test_moe_sharded_train_step_learns():
+    cfg = ModelConfig(vocab=256, d_model=32, n_heads=2, n_layers=2, d_ff=64,
+                      max_seq=48, moe_experts=4)
+    mesh = make_mesh(8, dp=1, sp=2, tp=2, ep=2)
+    step, init_all, _ = make_train_step(cfg, mesh)
+    params, opt = init_all(jax.random.key(0))
+    # experts land on the ep axis
+    spec = params["layers"][1]["moe"]["w1"].sharding.spec
+    assert spec[0] == "ep"
+    toks = jax.random.randint(jax.random.key(1), (4, 33), 0, cfg.vocab)
+    losses = []
+    for _ in range(4):
+        params, opt, loss = step(params, opt, toks)
+        losses.append(float(loss))
+    assert all(np.isfinite(losses))
+    assert losses[-1] < losses[0], losses
+
+
+def test_make_mesh_default_has_unit_ep():
+    mesh = make_mesh(8, dp=2, sp=2, tp=2)
+    assert dict(mesh.shape) == {"dp": 2, "sp": 2, "tp": 2, "ep": 1}

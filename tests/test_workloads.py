@@ -45,9 +45,9 @@ def test_causality():
 
 def test_mesh_shapes():
     mesh = make_mesh(8)
-    assert mesh.shape == {"dp": 2, "sp": 1, "tp": 4}
+    assert dict(mesh.shape) == {"dp": 2, "sp": 1, "tp": 4, "ep": 1}
     mesh2 = make_mesh(8, dp=2, sp=2, tp=2)
-    assert mesh2.shape == {"dp": 2, "sp": 2, "tp": 2}
+    assert dict(mesh2.shape) == {"dp": 2, "sp": 2, "tp": 2, "ep": 1}
 
 
 def test_train_step_dp_tp_loss_decreases():
