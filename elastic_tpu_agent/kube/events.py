@@ -104,7 +104,9 @@ class EventRecorder:
                 if len(self._recent) > _MAX_TRACKED_KEYS:
                     # Event storm: every key is still inside the window.
                     # Hard-cap by evicting the oldest emitters — an evicted
-                    # key re-emits early, which only costs one extra Event.
+                    # key re-emits early (one extra Event) and its folded
+                    # occurrence count is dropped with it; bounded memory
+                    # beats exact counts during a storm.
                     keep = sorted(
                         self._recent.items(), key=lambda kv: -kv[1][0]
                     )[:_MAX_TRACKED_KEYS]

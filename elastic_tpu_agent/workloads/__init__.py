@@ -1,4 +1,9 @@
 from .moe import init_moe_params, moe_mlp, moe_param_shardings
+from .pipeline import (
+    make_pipeline_mesh,
+    make_pipeline_train_step,
+    pipeline_apply,
+)
 from .transformer import (
     ModelConfig,
     forward,
@@ -16,8 +21,11 @@ __all__ = [
     "init_moe_params",
     "init_params",
     "make_mesh",
+    "make_pipeline_mesh",
+    "make_pipeline_train_step",
     "make_train_step",
     "moe_mlp",
+    "pipeline_apply",
     "moe_param_shardings",
     "param_shardings",
 ]

@@ -1,0 +1,92 @@
+"""Pipeline parallelism (workloads/pipeline.py): GPipe microbatching over
+the "pp" mesh axis via shard_map + ppermute + scan, on the 8-device CPU
+mesh from conftest."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from elastic_tpu_agent.workloads.pipeline import (
+    init_stage_params,
+    make_pipeline_mesh,
+    make_pipeline_train_step,
+    pipeline_apply,
+    stage_block,
+)
+
+
+def _sequential(params, x, pp):
+    ref = x
+    for i in range(pp):
+        stage = jax.tree.map(lambda a, i=i: a[i], params)
+        ref = jax.vmap(lambda mb, s=stage: stage_block(s, mb))(ref)
+    return ref
+
+
+def test_pipeline_matches_sequential():
+    """The pipelined schedule must be numerically identical to applying
+    the pp stages in order."""
+    mesh = make_pipeline_mesh(pp=4, dp=2)
+    params = init_stage_params(jax.random.key(0), 4, 16, 32)
+    x = jax.random.normal(jax.random.key(1), (6, 8, 16))
+    out = pipeline_apply(mesh, stage_block, params, x)
+    ref = _sequential(params, x, 4)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_pipeline_pp8_no_dp():
+    mesh = make_pipeline_mesh(pp=8, dp=1)
+    params = init_stage_params(jax.random.key(0), 8, 8, 16)
+    x = jax.random.normal(jax.random.key(1), (3, 4, 8))
+    out = pipeline_apply(mesh, stage_block, params, x)
+    ref = _sequential(params, x, 8)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_pipeline_single_microbatch():
+    """m=1 degenerates to fill+drain only — still correct."""
+    mesh = make_pipeline_mesh(pp=4, dp=1)
+    params = init_stage_params(jax.random.key(0), 4, 8, 16)
+    x = jax.random.normal(jax.random.key(1), (1, 4, 8))
+    out = pipeline_apply(mesh, stage_block, params, x)
+    ref = _sequential(params, x, 4)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_pipeline_train_step_learns():
+    """Gradients flow backward through the ppermute pipeline."""
+    mesh = make_pipeline_mesh(pp=4, dp=2)
+    step, init_all = make_pipeline_train_step(mesh, 16, 32)
+    params, opt = init_all(jax.random.key(0))
+    # stage weights actually sharded over pp
+    assert params["w1"].sharding.spec[0] == "pp"
+    x = jax.random.normal(jax.random.key(1), (6, 8, 16))
+    y = jax.random.normal(jax.random.key(2), (6, 8, 16)) * 0.1
+    losses = []
+    for _ in range(6):
+        params, opt, loss = step(params, opt, x, y)
+        losses.append(float(loss))
+    assert all(np.isfinite(losses))
+    assert losses[-1] < losses[0], losses
+
+
+def test_pipeline_grads_match_sequential():
+    """Pipelined loss gradient == gradient of the sequential program."""
+    mesh = make_pipeline_mesh(pp=4, dp=1)
+    params = init_stage_params(jax.random.key(0), 4, 8, 16)
+    x = jax.random.normal(jax.random.key(1), (4, 4, 8))
+
+    def pipe_loss(p):
+        return jnp.mean(jnp.square(pipeline_apply(mesh, stage_block, p, x)))
+
+    def seq_loss(p):
+        return jnp.mean(jnp.square(_sequential(p, x, 4)))
+
+    gp = jax.grad(pipe_loss)(params)
+    gs = jax.grad(seq_loss)(params)
+    for k in ("w1", "w2"):
+        np.testing.assert_allclose(np.asarray(gp[k]), np.asarray(gs[k]),
+                                   rtol=1e-4, atol=1e-5)
