@@ -69,7 +69,28 @@ def build_operator(opts: ManagerOptions):
         return TPUVMOperator(opts.dev_root)
     if kind.startswith("stub"):
         acc = kind.partition(":")[2] or "v5litepod-4"
-        return StubOperator(opts.dev_root, acc)
+        # Worker identity for multi-host simulations (kind clusters / CI):
+        # the tpuvm operator reads these from the metadata server; the stub
+        # takes them from the agent's own environment.
+        hostnames = [
+            h for h in os.environ.get(
+                "ELASTIC_TPU_STUB_HOSTNAMES", ""
+            ).split(",") if h
+        ]
+        try:
+            # tolerate malformed values like the tpuvm operator does
+            # (tpuvm.py worker_id falls back to 0)
+            worker_id = int(os.environ.get("ELASTIC_TPU_STUB_WORKER_ID", "0"))
+        except ValueError:
+            worker_id = 0
+        return StubOperator(
+            opts.dev_root, acc,
+            hostname=os.environ.get(
+                "ELASTIC_TPU_STUB_HOSTNAME", "stub-host"
+            ),
+            worker_id=worker_id,
+            worker_hostnames=hostnames,
+        )
     raise ValueError(f"unknown operator kind {kind!r}")
 
 

@@ -23,6 +23,8 @@ class StubOperator(LinkingOperator):
         accelerator_type: str = "v5litepod-4",
         num_chips: Optional[int] = None,
         hostname: str = "stub-host",
+        worker_id: int = 0,
+        worker_hostnames: Optional[List[str]] = None,
     ) -> None:
         super().__init__(dev_root)
         topo = parse_accelerator_type(accelerator_type)
@@ -31,10 +33,20 @@ class StubOperator(LinkingOperator):
         self._topo = topo
         self._num = num_chips if num_chips is not None else topo.chips_per_host
         self._hostname = hostname
+        self._worker_id = worker_id
+        self._worker_hostnames = list(worker_hostnames or [])
 
     @property
     def topology(self) -> TopologyInfo:
         return self._topo
+
+    # Same worker-identity surface as TPUVMOperator (tpuvm.py:121-151),
+    # so multi-host slice behavior is simulatable host-by-host in CI.
+    def worker_id(self) -> int:
+        return self._worker_id
+
+    def worker_hostnames(self) -> List[str]:
+        return list(self._worker_hostnames)
 
     def devices(self) -> List[TPUChip]:
         spec = self._topo.spec

@@ -25,7 +25,7 @@ __all__ = [
     "make_pipeline_train_step",
     "make_train_step",
     "moe_mlp",
-    "pipeline_apply",
     "moe_param_shardings",
     "param_shardings",
+    "pipeline_apply",
 ]

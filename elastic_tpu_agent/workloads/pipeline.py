@@ -26,7 +26,7 @@ executable training step.
 
 from __future__ import annotations
 
-from typing import Callable, Dict, Tuple
+from typing import Callable, Dict
 
 import jax
 import jax.numpy as jnp
@@ -133,10 +133,12 @@ def make_pipeline_train_step(
     """
     pp = mesh.shape["pp"]
     optimizer = optax.adam(learning_rate)
-    p_shard = {
-        "w1": NamedSharding(mesh, P("pp", None, None)),
-        "w2": NamedSharding(mesh, P("pp", None, None)),
-    }
+    params_struct = jax.eval_shape(
+        lambda k: init_stage_params(k, pp, d_model, d_ff), jax.random.key(0)
+    )
+    p_shard = jax.tree.map(
+        lambda _: NamedSharding(mesh, P("pp", None, None)), params_struct
+    )
     data_shard = NamedSharding(mesh, P(None, "dp", None))
     repl = NamedSharding(mesh, P())
 
@@ -152,9 +154,6 @@ def make_pipeline_train_step(
 
     # Optimizer moments are param-shaped ([pp, ...]): shard them on "pp"
     # like the params; scalars (step count) replicate.
-    params_struct = jax.eval_shape(
-        lambda k: init_stage_params(k, pp, d_model, d_ff), jax.random.key(0)
-    )
     opt_struct = jax.eval_shape(optimizer.init, params_struct)
     o_shard = jax.tree.map(
         lambda leaf: (

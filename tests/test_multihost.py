@@ -1,0 +1,210 @@
+"""Multi-host pod-slice simulation (BASELINE config 5).
+
+Four agent instances — one per simulated v5p-32 host — against ONE shared
+fake apiserver, each with its own fake kubelet. A 4-worker pod-slice
+lands one pod per host; every agent must emit a consistent slice env
+(distinct TPU_WORKER_ID, identical hostnames/bounds) derived purely from
+its own host facts + pod annotations, with zero agent-to-agent
+coordination (SURVEY.md §7 "multi-host slices" hard part).
+"""
+
+import json
+import os
+
+import pytest
+
+from elastic_tpu_agent.common import (
+    AnnotationAssumed,
+    ResourceTPUCore,
+    container_annotation,
+)
+from elastic_tpu_agent.kube.client import KubeClient
+from elastic_tpu_agent.manager import ManagerOptions, TPUManager
+from elastic_tpu_agent.plugins.tpushare import CORE_ENDPOINT, core_device_id
+from elastic_tpu_agent.tpu import StubOperator
+from elastic_tpu_agent.types import Device
+
+from fake_apiserver import FakeAPIServer, make_pod
+from fake_kubelet import FakeKubelet
+from test_e2e import wait_until
+
+N_HOSTS = 4
+ACCEL = "v5p-32"  # 16 chips, 4 per host -> 4 hosts
+HOSTNAMES = [f"tpu-host-{i}" for i in range(N_HOSTS)]
+
+
+class Host:
+    """One simulated slice host: agent + kubelet + stub operator."""
+
+    def __init__(self, tmp_path, apiserver_url, worker_id):
+        self.node = f"node-{worker_id}"
+        self.worker_id = worker_id
+        base = tmp_path / self.node
+        base.mkdir()
+        self.kubelet = FakeKubelet(
+            str(base / "dp"), str(base / "pr" / "kubelet.sock")
+        )
+        self.kubelet.start()
+        dev_root = str(base / "dev")
+        os.makedirs(dev_root)
+        self.alloc_dir = str(base / "alloc")
+        operator = StubOperator(
+            dev_root, ACCEL,
+            hostname=HOSTNAMES[worker_id],
+            worker_id=worker_id,
+            worker_hostnames=HOSTNAMES,
+        )
+        self.manager = TPUManager(
+            ManagerOptions(
+                node_name=self.node,
+                db_path=str(base / "meta.db"),
+                operator=operator,
+                dev_root=dev_root,
+                device_plugin_dir=str(base / "dp"),
+                pod_resources_socket=str(base / "pr" / "kubelet.sock"),
+                alloc_spec_dir=self.alloc_dir,
+                kube_client=KubeClient(apiserver_url),
+            )
+        )
+
+    def start(self):
+        self.manager.run(block=False)
+        assert self.kubelet.wait_registrations(2)
+
+    def stop(self):
+        self.manager.stop()
+        self.kubelet.stop()
+
+
+@pytest.fixture()
+def slice_hosts(tmp_path):
+    apiserver = FakeAPIServer()
+    url = apiserver.start()
+    hosts = [Host(tmp_path, url, i) for i in range(N_HOSTS)]
+    for h in hosts:
+        h.start()
+    yield apiserver, hosts
+    for h in hosts:
+        h.stop()
+    apiserver.stop()
+
+
+def test_slice_pods_get_consistent_topology_env(slice_hosts):
+    apiserver, hosts = slice_hosts
+    specs = []
+    for h in hosts:
+        pod_name = f"slice-w{h.worker_id}"
+        apiserver.upsert_pod(
+            make_pod(
+                "ml", pod_name, h.node,
+                annotations={
+                    AnnotationAssumed: "true",
+                    container_annotation("jax"): "0,1,2,3",
+                },
+                containers=[{"name": "jax"}],
+            )
+        )
+        assert wait_until(
+            lambda h=h, p=pod_name:
+                h.manager.sitter.get_pod("ml", p) is not None
+        )
+        # exclusive: all 4 local chips (400 core units)
+        ids = [
+            core_device_id(c, u) for c in range(4) for u in range(100)
+        ]
+        h.kubelet.kubelet_allocate_flow(
+            CORE_ENDPOINT, "ml", pod_name, "jax", ResourceTPUCore, ids
+        )
+        dev_hash = Device(ids, ResourceTPUCore).hash
+        with open(os.path.join(h.alloc_dir, f"{dev_hash}.json")) as f:
+            specs.append(json.load(f))
+
+    envs = [s["env"] for s in specs]
+    # Distinct, correctly-ordered worker ids; no coordination happened.
+    assert [e["TPU_WORKER_ID"] for e in envs] == ["0", "1", "2", "3"]
+    # Identical slice facts on every host.
+    for key in ("TPU_WORKER_HOSTNAMES", "TPU_ACCELERATOR_TYPE",
+                "TPU_CHIPS_PER_HOST_BOUNDS", "TPU_HOST_BOUNDS"):
+        assert len({e[key] for e in envs}) == 1, key
+    assert envs[0]["TPU_WORKER_HOSTNAMES"] == ",".join(HOSTNAMES)
+    assert envs[0]["TPU_ACCELERATOR_TYPE"] == ACCEL
+    # v5p-32: 4 chips/host in a 2x2x1 grid, 4 hosts tiled 2x2x1.
+    assert envs[0]["TPU_CHIPS_PER_HOST_BOUNDS"] == "2,2,1"
+    assert envs[0]["TPU_HOST_BOUNDS"] == "2,2,1"
+    # Each pod sees its 4 local chips densely renumbered.
+    for s in specs:
+        assert s["chip_indexes"] == [0, 1, 2, 3]
+        assert s["env"]["TPU_VISIBLE_CHIPS"] == "0,1,2,3"
+
+
+def test_annotation_override_renumbers_slice(slice_hosts):
+    """A pod-slice re-sliced by the scheduler (annotations carry its own
+    worker numbering) overrides host metadata: host 3 can be worker 0 of a
+    2-host sub-slice."""
+    from elastic_tpu_agent.common import (
+        AnnotationSliceName,
+        AnnotationSliceWorkerHosts,
+        AnnotationSliceWorkerID,
+    )
+
+    apiserver, hosts = slice_hosts
+    h = hosts[3]
+    apiserver.upsert_pod(
+        make_pod(
+            "ml", "resliced", h.node,
+            annotations={
+                AnnotationAssumed: "true",
+                container_annotation("jax"): "0,1,2,3",
+                AnnotationSliceName: "v5p-16",
+                AnnotationSliceWorkerID: "0",
+                AnnotationSliceWorkerHosts: "tpu-host-3,tpu-host-2",
+            },
+            containers=[{"name": "jax"}],
+        )
+    )
+    assert wait_until(
+        lambda: h.manager.sitter.get_pod("ml", "resliced") is not None
+    )
+    ids = [core_device_id(c, u) for c in range(4) for u in range(100)]
+    h.kubelet.kubelet_allocate_flow(
+        CORE_ENDPOINT, "ml", "resliced", "jax", ResourceTPUCore, ids
+    )
+    dev_hash = Device(ids, ResourceTPUCore).hash
+    with open(os.path.join(h.alloc_dir, f"{dev_hash}.json")) as f:
+        env = json.load(f)["env"]
+    assert env["TPU_WORKER_ID"] == "0"
+    assert env["TPU_WORKER_HOSTNAMES"] == "tpu-host-3,tpu-host-2"
+    assert env["TPU_ACCELERATOR_TYPE"] == "v5p-16"
+
+
+def test_crd_objects_coexist_per_node(slice_hosts):
+    """All agents publish ElasticTPU objects under their own node prefix
+    to the shared apiserver without clobbering each other."""
+    from elastic_tpu_agent.crd import ElasticTPUClient
+
+    apiserver, hosts = slice_hosts
+    for h in hosts[:2]:
+        pod_name = f"crd-w{h.worker_id}"
+        apiserver.upsert_pod(
+            make_pod(
+                "ml", pod_name, h.node,
+                annotations={
+                    AnnotationAssumed: "true",
+                    container_annotation("jax"): "1",
+                },
+                containers=[{"name": "jax"}],
+            )
+        )
+        assert wait_until(
+            lambda h=h, p=pod_name:
+                h.manager.sitter.get_pod("ml", p) is not None
+        )
+        ids = [core_device_id(1, u) for u in range(100)]
+        h.kubelet.kubelet_allocate_flow(
+            CORE_ENDPOINT, "ml", pod_name, "jax", ResourceTPUCore, ids
+        )
+    for h in hosts[:2]:
+        assert h.manager.crd_recorder.flush()
+    client = ElasticTPUClient(hosts[0].manager.client)
+    nodes = {obj.node_name for obj in client.list()}
+    assert {"node-0", "node-1"} <= nodes
