@@ -41,6 +41,8 @@ ReasonBound = "TPUBound"
 ReasonBindFailed = "TPUBindFailed"
 ReasonReclaimed = "TPUReclaimed"
 ReasonRestored = "TPURestored"
+ReasonChipUnhealthy = "TPUChipUnhealthy"
+ReasonChipHealthy = "TPUChipHealthy"
 
 
 class EventRecorder:

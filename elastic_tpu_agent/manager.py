@@ -109,7 +109,9 @@ class TPUManager:
         self.metrics = opts.metrics
         if self.metrics is not None:
             try:
-                self.metrics.chips.set(len(self.operator.devices()))
+                n = len(self.operator.devices())
+                self.metrics.chips.set(n)
+                self.metrics.healthy_chips.set(n)
             except Exception:  # noqa: BLE001 - discovery failure: gauge stays 0
                 logger.exception("chip discovery for metrics failed")
         self.crd_recorder = None
@@ -281,6 +283,8 @@ class TPUManager:
         self.restore()
         self.plugin.run(self._stop)
         self._gc_thread = self.plugin.start_gc(self.gc_queue, self._stop)
+        if hasattr(self.plugin, "start_health"):
+            self._health_thread = self.plugin.start_health(self._stop)
         if block:
             self._gc_thread.join()
 
@@ -293,6 +297,10 @@ class TPUManager:
         gc_thread = getattr(self, "_gc_thread", None)
         if gc_thread is not None:
             gc_thread.join(timeout=10.0)
+        # Same invariant for the health poller: it submits events too.
+        health_thread = getattr(self, "_health_thread", None)
+        if health_thread is not None:
+            health_thread.join(timeout=10.0)
         if hasattr(self.plugin, "core"):
             self.plugin.core.stop_streams()
             self.plugin.memory.stop_streams()

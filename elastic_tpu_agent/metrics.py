@@ -40,6 +40,11 @@ class AgentMetrics:
         self.chips = Gauge(
             "elastic_tpu_chips", "Physical TPU chips discovered", **kw
         )
+        self.healthy_chips = Gauge(
+            "elastic_tpu_chips_healthy",
+            "Chips currently advertised Healthy to kubelet",
+            **kw,
+        )
         self.bound_allocations = Gauge(
             "elastic_tpu_bound_allocations",
             "Live pod->chip bindings recorded in storage",

@@ -54,7 +54,13 @@ from ..common import (
     container_annotation,
 )
 from ..gen import deviceplugin_pb2 as dp
-from ..kube.events import ReasonBindFailed, ReasonBound, ReasonReclaimed
+from ..kube.events import (
+    ReasonBindFailed,
+    ReasonBound,
+    ReasonChipHealthy,
+    ReasonChipUnhealthy,
+    ReasonReclaimed,
+)
 from ..kube.locator import DeviceLocator, LocateError
 from ..qos import qos_env
 from ..slice_env import slice_env_for_pod
@@ -201,9 +207,39 @@ class _TPUSharePluginBase(_ListAndWatchMixin, rpc.DevicePluginServicer):
         self._crd = config.crd_recorder
         self._events = config.events
         self._chips = {c.index: c for c in self._operator.devices()}
+        self._unhealthy_chips: set = set()
         self._alloc_dir = config.extra.get(
             "alloc_spec_dir", DEFAULT_ALLOC_SPEC_DIR
         )
+
+    # -- health ---------------------------------------------------------------
+
+    def _chip_health(self, chip_index: int) -> str:
+        return (
+            rpc.UNHEALTHY if chip_index in self._unhealthy_chips
+            else rpc.HEALTHY
+        )
+
+    def apply_health(self, healthy: set) -> tuple:
+        """Apply an operator health view; on change, flip device health and
+        wake ListAndWatch so kubelet stops (or resumes) placing units on
+        the affected chips. Returns (went_bad, recovered) chip-index sets."""
+        unhealthy = set(self._chips) - healthy
+        if unhealthy == self._unhealthy_chips:
+            return set(), set()
+        went_bad = unhealthy - self._unhealthy_chips
+        recovered = self._unhealthy_chips - unhealthy
+        self._unhealthy_chips = unhealthy
+        if went_bad:
+            logger.warning(
+                "%s: chips %s now unhealthy", self.resource, sorted(went_bad)
+            )
+        if recovered:
+            logger.info(
+                "%s: chips %s recovered", self.resource, sorted(recovered)
+            )
+        self.notify_devices_changed()
+        return went_bad, recovered
 
     # -- helpers --------------------------------------------------------------
 
@@ -498,10 +534,11 @@ class TPUShareCorePlugin(_TPUSharePluginBase):
     def _device_list(self) -> List[dp.Device]:
         out = []
         for chip in self._chips.values():
+            health = self._chip_health(chip.index)
             for unit in range(TPUPercentEachChip):
                 out.append(
                     dp.Device(
-                        ID=core_device_id(chip.index, unit), health=rpc.HEALTHY
+                        ID=core_device_id(chip.index, unit), health=health
                     )
                 )
         return out
@@ -545,11 +582,12 @@ class TPUShareMemoryPlugin(_TPUSharePluginBase):
     def _device_list(self) -> List[dp.Device]:
         out = []
         for chip in self._chips.values():
+            health = self._chip_health(chip.index)
             units = chip.hbm_bytes // BytesPerMemoryUnit
             for unit in range(units):
                 out.append(
                     dp.Device(
-                        ID=mem_device_id(chip.index, unit), health=rpc.HEALTHY
+                        ID=mem_device_id(chip.index, unit), health=health
                     )
                 )
         return out
@@ -596,6 +634,60 @@ class TPUSharePlugin:
     def run(self, stop: threading.Event) -> None:
         for server in self.servers:
             server.start(stop)
+
+    # -- chip health (no reference analogue: NVML surfaced XIDs for free) -----
+
+    HEALTH_PERIOD_S = 5.0
+
+    def health_once(self) -> bool:
+        """One health poll: probe the operator ONCE, apply the same view to
+        both resources (they must never disagree about a chip), emit events
+        + metrics on transitions. Returns True when anything changed."""
+        try:
+            healthy = self._config.operator.healthy_indexes()
+        except Exception:  # noqa: BLE001 - a broken probe must not wedge
+            logger.exception("health probe failed")
+            return False
+        went_bad, recovered = self.core.apply_health(healthy)
+        self.memory.apply_health(healthy)
+        events = self._config.events
+        if events is not None:
+            for idx in sorted(went_bad):
+                events.node_event(
+                    ReasonChipUnhealthy,
+                    f"TPU chip {idx} unhealthy (device node missing); "
+                    "kubelet will stop placing units on it",
+                    type_="Warning",
+                )
+            for idx in sorted(recovered):
+                events.node_event(
+                    ReasonChipHealthy, f"TPU chip {idx} recovered"
+                )
+        metrics = self._config.metrics
+        if metrics is not None and hasattr(metrics, "healthy_chips"):
+            metrics.healthy_chips.set(
+                len(self.core._chips) - len(self.core._unhealthy_chips)
+            )
+        return bool(went_bad or recovered)
+
+    def health_loop(self, stop: threading.Event) -> None:
+        # Poll immediately: a chip that died between operator discovery and
+        # plugin start must not be advertised Healthy for a whole period.
+        while True:
+            try:
+                self.health_once()
+            except Exception:  # noqa: BLE001
+                logger.exception("health poll failed")
+            if stop.wait(self.HEALTH_PERIOD_S):
+                return
+
+    def start_health(self, stop: threading.Event) -> threading.Thread:
+        t = threading.Thread(
+            target=self.health_loop, args=(stop,), daemon=True,
+            name="tpu-health",
+        )
+        t.start()
+        return t
 
     # -- GC (reference: base.go:241-306, SURVEY.md §3.3) ----------------------
 

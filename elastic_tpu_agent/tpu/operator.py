@@ -66,6 +66,15 @@ class TPUOperator(ABC):
     def check(self, link_id: str) -> bool:
         """True when the virtual node exists."""
 
+    def healthy_indexes(self) -> set:
+        """Chip indexes currently healthy. Default: every discovered chip.
+        Operators with a live health source (device-node presence for
+        tpu-vm, injected faults for the stub) override this; the plugin
+        layer polls it and flips kubelet device health on changes — a
+        capability NVML gave the reference for free (XIDs) and TPU has no
+        single analogue for."""
+        return {c.index for c in self.devices()}
+
 
 # -- shared symlink mechanics -------------------------------------------------
 

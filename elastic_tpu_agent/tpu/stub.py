@@ -35,6 +35,7 @@ class StubOperator(LinkingOperator):
         self._hostname = hostname
         self._worker_id = worker_id
         self._worker_hostnames = list(worker_hostnames or [])
+        self._unhealthy: set = set()
 
     @property
     def topology(self) -> TopologyInfo:
@@ -47,6 +48,14 @@ class StubOperator(LinkingOperator):
 
     def worker_hostnames(self) -> List[str]:
         return list(self._worker_hostnames)
+
+    # -- fault injection (mirrors tpuvm healthy_indexes semantics) ------------
+
+    def set_unhealthy(self, indexes) -> None:
+        self._unhealthy = set(indexes)
+
+    def healthy_indexes(self) -> set:
+        return {c.index for c in self.devices()} - self._unhealthy
 
     def devices(self) -> List[TPUChip]:
         spec = self._topo.spec

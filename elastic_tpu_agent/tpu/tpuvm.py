@@ -188,3 +188,9 @@ class TPUVMOperator(LinkingOperator):
             )
             for i in indexes
         ]
+
+    def healthy_indexes(self) -> set:
+        """A chip is healthy while its /dev/accelN chardev is present; a
+        wedged/detached chip (driver reset, host maintenance event) drops
+        its node, and kubelet must stop placing fractional units on it."""
+        return set(self._accel_indexes())

@@ -1,0 +1,144 @@
+"""Chip health monitoring: a chip losing its device node flips its fake
+devices to Unhealthy on the live ListAndWatch stream, recovery flips them
+back, and both transitions surface as node Events and metrics.
+
+The reference got device health from NVML XIDs implicitly and never
+propagated it; TPU has no NVML, so health is an agent feature here
+(operator.healthy_indexes -> plugin.apply_health -> ListAndWatch)."""
+
+import os
+import queue
+import threading
+
+import pytest
+
+from elastic_tpu_agent import rpc
+from elastic_tpu_agent.plugins.tpushare import CORE_ENDPOINT, MEM_ENDPOINT
+
+from test_e2e import Cluster, wait_until
+from test_plugins import harness  # noqa: F401 - reuse the plugin harness
+
+
+def _stream_responses(client, out_queue, stop):
+    try:
+        for resp in client.list_and_watch():
+            out_queue.put(resp)
+            if stop.is_set():
+                return
+    except Exception:  # noqa: BLE001 - stream torn down at test end
+        pass
+
+
+def _health_by_chip(resp):
+    by_chip = {}
+    for dev in resp.devices:
+        chip = int(dev.ID.split("-")[2])
+        by_chip.setdefault(chip, set()).add(dev.health)
+    return by_chip
+
+
+def test_unhealthy_chip_propagates_to_listandwatch(harness):  # noqa: F811
+    client = harness.kubelet.plugin_client(CORE_ENDPOINT)
+    q: "queue.Queue" = queue.Queue()
+    stop = threading.Event()
+    t = threading.Thread(
+        target=_stream_responses, args=(client, q, stop), daemon=True
+    )
+    t.start()
+    first = q.get(timeout=10)
+    assert all(
+        h == {rpc.HEALTHY} for h in _health_by_chip(first).values()
+    )
+
+    # chip 2 dies
+    harness.operator.set_unhealthy({2})
+    assert harness.plugin.health_once()
+    resp = q.get(timeout=10)
+    by_chip = _health_by_chip(resp)
+    assert by_chip[2] == {rpc.UNHEALTHY}
+    for chip in (0, 1, 3):
+        assert by_chip[chip] == {rpc.HEALTHY}
+
+    # chip 2 recovers
+    harness.operator.set_unhealthy(set())
+    assert harness.plugin.health_once()
+    resp = q.get(timeout=10)
+    assert all(
+        h == {rpc.HEALTHY} for h in _health_by_chip(resp).values()
+    )
+    stop.set()
+
+
+def test_health_poll_idempotent_when_unchanged(harness):  # noqa: F811
+    assert not harness.plugin.health_once()
+    harness.operator.set_unhealthy({1})
+    assert harness.plugin.health_once()
+    assert not harness.plugin.health_once()  # no change -> no resend
+
+
+def test_memory_plugin_tracks_health_too(harness):  # noqa: F811
+    harness.operator.set_unhealthy({0})
+    harness.plugin.health_once()
+    mem_list = harness.plugin.memory._device_list()
+    unhealthy = {d.ID for d in mem_list if d.health == rpc.UNHEALTHY}
+    assert unhealthy and all(i.startswith("tpu-mem-0-") for i in unhealthy)
+
+
+@pytest.fixture()
+def cluster(tmp_path):
+    c = Cluster(tmp_path)
+    c.start()
+    yield c
+    c.stop()
+
+
+def test_health_transitions_emit_node_events(cluster):
+    plugin = cluster.manager.plugin
+    cluster.manager.operator.set_unhealthy({1, 3})
+    plugin.health_once()
+    cluster.manager.operator.set_unhealthy({1})
+    plugin.health_once()
+    assert cluster.manager.events.flush()
+    evs = cluster.apiserver.core_events
+    bad = [e for e in evs if e["reason"] == "TPUChipUnhealthy"]
+    good = [e for e in evs if e["reason"] == "TPUChipHealthy"]
+    assert len(bad) == 2 and all(e["type"] == "Warning" for e in bad)
+    assert {e["message"].split()[2] for e in bad} == {"1", "3"}
+    assert len(good) == 1 and "chip 3 recovered" in good[0]["message"]
+
+
+def test_tpuvm_health_follows_device_nodes(tmp_path):
+    """The tpu-vm operator's health source is /dev/accel* presence."""
+    from elastic_tpu_agent.tpu.tpuvm import TPUVMOperator
+
+    scan = tmp_path / "hostdev"
+    scan.mkdir()
+    for i in range(4):
+        (scan / f"accel{i}").touch()
+    op = TPUVMOperator(
+        str(tmp_path / "dev"), host_dev_scan_root=str(scan),
+        metadata=lambda attr: None,
+        env={"TPU_ACCELERATOR_TYPE": "v5litepod-4"},
+    )
+    os.makedirs(str(tmp_path / "dev"), exist_ok=True)
+    assert op.healthy_indexes() == {0, 1, 2, 3}
+    (scan / "accel2").unlink()
+    assert op.healthy_indexes() == {0, 1, 3}
+
+
+def test_health_loop_runs_periodically(tmp_path):
+    """The manager-started loop picks up operator changes by itself."""
+    from elastic_tpu_agent.plugins.tpushare import TPUSharePlugin
+
+    period = TPUSharePlugin.HEALTH_PERIOD_S
+    TPUSharePlugin.HEALTH_PERIOD_S = 0.05  # fast poll for the test
+    c = Cluster(tmp_path)
+    try:
+        c.start()
+        c.manager.operator.set_unhealthy({2})
+        assert wait_until(
+            lambda: c.manager.plugin.core._unhealthy_chips == {2}
+        )
+    finally:
+        TPUSharePlugin.HEALTH_PERIOD_S = period
+        c.stop()
