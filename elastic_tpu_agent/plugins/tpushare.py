@@ -663,12 +663,29 @@ class TPUSharePlugin:
                 events.node_event(
                     ReasonChipHealthy, f"TPU chip {idx} recovered"
                 )
+            if went_bad:
+                self._warn_bound_pods(events, went_bad)
         metrics = self._config.metrics
         if metrics is not None and hasattr(metrics, "healthy_chips"):
             metrics.healthy_chips.set(
                 len(self.core._chips) - len(self.core._unhealthy_chips)
             )
         return bool(went_bad or recovered)
+
+    def _warn_bound_pods(self, events, went_bad: set) -> None:
+        """Tell each pod bound to a newly-dead chip that its device is
+        gone — `kubectl describe pod` should answer "why did my training
+        job stall" without node access."""
+        for _, info in list(self._config.storage.items()):
+            for record in info.records():
+                hit = sorted(set(record.chip_indexes) & went_bad)
+                if hit:
+                    events.pod_event(
+                        info.namespace, info.name, ReasonChipUnhealthy,
+                        f"TPU chip(s) {','.join(map(str, hit))} bound to "
+                        "this pod became unhealthy",
+                        type_="Warning",
+                    )
 
     def health_loop(self, stop: threading.Event) -> None:
         # Poll immediately: a chip that died between operator discovery and

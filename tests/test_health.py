@@ -107,6 +107,46 @@ def test_health_transitions_emit_node_events(cluster):
     assert len(good) == 1 and "chip 3 recovered" in good[0]["message"]
 
 
+def test_bound_pod_warned_when_its_chip_dies(cluster):
+    from elastic_tpu_agent.common import (
+        AnnotationAssumed,
+        ResourceTPUCore,
+        container_annotation,
+    )
+    from elastic_tpu_agent.plugins.tpushare import core_device_id
+    from fake_apiserver import make_pod
+
+    cluster.apiserver.upsert_pod(
+        make_pod(
+            "default", "victim", cluster.node,
+            annotations={
+                AnnotationAssumed: "true",
+                container_annotation("jax"): "2",
+            },
+            containers=[{"name": "jax"}],
+        )
+    )
+    assert wait_until(
+        lambda: cluster.manager.sitter.get_pod("default", "victim")
+        is not None
+    )
+    ids = [core_device_id(2, i) for i in range(100)]
+    cluster.kubelet.kubelet_allocate_flow(
+        CORE_ENDPOINT, "default", "victim", "jax", ResourceTPUCore, ids
+    )
+    cluster.manager.operator.set_unhealthy({2})
+    cluster.manager.plugin.health_once()
+    assert cluster.manager.events.flush()
+    pod_warnings = [
+        e for e in cluster.apiserver.core_events
+        if e["reason"] == "TPUChipUnhealthy"
+        and e["involvedObject"]["kind"] == "Pod"
+    ]
+    assert len(pod_warnings) == 1
+    assert pod_warnings[0]["involvedObject"]["name"] == "victim"
+    assert "chip(s) 2" in pod_warnings[0]["message"]
+
+
 def test_tpuvm_health_follows_device_nodes(tmp_path):
     """The tpu-vm operator's health source is /dev/accel* presence."""
     from elastic_tpu_agent.tpu.tpuvm import TPUVMOperator
