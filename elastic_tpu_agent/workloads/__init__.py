@@ -1,3 +1,4 @@
+from .checkpointing import TrainCheckpointer
 from .moe import init_moe_params, moe_mlp, moe_param_shardings
 from .pipeline import (
     make_pipeline_mesh,
@@ -16,6 +17,7 @@ from .transformer import (
 
 __all__ = [
     "ModelConfig",
+    "TrainCheckpointer",
     "forward",
     "forward_with_aux",
     "init_moe_params",

@@ -16,6 +16,7 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import signal
 import sys
 import time
 
@@ -82,6 +83,11 @@ def main(argv=None) -> int:
     parser.add_argument("--dp", type=int, default=None)
     parser.add_argument("--sp", type=int, default=1)
     parser.add_argument("--tp", type=int, default=None)
+    parser.add_argument(
+        "--checkpoint-dir", default="",
+        help="enable preemption-tolerant checkpoint/resume (orbax)",
+    )
+    parser.add_argument("--checkpoint-every", type=int, default=10)
     args = parser.parse_args(argv)
 
     applied = load_alloc_env()
@@ -106,26 +112,63 @@ def main(argv=None) -> int:
         jax.random.key(1), (args.batch, args.seq + 1), 0, cfg.vocab
     )
 
-    # compile + warmup
-    params, opt_state, loss = train_step(params, opt_state, tokens)
-    jax.block_until_ready(loss)
+    # Preemption-tolerant resume (TPU pods are preemptible; the elastic
+    # scheduler may also move us): restore the latest checkpoint onto the
+    # live mesh shardings, and save on SIGTERM before dying.
+    ckpt = None
+    start_step = 0
+    preempted = {"flag": False}
+    if args.checkpoint_dir:
+        from .checkpointing import TrainCheckpointer
 
+        ckpt = TrainCheckpointer(args.checkpoint_dir)
+        if ckpt.latest_step is not None:
+            params, opt_state, start_step = ckpt.restore(params, opt_state)
+            start_step += 1
+
+        def on_sigterm(signum, frame):  # noqa: ARG001
+            preempted["flag"] = True
+
+        signal.signal(signal.SIGTERM, on_sigterm)
+
+    # AOT-compile instead of a warmup execution: a real warmup step would
+    # apply an optimizer update the step accounting never sees, so a
+    # resumed run would silently drift from an uninterrupted one.
+    train_step.lower(params, opt_state, tokens).compile()
+
+    every = max(0, args.checkpoint_every)  # 0 = save only on preemption
     t0 = time.perf_counter()
-    for _ in range(args.steps):
+    step = start_step
+    ran = 0
+    loss = None
+    for step in range(start_step, start_step + args.steps):
         params, opt_state, loss = train_step(params, opt_state, tokens)
-    jax.block_until_ready(loss)
+        ran += 1
+        if ckpt is not None and (
+            preempted["flag"] or (every > 0 and (step + 1) % every == 0)
+        ):
+            ckpt.save(step, params, opt_state)
+        if preempted["flag"]:
+            break
+    if loss is not None:
+        jax.block_until_ready(loss)
     dt = time.perf_counter() - t0
+    if ckpt is not None:
+        ckpt.wait()
+        ckpt.close()
 
     tokens_per_step = args.batch * args.seq
     report = {
         "platform": jax.devices()[0].platform,
         "devices": len(jax.devices()),
         "mesh": dict(mesh.shape),
-        "steps": args.steps,
-        "final_loss": float(loss),
-        "step_time_ms": dt / args.steps * 1000,
-        "tokens_per_s": tokens_per_step * args.steps / dt,
+        "steps": ran,
+        "start_step": start_step,
+        "final_loss": float(loss) if loss is not None else None,
+        "step_time_ms": dt / max(1, ran) * 1000,
+        "tokens_per_s": tokens_per_step * ran / dt,
         "alloc_env": applied,
+        "preempted": preempted["flag"],
     }
     print(json.dumps(report))
     return 0
