@@ -2,12 +2,15 @@
 # a test target was notably absent there).
 TAG ?= elastic-tpu-agent:latest
 
-.PHONY: all native test protos image bench clean
+.PHONY: all native sanitize test protos image bench clean
 
 all: native test
 
 native:
 	$(MAKE) -C native
+
+sanitize:
+	$(MAKE) -C native sanitize
 
 test: native
 	python -m pytest tests/ -q

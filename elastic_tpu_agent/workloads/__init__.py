@@ -1,4 +1,3 @@
-from .checkpointing import TrainCheckpointer
 from .moe import init_moe_params, moe_mlp, moe_param_shardings
 from .pipeline import (
     make_pipeline_mesh,
@@ -31,3 +30,13 @@ __all__ = [
     "param_shardings",
     "pipeline_apply",
 ]
+
+
+def __getattr__(name):
+    # Lazy: checkpointing pulls in orbax, which plain training/bench paths
+    # (and images without orbax) must not require.
+    if name == "TrainCheckpointer":
+        from .checkpointing import TrainCheckpointer
+
+        return TrainCheckpointer
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -138,7 +138,6 @@ def main(argv=None) -> int:
 
     every = max(0, args.checkpoint_every)  # 0 = save only on preemption
     t0 = time.perf_counter()
-    step = start_step
     ran = 0
     loss = None
     for step in range(start_step, start_step + args.steps):

@@ -84,9 +84,13 @@ def test_retention_keeps_newest(tmp_path):
         ckpt.save(s, params, opt)
     ckpt.wait()
     assert ckpt.latest_step == 3
-    # restore of an evicted step fails; newest two restorable
     _, _, step = ckpt.restore(params, opt)
     assert step == 3
+    # evicted steps really are gone
+    import pytest as _pytest
+
+    with _pytest.raises(Exception):
+        ckpt.restore(params, opt, step=0)
     ckpt.close()
 
 
@@ -97,7 +101,10 @@ def test_runner_resumes_from_checkpoint(tmp_path):
         **os.environ,
         "JAX_PLATFORMS": "cpu",
         "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
-        "PYTHONPATH": "/root/repo",
+        "PYTHONPATH": os.pathsep.join(
+            [os.path.join(os.path.dirname(__file__), "..")]
+            + os.environ.get("PYTHONPATH", "").split(os.pathsep)
+        ).rstrip(os.pathsep),
     }
     cmd = [
         sys.executable, "-m", "elastic_tpu_agent.workloads.runner",

@@ -1,0 +1,77 @@
+"""Re-run the native hook-chain flows against ASan+UBSan builds.
+
+The reference shipped no sanitizer configuration anywhere (SURVEY.md
+§5.2). Here the same test drivers from test_native run against
+`make sanitize` binaries; any heap/UB error aborts the binary (exitcode
+flips) or prints a Sanitizer report to stderr — both fail the
+assertions below.
+"""
+
+import os
+import subprocess
+
+import pytest
+
+import test_native as tn
+
+SAN_DIR = os.path.join(tn.NATIVE_DIR, "sanitized")
+
+
+@pytest.fixture(scope="module", autouse=True)
+def sanitized_binaries():
+    subprocess.run(
+        ["make", "-C", tn.NATIVE_DIR, "sanitize"],
+        check=True, capture_output=True,
+    )
+    saved = (tn.HOOK, tn.TOOLKIT, tn.MOUNT_TOOL)
+    tn.HOOK = os.path.join(SAN_DIR, "elastic-tpu-hook")
+    tn.TOOLKIT = os.path.join(SAN_DIR, "elastic-tpu-container-toolkit")
+    tn.MOUNT_TOOL = os.path.join(SAN_DIR, "mount_elastic_tpu")
+    yield
+    tn.HOOK, tn.TOOLKIT, tn.MOUNT_TOOL = saved
+
+
+def test_inject_flow_clean_under_sanitizers(tmp_path):
+    tn.test_hook_injects_devices_from_alloc_spec(tmp_path)
+
+
+def test_passthrough_clean_under_sanitizers(tmp_path):
+    tn.test_hook_passthrough_without_tpu_env(tmp_path)
+
+
+def test_toolkit_rerun_clean_under_sanitizers(tmp_path):
+    tn.test_toolkit_idempotent_rerun(tmp_path)
+
+
+def test_devscan_fallback_clean_under_sanitizers(tmp_path):
+    tn.test_devscan_fallback_resolves_links(tmp_path)
+
+
+def test_libtpu_install_clean_under_sanitizers(tmp_path):
+    tn.test_libtpu_copied_when_missing(tmp_path)
+
+
+def test_mount_tool_clean_under_sanitizers(tmp_path):
+    tn.test_mount_tool_attaches_into_mount_namespace(tmp_path)
+
+
+def test_malformed_input_errors_without_memory_bugs():
+    """Malformed stdin must fail by policy (clean error), not by ASan."""
+    result = subprocess.run(
+        [tn.HOOK], input=b"{not json", capture_output=True, timeout=30
+    )
+    assert result.returncode != 0
+    assert b"Sanitizer" not in result.stderr, result.stderr[-2000:]
+
+
+def test_deeply_nested_and_oversized_json_no_overflow(tmp_path):
+    """Adversarial bundle config: deep nesting + huge strings must not
+    smash the parser (stack overflow / OOB reads show up under ASan)."""
+    bundle, _ = tn.make_bundle(tmp_path, env=["TPU=cafebabe"])
+    evil = "[" * 2000 + "]" * 2000
+    (bundle / "config.json").write_text(
+        '{"process": {"env": ["TPU=' + "A" * 100000 + '"]}, '
+        '"root": {"path": "rootfs"}, "junk": ' + evil + "}"
+    )
+    result = tn.run_hook(bundle)
+    assert b"Sanitizer" not in result.stderr, result.stderr[-2000:]
