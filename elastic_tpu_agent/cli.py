@@ -56,6 +56,11 @@ def parse_args(argv=None) -> argparse.Namespace:
     )
     p.add_argument("--metrics-port", type=int, default=9478,
                    help="prometheus metrics port (0 = off)")
+    p.add_argument("--no-events", action="store_true",
+                   help="disable k8s Event emission (e.g. RBAC without "
+                        "events:create)")
+    p.add_argument("--no-crd", action="store_true",
+                   help="disable ElasticTPU CRD publication")
     p.add_argument("-v", "--verbose", action="count", default=0)
     return p.parse_args(argv)
 
@@ -88,6 +93,8 @@ def main(argv=None) -> int:
             pod_resources_socket=args.pod_resources_socket,
             alloc_spec_dir=args.alloc_spec_dir,
             metrics=metrics,
+            enable_events=not args.no_events,
+            enable_crd=not args.no_crd,
         )
     )
     run_thread = threading.Thread(

@@ -1,0 +1,108 @@
+"""Soak: sustained bind/delete churn with kubelet restarts and health
+flaps happening concurrently. Asserts the terminal state is clean — no
+leaked links, no leaked alloc specs, storage empty, agent still serving.
+"""
+
+import os
+import random
+import threading
+
+from elastic_tpu_agent.common import (
+    AnnotationAssumed,
+    ResourceTPUCore,
+    container_annotation,
+)
+from elastic_tpu_agent.plugins.tpushare import CORE_ENDPOINT, core_device_id
+
+from fake_apiserver import make_pod
+from test_e2e import Cluster, wait_until
+
+ROUNDS = 30
+
+
+def test_churn_survives_restarts_and_health_flaps(tmp_path):
+    c = Cluster(tmp_path)
+    c.start()
+    rng = random.Random(1234)
+    stop = threading.Event()
+
+    def health_flapper():
+        while not stop.is_set():
+            c.manager.operator.set_unhealthy(
+                {rng.randrange(4)} if rng.random() < 0.5 else set()
+            )
+            try:
+                c.manager.plugin.health_once()
+            except Exception:  # noqa: BLE001 - must never happen; assert below
+                errors.append("health_once raised")
+            stop.wait(0.01)
+
+    errors: list = []
+    flapper = threading.Thread(target=health_flapper, daemon=True)
+    flapper.start()
+    try:
+        for i in range(ROUNDS):
+            pod = f"churn-{i}"
+            chip = i % 4
+            c.apiserver.upsert_pod(
+                make_pod(
+                    "soak", pod, c.node,
+                    annotations={
+                        AnnotationAssumed: "true",
+                        container_annotation("jax"): str(chip),
+                    },
+                    containers=[{"name": "jax"}],
+                )
+            )
+            assert wait_until(
+                lambda p=pod: c.manager.sitter.get_pod("soak", p) is not None
+            )
+            ids = [
+                core_device_id(chip, (i * 13 + j) % 100) for j in range(20)
+            ]
+            c.kubelet.kubelet_allocate_flow(
+                CORE_ENDPOINT, "soak", pod, "jax", ResourceTPUCore, ids
+            )
+            assert c.manager.storage.load("soak", pod) is not None
+
+            if i % 7 == 3:
+                # kubelet restart mid-churn: plugins must re-register
+                before = len(c.kubelet.registrations)
+                c.kubelet.restart_registration()
+                assert wait_until(
+                    lambda b=before: len(c.kubelet.registrations) >= b + 2,
+                    timeout=30.0,
+                ), "plugins did not re-register after kubelet restart"
+
+            # delete every pod immediately; GC races the next bind
+            c.apiserver.delete_pod("soak", pod)
+            c.kubelet.unassign_pod("soak", pod)
+    finally:
+        stop.set()
+        flapper.join(timeout=5)
+
+    assert not errors
+    # terminal state: everything reclaimed
+    assert wait_until(
+        lambda: all(
+            c.manager.storage.load("soak", f"churn-{i}") is None
+            for i in range(ROUNDS)
+        ),
+        timeout=90.0,
+    ), "GC did not reclaim all churned pods"
+    assert wait_until(
+        lambda: c.manager.operator.list_links() == [], timeout=30.0
+    ), f"leaked links: {c.manager.operator.list_links()}"
+    leftover_specs = [
+        f for f in os.listdir(c.tmp / "alloc") if f.endswith(".json")
+    ] if os.path.isdir(c.tmp / "alloc") else []
+    assert leftover_specs == [], leftover_specs
+    # the agent is still alive and serving
+    c.manager.operator.set_unhealthy(set())
+    c.manager.plugin.health_once()
+    client = c.kubelet.plugin_client(CORE_ENDPOINT)
+    resp = client.get_preferred_allocation(
+        [core_device_id(0, u) for u in range(10)], [], 5
+    )
+    assert len(resp.container_responses[0].deviceIDs) == 5
+    c.stop()
