@@ -52,6 +52,18 @@ def supports_flash(seq: int, head_dim: int, cfg: FlashConfig) -> bool:
     )
 
 
+def auto_flash_config(seq: int, interpret: bool = False) -> FlashConfig:
+    """Largest square block that tiles ``seq``. Measured on v5e-1
+    ([16,1024,8,128] fwd+bwd): 512-blocks 4.75 ms vs 256-blocks 5.17 ms
+    vs materialized-scores 6.44 ms — bigger tiles amortize the online-
+    softmax bookkeeping; equal q/k blocks keep the causal fast path
+    (kernel skips kv blocks above the diagonal)."""
+    for blk in (512, 256, 128):
+        if seq % blk == 0:
+            return FlashConfig(block_q=blk, block_k=blk, interpret=interpret)
+    return FlashConfig(interpret=interpret)  # supports_flash will reject
+
+
 # -- reference (oracle / fallback) path ---------------------------------------
 
 

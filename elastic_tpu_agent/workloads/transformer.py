@@ -172,7 +172,7 @@ def _attention_core(
 ) -> jax.Array:
     """Dispatch the attention core ([b,s,n,h]³ → [b,s,n,h])."""
     from .attention import (
-        FlashConfig,
+        auto_flash_config,
         flash_attention,
         reference_attention,
         supports_flash,
@@ -187,7 +187,7 @@ def _attention_core(
         if sp > 1:
             impl = "ring"
         elif platform == "tpu" and supports_flash(
-            s, h, FlashConfig()
+            s, h, auto_flash_config(s)
         ):
             impl = "flash"
         else:
@@ -202,7 +202,7 @@ def _attention_core(
                 "flash attention cannot span a sharded sequence axis; "
                 "use ring (attn='ring'/'auto') when sp > 1"
             )
-        fc = FlashConfig(interpret=(platform != "tpu"))
+        fc = auto_flash_config(s, interpret=(platform != "tpu"))
         if mesh is None:
             return flash_attention(q, k, v, fc)
         # Under GSPMD, XLA cannot auto-partition a pallas_call: pin the

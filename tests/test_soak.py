@@ -23,6 +23,13 @@ ROUNDS = 30
 def test_churn_survives_restarts_and_health_flaps(tmp_path):
     c = Cluster(tmp_path)
     c.start()
+    try:
+        _run_churn(c)
+    finally:
+        c.stop()
+
+
+def _run_churn(c):
     rng = random.Random(1234)
     stop = threading.Event()
 
@@ -105,4 +112,3 @@ def test_churn_survives_restarts_and_health_flaps(tmp_path):
         [core_device_id(0, u) for u in range(10)], [], 5
     )
     assert len(resp.container_responses[0].deviceIDs) == 5
-    c.stop()
