@@ -194,11 +194,12 @@ def run_tpu_throughput():
         optimizer = optax.adamw(1e-3)
 
         def loss_fn(params, tokens):
-            logits = forward(params, tokens[:, :-1], cfg)
+            logits = forward(params, tokens[:, :-1], cfg).astype(jnp.float32)
             targets = tokens[:, 1:]
-            logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
             return jnp.mean(
-                -jnp.take_along_axis(logp, targets[..., None], axis=-1)
+                optax.softmax_cross_entropy_with_integer_labels(
+                    logits, targets
+                )
             )
 
         def one_step(carry, _):
