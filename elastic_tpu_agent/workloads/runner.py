@@ -30,8 +30,18 @@ PRESETS = {
 }
 
 
-def load_alloc_env(path: str = ENV_FILE) -> dict:
-    """Apply the hook-written env file (KEY=VALUE lines) to this process."""
+def load_alloc_env(path: str = "") -> dict:
+    """Apply the hook-written env file (KEY=VALUE lines) to this process.
+
+    ``path`` defaults to $ELASTIC_TPU_ENV_FILE (resolved at call time,
+    for non-standard mounts and tests) or the in-container ENV_FILE.
+
+    Agent values OVERRIDE ambient env: this file is the pod's allocation
+    truth, the moral equivalent of kubelet injecting the device plugin's
+    Allocate envs (reference gpushare.go:79-82) — image baselines like a
+    pre-set single-host TPU_WORKER_HOSTNAMES must not shadow the slice
+    the scheduler actually assigned."""
+    path = path or os.environ.get("ELASTIC_TPU_ENV_FILE", ENV_FILE)
     applied = {}
     if not os.path.exists(path):
         return applied
@@ -41,7 +51,7 @@ def load_alloc_env(path: str = ENV_FILE) -> dict:
             if not line or "=" not in line:
                 continue
             key, _, value = line.partition("=")
-            os.environ.setdefault(key, value)
+            os.environ[key] = value
             applied[key] = value
     return applied
 

@@ -159,3 +159,21 @@ def test_prestart_spec_carries_qos_and_slice_env(harness):
     assert env["TPU_WORKER_ID"] == "1"
     assert env["TPU_WORKER_HOSTNAMES"] == "w0,w1"
     assert spec["hbm_limit_bytes"] == 4096 * 1024 * 1024
+
+
+def test_load_alloc_env_overrides_ambient(tmp_path, monkeypatch):
+    """Agent env is authoritative: an image-baseline TPU var (e.g. the
+    single-host TPU_WORKER_HOSTNAMES some TPU images pre-set) must not
+    shadow the slice assignment the scheduler actually made."""
+    from elastic_tpu_agent.workloads.runner import load_alloc_env
+
+    monkeypatch.setenv("TPU_WORKER_HOSTNAMES", "localhost")
+    monkeypatch.setenv("TPU_WORKER_ID", "0")  # also restores after test
+    envfile = tmp_path / "env"
+    envfile.write_text("TPU_WORKER_HOSTNAMES=a,b\nTPU_WORKER_ID=1\n")
+    applied = load_alloc_env(str(envfile))
+    import os
+
+    assert os.environ["TPU_WORKER_HOSTNAMES"] == "a,b"
+    assert os.environ["TPU_WORKER_ID"] == "1"
+    assert applied == {"TPU_WORKER_HOSTNAMES": "a,b", "TPU_WORKER_ID": "1"}
