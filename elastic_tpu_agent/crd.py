@@ -19,6 +19,7 @@ from .kube.client import KubeClient, KubeError
 GROUP = "elasticgpu.io"
 VERSION = "v1alpha1"
 PLURAL = "elastictpus"
+KIND = "ElasticTPU"
 NodeLabel = "elasticgpu.io/node"
 
 # Canonical phases (reference types.go:49-57).
@@ -55,7 +56,7 @@ class ElasticTPU:
             metadata["labels"] = {NodeLabel: self.node_name}
         return {
             "apiVersion": f"{GROUP}/{VERSION}",
-            "kind": "ElasticTPU",
+            "kind": KIND,
             "metadata": metadata,
             "spec": {
                 "nodeName": self.node_name,

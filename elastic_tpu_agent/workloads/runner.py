@@ -98,6 +98,11 @@ def main(argv=None) -> int:
         help="enable preemption-tolerant checkpoint/resume (orbax)",
     )
     parser.add_argument("--checkpoint-every", type=int, default=10)
+    parser.add_argument(
+        "--profile-dir", default="",
+        help="capture a JAX/XLA profiler trace of the timed steps "
+             "(open with tensorboard or xprof)",
+    )
     args = parser.parse_args(argv)
 
     applied = load_alloc_env()
@@ -147,20 +152,28 @@ def main(argv=None) -> int:
     train_step.lower(params, opt_state, tokens).compile()
 
     every = max(0, args.checkpoint_every)  # 0 = save only on preemption
+    if args.profile_dir:
+        jax.profiler.start_trace(args.profile_dir)
     t0 = time.perf_counter()
     ran = 0
     loss = None
-    for step in range(start_step, start_step + args.steps):
-        params, opt_state, loss = train_step(params, opt_state, tokens)
-        ran += 1
-        if ckpt is not None and (
-            preempted["flag"] or (every > 0 and (step + 1) % every == 0)
-        ):
-            ckpt.save(step, params, opt_state)
-        if preempted["flag"]:
-            break
-    if loss is not None:
-        jax.block_until_ready(loss)
+    try:
+        for step in range(start_step, start_step + args.steps):
+            params, opt_state, loss = train_step(params, opt_state, tokens)
+            ran += 1
+            if ckpt is not None and (
+                preempted["flag"] or (every > 0 and (step + 1) % every == 0)
+            ):
+                ckpt.save(step, params, opt_state)
+            if preempted["flag"]:
+                break
+        if loss is not None:
+            jax.block_until_ready(loss)
+    finally:
+        # stop even on a mid-loop failure — the crashed run is exactly
+        # the one whose trace you want readable
+        if args.profile_dir:
+            jax.profiler.stop_trace()
     dt = time.perf_counter() - t0
     if ckpt is not None:
         ckpt.wait()

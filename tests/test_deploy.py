@@ -1,0 +1,117 @@
+"""Deploy manifests stay consistent with the code they deploy.
+
+The reference's manifest drifted from its code (its default plugin flag
+wasn't even supported by its factory, SURVEY.md §7); these tests pin our
+manifest to the CLI surface, RBAC to the API calls the agent makes, and
+the CRD manifest to the client's group/version/kind.
+"""
+
+import os
+
+import yaml
+
+from elastic_tpu_agent.cli import parse_args
+
+DEPLOY = os.path.join(os.path.dirname(__file__), "..", "deploy")
+
+
+def _load(name):
+    with open(os.path.join(DEPLOY, name)) as f:
+        return list(yaml.safe_load_all(f))
+
+
+def _daemonset():
+    for doc in _load("elastic-tpu-agent.yaml"):
+        if doc and doc.get("kind") == "DaemonSet":
+            return doc
+    raise AssertionError("no DaemonSet in manifest")
+
+
+def test_agent_args_are_valid_cli_flags():
+    ds = _daemonset()
+    agent = next(
+        c for c in ds["spec"]["template"]["spec"]["containers"]
+        if c["name"] == "agent"
+    )
+    flags = [
+        a.split("=")[0] for a in agent["command"] if a.startswith("--")
+    ]
+    # parse with harmless values: unknown flags raise SystemExit.
+    # store_true flags must be passed bare, valued flags need a value.
+    argv = []
+    for f in flags:
+        if f in ("--no-events", "--no-crd"):
+            argv.append(f)
+        elif f == "--metrics-port":
+            argv.append(f + "=0")
+        else:
+            argv.append(f + "=x")
+    parse_args(argv)
+
+
+def test_tpu_node_match_uses_exists_not_empty_value():
+    """GKE sets cloud.google.com/gke-tpu-accelerator to the accelerator
+    TYPE; a nodeSelector with value "" would never match any TPU node."""
+    ds = _daemonset()
+    spec = ds["spec"]["template"]["spec"]
+    assert "cloud.google.com/gke-tpu-accelerator" not in (
+        spec.get("nodeSelector") or {}
+    )
+    terms = spec["affinity"]["nodeAffinity"][
+        "requiredDuringSchedulingIgnoredDuringExecution"
+    ]["nodeSelectorTerms"]
+    exprs = [e for t in terms for e in t["matchExpressions"]]
+    assert any(
+        e["key"] == "cloud.google.com/gke-tpu-accelerator"
+        and e["operator"] == "Exists"
+        for e in exprs
+    )
+
+
+def test_rbac_covers_agent_api_calls():
+    rules = []
+    for doc in _load("elastic-tpu-agent.yaml"):
+        if doc and doc.get("kind") == "ClusterRole":
+            rules.extend(doc.get("rules", []))
+
+    def allowed(group, resource, verb):
+        for r in rules:
+            if (
+                group in r.get("apiGroups", [])
+                and resource in r.get("resources", [])
+                and verb in r.get("verbs", [])
+            ):
+                return True
+        return False
+
+    # sitter: list/watch pods; GC: get pods
+    for verb in ("get", "list", "watch"):
+        assert allowed("", "pods", verb), verb
+    # events recorder (kube/events.py)
+    assert allowed("", "events", "create")
+    # CRD recorder (crd_recorder.py): create/update/delete/list
+    for verb in ("create", "update", "delete", "list"):
+        assert allowed("elasticgpu.io", "elastictpus", verb), verb
+
+
+def test_crd_manifest_matches_client():
+    from elastic_tpu_agent import crd
+
+    doc = _load("elastic-tpu-crd.yaml")[0]
+    assert doc["spec"]["group"] == crd.GROUP
+    names = doc["spec"]["names"]
+    assert names["plural"] == crd.PLURAL
+    assert names["kind"] == crd.KIND
+    versions = [v["name"] for v in doc["spec"]["versions"]]
+    assert crd.VERSION in versions
+    served = next(v for v in doc["spec"]["versions"]
+                  if v["name"] == crd.VERSION)
+    assert served.get("subresources", {}).get("status") is not None, (
+        "client PUTs /status; the CRD must declare the subresource"
+    )
+
+
+def test_agent_image_entrypoint_module_exists():
+    import importlib
+
+    assert importlib.import_module("elastic_tpu_agent.cli").main
