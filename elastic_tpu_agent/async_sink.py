@@ -34,6 +34,7 @@ class AsyncSink:
         self._queue: "queue.Queue" = queue.Queue()
         self._failures = 0
         self._disabled = False
+        self._stopping = False
         self._pending = 0
         self._cond = threading.Condition()
         self._thread = threading.Thread(
@@ -47,11 +48,15 @@ class AsyncSink:
 
     def submit(self, op) -> None:
         """Enqueue a thunk; non-blocking, never raises."""
-        if self._disabled:
+        if self._disabled or self._stopping:
             return
         with self._cond:
+            if self._stopping:
+                return
             self._pending += 1
-        self._queue.put(op)
+            # put() under the lock (unbounded queue, never blocks): a put
+            # outside it could land after stop()'s drain and strand _pending.
+            self._queue.put(op)
 
     def flush(self, timeout: float = 10.0) -> bool:
         """Block until queued work has drained (tests / shutdown)."""
@@ -65,9 +70,20 @@ class AsyncSink:
         return True
 
     def stop(self, timeout: float = 5.0) -> None:
+        # Refuse new work before flushing so a submit() racing with stop()
+        # cannot land behind the _STOP sentinel and strand _pending > 0.
+        with self._cond:
+            self._stopping = True
         self.flush(timeout=timeout)
         self._queue.put(_STOP)
         self._thread.join(timeout=timeout)
+        if self._thread.is_alive():
+            # Worker is wedged on a slow op; it is a daemon thread and dies
+            # with the process. (No queue drain is needed: submit() enqueues
+            # under the lock after re-checking _stopping, so nothing can land
+            # behind the _STOP sentinel.)
+            logger.warning("%s worker did not stop within %.1fs", self._name,
+                           timeout)
 
     def _worker(self) -> None:
         while True:

@@ -44,7 +44,9 @@ AnnotationSliceWorkerHosts = "elasticgpu.io/tpu-slice-hosts"
 # both; see native/elastic_tpu_hook.cc).
 EnvAllocationHash = "TPU"
 EnvAllocationHashCompat = "GPU"
-# Visibility env consumed by libtpu/JAX inside the container.
+# Visibility env consumed by libtpu/JAX inside the container. Both spellings
+# are emitted everywhere (alloc env, spec files, native toolkit): older
+# libtpu releases read TPU_VISIBLE_DEVICES, newer ones TPU_VISIBLE_CHIPS.
 EnvTPUVisibleChips = "TPU_VISIBLE_CHIPS"
 EnvTPUVisibleDevices = "TPU_VISIBLE_DEVICES"
 

@@ -52,9 +52,19 @@ class EventRecorder:
         self._client = kube_client
         self._node = node_name
         self._sink = AsyncSink("event-recorder")
-        # key -> (last_emit_monotonic, suppressed_since_then)
-        self._recent: Dict[Tuple, Tuple[float, int]] = {}
+        # key -> (last_emit_monotonic, suppressed_since_then, emit_ctx)
+        # where emit_ctx = (namespace, base, involved, reason, message, type_)
+        # is kept so suppressed tails can be surfaced after the window.
+        self._recent: Dict[Tuple, Tuple[float, int, Tuple]] = {}
         self._recent_lock = threading.Lock()
+        self._stopped = threading.Event()
+        # Without this sweeper, occurrences folded inside the window would
+        # only surface on the NEXT post-window emission for the same key —
+        # a storm that stops would lose its tail counts forever.
+        self._sweeper = threading.Thread(
+            target=self._sweep_loop, daemon=True, name="event-residuals"
+        )
+        self._sweeper.start()
 
     @property
     def disabled(self) -> bool:
@@ -64,7 +74,32 @@ class EventRecorder:
         return self._sink.flush(timeout=timeout)
 
     def stop(self, timeout: float = 5.0) -> None:
+        self._stopped.set()
+        self.flush_residuals(force=True)
         self._sink.stop(timeout=timeout)
+
+    def _sweep_loop(self) -> None:
+        while not self._stopped.wait(AGGREGATION_WINDOW_S):
+            try:
+                self.flush_residuals()
+            except Exception:  # noqa: BLE001 - observability must not wedge
+                logger.exception("residual event sweep failed")
+
+    def flush_residuals(self, force: bool = False) -> None:
+        """Publish counts folded during aggregation windows that have since
+        lapsed (or all pending counts when ``force``), so storm tails are
+        surfaced even if the storm stopped before the next emission."""
+        now = time.monotonic()
+        due = []
+        with self._recent_lock:
+            for key, (last, suppressed, ctx) in list(self._recent.items()):
+                if suppressed <= 0:
+                    continue
+                if force or now - last >= AGGREGATION_WINDOW_S:
+                    due.append((suppressed, ctx))
+                    self._recent[key] = (last, 0, ctx)
+        for count, ctx in due:
+            self._post(*ctx, count=count)
 
     # -- emitters -------------------------------------------------------------
 
@@ -93,7 +128,7 @@ class EventRecorder:
         involved = {"kind": "Node", "apiVersion": "v1", "name": self._node}
         self._emit("default", self._node, involved, reason, message, type_)
 
-    def _should_emit(self, key: Tuple) -> int:
+    def _should_emit(self, key: Tuple, ctx: Tuple) -> int:
         """0 = suppress (inside the aggregation window); otherwise the
         count to publish (1 + occurrences folded since the last emit)."""
         now = time.monotonic()
@@ -113,23 +148,31 @@ class EventRecorder:
                         self._recent.items(), key=lambda kv: -kv[1][0]
                     )[:_MAX_TRACKED_KEYS]
                     self._recent = dict(keep)
-            last, suppressed = self._recent.get(key, (0.0, 0))
+            last, suppressed, _ = self._recent.get(key, (0.0, 0, ()))
             if last and now - last < AGGREGATION_WINDOW_S:
-                self._recent[key] = (last, suppressed + 1)
+                self._recent[key] = (last, suppressed + 1, ctx)
                 return 0
-            self._recent[key] = (now, 0)
+            self._recent[key] = (now, 0, ctx)
             return 1 + suppressed
 
     def _emit(
         self, namespace: str, base: str, involved: dict,
         reason: str, message: str, type_: str,
     ) -> None:
+        ctx = (namespace, base, involved, reason, message, type_)
         count = self._should_emit(
             (namespace, involved.get("kind"), involved.get("name"),
-             reason, message)
+             reason, message),
+            ctx,
         )
         if count == 0:
             return
+        self._post(*ctx, count=count)
+
+    def _post(
+        self, namespace: str, base: str, involved: dict,
+        reason: str, message: str, type_: str, count: int,
+    ) -> None:
         now = datetime.now(timezone.utc).strftime("%Y-%m-%dT%H:%M:%SZ")
         body = {
             "apiVersion": "v1",

@@ -48,6 +48,7 @@ from ..common import (
     BytesPerMemoryUnit,
     EnvAllocationHash,
     EnvTPUVisibleChips,
+    EnvTPUVisibleDevices,
     ResourceTPUCore,
     ResourceTPUMemory,
     TPUPercentEachChip,
@@ -478,10 +479,10 @@ class _TPUSharePluginBase(_ListAndWatchMixin, rpc.DevicePluginServicer):
         annotations: Dict,
         pod: Optional[dict] = None,
     ) -> Dict:
+        visible = ",".join(str(p) for p in range(len(chip_indexes)))
         env = {
-            EnvTPUVisibleChips: ",".join(
-                str(p) for p in range(len(chip_indexes))
-            ),
+            EnvTPUVisibleChips: visible,
+            EnvTPUVisibleDevices: visible,
         }
         env.update(qos_env(annotations, pod_spec=pod, **self._qos_kwargs(device)))
         topo, worker_id, hostnames = self._host_slice_facts()
@@ -548,7 +549,9 @@ class TPUShareCorePlugin(_TPUSharePluginBase):
 
     def _alloc_envs(self, device: Device, n_chips: int) -> Dict[str, str]:
         envs = super()._alloc_envs(device, n_chips)
-        envs[EnvTPUVisibleChips] = ",".join(str(p) for p in range(n_chips))
+        visible = ",".join(str(p) for p in range(n_chips))
+        envs[EnvTPUVisibleChips] = visible
+        envs[EnvTPUVisibleDevices] = visible
         return envs
 
     def _alloc_device_specs(self, device: Device, n_chips: int) -> List[dp.DeviceSpec]:

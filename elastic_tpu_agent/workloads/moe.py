@@ -97,14 +97,16 @@ def moe_mlp(
     aux_loss = n_experts * jnp.sum(density * density_prob)
 
     # -- capacity assignment (static C; overflow drops) --
-    position = jnp.cumsum(expert_mask, axis=0) * expert_mask      # [T, E] 1-idx
+    # Slot bookkeeping runs in int32: a float32 cumsum loses exactness once
+    # token counts approach 2^24, silently colliding slots at huge b*s.
+    imask = expert_mask.astype(jnp.int32)
+    position = jnp.cumsum(imask, axis=0) * imask                  # [T, E] 1-idx
     within = position <= cap
-    expert_mask = expert_mask * within
+    imask = imask * within
+    expert_mask = imask.astype(jnp.float32)
     gate = jnp.sum(probs * expert_mask, axis=-1)                  # [T]
-    slot = jnp.sum((position - 1.0) * expert_mask, axis=-1)       # [T] 0-idx
-    slot_hot = jax.nn.one_hot(
-        slot.astype(jnp.int32), cap, dtype=jnp.float32
-    )                                                             # [T, C]
+    slot = jnp.sum((position - 1) * imask, axis=-1)               # [T] 0-idx
+    slot_hot = jax.nn.one_hot(slot, cap, dtype=jnp.float32)       # [T, C]
     dispatch = (expert_mask[:, :, None] * slot_hot[:, None, :])   # [T, E, C]
     combine = (dispatch * gate[:, None, None]).astype(dtype)
     dispatch = dispatch.astype(dtype)

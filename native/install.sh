@@ -18,7 +18,7 @@ install -m 0755 "$SRC_DIR/mount_elastic_tpu" \
 
 # OCI hooks dir consumed by CRI-O / podman directly; for containerd+runc,
 # reference this json from the runtime handler or use an NRI/base-spec that
-# includes it (see deploy/README).
+# includes it (see docs/operations.md, "containerd / GKE activation").
 HOOK_DIR="$HOST_ROOT/usr/share/containers/oci/hooks.d"
 mkdir -p "$HOOK_DIR"
 cat > "$HOOK_DIR/10-elastic-tpu.json" <<'EOF'

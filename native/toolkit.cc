@@ -161,6 +161,8 @@ AllocSpec SpecFromDevScan(const std::string& dev_dir, const std::string& hash) {
       visible += std::to_string(p);
     }
     spec.env.emplace_back("TPU_VISIBLE_CHIPS", visible);
+    // Older libtpu releases read the DEVICES spelling; emit both.
+    spec.env.emplace_back("TPU_VISIBLE_DEVICES", visible);
     spec.valid = true;
   }
   return spec;

@@ -116,6 +116,7 @@ def test_config1_exclusive_allocation_lifecycle(cluster):
     dev_hash = Device(ids, ResourceTPUCore).hash
     assert env["TPU"] == dev_hash
     assert env["TPU_VISIBLE_CHIPS"] == "0"
+    assert env["TPU_VISIBLE_DEVICES"] == "0"
     # the virtual node exists and resolves to the annotated chip
     link = os.path.join(cluster.opts.dev_root, f"elastic-tpu-{dev_hash}-0")
     assert os.readlink(link) == "/dev/accel1"

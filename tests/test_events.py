@@ -183,16 +183,40 @@ def test_identical_events_aggregate_within_window():
 
     # Force the window to lapse; the next emit reports the folded count.
     with rec._recent_lock:
-        key, (last, suppressed) = next(iter(rec._recent.items()))
+        key, (last, suppressed, ctx) = next(iter(rec._recent.items()))
         assert suppressed == 4
         rec._recent[key] = (last - events_mod.AGGREGATION_WINDOW_S - 1,
-                            suppressed)
+                            suppressed, ctx)
     rec.pod_event("default", "looper", "TPUBindFailed", "same failure",
                   type_="Warning")
     assert rec.flush()
     assert len(client.events) == 2
     assert client.events[1]["count"] == 5
     rec.stop()
+
+
+def test_suppressed_tail_flushed_when_storm_stops():
+    """If a storm ends before the window lapses, the folded tail count must
+    still surface — via the residual sweep (or stop()), not only on the next
+    same-key emission (which may never come)."""
+    client = _CountingClient()
+    rec = EventRecorder(client, "node-a")
+    for _ in range(5):
+        rec.pod_event("default", "looper", "TPUBindFailed", "same failure",
+                      type_="Warning")
+    assert rec.flush()
+    assert len(client.events) == 1
+
+    # Window still open: residual sweep leaves the fold pending.
+    rec.flush_residuals()
+    assert rec.flush()
+    assert len(client.events) == 1
+
+    # stop() force-flushes the tail: 4 suppressed occurrences surface.
+    rec.stop()
+    assert len(client.events) == 2
+    assert client.events[1]["count"] == 4
+    assert client.events[1]["reason"] == "TPUBindFailed"
 
 
 def test_distinct_events_not_aggregated():

@@ -135,6 +135,7 @@ def test_slice_pods_get_consistent_topology_env(slice_hosts):
     for s in specs:
         assert s["chip_indexes"] == [0, 1, 2, 3]
         assert s["env"]["TPU_VISIBLE_CHIPS"] == "0,1,2,3"
+        assert s["env"]["TPU_VISIBLE_DEVICES"] == "0,1,2,3"
 
 
 def test_annotation_override_renumbers_slice(slice_hosts):

@@ -204,6 +204,8 @@ def test_devscan_fallback_injects_real_chardev(tmp_path):
     assert stat.S_ISCHR(st.st_mode)
     env_file = (rootfs / "run" / "elastic-tpu" / "env").read_text()
     assert "TPU_VISIBLE_CHIPS=0" in env_file
+    # dev-scan fallback generates the compat spelling too (older libtpu)
+    assert "TPU_VISIBLE_DEVICES=0" in env_file
 
 
 # -- libtpu install -----------------------------------------------------------

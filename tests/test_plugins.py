@@ -138,6 +138,7 @@ def test_allocate_fractional_core(harness):
     dev_hash = Device(ids, ResourceTPUCore).hash
     assert c.envs["TPU"] == dev_hash
     assert c.envs["TPU_VISIBLE_CHIPS"] == "0"
+    assert c.envs["TPU_VISIBLE_DEVICES"] == "0"
     assert c.envs["ELASTIC_TPU_CORE_UNITS"] == "50"
     assert len(c.devices) == 1
     assert c.devices[0].host_path == f"/dev/elastic-tpu-{dev_hash}-0"
@@ -156,6 +157,7 @@ def test_allocate_150_core_exposes_two_chips(harness):
     c = resp.container_responses[0]
     assert len(c.devices) == 2
     assert c.envs["TPU_VISIBLE_CHIPS"] == "0,1"
+    assert c.envs["TPU_VISIBLE_DEVICES"] == "0,1"
 
 
 def test_allocate_memory_sets_hbm_limit(harness):
@@ -193,6 +195,7 @@ def test_prestart_binds_and_persists(harness):
     assert spec["chip_indexes"] == [2]
     assert spec["device_paths"] == ["/dev/accel2"]
     assert spec["env"]["TPU_VISIBLE_CHIPS"] == "0"
+    assert spec["env"]["TPU_VISIBLE_DEVICES"] == "0"
     assert spec["container"] == "jax"
 
 
