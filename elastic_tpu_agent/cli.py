@@ -36,7 +36,8 @@ def parse_args(argv=None) -> argparse.Namespace:
     p.add_argument(
         "--operator",
         default="tpuvm",
-        help="device operator: tpuvm | stub | stub:<accel-type>",
+        help="device operator: tpuvm | stub | stub:<accel-type> | "
+             "exclusive | exclusive:<inner> (whole-chip, no virtual nodes)",
     )
     p.add_argument("--dev-root", default="/host/dev", help="host /dev mount")
     p.add_argument(

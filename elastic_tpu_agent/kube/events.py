@@ -75,6 +75,10 @@ class EventRecorder:
 
     def stop(self, timeout: float = 5.0) -> None:
         self._stopped.set()
+        # Join the sweeper BEFORE the force flush: a sweep that already
+        # zeroed a suppressed count under the lock but hasn't posted it yet
+        # would otherwise race the sink shutdown and drop the tail silently.
+        self._sweeper.join(timeout=timeout)
         self.flush_residuals(force=True)
         self._sink.stop(timeout=timeout)
 

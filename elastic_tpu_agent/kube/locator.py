@@ -17,6 +17,7 @@ single List serves all misses in one PreStart burst.
 
 from __future__ import annotations
 
+import itertools
 import logging
 import threading
 import time
@@ -27,6 +28,13 @@ from ..rpc import PodResourcesClient
 from ..types import Device, PodContainer, device_hash
 
 logger = logging.getLogger(__name__)
+
+# The cache is replaced wholesale on every List, so its size tracks live
+# node pods (kubelet caps out at a few hundred). The cap is a backstop
+# against a pathological pod-resources response (e.g. a buggy kubelet
+# echoing stale pods into the 16MiB List): evicted entries just fall back
+# to an inline refresh at locate() time.
+_MAX_CACHE_ENTRIES = 4096
 
 
 class LocateError(Exception):
@@ -75,10 +83,21 @@ class KubeletDeviceLocator(DeviceLocator):
                     fresh[device_hash(ids)] = PodContainer(
                         pod.namespace, pod.name, container.name
                     )
+        install = fresh
+        if len(fresh) > _MAX_CACHE_ENTRIES:
+            logger.warning(
+                "pod-resources List yielded %d device sets; capping cache "
+                "at %d", len(fresh), _MAX_CACHE_ENTRIES,
+            )
+            # cap only the shared cache; the caller still consults the full
+            # snapshot, so evicted sets resolve on their inline refresh
+            install = dict(
+                itertools.islice(fresh.items(), _MAX_CACHE_ENTRIES)
+            )
         with self._lock:
             if seq > self._installed_seq:
                 self._installed_seq = seq
-                self._cache = fresh
+                self._cache = install
         return fresh
 
     def locate(self, device: Device) -> PodContainer:

@@ -41,7 +41,7 @@ class ManagerOptions:
     db_path: str = "/host/var/lib/elastic-tpu/meta.db"
     kubeconfig: str = ""
     plugin_kind: str = "tpushare"
-    operator_kind: str = "tpuvm"  # tpuvm | stub | stub:<type>
+    operator_kind: str = "tpuvm"  # tpuvm | stub[:<type>] | exclusive[:<inner>]
     dev_root: str = "/host/dev"
     device_plugin_dir: str = rpc.DEVICE_PLUGIN_DIR
     pod_resources_socket: str = rpc.POD_RESOURCES_SOCKET
@@ -65,6 +65,20 @@ def build_operator(opts: ManagerOptions):
     if opts.operator is not None:
         return opts.operator
     kind = opts.operator_kind
+    if kind == "exclusive" or kind.startswith("exclusive:"):
+        # Whole-chip mode (reference: pkg/operator/nvidia.go no-op
+        # passthrough): discovery comes from the wrapped operator, but no
+        # virtual nodes are created — device specs hand out the physical
+        # /dev/accel* paths directly. `exclusive:<inner>` selects the
+        # discovery source, default tpuvm.
+        from dataclasses import replace
+
+        from .tpu.exclusive import ExclusiveOperator
+
+        inner_kind = kind.partition(":")[2] or "tpuvm"
+        return ExclusiveOperator(
+            build_operator(replace(opts, operator_kind=inner_kind))
+        )
     if kind == "tpuvm":
         return TPUVMOperator(opts.dev_root)
     if kind.startswith("stub"):

@@ -108,8 +108,10 @@ def _pick_chip_set(
     Picks the minimal number of chips whose free units cover ``need``, and
     among minimal sets the one with the smallest total pairwise ICI hop
     distance over the chosen chips *plus* any ``pinned`` chips the request's
-    must-include ids already sit on (then most free capacity). Hosts cap at
-    8 chips, so exhaustive subset search is exact and cheap (<= C(8,k)).
+    must-include ids already sit on (then most free capacity). Up to 8
+    candidate chips the subset search is exhaustive and exact (<= C(8,k));
+    beyond that (future larger hosts) a greedy nearest-chip build keeps the
+    cost O(n^2 * k) at the price of exactness.
     """
     pinned = pinned or set()
     free = sorted(by_chip.items(), key=lambda kv: (-len(kv[1]), kv[0]))
@@ -129,6 +131,8 @@ def _pick_chip_set(
     grid = chip_grid(
         max(chips_per_host, max(by_chip) + 1, max(pinned, default=0) + 1)
     )
+    if len(by_chip) > _EXACT_PACK_MAX_CHIPS:
+        return _greedy_chip_set(by_chip, need, grid, pinned)
     best: Optional[tuple] = None
     for combo in itertools.combinations(sorted(by_chip), k):
         cap = sum(len(by_chip[c]) for c in combo)
@@ -144,6 +148,38 @@ def _pick_chip_set(
             best = key
     chosen = best[2] if best else tuple(c for c, _ in free[:k])
     return sorted(chosen, key=lambda c: (-len(by_chip[c]), c))
+
+
+# Exhaustive ICI-span packing is exact up to this many candidate chips;
+# current TPU-VM hosts top out at 8 (v4/v5p host = 4 chips, v5e host = 8).
+_EXACT_PACK_MAX_CHIPS = 8
+
+
+def _greedy_chip_set(
+    by_chip: Dict[int, List[str]],
+    need: int,
+    grid: Dict[int, tuple],
+    pinned: set,
+) -> List[int]:
+    """Greedy fallback for hosts with more chips than the exact search
+    handles: seed with the pinned chips (else the fullest chip), then
+    repeatedly add the chip minimizing added ICI span (ties: most free
+    units) until the chosen set covers ``need``."""
+    chosen: List[int] = []
+    anchor = set(pinned)
+    remaining = dict(by_chip)
+    covered = 0
+    while covered < need and remaining:
+        best_key, best_chip = None, None
+        for c, ids in remaining.items():
+            span = sum(ici_distance(grid[c], grid[a]) for a in anchor)
+            key = (span, -len(ids), c)
+            if best_key is None or key < best_key:
+                best_key, best_chip = key, c
+        chosen.append(best_chip)
+        anchor.add(best_chip)
+        covered += len(remaining.pop(best_chip))
+    return chosen
 
 
 def _parse_chip_annotation(value: str) -> List[int]:
@@ -309,10 +345,17 @@ class _TPUSharePluginBase(_ListAndWatchMixin, rpc.DevicePluginServicer):
             chosen = list(creq.must_include_deviceIDs)
             if need > 0:
                 by_chip: Dict[int, List[str]] = {}
+                unparseable: List[str] = []
                 for did in creq.available_deviceIDs:
                     if did in chosen:
                         continue
-                    by_chip.setdefault(chip_of_device_id(did) or 0, []).append(did)
+                    chip = chip_of_device_id(did)
+                    if chip is None:
+                        # Don't bucket junk onto chip 0 — that would skew
+                        # packing toward it. Kept as last-resort filler only.
+                        unparseable.append(did)
+                        continue
+                    by_chip.setdefault(chip, []).append(did)
                 pinned = {
                     c for c in (
                         chip_of_device_id(did)
@@ -327,6 +370,8 @@ class _TPUSharePluginBase(_ListAndWatchMixin, rpc.DevicePluginServicer):
                     need -= len(take)
                     if need <= 0:
                         break
+                if need > 0 and unparseable:
+                    chosen.extend(unparseable[:need])
             responses.append(
                 dp.ContainerPreferredAllocationResponse(deviceIDs=chosen)
             )
@@ -378,8 +423,28 @@ class _TPUSharePluginBase(_ListAndWatchMixin, rpc.DevicePluginServicer):
                 )
             raise
 
+    def _chips_from_ids(self, device: Device) -> List[int]:
+        """Chip indexes encoded in the fake device ids themselves — the
+        authoritative source in whole-chip (exclusive) mode, where no
+        scheduler annotation redirects the placement."""
+        return sorted({
+            c for c in (chip_of_device_id(i) for i in device.ids)
+            if c is not None
+        })
+
     def _bind_located(self, device: Device, owner, pod: dict) -> None:
         annotations = pod.get("metadata", {}).get("annotations", {}) or {}
+        if not getattr(self._operator, "virtual_nodes", True):
+            # Whole-chip mode (reference: the nvidia no-op operator,
+            # pkg/operator/nvidia.go): kubelet's device choice IS the
+            # placement; no elastic-scheduler annotation is required and no
+            # virtual nodes exist — Allocate already handed out the
+            # physical /dev/accel* paths.
+            self._finish_bind(
+                device, owner, pod, annotations,
+                self._chips_from_ids(device), created=[],
+            )
+            return
         if annotations.get(AnnotationAssumed) != "true":
             raise LocateError(
                 f"pod {owner.pod_key} not assumed by the elastic scheduler"
@@ -416,13 +481,37 @@ class _TPUSharePluginBase(_ListAndWatchMixin, rpc.DevicePluginServicer):
                 link_id = f"{device.hash}-{p}"
                 self._operator.create(idx, link_id)
                 created.append(link_id)
+        except Exception:
+            self._rollback_created(created)
+            raise
+        self._finish_bind(device, owner, pod, annotations, chip_indexes, created)
+
+    def _rollback_created(self, created: List[str]) -> None:
+        for link_id in created:
+            try:
+                self._operator.delete(link_id)
+            except Exception:  # noqa: BLE001
+                logger.warning("rollback: failed deleting %s", link_id)
+
+    def _finish_bind(
+        self,
+        device: Device,
+        owner,
+        pod: dict,
+        annotations: Dict,
+        chip_indexes: List[int],
+        created: List[str],
+    ) -> None:
+        unknown = [i for i in chip_indexes if i not in self._chips]
+        if unknown:
+            self._rollback_created(created)
+            raise LocateError(
+                f"chips {unknown} not present on this host"
+            )
+        try:
             self._write_alloc_spec(device, owner, chip_indexes, annotations, pod)
         except Exception:
-            for link_id in created:
-                try:
-                    self._operator.delete(link_id)
-                except Exception:  # noqa: BLE001
-                    logger.warning("rollback: failed deleting %s", link_id)
+            self._rollback_created(created)
             raise
 
         record = AllocationRecord(
@@ -549,12 +638,35 @@ class TPUShareCorePlugin(_TPUSharePluginBase):
 
     def _alloc_envs(self, device: Device, n_chips: int) -> Dict[str, str]:
         envs = super()._alloc_envs(device, n_chips)
+        if not getattr(self._operator, "virtual_nodes", True):
+            # Whole-chip mode: the env must match the device specs, which
+            # come from the id-encoded chips — not from ceil(units/100)
+            # (kubelet may have split the ids across more chips than the
+            # minimum packing, e.g. when preferred allocation was skipped).
+            n_chips = len(
+                [c for c in self._chips_from_ids(device) if c in self._chips]
+            )
         visible = ",".join(str(p) for p in range(n_chips))
         envs[EnvTPUVisibleChips] = visible
         envs[EnvTPUVisibleDevices] = visible
         return envs
 
     def _alloc_device_specs(self, device: Device, n_chips: int) -> List[dp.DeviceSpec]:
+        if not getattr(self._operator, "virtual_nodes", True):
+            # Whole-chip mode: the fake ids already name physical chips and
+            # no symlink will be made at PreStart — hand out the real
+            # chardev paths, densely renumbered in-container.
+            known = [
+                c for c in self._chips_from_ids(device) if c in self._chips
+            ]
+            return [
+                dp.DeviceSpec(
+                    container_path=f"/dev/accel{p}",
+                    host_path=self._chips[c].device_path,
+                    permissions="rwm",
+                )
+                for p, c in enumerate(known)
+            ]
         # Virtual link -> dense in-container /dev/accel<p>. The runtime
         # resolves the symlink at container create (after PreStart made it).
         return [

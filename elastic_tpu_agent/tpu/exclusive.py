@@ -14,11 +14,19 @@ from .operator import TPUOperator, TPUChip
 
 
 class ExclusiveOperator(TPUOperator):
+    virtual_nodes = False
+
     def __init__(self, inner: TPUOperator) -> None:
         self._inner = inner
 
     def devices(self) -> List[TPUChip]:
         return self._inner.devices()
+
+    def __getattr__(self, name):
+        # Forward discovery-adjacent surface (topology, worker_id,
+        # worker_hostnames, healthy_indexes, fault-injection seams) so
+        # wrapping costs no capability; only create/delete/check are muted.
+        return getattr(self._inner, name)
 
     def create(self, index: int, link_id: str) -> None:  # noqa: ARG002
         return None

@@ -50,6 +50,11 @@ class TPUChip:
 class TPUOperator(ABC):
     """Physical device layer: discovery + virtual node lifecycle."""
 
+    # Whether this operator materializes per-allocation virtual nodes
+    # (/dev/elastic-tpu-<hash>-N). Whole-chip operators set this False and
+    # the plugin hands out physical /dev/accel* paths at Allocate instead.
+    virtual_nodes: bool = True
+
     @abstractmethod
     def devices(self) -> List[TPUChip]:
         """Enumerate this host's chips (reference: Devices(), base.go:19-45)."""

@@ -46,7 +46,7 @@ def wait_until(fn, timeout=10.0):
 class Cluster:
     """One fully-wired agent instance with fake control plane around it."""
 
-    def __init__(self, tmp_path, node="node-a"):
+    def __init__(self, tmp_path, node="node-a", operator_kind="stub:v5litepod-4"):
         self.node = node
         self.apiserver = FakeAPIServer()
         url = self.apiserver.start()
@@ -58,7 +58,7 @@ class Cluster:
         self.opts = ManagerOptions(
             node_name=node,
             db_path=str(tmp_path / "meta.db"),
-            operator_kind="stub:v5litepod-4",
+            operator_kind=operator_kind,
             dev_root=self._mkdir("dev"),
             device_plugin_dir=str(tmp_path / "dp"),
             pod_resources_socket=str(tmp_path / "pr" / "kubelet.sock"),
@@ -251,3 +251,80 @@ def test_restart_reclaims_dead_pods(tmp_path):
     mgr2.stop()
     c.kubelet.stop()
     c.apiserver.stop()
+
+
+def test_whole_chip_exclusive_operator(tmp_path):
+    """--operator exclusive: whole-chip mode needs no elastic scheduler and
+    no virtual nodes — Allocate hands out the physical /dev/accel* paths
+    the fake ids name, PreStart binds from the ids alone (no annotations),
+    and GC still reclaims state on pod delete."""
+    c = Cluster(tmp_path, operator_kind="exclusive:stub:v5litepod-4")
+    c.start()
+    try:
+        # plain pod: no elasticgpu.io/assumed, no container annotation
+        c.apiserver.upsert_pod(
+            make_pod("default", "whole", c.node, annotations={},
+                     containers=[{"name": "jax"}])
+        )
+        assert wait_until(
+            lambda: c.manager.sitter.get_pod("default", "whole") is not None
+        )
+        ids = [core_device_id(1, u) for u in range(100)]
+        resp = c.kubelet.kubelet_allocate_flow(
+            CORE_ENDPOINT, "default", "whole", "jax", ResourceTPUCore, ids
+        )
+        cresp = resp.container_responses[0]
+        # physical path, not a virtual link
+        assert [d.host_path for d in cresp.devices] == ["/dev/accel1"]
+        assert cresp.devices[0].container_path == "/dev/accel0"
+        assert cresp.envs["TPU_VISIBLE_CHIPS"] == "0"
+        # no symlinks were materialized
+        assert c.manager.operator.list_links() == []
+        # binding recorded with the id-derived chip
+        info = c.manager.storage.load("default", "whole")
+        rec = info.allocations["jax"][ResourceTPUCore]
+        assert rec.chip_indexes == [1]
+        # alloc spec for the hook carries the physical path
+        dev_hash = Device(ids, ResourceTPUCore).hash
+        with open(os.path.join(str(c.tmp / "alloc"), f"{dev_hash}.json")) as f:
+            spec = json.load(f)
+        assert spec["device_paths"] == ["/dev/accel1"]
+        # GC on delete
+        c.apiserver.delete_pod("default", "whole")
+        c.kubelet.unassign_pod("default", "whole")
+        assert wait_until(
+            lambda: c.manager.storage.load("default", "whole") is None,
+            timeout=30.0,
+        )
+    finally:
+        c.stop()
+
+
+def test_whole_chip_split_allocation_env_matches_devices(tmp_path):
+    """Exclusive mode with kubelet splitting ids across chips (preferred
+    allocation is only a hint): the visibility env must match the devices
+    actually injected, not the minimum chip packing."""
+    c = Cluster(tmp_path, operator_kind="exclusive:stub:v5litepod-4")
+    c.start()
+    try:
+        c.apiserver.upsert_pod(
+            make_pod("default", "split", c.node, annotations={},
+                     containers=[{"name": "jax"}])
+        )
+        assert wait_until(
+            lambda: c.manager.sitter.get_pod("default", "split") is not None
+        )
+        ids = [core_device_id(0, u) for u in range(50)] + [
+            core_device_id(1, u) for u in range(50)
+        ]
+        resp = c.kubelet.kubelet_allocate_flow(
+            CORE_ENDPOINT, "default", "split", "jax", ResourceTPUCore, ids
+        )
+        cresp = resp.container_responses[0]
+        assert [d.host_path for d in cresp.devices] == [
+            "/dev/accel0", "/dev/accel1"
+        ]
+        assert cresp.envs["TPU_VISIBLE_CHIPS"] == "0,1"
+        assert cresp.envs["TPU_VISIBLE_DEVICES"] == "0,1"
+    finally:
+        c.stop()

