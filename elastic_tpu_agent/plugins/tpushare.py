@@ -40,7 +40,7 @@ import os
 import queue
 import threading
 import time
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 from .. import rpc
 from ..common import (
@@ -79,6 +79,54 @@ MEM_ENDPOINT = "elastic-tpushare-mem.sock"
 DEFAULT_ALLOC_SPEC_DIR = "/host/var/lib/elastic-tpu/alloc"
 
 GC_PERIOD_S = 60.0  # reference: base.go:248
+
+# Serializes alloc-spec writes across the core and memory plugin servers
+# (both live in the one agent process) so concurrent PreStarts for the same
+# container can't interleave their sibling merges.
+_SPEC_MERGE_LOCK = threading.Lock()
+
+
+def _write_json_atomic(path: str, payload: Dict) -> None:
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(payload, f)
+    os.replace(tmp, path)
+
+
+def _merge_spec_payloads(a: Dict, b: Dict) -> Tuple[Dict, Dict]:
+    """Union two alloc-spec payloads for the same container; returns both
+    with identical devices/env (each keeps its own hash + resource).
+
+    Env conflicts resolve deterministically: the tpu-core payload's values
+    win (resource-specific keys — core units vs HBM quota — never collide;
+    shared keys like TPU_VISIBLE_* agree anyway since both plugins read the
+    same scheduler annotation)."""
+    chip_to_path: Dict[int, str] = {}
+    for p in (a, b):
+        for c, d in zip(p.get("chip_indexes", []), p.get("device_paths", [])):
+            chip_to_path[c] = d
+    chips = sorted(chip_to_path)
+    env: Dict[str, str] = {}
+    # core last -> core wins ties
+    first, second = (
+        (b, a) if a.get("resource") == ResourceTPUCore else (a, b)
+    )
+    env.update(first.get("env", {}))
+    env.update(second.get("env", {}))
+    resources = sorted(
+        {a.get("resource", ""), b.get("resource", "")}
+        | set(a.get("resources", []))
+        | set(b.get("resources", []))
+    )
+    out = []
+    for p in (a, b):
+        m = dict(p)
+        m["chip_indexes"] = chips
+        m["device_paths"] = [chip_to_path[c] for c in chips]
+        m["env"] = env
+        m["resources"] = resources
+        out.append(m)
+    return out[0], out[1]
 
 
 def core_device_id(chip: int, unit: int) -> str:
@@ -440,9 +488,10 @@ class _TPUSharePluginBase(_ListAndWatchMixin, rpc.DevicePluginServicer):
             # placement; no elastic-scheduler annotation is required and no
             # virtual nodes exist — Allocate already handed out the
             # physical /dev/accel* paths.
+            chip_indexes = self._chips_from_ids(device)
+            self._require_known_chips(chip_indexes)
             self._finish_bind(
-                device, owner, pod, annotations,
-                self._chips_from_ids(device), created=[],
+                device, owner, pod, annotations, chip_indexes, created=[],
             )
             return
         if annotations.get(AnnotationAssumed) != "true":
@@ -467,11 +516,7 @@ class _TPUSharePluginBase(_ListAndWatchMixin, rpc.DevicePluginServicer):
                 "container device visibility relies on the OCI hook",
                 self.resource, device.hash, len(chip_indexes), expected,
             )
-        unknown = [i for i in chip_indexes if i not in self._chips]
-        if unknown:
-            raise LocateError(
-                f"annotated chips {unknown} not present on this host"
-            )
+        self._require_known_chips(chip_indexes)
 
         # Materialize virtual nodes; roll back on partial failure
         # (reference: gpushare.go:133-142).
@@ -493,6 +538,13 @@ class _TPUSharePluginBase(_ListAndWatchMixin, rpc.DevicePluginServicer):
             except Exception:  # noqa: BLE001
                 logger.warning("rollback: failed deleting %s", link_id)
 
+    def _require_known_chips(self, chip_indexes: List[int]) -> None:
+        unknown = [i for i in chip_indexes if i not in self._chips]
+        if unknown:
+            raise LocateError(
+                f"chips {unknown} not present on this host"
+            )
+
     def _finish_bind(
         self,
         device: Device,
@@ -502,26 +554,29 @@ class _TPUSharePluginBase(_ListAndWatchMixin, rpc.DevicePluginServicer):
         chip_indexes: List[int],
         created: List[str],
     ) -> None:
-        unknown = [i for i in chip_indexes if i not in self._chips]
-        if unknown:
-            self._rollback_created(created)
-            raise LocateError(
-                f"chips {unknown} not present on this host"
-            )
-        try:
-            self._write_alloc_spec(device, owner, chip_indexes, annotations, pod)
-        except Exception:
-            self._rollback_created(created)
-            raise
+        # One lock spans sibling discovery, the spec write, AND the storage
+        # save that publishes this allocation: a core/memory PreStart pair
+        # for the same container racing here could otherwise both miss the
+        # sibling (save not yet visible) and write unmerged specs — and the
+        # load_or_create/save below is a read-modify-write that would lose
+        # one record. Binds are rare; global lock contention is noise.
+        with _SPEC_MERGE_LOCK:
+            try:
+                self._write_alloc_spec(
+                    device, owner, chip_indexes, annotations, pod
+                )
+            except Exception:
+                self._rollback_created(created)
+                raise
 
-        record = AllocationRecord(
-            device=device,
-            chip_indexes=chip_indexes,
-            created_node_ids=created,
-        )
-        info = self._storage.load_or_create(owner.namespace, owner.name)
-        info.set_allocation(owner.container, record)
-        self._storage.save(info)
+            record = AllocationRecord(
+                device=device,
+                chip_indexes=chip_indexes,
+                created_node_ids=created,
+            )
+            info = self._storage.load_or_create(owner.namespace, owner.name)
+            info.set_allocation(owner.container, record)
+            self._storage.save(info)
         if self._metrics is not None:
             self._metrics.bound_allocations.set(
                 sum(1 for _ in self._storage.items())
@@ -591,6 +646,25 @@ class _TPUSharePluginBase(_ListAndWatchMixin, rpc.DevicePluginServicer):
             "env": env,
         }
 
+    def _sibling_specs(self, owner) -> List[Dict]:
+        """Alloc-spec payloads already written for the SAME container by the
+        other resource's plugin (a container normally requests both tpu-core
+        and tpu-memory)."""
+        info = self._storage.load(owner.namespace, owner.name)
+        if info is None:
+            return []
+        out = []
+        for resource, rec in info.allocations.get(owner.container, {}).items():
+            if resource == self.resource:
+                continue
+            path = os.path.join(self._alloc_dir, f"{rec.device.hash}.json")
+            try:
+                with open(path) as f:
+                    out.append(json.load(f))
+            except (OSError, ValueError):
+                continue
+        return out
+
     def _write_alloc_spec(
         self,
         device: Device,
@@ -599,15 +673,32 @@ class _TPUSharePluginBase(_ListAndWatchMixin, rpc.DevicePluginServicer):
         annotations: Dict,
         pod: Optional[dict] = None,
     ) -> None:
+        """Write the spec for the OCI hook — MERGED with any sibling
+        resource's spec for the same container.
+
+        A container requesting both tpu-core and tpu-memory receives two
+        Allocate responses, each carrying ``TPU=<its own hash>``; kubelet
+        merges container env in undefined order, so the hook resolves
+        whichever hash happened to win. The reference had the same defect
+        and injected only the winner's spec (gpushare.go:79-82/204-207:
+        both set ``GPU=``, losing the loser's env). Here every spec file
+        for a container carries the union (devices + env of both
+        resources), so the hook's injection is identical no matter which
+        hash survives the merge.
+        """
+        # Caller (_finish_bind) holds _SPEC_MERGE_LOCK across this write and
+        # the storage save that makes the allocation visible to siblings.
         os.makedirs(self._alloc_dir, exist_ok=True)
-        path = os.path.join(self._alloc_dir, f"{device.hash}.json")
-        tmp = path + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump(
-                self._spec_payload(device, owner, chip_indexes, annotations, pod),
-                f,
+        payload = self._spec_payload(device, owner, chip_indexes, annotations, pod)
+        for sib in self._sibling_specs(owner):
+            payload, merged_sib = _merge_spec_payloads(payload, sib)
+            _write_json_atomic(
+                os.path.join(self._alloc_dir, f"{merged_sib['hash']}.json"),
+                merged_sib,
             )
-        os.replace(tmp, path)
+        _write_json_atomic(
+            os.path.join(self._alloc_dir, f"{device.hash}.json"), payload
+        )
 
     def remove_alloc_spec(self, alloc_hash: str) -> None:
         try:

@@ -140,3 +140,150 @@ def test_agent_to_toolkit_to_runner(tmp_path):
         assert report["final_loss"] > 0
     finally:
         c.stop()
+
+
+def test_dual_resource_container_gets_devices_and_hbm_env(tmp_path):
+    """One container requesting BOTH tpu-core and tpu-memory: kubelet merges
+    the two Allocate env maps in undefined order, so the hook may resolve
+    either hash. Every spec file carries the union (reference defect
+    gpushare.go:79-82/204-207: only the winner's spec was injected), so
+    whichever wins, the container ends with the devices AND the HBM quota."""
+    from elastic_tpu_agent.common import ResourceTPUCore
+    from elastic_tpu_agent.plugins.tpushare import CORE_ENDPOINT, core_device_id
+
+    c = Cluster(tmp_path)
+    c.start()
+    try:
+        half_gib_units = 8 * 1024
+        c.apiserver.upsert_pod(
+            make_pod(
+                "ml", "dual", c.node,
+                annotations={
+                    AnnotationAssumed: "true",
+                    container_annotation("jax"): "1",
+                },
+                containers=[{"name": "jax"}],
+            )
+        )
+        assert wait_until(
+            lambda: c.manager.sitter.get_pod("ml", "dual") is not None
+        )
+        core_ids = [core_device_id(1, u) for u in range(50)]
+        mem_ids = [mem_device_id(1, u) for u in range(half_gib_units)]
+        c.kubelet.kubelet_allocate_flow(
+            CORE_ENDPOINT, "ml", "dual", "jax", ResourceTPUCore, core_ids
+        )
+        c.kubelet.kubelet_allocate_flow(
+            MEM_ENDPOINT, "ml", "dual", "jax", ResourceTPUMemory, mem_ids
+        )
+        core_hash = Device(core_ids, ResourceTPUCore).hash
+        mem_hash = Device(mem_ids, ResourceTPUMemory).hash
+        alloc_dir = str(c.tmp / "alloc")
+
+        # both spec files carry the union
+        for h in (core_hash, mem_hash):
+            spec = json.load(open(os.path.join(alloc_dir, f"{h}.json")))
+            assert spec["env"]["ELASTIC_TPU_CORE_UNITS"] == "50", h
+            assert spec["env"]["ELASTIC_TPU_HBM_LIMIT_BYTES"] == str(
+                8 * 1024**3
+            ), h
+            assert spec["device_paths"] == ["/dev/accel1"], h
+            assert spec["resources"] == sorted(
+                [ResourceTPUCore, ResourceTPUMemory]
+            ), h
+
+        # drive the native hook with EACH hash: identical injection
+        for n, h in enumerate((core_hash, mem_hash)):
+            spec_path = os.path.join(alloc_dir, f"{h}.json")
+            spec = json.load(open(spec_path))
+            spec["device_paths"] = ["/dev/null"]
+            json.dump(spec, open(spec_path, "w"))
+            bundle = tmp_path / f"bundle{n}"
+            rootfs = bundle / "rootfs"
+            (rootfs / "dev").mkdir(parents=True)
+            (bundle / "config.json").write_text(json.dumps({
+                "ociVersion": "1.0.2",
+                "process": {"env": [f"TPU={h}"]},
+                "root": {"path": "rootfs"},
+            }))
+            state = json.dumps({"ociVersion": "1.0.2", "id": f"c{n}",
+                                "pid": 1, "bundle": str(bundle)})
+            result = subprocess.run(
+                [HOOK], input=state.encode(),
+                env={**os.environ, "ELASTIC_TPU_TOOLKIT": TOOLKIT,
+                     "ELASTIC_TPU_ALLOC_DIR": alloc_dir},
+                capture_output=True, timeout=30,
+            )
+            assert result.returncode == 0, result.stderr.decode()
+            st = os.stat(rootfs / "dev" / "accel0")
+            assert stat.S_ISCHR(st.st_mode)
+            content = (rootfs / "run" / "elastic-tpu" / "env").read_text()
+            assert f"ELASTIC_TPU_HBM_LIMIT_BYTES={8 * 1024**3}" in content, h
+            assert "ELASTIC_TPU_CORE_UNITS=50" in content, h
+    finally:
+        c.stop()
+
+
+def test_dual_resource_concurrent_prestarts_still_merge(tmp_path):
+    """Core and memory PreStarts racing for the same container must not
+    miss each other's spec (the bind lock spans sibling discovery, spec
+    write, and the storage save that publishes the allocation)."""
+    import threading
+
+    from elastic_tpu_agent.common import ResourceTPUCore
+    from elastic_tpu_agent.plugins.tpushare import CORE_ENDPOINT, core_device_id
+
+    c = Cluster(tmp_path)
+    c.start()
+    try:
+        c.apiserver.upsert_pod(
+            make_pod(
+                "ml", "race", c.node,
+                annotations={
+                    AnnotationAssumed: "true",
+                    container_annotation("jax"): "0",
+                },
+                containers=[{"name": "jax"}],
+            )
+        )
+        assert wait_until(
+            lambda: c.manager.sitter.get_pod("ml", "race") is not None
+        )
+        core_ids = [core_device_id(0, u) for u in range(50)]
+        mem_ids = [mem_device_id(0, u) for u in range(1024)]
+        errs = []
+
+        def flow(endpoint, resource, ids):
+            try:
+                c.kubelet.kubelet_allocate_flow(
+                    endpoint, "ml", "race", "jax", resource, ids
+                )
+            except Exception as e:  # noqa: BLE001
+                errs.append(e)
+
+        t1 = threading.Thread(
+            target=flow, args=(CORE_ENDPOINT, ResourceTPUCore, core_ids)
+        )
+        t2 = threading.Thread(
+            target=flow, args=(MEM_ENDPOINT, ResourceTPUMemory, mem_ids)
+        )
+        t1.start(); t2.start(); t1.join(30); t2.join(30)
+        assert not errs, errs
+
+        alloc_dir = str(c.tmp / "alloc")
+        for dev in (Device(core_ids, ResourceTPUCore),
+                    Device(mem_ids, ResourceTPUMemory)):
+            spec = json.load(
+                open(os.path.join(alloc_dir, f"{dev.hash}.json"))
+            )
+            assert spec["env"]["ELASTIC_TPU_CORE_UNITS"] == "50"
+            assert spec["env"]["ELASTIC_TPU_HBM_LIMIT_BYTES"] == str(
+                1024 * 1024 * 1024
+            )
+        # both allocation records survived the racing read-modify-write
+        info = c.manager.storage.load("ml", "race")
+        assert set(info.allocations["jax"]) == {
+            ResourceTPUCore, ResourceTPUMemory
+        }
+    finally:
+        c.stop()
