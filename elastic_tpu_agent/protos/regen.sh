@@ -5,5 +5,7 @@
 # grpcio-tools is not required in the image.
 set -e
 cd "$(dirname "$0")"
-protoc --python_out=../gen deviceplugin.proto podresources.proto
-echo "generated: ../gen/deviceplugin_pb2.py ../gen/podresources_pb2.py"
+protoc --python_out=../gen deviceplugin.proto podresources.proto \
+    podresources_v1.proto
+echo "generated: ../gen/deviceplugin_pb2.py ../gen/podresources_pb2.py" \
+     "../gen/podresources_v1_pb2.py"

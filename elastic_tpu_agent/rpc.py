@@ -17,6 +17,7 @@ import grpc
 
 from .gen import deviceplugin_pb2 as dp
 from .gen import podresources_pb2 as pr
+from .gen import podresources_v1_pb2 as prv1
 
 # -- kubelet filesystem contract (upstream constants) -------------------------
 DEVICE_PLUGIN_VERSION = "v1beta1"
@@ -237,54 +238,123 @@ def add_registration_servicer(
 
 class PodResourcesClient:
     """List() of pod->container->devices (reference: podresources/client.go
-    + locator.go:32-41). Lazily re-dials on failure."""
+    + locator.go:32-41). Lazily re-dials on failure.
+
+    Speaks BOTH kubelet API versions: probes ``v1`` first (served since
+    k8s 1.20, adds GetAllocatableResources) and falls back to ``v1alpha1``
+    when the kubelet answers UNIMPLEMENTED — the reference spoke only
+    v1alpha1 (pkg/podresources/v1alpha1/api.proto). The negotiated version
+    sticks for the life of the channel; a reset() re-probes, so a kubelet
+    upgrade under us is picked up on reconnect. The two Lists are wire- and
+    field-name-compatible for everything the locator touches
+    (pod_resources/name/namespace/containers/devices/resource_name/
+    device_ids), so callers never see the difference.
+    """
 
     def __init__(self, socket_path: str = POD_RESOURCES_SOCKET) -> None:
         self._socket = socket_path
         self._lock = threading.Lock()  # one client is shared by multiple
         self._channel: Optional[grpc.Channel] = None  # locators + prefetch
-        self._list = None  # threads
+        # immutable per-negotiation binding: (list_fn, request_cls,
+        # allocatable_fn_or_None, version) — swapped atomically so a caller
+        # can never pair a stale callable with the other version's request
+        self._bound: Optional[tuple] = None  # threads
 
-    def _ensure(self, timeout_s: float):
-        """Return the List callable, dialing if needed (thread-safe)."""
+    @property
+    def api_version(self) -> Optional[str]:
+        bound = self._bound
+        return bound[3] if bound else None
+
+    @staticmethod
+    def _bind_v1(channel) -> tuple:
+        list_fn = channel.unary_unary(
+            "/v1.PodResourcesLister/List",
+            request_serializer=prv1.ListPodResourcesRequest.SerializeToString,
+            response_deserializer=prv1.ListPodResourcesResponse.FromString,
+        )
+        allocatable = channel.unary_unary(
+            "/v1.PodResourcesLister/GetAllocatableResources",
+            request_serializer=(
+                prv1.AllocatableResourcesRequest.SerializeToString
+            ),
+            response_deserializer=(
+                prv1.AllocatableResourcesResponse.FromString
+            ),
+        )
+        return (list_fn, prv1.ListPodResourcesRequest, allocatable, "v1")
+
+    @staticmethod
+    def _bind_v1alpha1(channel) -> tuple:
+        list_fn = channel.unary_unary(
+            "/v1alpha1.PodResourcesLister/List",
+            request_serializer=pr.ListPodResourcesRequest.SerializeToString,
+            response_deserializer=pr.ListPodResourcesResponse.FromString,
+        )
+        return (list_fn, pr.ListPodResourcesRequest, None, "v1alpha1")
+
+    def _ensure(self, timeout_s: float) -> tuple:
+        """Return the negotiated binding tuple, dialing + version-probing
+        if needed (thread-safe). The probe is GetAllocatableResources — a
+        tiny response, unlike a full-node List — which a v1alpha1-only
+        kubelet rejects with UNIMPLEMENTED."""
         with self._lock:
-            if self._list is None:
+            if self._bound is None:
                 channel = grpc.insecure_channel(
                     unix_target(self._socket), options=_CHANNEL_OPTS
                 )
                 grpc.channel_ready_future(channel).result(timeout=timeout_s)
                 self._channel = channel
-                self._list = channel.unary_unary(
-                    "/v1alpha1.PodResourcesLister/List",
-                    request_serializer=(
-                        pr.ListPodResourcesRequest.SerializeToString
-                    ),
-                    response_deserializer=(
-                        pr.ListPodResourcesResponse.FromString
-                    ),
-                )
-            return self._list
+                bound = self._bind_v1(channel)
+                try:
+                    bound[2](
+                        prv1.AllocatableResourcesRequest(), timeout=timeout_s
+                    )
+                except grpc.RpcError as e:
+                    if e.code() == grpc.StatusCode.UNIMPLEMENTED:
+                        bound = self._bind_v1alpha1(channel)
+                    else:
+                        raise
+                self._bound = bound
+            return self._bound
 
     def reset(self) -> None:
-        """Drop the channel so the next call re-dials. The old channel is
-        closed after a grace period, NOT immediately: other threads
-        (locator prefetch + inline locate share this client) may have RPCs
-        in flight on it, and close() would cancel them."""
+        """Drop the channel so the next call re-dials (and re-probes the
+        API version — a kubelet upgrade under us is picked up here). The
+        old channel is closed after a grace period, NOT immediately: other
+        threads (locator prefetch + inline locate share this client) may
+        have RPCs in flight on it, and close() would cancel them."""
         with self._lock:
             old = self._channel
             self._channel = None
-            self._list = None
+            self._bound = None
         if old is not None:
             timer = threading.Timer(5.0, old.close)
             timer.daemon = True
             timer.start()
 
-    def list(self, timeout_s: float = 5.0) -> pr.ListPodResourcesResponse:
+    def list(self, timeout_s: float = 5.0):
         try:
-            list_fn = self._ensure(timeout_s)
-            return list_fn(pr.ListPodResourcesRequest(), timeout=timeout_s)
+            list_fn, req_cls, _, _ = self._ensure(timeout_s)
+            return list_fn(req_cls(), timeout=timeout_s)
         except grpc.RpcError:
             self.reset()  # re-dial next call (reference: locator.go:47-53)
+            raise
+
+    def get_allocatable_resources(
+        self, timeout_s: float = 5.0
+    ) -> Optional[prv1.AllocatableResourcesResponse]:
+        """Node allocatable devices (v1 only). Returns None when the
+        kubelet only speaks v1alpha1 — callers treat that as 'unknown',
+        not 'empty'."""
+        try:
+            _, _, allocatable_fn, version = self._ensure(timeout_s)
+            if allocatable_fn is None:
+                return None  # negotiated v1alpha1: genuinely unavailable
+            return allocatable_fn(
+                prv1.AllocatableResourcesRequest(), timeout=timeout_s
+            )
+        except grpc.RpcError:
+            self.reset()
             raise
 
     def close(self) -> None:
@@ -311,4 +381,49 @@ def add_pod_resources_servicer(
     }
     server.add_generic_rpc_handlers(
         (grpc.method_handlers_generic_handler("v1alpha1.PodResourcesLister", handlers),)
+    )
+
+
+def add_pod_resources_v1_servicer(
+    server: grpc.Server,
+    list_fn: Callable[[], "prv1.ListPodResourcesResponse"],
+    allocatable_fn: Optional[
+        Callable[[], "prv1.AllocatableResourcesResponse"]
+    ] = None,
+) -> None:
+    """v1 pod-resources server (kubelet >= 1.20 shape): List +
+    GetAllocatableResources. Used by the fake kubelet so client version
+    negotiation is testable against both shapes."""
+
+    def _list(request, context):  # noqa: ARG001
+        return list_fn()
+
+    def _allocatable(request, context):  # noqa: ARG001
+        # Real v1 kubelets always implement this RPC (the client uses it as
+        # its version probe) — an unconfigured fake answers empty, never
+        # UNIMPLEMENTED, which would misread as a v1alpha1-only kubelet.
+        if allocatable_fn is None:
+            return prv1.AllocatableResourcesResponse()
+        return allocatable_fn()
+
+    handlers = {
+        "List": grpc.unary_unary_rpc_method_handler(
+            _list,
+            request_deserializer=prv1.ListPodResourcesRequest.FromString,
+            response_serializer=(
+                prv1.ListPodResourcesResponse.SerializeToString
+            ),
+        ),
+        "GetAllocatableResources": grpc.unary_unary_rpc_method_handler(
+            _allocatable,
+            request_deserializer=(
+                prv1.AllocatableResourcesRequest.FromString
+            ),
+            response_serializer=(
+                prv1.AllocatableResourcesResponse.SerializeToString
+            ),
+        ),
+    }
+    server.add_generic_rpc_handlers(
+        (grpc.method_handlers_generic_handler("v1.PodResourcesLister", handlers),)
     )

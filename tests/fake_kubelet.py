@@ -20,6 +20,7 @@ import grpc
 from elastic_tpu_agent import rpc
 from elastic_tpu_agent.gen import deviceplugin_pb2 as dp
 from elastic_tpu_agent.gen import podresources_pb2 as pr
+from elastic_tpu_agent.gen import podresources_v1_pb2 as prv1
 
 
 class FakeKubelet:
@@ -36,6 +37,11 @@ class FakeKubelet:
         self._reg_server: Optional[grpc.Server] = None
         self._pr_server: Optional[grpc.Server] = None
         self.split_device_entries = False  # True -> k8s >=1.21 shape
+        # which pod-resources APIs this "kubelet" speaks (real ones serve
+        # both since 1.20; ("v1alpha1",) simulates an old kubelet)
+        self.api_versions = ("v1", "v1alpha1")
+        # resource -> [device ids] advertised via v1 GetAllocatableResources
+        self.allocatable: Dict[str, List[str]] = {}
 
     # -- lifecycle ------------------------------------------------------------
 
@@ -50,7 +56,19 @@ class FakeKubelet:
         self._reg_server.start()
 
         self._pr_server = grpc.server(futures.ThreadPoolExecutor(max_workers=4))
-        rpc.add_pod_resources_servicer(self._pr_server, self._list_pod_resources)
+        # Real kubelets >=1.20 serve BOTH versions on the one socket; the
+        # api_versions knob narrows the fake to one shape so client
+        # version negotiation is testable against old and new kubelets.
+        if "v1alpha1" in self.api_versions:
+            rpc.add_pod_resources_servicer(
+                self._pr_server, self._list_pod_resources
+            )
+        if "v1" in self.api_versions:
+            rpc.add_pod_resources_v1_servicer(
+                self._pr_server,
+                self._list_pod_resources_v1,
+                self._allocatable_v1,
+            )
         self._pr_server.add_insecure_port(
             rpc.unix_target(self.pod_resources_socket)
         )
@@ -137,6 +155,42 @@ class FakeKubelet:
                 pr.PodResources(name=pod, namespace=ns, containers=centries)
             )
         return pr.ListPodResourcesResponse(pod_resources=out)
+
+    def _list_pod_resources_v1(self) -> prv1.ListPodResourcesResponse:
+        """Same state as the v1alpha1 List, in the v1 wire shape."""
+        alpha = self._list_pod_resources()
+        return prv1.ListPodResourcesResponse(
+            pod_resources=[
+                prv1.PodResources(
+                    name=p.name,
+                    namespace=p.namespace,
+                    containers=[
+                        prv1.ContainerResources(
+                            name=c.name,
+                            devices=[
+                                prv1.ContainerDevices(
+                                    resource_name=d.resource_name,
+                                    device_ids=list(d.device_ids),
+                                )
+                                for d in c.devices
+                            ],
+                        )
+                        for c in p.containers
+                    ],
+                )
+                for p in alpha.pod_resources
+            ]
+        )
+
+    def _allocatable_v1(self) -> prv1.AllocatableResourcesResponse:
+        with self._lock:
+            items = sorted(self.allocatable.items())
+        return prv1.AllocatableResourcesResponse(
+            devices=[
+                prv1.ContainerDevices(resource_name=res, device_ids=ids)
+                for res, ids in items
+            ]
+        )
 
     # -- playing kubelet against a plugin server ------------------------------
 

@@ -36,10 +36,13 @@ class CountingClient(PodResourcesClient):
         return super().list(timeout_s=timeout_s)
 
 
-@pytest.fixture()
-def kubelet():
+@pytest.fixture(params=[("v1", "v1alpha1"), ("v1alpha1",), ("v1",)])
+def kubelet(request):
+    """Modern kubelet (both APIs), pre-1.20 kubelet (v1alpha1 only), and a
+    hypothetical v1-only one — the locator must work against all three."""
     tmp = pathlib.Path(tempfile.mkdtemp())
     k = FakeKubelet(str(tmp / "dp"), str(tmp / "pr" / "kubelet.sock"))
+    k.api_versions = request.param
     k.start()
     yield k
     k.stop()
@@ -84,3 +87,27 @@ def test_cache_cap_is_enforced(kubelet, monkeypatch):
     # entries evicted by the cap still resolve via an inline refresh
     owner = loc.locate(Device(_ids(299), RESOURCE))
     assert owner.name == "pod-299"
+
+
+def test_client_negotiates_expected_version(kubelet):
+    """v1 preferred when served; v1alpha1 fallback on UNIMPLEMENTED
+    (reference spoke only v1alpha1, pkg/podresources/v1alpha1)."""
+    kubelet.assign("ns", "p", "jax", RESOURCE, _ids(1))
+    client = CountingClient(kubelet.pod_resources_socket)
+    loc = KubeletDeviceLocator(RESOURCE, client)
+    assert loc.locate(Device(_ids(1), RESOURCE)).name == "p"
+    expected = "v1" if "v1" in kubelet.api_versions else "v1alpha1"
+    assert client.api_version == expected
+
+
+def test_allocatable_resources_v1_only(kubelet):
+    kubelet.allocatable[RESOURCE] = [f"tpu-core-{c}-{u}"
+                                     for c in range(4) for u in range(100)]
+    client = CountingClient(kubelet.pod_resources_socket)
+    resp = client.get_allocatable_resources()
+    if "v1" in kubelet.api_versions:
+        assert resp is not None
+        by_res = {d.resource_name: list(d.device_ids) for d in resp.devices}
+        assert len(by_res[RESOURCE]) == 400
+    else:
+        assert resp is None
