@@ -858,10 +858,15 @@ class TPUSharePlugin:
         self.memory.apply_health(healthy)
         events = self._config.events
         if events is not None:
+            try:
+                reasons = self._config.operator.health_reasons()
+            except Exception:  # noqa: BLE001 - reasons are best-effort
+                reasons = {}
             for idx in sorted(went_bad):
+                why = reasons.get(idx, "reported unhealthy by operator")
                 events.node_event(
                     ReasonChipUnhealthy,
-                    f"TPU chip {idx} unhealthy (device node missing); "
+                    f"TPU chip {idx} unhealthy ({why}); "
                     "kubelet will stop placing units on it",
                     type_="Warning",
                 )

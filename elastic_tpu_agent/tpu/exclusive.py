@@ -22,6 +22,11 @@ class ExclusiveOperator(TPUOperator):
     def devices(self) -> List[TPUChip]:
         return self._inner.devices()
 
+    def health_reasons(self) -> dict:
+        # Defined on the TPUOperator base, so __getattr__ would not forward
+        # it — delegate explicitly to keep the inner operator's detail.
+        return self._inner.health_reasons()
+
     def __getattr__(self, name):
         # Forward discovery-adjacent surface (topology, worker_id,
         # worker_hostnames, healthy_indexes, fault-injection seams) so

@@ -80,6 +80,11 @@ class TPUOperator(ABC):
         single analogue for."""
         return {c.index for c in self.devices()}
 
+    def health_reasons(self) -> dict:
+        """Best-effort {chip index: why it is unhealthy}, surfaced in the
+        TPUChipUnhealthy node event. Default: no detail."""
+        return {}
+
 
 # -- shared symlink mechanics -------------------------------------------------
 

@@ -20,6 +20,7 @@ import glob
 import logging
 import os
 import re
+import time
 from typing import Callable, Dict, List, Optional
 
 from .operator import LinkingOperator, TPUChip
@@ -30,8 +31,28 @@ logger = logging.getLogger(__name__)
 _METADATA_URL = (
     "http://metadata.google.internal/computeMetadata/v1/instance/attributes/"
 )
+# maintenance-event lives directly under instance/, not instance/attributes/
+_MAINTENANCE_EVENT_URL = (
+    "http://metadata.google.internal/computeMetadata/v1/instance/"
+    "maintenance-event"
+)
 _METADATA_HEADERS = {"Metadata-Flavor": "Google"}
 _METADATA_TIMEOUT_S = 2.0
+
+# Health-poll cost control: maintenance-event is re-fetched at most every
+# POLL_TTL (the 5s health loop must not hammer metadata), and after a
+# transport failure (non-GCE host, kind node) the endpoint is left alone
+# for ERROR_BACKOFF so health polling stays cheap where there is no
+# metadata server at all.
+_MAINTENANCE_POLL_TTL_S = 30.0
+_MAINTENANCE_ERROR_BACKOFF_S = 300.0
+
+# sysfs error counters: only counters that unambiguously mean "this chip is
+# broken" flip health — correctable-error counters tick during normal
+# operation and must not. Override the name filter via
+# ELASTIC_TPU_SYS_ERROR_PATTERNS (comma-separated substrings).
+_SYS_ACCEL_ROOT = "/sys/class/accel"
+_FATAL_COUNTER_SUBSTRINGS = ("fatal", "uncorrectable")
 
 # Conservative fallback when the generation cannot be determined: assume the
 # smallest HBM of any supported generation so fractional tpu-memory is never
@@ -42,20 +63,56 @@ _FALLBACK_CORES = 1
 MetadataFetcher = Callable[[str], Optional[str]]
 
 
-def _default_metadata_fetcher(attribute: str) -> Optional[str]:
+def _fetch_metadata_url(url: str) -> Optional[str]:
     try:
         import requests
 
         resp = requests.get(
-            _METADATA_URL + attribute,
-            headers=_METADATA_HEADERS,
-            timeout=_METADATA_TIMEOUT_S,
+            url, headers=_METADATA_HEADERS, timeout=_METADATA_TIMEOUT_S
         )
         if resp.status_code == 200:
             return resp.text.strip()
     except Exception:  # noqa: BLE001 - any transport failure = "absent"
         pass
     return None
+
+
+def _default_metadata_fetcher(attribute: str) -> Optional[str]:
+    return _fetch_metadata_url(_METADATA_URL + attribute)
+
+
+def _default_maintenance_fetcher() -> Optional[str]:
+    """Current GCE maintenance-event value ("NONE" when quiet,
+    "MIGRATE_ON_HOST_MAINTENANCE"/"TERMINATE_ON_HOST_MAINTENANCE" when an
+    event is imminent); None when the endpoint is unreachable."""
+    return _fetch_metadata_url(_MAINTENANCE_EVENT_URL)
+
+
+_COUNTER_WALK_DEPTH = 3
+
+
+def _counter_files(chip_dir: str):
+    """(dir, filename) pairs under a sysfs accelN entry, to a bounded
+    depth. Real sysfs reaches counters through symlinks —
+    /sys/class/accel/accelN is itself a link into /sys/devices/..., and
+    accelN/device links to the PCI device dir holding aer_dev_fatal /
+    aer_dev_uncorrectable — so the class dir and its device link are
+    realpath'd explicitly; everything below walks WITHOUT following links
+    (sysfs is cyclic through subsystem/ and friends)."""
+    roots = [os.path.realpath(chip_dir)]
+    dev = os.path.join(chip_dir, "device")
+    if os.path.isdir(dev):
+        real_dev = os.path.realpath(dev)
+        if not any(real_dev.startswith(r + os.sep) or real_dev == r
+                   for r in roots):
+            roots.append(real_dev)
+    for top in roots:
+        for root, dirs, files in os.walk(top, followlinks=False):
+            depth = root[len(top):].count(os.sep)
+            if depth >= _COUNTER_WALK_DEPTH:
+                dirs[:] = []
+            for name in files:
+                yield root, name
 
 
 def parse_tpu_env(raw: str) -> Dict[str, str]:
@@ -77,6 +134,8 @@ class TPUVMOperator(LinkingOperator):
         host_dev_scan_root: Optional[str] = None,
         metadata: MetadataFetcher = _default_metadata_fetcher,
         env: Optional[Dict[str, str]] = None,
+        maintenance: Callable[[], Optional[str]] = _default_maintenance_fetcher,
+        sys_accel_root: Optional[str] = None,
     ) -> None:
         # dev_root: where virtual links are created (host /dev mount).
         # host_dev_scan_root: where to look for accel* chardevs (defaults to
@@ -90,6 +149,26 @@ class TPUVMOperator(LinkingOperator):
         # PreStart hot path never re-hits the metadata server.
         self._worker_id: Optional[int] = None
         self._worker_hostnames: Optional[List[str]] = None
+        # -- health sources beyond node presence -------------------------
+        self._maintenance = maintenance
+        self._maint_cached: Optional[str] = None
+        self._maint_next_poll = 0.0
+        self._sys_root = sys_accel_root or self._env.get(
+            "ELASTIC_TPU_SYS_ACCEL_ROOT", _SYS_ACCEL_ROOT
+        )
+        self._counter_patterns = tuple(
+            p.strip() for p in self._env.get(
+                "ELASTIC_TPU_SYS_ERROR_PATTERNS", ""
+            ).split(",") if p.strip()
+        ) or _FATAL_COUNTER_SUBSTRINGS
+        # chip -> {counter path -> baseline value}; a chip whose fatal
+        # counter moved past its baseline stays unhealthy (sticky) until
+        # agent restart — transient "recovery" of a chip that faulted is
+        # not trusted.
+        self._counter_base: Dict[int, Dict[str, int]] = {}
+        self._error_chips: set = set()
+        self._ever_present: set = set()
+        self._health_reasons: Dict[int, str] = {}
 
     # -- inventory sources ---------------------------------------------------
 
@@ -189,8 +268,82 @@ class TPUVMOperator(LinkingOperator):
             for i in indexes
         ]
 
+    # -- health ---------------------------------------------------------------
+
+    def _maintenance_imminent(self) -> bool:
+        """True while GCE reports an upcoming host maintenance event.
+        Cached: success for _MAINTENANCE_POLL_TTL_S, transport failure for
+        _MAINTENANCE_ERROR_BACKOFF_S (non-GCE hosts have no endpoint and
+        must not pay a 2s timeout on every 5s health tick)."""
+        now = time.monotonic()
+        if now >= self._maint_next_poll:
+            val = self._maintenance()
+            self._maint_cached = val
+            self._maint_next_poll = now + (
+                _MAINTENANCE_POLL_TTL_S if val is not None
+                else _MAINTENANCE_ERROR_BACKOFF_S
+            )
+        return self._maint_cached not in (None, "", "NONE")
+
+    def _scan_error_counters(self, present: List[int]) -> None:
+        """Fold /sys/class/accel/accelN fatal-error counters into the
+        sticky error-chip set: the first observation of each counter is its
+        baseline (counters survive agent restarts; pre-existing nonzero
+        values are not our signal), any later increase marks the chip."""
+        for i in present:
+            chip_dir = os.path.join(self._sys_root, f"accel{i}")
+            if not os.path.isdir(chip_dir):
+                continue
+            base = self._counter_base.setdefault(i, {})
+            for root, name in _counter_files(chip_dir):
+                if not any(p in name for p in self._counter_patterns):
+                    continue
+                path = os.path.join(root, name)
+                try:
+                    with open(path) as f:
+                        value = int(f.read().strip())
+                except (OSError, ValueError):
+                    continue
+                if path not in base:
+                    base[path] = value
+                elif value > base[path]:
+                    if i not in self._error_chips:
+                        logger.warning(
+                            "chip %d: fatal counter %s %d -> %d; "
+                            "marking unhealthy", i, path, base[path],
+                            value,
+                        )
+                    self._error_chips.add(i)
+                    self._health_reasons[i] = (
+                        f"fatal error counter {name} rose to {value}"
+                    )
+                elif value < base[path]:
+                    # Counter reset (driver reload): re-baseline downward,
+                    # or errors 1..old-baseline would be masked forever.
+                    base[path] = value
+
     def healthy_indexes(self) -> set:
-        """A chip is healthy while its /dev/accelN chardev is present; a
-        wedged/detached chip (driver reset, host maintenance event) drops
-        its node, and kubelet must stop placing fractional units on it."""
-        return set(self._accel_indexes())
+        """A chip is healthy while (a) its /dev/accelN chardev is present
+        (a wedged/detached chip drops its node), (b) no sysfs fatal-error
+        counter has risen since baseline, and (c) GCE is not announcing a
+        host maintenance event — an imminent migration/termination drains
+        NEW placements off every chip while existing bindings ride out the
+        event (checkpoint/resume is the recovery path)."""
+        present = self._accel_indexes()
+        self._ever_present.update(present)
+        self._health_reasons = {
+            i: "device node missing"
+            for i in self._ever_present if i not in present
+        }
+        if self._maintenance_imminent():
+            for i in present:
+                self._health_reasons[i] = (
+                    f"host maintenance event: {self._maint_cached}"
+                )
+            return set()
+        self._scan_error_counters(present)
+        return set(present) - self._error_chips
+
+    def health_reasons(self) -> Dict[int, str]:
+        """Why each currently-unhealthy chip is unhealthy (best effort)."""
+        return dict(self._health_reasons)

@@ -182,3 +182,169 @@ def test_health_loop_runs_periodically(tmp_path):
     finally:
         TPUSharePlugin.HEALTH_PERIOD_S = period
         c.stop()
+
+
+def _tpuvm_op(tmp_path, **kw):
+    from elastic_tpu_agent.tpu.tpuvm import TPUVMOperator
+
+    scan = tmp_path / "hostdev"
+    scan.mkdir(exist_ok=True)
+    for i in range(4):
+        (scan / f"accel{i}").touch()
+    os.makedirs(str(tmp_path / "dev"), exist_ok=True)
+    kw.setdefault("metadata", lambda attr: None)
+    kw.setdefault("env", {"TPU_ACCELERATOR_TYPE": "v5litepod-4"})
+    kw.setdefault("maintenance", lambda: "NONE")
+    return TPUVMOperator(
+        str(tmp_path / "dev"), host_dev_scan_root=str(scan), **kw
+    )
+
+
+def test_maintenance_event_drains_all_chips(tmp_path):
+    """A GCE maintenance event (VM about to migrate/terminate) marks every
+    chip unhealthy so kubelet places nothing new; clearing the event
+    restores them. Fault-injected via the maintenance fetcher."""
+    import elastic_tpu_agent.tpu.tpuvm as tpuvm_mod
+
+    state = {"event": "NONE"}
+    op = _tpuvm_op(tmp_path, maintenance=lambda: state["event"])
+    # defeat the poll TTL so every healthy_indexes() re-fetches
+    op._maint_next_poll = 0.0
+    assert op.healthy_indexes() == {0, 1, 2, 3}
+
+    state["event"] = "MIGRATE_ON_HOST_MAINTENANCE"
+    op._maint_next_poll = 0.0
+    assert op.healthy_indexes() == set()
+    assert "maintenance" in op.health_reasons()[0]
+
+    state["event"] = "NONE"
+    op._maint_next_poll = 0.0
+    assert op.healthy_indexes() == {0, 1, 2, 3}
+
+
+def test_maintenance_fetch_failure_backs_off(tmp_path):
+    """Non-GCE hosts (kind, CI) have no metadata endpoint: one failed
+    fetch must back off instead of paying the timeout every 5s tick."""
+    calls = {"n": 0}
+
+    def failing():
+        calls["n"] += 1
+        return None
+
+    op = _tpuvm_op(tmp_path, maintenance=failing)
+    assert op.healthy_indexes() == {0, 1, 2, 3}
+    assert op.healthy_indexes() == {0, 1, 2, 3}
+    assert calls["n"] == 1, "no backoff after transport failure"
+
+
+def test_sysfs_fatal_counter_marks_chip_unhealthy_sticky(tmp_path):
+    """A rising fatal-error counter under /sys/class/accel/accelN flips
+    the chip unhealthy and keeps it so (sticky) even if the counter stops
+    moving; correctable counters are ignored; pre-existing nonzero values
+    are baseline, not a signal."""
+    sys_root = tmp_path / "sysaccel"
+    err_dir = sys_root / "accel1" / "device"
+    err_dir.mkdir(parents=True)
+    fatal = err_dir / "aer_dev_fatal"
+    fatal.write_text("7\n")  # pre-existing count: baseline, not a fault
+    correctable = err_dir / "aer_dev_correctable"
+    correctable.write_text("0\n")
+
+    op = _tpuvm_op(tmp_path, sys_accel_root=str(sys_root))
+    assert op.healthy_indexes() == {0, 1, 2, 3}
+
+    # correctable noise: ignored
+    correctable.write_text("5000\n")
+    assert op.healthy_indexes() == {0, 1, 2, 3}
+
+    # fatal counter rises past baseline: chip 1 out, sticky
+    fatal.write_text("8\n")
+    assert op.healthy_indexes() == {0, 2, 3}
+    assert "fatal" in op.health_reasons()[1]
+    fatal.write_text("8\n")
+    assert op.healthy_indexes() == {0, 2, 3}, "error chip must stay out"
+
+
+def test_health_flip_reason_lands_in_node_event(tmp_path):
+    """The maintenance/counter reason travels through health_once into the
+    TPUChipUnhealthy node event (the ListAndWatch machinery test already
+    covers device flips; this pins the reason string)."""
+    from elastic_tpu_agent.plugins.base import PluginConfig
+    from elastic_tpu_agent.plugins.tpushare import TPUSharePlugin
+    from elastic_tpu_agent.storage import Storage
+
+    from fake_kubelet import FakeSitter
+
+    state = {"event": "NONE"}
+    op = _tpuvm_op(tmp_path, maintenance=lambda: state["event"])
+
+    class RecEvents:
+        def __init__(self):
+            self.node_events = []
+
+        def node_event(self, reason, message, type_="Normal"):
+            self.node_events.append((reason, message))
+
+        def pod_event(self, *a, **k):
+            pass
+
+    events = RecEvents()
+    config = PluginConfig(
+        device_plugin_dir=str(tmp_path / "dp"),
+        pod_resources_socket=str(tmp_path / "pr.sock"),
+        operator=op,
+        sitter=FakeSitter(),
+        storage=Storage(str(tmp_path / "meta.db")),
+        locator_factory=lambda r: None,
+        events=events,
+        extra={"alloc_spec_dir": str(tmp_path / "alloc")},
+    )
+    plugin = TPUSharePlugin(config)
+    plugin.health_once()
+    assert events.node_events == []
+
+    state["event"] = "TERMINATE_ON_HOST_MAINTENANCE"
+    op._maint_next_poll = 0.0
+    assert plugin.health_once()
+    assert len(events.node_events) == 4
+    reason, message = events.node_events[0]
+    assert reason == "TPUChipUnhealthy"
+    assert "TERMINATE_ON_HOST_MAINTENANCE" in message
+
+
+def test_sysfs_counters_reachable_through_symlinks(tmp_path):
+    """Real sysfs shape: /sys/class/accel/accelN is a symlink into
+    /sys/devices/..., and accelN/device links to the PCI dir holding the
+    AER counters — both must be traversed."""
+    devices = tmp_path / "devices" / "platform" / "tpu1"
+    pci = tmp_path / "devices" / "pci0000" / "0000:00:05.0"
+    devices.mkdir(parents=True)
+    pci.mkdir(parents=True)
+    (devices / "device").symlink_to(pci)
+    sys_root = tmp_path / "class_accel"
+    sys_root.mkdir()
+    (sys_root / "accel1").symlink_to(devices)
+    fatal = pci / "aer_dev_fatal"
+    fatal.write_text("0\n")
+
+    op = _tpuvm_op(tmp_path, sys_accel_root=str(sys_root))
+    assert op.healthy_indexes() == {0, 1, 2, 3}
+    fatal.write_text("1\n")
+    assert op.healthy_indexes() == {0, 2, 3}
+
+
+def test_sysfs_counter_reset_rebaselines(tmp_path):
+    """A driver reload zeroing the counter must re-baseline downward, or
+    errors below the stale baseline would be masked forever."""
+    sys_root = tmp_path / "sysaccel"
+    err_dir = sys_root / "accel0" / "device"
+    err_dir.mkdir(parents=True)
+    fatal = err_dir / "aer_dev_fatal"
+    fatal.write_text("7\n")
+
+    op = _tpuvm_op(tmp_path, sys_accel_root=str(sys_root))
+    assert 0 in op.healthy_indexes()          # 7 is baseline, not a fault
+    fatal.write_text("0\n")                   # driver reload reset
+    assert 0 in op.healthy_indexes()          # re-baselined at 0
+    fatal.write_text("2\n")                   # 2 NEW fatal errors
+    assert 0 not in op.healthy_indexes()
