@@ -27,7 +27,14 @@ import logging
 from typing import Iterable, List, Optional
 
 from .async_sink import AsyncSink
-from .crd import ElasticTPU, ElasticTPUClient, PhaseBound, PhaseReleased
+from .common import ResourceTPUCore, ResourceTPUMemory, TPUPercentEachChip
+from .crd import (
+    ElasticTPU,
+    ElasticTPUClient,
+    PhaseAvailable,
+    PhaseBound,
+    PhaseReleased,
+)
 
 logger = logging.getLogger(__name__)
 
@@ -52,6 +59,42 @@ class CRDRecorder:
     def object_name(self, alloc_hash: str) -> str:
         # DNS-1123: node names are already DNS labels, hash is lowercase hex.
         return f"{self._node}-{alloc_hash}"
+
+    def inventory_name(self, chip_index: int) -> str:
+        return f"{self._node}-chip{chip_index}"
+
+    def publish_inventory(self, chips) -> None:
+        """Publish one Available-phase ElasticTPU object per discovered
+        chip, so CRD consumers (external schedulers, dashboards) see node
+        CAPACITY and not just bindings — the reference CRD modeled exactly
+        these phases and node-inventory objects but its agent never wrote
+        them (reference vendor/elasticgpu.io types.go:49-78, writing path
+        commented out). Called at boot and reconciled by restore()."""
+        objs = [
+            ElasticTPU(
+                name=self.inventory_name(chip.index),
+                node_name=self._node,
+                capacity={
+                    ResourceTPUCore: str(TPUPercentEachChip),
+                    ResourceTPUMemory: str(chip.hbm_bytes // (1024 * 1024)),
+                },
+                chip_indexes=[chip.index],
+                accelerator_type=self._accelerator_type,
+                phase=PhaseAvailable,
+                message=(
+                    f"chip {chip.index} ({chip.uuid}): "
+                    f"{chip.hbm_bytes // (1024 ** 3)} GiB HBM, "
+                    f"{chip.cores} core(s)"
+                ),
+            )
+            for chip in chips
+        ]
+
+        def publish() -> None:
+            for obj in objs:
+                self._client.create(obj, update_existing=True)
+
+        self._submit(publish)
 
     def record_bound(
         self,
@@ -91,10 +134,17 @@ class CRDRecorder:
 
         self._submit(release)
 
-    def reconcile(self, live_hashes: Iterable[str]) -> None:
+    def reconcile(
+        self,
+        live_hashes: Iterable[str],
+        chip_indexes: Iterable[int] = (),
+    ) -> None:
         """Restore-time sweep: delete objects this node published for
-        allocations that no longer exist in the checkpoint store."""
+        allocations that no longer exist in the checkpoint store, and
+        inventory objects for chips no longer present (keeps the ones that
+        are — publish_inventory upserts them)."""
         live = {self.object_name(h) for h in live_hashes}
+        live |= {self.inventory_name(i) for i in chip_indexes}
 
         def sweep() -> None:
             for obj in self._client.list(self._node):

@@ -208,13 +208,18 @@ class TPUManager:
         self._sweep_orphans(report)
         if self.crd_recorder is not None:
             # Sweep stale ElasticTPU objects this node published for
-            # allocations that no longer exist after the reconcile above.
+            # allocations that no longer exist after the reconcile above;
+            # chip-inventory objects for still-present chips are kept.
             live = [
                 record.device.hash
                 for _, info in self.storage.items()
                 for record in info.records()
             ]
-            self.crd_recorder.reconcile(live)
+            try:
+                chips = [c.index for c in self.operator.devices()]
+            except Exception:  # noqa: BLE001 - discovery failure
+                chips = []
+            self.crd_recorder.reconcile(live, chip_indexes=chips)
         logger.info("restore report: %s", report)
         if self.events is not None and (
             report["restored_links"] or report["reclaimed_pods"]
@@ -294,6 +299,14 @@ class TPUManager:
         self.sitter.start(self._stop)
         if not self.sitter.wait_synced(timeout=60.0):
             logger.warning("sitter not synced after 60s; continuing anyway")
+        if self.crd_recorder is not None:
+            # Capacity first, bindings after: CRD consumers should see this
+            # node's chips as Available inventory from boot (reference CRD
+            # phases, types.go:49-78), not only Bound lifecycle objects.
+            try:
+                self.crd_recorder.publish_inventory(self.operator.devices())
+            except Exception:  # noqa: BLE001 - observability, never fatal
+                logger.exception("inventory publication failed")
         self.restore()
         self.plugin.run(self._stop)
         self._gc_thread = self.plugin.start_gc(self.gc_queue, self._stop)
