@@ -1,0 +1,15 @@
+"""containerd NRI (Node Resource Interface) plugin.
+
+This is the containerd/GKE activation path for the injection chain
+(docs/operations.md "containerd / GKE activation"): containerd does not
+read OCI hooks.d, so instead of the hook binary the agent speaks NRI —
+it subscribes to CreateContainer events and returns a ContainerAdjustment
+carrying the devices, env, and mounts recorded in the allocation spec.
+
+Reference parity: the reference activates its injection by *replacing the
+host's nvidia hook binary* (tools/install.sh:2-5); there is no TPU binary
+to replace, and GKE's containerd ignores hooks.d, so NRI is the
+TPU-native equivalent mechanism.
+"""
+
+from .plugin import NRIPlugin, adjustment_from_spec  # noqa: F401

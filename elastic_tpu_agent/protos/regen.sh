@@ -6,6 +6,6 @@
 set -e
 cd "$(dirname "$0")"
 protoc --python_out=../gen deviceplugin.proto podresources.proto \
-    podresources_v1.proto
+    podresources_v1.proto ttrpc.proto nri.proto
 echo "generated: ../gen/deviceplugin_pb2.py ../gen/podresources_pb2.py" \
-     "../gen/podresources_v1_pb2.py"
+     "../gen/podresources_v1_pb2.py ../gen/ttrpc_pb2.py ../gen/nri_pb2.py"
