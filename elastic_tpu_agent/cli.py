@@ -55,6 +55,16 @@ def parse_args(argv=None) -> argparse.Namespace:
         default="/host/var/lib/elastic-tpu/alloc",
         help="where allocation specs for the OCI hook are written",
     )
+    p.add_argument(
+        "--nri-socket", default="",
+        help="containerd NRI socket; when set the agent registers as an "
+             "NRI plugin and injects devices at CreateContainer "
+             "(containerd/GKE activation; typical: /var/run/nri/nri.sock)",
+    )
+    p.add_argument(
+        "--nri-libtpu", default="",
+        help="host libtpu.so to bind-mount into TPU containers via NRI",
+    )
     p.add_argument("--metrics-port", type=int, default=9478,
                    help="prometheus metrics port (0 = off)")
     p.add_argument("--no-events", action="store_true",
@@ -93,6 +103,8 @@ def main(argv=None) -> int:
             device_plugin_dir=args.device_plugin_dir,
             pod_resources_socket=args.pod_resources_socket,
             alloc_spec_dir=args.alloc_spec_dir,
+            nri_socket=args.nri_socket,
+            nri_libtpu=args.nri_libtpu,
             metrics=metrics,
             enable_events=not args.no_events,
             enable_crd=not args.no_crd,

@@ -54,6 +54,14 @@ class ManagerOptions:
     # Emit core/v1 Events on bind/reclaim/restore (kube/events.py) — the
     # RBAC grant the reference carried but never exercised.
     enable_events: bool = True
+    # containerd NRI activation (nri/plugin.py): when set, the agent
+    # registers as an external NRI plugin on this socket and injects
+    # devices at CreateContainer — the containerd/GKE replacement for the
+    # hooks.d chain ("" = off).
+    nri_socket: str = ""
+    # host path of libtpu.so to bind-mount into TPU containers via NRI
+    # ("" = images ship their own).
+    nri_libtpu: str = ""
     # test seams
     kube_client: Optional[KubeClient] = None
     operator: object = None
@@ -157,6 +165,24 @@ class TPUManager:
         from .plugins.base import plugin_factory
 
         self.plugin = plugin_factory(opts.plugin_kind, self.config)
+        self.nri_plugin = None
+        if opts.nri_socket:
+            from .nri import NRIPlugin
+
+            # Mount.source in an NRI adjustment resolves in the HOST mount
+            # namespace; the agent's own view is under the /host hostPath
+            # prefix, so strip it for the mount source.
+            host_alloc = opts.alloc_spec_dir
+            if host_alloc.startswith("/host/"):
+                host_alloc = host_alloc[len("/host"):]
+            self.nri_plugin = NRIPlugin(
+                socket_path=opts.nri_socket,
+                alloc_spec_dir=opts.alloc_spec_dir,
+                host_alloc_dir=host_alloc,
+                dev_root=opts.dev_root,
+                libtpu_path=opts.nri_libtpu,
+                metrics=self.metrics,
+            )
         self._stop = threading.Event()
 
     # -- Restore (SURVEY.md §3.5: declared-but-unimplemented upstream) --------
@@ -312,6 +338,8 @@ class TPUManager:
         self._gc_thread = self.plugin.start_gc(self.gc_queue, self._stop)
         if hasattr(self.plugin, "start_health"):
             self._health_thread = self.plugin.start_health(self._stop)
+        if self.nri_plugin is not None:
+            self._nri_thread = self.nri_plugin.start(self._stop)
         if block:
             self._gc_thread.join()
 
@@ -328,6 +356,8 @@ class TPUManager:
         health_thread = getattr(self, "_health_thread", None)
         if health_thread is not None:
             health_thread.join(timeout=10.0)
+        if self.nri_plugin is not None:
+            self.nri_plugin.stop()
         if hasattr(self.plugin, "core"):
             self.plugin.core.stop_streams()
             self.plugin.memory.stop_streams()

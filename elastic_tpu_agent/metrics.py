@@ -60,6 +60,11 @@ class AgentMetrics:
             "Virtual device nodes re-created by restore()",
             **kw,
         )
+        self.nri_injections = Counter(
+            "elastic_tpu_nri_injections_total",
+            "Containers adjusted (devices injected) via the NRI plugin",
+            **kw,
+        )
 
     def observe_allocate(self, seconds: float) -> None:
         self.allocate_latency.observe(seconds)

@@ -1,0 +1,398 @@
+"""The NRI plugin: containerd-native device injection for elastic TPU pods.
+
+This is the containerd/GKE counterpart of the OCI hook chain
+(native/hook.cc + native/toolkit.cc): containerd does not read OCI
+hooks.d, so on containerd nodes the agent registers as an external NRI
+plugin on ``/var/run/nri/nri.sock`` and answers CreateContainer events
+with a ContainerAdjustment carrying exactly what the toolkit would have
+injected — dense ``/dev/accel<p>`` device nodes (major:minor resolved by
+stat of the allocation spec's host device paths), the spec's env
+(TPU_VISIBLE_CHIPS, HBM quota, slice topology), and bind mounts for the
+spec file and optionally ``libtpu.so``.
+
+Reference parity: the reference activates injection by *replacing the
+host's nvidia prestart hook binary* (``/root/reference/tools/install.sh:2-5``,
+exec'd from ``cmd/elastic-gpu-hook/main.go:224-257``). There is no TPU
+binary to swap and GKE's containerd ignores hooks.d, so speaking NRI is
+the TPU-native equivalent of that activation mechanism.
+
+Protocol (interop with github.com/containerd/nri): the plugin dials the
+runtime's socket, multiplexes two ttrpc connections over it (conn 1:
+runtime calls the Plugin service on us; conn 2: we call the Runtime
+service), registers itself, then the runtime drives
+Configure -> Synchronize -> per-event RPCs. Transport lives in
+``nri/mux.py`` + ``nri/ttrpc.py``; message shapes in ``protos/nri.proto``.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import socket
+import threading
+from typing import Callable, Dict, List, Optional
+
+from ..common import EnvAllocationHash, EnvAllocationHashCompat
+from ..gen import nri_pb2 as pb
+from . import mux as nri_mux
+from . import ttrpc
+
+logger = logging.getLogger(__name__)
+
+DEFAULT_NRI_SOCKET = "/var/run/nri/nri.sock"
+
+PLUGIN_SERVICE = "nri.pb.v1alpha1.Plugin"
+RUNTIME_SERVICE = "nri.pb.v1alpha1.Runtime"
+
+# ConfigureResponse.events bit for an Event enum value (upstream pkg/api:
+# bit (event-1)).
+def event_mask(*events: int) -> int:
+    mask = 0
+    for e in events:
+        mask |= 1 << (e - 1)
+    return mask
+
+
+# Where the spec file and libtpu land inside the container. The spec mount
+# mirrors toolkit.cc step 3 (it *copies* the spec into the rootfs; NRI can
+# only mount, same outcome for in-container tooling).
+SPEC_MOUNT_DEST = "/run/elastic-tpu/spec.json"
+DEFAULT_LIBTPU_DEST = "/lib/libtpu.so"
+
+_BIND_OPTS = ["bind", "ro", "nosuid", "nodev"]
+
+
+def hash_from_env(env: List[str]) -> Optional[str]:
+    """Extract the allocation hash from container env (``TPU=<hash>`` with
+    ``GPU=`` accepted for compatibility — same contract as the OCI hook,
+    native/hook.cc HashFromEnv)."""
+    for key in (EnvAllocationHash + "=", EnvAllocationHashCompat + "="):
+        for entry in env:
+            if entry.startswith(key):
+                value = entry[len(key):]
+                if value:
+                    return value
+    return None
+
+
+def adjustment_from_spec(
+    spec: Dict,
+    stat_fn: Callable = os.stat,
+    dev_root: str = "/dev",
+    libtpu_path: str = "",
+    libtpu_dest: str = DEFAULT_LIBTPU_DEST,
+    spec_path: str = "",
+) -> pb.ContainerAdjustment:
+    """Build the ContainerAdjustment equivalent to a toolkit.cc injection.
+
+    - one chardev per spec chip, densely renumbered ``/dev/accel<p>``
+      (toolkit.cc step 2), major:minor from stat of the host node;
+    - the spec's env verbatim (toolkit.cc step 3's env file, but injected
+      as real process env — strictly better);
+    - a read-only bind mount of the spec file (step 3's rootfs copy);
+    - optionally a read-only bind mount of libtpu.so (step 4's copy).
+
+    ``dev_root`` maps the spec's host paths into this process's mount view
+    (the agent sees the host's /dev at /host/dev in the DaemonSet).
+    """
+    adjust = pb.ContainerAdjustment()
+    adjust.annotations["elastic-tpu.elasticgpu.io/hash"] = spec.get("hash", "")
+    for p, host_path in enumerate(spec.get("device_paths", [])):
+        view = host_path
+        if dev_root != "/dev" and host_path.startswith("/dev/"):
+            view = os.path.join(dev_root, host_path[len("/dev/"):])
+        st = stat_fn(view)
+        rdev = getattr(st, "st_rdev", 0)
+        adjust.linux.devices.append(
+            pb.LinuxDevice(
+                path=f"/dev/accel{p}",
+                type="c",
+                major=os.major(rdev),
+                minor=os.minor(rdev),
+                file_mode=pb.OptionalFileMode(value=0o660),
+            )
+        )
+    for key in sorted(spec.get("env", {})):
+        adjust.env.append(pb.KeyValue(key=key, value=spec["env"][key]))
+    if spec_path:
+        adjust.mounts.append(
+            pb.Mount(
+                destination=SPEC_MOUNT_DEST,
+                type="bind",
+                source=spec_path,
+                options=list(_BIND_OPTS),
+            )
+        )
+    if libtpu_path:
+        adjust.mounts.append(
+            pb.Mount(
+                destination=libtpu_dest,
+                type="bind",
+                source=libtpu_path,
+                options=list(_BIND_OPTS),
+            )
+        )
+    return adjust
+
+
+class NRIPlugin:
+    """External NRI plugin: dial, register, serve CreateContainer.
+
+    Runs the whole lifetime in ``run(stop)`` with reconnect + backoff —
+    containerd restarts must not strand the injection path (the same
+    resilience the device-plugin servers get from their fsnotify
+    re-register loop, plugins/base.py).
+    """
+
+    RECONNECT_MIN_S = 1.0
+    RECONNECT_MAX_S = 30.0
+
+    def __init__(
+        self,
+        socket_path: str = DEFAULT_NRI_SOCKET,
+        alloc_spec_dir: str = "/host/var/lib/elastic-tpu/alloc",
+        host_alloc_dir: str = "",
+        plugin_name: str = "elastic-tpu",
+        plugin_idx: str = "10",
+        dev_root: str = "/dev",
+        libtpu_path: str = "",
+        libtpu_dest: str = DEFAULT_LIBTPU_DEST,
+        stat_fn: Callable = os.stat,
+        metrics=None,
+    ) -> None:
+        self._socket_path = socket_path
+        self._alloc_dir = alloc_spec_dir
+        # Specs are READ through the agent's mount view (alloc_spec_dir,
+        # typically /host/var/lib/...), but the adjustment's Mount.source
+        # is resolved by runc in the HOST mount namespace — it must be the
+        # host-side path or every TPU container create fails on a
+        # nonexistent bind source.
+        self._host_alloc_dir = host_alloc_dir or alloc_spec_dir
+        self._name = plugin_name
+        self._idx = plugin_idx
+        self._dev_root = dev_root
+        self._libtpu = libtpu_path
+        self._libtpu_dest = libtpu_dest
+        self._stat = stat_fn
+        self._metrics = metrics
+        self._mux: Optional[nri_mux.Mux] = None
+        self._server: Optional[ttrpc.Server] = None
+        self._mux_lock = threading.Lock()
+        self._stopping = False
+        # observability for tests / metrics
+        self.configured = threading.Event()
+        self.synchronized = threading.Event()
+        self.injected_count = 0
+
+    # -- spec loading ---------------------------------------------------------
+
+    def _spec_path(self, alloc_hash: str) -> str:
+        return os.path.join(self._alloc_dir, f"{alloc_hash}.json")
+
+    def _load_spec(self, alloc_hash: str) -> Dict:
+        # basename() defuses a hostile hash like "../x" before it becomes
+        # a path component.
+        path = self._spec_path(os.path.basename(alloc_hash))
+        with open(path) as f:
+            return json.load(f)
+
+    # -- Plugin service handlers ----------------------------------------------
+
+    def _on_configure(self, req: pb.ConfigureRequest) -> pb.ConfigureResponse:
+        logger.info(
+            "NRI: configured by %s %s", req.runtime_name, req.runtime_version
+        )
+        self.configured.set()
+        return pb.ConfigureResponse(
+            events=event_mask(pb.CREATE_CONTAINER)
+        )
+
+    def _on_synchronize(
+        self, req: pb.SynchronizeRequest
+    ) -> pb.SynchronizeResponse:
+        # Existing containers were created before we connected; their device
+        # nodes were injected by whichever path was active then (or the pod
+        # predates the agent — nothing NRI can retrofit at this point, the
+        # adjustment API only exists at create time). Log the TPU ones so a
+        # restart that raced container creation is visible.
+        stale = [
+            f"{c.pod_sandbox_id[:8]}/{c.name}"
+            for c in req.containers
+            if hash_from_env(list(c.env))
+        ]
+        if stale:
+            logger.info(
+                "NRI: %d pre-existing TPU container(s): %s",
+                len(stale), ", ".join(stale),
+            )
+        self.synchronized.set()
+        return pb.SynchronizeResponse(more=req.more)
+
+    def _on_create_container(
+        self, req: pb.CreateContainerRequest
+    ) -> pb.CreateContainerResponse:
+        alloc_hash = hash_from_env(list(req.container.env))
+        if alloc_hash is None:
+            return pb.CreateContainerResponse()  # not ours: no adjustment
+        try:
+            spec = self._load_spec(alloc_hash)
+        except (OSError, ValueError) as e:
+            # Fail the create rather than let a TPU pod start deviceless —
+            # kubelet will retry and the error names the missing spec
+            # (the OCI toolkit fails the prestart the same way).
+            raise RuntimeError(
+                f"allocation spec for hash {alloc_hash!r} unreadable: {e}"
+            )
+        adjust = adjustment_from_spec(
+            spec,
+            stat_fn=self._stat,
+            dev_root=self._dev_root,
+            libtpu_path=self._libtpu,
+            libtpu_dest=self._libtpu_dest,
+            spec_path=os.path.join(
+                self._host_alloc_dir,
+                f"{os.path.basename(alloc_hash)}.json",
+            ),
+        )
+        self.injected_count += 1
+        if self._metrics is not None and hasattr(self._metrics, "nri_injections"):
+            self._metrics.nri_injections.inc()
+        logger.info(
+            "NRI: injected %d device(s) for %s/%s (hash %s)",
+            len(adjust.linux.devices), req.pod.namespace, req.pod.name,
+            alloc_hash,
+        )
+        return pb.CreateContainerResponse(adjust=adjust)
+
+    def _on_shutdown(self, req: pb.Empty) -> pb.Empty:  # noqa: ARG002
+        logger.info("NRI: runtime requested shutdown")
+        # End the session only after the response frame is written; run()
+        # decides whether to reconnect.
+        if self._server is not None:
+            self._server.stop_after_reply()
+        return pb.Empty()
+
+    def _on_noop_update(
+        self, req: pb.UpdateContainerRequest  # noqa: ARG002
+    ) -> pb.UpdateContainerResponse:
+        return pb.UpdateContainerResponse()
+
+    def _on_noop_stop(
+        self, req: pb.StopContainerRequest  # noqa: ARG002
+    ) -> pb.StopContainerResponse:
+        return pb.StopContainerResponse()
+
+    def _on_state_change(self, req: pb.StateChangeEvent) -> pb.Empty:  # noqa: ARG002
+        return pb.Empty()
+
+    # -- connection lifecycle -------------------------------------------------
+
+    def _register_handlers(self, server: ttrpc.Server) -> None:
+        server.register(
+            PLUGIN_SERVICE, "Configure", pb.ConfigureRequest,
+            self._on_configure,
+        )
+        server.register(
+            PLUGIN_SERVICE, "Synchronize", pb.SynchronizeRequest,
+            self._on_synchronize,
+        )
+        server.register(
+            PLUGIN_SERVICE, "CreateContainer", pb.CreateContainerRequest,
+            self._on_create_container,
+        )
+        server.register(
+            PLUGIN_SERVICE, "Shutdown", pb.Empty, self._on_shutdown,
+        )
+        server.register(
+            PLUGIN_SERVICE, "UpdateContainer", pb.UpdateContainerRequest,
+            self._on_noop_update,
+        )
+        server.register(
+            PLUGIN_SERVICE, "StopContainer", pb.StopContainerRequest,
+            self._on_noop_stop,
+        )
+        server.register(
+            PLUGIN_SERVICE, "StateChange", pb.StateChangeEvent,
+            self._on_state_change,
+        )
+
+    def serve_once(self) -> None:
+        """One connection lifetime: dial, register, serve until close."""
+        sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        sock.connect(self._socket_path)
+        mux = nri_mux.Mux(sock)
+        # Open both logical conns BEFORE the reader starts — frames for
+        # unopened conns are dropped (mux.py).
+        plugin_ch = mux.open(nri_mux.PLUGIN_SERVICE_CONN)
+        runtime_ch = mux.open(nri_mux.RUNTIME_SERVICE_CONN)
+        with self._mux_lock:
+            self._mux = mux
+        mux.start()
+        server = ttrpc.Server(plugin_ch)
+        self._server = server
+        self._register_handlers(server)
+        serve_thread = threading.Thread(
+            target=server.serve_forever, name="nri-plugin-serve", daemon=True
+        )
+        serve_thread.start()
+        try:
+            client = ttrpc.Client(runtime_ch)
+            client.call(
+                RUNTIME_SERVICE, "RegisterPlugin",
+                pb.RegisterPluginRequest(
+                    plugin_name=self._name, plugin_idx=self._idx
+                ),
+                pb.Empty,
+            )
+            logger.info(
+                "NRI: registered as %s-%s on %s",
+                self._idx, self._name, self._socket_path,
+            )
+            serve_thread.join()  # session lifetime
+        except ttrpc.ChannelClosed:
+            pass  # runtime went away mid-handshake; run() retries
+        finally:
+            # Every exit — including a registration rejection (TtrpcError)
+            # propagating to run()'s retry loop — must close the mux, or
+            # each reconnect attempt would leak the socket plus the reader
+            # and serve threads left blocked on it.
+            mux.close()  # unblocks serve_forever via ChannelClosed
+            serve_thread.join(timeout=5.0)
+            with self._mux_lock:
+                self._mux = None
+                self._server = None
+
+    def _close_mux(self) -> None:
+        with self._mux_lock:
+            if self._mux is not None:
+                self._mux.close()
+
+    def run(self, stop: threading.Event) -> None:
+        """Serve with reconnect + exponential backoff until ``stop``."""
+        backoff = self.RECONNECT_MIN_S
+        while not stop.is_set() and not self._stopping:
+            try:
+                self.serve_once()
+                backoff = self.RECONNECT_MIN_S  # had a real session
+            except OSError as e:
+                logger.warning(
+                    "NRI: connect to %s failed: %s (retry in %.0fs)",
+                    self._socket_path, e, backoff,
+                )
+            except Exception:  # noqa: BLE001 - never kill the agent
+                logger.exception("NRI: session failed")
+            if stop.wait(backoff):
+                return
+            backoff = min(backoff * 2, self.RECONNECT_MAX_S)
+
+    def start(self, stop: threading.Event) -> threading.Thread:
+        t = threading.Thread(
+            target=self.run, args=(stop,), daemon=True, name="nri-plugin"
+        )
+        t.start()
+        return t
+
+    def stop(self) -> None:
+        self._stopping = True
+        self._close_mux()

@@ -20,6 +20,7 @@ errors it can attribute to a stream).
 from __future__ import annotations
 
 import logging
+import socket
 import struct
 import threading
 from typing import Callable, Dict, Optional, Tuple
@@ -91,6 +92,14 @@ class SocketChannel(Channel):
         return buf
 
     def close(self) -> None:
+        # shutdown() before close(): a close alone neither wakes a reader
+        # thread blocked in recv (it holds a kernel reference to the file,
+        # deferring release) nor sends FIN to the peer — both ends of the
+        # NRI socket would hang forever instead of reconnecting.
+        try:
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
         try:
             self._sock.close()
         except OSError:
@@ -162,11 +171,18 @@ class Server:
         self._ch = channel
         self._handlers: Dict[Tuple[str, str], Tuple[Handler, type]] = {}
         self._wlock = threading.Lock()
+        self._stop_after_reply = False
 
     def register(self, service: str, method: str, request_cls,
                  handler: Callable) -> None:
         """handler(request_msg) -> response protobuf message."""
         self._handlers[(service, method)] = (handler, request_cls)
+
+    def stop_after_reply(self) -> None:
+        """Make serve_forever return once the in-flight response is written
+        — lets a Shutdown handler end the session without racing its own
+        response frame out of the connection."""
+        self._stop_after_reply = True
 
     def serve_forever(self) -> None:
         """Blocking dispatch loop; returns when the channel closes."""
@@ -210,6 +226,8 @@ class Server:
                     self._ch, sid, MESSAGE_TYPE_RESPONSE,
                     resp.SerializeToString(),
                 )
+            if self._stop_after_reply:
+                return
 
     def _respond_error(self, sid: int, code: int, message: str) -> None:
         resp = ttrpc_pb2.Response(

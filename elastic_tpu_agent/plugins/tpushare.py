@@ -66,7 +66,7 @@ from ..kube.locator import DeviceLocator, LocateError
 from ..qos import qos_env
 from ..slice_env import slice_env_for_pod
 from ..tpu.topology import chip_grid, ici_distance
-from ..types import AllocationRecord, Device, PodInfo
+from ..types import AllocationRecord, Device, PodContainer, PodInfo
 from .base import DevicePluginServer, PluginConfig
 
 logger = logging.getLogger(__name__)
@@ -561,11 +561,25 @@ class _TPUSharePluginBase(_ListAndWatchMixin, rpc.DevicePluginServicer):
         # load_or_create/save below is a read-modify-write that would lose
         # one record. Binds are rare; global lock contention is noise.
         with _SPEC_MERGE_LOCK:
+            own_path = os.path.join(self._alloc_dir, f"{device.hash}.json")
+            fresh_bind = not os.path.exists(own_path)
             try:
                 self._write_alloc_spec(
                     device, owner, chip_indexes, annotations, pod
                 )
             except Exception:
+                # Sibling files are merged before the own file lands; a
+                # mid-write failure may have left them naming this failed
+                # allocation — restore them before surfacing the error.
+                # Only for a FRESH bind though: a transient failure while
+                # re-binding (container restart) must leave the previous,
+                # still-valid on-disk specs alone.
+                if fresh_bind:
+                    try:
+                        os.unlink(own_path)
+                    except OSError:
+                        pass
+                    self._restore_sibling_specs(owner, device.hash)
                 self._rollback_created(created)
                 raise
 
@@ -690,6 +704,14 @@ class _TPUSharePluginBase(_ListAndWatchMixin, rpc.DevicePluginServicer):
         # the storage save that makes the allocation visible to siblings.
         os.makedirs(self._alloc_dir, exist_ok=True)
         payload = self._spec_payload(device, owner, chip_indexes, annotations, pod)
+        # Pre-merge snapshot: lets a later single-resource release restore
+        # the surviving sibling's spec to exactly this content instead of
+        # leaving it naming the released allocation's chips/env.
+        payload["own"] = {
+            "chip_indexes": list(payload["chip_indexes"]),
+            "device_paths": list(payload["device_paths"]),
+            "env": dict(payload["env"]),
+        }
         for sib in self._sibling_specs(owner):
             payload, merged_sib = _merge_spec_payloads(payload, sib)
             _write_json_atomic(
@@ -700,11 +722,41 @@ class _TPUSharePluginBase(_ListAndWatchMixin, rpc.DevicePluginServicer):
             os.path.join(self._alloc_dir, f"{device.hash}.json"), payload
         )
 
-    def remove_alloc_spec(self, alloc_hash: str) -> None:
+    def _restore_sibling_specs(self, owner, released_hash: str) -> None:
+        """(_SPEC_MERGE_LOCK held) Rewrite the container's surviving
+        sibling specs from their pre-merge ``own`` snapshots, so the
+        released allocation's devices/env stop appearing in them (the
+        stale-union defect, ADVICE r2/r3)."""
+        info = self._storage.load(owner.namespace, owner.name)
+        siblings = info.allocations.get(owner.container, {}) if info else {}
+        for rec in siblings.values():
+            if rec.device.hash == released_hash:
+                continue
+            path = os.path.join(self._alloc_dir, f"{rec.device.hash}.json")
+            try:
+                with open(path) as f:
+                    spec = json.load(f)
+            except (OSError, ValueError):
+                continue
+            own = spec.get("own")
+            if not own:
+                continue
+            restored = dict(spec)
+            restored.update(own)
+            restored["resources"] = [restored.get("resource", "")]
+            _write_json_atomic(path, restored)
+
+    def remove_alloc_spec(self, alloc_hash: str, owner=None) -> None:
+        """Unlink an allocation's spec; when ``owner`` is given, also
+        restore the container's surviving sibling specs to their own
+        (unmerged) content."""
         try:
             os.unlink(os.path.join(self._alloc_dir, f"{alloc_hash}.json"))
         except FileNotFoundError:
             pass
+        if owner is not None:
+            with _SPEC_MERGE_LOCK:
+                self._restore_sibling_specs(owner, alloc_hash)
 
 
 class TPUShareCorePlugin(_TPUSharePluginBase):
@@ -714,8 +766,22 @@ class TPUShareCorePlugin(_TPUSharePluginBase):
 
     def _device_list(self) -> List[dp.Device]:
         out = []
+        whole_chip = not getattr(self._operator, "virtual_nodes", True)
         for chip in self._chips.values():
             health = self._chip_health(chip.index)
+            if whole_chip:
+                # One advertised device == one physical chip (the reference
+                # no-op operator's shape, pkg/operator/nvidia.go:1-22).
+                # Advertising 100 fractional units here would let kubelet
+                # split one chip's units across two pods, each of which
+                # would then receive the whole /dev/accelN — defeating the
+                # mode's exclusivity promise (ADVICE r2/r3).
+                out.append(
+                    dp.Device(
+                        ID=core_device_id(chip.index, 0), health=health
+                    )
+                )
+                continue
             for unit in range(TPUPercentEachChip):
                 out.append(
                     dp.Device(
@@ -725,6 +791,8 @@ class TPUShareCorePlugin(_TPUSharePluginBase):
         return out
 
     def _chips_for_request(self, n_ids: int) -> int:
+        if not getattr(self._operator, "virtual_nodes", True):
+            return max(1, n_ids)  # whole-chip: one id == one chip
         return max(1, math.ceil(n_ids / TPUPercentEachChip))
 
     def _alloc_envs(self, device: Device, n_chips: int) -> Dict[str, str]:
@@ -770,6 +838,15 @@ class TPUShareCorePlugin(_TPUSharePluginBase):
         ]
 
     def _qos_kwargs(self, device: Device) -> Dict:
+        if not getattr(self._operator, "virtual_nodes", True):
+            # Whole-chip: one advertised id == one chip == 100% of it. The
+            # qos contract ("core share in 1% units", qos.py) would
+            # otherwise read an exclusive pod as a 1% share and a
+            # duty-cycle-honoring runtime would throttle it to nothing.
+            n = len(
+                [c for c in self._chips_from_ids(device) if c in self._chips]
+            ) or len(device.ids)
+            return {"core_units": TPUPercentEachChip * n}
         return {"core_units": len(device.ids)}
 
 
@@ -938,17 +1015,23 @@ class TPUSharePlugin:
         for key, info in list(storage.items()):
             if not self._pod_is_gone(info.namespace, info.name):
                 continue
-            for record in info.records():
-                for link_id in record.created_node_ids:
-                    try:
-                        operator.delete(link_id)
-                    except Exception:  # noqa: BLE001
-                        logger.warning("GC: failed deleting node %s", link_id)
-                self.core.remove_alloc_spec(record.device.hash)
-                if self._config.crd_recorder is not None:
-                    self._config.crd_recorder.record_released(
-                        record.device.hash
-                    )
+            for container, by_resource in info.allocations.items():
+                owner = PodContainer(info.namespace, info.name, container)
+                for record in by_resource.values():
+                    for link_id in record.created_node_ids:
+                        try:
+                            operator.delete(link_id)
+                        except Exception:  # noqa: BLE001
+                            logger.warning(
+                                "GC: failed deleting node %s", link_id
+                            )
+                    # owner passed so a sibling that outlives this unlink
+                    # (iteration order) never names the freed devices
+                    self.core.remove_alloc_spec(record.device.hash, owner)
+                    if self._config.crd_recorder is not None:
+                        self._config.crd_recorder.record_released(
+                            record.device.hash
+                        )
             storage.delete(info.namespace, info.name)
             reclaimed += 1
             events = self._config.events

@@ -292,11 +292,29 @@ class PodResourcesClient:
         )
         return (list_fn, pr.ListPodResourcesRequest, None, "v1alpha1")
 
+    # Codes that mean "the kubelet (or the wire) is broken right now", not
+    # "this RPC isn't served": negotiation must re-raise these and retry
+    # later instead of concluding anything about the API version.
+    _TRANSPORT_CODES = frozenset({
+        grpc.StatusCode.UNAVAILABLE,
+        grpc.StatusCode.DEADLINE_EXCEEDED,
+        grpc.StatusCode.CANCELLED,
+    })
+
     def _ensure(self, timeout_s: float) -> tuple:
         """Return the negotiated binding tuple, dialing + version-probing
         if needed (thread-safe). The probe is GetAllocatableResources — a
         tiny response, unlike a full-node List — which a v1alpha1-only
-        kubelet rejects with UNIMPLEMENTED."""
+        kubelet rejects with UNIMPLEMENTED.
+
+        k8s 1.21-1.22 wrinkle: those kubelets serve v1 List but answer the
+        probe with a NON-UNIMPLEMENTED error when the
+        KubeletPodResourcesGetAllocatable gate is off. Treating that as
+        fatal would strand the locator on a kubelet whose List works fine,
+        so on any non-transport probe failure the v1 List itself is probed
+        to separate "v1 with allocatable disabled" (bind v1, allocatable
+        marked unavailable) from "no v1 at all" (fall back to v1alpha1).
+        """
         with self._lock:
             if self._bound is None:
                 channel = grpc.insecure_channel(
@@ -312,8 +330,24 @@ class PodResourcesClient:
                 except grpc.RpcError as e:
                     if e.code() == grpc.StatusCode.UNIMPLEMENTED:
                         bound = self._bind_v1alpha1(channel)
-                    else:
+                    elif e.code() in self._TRANSPORT_CODES:
                         raise
+                    else:
+                        try:
+                            bound[0](
+                                prv1.ListPodResourcesRequest(),
+                                timeout=timeout_s,
+                            )
+                            # v1 List works; only allocatable is gated off
+                            bound = (bound[0], bound[1], None, "v1")
+                        except grpc.RpcError as e2:
+                            if (
+                                e2.code()
+                                == grpc.StatusCode.UNIMPLEMENTED
+                            ):
+                                bound = self._bind_v1alpha1(channel)
+                            else:
+                                raise
                 self._bound = bound
             return self._bound
 

@@ -115,6 +115,50 @@ def _counter_files(chip_dir: str):
                 yield root, name
 
 
+def read_counter_file(path: str) -> Optional[int]:
+    """Reduce a sysfs error-counter file to one integer.
+
+    Two real-world shapes: a plain single integer (simple driver
+    counters), and the PCIe AER table — one ``ERROR_NAME count`` pair per
+    line with a ``TOTAL_ERR_*`` summary row, e.g.::
+
+        TLP 0
+        FCP 1
+        CmpltTO 0
+        TOTAL_ERR_FATAL 1
+
+    The AER parse prefers the TOTAL row and otherwise sums the per-error
+    rows. (int(read) on the whole file — the previous behavior — raised
+    on every real aer_dev_fatal/aer_dev_uncorrectable and silently
+    disabled the signal the code targets; ADVICE r2/r3.)
+    Returns None for unreadable/unparseable content."""
+    try:
+        with open(path) as f:
+            raw = f.read().strip()
+    except OSError:
+        return None
+    if not raw:
+        return None
+    try:
+        return int(raw)
+    except ValueError:
+        pass
+    total, matched = 0, False
+    for line in raw.splitlines():
+        parts = line.split()
+        if len(parts) < 2:
+            continue
+        try:
+            value = int(parts[-1], 0)
+        except ValueError:
+            continue
+        if parts[0].startswith("TOTAL_ERR"):
+            return value
+        total += value
+        matched = True
+    return total if matched else None
+
+
 def parse_tpu_env(raw: str) -> Dict[str, str]:
     """Parse the metadata ``tpu-env`` attribute: lines of KEY: 'value'."""
     out: Dict[str, str] = {}
@@ -169,6 +213,10 @@ class TPUVMOperator(LinkingOperator):
         self._error_chips: set = set()
         self._ever_present: set = set()
         self._health_reasons: Dict[int, str] = {}
+        # chip -> the reason it entered _error_chips; never cleared while
+        # the chip stays sticky, so a counter re-baseline (driver reload)
+        # can't replace the specific cause with a generic one.
+        self._sticky_reasons: Dict[int, str] = {}
 
     # -- inventory sources ---------------------------------------------------
 
@@ -299,10 +347,8 @@ class TPUVMOperator(LinkingOperator):
                 if not any(p in name for p in self._counter_patterns):
                     continue
                 path = os.path.join(root, name)
-                try:
-                    with open(path) as f:
-                        value = int(f.read().strip())
-                except (OSError, ValueError):
+                value = read_counter_file(path)
+                if value is None:
                     continue
                 if path not in base:
                     base[path] = value
@@ -314,7 +360,7 @@ class TPUVMOperator(LinkingOperator):
                             value,
                         )
                     self._error_chips.add(i)
-                    self._health_reasons[i] = (
+                    self._sticky_reasons[i] = (
                         f"fatal error counter {name} rose to {value}"
                     )
                 elif value < base[path]:
@@ -331,17 +377,25 @@ class TPUVMOperator(LinkingOperator):
         event (checkpoint/resume is the recovery path)."""
         present = self._accel_indexes()
         self._ever_present.update(present)
-        self._health_reasons = {
+        reasons = {
             i: "device node missing"
             for i in self._ever_present if i not in present
         }
         if self._maintenance_imminent():
             for i in present:
-                self._health_reasons[i] = (
+                reasons[i] = (
                     f"host maintenance event: {self._maint_cached}"
                 )
+            # error chips keep their specific cause even through an event
+            reasons.update(self._sticky_reasons)
+            self._health_reasons = reasons
             return set()
         self._scan_error_counters(present)
+        for i in self._error_chips:
+            reasons[i] = self._sticky_reasons.get(
+                i, "reported unhealthy by operator"
+            )
+        self._health_reasons = reasons
         return set(present) - self._error_chips
 
     def health_reasons(self) -> Dict[int, str]:

@@ -29,4 +29,26 @@ cat > "$HOOK_DIR/10-elastic-tpu.json" <<'EOF'
   "stages": ["createRuntime", "prestart"]
 }
 EOF
+# containerd + runc (the GKE default) ignores hooks.d; there the agent
+# injects via NRI instead (elastic_tpu_agent/nri/, --nri-socket flag on
+# the DaemonSet). NRI ships in containerd >= 1.7 but is disabled by
+# default before 2.0; ENABLE_NRI=1 enables it via a config edit.
+if [ "${ENABLE_NRI:-0}" = "1" ]; then
+    CTRD_CONF="$HOST_ROOT/etc/containerd/config.toml"
+    if [ -f "$CTRD_CONF" ] && \
+       ! grep -q 'io.containerd.nri.v1.nri' "$CTRD_CONF"; then
+        cp "$CTRD_CONF" "$CTRD_CONF.elastic-tpu.bak"
+        cat >> "$CTRD_CONF" <<'EOF'
+
+# added by elastic-tpu-agent installer: enable NRI for device injection
+[plugins."io.containerd.nri.v1.nri"]
+  disable = false
+  disable_connections = false
+  socket_path = "/var/run/nri/nri.sock"
+EOF
+        echo "enabled NRI in $CTRD_CONF (backup: $CTRD_CONF.elastic-tpu.bak);"
+        echo "restart containerd for it to take effect"
+    fi
+fi
+
 echo "elastic-tpu host helpers installed under $HOST_ROOT/usr/local/bin"

@@ -42,6 +42,10 @@ class FakeKubelet:
         self.api_versions = ("v1", "v1alpha1")
         # resource -> [device ids] advertised via v1 GetAllocatableResources
         self.allocatable: Dict[str, List[str]] = {}
+        # simulate k8s 1.21-1.22 with KubeletPodResourcesGetAllocatable
+        # off: v1 List served, GetAllocatableResources errors (UNKNOWN,
+        # like the real kubelet's plain-error answer)
+        self.allocatable_disabled = False
 
     # -- lifecycle ------------------------------------------------------------
 
@@ -183,6 +187,10 @@ class FakeKubelet:
         )
 
     def _allocatable_v1(self) -> prv1.AllocatableResourcesResponse:
+        if self.allocatable_disabled:
+            raise RuntimeError(
+                "Pod Resources API GetAllocatableResources disabled"
+            )
         with self._lock:
             items = sorted(self.allocatable.items())
         return prv1.AllocatableResourcesResponse(

@@ -269,7 +269,11 @@ def test_whole_chip_exclusive_operator(tmp_path):
         assert wait_until(
             lambda: c.manager.sitter.get_pod("default", "whole") is not None
         )
-        ids = [core_device_id(1, u) for u in range(100)]
+        # Whole-chip advertisement is ONE device per chip — kubelet cannot
+        # split a chip between pods (ADVICE r2/r3 exclusivity fix).
+        adv = c.manager.plugin.core._device_list()
+        assert [d.ID for d in adv] == [core_device_id(i, 0) for i in range(4)]
+        ids = [core_device_id(1, 0)]
         resp = c.kubelet.kubelet_allocate_flow(
             CORE_ENDPOINT, "default", "whole", "jax", ResourceTPUCore, ids
         )
@@ -278,6 +282,8 @@ def test_whole_chip_exclusive_operator(tmp_path):
         assert [d.host_path for d in cresp.devices] == ["/dev/accel1"]
         assert cresp.devices[0].container_path == "/dev/accel0"
         assert cresp.envs["TPU_VISIBLE_CHIPS"] == "0"
+        # whole-chip == 100% share, not "1 unit of 100" (review r4)
+        assert cresp.envs["ELASTIC_TPU_CORE_UNITS"] == "100"
         # no symlinks were materialized
         assert c.manager.operator.list_links() == []
         # binding recorded with the id-derived chip
@@ -314,9 +320,8 @@ def test_whole_chip_split_allocation_env_matches_devices(tmp_path):
         assert wait_until(
             lambda: c.manager.sitter.get_pod("default", "split") is not None
         )
-        ids = [core_device_id(0, u) for u in range(50)] + [
-            core_device_id(1, u) for u in range(50)
-        ]
+        # a pod holding two whole chips (one advertised device each)
+        ids = [core_device_id(0, 0), core_device_id(1, 0)]
         resp = c.kubelet.kubelet_allocate_flow(
             CORE_ENDPOINT, "default", "split", "jax", ResourceTPUCore, ids
         )

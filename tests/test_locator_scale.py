@@ -100,6 +100,27 @@ def test_client_negotiates_expected_version(kubelet):
     assert client.api_version == expected
 
 
+def test_gate_off_kubelet_still_negotiates_v1(tmp_path):
+    """k8s 1.21-1.22 with KubeletPodResourcesGetAllocatable off: the
+    version probe fails with a non-UNIMPLEMENTED error while v1 List works
+    — the client must bind v1 (allocatable marked unavailable), not
+    re-raise on every call (ADVICE r2/r3: rpc.py v1-negotiation gap)."""
+    k = FakeKubelet(str(tmp_path / "dp"), str(tmp_path / "pr" / "kubelet.sock"))
+    k.allocatable_disabled = True
+    k.start()
+    try:
+        k.assign("ns", "p", "jax", RESOURCE, _ids(1))
+        client = CountingClient(k.pod_resources_socket)
+        loc = KubeletDeviceLocator(RESOURCE, client)
+        assert loc.locate(Device(_ids(1), RESOURCE)).name == "p"
+        assert client.api_version == "v1"
+        # allocatable reads as unknown, and does NOT poison the channel
+        assert client.get_allocatable_resources() is None
+        assert loc.locate(Device(_ids(1), RESOURCE)).name == "p"
+    finally:
+        k.stop()
+
+
 def test_allocatable_resources_v1_only(kubelet):
     kubelet.allocatable[RESOURCE] = [f"tpu-core-{c}-{u}"
                                      for c in range(4) for u in range(100)]

@@ -1,0 +1,420 @@
+"""End-to-end tests for the containerd NRI activation path.
+
+A fake NRI *runtime* (the containerd side) listens on a real unix socket
+and speaks the genuine wire protocol — the connection multiplexer framing
+(nri/mux.py) carrying two ttrpc connections (nri/ttrpc.py) — so the whole
+plugin stack from socket bytes up through ContainerAdjustment is
+exercised with no hooks.d involvement anywhere.
+
+The adjustment content is asserted against the same contract
+native/toolkit.cc implements (dense /dev/accel<p>, spec env, libtpu):
+the two activation paths must inject identically.
+"""
+
+import json
+import os
+import socket
+import threading
+
+import pytest
+
+from elastic_tpu_agent.common import EnvTPUVisibleChips
+from elastic_tpu_agent.gen import nri_pb2 as pb
+from elastic_tpu_agent.nri import NRIPlugin, adjustment_from_spec
+from elastic_tpu_agent.nri import mux as nri_mux
+from elastic_tpu_agent.nri import ttrpc
+from elastic_tpu_agent.nri.plugin import (
+    PLUGIN_SERVICE,
+    RUNTIME_SERVICE,
+    SPEC_MOUNT_DEST,
+    event_mask,
+    hash_from_env,
+)
+
+
+class FakeStat:
+    """st_rdev carrier for the injected stat seam (tests can't mknod)."""
+
+    def __init__(self, major, minor):
+        self.st_rdev = os.makedev(major, minor)
+
+
+def fake_stat_table(table):
+    def stat_fn(path):
+        if path not in table:
+            raise FileNotFoundError(path)
+        return table[path]
+
+    return stat_fn
+
+
+class FakeNRIRuntime:
+    """containerd's side of the NRI socket, over the real framing.
+
+    Mirrors the adaptation's external-plugin accept path: accept the
+    connection, wait for RegisterPlugin on the Runtime service (conn 2),
+    then drive Configure / Synchronize / per-event calls on the Plugin
+    service (conn 1)."""
+
+    def __init__(self, socket_path):
+        self.socket_path = socket_path
+        self._listener = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        self._listener.bind(socket_path)
+        self._listener.listen(2)
+        self._listener.settimeout(5.0)
+        self.registered = threading.Event()
+        self.register_request = None
+        self.mux = None
+        self.client = None
+
+    def accept(self):
+        conn, _ = self._listener.accept()
+        self.registered.clear()
+        self.mux = nri_mux.Mux(conn)
+        plugin_ch = self.mux.open(nri_mux.PLUGIN_SERVICE_CONN)
+        runtime_ch = self.mux.open(nri_mux.RUNTIME_SERVICE_CONN)
+        server = ttrpc.Server(runtime_ch)
+        server.register(
+            RUNTIME_SERVICE, "RegisterPlugin", pb.RegisterPluginRequest,
+            self._on_register,
+        )
+        threading.Thread(target=server.serve_forever, daemon=True).start()
+        self.mux.start()
+        self.client = ttrpc.Client(plugin_ch)
+
+    def _on_register(self, req):
+        self.register_request = req
+        self.registered.set()
+        return pb.Empty()
+
+    def configure(self, runtime_name="fake-containerd", version="v9"):
+        return self.client.call(
+            PLUGIN_SERVICE, "Configure",
+            pb.ConfigureRequest(
+                runtime_name=runtime_name, runtime_version=version
+            ),
+            pb.ConfigureResponse,
+        )
+
+    def synchronize(self, pods=(), containers=()):
+        return self.client.call(
+            PLUGIN_SERVICE, "Synchronize",
+            pb.SynchronizeRequest(pods=pods, containers=containers),
+            pb.SynchronizeResponse,
+        )
+
+    def create_container(self, env, pod_name="train", namespace="ml"):
+        return self.client.call(
+            PLUGIN_SERVICE, "CreateContainer",
+            pb.CreateContainerRequest(
+                pod=pb.PodSandbox(
+                    id="sandbox-1", name=pod_name, namespace=namespace
+                ),
+                container=pb.Container(
+                    id="ctr-1", pod_sandbox_id="sandbox-1", name="main",
+                    env=list(env),
+                ),
+            ),
+            pb.CreateContainerResponse,
+        )
+
+    def shutdown_plugin(self):
+        return self.client.call(
+            PLUGIN_SERVICE, "Shutdown", pb.Empty(), pb.Empty
+        )
+
+    def close(self):
+        if self.mux is not None:
+            self.mux.close()
+        self._listener.close()
+
+
+SPEC = {
+    "hash": "ab12cd34",
+    "resource": "elasticgpu.io/tpu-core",
+    "namespace": "ml",
+    "pod": "train",
+    "container": "main",
+    "chip_indexes": [2, 3],
+    "device_paths": ["/dev/accel2", "/dev/accel3"],
+    "env": {
+        EnvTPUVisibleChips: "0,1",
+        "TPU_VISIBLE_DEVICES": "0,1",
+        "TPU_CORE_UNITS": "200",
+    },
+}
+
+DEV_TABLE = {
+    "/dev/accel2": FakeStat(120, 2),
+    "/dev/accel3": FakeStat(120, 3),
+}
+
+
+@pytest.fixture
+def alloc_dir(tmp_path):
+    d = tmp_path / "alloc"
+    d.mkdir()
+    with open(d / f"{SPEC['hash']}.json", "w") as f:
+        json.dump(SPEC, f)
+    return str(d)
+
+
+@pytest.fixture
+def runtime(tmp_path):
+    rt = FakeNRIRuntime(str(tmp_path / "nri.sock"))
+    yield rt
+    rt.close()
+
+
+@pytest.fixture
+def plugin(runtime, alloc_dir, tmp_path):
+    p = NRIPlugin(
+        socket_path=runtime.socket_path,
+        alloc_spec_dir=alloc_dir,
+        libtpu_path=str(tmp_path / "libtpu.so"),
+        stat_fn=fake_stat_table(DEV_TABLE),
+    )
+    stop = threading.Event()
+    thread = p.start(stop)
+    runtime.accept()
+    assert runtime.registered.wait(5.0)
+    yield p
+    stop.set()
+    p.stop()
+    thread.join(timeout=5.0)
+
+
+def test_registration_identity(runtime, plugin):
+    req = runtime.register_request
+    assert req.plugin_name == "elastic-tpu"
+    assert req.plugin_idx == "10"
+
+
+def test_configure_subscribes_create_container(runtime, plugin):
+    resp = runtime.configure()
+    assert resp.events & event_mask(pb.CREATE_CONTAINER)
+    # create-only injector: no other lifecycle subscriptions
+    assert resp.events == event_mask(pb.CREATE_CONTAINER)
+    assert plugin.configured.is_set()
+
+
+def test_synchronize_reports_existing(runtime, plugin):
+    existing = pb.Container(
+        id="old", pod_sandbox_id="s0", name="old-tpu",
+        env=[f"TPU={SPEC['hash']}"],
+    )
+    resp = runtime.synchronize(containers=[existing])
+    assert list(resp.update) == []  # nothing retrofittable at sync time
+    assert plugin.synchronized.is_set()
+
+
+def test_create_container_injects_toolkit_equivalent(
+    runtime, plugin, alloc_dir, tmp_path
+):
+    """The adjustment must match what native/toolkit.cc injects: dense
+    /dev/accel<p> chardevs with the host nodes' major:minor, the spec env,
+    and the spec + libtpu mounts."""
+    runtime.configure()
+    resp = runtime.create_container([f"TPU={SPEC['hash']}", "FOO=bar"])
+    adjust = resp.adjust
+
+    devices = list(adjust.linux.devices)
+    assert [d.path for d in devices] == ["/dev/accel0", "/dev/accel1"]
+    assert [(d.major, d.minor) for d in devices] == [(120, 2), (120, 3)]
+    assert all(d.type == "c" for d in devices)
+
+    env = {kv.key: kv.value for kv in adjust.env}
+    assert env == SPEC["env"]
+
+    mounts = {m.destination: m for m in adjust.mounts}
+    spec_mount = mounts[SPEC_MOUNT_DEST]
+    assert spec_mount.source == os.path.join(alloc_dir, f"{SPEC['hash']}.json")
+    assert "ro" in spec_mount.options
+    libtpu = mounts["/lib/libtpu.so"]
+    assert libtpu.source == str(tmp_path / "libtpu.so")
+
+    assert adjust.annotations["elastic-tpu.elasticgpu.io/hash"] == SPEC["hash"]
+    assert plugin.injected_count == 1
+
+
+def test_create_container_gpu_compat_env(runtime, plugin):
+    resp = runtime.create_container([f"GPU={SPEC['hash']}"])
+    assert len(resp.adjust.linux.devices) == 2
+
+
+def test_create_container_passthrough_without_hash(runtime, plugin):
+    resp = runtime.create_container(["PATH=/usr/bin", "HOME=/root"])
+    assert len(resp.adjust.linux.devices) == 0
+    assert len(resp.adjust.env) == 0
+    assert len(resp.adjust.mounts) == 0
+    assert plugin.injected_count == 0
+
+
+def test_create_container_missing_spec_fails_closed(runtime, plugin):
+    """A TPU container whose spec is gone must NOT start deviceless."""
+    with pytest.raises(ttrpc.TtrpcError) as ei:
+        runtime.create_container(["TPU=feedface"])
+    assert "feedface" in ei.value.message
+
+
+def test_hostile_hash_cannot_escape_alloc_dir(runtime, plugin, tmp_path):
+    (tmp_path / "evil.json").write_text(json.dumps(SPEC))
+    with pytest.raises(ttrpc.TtrpcError):
+        runtime.create_container(["TPU=../evil"])
+
+
+def test_unknown_method_gets_unimplemented(runtime, plugin):
+    with pytest.raises(ttrpc.TtrpcError) as ei:
+        runtime.client.call(
+            PLUGIN_SERVICE, "NoSuchMethod", pb.Empty(), pb.Empty
+        )
+    assert ei.value.code == ttrpc.CODE_UNIMPLEMENTED
+
+
+def test_reconnect_after_runtime_restart(runtime, alloc_dir):
+    """containerd restarts: the plugin must come back and re-register."""
+    p = NRIPlugin(
+        socket_path=runtime.socket_path,
+        alloc_spec_dir=alloc_dir,
+        stat_fn=fake_stat_table(DEV_TABLE),
+    )
+    p.RECONNECT_MIN_S = 0.05  # keep the test fast
+    stop = threading.Event()
+    thread = p.start(stop)
+    runtime.accept()
+    assert runtime.registered.wait(5.0)
+    runtime.mux.close()  # "containerd died"
+    runtime.accept()  # it comes back...
+    assert runtime.registered.wait(5.0)  # ...and the plugin re-registers
+    resp = runtime.create_container([f"TPU={SPEC['hash']}"])
+    assert len(resp.adjust.linux.devices) == 2
+    stop.set()
+    p.stop()
+    thread.join(timeout=5.0)
+
+
+def test_shutdown_then_reconnect(runtime, alloc_dir):
+    """A polite runtime Shutdown also leads to re-registration."""
+    p = NRIPlugin(
+        socket_path=runtime.socket_path,
+        alloc_spec_dir=alloc_dir,
+        stat_fn=fake_stat_table(DEV_TABLE),
+    )
+    p.RECONNECT_MIN_S = 0.05
+    stop = threading.Event()
+    thread = p.start(stop)
+    runtime.accept()
+    assert runtime.registered.wait(5.0)
+    runtime.shutdown_plugin()
+    runtime.accept()
+    assert runtime.registered.wait(5.0)
+    stop.set()
+    p.stop()
+    thread.join(timeout=5.0)
+
+
+# -- unit-level: the pure adjustment builder ---------------------------------
+
+
+def test_adjustment_dev_root_translation():
+    """In the DaemonSet the agent sees host /dev at /host/dev; spec paths
+    stay host-absolute and must be stat'ed through the mount."""
+    seen = []
+
+    def spy_stat(path):
+        seen.append(path)
+        return FakeStat(120, 0)
+
+    adjust = adjustment_from_spec(
+        {"hash": "h", "device_paths": ["/dev/accel0"], "env": {}},
+        stat_fn=spy_stat,
+        dev_root="/host/dev",
+    )
+    assert seen == ["/host/dev/accel0"]
+    assert adjust.linux.devices[0].path == "/dev/accel0"
+
+
+def test_adjustment_empty_without_libtpu_or_spec_path():
+    adjust = adjustment_from_spec(
+        {"hash": "h", "device_paths": [], "env": {"A": "1"}},
+        stat_fn=fake_stat_table({}),
+    )
+    assert len(adjust.mounts) == 0
+    assert [kv.key for kv in adjust.env] == ["A"]
+
+
+def test_hash_from_env_prefers_tpu_and_skips_empty():
+    assert hash_from_env(["GPU=g", "TPU=t"]) == "t"
+    assert hash_from_env(["TPU=", "GPU=g"]) == "g"
+    assert hash_from_env(["TPUX=t"]) is None
+    assert hash_from_env([]) is None
+
+
+def test_spec_mount_source_uses_host_namespace_path(tmp_path):
+    """The adjustment's Mount.source is resolved by runc in the HOST mount
+    namespace — it must be the host-side alloc dir, not the agent's /host
+    view (code-review r4 finding)."""
+    agent_view = tmp_path / "host" / "var" / "lib" / "elastic-tpu" / "alloc"
+    agent_view.mkdir(parents=True)
+    (agent_view / f"{SPEC['hash']}.json").write_text(json.dumps(SPEC))
+    p = NRIPlugin(
+        socket_path="unused",
+        alloc_spec_dir=str(agent_view),
+        host_alloc_dir="/var/lib/elastic-tpu/alloc",
+        stat_fn=fake_stat_table(DEV_TABLE),
+    )
+    resp = p._on_create_container(
+        pb.CreateContainerRequest(
+            pod=pb.PodSandbox(name="t", namespace="ns"),
+            container=pb.Container(id="c", env=[f"TPU={SPEC['hash']}"]),
+        )
+    )
+    mounts = {m.destination: m.source for m in resp.adjust.mounts}
+    assert mounts[SPEC_MOUNT_DEST] == (
+        f"/var/lib/elastic-tpu/alloc/{SPEC['hash']}.json"
+    )
+
+
+# -- manager wiring ----------------------------------------------------------
+
+
+def test_manager_runs_nri_plugin(tmp_path):
+    """`--nri-socket` on the agent registers the NRI plugin alongside the
+    device-plugin servers (the DaemonSet's containerd activation path)."""
+    from fake_apiserver import FakeAPIServer
+    from fake_kubelet import FakeKubelet
+
+    from elastic_tpu_agent.kube.client import KubeClient
+    from elastic_tpu_agent.manager import ManagerOptions, TPUManager
+
+    rt = FakeNRIRuntime(str(tmp_path / "nri.sock"))
+    api = FakeAPIServer()
+    url = api.start()
+    kubelet = FakeKubelet(
+        str(tmp_path / "dp"), str(tmp_path / "pr" / "kubelet.sock")
+    )
+    kubelet.start()
+    (tmp_path / "dev").mkdir()
+    mgr = TPUManager(
+        ManagerOptions(
+            node_name="node-nri",
+            db_path=str(tmp_path / "meta.db"),
+            operator_kind="stub:v5litepod-4",
+            dev_root=str(tmp_path / "dev"),
+            device_plugin_dir=str(tmp_path / "dp"),
+            pod_resources_socket=str(tmp_path / "pr" / "kubelet.sock"),
+            alloc_spec_dir=str(tmp_path / "alloc"),
+            kube_client=KubeClient(url),
+            nri_socket=rt.socket_path,
+        )
+    )
+    try:
+        mgr.run(block=False)
+        rt.accept()
+        assert rt.registered.wait(5.0)
+        assert rt.configure().events == event_mask(pb.CREATE_CONTAINER)
+    finally:
+        mgr.stop()
+        rt.close()
+        kubelet.stop()
+        api.stop()
