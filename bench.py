@@ -20,6 +20,7 @@ attached (tokens/s, step time, estimated MXU utilization).
 
 from __future__ import annotations
 
+import functools
 import json
 import os
 import statistics
@@ -85,7 +86,7 @@ def build_cluster(tmp, disable_locator_cache=False):
     return api, kubelet, manager
 
 
-def run_control_plane(disable_locator_cache=False):
+def run_control_plane(disable_locator_cache=False, sandbox_sleep_s=0.005):
     from elastic_tpu_agent.common import (
         AnnotationAssumed,
         ResourceTPUCore,
@@ -132,8 +133,10 @@ def run_control_plane(disable_locator_cache=False):
                 # model a conservative 5 ms so Allocate-time prefetching
                 # gets the same overlap window it has in production. Both
                 # variants get the identical gap; it is excluded from the
-                # timed sections.
-                time.sleep(0.005)
+                # timed sections. A 0 ms variant is ALSO published (main)
+                # so the prefetch overlap never hides in the headline.
+                if sandbox_sleep_s:
+                    time.sleep(sandbox_sleep_s)
                 t2 = time.perf_counter()
                 client.pre_start_container(ids)
                 t3 = time.perf_counter()
@@ -158,108 +161,209 @@ PEAK_TFLOPS = {"v2": 23, "v3": 61, "v4": 137.5, "v5e": 197, "v5p": 229.5,
                "v6e": 459}
 
 
+def detect_tpu_gen(device_kind: str) -> str:
+    """Generation from jax's device_kind string ("TPU v5 lite", "TPU v4",
+    ...), so peak FLOP/s comes from the hardware actually attached. An
+    explicit PALLAS_AXON_TPU_GEN env override always wins (the operator's
+    correction for hardware whose kind string misleads); default v5e."""
+    override = os.environ.get("PALLAS_AXON_TPU_GEN")
+    if override in PEAK_TFLOPS:
+        return override
+    kind = (device_kind or "").lower()
+    if "v6" in kind:
+        return "v6e"  # v6e is the only v6 with public spec numbers
+    if "v5" in kind:
+        return "v5e" if ("lite" in kind or "5e" in kind) else "v5p"
+    for gen in ("v4", "v3", "v2"):
+        if gen in kind:
+            return gen
+    return "v5e"
+
+
+def tpu_measure_once():
+    """The actual on-chip measurement. Runs inside a SUBPROCESS (see
+    run_tpu_throughput): a poisoned/failed backend init must never take
+    the control-plane numbers down with it, and a fresh process is the
+    only reliable backend re-init."""
+    import jax
+
+    # Persistent compile cache: remote TPU compiles cost minutes; the
+    # driver re-runs bench every round with identical shapes.
+    jax.config.update(
+        "jax_compilation_cache_dir", os.path.join(REPO, ".jax_cache")
+    )
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+    devices = jax.devices()
+    platform = devices[0].platform
+    if platform == "cpu":
+        return {"skipped": "cpu-only host"}
+    import jax.numpy as jnp
+    import optax
+
+    from elastic_tpu_agent.workloads.transformer import (
+        ModelConfig,
+        forward,
+        init_params,
+    )
+
+    # n_heads=8 → head_dim=128: fills the MXU lane width and meets the
+    # Pallas flash-attention tile gate (attention.supports_flash), which
+    # the "auto" dispatch then engages on TPU with adaptive 512-blocks
+    # (attention.auto_flash_config). Measured on v5e-1 at this config:
+    # flash/512 143.8 TFLOP/s vs flash/256 129.8 vs materialized 108.1.
+    cfg = ModelConfig(
+        vocab=32768, d_model=1024, n_heads=8, n_layers=8, d_ff=4096,
+        max_seq=1024,
+    )
+    optimizer = optax.adamw(1e-3)
+
+    def loss_fn(params, tokens):
+        logits = forward(params, tokens[:, :-1], cfg).astype(jnp.float32)
+        targets = tokens[:, 1:]
+        return jnp.mean(
+            optax.softmax_cross_entropy_with_integer_labels(
+                logits, targets
+            )
+        )
+
+    def one_step(carry, _):
+        params, opt_state, tokens = carry
+        loss, grads = jax.value_and_grad(loss_fn)(params, tokens)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return (params, opt_state, tokens), loss
+
+    steps = 10
+
+    # K steps inside ONE jit (lax.scan): per-call dispatch through a
+    # remote/relayed runtime costs ~1s, which would swamp the ~100ms
+    # step — the scan measures the chip, not the wire. Donating params +
+    # opt_state lets XLA update them in place instead of double-buffering
+    # the whole model state.
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
+    def run_steps(params, opt_state, tokens):
+        (params, opt_state, _), losses = jax.lax.scan(
+            one_step, (params, opt_state, tokens), None, length=steps
+        )
+        return params, opt_state, losses[-1]
+
+    params = init_params(cfg, jax.random.key(0))
+    opt_state = optimizer.init(params)
+    # batch 16 maximizes measured util (flash attention removed the
+    # s×s score materialization that used to OOM above batch 8).
+    batch, seq = 16, 1024
+    tokens = jax.random.randint(
+        jax.random.key(1), (batch, seq + 1), 0, cfg.vocab
+    )
+    params, opt_state, loss = run_steps(params, opt_state, tokens)
+    float(loss)  # compile + warmup; host transfer is the real barrier
+    t0 = time.perf_counter()
+    params, opt_state, loss = run_steps(params, opt_state, tokens)
+    final_loss = float(loss)  # block_until_ready alone does not
+    dt = time.perf_counter() - t0  # synchronize through the relay
+
+    n_params = sum(
+        p.size for p in jax.tree_util.tree_leaves(params)
+    )
+    tokens_per_step = batch * seq
+    # Exact model-FLOPs accounting (MFU convention: counted work excludes
+    # the flash backward's recompute, so utilization reads conservative):
+    #   parameter matmuls: 6·N per token (fwd 2N + bwd 4N)
+    #   attention scores:  12·L·s²·d per batch-row fwd+bwd, halved because
+    #   the Pallas kernel skips fully-masked kv blocks above the causal
+    #   diagonal (attention.py "causal fast path").
+    param_flops = 6 * n_params * tokens_per_step
+    attn_flops = 12 * cfg.n_layers * batch * seq * seq * cfg.d_model * 0.5
+    flops_per_step = param_flops + attn_flops
+    achieved_tflops = flops_per_step * steps / dt / 1e12
+    gen = detect_tpu_gen(getattr(devices[0], "device_kind", ""))
+    peak = PEAK_TFLOPS.get(gen, 197)
+    return {
+        "platform": platform,
+        "device_kind": getattr(devices[0], "device_kind", ""),
+        "tpu_gen": gen,
+        "step_time_ms": dt / steps * 1000,
+        "tokens_per_s": tokens_per_step * steps / dt,
+        "achieved_tflops": achieved_tflops,
+        "mxu_util_pct": 100 * achieved_tflops / peak,
+        "attn_flops_pct": 100 * attn_flops / flops_per_step,
+        "final_loss": final_loss,
+        "n_params_m": n_params / 1e6,
+    }
+
+
+# Retry policy for the TPU measurement: a transient runtime/tunnel
+# hiccup (the exact failure that erased round 3's number) gets real
+# second and third chances before "absent" is declared. Fast failures
+# (init error) retry up to 3× with backoff; a TIMEOUT means the backend
+# is wedged in compile/init — one more full-length attempt, then give
+# up, so a dead tunnel can't eat the whole bench budget.
+_TPU_RETRY_DELAYS_S = (0.0, 5.0, 20.0)
+_TPU_SUBPROC_TIMEOUT_S = int(
+    os.environ.get("ELASTIC_TPU_BENCH_TPU_TIMEOUT_S", "1500")
+)  # first compile through a relay is minutes
+_TPU_MAX_TIMEOUTS = 2
+
+
 def run_tpu_throughput():
+    """Measure in an isolated subprocess with retry + backoff."""
+    import subprocess
+
+    last_err = None
+    timeouts = 0
+    for delay in _TPU_RETRY_DELAYS_S:
+        if delay:
+            time.sleep(delay)
+        try:
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__), "--tpu-only"],
+                capture_output=True, timeout=_TPU_SUBPROC_TIMEOUT_S,
+            )
+        except subprocess.TimeoutExpired:
+            timeouts += 1
+            last_err = f"measurement timed out after {_TPU_SUBPROC_TIMEOUT_S}s"
+            if timeouts >= _TPU_MAX_TIMEOUTS:
+                break
+            continue
+        result = None
+        for line in reversed(proc.stdout.decode().splitlines()):
+            line = line.strip()
+            if line.startswith("{"):
+                try:
+                    result = json.loads(line)
+                except ValueError:
+                    pass
+                break
+        if result is None:
+            tail = proc.stderr.decode()[-500:]
+            last_err = f"no result (rc={proc.returncode}): {tail}"
+            continue
+        if result.get("skipped"):
+            return None  # genuinely no accelerator; not an error
+        if "error" not in result:
+            return result
+        last_err = result["error"]
+    return {
+        "error": last_err,
+        "attempts": len(_TPU_RETRY_DELAYS_S),
+        "hardware": "absent_or_failed_after_retries",
+    }
+
+
+def tpu_only_main():
+    """Child-process entry (--tpu-only): print one JSON line."""
     try:
-        import jax
-
-        # Persistent compile cache: remote TPU compiles cost minutes; the
-        # driver re-runs bench every round with identical shapes.
-        jax.config.update(
-            "jax_compilation_cache_dir", os.path.join(REPO, ".jax_cache")
-        )
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
-
-        devices = jax.devices()
-        platform = devices[0].platform
-        if platform == "cpu":
-            return None
-        import jax.numpy as jnp
-        import optax
-
-        from elastic_tpu_agent.workloads.transformer import (
-            ModelConfig,
-            forward,
-            init_params,
-        )
-
-        # n_heads=8 → head_dim=128: fills the MXU lane width and meets the
-        # Pallas flash-attention tile gate (attention.supports_flash), which
-        # the "auto" dispatch then engages on TPU with adaptive 512-blocks
-        # (attention.auto_flash_config). Measured on v5e-1 at this config:
-        # flash/512 143.8 TFLOP/s vs flash/256 129.8 vs materialized 108.1.
-        cfg = ModelConfig(
-            vocab=32768, d_model=1024, n_heads=8, n_layers=8, d_ff=4096,
-            max_seq=1024,
-        )
-        optimizer = optax.adamw(1e-3)
-
-        def loss_fn(params, tokens):
-            logits = forward(params, tokens[:, :-1], cfg).astype(jnp.float32)
-            targets = tokens[:, 1:]
-            return jnp.mean(
-                optax.softmax_cross_entropy_with_integer_labels(
-                    logits, targets
-                )
-            )
-
-        def one_step(carry, _):
-            params, opt_state, tokens = carry
-            loss, grads = jax.value_and_grad(loss_fn)(params, tokens)
-            updates, opt_state = optimizer.update(grads, opt_state, params)
-            params = optax.apply_updates(params, updates)
-            return (params, opt_state, tokens), loss
-
-        steps = 10
-
-        # K steps inside ONE jit (lax.scan): per-call dispatch through a
-        # remote/relayed runtime costs ~1s, which would swamp the ~100ms
-        # step — the scan measures the chip, not the wire.
-        @jax.jit
-        def run_steps(params, opt_state, tokens):
-            (params, opt_state, _), losses = jax.lax.scan(
-                one_step, (params, opt_state, tokens), None, length=steps
-            )
-            return params, opt_state, losses[-1]
-
-        params = init_params(cfg, jax.random.key(0))
-        opt_state = optimizer.init(params)
-        # batch 16 maximizes measured util (flash attention removed the
-        # s×s score materialization that used to OOM above batch 8).
-        batch, seq = 16, 1024
-        tokens = jax.random.randint(
-            jax.random.key(1), (batch, seq + 1), 0, cfg.vocab
-        )
-        params, opt_state, loss = run_steps(params, opt_state, tokens)
-        float(loss)  # compile + warmup; host transfer is the real barrier
-        t0 = time.perf_counter()
-        params, opt_state, loss = run_steps(params, opt_state, tokens)
-        final_loss = float(loss)  # block_until_ready alone does not
-        dt = time.perf_counter() - t0  # synchronize through the relay
-
-        n_params = sum(
-            p.size for p in jax.tree_util.tree_leaves(params)
-        )
-        tokens_per_step = batch * seq
-        flops_per_step = 6 * n_params * tokens_per_step  # fwd+bwd estimate
-        achieved_tflops = flops_per_step * steps / dt / 1e12
-        gen = os.environ.get("PALLAS_AXON_TPU_GEN", "v5e")
-        peak = PEAK_TFLOPS.get(gen, 197)
-        return {
-            "platform": platform,
-            "tpu_gen": gen,
-            "step_time_ms": dt / steps * 1000,
-            "tokens_per_s": tokens_per_step * steps / dt,
-            "achieved_tflops": achieved_tflops,
-            "mxu_util_pct": 100 * achieved_tflops / peak,
-            "final_loss": final_loss,
-            "n_params_m": n_params / 1e6,
-        }
+        print(json.dumps(tpu_measure_once()))
     except Exception as e:  # noqa: BLE001
-        return {"error": f"{type(e).__name__}: {e}"}
+        print(json.dumps({"error": f"{type(e).__name__}: {e}"}))
 
 
 def main():
     ours = run_control_plane(disable_locator_cache=False)
+    ours_0ms = run_control_plane(
+        disable_locator_cache=False, sandbox_sleep_s=0.0
+    )
     ref = run_control_plane(disable_locator_cache=True)
     tpu = run_tpu_throughput()
     vs_baseline = ref["bind_p50_ms"] / ours["bind_p50_ms"]
@@ -270,6 +374,11 @@ def main():
         "vs_baseline": round(vs_baseline, 3),
         "extra": {
             "ours": {k: round(v, 3) for k, v in ours.items()},
+            # Same flow with NO synthetic sandbox gap: prefetch overlap
+            # gets zero help here, so this is the un-gifted number.
+            "ours_no_sandbox_gap": {
+                k: round(v, 3) for k, v in ours_0ms.items()
+            },
             "reference_style_uncached": {
                 k: round(v, 3) for k, v in ref.items()
             },
@@ -281,4 +390,7 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    if "--tpu-only" in sys.argv:
+        tpu_only_main()
+    else:
+        main()

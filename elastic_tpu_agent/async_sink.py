@@ -6,36 +6,55 @@ bind-latency hot path (BASELINE.md SLO), must never raise into callers,
 and must self-disable after consecutive failures so a missing CRD or
 denied RBAC can't spam the apiserver forever. This worker implements
 that contract once.
+
+Flow control:
+- the worker drains in BATCHES (everything queued when it wakes) and
+  writes for the same coalescing ``key`` collapse to the newest one, so
+  a storm of updates for one object costs one apiserver write;
+- the queue is BOUNDED: past ``max_queue`` the oldest entry is dropped
+  (newer state wins for observability) and counted in ``dropped``;
+- ``stop()`` DRAINS: everything submitted before the call is written
+  (or dropped by the bound) before the worker exits — queued
+  Bound/Released records no longer die with the daemon thread.
 """
 
 from __future__ import annotations
 
 import logging
-import queue
 import threading
-import time
+from typing import Callable, List, Optional, Tuple
 
 logger = logging.getLogger(__name__)
 
-_STOP = object()
-
 MAX_CONSECUTIVE_FAILURES = 5
+DEFAULT_MAX_QUEUE = 4096
 
 
 class AsyncSink:
-    """Single worker thread draining a queue of thunks; self-disables
-    after ``max_failures`` consecutive errors."""
+    """Single worker thread draining a bounded, coalescing op queue;
+    self-disables after ``max_failures`` consecutive errors."""
 
     def __init__(
-        self, name: str, max_failures: int = MAX_CONSECUTIVE_FAILURES
+        self,
+        name: str,
+        max_failures: int = MAX_CONSECUTIVE_FAILURES,
+        max_queue: int = DEFAULT_MAX_QUEUE,
+        on_drop: Optional[Callable[[], None]] = None,
     ) -> None:
         self._name = name
         self._max_failures = max_failures
-        self._queue: "queue.Queue" = queue.Queue()
+        self._max_queue = max_queue
+        self._on_drop = on_drop
+        # Insertion-ordered op store: coalescing keys map to their newest
+        # op in O(1); un-keyed ops get a unique sequence number. Dict
+        # order gives O(1) drop-oldest and preserves submit order.
+        self._items: "dict[object, Callable]" = {}
+        self._seq = 0
         self._failures = 0
         self._disabled = False
         self._stopping = False
-        self._pending = 0
+        self._busy = False
+        self._dropped = 0
         self._cond = threading.Condition()
         self._thread = threading.Thread(
             target=self._worker, daemon=True, name=name
@@ -46,67 +65,99 @@ class AsyncSink:
     def disabled(self) -> bool:
         return self._disabled
 
-    def submit(self, op) -> None:
-        """Enqueue a thunk; non-blocking, never raises."""
-        if self._disabled or self._stopping:
+    @property
+    def dropped(self) -> int:
+        """Ops discarded by the queue bound since start."""
+        return self._dropped
+
+    def submit(self, op: Callable, key: Optional[object] = None) -> None:
+        """Enqueue a thunk; non-blocking, never raises. A non-None ``key``
+        coalesces: any queued op with the same key is superseded."""
+        if self._disabled:
             return
         with self._cond:
             if self._stopping:
                 return
-            self._pending += 1
-            # put() under the lock (unbounded queue, never blocks): a put
-            # outside it could land after stop()'s drain and strand _pending.
-            self._queue.put(op)
+            if key is None:
+                self._seq += 1
+                key = ("_seq", self._seq)
+            else:
+                # superseding moves the write to the newest position
+                self._items.pop(key, None)
+            if len(self._items) >= self._max_queue:
+                oldest = next(iter(self._items))
+                del self._items[oldest]  # drop-oldest: newer state wins
+                self._dropped += 1
+                if self._on_drop is not None:
+                    try:
+                        self._on_drop()
+                    except Exception:  # noqa: BLE001
+                        pass
+                if self._dropped in (1, 100) or self._dropped % 1000 == 0:
+                    logger.warning(
+                        "%s queue full (%d): dropped %d op(s) so far",
+                        self._name, self._max_queue, self._dropped,
+                    )
+            self._items[key] = op
+            self._cond.notify_all()
 
     def flush(self, timeout: float = 10.0) -> bool:
         """Block until queued work has drained (tests / shutdown)."""
+        import time
+
         deadline = time.monotonic() + timeout
         with self._cond:
-            while self._pending > 0:
+            while self._items or self._busy:
                 remaining = deadline - time.monotonic()
                 if remaining <= 0:
                     return False
                 self._cond.wait(timeout=remaining)
         return True
 
-    def stop(self, timeout: float = 5.0) -> None:
-        # Refuse new work before flushing so a submit() racing with stop()
-        # cannot land behind the _STOP sentinel and strand _pending > 0.
+    def stop(self, timeout: float = 30.0) -> None:
+        """Drain-then-stop: the worker writes everything already queued,
+        then exits. The timeout only guards a wedged apiserver op (the
+        thread is a daemon and dies with the process in that case)."""
         with self._cond:
             self._stopping = True
-        self.flush(timeout=timeout)
-        self._queue.put(_STOP)
+            self._cond.notify_all()
         self._thread.join(timeout=timeout)
         if self._thread.is_alive():
-            # Worker is wedged on a slow op; it is a daemon thread and dies
-            # with the process. (No queue drain is needed: submit() enqueues
-            # under the lock after re-checking _stopping, so nothing can land
-            # behind the _STOP sentinel.)
-            logger.warning("%s worker did not stop within %.1fs", self._name,
-                           timeout)
+            logger.warning(
+                "%s worker still draining after %.1fs; abandoning "
+                "(%d op(s) may be lost)",
+                self._name, timeout, len(self._items),
+            )
 
     def _worker(self) -> None:
         while True:
-            op = self._queue.get()
-            if op is _STOP:
-                return
-            try:
-                if not self._disabled:
-                    op()
-                    self._failures = 0
-            except Exception as e:  # noqa: BLE001 - observability must not wedge
-                self._failures += 1
-                if self._failures >= self._max_failures:
-                    self._disabled = True
-                    logger.warning(
-                        "%s disabled after %d consecutive failures (last: %s)",
-                        self._name, self._failures, e,
-                    )
-                else:
-                    logger.warning("%s write failed (%s); continuing",
-                                   self._name, e)
-            finally:
-                with self._cond:
-                    self._pending -= 1
-                    if self._pending <= 0:
-                        self._cond.notify_all()
+            with self._cond:
+                while not self._items and not self._stopping:
+                    self._cond.wait()
+                if not self._items:  # stopping and drained
+                    self._cond.notify_all()
+                    return
+                batch, self._items = list(self._items.values()), {}
+                self._busy = True
+            for op in batch:
+                try:
+                    if not self._disabled:
+                        op()
+                        self._failures = 0
+                except Exception as e:  # noqa: BLE001 - must not wedge
+                    self._failures += 1
+                    if self._failures >= self._max_failures:
+                        self._disabled = True
+                        logger.warning(
+                            "%s disabled after %d consecutive failures "
+                            "(last: %s)", self._name, self._failures, e,
+                        )
+                    else:
+                        logger.warning(
+                            "%s write failed (%s); continuing",
+                            self._name, e,
+                        )
+            with self._cond:
+                self._busy = False
+                if not self._items:
+                    self._cond.notify_all()

@@ -33,6 +33,7 @@ from .crd import (
     ElasticTPUClient,
     PhaseAvailable,
     PhaseBound,
+    PhaseFailed,
     PhaseReleased,
 )
 
@@ -48,11 +49,15 @@ class CRDRecorder:
         client: ElasticTPUClient,
         node_name: str,
         accelerator_type: str = "",
+        metrics=None,
     ) -> None:
         self._client = client
         self._node = node_name
         self._accelerator_type = accelerator_type
-        self._sink = AsyncSink("crd-recorder")
+        on_drop = None
+        if metrics is not None and hasattr(metrics, "observability_dropped"):
+            on_drop = metrics.observability_dropped.inc
+        self._sink = AsyncSink("crd-recorder", on_drop=on_drop)
 
     # -- public API (called from plugin bind / GC / manager restore) ----------
 
@@ -94,7 +99,8 @@ class CRDRecorder:
             for obj in objs:
                 self._client.create(obj, update_existing=True)
 
-        self._submit(publish)
+        # coalescing key: only the newest queued inventory snapshot matters
+        self._submit(publish, key="inventory")
 
     def record_bound(
         self,
@@ -118,7 +124,32 @@ class CRDRecorder:
             phase=PhaseBound,
             message=f"bound by elastic-tpu-agent on {self._node}",
         )
-        self._submit(lambda: self._client.create(obj, update_existing=True))
+        # keyed per object: a queued-but-unwritten Bound for this hash is
+        # superseded by a newer write (e.g. its Released) instead of both
+        # hitting the apiserver
+        self._submit(
+            lambda: self._client.create(obj, update_existing=True),
+            key=("obj", obj.name),
+        )
+
+    def record_chip_health(
+        self, chip_index: int, healthy: bool, reason: str = ""
+    ) -> None:
+        """Flip the chip's inventory object between Available and Failed on
+        health transitions, so an external scheduler consuming the CRD
+        stops placing onto a dead chip (reference phases: vendored
+        types.go:49-57; the boot-time publish alone would advertise a dead
+        chip as Available forever)."""
+        name = self.inventory_name(chip_index)
+        if healthy:
+            phase, message = PhaseAvailable, "chip recovered"
+        else:
+            phase, message = PhaseFailed, reason or "chip unhealthy"
+
+        self._submit(
+            lambda: self._client.update_status(name, phase, message),
+            key=("chip", chip_index),
+        )
 
     def record_released(self, alloc_hash: str) -> None:
         name = self.object_name(alloc_hash)
@@ -132,7 +163,7 @@ class CRDRecorder:
                 pass
             self._client.delete(name)
 
-        self._submit(release)
+        self._submit(release, key=("obj", name))
 
     def reconcile(
         self,
@@ -152,26 +183,28 @@ class CRDRecorder:
                     logger.info("crd reconcile: removing stale %s", obj.name)
                     self._client.delete(obj.name)
 
-        self._submit(sweep)
+        self._submit(sweep, key="reconcile")
 
     # -- lifecycle ------------------------------------------------------------
 
     def flush(self, timeout: float = 10.0) -> bool:
         return self._sink.flush(timeout=timeout)
 
-    def stop(self, timeout: float = 5.0) -> None:
+    def stop(self, timeout: float = 30.0) -> None:
+        # Generous default: stop() DRAINS (async_sink) — capping it at a
+        # few seconds would re-introduce the abandoned-queue shutdown.
         self._sink.stop(timeout=timeout)
 
     @property
     def disabled(self) -> bool:
         return self._sink.disabled
 
-    def _submit(self, op) -> None:
-        self._sink.submit(op)
+    def _submit(self, op, key=None) -> None:
+        self._sink.submit(op, key=key)
 
 
 def build_recorder(
-    kube_client, node_name: str, operator
+    kube_client, node_name: str, operator, metrics=None
 ) -> Optional[CRDRecorder]:
     """Manager-side constructor: a recorder bound to this node's client and
     accelerator type; None when there is no kube client (hermetic runs)."""
@@ -182,5 +215,6 @@ def build_recorder(
     if topo is not None:
         acc = getattr(topo, "accelerator_type", "") or ""
     return CRDRecorder(
-        ElasticTPUClient(kube_client), node_name, accelerator_type=acc
+        ElasticTPUClient(kube_client), node_name, accelerator_type=acc,
+        metrics=metrics,
     )

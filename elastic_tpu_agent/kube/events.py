@@ -43,15 +43,19 @@ ReasonReclaimed = "TPUReclaimed"
 ReasonRestored = "TPURestored"
 ReasonChipUnhealthy = "TPUChipUnhealthy"
 ReasonChipHealthy = "TPUChipHealthy"
+ReasonAllocatableDrift = "TPUAllocatableDrift"
 
 
 class EventRecorder:
     """Posts core/v1 Events; all methods non-blocking and never raise."""
 
-    def __init__(self, kube_client, node_name: str) -> None:
+    def __init__(self, kube_client, node_name: str, metrics=None) -> None:
         self._client = kube_client
         self._node = node_name
-        self._sink = AsyncSink("event-recorder")
+        on_drop = None
+        if metrics is not None and hasattr(metrics, "observability_dropped"):
+            on_drop = metrics.observability_dropped.inc
+        self._sink = AsyncSink("event-recorder", on_drop=on_drop)
         # key -> (last_emit_monotonic, suppressed_since_then, emit_ctx)
         # where emit_ctx = (namespace, base, involved, reason, message, type_)
         # is kept so suppressed tails can be surfaced after the window.
@@ -73,7 +77,9 @@ class EventRecorder:
     def flush(self, timeout: float = 10.0) -> bool:
         return self._sink.flush(timeout=timeout)
 
-    def stop(self, timeout: float = 5.0) -> None:
+    def stop(self, timeout: float = 30.0) -> None:
+        # Generous default: the sink drains on stop (async_sink); a short
+        # cap would abandon queued events at shutdown.
         self._stopped.set()
         # Join the sweeper BEFORE the force flush: a sweep that already
         # zeroed a suppressed count under the lock but hasn't posted it yet
@@ -201,7 +207,9 @@ class EventRecorder:
         self._sink.submit(lambda: self._client.create_event(namespace, body))
 
 
-def build_event_recorder(kube_client, node_name: str) -> Optional[EventRecorder]:
+def build_event_recorder(
+    kube_client, node_name: str, metrics=None
+) -> Optional[EventRecorder]:
     if kube_client is None or not node_name:
         return None
-    return EventRecorder(kube_client, node_name)
+    return EventRecorder(kube_client, node_name, metrics=metrics)

@@ -141,14 +141,18 @@ class TPUManager:
             from .crd_recorder import build_recorder
 
             self.crd_recorder = build_recorder(
-                self.client, opts.node_name, self.operator
+                self.client, opts.node_name, self.operator,
+                metrics=self.metrics,
             )
         self.events = None
         if opts.enable_events:
             from .kube.events import build_event_recorder
 
-            self.events = build_event_recorder(self.client, opts.node_name)
+            self.events = build_event_recorder(
+                self.client, opts.node_name, metrics=self.metrics
+            )
         pr_client = rpc.PodResourcesClient(opts.pod_resources_socket)
+        self.pr_client = pr_client
         self.config = PluginConfig(
             node_name=opts.node_name,
             device_plugin_dir=opts.device_plugin_dir,
@@ -317,6 +321,84 @@ class TPUManager:
             except OSError:
                 logger.warning("restore: orphan spec unlink %s failed", fname)
 
+    def check_allocatable_drift(self) -> Optional[dict]:
+        """Cross-check kubelet's allocatable-device view (pod-resources v1
+        GetAllocatableResources) against this agent's advertisement — a
+        chip kubelet still counts allocatable that we no longer advertise
+        (or vice versa) means scheduler math is wrong on this node.
+
+        Returns {resource: {"missing": [chips], "extra": [chips]}} for
+        drifted resources, {} when in sync, None when the kubelet cannot
+        answer (v1alpha1-only or the allocatable gate is off)."""
+        from .common import ResourceTPUCore, ResourceTPUMemory
+        from .plugins.tpushare import chip_of_device_id
+
+        try:
+            resp = self.pr_client.get_allocatable_resources()
+        except Exception as e:  # noqa: BLE001 - diagnostic, never fatal
+            logger.warning("allocatable cross-check failed: %s", e)
+            return None
+        if resp is None:
+            return None
+        ours = {c.index for c in self.operator.devices()}
+        drift: dict = {}
+        for resource in (ResourceTPUCore, ResourceTPUMemory):
+            seen: set = set()
+            found = False
+            for dev in resp.devices:
+                if dev.resource_name != resource:
+                    continue
+                found = True
+                for did in dev.device_ids:
+                    chip = chip_of_device_id(did)
+                    if chip is not None:
+                        seen.add(chip)
+            if not found:
+                # kubelet has not consumed our ListAndWatch yet (fresh
+                # boot) — absence is indistinguishable from lag; skip.
+                continue
+            missing = sorted(ours - seen)
+            extra = sorted(seen - ours)
+            if missing or extra:
+                drift[resource] = {"missing": missing, "extra": extra}
+        if drift:
+            logger.warning("allocatable drift vs kubelet: %s", drift)
+            if self.events is not None:
+                from .kube.events import ReasonAllocatableDrift
+
+                parts = []
+                for resource, d in sorted(drift.items()):
+                    if d["missing"]:
+                        parts.append(
+                            f"{resource}: kubelet missing chip(s) "
+                            f"{','.join(map(str, d['missing']))}"
+                        )
+                    if d["extra"]:
+                        parts.append(
+                            f"{resource}: kubelet still counts absent "
+                            f"chip(s) {','.join(map(str, d['extra']))}"
+                        )
+                self.events.node_event(
+                    ReasonAllocatableDrift,
+                    "kubelet allocatable view disagrees with agent "
+                    "advertisement — " + "; ".join(parts),
+                    type_="Warning",
+                )
+        return drift
+
+    _ALLOCATABLE_CHECK_DELAY_S = 10.0
+
+    def _deferred_allocatable_check(self, stop: threading.Event) -> None:
+        # Deferred: right after Register, kubelet has not consumed the
+        # first ListAndWatch yet, so an immediate check would always cry
+        # drift on a fresh boot.
+        if stop.wait(self._ALLOCATABLE_CHECK_DELAY_S):
+            return
+        try:
+            self.check_allocatable_drift()
+        except Exception:  # noqa: BLE001
+            logger.exception("allocatable cross-check failed")
+
     # -- Run ------------------------------------------------------------------
 
     def run(self, block: bool = True) -> None:
@@ -340,6 +422,10 @@ class TPUManager:
             self._health_thread = self.plugin.start_health(self._stop)
         if self.nri_plugin is not None:
             self._nri_thread = self.nri_plugin.start(self._stop)
+        threading.Thread(
+            target=self._deferred_allocatable_check, args=(self._stop,),
+            daemon=True, name="allocatable-check",
+        ).start()
         if block:
             self._gc_thread.join()
 

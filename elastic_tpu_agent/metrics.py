@@ -60,6 +60,11 @@ class AgentMetrics:
             "Virtual device nodes re-created by restore()",
             **kw,
         )
+        self.observability_dropped = Counter(
+            "elastic_tpu_observability_dropped_total",
+            "CRD/event writes dropped by the bounded async queue",
+            **kw,
+        )
         self.nri_injections = Counter(
             "elastic_tpu_nri_injections_total",
             "Containers adjusted (devices injected) via the NRI plugin",

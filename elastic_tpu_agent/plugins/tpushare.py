@@ -933,12 +933,26 @@ class TPUSharePlugin:
             return False
         went_bad, recovered = self.core.apply_health(healthy)
         self.memory.apply_health(healthy)
-        events = self._config.events
-        if events is not None:
+        reasons = {}
+        if went_bad or recovered:
             try:
                 reasons = self._config.operator.health_reasons()
             except Exception:  # noqa: BLE001 - reasons are best-effort
                 reasons = {}
+        recorder = self._config.crd_recorder
+        if recorder is not None:
+            # Keep the CRD inventory truthful: a chip that died flips its
+            # ElasticTPU object to Failed (with the specific reason) so
+            # external schedulers stop placing onto it; recovery flips it
+            # back to Available.
+            for idx in sorted(went_bad):
+                recorder.record_chip_health(
+                    idx, False, reasons.get(idx, "reported unhealthy")
+                )
+            for idx in sorted(recovered):
+                recorder.record_chip_health(idx, True)
+        events = self._config.events
+        if events is not None:
             for idx in sorted(went_bad):
                 why = reasons.get(idx, "reported unhealthy by operator")
                 events.node_event(

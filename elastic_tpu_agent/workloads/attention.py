@@ -265,7 +265,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
     dq_ref[0] = dq.astype(dq_ref.dtype)
 
 
-def _flash_bwd(q, k, v, o, lse, do, cfg: FlashConfig):
+def _flash_bwd(q, k, v, o, lse, do, cfg: FlashConfig, dlse=None):
     bn, s, h = q.shape
     nq = s // cfg.block_q
     nk = s // cfg.block_k
@@ -277,6 +277,12 @@ def _flash_bwd(q, k, v, o, lse, do, cfg: FlashConfig):
         do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1,
         keepdims=True,
     )  # [bn, s, 1]
+    if dlse is not None:
+        # lse is a *returned* output (ring attention's merge consumes it):
+        # d loss/d s_ij gains the term p_ij·dlse_i on top of the usual
+        # p_ij·(dp_ij − delta_i), so folding −dlse into delta routes the
+        # whole thing through the existing kernels unchanged.
+        delta = delta - dlse.astype(jnp.float32).reshape(bn, s, 1)
 
     dk, dv = pl.pallas_call(
         functools.partial(
@@ -346,6 +352,53 @@ def _flash_attention_bwd_rule(cfg: FlashConfig, res, do):
 _flash_attention_bnsh.defvjp(
     _flash_attention_fwd_rule, _flash_attention_bwd_rule
 )
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def _flash_attention_lse_bnsh(q, k, v, cfg: FlashConfig):
+    """Like `_flash_attention_bnsh` but ALSO returns lse [bn, s] — the
+    ring-attention building block, whose log-sum-exp merge needs each
+    chunk's lse and therefore its gradient (handled via the delta fold in
+    `_flash_bwd`)."""
+    o, lse = _flash_fwd(q, k, v, cfg)
+    return o, lse[..., 0]
+
+
+def _flash_attention_lse_fwd_rule(q, k, v, cfg: FlashConfig):
+    o, lse = _flash_fwd(q, k, v, cfg)
+    return (o, lse[..., 0]), (q, k, v, o, lse)
+
+
+def _flash_attention_lse_bwd_rule(cfg: FlashConfig, res, cotangents):
+    q, k, v, o, lse = res
+    do, dlse = cotangents
+    return _flash_bwd(q, k, v, o, lse, do, cfg, dlse=dlse)
+
+
+_flash_attention_lse_bnsh.defvjp(
+    _flash_attention_lse_fwd_rule, _flash_attention_lse_bwd_rule
+)
+
+
+def flash_attention_with_lse(
+    q: jax.Array, k: jax.Array, v: jax.Array,
+    cfg: FlashConfig = FlashConfig(),
+) -> Tuple[jax.Array, jax.Array]:
+    """Flash attention returning (o [b,s,n,h], lse [b,n,s]) — the shapes
+    ring_attention's online-softmax merge consumes. Requires the flash
+    shape gate (callers dispatch; no silent fallback here)."""
+    b, s, n, h = q.shape
+
+    def to_bnsh(x):
+        return x.transpose(0, 2, 1, 3).reshape(b * n, s, h)
+
+    o, lse = _flash_attention_lse_bnsh(
+        to_bnsh(q), to_bnsh(k), to_bnsh(v), cfg
+    )
+    return (
+        o.reshape(b, n, s, h).transpose(0, 2, 1, 3),
+        lse.reshape(b, n, s),
+    )
 
 
 def flash_attention(

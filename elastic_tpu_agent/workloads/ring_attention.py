@@ -4,28 +4,49 @@ Long-context sequence/context parallelism for the workload stack. Each
 device of the "sp" mesh axis holds one contiguous sequence shard of
 q/k/v; k/v chunks rotate around the ring via `jax.lax.ppermute` (XLA
 lowers it to ICI neighbor exchanges), and partial attention outputs are
-merged with the online-softmax log-sum-exp rule. Peak memory per device
-is O(s_local²) for one block-pair of scores instead of O(s²) — and the
-k/v rotation overlaps with the block computation in XLA's schedule.
+merged with the online-softmax log-sum-exp rule.
+
+Inside each ring step the (q-shard × kv-chunk) block runs the Pallas
+flash kernel (attention.py) whenever the shape gate passes, so NO
+s_loc×s_loc score tensor is ever materialized — peak memory per device is
+O(block_q·block_k) kernel tiles plus the rotating k/v shard, i.e. O(s·h)
+per device overall. Because shards are contiguous and equal-sized, the
+chunk-offset causal mask collapses to three block cases dispatched with
+`lax.switch`:
+
+  future chunk (k_off > q_off)  -> fully masked: skip the kernel entirely
+  diagonal     (k_off == q_off) -> causal flash kernel (local tri mask)
+  past chunk   (k_off < q_off)  -> non-causal flash kernel (no mask)
+
+The einsum fallback (`_block_attn`) remains for unaligned shapes.
 
 The reference repo has no sequence-parallel or attention code at all
 (SURVEY.md §2 "Parallelism-strategy inventory: NONE"); this implements
 the capability TPU-first rather than translating anything.
 
-Differentiable end-to-end: the ring is a `lax.scan` of jnp ops +
-`ppermute`, so JAX autodiff derives the backward ring (gradients rotate
-the opposite way) without a custom VJP.
+Differentiable end-to-end: the ring is a `lax.scan` of blocks +
+`ppermute`; the flash block is a custom-VJP primitive that returns lse
+and takes its cotangent (attention._flash_attention_lse_bnsh), so JAX
+autodiff derives the backward ring without a hand-written outer VJP.
 """
 
 from __future__ import annotations
 
-from typing import Optional, Tuple
+import dataclasses
+import functools
+from typing import Optional, Tuple, Union
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .attention import NEG_INF
+from .attention import (
+    NEG_INF,
+    FlashConfig,
+    auto_flash_config,
+    flash_attention_with_lse,
+    supports_flash,
+)
 
 
 def _block_attn(
@@ -33,7 +54,9 @@ def _block_attn(
     q_off: jax.Array, k_off: jax.Array,
     scale: float, causal: bool,
 ) -> Tuple[jax.Array, jax.Array]:
-    """Attention of a local q shard against one k/v chunk.
+    """Einsum fallback: attention of a local q shard against one k/v
+    chunk, materializing the [sq, sk] score block (only used when the
+    flash shape gate fails).
 
     q: [b, sq, n, h]; k,v: [b, sk, n, h]; offsets are the chunks' global
     sequence starts (traced scalars). Returns (o [b, sq, n, h] normalized
@@ -51,30 +74,80 @@ def _block_attn(
     return o, lse
 
 
+def _flash_block(
+    q: jax.Array, k: jax.Array, v: jax.Array,
+    q_off: jax.Array, k_off: jax.Array,
+    cfg: FlashConfig, causal: bool,
+) -> Tuple[jax.Array, jax.Array]:
+    """Flash-kernel block with the chunk-offset causal mask expressed as
+    a three-way switch (see module docstring). Offsets are traced, so the
+    case index is data-dependent — `lax.switch` compiles all three
+    branches once and executes exactly one per ring step per device."""
+    b, sq, n, h = q.shape
+
+    def future(q, k, v):  # noqa: ARG001 - fully masked: no kernel at all
+        return (
+            jnp.zeros((b, sq, n, h), q.dtype),
+            jnp.full((b, n, sq), NEG_INF, jnp.float32),
+        )
+
+    def diagonal(q, k, v):
+        return flash_attention_with_lse(
+            q, k, v, dataclasses.replace(cfg, causal=True)
+        )
+
+    def past(q, k, v):
+        return flash_attention_with_lse(
+            q, k, v, dataclasses.replace(cfg, causal=False)
+        )
+
+    if not causal:
+        return past(q, k, v)
+    case = (1 + jnp.sign(q_off - k_off)).astype(jnp.int32)
+    return jax.lax.switch(case, [future, diagonal, past], q, k, v)
+
+
 def ring_attention(
     q: jax.Array, k: jax.Array, v: jax.Array,
     axis_name: str = "sp",
     causal: bool = True,
     sm_scale: Optional[float] = None,
+    flash: Union[FlashConfig, bool, None] = None,
 ) -> jax.Array:
     """Local view (call inside `jax.shard_map`): q/k/v are the sequence
-    shards [b, s_local, n, h]; returns the local output shard."""
-    import functools
+    shards [b, s_local, n, h]; returns the local output shard.
 
+    ``flash``: None = auto (Pallas kernels when the shape gate passes,
+    interpret mode off-TPU); False = force the einsum fallback; or an
+    explicit FlashConfig."""
     size = jax.lax.psum(1, axis_name)  # static axis size
     idx = jax.lax.axis_index(axis_name)
     s_loc = q.shape[1]
     scale = (
         sm_scale if sm_scale is not None else 1.0 / np.sqrt(q.shape[-1])
     )
+    use_flash = False
+    if flash is not False:
+        interpret = jax.default_backend() != "tpu"
+        cfg = (
+            flash if isinstance(flash, FlashConfig)
+            else auto_flash_config(s_loc, interpret=interpret)
+        )
+        cfg = dataclasses.replace(cfg, sm_scale=scale)
+        use_flash = supports_flash(s_loc, q.shape[-1], cfg)
     perm = [(i, (i + 1) % size) for i in range(size)]
     # Checkpoint each block: scan autodiff would otherwise stack every
-    # step's score/prob residuals — an O(s_loc·s) slab per device, which
-    # is exactly what ring attention exists to avoid. Recomputing the
-    # block in backward keeps peak memory at one block-pair.
-    block = jax.checkpoint(
-        functools.partial(_block_attn, scale=scale, causal=causal)
-    )
+    # step's residuals; recomputing the block in backward keeps peak
+    # memory at one block-pair. (The flash kernel recomputes from lse
+    # anyway; checkpoint also covers the einsum fallback.)
+    if use_flash:
+        block = jax.checkpoint(
+            functools.partial(_flash_block, cfg=cfg, causal=causal)
+        )
+    else:
+        block = jax.checkpoint(
+            functools.partial(_block_attn, scale=scale, causal=causal)
+        )
 
     def merge(o, lse, o_b, lse_b):
         new_lse = jnp.logaddexp(lse, lse_b)
@@ -117,6 +190,7 @@ def ring_attention_sharded(
     mesh: jax.sharding.Mesh,
     causal: bool = True,
     sm_scale: Optional[float] = None,
+    flash: Union[FlashConfig, bool, None] = None,
 ) -> jax.Array:
     """Global view: q/k/v [b, s, n, h] with b on "dp", s on "sp", heads on
     "tp". Wraps `ring_attention` in shard_map over the full mesh."""
@@ -125,7 +199,8 @@ def ring_attention_sharded(
     spec = P("dp", "sp", "tp", None)
     return jax.shard_map(
         lambda q, k, v: ring_attention(
-            q, k, v, axis_name="sp", causal=causal, sm_scale=sm_scale
+            q, k, v, axis_name="sp", causal=causal, sm_scale=sm_scale,
+            flash=flash,
         ),
         mesh=mesh,
         in_specs=(spec, spec, spec),

@@ -63,6 +63,11 @@ class FakeAPIServer:
 
         class Handler(BaseHTTPRequestHandler):
             protocol_version = "HTTP/1.1"
+            # Responses go out as two writes (headers, body); with Nagle
+            # on, the body segment waits out the client's delayed ACK —
+            # ~40ms PER RESPONSE, which made every CRD/event write look
+            # 40ms slow and wrecked drain-rate numbers.
+            disable_nagle_algorithm = True
 
             def log_message(self, *args):  # noqa: D102
                 pass

@@ -1,0 +1,99 @@
+"""AsyncSink flow control: batch drain, coalescing keys, bounded queue
+with drop-oldest, and drain-then-stop semantics (VERDICT r3 #6 — queued
+Bound/Released records must not die with the daemon thread)."""
+
+import threading
+import time
+
+from elastic_tpu_agent.async_sink import AsyncSink
+
+
+def test_stop_drains_everything_submitted_before_it():
+    done = []
+    gate = threading.Event()
+
+    def slowish(i):
+        def op():
+            gate.wait(5.0)
+            done.append(i)
+        return op
+
+    sink = AsyncSink("t")
+    for i in range(50):
+        sink.submit(slowish(i))
+    gate.set()
+    sink.stop(timeout=10.0)
+    assert len(done) == 50, "stop() lost queued work"
+
+
+def test_submit_after_stop_is_refused():
+    sink = AsyncSink("t")
+    sink.stop()
+    ran = []
+    sink.submit(lambda: ran.append(1))
+    time.sleep(0.05)
+    assert ran == []
+
+
+def test_coalescing_key_supersedes_queued_op():
+    ran = []
+    hold = threading.Event()
+    sink = AsyncSink("t")
+    sink.submit(hold.wait)  # occupy the worker so the next ops stay queued
+    sink.submit(lambda: ran.append("old"), key="k")
+    sink.submit(lambda: ran.append("new"), key="k")
+    sink.submit(lambda: ran.append("other"))
+    hold.set()
+    assert sink.flush(timeout=5.0)
+    assert ran == ["new", "other"], ran
+
+
+def test_bounded_queue_drops_oldest_and_counts():
+    drops = []
+    hold = threading.Event()
+    started = threading.Event()
+    sink = AsyncSink("t", max_queue=10, on_drop=lambda: drops.append(1))
+    ran = []
+
+    def blocker():
+        started.set()
+        hold.wait(5.0)
+
+    sink.submit(blocker)
+    assert started.wait(5.0)  # worker is busy; the flood stays queued
+    for i in range(25):
+        sink.submit(lambda i=i: ran.append(i))
+    hold.set()
+    assert sink.flush(timeout=5.0)
+    assert sink.dropped == 15
+    assert len(drops) == 15
+    # the NEWEST 10 survived (drop-oldest)
+    assert ran == list(range(15, 25))
+
+
+def test_batch_drain_keeps_order_within_batch():
+    ran = []
+    hold = threading.Event()
+    sink = AsyncSink("t")
+    sink.submit(hold.wait)
+    for i in range(20):
+        sink.submit(lambda i=i: ran.append(i))
+    hold.set()
+    assert sink.flush(timeout=5.0)
+    assert ran == list(range(20))
+
+
+def test_self_disable_after_consecutive_failures():
+    def boom():
+        raise RuntimeError("nope")
+
+    sink = AsyncSink("t", max_failures=3)
+    for _ in range(3):
+        sink.submit(boom)
+    assert sink.flush(timeout=5.0)
+    assert sink.disabled
+    ran = []
+    sink.submit(lambda: ran.append(1))
+    time.sleep(0.05)
+    assert ran == []
+    sink.stop()

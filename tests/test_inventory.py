@@ -1,0 +1,178 @@
+"""ElasticTPU chip-inventory lifecycle (VERDICT r3 #7): boot publish,
+upsert idempotence, restore's stale sweep, and the health→phase loop that
+keeps an external scheduler from placing onto a dead chip."""
+
+import pytest
+
+from elastic_tpu_agent.crd import (
+    ElasticTPU,
+    ElasticTPUClient,
+    PhaseAvailable,
+    PhaseFailed,
+)
+from elastic_tpu_agent.common import ResourceTPUCore, TPUPercentEachChip
+
+from test_e2e import Cluster, wait_until
+
+
+@pytest.fixture()
+def cluster(tmp_path):
+    c = Cluster(tmp_path)
+    c.start()
+    yield c
+    c.stop()
+
+
+def _client(cluster) -> ElasticTPUClient:
+    return ElasticTPUClient(cluster.opts.kube_client)
+
+
+def _inventory(cluster):
+    return sorted(
+        (o for o in _client(cluster).list(cluster.node)
+         if "-chip" in o.name),
+        key=lambda o: o.name,
+    )
+
+
+def test_boot_publishes_available_inventory(cluster):
+    """After start, every discovered chip has an Available-phase object
+    with its capacity (reference modeled these phases but never wrote
+    them, vendored types.go:49-78)."""
+    assert cluster.manager.crd_recorder.flush(timeout=10.0)
+    objs = _inventory(cluster)
+    assert [o.name for o in objs] == [
+        f"{cluster.node}-chip{i}" for i in range(4)
+    ]
+    for i, o in enumerate(objs):
+        assert o.phase == PhaseAvailable
+        assert o.chip_indexes == [i]
+        assert o.capacity[ResourceTPUCore] == str(TPUPercentEachChip)
+        assert int(o.capacity["elasticgpu.io/tpu-memory"]) > 0
+
+
+def test_publish_inventory_is_upsert_idempotent(cluster):
+    recorder = cluster.manager.crd_recorder
+    assert recorder.flush(timeout=10.0)
+    before = _inventory(cluster)
+    recorder.publish_inventory(cluster.manager.operator.devices())
+    recorder.publish_inventory(cluster.manager.operator.devices())
+    assert recorder.flush(timeout=10.0)
+    after = _inventory(cluster)
+    assert [o.name for o in after] == [o.name for o in before]
+    assert all(o.phase == PhaseAvailable for o in after)
+
+
+def test_restore_sweeps_stale_inventory_keeps_live(cluster):
+    """A chip object left over from a host reshape (chip no longer
+    present) is swept by restore's reconcile; present chips' objects
+    survive."""
+    recorder = cluster.manager.crd_recorder
+    assert recorder.flush(timeout=10.0)
+    ghost = ElasticTPU(
+        name=f"{cluster.node}-chip9",
+        node_name=cluster.node,
+        capacity={ResourceTPUCore: "100"},
+        chip_indexes=[9],
+        phase=PhaseAvailable,
+    )
+    _client(cluster).create(ghost)
+    cluster.manager.restore()
+    assert recorder.flush(timeout=10.0)
+    names = [o.name for o in _inventory(cluster)]
+    assert f"{cluster.node}-chip9" not in names
+    assert names == [f"{cluster.node}-chip{i}" for i in range(4)]
+
+
+def test_unhealthy_chip_flips_inventory_to_failed_and_back(cluster):
+    """health_once drives the inventory phase: dead chip → Failed (with
+    reason), recovery → Available."""
+    recorder = cluster.manager.crd_recorder
+    assert recorder.flush(timeout=10.0)
+    op = cluster.manager.operator
+    plugin = cluster.manager.plugin
+
+    op.set_unhealthy({2})
+    assert plugin.health_once()
+    assert recorder.flush(timeout=10.0)
+    objs = {o.name: o for o in _inventory(cluster)}
+    assert objs[f"{cluster.node}-chip2"].phase == PhaseFailed
+    # the other chips stay Available
+    assert objs[f"{cluster.node}-chip0"].phase == PhaseAvailable
+
+    op.set_unhealthy(set())
+    assert plugin.health_once()
+    assert recorder.flush(timeout=10.0)
+    objs = {o.name: o for o in _inventory(cluster)}
+    assert objs[f"{cluster.node}-chip2"].phase == PhaseAvailable
+
+
+def test_allocatable_drift_detected_and_evented(cluster):
+    """VERDICT r3 #8: kubelet's GetAllocatableResources view is
+    cross-checked against the advertisement; a chip kubelet doesn't count
+    surfaces as a warning node event."""
+    from elastic_tpu_agent.plugins.tpushare import (
+        core_device_id,
+        mem_device_id,
+    )
+
+    # kubelet counts chips 0-2 for core (chip 3 missing) and an absent
+    # chip 7 for memory
+    cluster.kubelet.allocatable[ResourceTPUCore] = [
+        core_device_id(c, u) for c in range(3) for u in range(100)
+    ]
+    cluster.kubelet.allocatable["elasticgpu.io/tpu-memory"] = [
+        mem_device_id(c, u) for c in [0, 1, 2, 3, 7] for u in range(4)
+    ]
+    drift = cluster.manager.check_allocatable_drift()
+    assert drift[ResourceTPUCore] == {"missing": [3], "extra": []}
+    assert drift["elasticgpu.io/tpu-memory"] == {
+        "missing": [], "extra": [7]
+    }
+    # warning event landed on the node
+    assert cluster.manager.events is not None
+    cluster.manager.events.flush()
+    events = [
+        e for e in cluster.apiserver.core_events
+        if e.get("reason") == "TPUAllocatableDrift"
+    ]
+    assert events, "drift did not surface as a node event"
+    assert "chip(s) 3" in events[0]["message"]
+
+
+def test_allocatable_in_sync_reports_empty(cluster):
+    from elastic_tpu_agent.plugins.tpushare import (
+        core_device_id,
+        mem_device_id,
+    )
+
+    cluster.kubelet.allocatable[ResourceTPUCore] = [
+        core_device_id(c, u) for c in range(4) for u in range(100)
+    ]
+    cluster.kubelet.allocatable["elasticgpu.io/tpu-memory"] = [
+        mem_device_id(c, u) for c in range(4) for u in range(4)
+    ]
+    assert cluster.manager.check_allocatable_drift() == {}
+
+
+def test_allocatable_unknown_on_old_kubelet(cluster):
+    """A fresh boot (kubelet has nothing for our resources yet) must NOT
+    cry drift; a v1alpha1-only kubelet reports None (unknown)."""
+    assert cluster.manager.check_allocatable_drift() == {}  # nothing seen
+    cluster.manager.pr_client.reset()
+    cluster.kubelet.allocatable_disabled = True
+    cluster.manager.pr_client.reset()
+    assert cluster.manager.check_allocatable_drift() is None
+
+
+def test_health_flip_carries_reason_into_status(cluster):
+    recorder = cluster.manager.crd_recorder
+    assert recorder.flush(timeout=10.0)
+    op = cluster.manager.operator
+    op.set_unhealthy({1})
+    # stub operator has no health_reasons(); the generic reason applies
+    assert cluster.manager.plugin.health_once()
+    assert recorder.flush(timeout=10.0)
+    obj = _client(cluster).get(f"{cluster.node}-chip1")
+    assert obj.phase == PhaseFailed
+    assert obj.message
