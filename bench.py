@@ -195,6 +195,9 @@ def tpu_measure_once():
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 
     devices = jax.devices()
+    # Phase marker for the parent's watchdog: backend init completed.
+    # (stderr — stdout carries only the final JSON line.)
+    print("bench-phase: devices-initialized", file=sys.stderr, flush=True)
     platform = devices[0].platform
     if platform == "cpu":
         return {"skipped": "cpu-only host"}
@@ -300,44 +303,88 @@ def tpu_measure_once():
 # is wedged in compile/init — one more full-length attempt, then give
 # up, so a dead tunnel can't eat the whole bench budget.
 _TPU_RETRY_DELAYS_S = (0.0, 5.0, 20.0)
+# Phased watchdog budgets: a wedged backend (init never completes) is
+# killed after INIT; once the child reports devices-initialized it gets
+# the full TOTAL for the (legitimately slow) first remote compile.
+_TPU_INIT_TIMEOUT_S = int(
+    os.environ.get("ELASTIC_TPU_BENCH_TPU_INIT_TIMEOUT_S", "300")
+)
 _TPU_SUBPROC_TIMEOUT_S = int(
     os.environ.get("ELASTIC_TPU_BENCH_TPU_TIMEOUT_S", "1500")
-)  # first compile through a relay is minutes
+)
 _TPU_MAX_TIMEOUTS = 2
+
+
+def _run_tpu_child():
+    """One watchdogged child run.
+
+    Returns (result_dict | None, err | None, timed_out: bool) — the
+    timeout flag is structured, not parsed back out of prose (a crash
+    whose stderr merely contains 'timed out' must count as a fast
+    failure, not a timeout)."""
+    import subprocess
+
+    proc = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), "--tpu-only"],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+    )
+    stderr_chunks = []
+    initialized = threading.Event()
+
+    def drain_stderr():
+        for raw in proc.stderr:
+            stderr_chunks.append(raw)
+            if b"devices-initialized" in raw:
+                initialized.set()
+
+    t = threading.Thread(target=drain_stderr, daemon=True)
+    t.start()
+    start = time.monotonic()
+    while True:
+        rc = proc.poll()
+        if rc is not None:
+            break
+        elapsed = time.monotonic() - start
+        if not initialized.is_set() and elapsed > _TPU_INIT_TIMEOUT_S:
+            proc.kill()
+            proc.wait()
+            return None, (
+                f"backend init did not complete within {_TPU_INIT_TIMEOUT_S}s"
+            ), True
+        if elapsed > _TPU_SUBPROC_TIMEOUT_S:
+            proc.kill()
+            proc.wait()
+            return None, (
+                f"measurement timed out after {_TPU_SUBPROC_TIMEOUT_S}s"
+            ), True
+        time.sleep(0.5)
+    stdout = proc.stdout.read().decode()
+    t.join(timeout=5)
+    for line in reversed(stdout.splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                return json.loads(line), None, False
+            except ValueError:
+                break
+    tail = b"".join(stderr_chunks).decode()[-500:]
+    return None, f"no result (rc={rc}): {tail}", False
 
 
 def run_tpu_throughput():
     """Measure in an isolated subprocess with retry + backoff."""
-    import subprocess
-
     last_err = None
     timeouts = 0
     for delay in _TPU_RETRY_DELAYS_S:
         if delay:
             time.sleep(delay)
-        try:
-            proc = subprocess.run(
-                [sys.executable, os.path.abspath(__file__), "--tpu-only"],
-                capture_output=True, timeout=_TPU_SUBPROC_TIMEOUT_S,
-            )
-        except subprocess.TimeoutExpired:
-            timeouts += 1
-            last_err = f"measurement timed out after {_TPU_SUBPROC_TIMEOUT_S}s"
-            if timeouts >= _TPU_MAX_TIMEOUTS:
-                break
-            continue
-        result = None
-        for line in reversed(proc.stdout.decode().splitlines()):
-            line = line.strip()
-            if line.startswith("{"):
-                try:
-                    result = json.loads(line)
-                except ValueError:
-                    pass
-                break
-        if result is None:
-            tail = proc.stderr.decode()[-500:]
-            last_err = f"no result (rc={proc.returncode}): {tail}"
+        result, err, timed_out = _run_tpu_child()
+        if err is not None:
+            last_err = err
+            if timed_out:
+                timeouts += 1
+                if timeouts >= _TPU_MAX_TIMEOUTS:
+                    break
             continue
         if result.get("skipped"):
             return None  # genuinely no accelerator; not an error

@@ -30,6 +30,15 @@ MAX_CONSECUTIVE_FAILURES = 5
 DEFAULT_MAX_QUEUE = 4096
 
 
+def drop_hook(metrics) -> Optional[Callable[[], None]]:
+    """The on_drop callback for a metrics object carrying the shared
+    elastic_tpu_observability_dropped_total counter (one place, so every
+    AsyncSink consumer wires the metric identically)."""
+    if metrics is not None and hasattr(metrics, "observability_dropped"):
+        return metrics.observability_dropped.inc
+    return None
+
+
 class AsyncSink:
     """Single worker thread draining a bounded, coalescing op queue;
     self-disables after ``max_failures`` consecutive errors."""

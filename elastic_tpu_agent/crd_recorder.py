@@ -26,7 +26,7 @@ from __future__ import annotations
 import logging
 from typing import Iterable, List, Optional
 
-from .async_sink import AsyncSink
+from .async_sink import AsyncSink, drop_hook
 from .common import ResourceTPUCore, ResourceTPUMemory, TPUPercentEachChip
 from .crd import (
     ElasticTPU,
@@ -54,10 +54,7 @@ class CRDRecorder:
         self._client = client
         self._node = node_name
         self._accelerator_type = accelerator_type
-        on_drop = None
-        if metrics is not None and hasattr(metrics, "observability_dropped"):
-            on_drop = metrics.observability_dropped.inc
-        self._sink = AsyncSink("crd-recorder", on_drop=on_drop)
+        self._sink = AsyncSink("crd-recorder", on_drop=drop_hook(metrics))
 
     # -- public API (called from plugin bind / GC / manager restore) ----------
 

@@ -20,7 +20,7 @@ import time
 from datetime import datetime, timezone
 from typing import Dict, Optional, Tuple
 
-from ..async_sink import AsyncSink
+from ..async_sink import AsyncSink, drop_hook
 
 logger = logging.getLogger(__name__)
 
@@ -52,10 +52,7 @@ class EventRecorder:
     def __init__(self, kube_client, node_name: str, metrics=None) -> None:
         self._client = kube_client
         self._node = node_name
-        on_drop = None
-        if metrics is not None and hasattr(metrics, "observability_dropped"):
-            on_drop = metrics.observability_dropped.inc
-        self._sink = AsyncSink("event-recorder", on_drop=on_drop)
+        self._sink = AsyncSink("event-recorder", on_drop=drop_hook(metrics))
         # key -> (last_emit_monotonic, suppressed_since_then, emit_ctx)
         # where emit_ctx = (namespace, base, involved, reason, message, type_)
         # is kept so suppressed tails can be surfaced after the window.

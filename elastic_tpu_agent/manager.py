@@ -341,6 +341,12 @@ class TPUManager:
         if resp is None:
             return None
         ours = {c.index for c in self.operator.devices()}
+        # Chips we ourselves advertise Unhealthy are EXPECTED to be absent
+        # from kubelet's allocatable view — comparing against them would
+        # turn every health report into a false drift warning.
+        core = getattr(self.plugin, "core", None)
+        if core is not None:
+            ours -= getattr(core, "_unhealthy_chips", set())
         drift: dict = {}
         for resource in (ResourceTPUCore, ResourceTPUMemory):
             seen: set = set()

@@ -292,6 +292,9 @@ class _TPUSharePluginBase(_ListAndWatchMixin, rpc.DevicePluginServicer):
         self._crd = config.crd_recorder
         self._events = config.events
         self._chips = {c.index: c for c in self._operator.devices()}
+        # Whole-chip (exclusive) mode: the operator makes no virtual
+        # nodes; advertisement/env/qos all branch on this one flag.
+        self._whole_chip = not getattr(self._operator, "virtual_nodes", True)
         self._unhealthy_chips: set = set()
         self._alloc_dir = config.extra.get(
             "alloc_spec_dir", DEFAULT_ALLOC_SPEC_DIR
@@ -482,7 +485,7 @@ class _TPUSharePluginBase(_ListAndWatchMixin, rpc.DevicePluginServicer):
 
     def _bind_located(self, device: Device, owner, pod: dict) -> None:
         annotations = pod.get("metadata", {}).get("annotations", {}) or {}
-        if not getattr(self._operator, "virtual_nodes", True):
+        if self._whole_chip:
             # Whole-chip mode (reference: the nvidia no-op operator,
             # pkg/operator/nvidia.go): kubelet's device choice IS the
             # placement; no elastic-scheduler annotation is required and no
@@ -766,10 +769,9 @@ class TPUShareCorePlugin(_TPUSharePluginBase):
 
     def _device_list(self) -> List[dp.Device]:
         out = []
-        whole_chip = not getattr(self._operator, "virtual_nodes", True)
         for chip in self._chips.values():
             health = self._chip_health(chip.index)
-            if whole_chip:
+            if self._whole_chip:
                 # One advertised device == one physical chip (the reference
                 # no-op operator's shape, pkg/operator/nvidia.go:1-22).
                 # Advertising 100 fractional units here would let kubelet
@@ -791,13 +793,13 @@ class TPUShareCorePlugin(_TPUSharePluginBase):
         return out
 
     def _chips_for_request(self, n_ids: int) -> int:
-        if not getattr(self._operator, "virtual_nodes", True):
+        if self._whole_chip:
             return max(1, n_ids)  # whole-chip: one id == one chip
         return max(1, math.ceil(n_ids / TPUPercentEachChip))
 
     def _alloc_envs(self, device: Device, n_chips: int) -> Dict[str, str]:
         envs = super()._alloc_envs(device, n_chips)
-        if not getattr(self._operator, "virtual_nodes", True):
+        if self._whole_chip:
             # Whole-chip mode: the env must match the device specs, which
             # come from the id-encoded chips — not from ceil(units/100)
             # (kubelet may have split the ids across more chips than the
@@ -811,7 +813,7 @@ class TPUShareCorePlugin(_TPUSharePluginBase):
         return envs
 
     def _alloc_device_specs(self, device: Device, n_chips: int) -> List[dp.DeviceSpec]:
-        if not getattr(self._operator, "virtual_nodes", True):
+        if self._whole_chip:
             # Whole-chip mode: the fake ids already name physical chips and
             # no symlink will be made at PreStart — hand out the real
             # chardev paths, densely renumbered in-container.
@@ -838,7 +840,7 @@ class TPUShareCorePlugin(_TPUSharePluginBase):
         ]
 
     def _qos_kwargs(self, device: Device) -> Dict:
-        if not getattr(self._operator, "virtual_nodes", True):
+        if self._whole_chip:
             # Whole-chip: one advertised id == one chip == 100% of it. The
             # qos contract ("core share in 1% units", qos.py) would
             # otherwise read an exclusive pod as a 1% share and a

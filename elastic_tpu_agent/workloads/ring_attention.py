@@ -133,7 +133,12 @@ def ring_attention(
             flash if isinstance(flash, FlashConfig)
             else auto_flash_config(s_loc, interpret=interpret)
         )
-        cfg = dataclasses.replace(cfg, sm_scale=scale)
+        if cfg.sm_scale is None:
+            # the ring-level scale only fills in when the caller's config
+            # didn't pin one
+            cfg = dataclasses.replace(cfg, sm_scale=scale)
+        else:
+            scale = cfg.sm_scale  # einsum fallback must agree with it
         use_flash = supports_flash(s_loc, q.shape[-1], cfg)
     perm = [(i, (i + 1) % size) for i in range(size)]
     # Checkpoint each block: scan autodiff would otherwise stack every

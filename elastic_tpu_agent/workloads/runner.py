@@ -94,6 +94,20 @@ def main(argv=None) -> int:
     parser.add_argument("--sp", type=int, default=1)
     parser.add_argument("--tp", type=int, default=None)
     parser.add_argument(
+        "--pp", type=int, default=1,
+        help="pipeline-parallel stages (layer stack staged over a 'pp' "
+             "mesh axis; n_layers must divide evenly)",
+    )
+    parser.add_argument(
+        "--pp-schedule", choices=("gpipe", "1f1b"), default="gpipe",
+        help="pipeline schedule: GPipe (autodiff backward) or 1F1B "
+             "(interleaved, O(pp) activation memory)",
+    )
+    parser.add_argument(
+        "--n-micro", type=int, default=4,
+        help="microbatches per step in pipeline mode (--pp > 1)",
+    )
+    parser.add_argument(
         "--checkpoint-dir", default="",
         help="enable preemption-tolerant checkpoint/resume (orbax)",
     )
@@ -120,12 +134,34 @@ def main(argv=None) -> int:
     from .transformer import ModelConfig, make_mesh, make_train_step
 
     cfg = ModelConfig(max_seq=args.seq, **PRESETS[args.preset])
-    mesh = make_mesh(dp=args.dp, sp=args.sp, tp=args.tp)
-    train_step, init_all, _ = make_train_step(cfg, mesh)
+    if args.pp > 1:
+        from .pipeline import make_pipeline_mesh
+        from .transformer_pipeline import make_pipeline_transformer_step
+
+        dp = args.dp or max(1, len(jax.devices()) // args.pp)
+        mesh = make_pipeline_mesh(pp=args.pp, dp=dp)
+        train_step, init_all = make_pipeline_transformer_step(
+            cfg, mesh, n_micro=args.n_micro, schedule=args.pp_schedule
+        )
+        assert args.batch % args.n_micro == 0, (
+            f"--batch {args.batch} must divide into --n-micro {args.n_micro}"
+        )
+        assert (args.batch // args.n_micro) % dp == 0, (
+            f"microbatch size {args.batch // args.n_micro} must be "
+            f"divisible by dp={dp}"
+        )
+        tokens = jax.random.randint(
+            jax.random.key(1),
+            (args.n_micro, args.batch // args.n_micro, args.seq + 1),
+            0, cfg.vocab,
+        )
+    else:
+        mesh = make_mesh(dp=args.dp, sp=args.sp, tp=args.tp)
+        train_step, init_all, _ = make_train_step(cfg, mesh)
+        tokens = jax.random.randint(
+            jax.random.key(1), (args.batch, args.seq + 1), 0, cfg.vocab
+        )
     params, opt_state = init_all(jax.random.key(0))
-    tokens = jax.random.randint(
-        jax.random.key(1), (args.batch, args.seq + 1), 0, cfg.vocab
-    )
 
     # Preemption-tolerant resume (TPU pods are preemptible; the elastic
     # scheduler may also move us): restore the latest checkpoint onto the

@@ -9,12 +9,18 @@ set -e
 HOST_ROOT="${HOST_ROOT:-/host}"
 SRC_DIR="$(dirname "$0")"
 
+# NOTE: on GKE COS nodes /usr is read-only — there, skip the binary
+# install entirely and use the agent's NRI path (--nri-socket), which
+# needs no host binaries. Set SKIP_BINARIES=1 to do that explicitly.
+if [ "${SKIP_BINARIES:-0}" != "1" ]; then
+mkdir -p "$HOST_ROOT/usr/local/bin"
 install -m 0755 "$SRC_DIR/elastic-tpu-hook" \
     "$HOST_ROOT/usr/local/bin/elastic-tpu-hook"
 install -m 0755 "$SRC_DIR/elastic-tpu-container-toolkit" \
     "$HOST_ROOT/usr/local/bin/elastic-tpu-container-toolkit"
 install -m 0755 "$SRC_DIR/mount_elastic_tpu" \
     "$HOST_ROOT/usr/local/bin/mount_elastic_tpu"
+fi
 
 # OCI hooks dir consumed by CRI-O / podman directly; for containerd+runc,
 # reference this json from the runtime handler or use an NRI/base-spec that
@@ -29,26 +35,92 @@ cat > "$HOOK_DIR/10-elastic-tpu.json" <<'EOF'
   "stages": ["createRuntime", "prestart"]
 }
 EOF
+# containerd activation path 2 (RuntimeClass + base_runtime_spec, see
+# docs/operations.md): ENABLE_BASE_SPEC=1 emits
+# /etc/elastic-tpu/cri-base.json — the OCI base spec $BASE_SPEC_SRC
+# (dump one with `ctr oci spec`) with the elastic-tpu hook injected at
+# createRuntime+prestart. Runs under the agent image, so python3 exists.
+if [ "${ENABLE_BASE_SPEC:-0}" = "1" ]; then
+    if [ ! -f "${BASE_SPEC_SRC:-}" ]; then
+        echo "ENABLE_BASE_SPEC=1 needs BASE_SPEC_SRC=<ctr oci spec dump>" >&2
+        exit 1
+    fi
+    mkdir -p "$HOST_ROOT/etc/elastic-tpu"
+    python3 - "$BASE_SPEC_SRC" "$HOST_ROOT/etc/elastic-tpu/cri-base.json" <<'PYEOF'
+import json, sys
+src, dst = sys.argv[1], sys.argv[2]
+spec = json.load(open(src))
+hook = {"path": "/usr/local/bin/elastic-tpu-hook"}
+hooks = spec.setdefault("hooks", {})
+for stage in ("createRuntime", "prestart"):
+    entries = hooks.setdefault(stage, [])
+    if not any(h.get("path") == hook["path"] for h in entries):
+        entries.append(dict(hook))
+json.dump(spec, open(dst, "w"), indent=2)
+print(f"wrote {dst}")
+PYEOF
+    echo "point a runtime handler at it:"
+    echo '  [plugins."io.containerd.grpc.v1.cri".containerd.runtimes.elastic-tpu]'
+    echo '    runtime_type = "io.containerd.runc.v2"'
+    echo '    base_runtime_spec = "/etc/elastic-tpu/cri-base.json"'
+fi
+
 # containerd + runc (the GKE default) ignores hooks.d; there the agent
 # injects via NRI instead (elastic_tpu_agent/nri/, --nri-socket flag on
 # the DaemonSet). NRI ships in containerd >= 1.7 but is disabled by
 # default before 2.0; ENABLE_NRI=1 enables it via a config edit.
 if [ "${ENABLE_NRI:-0}" = "1" ]; then
     CTRD_CONF="$HOST_ROOT/etc/containerd/config.toml"
-    if [ -f "$CTRD_CONF" ] && \
-       ! grep -q 'io.containerd.nri.v1.nri' "$CTRD_CONF"; then
-        cp "$CTRD_CONF" "$CTRD_CONF.elastic-tpu.bak"
-        cat >> "$CTRD_CONF" <<'EOF'
-
-# added by elastic-tpu-agent installer: enable NRI for device injection
-[plugins."io.containerd.nri.v1.nri"]
-  disable = false
-  disable_connections = false
-  socket_path = "/var/run/nri/nri.sock"
-EOF
-        echo "enabled NRI in $CTRD_CONF (backup: $CTRD_CONF.elastic-tpu.bak);"
-        echo "restart containerd for it to take effect"
-    fi
+    # Three host states to handle (each loudly): no config.toml (create a
+    # minimal one — containerd merges it over its defaults), config
+    # without the NRI section (append it), and the common `containerd
+    # config default` dump whose section exists with disable = true
+    # (flip it in place). Runs under the agent image, so python3 exists.
+    python3 - "$CTRD_CONF" <<'PYEOF'
+import re, shutil, sys, os
+conf = sys.argv[1]
+SECTION = '[plugins."io.containerd.nri.v1.nri"]'
+BLOCK = (
+    "\n# added by elastic-tpu-agent installer: enable NRI for device"
+    " injection\n"
+    + SECTION + "\n"
+    "  disable = false\n"
+    "  disable_connections = false\n"
+    '  socket_path = "/var/run/nri/nri.sock"\n'
+)
+if not os.path.exists(conf):
+    os.makedirs(os.path.dirname(conf), exist_ok=True)
+    with open(conf, "w") as f:
+        f.write("version = 2\n" + BLOCK)
+    print(f"created {conf} with NRI enabled; restart containerd")
+    sys.exit(0)
+raw = open(conf).read()
+if "io.containerd.nri.v1.nri" not in raw:
+    shutil.copy(conf, conf + ".elastic-tpu.bak")
+    with open(conf, "a") as f:
+        f.write(BLOCK)
+    print(f"enabled NRI in {conf} (backup: {conf}.elastic-tpu.bak); "
+          "restart containerd")
+    sys.exit(0)
+# Section exists: flip disable flags inside it only.
+start = raw.index("io.containerd.nri.v1.nri")
+nxt = re.search(r"^\s*\[", raw[start:], re.M | re.S)
+# find the end of the section: next table header after this line
+tail = raw[start:]
+m = re.search(r"\n\s*\[", tail)
+end = start + (m.start() if m else len(tail))
+section = raw[start:end]
+flipped = re.sub(r"(disable(?:_connections)?\s*=\s*)true", r"\1false",
+                 section)
+if flipped == section:
+    print(f"NRI already enabled in {conf}; nothing to do")
+    sys.exit(0)
+shutil.copy(conf, conf + ".elastic-tpu.bak")
+with open(conf, "w") as f:
+    f.write(raw[:start] + flipped + raw[end:])
+print(f"flipped NRI disable -> false in {conf} "
+      f"(backup: {conf}.elastic-tpu.bak); restart containerd")
+PYEOF
 fi
 
 echo "elastic-tpu host helpers installed under $HOST_ROOT/usr/local/bin"

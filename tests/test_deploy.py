@@ -12,7 +12,8 @@ import yaml
 
 from elastic_tpu_agent.cli import parse_args
 
-DEPLOY = os.path.join(os.path.dirname(__file__), "..", "deploy")
+REPO = os.path.join(os.path.dirname(__file__), "..")
+DEPLOY = os.path.join(REPO, "deploy")
 
 
 def _load(name):
@@ -109,6 +110,127 @@ def test_crd_manifest_matches_client():
     assert served.get("subresources", {}).get("status") is not None, (
         "client PUTs /status; the CRD must declare the subresource"
     )
+
+
+def test_install_sh_base_spec_generation(tmp_path):
+    """ENABLE_BASE_SPEC=1 injects the hook into a ctr-oci-spec dump and
+    writes the cri-base.json a containerd runtime handler points at
+    (docs/operations.md containerd path 2)."""
+    import json
+    import subprocess
+
+    host = tmp_path / "host"
+    (host / "usr" / "local" / "bin").mkdir(parents=True)
+    src = tmp_path / "spec.json"
+    src.write_text(json.dumps({
+        "ociVersion": "1.0.2",
+        "process": {"args": ["sh"]},
+        "root": {"path": "rootfs"},
+    }))
+    # stage fake binaries next to a copied install.sh so `install` finds them
+    stage = tmp_path / "native"
+    stage.mkdir()
+    for name in ("elastic-tpu-hook", "elastic-tpu-container-toolkit",
+                 "mount_elastic_tpu"):
+        (stage / name).write_text("#!/bin/sh\n")
+    script = stage / "install.sh"
+    script.write_text(
+        open(os.path.join(REPO, "native", "install.sh")).read()
+    )
+    script.chmod(0o755)
+    result = subprocess.run(
+        ["sh", str(script)],
+        env={**os.environ, "HOST_ROOT": str(host),
+             "ENABLE_BASE_SPEC": "1", "BASE_SPEC_SRC": str(src)},
+        capture_output=True, timeout=60,
+    )
+    assert result.returncode == 0, result.stderr.decode()
+    out = json.load(open(host / "etc" / "elastic-tpu" / "cri-base.json"))
+    for stage_name in ("createRuntime", "prestart"):
+        paths = [h["path"] for h in out["hooks"][stage_name]]
+        assert paths == ["/usr/local/bin/elastic-tpu-hook"], stage_name
+    # idempotent: re-running does not duplicate the hook
+    result = subprocess.run(
+        ["sh", str(script)],
+        env={**os.environ, "HOST_ROOT": str(host),
+             "ENABLE_BASE_SPEC": "1",
+             "BASE_SPEC_SRC": str(host / "etc" / "elastic-tpu" / "cri-base.json")},
+        capture_output=True, timeout=60,
+    )
+    assert result.returncode == 0
+    out = json.load(open(host / "etc" / "elastic-tpu" / "cri-base.json"))
+    assert len(out["hooks"]["prestart"]) == 1
+
+
+def _run_install(tmp_path, host, extra_env):
+    import subprocess
+
+    stage = tmp_path / f"native-{len(extra_env)}"
+    stage.mkdir(exist_ok=True)
+    for name in ("elastic-tpu-hook", "elastic-tpu-container-toolkit",
+                 "mount_elastic_tpu"):
+        (stage / name).write_text("#!/bin/sh\n")
+    script = stage / "install.sh"
+    script.write_text(
+        open(os.path.join(REPO, "native", "install.sh")).read()
+    )
+    return subprocess.run(
+        ["sh", str(script)],
+        env={**os.environ, "HOST_ROOT": str(host), **extra_env},
+        capture_output=True, timeout=60, text=True,
+    )
+
+
+def test_install_sh_enable_nri_all_config_states(tmp_path):
+    """ENABLE_NRI=1 must activate NRI in every containerd config state:
+    absent config, config without the section, and — the common
+    `containerd config default` dump — a section with disable = true
+    (previously a silent no-op, review r4)."""
+    host = tmp_path / "host"
+    (host / "usr" / "local" / "bin").mkdir(parents=True)
+    conf = host / "etc" / "containerd" / "config.toml"
+
+    # state 1: no config.toml -> created with NRI enabled
+    r = _run_install(tmp_path, host, {"ENABLE_NRI": "1"})
+    assert r.returncode == 0, r.stderr
+    raw = conf.read_text()
+    assert 'io.containerd.nri.v1.nri' in raw and "disable = false" in raw
+
+    # state 2: config without the section -> appended
+    conf.write_text('version = 2\n[plugins."io.containerd.grpc.v1.cri"]\n')
+    r = _run_install(tmp_path, host, {"ENABLE_NRI": "1"})
+    assert r.returncode == 0, r.stderr
+    raw = conf.read_text()
+    assert 'io.containerd.nri.v1.nri' in raw and "disable = false" in raw
+
+    # state 3: the `containerd config default` shape — section present,
+    # disabled -> flipped in place, other sections untouched
+    conf.write_text(
+        'version = 2\n'
+        '[plugins."io.containerd.grpc.v1.cri"]\n'
+        '  sandbox_image = "pause:3.9"\n'
+        '[plugins."io.containerd.nri.v1.nri"]\n'
+        '  disable = true\n'
+        '  disable_connections = true\n'
+        '  plugin_config_path = "/etc/nri/conf.d"\n'
+        '[plugins."io.containerd.runtime.v1.linux"]\n'
+        '  shim_debug = false\n'
+    )
+    r = _run_install(tmp_path, host, {"ENABLE_NRI": "1"})
+    assert r.returncode == 0, r.stderr
+    raw = conf.read_text()
+    assert "disable = false" in raw
+    assert "disable_connections = false" in raw
+    assert "disable = true" not in raw
+    assert 'sandbox_image = "pause:3.9"' in raw  # untouched
+    assert "shim_debug = false" in raw  # booleans outside the section kept
+
+    # state 4: already enabled -> loud no-op, idempotent
+    before = conf.read_text()
+    r = _run_install(tmp_path, host, {"ENABLE_NRI": "1"})
+    assert r.returncode == 0, r.stderr
+    assert "already enabled" in r.stdout
+    assert conf.read_text() == before
 
 
 def test_agent_image_entrypoint_module_exists():

@@ -146,6 +146,39 @@ def test_training_reduces_loss_both_schedules(mesh, tokens):
         )
 
 
+def test_runner_pipeline_mode(tmp_path):
+    """The in-pod runner trains the pipelined flagship end-to-end in a
+    real process (--pp), both schedules."""
+    import json
+    import os
+    import subprocess
+    import sys
+
+    env = {
+        **os.environ,
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+        "PYTHONPATH": os.pathsep.join(
+            [os.path.join(os.path.dirname(__file__), "..")]
+            + os.environ.get("PYTHONPATH", "").split(os.pathsep)
+        ).rstrip(os.pathsep),
+    }
+    for schedule in ("gpipe", "1f1b"):
+        out = subprocess.run(
+            [
+                sys.executable, "-m", "elastic_tpu_agent.workloads.runner",
+                "--preset", "tiny", "--steps", "2", "--batch", "8",
+                "--seq", "32", "--pp", "2", "--n-micro", "4",
+                "--pp-schedule", schedule, "--dp", "2",
+            ],
+            env=env, capture_output=True, text=True, timeout=600,
+        )
+        assert out.returncode == 0, out.stderr[-2000:]
+        report = json.loads(out.stdout.strip().splitlines()[-1])
+        assert report["mesh"] == {"pp": 2, "dp": 2}, report
+        assert report["final_loss"] == report["final_loss"], schedule
+
+
 def test_pp2_also_works(tokens):
     mesh2 = make_pipeline_mesh(pp=2, dp=2)
     cfg = ModelConfig(
