@@ -329,6 +329,7 @@ def _run_tpu_child():
         stdout=subprocess.PIPE, stderr=subprocess.PIPE,
     )
     stderr_chunks = []
+    stdout_chunks = []
     initialized = threading.Event()
 
     def drain_stderr():
@@ -337,8 +338,17 @@ def _run_tpu_child():
             if b"devices-initialized" in raw:
                 initialized.set()
 
+    def drain_stdout():
+        # Both pipes must drain WHILE the child runs: a child that emits
+        # more than the ~64KiB pipe capacity before its final JSON line
+        # would otherwise block on write() forever and read as a timeout.
+        for raw in proc.stdout:
+            stdout_chunks.append(raw)
+
     t = threading.Thread(target=drain_stderr, daemon=True)
     t.start()
+    t_out = threading.Thread(target=drain_stdout, daemon=True)
+    t_out.start()
     start = time.monotonic()
     while True:
         rc = proc.poll()
@@ -358,7 +368,8 @@ def _run_tpu_child():
                 f"measurement timed out after {_TPU_SUBPROC_TIMEOUT_S}s"
             ), True
         time.sleep(0.5)
-    stdout = proc.stdout.read().decode()
+    t_out.join(timeout=5)
+    stdout = b"".join(stdout_chunks).decode()
     t.join(timeout=5)
     for line in reversed(stdout.splitlines()):
         line = line.strip()

@@ -50,16 +50,24 @@ class DeviceLocator(ABC):
 class KubeletDeviceLocator(DeviceLocator):
     """One locator per extended resource (reference: base.go:56-58)."""
 
+    # How long a cache miss will wait for an in-flight refresh (usually
+    # the Allocate-time prefetch) before paying its own List. A full-node
+    # List is single-digit ms even at 1000 pods, so this bound only bites
+    # when the kubelet itself is stalling.
+    JOIN_REFRESH_TIMEOUT_S = 0.25
+
     def __init__(self, resource: str, client: PodResourcesClient) -> None:
         self._resource = resource
         self._client = client
         self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
         self._cache: Dict[str, PodContainer] = {}  # device-set hash -> owner
         self._refresh_seq = 0       # ordering guard: a slow, stale List
         self._installed_seq = 0     # must never replace a newer snapshot
+        self._refreshing = 0        # in-flight List count (join target)
         self._prefetch_wake = threading.Event()
         self._prefetch_thread: Optional[threading.Thread] = None
-        self._prefetch_debounce_s = 0.002
+        self._prefetch_debounce_s = 0.0005
 
     def _refresh(self) -> Dict[str, PodContainer]:
         """Full List -> rebuild hash index for our resource. Returns the
@@ -69,7 +77,14 @@ class KubeletDeviceLocator(DeviceLocator):
         with self._lock:
             self._refresh_seq += 1
             seq = self._refresh_seq
-        resp = self._client.list()
+            self._refreshing += 1
+        try:
+            resp = self._client.list()
+        except Exception:
+            with self._cond:
+                self._refreshing -= 1
+                self._cond.notify_all()
+            raise
         fresh: Dict[str, PodContainer] = {}
         for pod in resp.pod_resources:
             for container in pod.containers:
@@ -94,16 +109,36 @@ class KubeletDeviceLocator(DeviceLocator):
             install = dict(
                 itertools.islice(fresh.items(), _MAX_CACHE_ENTRIES)
             )
-        with self._lock:
+        with self._cond:
             if seq > self._installed_seq:
                 self._installed_seq = seq
                 self._cache = install
+            self._refreshing -= 1
+            self._cond.notify_all()
         return fresh
 
     def locate(self, device: Device) -> PodContainer:
         key = device.hash
-        with self._lock:
+        with self._cond:
             hit = self._cache.get(key)
+            if hit is None and (
+                self._refreshing > 0 or self._prefetch_wake.is_set()
+            ):
+                # A List is in flight or about to start (the Allocate-time
+                # prefetch): join it instead of paying a duplicate full
+                # List — the common PreStart-raced-the-prefetch case.
+                seen = self._installed_seq
+                self._cond.wait_for(
+                    lambda: (
+                        self._installed_seq > seen
+                        or (
+                            self._refreshing == 0
+                            and not self._prefetch_wake.is_set()
+                        )
+                    ),
+                    timeout=self.JOIN_REFRESH_TIMEOUT_S,
+                )
+                hit = self._cache.get(key)
         if hit is not None:
             return hit
         # Miss: refresh inline, consulting OUR OWN snapshot (the shared
@@ -163,8 +198,18 @@ class KubeletDeviceLocator(DeviceLocator):
         while True:
             self._prefetch_wake.wait()
             time.sleep(self._prefetch_debounce_s)
-            self._prefetch_wake.clear()
+            # Clear-then-refresh under the cond: a locate() miss joining a
+            # "pending" prefetch keys off wake-or-refreshing; without the
+            # lock there is a visible instant where both are false and the
+            # join falls through to a duplicate List.
+            with self._cond:
+                self._prefetch_wake.clear()
+                self._refreshing += 1
             try:
                 self._refresh()
             except Exception:  # noqa: BLE001 - locate() retries inline
                 pass
+            finally:
+                with self._cond:
+                    self._refreshing -= 1
+                    self._cond.notify_all()

@@ -345,8 +345,8 @@ class TPUManager:
         # from kubelet's allocatable view — comparing against them would
         # turn every health report into a false drift warning.
         core = getattr(self.plugin, "core", None)
-        if core is not None:
-            ours -= getattr(core, "_unhealthy_chips", set())
+        if core is not None and hasattr(core, "unhealthy_chips"):
+            ours -= core.unhealthy_chips()
         drift: dict = {}
         for resource in (ResourceTPUCore, ResourceTPUMemory):
             seen: set = set()

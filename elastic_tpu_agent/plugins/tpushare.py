@@ -302,6 +302,12 @@ class _TPUSharePluginBase(_ListAndWatchMixin, rpc.DevicePluginServicer):
 
     # -- health ---------------------------------------------------------------
 
+    def unhealthy_chips(self) -> set:
+        """Chip indexes currently advertised Unhealthy to kubelet (public
+        accessor — external consumers like the manager's allocatable
+        cross-check must not depend on private state)."""
+        return set(self._unhealthy_chips)
+
     def _chip_health(self, chip_index: int) -> str:
         return (
             rpc.UNHEALTHY if chip_index in self._unhealthy_chips

@@ -133,12 +133,12 @@ def ring_attention(
             flash if isinstance(flash, FlashConfig)
             else auto_flash_config(s_loc, interpret=interpret)
         )
-        if cfg.sm_scale is None:
-            # the ring-level scale only fills in when the caller's config
-            # didn't pin one
-            cfg = dataclasses.replace(cfg, sm_scale=scale)
-        else:
+        if sm_scale is None and cfg.sm_scale is not None:
             scale = cfg.sm_scale  # einsum fallback must agree with it
+        else:
+            # an explicit sm_scale argument wins over the config's; fill
+            # the config so both paths use the same value
+            cfg = dataclasses.replace(cfg, sm_scale=scale)
         use_flash = supports_flash(s_loc, q.shape[-1], cfg)
     perm = [(i, (i + 1) % size) for i in range(size)]
     # Checkpoint each block: scan autodiff would otherwise stack every

@@ -138,6 +138,11 @@ def main(argv=None) -> int:
         from .pipeline import make_pipeline_mesh
         from .transformer_pipeline import make_pipeline_transformer_step
 
+        if args.sp != 1 or (args.tp or 1) != 1:
+            parser.error(
+                "--pp composes with --dp only; --sp/--tp are not supported "
+                "in pipeline mode (the pp mesh has axes pp x dp)"
+            )
         dp = args.dp or max(1, len(jax.devices()) // args.pp)
         mesh = make_pipeline_mesh(pp=args.pp, dp=dp)
         train_step, init_all = make_pipeline_transformer_step(

@@ -102,10 +102,9 @@ if "io.containerd.nri.v1.nri" not in raw:
     print(f"enabled NRI in {conf} (backup: {conf}.elastic-tpu.bak); "
           "restart containerd")
     sys.exit(0)
-# Section exists: flip disable flags inside it only.
+# Section exists: flip disable flags inside it only (section ends at the
+# next table header).
 start = raw.index("io.containerd.nri.v1.nri")
-nxt = re.search(r"^\s*\[", raw[start:], re.M | re.S)
-# find the end of the section: next table header after this line
 tail = raw[start:]
 m = re.search(r"\n\s*\[", tail)
 end = start + (m.start() if m else len(tail))

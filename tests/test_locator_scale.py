@@ -121,6 +121,42 @@ def test_gate_off_kubelet_still_negotiates_v1(tmp_path):
         k.stop()
 
 
+def test_miss_joins_inflight_prefetch_single_list(tmp_path):
+    """A locate() miss while the Allocate-time prefetch is pending or in
+    flight must JOIN that List, not issue a duplicate one (the PreStart-
+    raced-the-prefetch case; review r4 perf fix)."""
+    import threading
+    import time as _time
+
+    k = FakeKubelet(str(tmp_path / "dp"), str(tmp_path / "pr" / "kubelet.sock"))
+    k.start()
+    try:
+        k.assign("ns", "p", "jax", RESOURCE, _ids(1))
+        client = CountingClient(k.pod_resources_socket)
+        loc = KubeletDeviceLocator(RESOURCE, client)
+        # hold the List so the prefetch is verifiably in flight
+        gate = threading.Event()
+        orig_list = client.list
+
+        def slow_list(timeout_s=5.0):
+            gate.wait(5.0)
+            return orig_list(timeout_s=timeout_s)
+
+        client.list = slow_list
+        loc.prefetch_async()
+        _time.sleep(0.05)  # debounce passed; prefetch blocked in List
+        release = threading.Timer(0.05, gate.set)
+        release.start()
+        owner = loc.locate(Device(_ids(1), RESOURCE))
+        assert owner.name == "p"
+        assert client.lists == 1, (
+            f"locate paid {client.lists} Lists; should have joined the "
+            "prefetch's one"
+        )
+    finally:
+        k.stop()
+
+
 def test_allocatable_resources_v1_only(kubelet):
     kubelet.allocatable[RESOURCE] = [f"tpu-core-{c}-{u}"
                                      for c in range(4) for u in range(100)]

@@ -263,6 +263,45 @@ def test_hostile_hash_cannot_escape_alloc_dir(runtime, plugin, tmp_path):
         runtime.create_container(["TPU=../evil"])
 
 
+def test_malformed_request_payload_keeps_session_alive(runtime, plugin):
+    """A garbage ttrpc Request payload gets an error response and the
+    session keeps serving (protocol robustness against a confused
+    runtime)."""
+    from elastic_tpu_agent.nri.ttrpc import (
+        MESSAGE_TYPE_REQUEST,
+        write_frame,
+    )
+
+    # raw garbage straight onto the plugin-service conn
+    plugin_ch = runtime.mux.open(1)
+    write_frame(plugin_ch, 99, MESSAGE_TYPE_REQUEST, b"\xff\xfe garbage")
+    # the session survives: a real call still works afterwards
+    resp = runtime.create_container([f"TPU={SPEC['hash']}"])
+    assert len(resp.adjust.linux.devices) == 2
+
+
+def test_unexpected_response_frame_is_ignored(runtime, plugin):
+    """A stray RESPONSE-typed frame on the plugin conn is dropped, not
+    fatal."""
+    from elastic_tpu_agent.nri.ttrpc import (
+        MESSAGE_TYPE_RESPONSE,
+        write_frame,
+    )
+
+    plugin_ch = runtime.mux.open(1)
+    write_frame(plugin_ch, 7, MESSAGE_TYPE_RESPONSE, b"")
+    resp = runtime.create_container([f"TPU={SPEC['hash']}"])
+    assert len(resp.adjust.linux.devices) == 2
+
+
+def test_frame_for_unopened_mux_conn_is_dropped(runtime, plugin):
+    """Mux frames addressed to a connection id neither side opened are
+    dropped (upstream behavior), not fatal."""
+    runtime.mux._send(42, b"who dis")
+    resp = runtime.create_container([f"TPU={SPEC['hash']}"])
+    assert len(resp.adjust.linux.devices) == 2
+
+
 def test_unknown_method_gets_unimplemented(runtime, plugin):
     with pytest.raises(ttrpc.TtrpcError) as ei:
         runtime.client.call(
