@@ -80,42 +80,43 @@ class KubeletDeviceLocator(DeviceLocator):
             self._refreshing += 1
         try:
             resp = self._client.list()
-        except Exception:
+            fresh: Dict[str, PodContainer] = {}
+            for pod in resp.pod_resources:
+                for container in pod.containers:
+                    ids = []
+                    for dev in container.devices:
+                        if dev.resource_name == self._resource:
+                            # merges both the ≤1.20 one-entry-many-ids and
+                            # the ≥1.21 one-id-per-entry shapes
+                            ids.extend(dev.device_ids)
+                    if ids:
+                        fresh[device_hash(ids)] = PodContainer(
+                            pod.namespace, pod.name, container.name
+                        )
+            install = fresh
+            if len(fresh) > _MAX_CACHE_ENTRIES:
+                logger.warning(
+                    "pod-resources List yielded %d device sets; capping "
+                    "cache at %d", len(fresh), _MAX_CACHE_ENTRIES,
+                )
+                # cap only the shared cache; the caller still consults the
+                # full snapshot, so evicted sets resolve on their inline
+                # refresh
+                install = dict(
+                    itertools.islice(fresh.items(), _MAX_CACHE_ENTRIES)
+                )
+            with self._cond:
+                if seq > self._installed_seq:
+                    self._installed_seq = seq
+                    self._cache = install
+            return fresh
+        finally:
+            # ANY exit — including a parse failure after a successful
+            # List — must release the in-flight count, or joiners would
+            # pay the full join timeout on every future miss.
             with self._cond:
                 self._refreshing -= 1
                 self._cond.notify_all()
-            raise
-        fresh: Dict[str, PodContainer] = {}
-        for pod in resp.pod_resources:
-            for container in pod.containers:
-                ids = []
-                for dev in container.devices:
-                    if dev.resource_name == self._resource:
-                        # merges both the ≤1.20 one-entry-many-ids and the
-                        # ≥1.21 one-id-per-entry shapes
-                        ids.extend(dev.device_ids)
-                if ids:
-                    fresh[device_hash(ids)] = PodContainer(
-                        pod.namespace, pod.name, container.name
-                    )
-        install = fresh
-        if len(fresh) > _MAX_CACHE_ENTRIES:
-            logger.warning(
-                "pod-resources List yielded %d device sets; capping cache "
-                "at %d", len(fresh), _MAX_CACHE_ENTRIES,
-            )
-            # cap only the shared cache; the caller still consults the full
-            # snapshot, so evicted sets resolve on their inline refresh
-            install = dict(
-                itertools.islice(fresh.items(), _MAX_CACHE_ENTRIES)
-            )
-        with self._cond:
-            if seq > self._installed_seq:
-                self._installed_seq = seq
-                self._cache = install
-            self._refreshing -= 1
-            self._cond.notify_all()
-        return fresh
 
     def locate(self, device: Device) -> PodContainer:
         key = device.hash

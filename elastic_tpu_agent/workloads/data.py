@@ -1,0 +1,155 @@
+"""Token data pipeline: memory-mapped datasets with deterministic,
+dp-sharded batching.
+
+The training story's input side (the reference has no workload code at
+all — SURVEY.md §2; the runner previously trained on random tokens).
+TPU-first design notes:
+
+- The file is a flat token stream behind a tiny header, read through
+  ``np.memmap`` — the kernel's page cache IS the prefetcher for
+  sequential training reads; no native reader thread beats mmap for
+  this access pattern on a TPU-VM host.
+- Batching is a pure function of (step, dp_rank, dp_size): every host
+  of a slice computes ITS shard without coordination (the same
+  derive-from-facts principle as slice_env), restarts/resumes are
+  exactly reproducible, and no host ever materializes another host's
+  shard.
+- Batches are yielded as numpy; the caller's jit feeds them to the
+  device — keeping host->device transfer the only copy.
+
+File format (little-endian): magic ``ETPU``, uint32 version (1),
+uint32 token dtype itemsize (2 = uint16, 4 = uint32), uint64 token
+count, then the raw tokens.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+from typing import Iterator
+
+import numpy as np
+
+MAGIC = b"ETPU"
+VERSION = 1
+_HEADER = struct.Struct("<4sIIQ")
+
+
+def write_token_file(path: str, tokens: np.ndarray) -> None:
+    """Write a token array (any int dtype; stored uint16 when it fits)."""
+    tokens = np.asarray(tokens)
+    if tokens.size and tokens.min() < 0:
+        raise ValueError("tokens must be non-negative")
+    dtype = np.uint16 if (not tokens.size or tokens.max() < 2 ** 16) \
+        else np.uint32
+    tokens = tokens.astype(dtype)
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(_HEADER.pack(
+            MAGIC, VERSION, dtype().itemsize, tokens.size
+        ))
+        tokens.tofile(f)
+    os.replace(tmp, path)
+
+
+def encode_bytes(text: bytes) -> np.ndarray:
+    """Hermetic byte-level encoding (vocab 256) — no tokenizer download
+    needed; real deployments drop in their own tokenized .bin."""
+    return np.frombuffer(text, dtype=np.uint8).astype(np.uint16)
+
+
+class TokenDataset:
+    """Memory-mapped token stream with deterministic sharded batching."""
+
+    def __init__(self, path: str) -> None:
+        with open(path, "rb") as f:
+            raw = f.read(_HEADER.size)
+        magic, version, itemsize, count = _HEADER.unpack(raw)
+        if magic != MAGIC:
+            raise ValueError(f"{path}: not an ETPU token file")
+        if version != VERSION:
+            raise ValueError(f"{path}: unsupported version {version}")
+        dtype = {2: np.uint16, 4: np.uint32}.get(itemsize)
+        if dtype is None:
+            raise ValueError(f"{path}: unsupported token itemsize {itemsize}")
+        self.n_tokens = count
+        self._tokens = np.memmap(
+            path, dtype=dtype, mode="r", offset=_HEADER.size, shape=(count,)
+        )
+
+    def max_token(self, sample: "int | None" = None) -> int:
+        """Max token id (vocab sanity checks). ``sample`` bounds the scan
+        to a prefix for quick checks; None (default) scans the whole file
+        in chunks — one out-of-range token anywhere corrupts training, so
+        callers gating on the vocab should pay the full sequential read."""
+        if self.n_tokens == 0:
+            return 0
+        end = self.n_tokens if sample is None else min(sample, self.n_tokens)
+        out = 0
+        chunk = 1 << 24
+        for start in range(0, end, chunk):
+            out = max(out, int(self._tokens[start: min(start + chunk, end)]
+                               .max()))
+        return out
+
+    def sequences_per_epoch(self, seq: int) -> int:
+        return max(1, (self.n_tokens - 1) // seq)
+
+    def batch(
+        self,
+        step: int,
+        batch: int,
+        seq: int,
+        dp_rank: int = 0,
+        dp_size: int = 1,
+    ) -> np.ndarray:
+        """[batch, seq+1] int32 tokens for this host's shard of ``step``.
+
+        ``batch`` is the LOCAL batch; sample k of step t globally is
+        ``t*dp_size*batch + dp_rank*batch + k``, striding the stream in
+        seq-token windows and wrapping at epoch end (the +1 column is
+        the shift-by-one target, overlapping the next window by one
+        token like every LM data pipeline)."""
+        if self.n_tokens < seq + 1:
+            raise ValueError(
+                f"dataset has {self.n_tokens} tokens; need >= {seq + 1}"
+            )
+        per_epoch = self.sequences_per_epoch(seq)
+        out = np.empty((batch, seq + 1), np.int32)
+        base = step * dp_size * batch + dp_rank * batch
+        for k in range(batch):
+            idx = (base + k) % per_epoch
+            start = idx * seq
+            out[k] = self._tokens[start: start + seq + 1]
+        return out
+
+    def batches(
+        self, batch: int, seq: int, dp_rank: int = 0, dp_size: int = 1,
+        start_step: int = 0,
+    ) -> Iterator[np.ndarray]:
+        step = start_step
+        while True:
+            yield self.batch(step, batch, seq, dp_rank, dp_size)
+            step += 1
+
+
+def encode_file(input_path: str, output_path: str) -> int:
+    """Byte-encode a text/binary file into an ETPU token file; returns
+    the token count."""
+    with open(input_path, "rb") as f:
+        tokens = encode_bytes(f.read())
+    write_token_file(output_path, tokens)
+    return int(tokens.size)
+
+
+if __name__ == "__main__":  # tiny CLI: encode a file
+    import argparse
+
+    p = argparse.ArgumentParser(
+        description="byte-encode a file into an ETPU token dataset"
+    )
+    p.add_argument("input")
+    p.add_argument("output")
+    args = p.parse_args()
+    n = encode_file(args.input, args.output)
+    print(f"wrote {n} tokens to {args.output}")

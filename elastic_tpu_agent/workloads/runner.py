@@ -90,6 +90,11 @@ def main(argv=None) -> int:
     parser.add_argument("--batch", type=int, default=8)
     parser.add_argument("--seq", type=int, default=256)
     parser.add_argument("--preset", choices=sorted(PRESETS), default="small")
+    parser.add_argument(
+        "--kv-heads", type=int, default=0,
+        help="grouped-query attention: shared k/v heads "
+             "(0 = MHA; must divide the preset's n_heads)",
+    )
     parser.add_argument("--dp", type=int, default=None)
     parser.add_argument("--sp", type=int, default=1)
     parser.add_argument("--tp", type=int, default=None)
@@ -106,6 +111,11 @@ def main(argv=None) -> int:
     parser.add_argument(
         "--n-micro", type=int, default=4,
         help="microbatches per step in pipeline mode (--pp > 1)",
+    )
+    parser.add_argument(
+        "--data", default="",
+        help="ETPU token dataset (workloads/data.py) to train on; "
+             "default: synthetic random tokens",
     )
     parser.add_argument(
         "--checkpoint-dir", default="",
@@ -133,7 +143,9 @@ def main(argv=None) -> int:
 
     from .transformer import ModelConfig, make_mesh, make_train_step
 
-    cfg = ModelConfig(max_seq=args.seq, **PRESETS[args.preset])
+    cfg = ModelConfig(
+        max_seq=args.seq, n_kv_heads=args.kv_heads, **PRESETS[args.preset]
+    )
     if args.pp > 1:
         from .pipeline import make_pipeline_mesh
         from .transformer_pipeline import make_pipeline_transformer_step
@@ -168,6 +180,42 @@ def main(argv=None) -> int:
         )
     params, opt_state = init_all(jax.random.key(0))
 
+    dataset = None
+    if args.data:
+        from .data import TokenDataset
+
+        dataset = TokenDataset(args.data)
+        # full-file scan: a single out-of-range token ANYWHERE silently
+        # corrupts training via clamped gathers, so sampling is not enough
+        assert dataset.max_token(sample=None) < cfg.vocab, (
+            f"dataset tokens exceed model vocab {cfg.vocab}"
+        )
+
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    token_sharding = NamedSharding(
+        mesh, P(None, "dp", None) if args.pp > 1 else P("dp", None)
+    )
+
+    def tokens_for(step):
+        """Per-step batch: deterministic dataset shard (this process's
+        slice of the global batch) or the fixed synthetic tokens."""
+        if dataset is None:
+            return tokens
+        b = dataset.batch(
+            step, args.batch, args.seq,
+            dp_rank=jax.process_index(), dp_size=jax.process_count(),
+        )
+        if args.pp > 1:
+            b = b.reshape(args.n_micro, args.batch // args.n_micro, -1)
+        if jax.process_count() == 1:
+            return b  # one process: the local batch IS the global batch
+        # Multi-host: each process holds only ITS shard of the global
+        # batch. Assemble the distributed array explicitly — handing the
+        # local numpy to jit would be reinterpreted as a (wrong) global
+        # value and sliced a second time by device ownership.
+        return jax.make_array_from_process_local_data(token_sharding, b)
+
     # Preemption-tolerant resume (TPU pods are preemptible; the elastic
     # scheduler may also move us): restore the latest checkpoint onto the
     # live mesh shardings, and save on SIGTERM before dying.
@@ -200,7 +248,9 @@ def main(argv=None) -> int:
     loss = None
     try:
         for step in range(start_step, start_step + args.steps):
-            params, opt_state, loss = train_step(params, opt_state, tokens)
+            params, opt_state, loss = train_step(
+                params, opt_state, tokens_for(step)
+            )
             ran += 1
             if ckpt is not None and (
                 preempted["flag"] or (every > 0 and (step + 1) % every == 0)

@@ -43,6 +43,11 @@ class ModelConfig:
     d_ff: int = 2048
     max_seq: int = 1024
     dtype: Any = jnp.bfloat16
+    # Grouped-query attention: number of shared k/v heads (0 = MHA, i.e.
+    # n_kv_heads == n_heads). Cuts kv projection weights and kv-cache by
+    # n_heads/n_kv_heads; the attention core still runs at full q-head
+    # width (kv heads are repeated into their groups before the kernel).
+    n_kv_heads: int = 0
     # Attention core: "auto" picks ring when the sequence axis is sharded
     # (sp>1), the Pallas flash kernel on TPU when tiles align, and the
     # materialized-scores einsum otherwise. "flash"/"ring"/"reference"
@@ -63,6 +68,14 @@ class ModelConfig:
     @property
     def head_dim(self) -> int:
         return self.d_model // self.n_heads
+
+    @property
+    def kv_heads(self) -> int:
+        return self.n_kv_heads or self.n_heads
+
+    @property
+    def is_gqa(self) -> bool:
+        return self.kv_heads != self.n_heads
 
     def is_moe_layer(self, i: int) -> bool:
         return self.moe_experts > 0 and i % self.moe_every == (
@@ -88,14 +101,29 @@ def init_params(cfg: ModelConfig, key: jax.Array) -> Dict:
         "lm_head": dense(keys[2], (cfg.d_model, cfg.vocab)),
         "layers": [],
     }
+    if cfg.is_gqa:
+        assert cfg.n_heads % cfg.kv_heads == 0, (
+            f"n_heads {cfg.n_heads} must be a multiple of n_kv_heads "
+            f"{cfg.kv_heads}"
+        )
     for i in range(cfg.n_layers):
         k = jax.random.split(keys[3 + i], 6)
         layer = {
             "ln1_scale": jnp.ones((cfg.d_model,), jnp.float32),
-            "wqkv": dense(k[0], (cfg.d_model, 3, cfg.n_heads, cfg.head_dim)),
             "wo": dense(k[1], (cfg.n_heads, cfg.head_dim, cfg.d_model)),
             "ln2_scale": jnp.ones((cfg.d_model,), jnp.float32),
         }
+        if cfg.is_gqa:
+            layer["wq"] = dense(
+                k[0], (cfg.d_model, cfg.n_heads, cfg.head_dim)
+            )
+            layer["wkv"] = dense(
+                k[4], (cfg.d_model, 2, cfg.kv_heads, cfg.head_dim)
+            )
+        else:
+            layer["wqkv"] = dense(
+                k[0], (cfg.d_model, 3, cfg.n_heads, cfg.head_dim)
+            )
         if cfg.is_moe_layer(i):
             from .moe import init_moe_params
 
@@ -119,6 +147,8 @@ def param_shardings(mesh: Mesh) -> Dict:
     layer = {
         "ln1_scale": ns(),
         "wqkv": ns(None, None, "tp", None),   # shard heads
+        "wq": ns(None, "tp", None),           # shard q heads (GQA)
+        "wkv": ns(None, None, "tp", None),    # shard kv heads (GQA)
         "wo": ns("tp", None, None),           # shard heads
         "ln2_scale": ns(),
         "w1": ns(None, "tp"),                 # shard FF hidden
@@ -134,8 +164,20 @@ def param_shardings(mesh: Mesh) -> Dict:
 
 
 def _full_param_shardings(mesh: Mesh, cfg: ModelConfig) -> Dict:
+    if cfg.is_gqa:
+        tp = mesh.shape.get("tp", 1)
+        assert cfg.kv_heads % tp == 0, (
+            f"GQA kv_heads {cfg.kv_heads} must be divisible by tp={tp} "
+            "(wkv shards its kv-head axis over tp); use a smaller tp or "
+            "more kv heads"
+        )
     base = param_shardings(mesh)
-    dense_layer = base["layers"][0]
+    # keep only the attention projection keys this config's params carry
+    # (pytree structure must match params exactly for jit shardings)
+    attn_drop = ("wqkv",) if cfg.is_gqa else ("wq", "wkv")
+    dense_layer = {
+        k: v for k, v in base["layers"][0].items() if k not in attn_drop
+    }
     layers = []
     for i in range(cfg.n_layers):
         if cfg.is_moe_layer(i):
@@ -223,8 +265,22 @@ def _attention(
     x: jax.Array, layer: Dict, cfg: ModelConfig,
     mesh: Optional[Mesh] = None,
 ) -> jax.Array:
-    qkv = jnp.einsum("bsd,dcnh->bcsnh", x, layer["wqkv"].astype(cfg.dtype))
-    q, k, v = qkv[:, 0], qkv[:, 1], qkv[:, 2]  # [b, s, n, h]
+    if "wq" in layer:  # GQA: separate q and shared-kv projections
+        q = jnp.einsum("bsd,dnh->bsnh", x, layer["wq"].astype(cfg.dtype))
+        kv = jnp.einsum(
+            "bsd,dcgh->bcsgh", x, layer["wkv"].astype(cfg.dtype)
+        )
+        groups = cfg.n_heads // cfg.kv_heads
+        # repeat each kv head across its q-head group; XLA folds the
+        # repeat into the consumer matmuls (no materialized copy when the
+        # core is the einsum path; the kernels read it tiled either way)
+        k = jnp.repeat(kv[:, 0], groups, axis=2)
+        v = jnp.repeat(kv[:, 1], groups, axis=2)
+    else:
+        qkv = jnp.einsum(
+            "bsd,dcnh->bcsnh", x, layer["wqkv"].astype(cfg.dtype)
+        )
+        q, k, v = qkv[:, 0], qkv[:, 1], qkv[:, 2]  # [b, s, n, h]
     out = _attention_core(q, k, v, cfg, mesh)
     return jnp.einsum("bsnh,nhd->bsd", out, layer["wo"].astype(cfg.dtype))
 

@@ -59,6 +59,10 @@ def init_pipeline_params(cfg: ModelConfig, key: jax.Array, pp: int) -> Dict:
         f"n_layers {cfg.n_layers} must divide into pp={pp} stages"
     )
     assert cfg.moe_experts == 0, "MoE + pipeline not supported"
+    assert not cfg.is_gqa, (
+        "GQA + pipeline not supported: the pipeline stages use fused "
+        "wqkv projections (n_kv_heads must equal n_heads)"
+    )
     lpp = cfg.n_layers // pp
     init = jax.nn.initializers.normal(0.02)
     keys = jax.random.split(key, 9)

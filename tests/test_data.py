@@ -1,0 +1,122 @@
+"""Token data pipeline (workloads/data.py): file format round-trip,
+deterministic dp-sharded batching, epoch wrap, and runner integration."""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from elastic_tpu_agent.workloads.data import (
+    TokenDataset,
+    encode_bytes,
+    encode_file,
+    write_token_file,
+)
+
+
+def test_roundtrip_uint16_and_uint32(tmp_path):
+    small = np.arange(1000) % 50000
+    write_token_file(str(tmp_path / "small.bin"), small)
+    ds = TokenDataset(str(tmp_path / "small.bin"))
+    assert ds.n_tokens == 1000
+    np.testing.assert_array_equal(ds._tokens[:10], small[:10])
+
+    big = np.array([0, 70000, 123456])
+    write_token_file(str(tmp_path / "big.bin"), big)
+    ds = TokenDataset(str(tmp_path / "big.bin"))
+    assert int(ds._tokens[1]) == 70000  # survived (uint32 upgrade)
+
+
+def test_bad_magic_rejected(tmp_path):
+    p = tmp_path / "junk.bin"
+    p.write_bytes(b"NOPE" + b"\x00" * 32)
+    with pytest.raises(ValueError, match="not an ETPU"):
+        TokenDataset(str(p))
+
+
+def test_batches_are_deterministic_and_sharded(tmp_path):
+    tokens = np.arange(10000) % 251
+    path = str(tmp_path / "t.bin")
+    write_token_file(path, tokens)
+    ds = TokenDataset(path)
+
+    b0 = ds.batch(step=3, batch=4, seq=16, dp_rank=0, dp_size=2)
+    again = ds.batch(step=3, batch=4, seq=16, dp_rank=0, dp_size=2)
+    np.testing.assert_array_equal(b0, again)  # pure function of step
+
+    b1 = ds.batch(step=3, batch=4, seq=16, dp_rank=1, dp_size=2)
+    assert not np.array_equal(b0, b1)  # disjoint shards
+
+    # global sample identity: rank 1's first row == the row a dp_size=1
+    # reader sees at global position step*8 + 4
+    flat = ds.batch(step=0, batch=32, seq=16, dp_rank=0, dp_size=1)
+    np.testing.assert_array_equal(b1[0], flat[3 * 8 + 4])
+
+    # shift-by-one targets: next window starts where this one's target ends
+    row = ds.batch(0, 1, 16)[0]
+    np.testing.assert_array_equal(row[1:][:15], ds.batch(0, 1, 16)[0][1:16])
+    assert row.shape == (17,)
+
+
+def test_epoch_wrap(tmp_path):
+    tokens = np.arange(100)
+    path = str(tmp_path / "tiny.bin")
+    write_token_file(path, tokens)
+    ds = TokenDataset(path)
+    per_epoch = ds.sequences_per_epoch(16)
+    wrapped = ds.batch(step=per_epoch, batch=1, seq=16)
+    first = ds.batch(step=0, batch=1, seq=16)
+    np.testing.assert_array_equal(wrapped, first)
+
+
+def test_too_short_dataset_rejected(tmp_path):
+    write_token_file(str(tmp_path / "s.bin"), np.arange(10))
+    ds = TokenDataset(str(tmp_path / "s.bin"))
+    with pytest.raises(ValueError, match="need"):
+        ds.batch(0, 1, 32)
+
+
+def test_encode_file_bytes(tmp_path):
+    src = tmp_path / "text.txt"
+    src.write_text("hello tpu")
+    n = encode_file(str(src), str(tmp_path / "text.bin"))
+    assert n == 9
+    ds = TokenDataset(str(tmp_path / "text.bin"))
+    assert bytes(ds._tokens[:5].astype(np.uint8)) == b"hello"
+    assert encode_bytes(b"ab").tolist() == [97, 98]
+
+
+def test_runner_trains_on_dataset(tmp_path):
+    """Real runner process training on a real token file: the loss on
+    structured data (repeating pattern) must drop fast — proof the
+    pipeline feeds real tokens, not noise."""
+    rng = np.random.default_rng(0)
+    pattern = rng.integers(0, 256, size=64)
+    tokens = np.tile(pattern, 400)  # highly learnable stream
+    data_path = str(tmp_path / "train.bin")
+    write_token_file(data_path, tokens)
+
+    env = {
+        **os.environ,
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+        "PYTHONPATH": os.pathsep.join(
+            [os.path.join(os.path.dirname(__file__), "..")]
+            + os.environ.get("PYTHONPATH", "").split(os.pathsep)
+        ).rstrip(os.pathsep),
+    }
+    out = subprocess.run(
+        [
+            sys.executable, "-m", "elastic_tpu_agent.workloads.runner",
+            "--preset", "tiny", "--steps", "30", "--batch", "8",
+            "--seq", "32", "--data", data_path,
+        ],
+        env=env, capture_output=True, text=True, timeout=600,
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    report = json.loads(out.stdout.strip().splitlines()[-1])
+    # tiny preset vocab 2048 >= byte vocab 256; random-chance nll ~ln(256)=5.5
+    assert report["final_loss"] < 3.0, report["final_loss"]
