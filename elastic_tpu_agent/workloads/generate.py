@@ -1,0 +1,234 @@
+"""Autoregressive generation with a KV cache for the flagship LM.
+
+Inference side of the workload stack (training lives in
+transformer.py). TPU-first decode design:
+
+- The KV cache is preallocated at ``max_len`` and updated in place with
+  ``lax.dynamic_update_slice`` — static shapes throughout, so the whole
+  decode loop is ONE ``lax.scan`` under jit (no per-token retrace, no
+  dynamic shapes blocking XLA's tiling).
+- The cache stores ``kv_heads`` heads, not ``n_heads`` — for GQA models
+  (transformer.ModelConfig.n_kv_heads) the cache is
+  n_heads/kv_heads× smaller, which is the entire point of GQA at decode
+  time (HBM bandwidth per generated token is the decode bottleneck).
+- Attention against the cache masks by position (keys beyond the
+  current length contribute NEG_INF) instead of slicing to a dynamic
+  length.
+- Prefill runs the prompt in one batched pass (MXU-shaped matmuls),
+  filling the cache; decode then appends one position per scan step.
+
+Decode-vs-forward equivalence (every step's logits match the full
+recompute) is pinned by tests for both MHA and GQA.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .attention import NEG_INF
+from .transformer import ModelConfig, _rmsnorm
+
+
+class KVCache(NamedTuple):
+    """Per-layer stacked caches: k, v [n_layers, b, max_len, kv_heads, h],
+    plus the current filled length (scalar int32)."""
+
+    k: jax.Array
+    v: jax.Array
+    length: jax.Array
+
+    @classmethod
+    def empty(
+        cls, cfg: ModelConfig, batch: int, max_len: int, dtype=None
+    ) -> "KVCache":
+        shape = (
+            cfg.n_layers, batch, max_len, cfg.kv_heads, cfg.head_dim
+        )
+        dtype = dtype or cfg.dtype
+        return cls(
+            k=jnp.zeros(shape, dtype),
+            v=jnp.zeros(shape, dtype),
+            length=jnp.int32(0),
+        )
+
+
+def _qkv(x: jax.Array, layer: Dict, cfg: ModelConfig):
+    """Projections for a chunk x [b, t, d] -> q [b,t,n,h], k/v [b,t,g,h]."""
+    if "wq" in layer:  # GQA
+        q = jnp.einsum("btd,dnh->btnh", x, layer["wq"].astype(cfg.dtype))
+        kv = jnp.einsum(
+            "btd,dcgh->bctgh", x, layer["wkv"].astype(cfg.dtype)
+        )
+        return q, kv[:, 0], kv[:, 1]
+    qkv = jnp.einsum(
+        "btd,dcnh->bctnh", x, layer["wqkv"].astype(cfg.dtype)
+    )
+    return qkv[:, 0], qkv[:, 1], qkv[:, 2]
+
+
+def _cached_attention(
+    q: jax.Array,           # [b, t, n, h] for the current chunk
+    cache_k: jax.Array,     # [b, max_len, g, h] incl. the chunk's keys
+    cache_v: jax.Array,
+    q_pos: jax.Array,       # global position of q[:, 0]
+    cfg: ModelConfig,
+) -> jax.Array:
+    """Causal attention of the chunk against the (masked) full cache.
+
+    The cache stays at kv_heads width through the whole computation —
+    q is viewed as [b, t, g, r, h] (r q-heads per kv head, contiguous
+    groups matching transformer._attention's repeat convention) and the
+    dots batch over g, so per-token HBM reads are the GQA-sized cache,
+    never an expanded MHA-width copy."""
+    b, t, n, h = q.shape
+    g = cfg.kv_heads
+    r = n // g
+    q5 = q.reshape(b, t, g, r, h)
+    scale = 1.0 / np.sqrt(cfg.head_dim)
+    logits = jnp.einsum(
+        "btgrh,bsgh->bgrts", q5, cache_k
+    ).astype(jnp.float32) * scale
+    max_len = cache_k.shape[1]
+    rows = q_pos + jax.lax.broadcasted_iota(jnp.int32, (t, max_len), 0)
+    cols = jax.lax.broadcasted_iota(jnp.int32, (t, max_len), 1)
+    logits = jnp.where(
+        (cols <= rows)[None, None, None], logits, NEG_INF
+    )
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum(
+        "bgrts,bsgh->btgrh", probs.astype(cache_v.dtype), cache_v
+    )
+    return out.reshape(b, t, n, h)
+
+
+def _forward_chunk(
+    params: Dict, tokens: jax.Array, cache: KVCache, cfg: ModelConfig
+) -> Tuple[jax.Array, KVCache]:
+    """Run a token chunk [b, t] at positions cache.length..+t; returns
+    (logits [b, t, vocab], updated cache)."""
+    b, t = tokens.shape
+    pos = cache.length
+    x = params["embed"].astype(cfg.dtype)[tokens]
+    positions = pos + jnp.arange(t)
+    x = x + params["pos_embed"].astype(cfg.dtype)[positions][None]
+
+    new_k, new_v = cache.k, cache.v
+    for i, layer in enumerate(params["layers"]):
+        h = _rmsnorm(x, layer["ln1_scale"])
+        q, k_c, v_c = _qkv(h, layer, cfg)
+        lk = jax.lax.dynamic_update_slice(
+            cache.k[i], k_c.astype(cache.k.dtype), (0, pos, 0, 0)
+        )
+        lv = jax.lax.dynamic_update_slice(
+            cache.v[i], v_c.astype(cache.v.dtype), (0, pos, 0, 0)
+        )
+        new_k = new_k.at[i].set(lk)
+        new_v = new_v.at[i].set(lv)
+        attn = _cached_attention(q, lk, lv, pos, cfg)
+        x = x + jnp.einsum(
+            "btnh,nhd->btd", attn, layer["wo"].astype(cfg.dtype)
+        )
+        h2 = _rmsnorm(x, layer["ln2_scale"])
+        h2 = jax.nn.gelu(
+            jnp.einsum("btd,df->btf", h2, layer["w1"].astype(cfg.dtype))
+        )
+        x = x + jnp.einsum("btf,fd->btd", h2, layer["w2"].astype(cfg.dtype))
+    x = _rmsnorm(x, params["final_norm_scale"])
+    logits = jnp.einsum(
+        "btd,dv->btv", x, params["lm_head"].astype(cfg.dtype)
+    ).astype(jnp.float32)
+    return logits, KVCache(k=new_k, v=new_v, length=pos + t)
+
+
+def _sample(logits, key, temperature: float, top_k: int):
+    """logits [b, vocab] -> token ids [b]."""
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    logits = logits / temperature
+    if top_k > 0:
+        kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
+        logits = jnp.where(logits >= kth, logits, NEG_INF)
+    return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
+
+
+def generate(
+    params: Dict,
+    prompt: jax.Array,
+    cfg: ModelConfig,
+    max_new_tokens: int,
+    temperature: float = 0.0,
+    top_k: int = 0,
+    key: Optional[jax.Array] = None,
+    max_len: Optional[int] = None,
+) -> jax.Array:
+    """Generate continuations. prompt [b, p] -> [b, p + max_new_tokens].
+
+    Greedy when temperature == 0 (default), else temperature sampling
+    with optional top-k. Compiles to prefill + ONE scan; all shapes
+    static. MoE models are not supported (dense decode only).
+    """
+    assert cfg.moe_experts == 0, "MoE decode not supported"
+    b, p = prompt.shape
+    total = p + max_new_tokens
+    max_len = max_len or total
+    assert max_len >= total, (max_len, total)
+    assert cfg.max_seq >= max_len, (
+        f"cfg.max_seq {cfg.max_seq} < requested length {max_len}"
+    )
+    if key is None:
+        key = jax.random.key(0)
+
+    if max_new_tokens == 0:
+        return prompt
+    run = _build_run(cfg, b, max_new_tokens, temperature, top_k, max_len)
+    return run(params, prompt, key)
+
+
+@functools.lru_cache(maxsize=64)
+def _build_run(
+    cfg: ModelConfig, b: int, max_new_tokens: int,
+    temperature: float, top_k: int, max_len: int,
+):
+    """Cached jitted decode program per (config, shape, sampling) key —
+    a fresh closure per generate() call would retrace and recompile the
+    whole prefill+scan on every invocation."""
+
+    @jax.jit
+    def run(params, prompt, key):
+        cache = KVCache.empty(cfg, b, max_len)
+        logits, cache = _forward_chunk(params, prompt, cache, cfg)
+        first = _sample(logits[:, -1], key, temperature, top_k)
+
+        def step(carry, _):
+            cache, tok, key = carry
+            key, sub = jax.random.split(key)
+            logits, cache = _forward_chunk(
+                params, tok[:, None], cache, cfg
+            )
+            nxt = _sample(logits[:, -1], sub, temperature, top_k)
+            # yield the step's INPUT token: over N steps that emits
+            # generated tokens 1..N exactly (the final sample is the
+            # N+1-th, beyond the requested budget)
+            return (cache, nxt, key), tok
+
+        _, toks = jax.lax.scan(
+            step, (cache, first, key), None, length=max_new_tokens
+        )
+        gen = jnp.moveaxis(toks, 0, 1)  # [b, max_new_tokens]
+        return jnp.concatenate([prompt, gen], axis=1)
+
+    return run
+
+
+def decode_logits_reference(
+    params: Dict, tokens: jax.Array, cfg: ModelConfig
+) -> jax.Array:
+    """Oracle: full-recompute logits for a whole sequence (no cache)."""
+    from .transformer import forward
+
+    return forward(params, tokens, cfg).astype(jnp.float32)

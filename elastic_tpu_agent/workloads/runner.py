@@ -237,8 +237,11 @@ def main(argv=None) -> int:
 
     # AOT-compile instead of a warmup execution: a real warmup step would
     # apply an optimizer update the step accounting never sees, so a
-    # resumed run would silently drift from an uninterrupted one.
-    train_step.lower(params, opt_state, tokens).compile()
+    # resumed run would silently drift from an uninterrupted one. Compile
+    # against the REAL first batch (dataset batches in multi-host runs are
+    # globally process_count× larger than the synthetic shape — compiling
+    # the wrong shape would push a full recompile into the timed loop).
+    train_step.lower(params, opt_state, tokens_for(start_step)).compile()
 
     every = max(0, args.checkpoint_every)  # 0 = save only on preemption
     if args.profile_dir:
@@ -270,7 +273,12 @@ def main(argv=None) -> int:
         ckpt.wait()
         ckpt.close()
 
-    tokens_per_step = args.batch * args.seq
+    # dataset mode feeds a global batch of local*process_count rows;
+    # synthetic mode replicates one global batch of args.batch rows
+    global_batch = args.batch * (
+        jax.process_count() if dataset is not None else 1
+    )
+    tokens_per_step = global_batch * args.seq
     report = {
         "platform": jax.devices()[0].platform,
         "devices": len(jax.devices()),

@@ -137,23 +137,29 @@ def init_params(cfg: ModelConfig, key: jax.Array) -> Dict:
     return params
 
 
-def param_shardings(mesh: Mesh) -> Dict:
-    """NamedSharding pytree matching init_params: tensor-parallel over
-    "tp", replicated over "dp"/"sp"."""
+def param_shardings(mesh: Mesh, cfg: Optional[ModelConfig] = None) -> Dict:
+    """NamedSharding pytree matching init_params for ``cfg`` (default:
+    a dense MHA config): tensor-parallel over "tp", replicated over
+    "dp"/"sp". The layer dict carries exactly the attention projection
+    keys that config's params carry (fused wqkv for MHA, wq+wkv for
+    GQA) so it is usable directly as jit shardings."""
+    cfg = cfg or ModelConfig()
 
     def ns(*spec):
         return NamedSharding(mesh, P(*spec))
 
     layer = {
         "ln1_scale": ns(),
-        "wqkv": ns(None, None, "tp", None),   # shard heads
-        "wq": ns(None, "tp", None),           # shard q heads (GQA)
-        "wkv": ns(None, None, "tp", None),    # shard kv heads (GQA)
         "wo": ns("tp", None, None),           # shard heads
         "ln2_scale": ns(),
         "w1": ns(None, "tp"),                 # shard FF hidden
         "w2": ns("tp", None),                 # shard FF hidden
     }
+    if cfg.is_gqa:
+        layer["wq"] = ns(None, "tp", None)    # shard q heads
+        layer["wkv"] = ns(None, None, "tp", None)  # shard kv heads
+    else:
+        layer["wqkv"] = ns(None, None, "tp", None)  # shard heads
     return {
         "embed": ns(None, None),
         "pos_embed": ns(),
@@ -171,13 +177,8 @@ def _full_param_shardings(mesh: Mesh, cfg: ModelConfig) -> Dict:
             "(wkv shards its kv-head axis over tp); use a smaller tp or "
             "more kv heads"
         )
-    base = param_shardings(mesh)
-    # keep only the attention projection keys this config's params carry
-    # (pytree structure must match params exactly for jit shardings)
-    attn_drop = ("wqkv",) if cfg.is_gqa else ("wq", "wkv")
-    dense_layer = {
-        k: v for k, v in base["layers"][0].items() if k not in attn_drop
-    }
+    base = param_shardings(mesh, cfg)
+    dense_layer = base["layers"][0]
     layers = []
     for i in range(cfg.n_layers):
         if cfg.is_moe_layer(i):

@@ -55,10 +55,12 @@ def test_batches_are_deterministic_and_sharded(tmp_path):
     flat = ds.batch(step=0, batch=32, seq=16, dp_rank=0, dp_size=1)
     np.testing.assert_array_equal(b1[0], flat[3 * 8 + 4])
 
-    # shift-by-one targets: next window starts where this one's target ends
-    row = ds.batch(0, 1, 16)[0]
-    np.testing.assert_array_equal(row[1:][:15], ds.batch(0, 1, 16)[0][1:16])
-    assert row.shape == (17,)
+    # shift-by-one targets: consecutive windows overlap by exactly one
+    # token — window k's last (target-only) token is window k+1's first
+    # input token
+    two = ds.batch(0, 2, 16)
+    assert two.shape == (2, 17)
+    assert two[0][16] == two[1][0]
 
 
 def test_epoch_wrap(tmp_path):
