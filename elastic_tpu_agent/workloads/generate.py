@@ -31,7 +31,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .attention import NEG_INF
-from .transformer import ModelConfig, _rmsnorm
+from .transformer import ModelConfig, _rmsnorm, rope
 
 
 class KVCache(NamedTuple):
@@ -115,12 +115,18 @@ def _forward_chunk(
     pos = cache.length
     x = params["embed"].astype(cfg.dtype)[tokens]
     positions = pos + jnp.arange(t)
-    x = x + params["pos_embed"].astype(cfg.dtype)[positions][None]
+    if cfg.pos == "learned":
+        x = x + params["pos_embed"].astype(cfg.dtype)[positions][None]
 
     new_k, new_v = cache.k, cache.v
     for i, layer in enumerate(params["layers"]):
         h = _rmsnorm(x, layer["ln1_scale"])
         q, k_c, v_c = _qkv(h, layer, cfg)
+        if cfg.pos == "rope":
+            # rotated keys go INTO the cache (absolute rotations), so
+            # decode steps never re-touch old cache entries
+            q = rope(q, positions, cfg.rope_theta)
+            k_c = rope(k_c, positions, cfg.rope_theta)
         lk = jax.lax.dynamic_update_slice(
             cache.k[i], k_c.astype(cache.k.dtype), (0, pos, 0, 0)
         )
@@ -177,9 +183,11 @@ def generate(
     total = p + max_new_tokens
     max_len = max_len or total
     assert max_len >= total, (max_len, total)
-    assert cfg.max_seq >= max_len, (
-        f"cfg.max_seq {cfg.max_seq} < requested length {max_len}"
-    )
+    if cfg.pos == "learned":
+        # only the learned table bounds the length; rope extrapolates
+        assert cfg.max_seq >= max_len, (
+            f"cfg.max_seq {cfg.max_seq} < requested length {max_len}"
+        )
     if key is None:
         key = jax.random.key(0)
 

@@ -48,6 +48,12 @@ class ModelConfig:
     # n_heads/n_kv_heads; the attention core still runs at full q-head
     # width (kv heads are repeated into their groups before the kernel).
     n_kv_heads: int = 0
+    # Position encoding: "learned" (table added to embeddings, bounded by
+    # max_seq) or "rope" (rotary embeddings applied to q/k — extrapolates
+    # past max_seq and composes with sequence sharding because rotation
+    # is per-position elementwise, applied BEFORE the attention core).
+    pos: str = "learned"
+    rope_theta: float = 10000.0
     # Attention core: "auto" picks ring when the sequence axis is sharded
     # (sp>1), the Pallas flash kernel on TPU when tiles align, and the
     # materialized-scores einsum otherwise. "flash"/"ring"/"reference"
@@ -93,14 +99,18 @@ def init_params(cfg: ModelConfig, key: jax.Array) -> Dict:
     def dense(key, shape):
         return initializer(key, shape, jnp.float32)
 
+    assert cfg.pos in ("learned", "rope"), cfg.pos
+    if cfg.pos == "rope":
+        assert cfg.head_dim % 2 == 0, "rope needs an even head_dim"
     keys = jax.random.split(key, 4 + cfg.n_layers)
     params = {
         "embed": dense(keys[0], (cfg.vocab, cfg.d_model)),
-        "pos_embed": dense(keys[1], (cfg.max_seq, cfg.d_model)),
         "final_norm_scale": jnp.ones((cfg.d_model,), jnp.float32),
         "lm_head": dense(keys[2], (cfg.d_model, cfg.vocab)),
         "layers": [],
     }
+    if cfg.pos == "learned":
+        params["pos_embed"] = dense(keys[1], (cfg.max_seq, cfg.d_model))
     if cfg.is_gqa:
         assert cfg.n_heads % cfg.kv_heads == 0, (
             f"n_heads {cfg.n_heads} must be a multiple of n_kv_heads "
@@ -160,13 +170,15 @@ def param_shardings(mesh: Mesh, cfg: Optional[ModelConfig] = None) -> Dict:
         layer["wkv"] = ns(None, None, "tp", None)  # shard kv heads
     else:
         layer["wqkv"] = ns(None, None, "tp", None)  # shard heads
-    return {
+    out = {
         "embed": ns(None, None),
-        "pos_embed": ns(),
         "final_norm_scale": ns(),
         "lm_head": ns(None, "tp"),            # shard vocab
         "layers": [layer],  # broadcast over the layer list by tree prefix
     }
+    if cfg.pos == "learned":
+        out["pos_embed"] = ns()
+    return out
 
 
 def _full_param_shardings(mesh: Mesh, cfg: ModelConfig) -> Dict:
@@ -200,6 +212,28 @@ def _full_param_shardings(mesh: Mesh, cfg: ModelConfig) -> Dict:
 
 
 # -- model --------------------------------------------------------------------
+
+
+def rope(
+    x: jax.Array, positions: jax.Array, theta: float = 10000.0
+) -> jax.Array:
+    """Rotary position embedding. x: [b, s, n, h] (h even), positions:
+    [s] global token positions. Pairs (x[2i], x[2i+1]) rotate by
+    pos·theta^(-2i/h); elementwise per position, so it shards trivially
+    over any sequence partitioning (the ring/sp layouts included)."""
+    h = x.shape[-1]
+    freqs = theta ** (
+        -jnp.arange(0, h, 2, dtype=jnp.float32) / h
+    )  # [h/2]
+    angles = positions[:, None].astype(jnp.float32) * freqs[None]  # [s, h/2]
+    cos = jnp.cos(angles)[None, :, None, :]
+    sin = jnp.sin(angles)[None, :, None, :]
+    x1 = x[..., 0::2].astype(jnp.float32)
+    x2 = x[..., 1::2].astype(jnp.float32)
+    out = jnp.stack(
+        [x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1
+    ).reshape(x.shape)
+    return out.astype(x.dtype)
 
 
 def _rmsnorm(x: jax.Array, scale: jax.Array) -> jax.Array:
@@ -266,22 +300,38 @@ def _attention(
     x: jax.Array, layer: Dict, cfg: ModelConfig,
     mesh: Optional[Mesh] = None,
 ) -> jax.Array:
+    rotate = None
+    if cfg.pos == "rope":
+        # Global token positions: under GSPMD this op sees the GLOBAL
+        # sequence, so positions are correct for any sp sharding (the
+        # rotation is per-position elementwise and happens BEFORE the
+        # sharded attention core / ring).
+        positions = jnp.arange(x.shape[1])
+
+        def rotate(t):
+            return rope(t, positions, cfg.rope_theta)
+
     if "wq" in layer:  # GQA: separate q and shared-kv projections
         q = jnp.einsum("bsd,dnh->bsnh", x, layer["wq"].astype(cfg.dtype))
         kv = jnp.einsum(
             "bsd,dcgh->bcsgh", x, layer["wkv"].astype(cfg.dtype)
         )
+        k0, v0 = kv[:, 0], kv[:, 1]
+        if rotate is not None:
+            q, k0 = rotate(q), rotate(k0)  # rotate at kv width, cheaper
         groups = cfg.n_heads // cfg.kv_heads
         # repeat each kv head across its q-head group; XLA folds the
         # repeat into the consumer matmuls (no materialized copy when the
         # core is the einsum path; the kernels read it tiled either way)
-        k = jnp.repeat(kv[:, 0], groups, axis=2)
-        v = jnp.repeat(kv[:, 1], groups, axis=2)
+        k = jnp.repeat(k0, groups, axis=2)
+        v = jnp.repeat(v0, groups, axis=2)
     else:
         qkv = jnp.einsum(
             "bsd,dcnh->bcsnh", x, layer["wqkv"].astype(cfg.dtype)
         )
         q, k, v = qkv[:, 0], qkv[:, 1], qkv[:, 2]  # [b, s, n, h]
+        if rotate is not None:
+            q, k = rotate(q), rotate(k)
     out = _attention_core(q, k, v, cfg, mesh)
     return jnp.einsum("bsnh,nhd->bsd", out, layer["wo"].astype(cfg.dtype))
 
@@ -302,7 +352,8 @@ def forward_with_aux(
     ICI collectives — over the mesh."""
     _, s = tokens.shape
     x = params["embed"].astype(cfg.dtype)[tokens]
-    x = x + params["pos_embed"].astype(cfg.dtype)[:s][None]
+    if cfg.pos == "learned":
+        x = x + params["pos_embed"].astype(cfg.dtype)[:s][None]
     mesh = (
         activation_sharding.mesh if activation_sharding is not None else None
     )

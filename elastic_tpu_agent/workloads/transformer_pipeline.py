@@ -43,7 +43,7 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from .attention import auto_flash_config, flash_attention
-from .transformer import ModelConfig, _rmsnorm
+from .transformer import ModelConfig, _rmsnorm, rope
 
 
 # -- parameters ---------------------------------------------------------------
@@ -63,6 +63,7 @@ def init_pipeline_params(cfg: ModelConfig, key: jax.Array, pp: int) -> Dict:
         "GQA + pipeline not supported: the pipeline stages use fused "
         "wqkv projections (n_kv_heads must equal n_heads)"
     )
+    assert cfg.pos in ("learned", "rope"), cfg.pos
     lpp = cfg.n_layers // pp
     init = jax.nn.initializers.normal(0.02)
     keys = jax.random.split(key, 9)
@@ -70,11 +71,14 @@ def init_pipeline_params(cfg: ModelConfig, key: jax.Array, pp: int) -> Dict:
     def dense(k, shape):
         return init(k, shape, jnp.float32)
 
-    return {
+    out = {
         "embed": dense(keys[0], (cfg.vocab, cfg.d_model)),
-        "pos_embed": dense(keys[1], (cfg.max_seq, cfg.d_model)),
         "final_norm_scale": jnp.ones((cfg.d_model,), jnp.float32),
         "lm_head": dense(keys[2], (cfg.d_model, cfg.vocab)),
+    }
+    if cfg.pos == "learned":
+        out["pos_embed"] = dense(keys[1], (cfg.max_seq, cfg.d_model))
+    return out | {
         "stages": {
             "ln1_scale": jnp.ones((pp, lpp, cfg.d_model), jnp.float32),
             "wqkv": dense(
@@ -118,8 +122,15 @@ def _stage_fn(stage_params: Dict, x: jax.Array, cfg: ModelConfig) -> jax.Array:
         qkv = jnp.einsum(
             "bsd,dcnh->bcsnh", h, lp["wqkv"].astype(cfg.dtype)
         )
+        q, k, v = qkv[:, 0], qkv[:, 1], qkv[:, 2]
+        if cfg.pos == "rope":
+            # pipeline stages see the full (unsharded) sequence, so
+            # local indices ARE the global positions
+            positions = jnp.arange(x.shape[1])
+            q = rope(q, positions, cfg.rope_theta)
+            k = rope(k, positions, cfg.rope_theta)
         # flash_attention falls back to the einsum oracle off-gate
-        attn = flash_attention(qkv[:, 0], qkv[:, 1], qkv[:, 2], fc)
+        attn = flash_attention(q, k, v, fc)
         x = x + jnp.einsum(
             "bsnh,nhd->bsd", attn, lp["wo"].astype(cfg.dtype)
         )
@@ -138,7 +149,9 @@ def _embed_fn(params: Dict, tokens: jax.Array, cfg: ModelConfig) -> jax.Array:
     """tokens [m, mb, s] -> activations [m, mb, s, d]."""
     s = tokens.shape[-1]
     x = params["embed"].astype(cfg.dtype)[tokens]
-    return x + params["pos_embed"].astype(cfg.dtype)[:s][None, None]
+    if cfg.pos == "learned":
+        x = x + params["pos_embed"].astype(cfg.dtype)[:s][None, None]
+    return x
 
 
 def _head_loss(
@@ -349,7 +362,8 @@ def make_pipeline_transformer_step(
         def step(params, opt_state, toks):
             head = split_head(params)
             embed_params = {
-                "embed": params["embed"], "pos_embed": params["pos_embed"]
+                k: params[k] for k in ("embed", "pos_embed")
+                if k in params  # no pos_embed under pos="rope"
             }
             xs, embed_vjp = jax.vjp(
                 lambda ep: _embed_fn(ep, toks[:, :, :-1], cfg),
@@ -361,11 +375,12 @@ def make_pipeline_transformer_step(
             (g_embed,) = embed_vjp(dxs.astype(xs.dtype))
             grads = {
                 "embed": g_embed["embed"],
-                "pos_embed": g_embed["pos_embed"],
                 "final_norm_scale": g_head["final_norm_scale"],
                 "lm_head": g_head["lm_head"],
                 "stages": g_stage,
             }
+            if "pos_embed" in g_embed:
+                grads["pos_embed"] = g_embed["pos_embed"]
             grads = jax.tree.map(
                 lambda g, p: g.astype(p.dtype), grads, params
             )

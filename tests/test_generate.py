@@ -24,11 +24,15 @@ BASE = dict(
 )
 
 
-@pytest.mark.parametrize("kv_heads", [0, 2], ids=["mha", "gqa"])
-def test_cached_decode_matches_full_forward(kv_heads):
+@pytest.mark.parametrize(
+    "kv_heads,pos",
+    [(0, "learned"), (2, "learned"), (0, "rope"), (2, "rope")],
+    ids=["mha", "gqa", "mha-rope", "gqa-rope"],
+)
+def test_cached_decode_matches_full_forward(kv_heads, pos):
     """Prefill + one-token decode steps produce the same logits as
     recomputing the whole sequence each time."""
-    cfg = ModelConfig(**BASE, n_kv_heads=kv_heads)
+    cfg = ModelConfig(**BASE, n_kv_heads=kv_heads, pos=pos)
     params = init_params(cfg, jax.random.key(0))
     tokens = jax.random.randint(jax.random.key(1), (2, 12), 0, cfg.vocab)
 
@@ -118,3 +122,14 @@ def test_generate_rejects_overlong_request():
     with pytest.raises(AssertionError, match="max_seq"):
         generate(params, jnp.zeros((1, 60), jnp.int32), cfg,
                  max_new_tokens=10)
+
+
+def test_rope_generates_past_max_seq():
+    """Rotary models extrapolate: generation may run past cfg.max_seq
+    (nothing indexes a position table)."""
+    cfg = ModelConfig(**{**BASE, "max_seq": 16}, pos="rope")
+    params = init_params(cfg, jax.random.key(0))
+    out = generate(params, jnp.zeros((1, 8), jnp.int32), cfg,
+                   max_new_tokens=24)  # total 32 > max_seq 16
+    assert out.shape == (1, 32)
+    assert int(out.max()) < cfg.vocab

@@ -209,6 +209,25 @@ def test_pipeline_checkpoint_resume(tmp_path, mesh, tokens):
     )
 
 
+def test_rope_pipeline_smoke(tokens):
+    """pos='rope' works under the pipeline (stages see full sequences, so
+    local indices are global positions); no pos_embed param exists."""
+    mesh2 = make_pipeline_mesh(pp=2, dp=2)
+    cfg = ModelConfig(
+        vocab=128, d_model=32, n_heads=2, n_layers=4, d_ff=64, max_seq=32,
+        dtype=jnp.float32, pos="rope",
+    )
+    params = init_pipeline_params(cfg, jax.random.key(7), 2)
+    assert "pos_embed" not in params
+    for schedule in ("gpipe", "1f1b"):
+        step, init_all = make_pipeline_transformer_step(
+            cfg, mesh2, n_micro=M, schedule=schedule
+        )
+        _, opt0 = init_all(jax.random.key(0))
+        _, _, loss = step(_copy(params), opt0, tokens)
+        assert np.isfinite(float(loss)), schedule
+
+
 def test_pp2_also_works(tokens):
     mesh2 = make_pipeline_mesh(pp=2, dp=2)
     cfg = ModelConfig(
