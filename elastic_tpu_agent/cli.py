@@ -65,6 +65,12 @@ def parse_args(argv=None) -> argparse.Namespace:
         "--nri-libtpu", default="",
         help="host libtpu.so to bind-mount into TPU containers via NRI",
     )
+    p.add_argument(
+        "--nri-evict-on-chip-failure", action="store_true",
+        help="policy: evict containers bound to a chip that goes "
+             "unhealthy (via NRI UpdateContainers) so kubelet restarts "
+             "them onto healthy chips",
+    )
     p.add_argument("--metrics-port", type=int, default=9478,
                    help="prometheus metrics port (0 = off)")
     p.add_argument("--no-events", action="store_true",
@@ -105,6 +111,7 @@ def main(argv=None) -> int:
             alloc_spec_dir=args.alloc_spec_dir,
             nri_socket=args.nri_socket,
             nri_libtpu=args.nri_libtpu,
+            nri_evict_on_chip_failure=args.nri_evict_on_chip_failure,
             metrics=metrics,
             enable_events=not args.no_events,
             enable_crd=not args.no_crd,

@@ -62,6 +62,12 @@ class ManagerOptions:
     # host path of libtpu.so to bind-mount into TPU containers via NRI
     # ("" = images ship their own).
     nri_libtpu: str = ""
+    # Policy (default OFF): when a chip goes unhealthy, ask containerd
+    # (via NRI UpdateContainers) to evict containers whose injected
+    # devices include it — the bind is immutable post-create, so
+    # eviction is the only in-band recovery; kubelet restarts the pod
+    # onto healthy chips.
+    nri_evict_on_chip_failure: bool = False
     # test seams
     kube_client: Optional[KubeClient] = None
     operator: object = None
@@ -187,6 +193,13 @@ class TPUManager:
                 libtpu_path=opts.nri_libtpu,
                 metrics=self.metrics,
             )
+            if opts.nri_evict_on_chip_failure and hasattr(
+                self.plugin, "on_chips_failed"
+            ):
+                self.plugin.on_chips_failed = self.nri_plugin.evict_for_chips
+                self.plugin.on_chips_recovered = (
+                    self.nri_plugin.clear_failed_chips
+                )
         self._stop = threading.Event()
 
     # -- Restore (SURVEY.md §3.5: declared-but-unimplemented upstream) --------

@@ -17,9 +17,9 @@ from __future__ import annotations
 
 import struct
 import threading
-from typing import Dict
+from typing import Dict, Optional
 
-from .ttrpc import Channel, ChannelClosed, SocketChannel
+from .ttrpc import Channel, ChannelClosed, ChannelTimeout, SocketChannel
 
 _FRAME = struct.Struct(">II")
 
@@ -58,12 +58,23 @@ class MuxChannel(Channel):
     def sendall(self, data: bytes) -> None:
         self._mux._send(self._id, data)
 
-    def recv_exact(self, n: int) -> bytes:
+    def recv_exact(self, n: int, timeout: Optional[float] = None) -> bytes:
+        import time as _time
+
+        deadline = None if timeout is None else _time.monotonic() + timeout
         with self._cond:
             while len(self._buf) < n:
                 if self._closed:
                     raise ChannelClosed("mux closed")
-                self._cond.wait()
+                if deadline is None:
+                    self._cond.wait()
+                else:
+                    remaining = deadline - _time.monotonic()
+                    if remaining <= 0:
+                        raise ChannelTimeout(
+                            f"mux recv timed out after {timeout}s"
+                        )
+                    self._cond.wait(timeout=remaining)
             out = bytes(self._buf[:n])
             del self._buf[:n]
             return out

@@ -178,8 +178,21 @@ class NRIPlugin:
         self._metrics = metrics
         self._mux: Optional[nri_mux.Mux] = None
         self._server: Optional[ttrpc.Server] = None
+        self._runtime: Optional[ttrpc.Client] = None
         self._mux_lock = threading.Lock()
         self._stopping = False
+        # container id -> set of chip indexes injected at create time;
+        # feeds evict_for_chips when a chip dies. Pruned on removal and
+        # REBUILT from the runtime's Synchronize snapshot on every
+        # (re)connect, so containers created under a previous session —
+        # and removals missed while disconnected — are both covered.
+        self._bound_chips: Dict[str, set] = {}
+        # chip -> health reason, sticky until clear_failed_chips(); lets
+        # evictions that failed (runtime down, RPC error) retry after the
+        # next Synchronize instead of being dropped on the transition
+        self._failed_chips: Dict[int, str] = {}
+        self._evicted: set = set()  # container ids already evicted
+        self._bound_lock = threading.Lock()
         # observability for tests / metrics
         self.configured = threading.Event()
         self.synchronized = threading.Event()
@@ -205,28 +218,50 @@ class NRIPlugin:
         )
         self.configured.set()
         return pb.ConfigureResponse(
-            events=event_mask(pb.CREATE_CONTAINER)
+            events=event_mask(pb.CREATE_CONTAINER, pb.REMOVE_CONTAINER)
         )
 
     def _on_synchronize(
         self, req: pb.SynchronizeRequest
     ) -> pb.SynchronizeResponse:
-        # Existing containers were created before we connected; their device
-        # nodes were injected by whichever path was active then (or the pod
-        # predates the agent — nothing NRI can retrofit at this point, the
-        # adjustment API only exists at create time). Log the TPU ones so a
-        # restart that raced container creation is visible.
-        stale = [
-            f"{c.pod_sandbox_id[:8]}/{c.name}"
-            for c in req.containers
-            if hash_from_env(list(c.env))
-        ]
-        if stale:
+        # Existing containers were created before this session; their
+        # device nodes were injected then (adjustments only exist at
+        # create time). REBUILD the eviction-tracking map from this
+        # authoritative snapshot: containers from a previous agent/NRI
+        # session stay evictable, and removals missed while disconnected
+        # stop lingering.
+        bound: Dict[str, set] = {}
+        for c in req.containers:
+            alloc_hash = hash_from_env(list(c.env))
+            if alloc_hash is None:
+                continue
+            try:
+                spec = self._load_spec(alloc_hash)
+            except (OSError, ValueError):
+                logger.warning(
+                    "NRI: pre-existing TPU container %s/%s has no alloc "
+                    "spec (hash %s)", c.pod_sandbox_id[:8], c.name,
+                    alloc_hash,
+                )
+                continue
+            bound[c.id] = set(spec.get("chip_indexes", []))
+        with self._bound_lock:
+            self._bound_chips = bound
+            self._evicted &= set(bound)
+            retry_needed = bool(self._failed_chips)
+        if bound:
             logger.info(
-                "NRI: %d pre-existing TPU container(s): %s",
-                len(stale), ", ".join(stale),
+                "NRI: tracking %d pre-existing TPU container(s)", len(bound)
             )
         self.synchronized.set()
+        if retry_needed:
+            # Evictions pending from before the reconnect: retry off the
+            # serve thread (the runtime is still waiting for THIS
+            # response; calling it inline could deadlock the handshake).
+            threading.Thread(
+                target=self._flush_evictions, daemon=True,
+                name="nri-evict-retry",
+            ).start()
         return pb.SynchronizeResponse(more=req.more)
 
     def _on_create_container(
@@ -256,6 +291,10 @@ class NRIPlugin:
             ),
         )
         self.injected_count += 1
+        with self._bound_lock:
+            self._bound_chips[req.container.id] = set(
+                spec.get("chip_indexes", [])
+            )
         if self._metrics is not None and hasattr(self._metrics, "nri_injections"):
             self._metrics.nri_injections.inc()
         logger.info(
@@ -283,8 +322,105 @@ class NRIPlugin:
     ) -> pb.StopContainerResponse:
         return pb.StopContainerResponse()
 
-    def _on_state_change(self, req: pb.StateChangeEvent) -> pb.Empty:  # noqa: ARG002
+    def _on_state_change(self, req: pb.StateChangeEvent) -> pb.Empty:
+        if req.event == pb.REMOVE_CONTAINER and req.container.id:
+            with self._bound_lock:
+                self._bound_chips.pop(req.container.id, None)
         return pb.Empty()
+
+    # -- chip-failure eviction ------------------------------------------------
+
+    EVICT_RPC_TIMEOUT_S = 10.0
+
+    def evict_for_chips(self, chips: set, reasons=None) -> int:
+        """Record ``chips`` as failed and evict every tracked container
+        whose injected devices include one of them (kubelet then
+        restarts the pod, landing it on healthy chips — the dead chip is
+        no longer advertised). Returns the number of evictions
+        containerd ACCEPTED in this call; containers that could not be
+        evicted now (no live session, RPC failure) retry automatically
+        after the next Synchronize because the failed-chip set is sticky
+        until clear_failed_chips().
+
+        Rationale: a container bound to a dead chip holds a device node
+        that will never work again — the bind is immutable post-create,
+        so eviction is the only recovery containerd offers. Gated behind
+        the agent's --nri-evict-on-chip-failure flag (policy, default
+        off)."""
+        reasons = reasons or {}
+        with self._bound_lock:
+            for c in chips:
+                self._failed_chips[c] = reasons.get(c, "chip unhealthy")
+        return self._flush_evictions()
+
+    def clear_failed_chips(self, chips: set) -> None:
+        """Chip recovered: stop evicting (new) containers bound to it."""
+        with self._bound_lock:
+            for c in chips:
+                self._failed_chips.pop(c, None)
+
+    def _flush_evictions(self) -> int:
+        with self._bound_lock:
+            failed_chips = dict(self._failed_chips)
+            victims = {
+                cid: sorted(set(bound) & set(failed_chips))
+                for cid, bound in self._bound_chips.items()
+                if set(bound) & set(failed_chips)
+                and cid not in self._evicted
+            }
+        if not victims:
+            return 0
+        with self._mux_lock:
+            client = self._runtime
+        if client is None:
+            logger.warning(
+                "NRI: no live session; %d eviction(s) pending until "
+                "reconnect", len(victims),
+            )
+            return 0
+        evictions = [
+            pb.ContainerEviction(
+                container_id=cid,
+                reason=(
+                    "TPU chip(s) "
+                    + ",".join(
+                        f"{c} ({failed_chips[c]})" for c in hit
+                    )
+                    + " failed; device is unrecoverable in-place"
+                ),
+            )
+            for cid, hit in sorted(victims.items())
+        ]
+        try:
+            resp = client.call(
+                RUNTIME_SERVICE, "UpdateContainers",
+                pb.UpdateContainersRequest(evict=evictions),
+                pb.UpdateContainersResponse,
+                timeout_s=self.EVICT_RPC_TIMEOUT_S,
+            )
+        except (
+            ttrpc.TtrpcError, ttrpc.ChannelClosed, ttrpc.ChannelTimeout
+        ) as e:
+            logger.warning(
+                "NRI: eviction request failed (%s); will retry after the "
+                "next session sync", e,
+            )
+            return 0
+        failed_ids = {u.container_id for u in resp.failed}
+        ok = 0
+        with self._bound_lock:
+            for ev in evictions:
+                if ev.container_id in failed_ids:
+                    logger.warning(
+                        "NRI: eviction of %s failed", ev.container_id
+                    )
+                else:
+                    self._evicted.add(ev.container_id)
+                    ok += 1
+                    logger.info(
+                        "NRI: evicted %s (%s)", ev.container_id, ev.reason
+                    )
+        return ok
 
     # -- connection lifecycle -------------------------------------------------
 
@@ -349,6 +485,8 @@ class NRIPlugin:
                 "NRI: registered as %s-%s on %s",
                 self._idx, self._name, self._socket_path,
             )
+            with self._mux_lock:
+                self._runtime = client  # live session: evictions possible
             serve_thread.join()  # session lifetime
         except ttrpc.ChannelClosed:
             pass  # runtime went away mid-handshake; run() retries
@@ -362,6 +500,7 @@ class NRIPlugin:
             with self._mux_lock:
                 self._mux = None
                 self._server = None
+                self._runtime = None
 
     def _close_mux(self) -> None:
         with self._mux_lock:

@@ -56,14 +56,19 @@ class ChannelClosed(Exception):
     """The underlying byte stream ended."""
 
 
+class ChannelTimeout(Exception):
+    """A bounded read ran out of time (the channel itself is still up)."""
+
+
 class Channel:
     """Byte-stream interface ttrpc runs over (a socket or one mux conn)."""
 
     def sendall(self, data: bytes) -> None:
         raise NotImplementedError
 
-    def recv_exact(self, n: int) -> bytes:
-        """Return exactly n bytes or raise ChannelClosed."""
+    def recv_exact(self, n: int, timeout: Optional[float] = None) -> bytes:
+        """Return exactly n bytes; raise ChannelClosed on EOF or
+        ChannelTimeout when a non-None timeout elapses first."""
         raise NotImplementedError
 
     def close(self) -> None:
@@ -77,15 +82,36 @@ class SocketChannel(Channel):
         self._sock = sock
 
     def sendall(self, data: bytes) -> None:
-        self._sock.sendall(data)
+        try:
+            self._sock.sendall(data)
+        except OSError as e:
+            raise ChannelClosed(str(e))
 
-    def recv_exact(self, n: int) -> bytes:
+    def recv_exact(self, n: int, timeout: Optional[float] = None) -> bytes:
+        import time as _time
+
+        deadline = None if timeout is None else _time.monotonic() + timeout
         buf = b""
         while len(buf) < n:
+            if deadline is not None:
+                remaining = deadline - _time.monotonic()
+                if remaining <= 0:
+                    self._sock.settimeout(None)
+                    raise ChannelTimeout(f"recv timed out after {timeout}s")
+                self._sock.settimeout(remaining)
             try:
                 chunk = self._sock.recv(n - len(buf))
+            except socket.timeout:
+                self._sock.settimeout(None)
+                raise ChannelTimeout(f"recv timed out after {timeout}s")
             except OSError as e:
                 raise ChannelClosed(str(e))
+            finally:
+                if deadline is not None:
+                    try:
+                        self._sock.settimeout(None)
+                    except OSError:
+                        pass
             if not chunk:
                 raise ChannelClosed("socket closed")
             buf += chunk
@@ -110,13 +136,15 @@ def write_frame(ch: Channel, stream_id: int, mtype: int, payload: bytes) -> None
     ch.sendall(_HEADER.pack(len(payload), stream_id, mtype, 0) + payload)
 
 
-def read_frame(ch: Channel) -> Tuple[int, int, int, bytes]:
+def read_frame(
+    ch: Channel, timeout: Optional[float] = None
+) -> Tuple[int, int, int, bytes]:
     """-> (stream_id, type, flags, payload)"""
-    hdr = ch.recv_exact(_HEADER.size)
+    hdr = ch.recv_exact(_HEADER.size, timeout=timeout)
     length, stream_id, mtype, flags = _HEADER.unpack(hdr)
     if length > MAX_MESSAGE_SIZE:
         raise ChannelClosed(f"oversized ttrpc frame ({length} bytes)")
-    payload = ch.recv_exact(length) if length else b""
+    payload = ch.recv_exact(length, timeout=timeout) if length else b""
     return stream_id, mtype, flags, payload
 
 
@@ -130,12 +158,20 @@ class Client:
         self._lock = threading.Lock()
 
     def call(self, service: str, method: str, request, response_cls,
-             timeout_nano: int = 0):
+             timeout_s: Optional[float] = None):
+        """Unary call; a non-None ``timeout_s`` bounds the wait for the
+        response (raising ChannelTimeout) so callers on latency-critical
+        threads can't wedge on a stalled runtime. A late response for
+        the abandoned stream id is skipped by a later call's sid match."""
+        import time as _time
+
         req = ttrpc_pb2.Request(
             service=service,
             method=method,
             payload=request.SerializeToString(),
-            timeout_nano=timeout_nano,
+            timeout_nano=(
+                int(timeout_s * 1e9) if timeout_s is not None else 0
+            ),
         )
         with self._lock:
             stream_id = self._next_stream
@@ -144,8 +180,22 @@ class Client:
                 self._ch, stream_id, MESSAGE_TYPE_REQUEST,
                 req.SerializeToString(),
             )
+            deadline = (
+                None if timeout_s is None
+                else _time.monotonic() + timeout_s
+            )
             while True:
-                sid, mtype, _flags, payload = read_frame(self._ch)
+                remaining = None
+                if deadline is not None:
+                    remaining = deadline - _time.monotonic()
+                    if remaining <= 0:
+                        raise ChannelTimeout(
+                            f"{service}/{method} timed out after "
+                            f"{timeout_s}s"
+                        )
+                sid, mtype, _flags, payload = read_frame(
+                    self._ch, timeout=remaining
+                )
                 if mtype != MESSAGE_TYPE_RESPONSE or sid != stream_id:
                     logger.warning(
                         "ttrpc client: unexpected frame sid=%d type=%d", sid,
@@ -221,11 +271,14 @@ class Server:
                 resp = ttrpc_pb2.Response(
                     status=ttrpc_pb2.Status(code=CODE_UNKNOWN, message=str(e))
                 )
-            with self._wlock:
-                write_frame(
-                    self._ch, sid, MESSAGE_TYPE_RESPONSE,
-                    resp.SerializeToString(),
-                )
+            try:
+                with self._wlock:
+                    write_frame(
+                        self._ch, sid, MESSAGE_TYPE_RESPONSE,
+                        resp.SerializeToString(),
+                    )
+            except ChannelClosed:
+                return  # peer went away mid-response; session over
             if self._stop_after_reply:
                 return
 
@@ -233,7 +286,11 @@ class Server:
         resp = ttrpc_pb2.Response(
             status=ttrpc_pb2.Status(code=code, message=message)
         )
-        with self._wlock:
-            write_frame(
-                self._ch, sid, MESSAGE_TYPE_RESPONSE, resp.SerializeToString()
-            )
+        try:
+            with self._wlock:
+                write_frame(
+                    self._ch, sid, MESSAGE_TYPE_RESPONSE,
+                    resp.SerializeToString(),
+                )
+        except ChannelClosed:
+            pass

@@ -930,6 +930,14 @@ class TPUSharePlugin:
 
     HEALTH_PERIOD_S = 5.0
 
+    # Optional policy hooks set by the manager: on_chips_failed is
+    # called with (went_bad_chips, reasons) and on_chips_recovered with
+    # (recovered_chips,) on health transitions (e.g. NRI-based eviction
+    # of containers bound to the dead chips, and clearing the sticky
+    # eviction set when a chip comes back).
+    on_chips_failed = None
+    on_chips_recovered = None
+
     def health_once(self) -> bool:
         """One health poll: probe the operator ONCE, apply the same view to
         both resources (they must never disagree about a chip), emit events
@@ -980,6 +988,16 @@ class TPUSharePlugin:
             metrics.healthy_chips.set(
                 len(self.core._chips) - len(self.core._unhealthy_chips)
             )
+        if self.on_chips_failed is not None and went_bad:
+            try:
+                self.on_chips_failed(set(went_bad), reasons)
+            except Exception:  # noqa: BLE001 - policy must not wedge health
+                logger.exception("chip-failure policy hook failed")
+        if self.on_chips_recovered is not None and recovered:
+            try:
+                self.on_chips_recovered(set(recovered))
+            except Exception:  # noqa: BLE001
+                logger.exception("chip-recovery policy hook failed")
         return bool(went_bad or recovered)
 
     def _warn_bound_pods(self, events, went_bad: set) -> None:

@@ -64,6 +64,8 @@ class FakeNRIRuntime:
         self._listener.settimeout(5.0)
         self.registered = threading.Event()
         self.register_request = None
+        self.update_requests = []  # UpdateContainersRequest log
+        self.fail_evictions = set()  # container ids to report as failed
         self.mux = None
         self.client = None
 
@@ -78,6 +80,10 @@ class FakeNRIRuntime:
             RUNTIME_SERVICE, "RegisterPlugin", pb.RegisterPluginRequest,
             self._on_register,
         )
+        server.register(
+            RUNTIME_SERVICE, "UpdateContainers",
+            pb.UpdateContainersRequest, self._on_update_containers,
+        )
         threading.Thread(target=server.serve_forever, daemon=True).start()
         self.mux.start()
         self.client = ttrpc.Client(plugin_ch)
@@ -86,6 +92,24 @@ class FakeNRIRuntime:
         self.register_request = req
         self.registered.set()
         return pb.Empty()
+
+    def _on_update_containers(self, req):
+        self.update_requests.append(req)
+        return pb.UpdateContainersResponse(
+            failed=[
+                pb.ContainerUpdate(container_id=cid)
+                for cid in sorted(self.fail_evictions)
+            ]
+        )
+
+    def state_change(self, event, container_id):
+        return self.client.call(
+            PLUGIN_SERVICE, "StateChange",
+            pb.StateChangeEvent(
+                event=event, container=pb.Container(id=container_id)
+            ),
+            pb.Empty,
+        )
 
     def configure(self, runtime_name="fake-containerd", version="v9"):
         return self.client.call(
@@ -103,7 +127,9 @@ class FakeNRIRuntime:
             pb.SynchronizeResponse,
         )
 
-    def create_container(self, env, pod_name="train", namespace="ml"):
+    def create_container(
+        self, env, pod_name="train", namespace="ml", container_id="ctr-1"
+    ):
         return self.client.call(
             PLUGIN_SERVICE, "CreateContainer",
             pb.CreateContainerRequest(
@@ -111,8 +137,8 @@ class FakeNRIRuntime:
                     id="sandbox-1", name=pod_name, namespace=namespace
                 ),
                 container=pb.Container(
-                    id="ctr-1", pod_sandbox_id="sandbox-1", name="main",
-                    env=list(env),
+                    id=container_id, pod_sandbox_id="sandbox-1",
+                    name="main", env=list(env),
                 ),
             ),
             pb.CreateContainerResponse,
@@ -193,8 +219,10 @@ def test_registration_identity(runtime, plugin):
 def test_configure_subscribes_create_container(runtime, plugin):
     resp = runtime.configure()
     assert resp.events & event_mask(pb.CREATE_CONTAINER)
-    # create-only injector: no other lifecycle subscriptions
-    assert resp.events == event_mask(pb.CREATE_CONTAINER)
+    # injects at create, prunes tracking at remove — nothing else
+    assert resp.events == event_mask(
+        pb.CREATE_CONTAINER, pb.REMOVE_CONTAINER
+    )
     assert plugin.configured.is_set()
 
 
@@ -352,6 +380,181 @@ def test_shutdown_then_reconnect(runtime, alloc_dir):
     thread.join(timeout=5.0)
 
 
+# -- chip-failure eviction ---------------------------------------------------
+
+
+SPEC_B = {
+    "hash": "beef0002",
+    "resource": "elasticgpu.io/tpu-core",
+    "namespace": "ml",
+    "pod": "other",
+    "container": "main",
+    "chip_indexes": [3],
+    "device_paths": ["/dev/accel3"],
+    "env": {EnvTPUVisibleChips: "0"},
+}
+
+
+@pytest.fixture
+def alloc_dir_two(alloc_dir):
+    with open(os.path.join(alloc_dir, f"{SPEC_B['hash']}.json"), "w") as f:
+        json.dump(SPEC_B, f)
+    return alloc_dir
+
+
+def test_evict_for_chips_targets_bound_containers(
+    runtime, plugin, alloc_dir_two
+):
+    """Containers whose injected devices include a failed chip get an
+    eviction request with the reason; others are untouched."""
+    runtime.configure()
+    runtime.create_container([f"TPU={SPEC['hash']}"], container_id="a")
+    runtime.create_container([f"TPU={SPEC_B['hash']}"], container_id="b")
+    runtime.create_container(["PATH=/bin"], container_id="c")  # not ours
+
+    n = plugin.evict_for_chips({2}, reasons={2: "fatal AER counter rose"})
+    assert n == 1
+    assert len(runtime.update_requests) == 1
+    evs = list(runtime.update_requests[0].evict)
+    assert [e.container_id for e in evs] == ["a"]  # chip 2 only in SPEC
+    assert "2 (fatal AER counter rose)" in evs[0].reason
+
+    # chip 3 is in BOTH specs, but "a" was already evicted above — only
+    # "b" goes (an evicted container is already restarting; re-evicting
+    # it would churn the replacement)
+    n = plugin.evict_for_chips({3})
+    assert n == 1
+    evs = list(runtime.update_requests[1].evict)
+    assert [e.container_id for e in evs] == ["b"]
+
+
+def test_removed_container_not_evicted(runtime, plugin):
+    runtime.configure()
+    runtime.create_container([f"TPU={SPEC['hash']}"], container_id="gone")
+    runtime.state_change(pb.REMOVE_CONTAINER, "gone")
+    assert plugin.evict_for_chips({2}) == 0
+    assert runtime.update_requests == []
+
+
+def test_evict_counts_runtime_failures(runtime, plugin, alloc_dir_two):
+    runtime.configure()
+    runtime.create_container([f"TPU={SPEC['hash']}"], container_id="a")
+    runtime.create_container([f"TPU={SPEC_B['hash']}"], container_id="b")
+    runtime.fail_evictions = {"a"}
+    assert plugin.evict_for_chips({3}) == 1  # b succeeded, a failed
+
+
+def test_evict_without_session_is_safe(alloc_dir, tmp_path):
+    p = NRIPlugin(
+        socket_path=str(tmp_path / "nowhere.sock"),
+        alloc_spec_dir=alloc_dir,
+        stat_fn=fake_stat_table(DEV_TABLE),
+    )
+    p._bound_chips["x"] = {2}
+    assert p.evict_for_chips({2}) == 0  # no live session: no-op
+
+
+def test_health_hook_drives_eviction(runtime, plugin, monkeypatch):
+    """The TPUSharePlugin health hook wiring: a chip going unhealthy
+    triggers evict_for_chips with the reasons map."""
+    from elastic_tpu_agent.plugins.base import PluginConfig
+    from elastic_tpu_agent.plugins.tpushare import TPUSharePlugin
+    from elastic_tpu_agent.storage import Storage
+    from elastic_tpu_agent.tpu.stub import StubOperator
+
+    from fake_kubelet import FakeSitter
+
+    runtime.configure()
+    runtime.create_container([f"TPU={SPEC['hash']}"], container_id="victim")
+
+    import tempfile
+
+    tmp = tempfile.mkdtemp()
+    op = StubOperator(tmp, "v5litepod-4")
+    config = PluginConfig(
+        device_plugin_dir=tmp,
+        pod_resources_socket=os.path.join(tmp, "pr.sock"),
+        operator=op,
+        sitter=FakeSitter(),
+        storage=Storage(os.path.join(tmp, "meta.db")),
+        locator_factory=lambda r: None,
+        extra={"alloc_spec_dir": tmp},
+    )
+    share = TPUSharePlugin(config)
+    share.on_chips_failed = plugin.evict_for_chips
+    share.health_once()  # all healthy: no evictions
+    assert runtime.update_requests == []
+    op.set_unhealthy({2})
+    assert share.health_once()
+    assert len(runtime.update_requests) == 1
+    assert runtime.update_requests[0].evict[0].container_id == "victim"
+
+
+def test_synchronize_rebuilds_tracking_from_snapshot(runtime, plugin):
+    """Containers created under a PREVIOUS session arrive via
+    Synchronize; they must be evictable (review r4: session-restart
+    blindness) and stale tracked ids must drop."""
+    runtime.configure()
+    plugin._bound_chips["stale-id"] = {2}  # simulates a missed removal
+    existing = pb.Container(
+        id="old-ctr", pod_sandbox_id="s0", name="oldtpu",
+        env=[f"TPU={SPEC['hash']}"],
+    )
+    runtime.synchronize(containers=[existing])
+    assert plugin._bound_chips == {"old-ctr": {2, 3}}
+    assert plugin.evict_for_chips({2}) == 1
+    assert runtime.update_requests[0].evict[0].container_id == "old-ctr"
+
+
+def test_pending_eviction_retries_after_reconnect(runtime, alloc_dir):
+    """A chip failure while the session is down parks the eviction; the
+    next session's Synchronize retries it."""
+    import time
+
+    p = NRIPlugin(
+        socket_path=runtime.socket_path,
+        alloc_spec_dir=alloc_dir,
+        stat_fn=fake_stat_table(DEV_TABLE),
+    )
+    p.RECONNECT_MIN_S = 0.05
+    stop = threading.Event()
+    thread = p.start(stop)
+    runtime.accept()
+    assert runtime.registered.wait(5.0)
+    runtime.configure()
+    runtime.create_container([f"TPU={SPEC['hash']}"], container_id="v1")
+    runtime.mux.close()  # session dies
+    time.sleep(0.2)
+    assert p.evict_for_chips({2}, {2: "node missing"}) == 0  # parked
+    runtime.accept()  # containerd back
+    assert runtime.registered.wait(5.0)
+    runtime.configure()
+    runtime.synchronize(containers=[
+        pb.Container(id="v1", name="m", env=[f"TPU={SPEC['hash']}"])
+    ])
+    deadline = time.time() + 5
+    while time.time() < deadline and not runtime.update_requests:
+        time.sleep(0.05)
+    assert runtime.update_requests, "pending eviction never retried"
+    ev = runtime.update_requests[0].evict[0]
+    assert ev.container_id == "v1" and "node missing" in ev.reason
+    stop.set()
+    p.stop()
+    thread.join(timeout=5.0)
+
+
+def test_recovery_clears_sticky_failed_chips(runtime, plugin):
+    runtime.configure()
+    runtime.create_container([f"TPU={SPEC['hash']}"], container_id="a")
+    assert plugin.evict_for_chips({2}) == 1
+    plugin.clear_failed_chips({2})
+    assert plugin._failed_chips == {}
+    # a new container on the recovered chip is NOT evicted
+    runtime.create_container([f"TPU={SPEC['hash']}"], container_id="a2")
+    assert plugin._flush_evictions() == 0
+    assert len(runtime.update_requests) == 1  # only the original
+
+
 # -- unit-level: the pure adjustment builder ---------------------------------
 
 
@@ -451,7 +654,9 @@ def test_manager_runs_nri_plugin(tmp_path):
         mgr.run(block=False)
         rt.accept()
         assert rt.registered.wait(5.0)
-        assert rt.configure().events == event_mask(pb.CREATE_CONTAINER)
+        assert rt.configure().events == event_mask(
+            pb.CREATE_CONTAINER, pb.REMOVE_CONTAINER
+        )
     finally:
         mgr.stop()
         rt.close()
