@@ -282,7 +282,7 @@ def tpu_measure_once():
     achieved_tflops = flops_per_step * steps / dt / 1e12
     gen = detect_tpu_gen(getattr(devices[0], "device_kind", ""))
     peak = PEAK_TFLOPS.get(gen, 197)
-    return {
+    result = {
         "platform": platform,
         "device_kind": getattr(devices[0], "device_kind", ""),
         "tpu_gen": gen,
@@ -293,6 +293,36 @@ def tpu_measure_once():
         "attn_flops_pct": 100 * attn_flops / flops_per_step,
         "final_loss": final_loss,
         "n_params_m": n_params / 1e6,
+    }
+    try:
+        result["decode"] = tpu_decode_measure(params, cfg)
+    except Exception as e:  # noqa: BLE001 - decode is a bonus metric
+        result["decode"] = {"error": f"{type(e).__name__}: {e}"}
+    return result
+
+
+def tpu_decode_measure(params, cfg, batch=8, prompt_len=128, new_tokens=128):
+    """KV-cache decode throughput on the trained params (the inference
+    half of the workload stack; workloads/generate.py)."""
+    import jax
+
+    from elastic_tpu_agent.workloads.generate import generate
+
+    prompt = jax.random.randint(
+        jax.random.key(3), (batch, prompt_len), 0, cfg.vocab
+    )
+    out = generate(params, prompt, cfg, max_new_tokens=new_tokens)
+    jax.block_until_ready(out)  # compile + warmup
+    t0 = time.perf_counter()
+    out = generate(params, prompt, cfg, max_new_tokens=new_tokens)
+    jax.block_until_ready(out)
+    dt = time.perf_counter() - t0
+    return {
+        "batch": batch,
+        "prompt_len": prompt_len,
+        "new_tokens": new_tokens,
+        "decode_tokens_per_s": batch * new_tokens / dt,
+        "ms_per_token": dt / new_tokens * 1000,
     }
 
 

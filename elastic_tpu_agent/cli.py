@@ -79,7 +79,13 @@ def parse_args(argv=None) -> argparse.Namespace:
     p.add_argument("--no-crd", action="store_true",
                    help="disable ElasticTPU CRD publication")
     p.add_argument("-v", "--verbose", action="count", default=0)
-    return p.parse_args(argv)
+    args = p.parse_args(argv)
+    if args.nri_evict_on_chip_failure and not args.nri_socket:
+        p.error(
+            "--nri-evict-on-chip-failure requires --nri-socket (evictions "
+            "go through the NRI session)"
+        )
+    return args
 
 
 def main(argv=None) -> int:

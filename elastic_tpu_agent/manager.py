@@ -193,13 +193,20 @@ class TPUManager:
                 libtpu_path=opts.nri_libtpu,
                 metrics=self.metrics,
             )
-            if opts.nri_evict_on_chip_failure and hasattr(
-                self.plugin, "on_chips_failed"
-            ):
-                self.plugin.on_chips_failed = self.nri_plugin.evict_for_chips
-                self.plugin.on_chips_recovered = (
-                    self.nri_plugin.clear_failed_chips
-                )
+            if opts.nri_evict_on_chip_failure:
+                if hasattr(self.plugin, "on_chips_failed"):
+                    self.plugin.on_chips_failed = (
+                        self.nri_plugin.evict_for_chips
+                    )
+                    self.plugin.on_chips_recovered = (
+                        self.nri_plugin.clear_failed_chips
+                    )
+                else:
+                    logger.warning(
+                        "nri_evict_on_chip_failure set but plugin kind "
+                        "%r has no health hooks; policy is INACTIVE",
+                        opts.plugin_kind,
+                    )
         self._stop = threading.Event()
 
     # -- Restore (SURVEY.md §3.5: declared-but-unimplemented upstream) --------

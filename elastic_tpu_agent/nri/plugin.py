@@ -193,6 +193,10 @@ class NRIPlugin:
         self._failed_chips: Dict[int, str] = {}
         self._evicted: set = set()  # container ids already evicted
         self._bound_lock = threading.Lock()
+        # serializes whole flush passes: concurrent flushes (health hook
+        # racing the reconnect-retry thread) would both snapshot victims
+        # before either records _evicted and double-evict
+        self._flush_lock = threading.Lock()
         # observability for tests / metrics
         self.configured = threading.Event()
         self.synchronized = threading.Event()
@@ -295,6 +299,18 @@ class NRIPlugin:
             self._bound_chips[req.container.id] = set(
                 spec.get("chip_indexes", [])
             )
+            born_dead = bool(
+                set(spec.get("chip_indexes", [])) & set(self._failed_chips)
+            )
+        if born_dead:
+            # The chip failed between Allocate and CreateContainer (the
+            # spec predates the failure): evict immediately, off the
+            # serve thread — the runtime is still waiting for THIS
+            # response.
+            threading.Thread(
+                target=self._flush_evictions, daemon=True,
+                name="nri-evict-born-dead",
+            ).start()
         if self._metrics is not None and hasattr(self._metrics, "nri_injections"):
             self._metrics.nri_injections.inc()
         logger.info(
@@ -326,6 +342,7 @@ class NRIPlugin:
         if req.event == pb.REMOVE_CONTAINER and req.container.id:
             with self._bound_lock:
                 self._bound_chips.pop(req.container.id, None)
+                self._evicted.discard(req.container.id)  # no leak
         return pb.Empty()
 
     # -- chip-failure eviction ------------------------------------------------
@@ -360,6 +377,10 @@ class NRIPlugin:
                 self._failed_chips.pop(c, None)
 
     def _flush_evictions(self) -> int:
+        with self._flush_lock:
+            return self._flush_evictions_locked()
+
+    def _flush_evictions_locked(self) -> int:
         with self._bound_lock:
             failed_chips = dict(self._failed_chips)
             victims = {

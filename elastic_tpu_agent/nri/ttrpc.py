@@ -193,9 +193,18 @@ class Client:
                             f"{service}/{method} timed out after "
                             f"{timeout_s}s"
                         )
-                sid, mtype, _flags, payload = read_frame(
-                    self._ch, timeout=remaining
-                )
+                try:
+                    sid, mtype, _flags, payload = read_frame(
+                        self._ch, timeout=remaining
+                    )
+                except ChannelTimeout:
+                    # A timeout may strike MID-FRAME (header consumed,
+                    # payload pending): the stream is no longer aligned
+                    # and reusing it would parse payload bytes as a
+                    # header. Poison the channel — the owner's reconnect
+                    # loop builds a fresh session.
+                    self._ch.close()
+                    raise
                 if mtype != MESSAGE_TYPE_RESPONSE or sid != stream_id:
                     logger.warning(
                         "ttrpc client: unexpected frame sid=%d type=%d", sid,

@@ -555,6 +555,61 @@ def test_recovery_clears_sticky_failed_chips(runtime, plugin):
     assert len(runtime.update_requests) == 1  # only the original
 
 
+def test_container_born_on_failed_chip_is_evicted(runtime, plugin):
+    """A container created AFTER its chip failed (Allocate raced the
+    failure) must still be evicted — nothing else would ever trigger it
+    in a stable session (review r4)."""
+    import time
+
+    runtime.configure()
+    assert plugin.evict_for_chips({2}, {2: "died early"}) == 0  # nothing yet
+    runtime.create_container([f"TPU={SPEC['hash']}"], container_id="late")
+    deadline = time.time() + 5
+    while time.time() < deadline and not runtime.update_requests:
+        time.sleep(0.05)
+    assert runtime.update_requests, "born-dead container never evicted"
+    assert runtime.update_requests[0].evict[0].container_id == "late"
+
+
+def test_remove_prunes_evicted_set(runtime, plugin):
+    runtime.configure()
+    runtime.create_container([f"TPU={SPEC['hash']}"], container_id="x")
+    assert plugin.evict_for_chips({2}) == 1
+    assert "x" in plugin._evicted
+    runtime.state_change(pb.REMOVE_CONTAINER, "x")
+    assert "x" not in plugin._evicted
+
+
+def test_cli_rejects_evict_without_socket():
+    from elastic_tpu_agent.cli import parse_args
+
+    with pytest.raises(SystemExit):
+        parse_args(["--nri-evict-on-chip-failure"])
+    args = parse_args(
+        ["--nri-evict-on-chip-failure", "--nri-socket", "/run/nri.sock"]
+    )
+    assert args.nri_evict_on_chip_failure
+
+
+def test_nri_churn_soak(runtime, plugin):
+    """Create/remove churn: tracking stays exact (no growth), the
+    session stays responsive, and evictions see only live containers."""
+    runtime.configure()
+    for i in range(60):
+        cid = f"churn-{i}"
+        resp = runtime.create_container(
+            [f"TPU={SPEC['hash']}"], container_id=cid
+        )
+        assert len(resp.adjust.linux.devices) == 2
+        if i % 2 == 0:  # remove half as we go
+            runtime.state_change(pb.REMOVE_CONTAINER, cid)
+    live = {f"churn-{i}" for i in range(60) if i % 2 == 1}
+    assert set(plugin._bound_chips) == live
+    assert plugin.evict_for_chips({2}) == len(live)
+    evicted = {e.container_id for e in runtime.update_requests[-1].evict}
+    assert evicted == live
+
+
 # -- unit-level: the pure adjustment builder ---------------------------------
 
 
