@@ -41,6 +41,11 @@ class FlashConfig:
     block_k: int = 256
     sm_scale: Optional[float] = None  # default 1/sqrt(head_dim)
     interpret: bool = False  # run kernels interpreted (CPU/testing)
+    # Sliding-window attention: each query attends only the last
+    # ``window`` positions (0 = unlimited). Requires causal. The kernels
+    # skip kv blocks entirely outside the window, so compute per query
+    # is O(window·h) regardless of sequence length.
+    window: int = 0
 
 
 def supports_flash(seq: int, head_dim: int, cfg: FlashConfig) -> bool:
@@ -69,14 +74,19 @@ def auto_flash_config(seq: int, interpret: bool = False) -> FlashConfig:
 
 def reference_attention(
     q: jax.Array, k: jax.Array, v: jax.Array, causal: bool = True,
-    sm_scale: Optional[float] = None,
+    sm_scale: Optional[float] = None, window: int = 0,
 ) -> jax.Array:
-    """Plain materialized-scores attention. [b, s, n, h] → [b, s, n, h]."""
+    """Plain materialized-scores attention. [b, s, n, h] → [b, s, n, h].
+    ``window`` > 0 limits each query to the last ``window`` positions."""
     scale = sm_scale if sm_scale is not None else 1.0 / np.sqrt(q.shape[-1])
     logits = jnp.einsum("bsnh,btnh->bnst", q, k) * scale
+    s, t = logits.shape[-2], logits.shape[-1]
     if causal:
-        s, t = logits.shape[-2], logits.shape[-1]
         mask = jnp.tril(jnp.ones((s, t), dtype=bool))
+        if window > 0:
+            rows = jnp.arange(s)[:, None]
+            cols = jnp.arange(t)[None, :]
+            mask &= rows - cols < window
         logits = jnp.where(mask[None, None], logits, NEG_INF)
     probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
     return jnp.einsum(
@@ -107,7 +117,10 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, cfg: FlashConfig,
         if cfg.causal:
             rows = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
             cols = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-            s_ij = jnp.where(rows >= cols, s_ij, NEG_INF)
+            keep = rows >= cols
+            if cfg.window > 0:
+                keep &= rows - cols < cfg.window
+            s_ij = jnp.where(keep, s_ij, NEG_INF)
         m_new = jnp.maximum(m, jnp.max(s_ij, axis=-1, keepdims=True))
         p = jnp.exp(s_ij - m_new)
         corr = jnp.exp(m - m_new)
@@ -121,12 +134,16 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, cfg: FlashConfig,
     m0 = jnp.full((bq, 1), NEG_INF, jnp.float32)
     l0 = jnp.zeros((bq, 1), jnp.float32)
     acc0 = jnp.zeros((bq, h), jnp.float32)
+    lower = 0
     if cfg.causal and cfg.block_q == cfg.block_k:
         # q block i only ever sees kv blocks 0..i
         upper = qi + 1
+        if cfg.window > 0:
+            # earliest visible column is row_min - window + 1
+            lower = jnp.maximum(0, (qi * bq - cfg.window + 1) // bk)
     else:
         upper = n_kv_blocks
-    m, l, acc = jax.lax.fori_loop(0, upper, body, (m0, l0, acc0))
+    m, l, acc = jax.lax.fori_loop(lower, upper, body, (m0, l0, acc0))
     l = jnp.maximum(l, 1e-30)  # fully-masked rows: avoid 0/0
     o_ref[0] = (acc / l).astype(o_ref.dtype)
     lse_ref[0] = m + jnp.log(l)
@@ -192,7 +209,10 @@ def _dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         if cfg.causal:
             rows = i * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
             cols = kj * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-            s_ij = jnp.where(rows >= cols, s_ij, NEG_INF)
+            keep = rows >= cols
+            if cfg.window > 0:
+                keep &= rows - cols < cfg.window
+            s_ij = jnp.where(keep, s_ij, NEG_INF)
         p = jnp.exp(s_ij - lsei[:, None])  # [bq, bk]
         dv_new = dv + jax.lax.dot_general(
             p.astype(doi.dtype), doi, (((0,), (0,)), ((), ())),
@@ -209,13 +229,19 @@ def _dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         )  # [bk, h]
         return dk_new, dv_new
 
+    upper = n_q_blocks
     if cfg.causal and cfg.block_q == cfg.block_k:
         lower = kj  # q blocks before the diagonal are fully masked
+        if cfg.window > 0:
+            # the last row that can see this kv block's first column is
+            # kj*bk + window - 1 + (bk - 1); beyond it, fully masked
+            last_row = (kj + 1) * bk + cfg.window - 2
+            upper = jnp.minimum(n_q_blocks, last_row // bq + 1)
     else:
         lower = 0
     dk0 = jnp.zeros((bk, h), jnp.float32)
     dv0 = jnp.zeros((bk, h), jnp.float32)
-    dk, dv = jax.lax.fori_loop(lower, n_q_blocks, body, (dk0, dv0))
+    dk, dv = jax.lax.fori_loop(lower, upper, body, (dk0, dv0))
     dk_ref[0] = dk.astype(dk_ref.dtype)
     dv_ref[0] = dv.astype(dv_ref.dtype)
 
@@ -244,7 +270,10 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
                 jnp.int32, (bq, bk), 0
             )
             cols = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-            s_ij = jnp.where(rows >= cols, s_ij, NEG_INF)
+            keep = rows >= cols
+            if cfg.window > 0:
+                keep &= rows - cols < cfg.window
+            s_ij = jnp.where(keep, s_ij, NEG_INF)
         p = jnp.exp(s_ij - lse[:, None])
         dp = jax.lax.dot_general(
             do, vj, (((1,), (1,)), ((), ())),
@@ -256,12 +285,15 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
             preferred_element_type=jnp.float32,
         )
 
+    lower = 0
     if cfg.causal and cfg.block_q == cfg.block_k:
         upper = qi_idx + 1
+        if cfg.window > 0:
+            lower = jnp.maximum(0, (qi_idx * bq - cfg.window + 1) // bk)
     else:
         upper = n_kv_blocks
     dq0 = jnp.zeros((bq, h), jnp.float32)
-    dq = jax.lax.fori_loop(0, upper, body, dq0)
+    dq = jax.lax.fori_loop(lower, upper, body, dq0)
     dq_ref[0] = dq.astype(dq_ref.dtype)
 
 
@@ -411,9 +443,12 @@ def flash_attention(
     misalignment) so callers never need their own dispatch.
     """
     b, s, n, h = q.shape
+    if cfg.window > 0:
+        assert cfg.causal, "sliding-window attention requires causal"
     if not supports_flash(s, h, cfg):
         return reference_attention(
-            q, k, v, causal=cfg.causal, sm_scale=cfg.sm_scale
+            q, k, v, causal=cfg.causal, sm_scale=cfg.sm_scale,
+            window=cfg.window,
         )
     def to_bnsh(x):
         return x.transpose(0, 2, 1, 3).reshape(b * n, s, h)

@@ -96,9 +96,10 @@ def _cached_attention(
     max_len = cache_k.shape[1]
     rows = q_pos + jax.lax.broadcasted_iota(jnp.int32, (t, max_len), 0)
     cols = jax.lax.broadcasted_iota(jnp.int32, (t, max_len), 1)
-    logits = jnp.where(
-        (cols <= rows)[None, None, None], logits, NEG_INF
-    )
+    keep = cols <= rows
+    if cfg.window > 0:
+        keep &= rows - cols < cfg.window
+    logits = jnp.where(keep[None, None, None], logits, NEG_INF)
     probs = jax.nn.softmax(logits, axis=-1)
     out = jnp.einsum(
         "bgrts,bsgh->btgrh", probs.astype(cache_v.dtype), cache_v

@@ -54,6 +54,12 @@ class ModelConfig:
     # is per-position elementwise, applied BEFORE the attention core).
     pos: str = "learned"
     rope_theta: float = 10000.0
+    # Sliding-window attention: each token attends only the last
+    # ``window`` positions (0 = full causal). Served by the flash
+    # kernels with block skipping (compute O(window) per query) or the
+    # windowed reference path; not supported together with ring/sp
+    # sharding.
+    window: int = 0
     # Attention core: "auto" picks ring when the sequence axis is sharded
     # (sp>1), the Pallas flash kernel on TPU when tiles align, and the
     # materialized-scores einsum otherwise. "flash"/"ring"/"reference"
@@ -270,6 +276,12 @@ def _attention_core(
         else:
             impl = "reference"
     if impl == "ring":
+        if cfg.window > 0:
+            raise ValueError(
+                "sliding-window attention is not supported with ring/sp "
+                "sharding; use sp=1 (flash handles long windows with "
+                "O(window) compute per query)"
+            )
         if mesh is None:
             raise ValueError("ring attention needs a mesh (sp axis)")
         return ring_attention_sharded(q, k, v, mesh)
@@ -280,6 +292,8 @@ def _attention_core(
                 "use ring (attn='ring'/'auto') when sp > 1"
             )
         fc = auto_flash_config(s, interpret=(platform != "tpu"))
+        if cfg.window > 0:
+            fc = dataclasses.replace(fc, window=cfg.window)
         if mesh is None:
             return flash_attention(q, k, v, fc)
         # Under GSPMD, XLA cannot auto-partition a pallas_call: pin the
@@ -293,7 +307,7 @@ def _attention_core(
             out_specs=spec,
             check_vma=False,
         )(q, k, v)
-    return reference_attention(q, k, v, causal=True)
+    return reference_attention(q, k, v, causal=True, window=cfg.window)
 
 
 def _attention(

@@ -116,6 +116,8 @@ def _stage_fn(stage_params: Dict, x: jax.Array, cfg: ModelConfig) -> jax.Array:
     fc = auto_flash_config(
         x.shape[1], interpret=jax.default_backend() != "tpu"
     )
+    if cfg.window > 0:
+        fc = dataclasses.replace(fc, window=cfg.window)
 
     def one_layer(x, lp):
         h = _rmsnorm(x, lp["ln1_scale"])

@@ -1,0 +1,190 @@
+"""Sliding-window flash attention: kernels vs the windowed oracle —
+forward, backward, block-skip bounds at awkward window/block ratios."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from elastic_tpu_agent.workloads.attention import (
+    FlashConfig,
+    flash_attention,
+    reference_attention,
+)
+
+
+def _qkv(b=1, s=512, n=2, h=128, seed=0):
+    qs = jax.random.normal(jax.random.key(seed), (3, b, s, n, h), jnp.float32)
+    return qs[0], qs[1], qs[2]
+
+
+# windows chosen to hit: sub-block, exactly one block, non-multiple of
+# the block, and spanning several blocks
+@pytest.mark.parametrize("window", [32, 128, 200, 384])
+def test_windowed_forward_matches_oracle(window):
+    q, k, v = _qkv(seed=window)
+    cfg = FlashConfig(block_q=128, block_k=128, interpret=True, window=window)
+    got = flash_attention(q, k, v, cfg)
+    want = reference_attention(q, k, v, causal=True, window=window)
+    np.testing.assert_allclose(got, want, atol=2e-5)
+
+
+@pytest.mark.parametrize("window", [64, 200])
+def test_windowed_gradients_match_oracle(window):
+    q, k, v = _qkv(b=1, s=384, n=1, seed=window + 7)
+    cfg = FlashConfig(block_q=128, block_k=128, interpret=True, window=window)
+
+    def loss(attn):
+        return lambda q, k, v: jnp.sum(jnp.sin(attn(q, k, v)))
+
+    got = jax.grad(
+        loss(lambda q, k, v: flash_attention(q, k, v, cfg)),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    want = jax.grad(
+        loss(lambda q, k, v: reference_attention(
+            q, k, v, causal=True, window=window
+        )),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    for g, w in zip(got, want):
+        np.testing.assert_allclose(g, w, atol=5e-5)
+
+
+def test_window_larger_than_seq_equals_full_causal():
+    q, k, v = _qkv(s=256, seed=3)
+    cfg = FlashConfig(block_q=128, block_k=128, interpret=True, window=4096)
+    got = flash_attention(q, k, v, cfg)
+    want = reference_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(got, want, atol=2e-5)
+
+
+def test_window_requires_causal():
+    q, k, v = _qkv(s=256, seed=4)
+    cfg = FlashConfig(
+        causal=False, block_q=128, block_k=128, interpret=True, window=64
+    )
+    with pytest.raises(AssertionError, match="causal"):
+        flash_attention(q, k, v, cfg)
+
+
+def test_model_windowed_forward_and_decode_agree():
+    """ModelConfig.window: the training forward and the KV-cache decode
+    both honor the window and agree position-by-position."""
+    from elastic_tpu_agent.workloads.generate import (
+        KVCache,
+        _forward_chunk,
+        decode_logits_reference,
+    )
+    from elastic_tpu_agent.workloads.transformer import (
+        ModelConfig,
+        init_params,
+    )
+
+    base = dict(
+        vocab=97, d_model=32, n_heads=2, n_layers=2, d_ff=64, max_seq=64,
+        dtype=jnp.float32, attn="reference",
+    )
+    cfg = ModelConfig(**base, window=6)
+    full = ModelConfig(**base)
+    params = init_params(cfg, jax.random.key(0))
+    tokens = jax.random.randint(jax.random.key(1), (1, 24), 0, 97)
+
+    want = decode_logits_reference(params, tokens, cfg)
+    # windowing actually changes the result vs full attention
+    assert not np.allclose(
+        want, decode_logits_reference(params, tokens, full), atol=1e-3
+    )
+    cache = KVCache.empty(cfg, 1, 24)
+    logits, cache = _forward_chunk(params, tokens[:, :10], cache, cfg)
+    np.testing.assert_allclose(logits, want[:, :10], atol=1e-4, rtol=1e-4)
+    for t in range(10, 24):
+        step_logits, cache = _forward_chunk(
+            params, tokens[:, t:t + 1], cache, cfg
+        )
+        np.testing.assert_allclose(
+            step_logits[:, 0], want[:, t], atol=1e-4, rtol=1e-4
+        )
+
+
+def test_pipeline_honors_window():
+    """The pipelined stages apply the same window as the unpipelined
+    model (review r4: pipeline silently ignored it)."""
+    from elastic_tpu_agent.workloads.pipeline import make_pipeline_mesh
+    from elastic_tpu_agent.workloads.transformer import ModelConfig
+    from elastic_tpu_agent.workloads.transformer_pipeline import (
+        _embed_fn,
+        _head_loss,
+        _stage_fn,
+        init_pipeline_params,
+        make_pipeline_transformer_step,
+    )
+
+    cfg = ModelConfig(
+        vocab=97, d_model=32, n_heads=2, n_layers=2, d_ff=64, max_seq=32,
+        dtype=jnp.float32, window=5,
+    )
+    pp = 2
+    params = init_pipeline_params(cfg, jax.random.key(0), pp)
+    tokens = jax.random.randint(jax.random.key(1), (2, 2, 17), 0, 97)
+    mesh = make_pipeline_mesh(pp=pp, dp=2)
+    step, init_all = make_pipeline_transformer_step(
+        cfg, mesh, n_micro=2, schedule="gpipe"
+    )
+    _, opt0 = init_all(jax.random.key(0))
+    _, _, loss_w = step(jax.tree.map(jnp.copy, params), opt0, tokens)
+
+    # oracle: unpipelined stages with the SAME window
+    xs = _embed_fn(params, tokens[:, :, :-1], cfg)
+    head = {
+        "final_norm_scale": params["final_norm_scale"],
+        "lm_head": params["lm_head"],
+    }
+
+    def per_micro(x, tgt):
+        for p in range(pp):
+            sp = jax.tree.map(lambda a: a[p], params["stages"])
+            x = _stage_fn(sp, x, cfg)
+        return _head_loss(x, head, tgt, cfg)
+
+    want = float(jnp.mean(jax.vmap(per_micro)(xs, tokens[:, :, 1:])))
+    np.testing.assert_allclose(float(loss_w), want, rtol=1e-5)
+    # and the window changes the loss vs full attention
+    full_cfg = ModelConfig(
+        vocab=97, d_model=32, n_heads=2, n_layers=2, d_ff=64, max_seq=32,
+        dtype=jnp.float32,
+    )
+    step_f, init_f = make_pipeline_transformer_step(
+        full_cfg, mesh, n_micro=2, schedule="gpipe"
+    )
+    _, opt0f = init_f(jax.random.key(0))
+    _, _, loss_full = step_f(jax.tree.map(jnp.copy, params), opt0f, tokens)
+    assert abs(float(loss_full) - float(loss_w)) > 1e-6
+
+
+def test_model_ring_with_window_rejected():
+    from elastic_tpu_agent.workloads.transformer import (
+        ModelConfig,
+        make_mesh,
+        make_train_step,
+    )
+
+    cfg = ModelConfig(
+        vocab=128, d_model=64, n_heads=4, n_layers=1, d_ff=128, max_seq=64,
+        window=16, dtype=jnp.float32,
+    )
+    mesh = make_mesh(8, dp=2, sp=2, tp=2)
+    step, init_all, _ = make_train_step(cfg, mesh)
+    params, opt = init_all(jax.random.key(0))
+    tokens = jax.random.randint(jax.random.key(1), (4, 33), 0, 128)
+    with pytest.raises(ValueError, match="sliding-window"):
+        step(params, opt, tokens)
+
+
+def test_unaligned_fallback_respects_window():
+    # head_dim 64 fails the lane gate -> reference path must still window
+    q, k, v = _qkv(s=192, h=64, seed=5)
+    cfg = FlashConfig(block_q=128, block_k=128, interpret=True, window=50)
+    got = flash_attention(q, k, v, cfg)
+    want = reference_attention(q, k, v, causal=True, window=50)
+    np.testing.assert_allclose(got, want, atol=2e-5)
