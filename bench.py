@@ -210,13 +210,16 @@ def tpu_measure_once():
         init_params,
     )
 
-    # n_heads=8 → head_dim=128: fills the MXU lane width and meets the
-    # Pallas flash-attention tile gate (attention.supports_flash), which
-    # the "auto" dispatch then engages on TPU with adaptive 512-blocks
-    # (attention.auto_flash_config). Measured on v5e-1 at this config:
-    # flash/512 143.8 TFLOP/s vs flash/256 129.8 vs materialized 108.1.
+    # head_dim=128 fills the MXU lane width and meets the Pallas
+    # flash-attention tile gate (attention.supports_flash), which the
+    # "auto" dispatch then engages on TPU with adaptive 512-blocks
+    # (attention.auto_flash_config). Config chosen by a measured sweep
+    # on v5e-1 (docs/perf.md): d_model 2048 @ batch 8 → 150.4 TFLOP/s
+    # (76.3% MFU) vs d_model 1024 @ batch 16 → 146.6 (74.4%); batch 16
+    # at d_model 2048 REGRESSES to 141.4 (71.8%, HBM pressure), and 16
+    # layers OOM (16.07G > 15.75G HBM with f32 masters + adam state).
     cfg = ModelConfig(
-        vocab=32768, d_model=1024, n_heads=8, n_layers=8, d_ff=4096,
+        vocab=32768, d_model=2048, n_heads=16, n_layers=8, d_ff=8192,
         max_seq=1024,
     )
     optimizer = optax.adamw(1e-3)
@@ -253,9 +256,7 @@ def tpu_measure_once():
 
     params = init_params(cfg, jax.random.key(0))
     opt_state = optimizer.init(params)
-    # batch 16 maximizes measured util (flash attention removed the
-    # s×s score materialization that used to OOM above batch 8).
-    batch, seq = 16, 1024
+    batch, seq = 8, 1024
     tokens = jax.random.randint(
         jax.random.key(1), (batch, seq + 1), 0, cfg.vocab
     )
@@ -303,27 +304,43 @@ def tpu_measure_once():
 
 def tpu_decode_measure(params, cfg, batch=8, prompt_len=128, new_tokens=128):
     """KV-cache decode throughput on the trained params (the inference
-    half of the workload stack; workloads/generate.py)."""
+    half of the workload stack; workloads/generate.py), in both weight
+    forms: bf16-from-f32 and int8 weight-only (workloads/quantize.py).
+    Decode is HBM-bound — int8 halves the per-token weight read."""
     import jax
 
     from elastic_tpu_agent.workloads.generate import generate
+    from elastic_tpu_agent.workloads.quantize import quantize_params
 
     prompt = jax.random.randint(
         jax.random.key(3), (batch, prompt_len), 0, cfg.vocab
     )
-    out = generate(params, prompt, cfg, max_new_tokens=new_tokens)
-    jax.block_until_ready(out)  # compile + warmup
-    t0 = time.perf_counter()
-    out = generate(params, prompt, cfg, max_new_tokens=new_tokens)
-    jax.block_until_ready(out)
-    dt = time.perf_counter() - t0
-    return {
+
+    def measure(p):
+        out = generate(p, prompt, cfg, max_new_tokens=new_tokens)
+        jax.block_until_ready(out)  # compile + warmup
+        t0 = time.perf_counter()
+        out = generate(p, prompt, cfg, max_new_tokens=new_tokens)
+        jax.block_until_ready(out)
+        return time.perf_counter() - t0
+
+    dt = measure(params)
+    result = {
         "batch": batch,
         "prompt_len": prompt_len,
         "new_tokens": new_tokens,
         "decode_tokens_per_s": batch * new_tokens / dt,
         "ms_per_token": dt / new_tokens * 1000,
     }
+    try:
+        qparams = jax.jit(quantize_params)(params)
+        jax.block_until_ready(qparams)
+        dq = measure(qparams)
+        result["int8_decode_tokens_per_s"] = batch * new_tokens / dq
+        result["int8_speedup"] = dt / dq
+    except Exception as e:  # noqa: BLE001 - int8 is a bonus metric
+        result["int8_error"] = f"{type(e).__name__}: {e}"
+    return result
 
 
 # Retry policy for the TPU measurement: a transient runtime/tunnel

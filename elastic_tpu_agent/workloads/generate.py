@@ -31,6 +31,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .attention import NEG_INF
+from .quantize import embed_lookup, wdense
 from .transformer import ModelConfig, _rmsnorm, rope
 
 
@@ -60,13 +61,13 @@ class KVCache(NamedTuple):
 def _qkv(x: jax.Array, layer: Dict, cfg: ModelConfig):
     """Projections for a chunk x [b, t, d] -> q [b,t,n,h], k/v [b,t,g,h]."""
     if "wq" in layer:  # GQA
-        q = jnp.einsum("btd,dnh->btnh", x, layer["wq"].astype(cfg.dtype))
+        q = jnp.einsum("btd,dnh->btnh", x, wdense(layer, "wq", cfg.dtype))
         kv = jnp.einsum(
-            "btd,dcgh->bctgh", x, layer["wkv"].astype(cfg.dtype)
+            "btd,dcgh->bctgh", x, wdense(layer, "wkv", cfg.dtype)
         )
         return q, kv[:, 0], kv[:, 1]
     qkv = jnp.einsum(
-        "btd,dcnh->bctnh", x, layer["wqkv"].astype(cfg.dtype)
+        "btd,dcnh->bctnh", x, wdense(layer, "wqkv", cfg.dtype)
     )
     return qkv[:, 0], qkv[:, 1], qkv[:, 2]
 
@@ -114,7 +115,7 @@ def _forward_chunk(
     (logits [b, t, vocab], updated cache)."""
     b, t = tokens.shape
     pos = cache.length
-    x = params["embed"].astype(cfg.dtype)[tokens]
+    x = embed_lookup(params, tokens, cfg.dtype)
     positions = pos + jnp.arange(t)
     if cfg.pos == "learned":
         x = x + params["pos_embed"].astype(cfg.dtype)[positions][None]
@@ -138,28 +139,53 @@ def _forward_chunk(
         new_v = new_v.at[i].set(lv)
         attn = _cached_attention(q, lk, lv, pos, cfg)
         x = x + jnp.einsum(
-            "btnh,nhd->btd", attn, layer["wo"].astype(cfg.dtype)
+            "btnh,nhd->btd", attn, wdense(layer, "wo", cfg.dtype)
         )
         h2 = _rmsnorm(x, layer["ln2_scale"])
         h2 = jax.nn.gelu(
-            jnp.einsum("btd,df->btf", h2, layer["w1"].astype(cfg.dtype))
+            jnp.einsum("btd,df->btf", h2, wdense(layer, "w1", cfg.dtype))
         )
-        x = x + jnp.einsum("btf,fd->btd", h2, layer["w2"].astype(cfg.dtype))
+        x = x + jnp.einsum("btf,fd->btd", h2, wdense(layer, "w2", cfg.dtype))
     x = _rmsnorm(x, params["final_norm_scale"])
     logits = jnp.einsum(
-        "btd,dv->btv", x, params["lm_head"].astype(cfg.dtype)
+        "btd,dv->btv", x, wdense(params, "lm_head", cfg.dtype)
     ).astype(jnp.float32)
     return logits, KVCache(k=new_k, v=new_v, length=pos + t)
 
 
-def _sample(logits, key, temperature: float, top_k: int):
-    """logits [b, vocab] -> token ids [b]."""
+def _sample(logits, key, temperature: float, top_k: int, top_p: float):
+    """logits [b, vocab] -> token ids [b].
+
+    top-k and nucleus top-p share ONE full-vocab sort (this runs inside
+    the decode scan body, so the sort is per generated token): both
+    filters reduce to a per-row cutoff value in the descending order,
+    and the final mask is a single compare against the raw logits."""
     if temperature == 0.0:
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
     logits = logits / temperature
-    if top_k > 0:
-        kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
-        logits = jnp.where(logits >= kth, logits, NEG_INF)
+    if top_k > 0 or 0.0 < top_p < 1.0:
+        ranked = jnp.sort(logits, axis=-1)[:, ::-1]
+        if top_k > 0:
+            # rank-space mask: positions past top_k drop out of the
+            # nucleus distribution below (softmax gives them 0 mass)
+            pos = jnp.arange(ranked.shape[-1])
+            ranked = jnp.where(pos[None] < top_k, ranked, NEG_INF)
+        if 0.0 < top_p < 1.0:
+            # keep the smallest prefix of the descending order whose
+            # mass reaches top_p: a position stays while the mass
+            # strictly BEFORE it is short of top_p (so the first token
+            # is always kept). keep_count <= top_k when both are on —
+            # masked positions carry ~full prefix mass — so the cutoff
+            # is always a real logit value.
+            probs = jax.nn.softmax(ranked, axis=-1)
+            before = jnp.cumsum(probs, axis=-1) - probs
+            keep_count = jnp.sum(before < top_p, axis=-1)  # [b], >= 1
+            cutoff = jnp.take_along_axis(
+                ranked, keep_count[:, None] - 1, axis=-1
+            )
+        else:
+            cutoff = ranked[:, top_k - 1][:, None]
+        logits = jnp.where(logits >= cutoff, logits, NEG_INF)
     return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
 
 
@@ -170,14 +196,17 @@ def generate(
     max_new_tokens: int,
     temperature: float = 0.0,
     top_k: int = 0,
+    top_p: float = 0.0,
     key: Optional[jax.Array] = None,
     max_len: Optional[int] = None,
 ) -> jax.Array:
     """Generate continuations. prompt [b, p] -> [b, p + max_new_tokens].
 
     Greedy when temperature == 0 (default), else temperature sampling
-    with optional top-k. Compiles to prefill + ONE scan; all shapes
-    static. MoE models are not supported (dense decode only).
+    with optional top-k and/or nucleus top-p truncation. Compiles to
+    prefill + ONE scan; all shapes static. Accepts float params or the
+    int8 weight-only form from quantize.quantize_params. MoE models are
+    not supported (dense decode only).
     """
     assert cfg.moe_experts == 0, "MoE decode not supported"
     b, p = prompt.shape
@@ -194,14 +223,16 @@ def generate(
 
     if max_new_tokens == 0:
         return prompt
-    run = _build_run(cfg, b, max_new_tokens, temperature, top_k, max_len)
+    run = _build_run(
+        cfg, b, max_new_tokens, temperature, top_k, top_p, max_len
+    )
     return run(params, prompt, key)
 
 
 @functools.lru_cache(maxsize=64)
 def _build_run(
     cfg: ModelConfig, b: int, max_new_tokens: int,
-    temperature: float, top_k: int, max_len: int,
+    temperature: float, top_k: int, top_p: float, max_len: int,
 ):
     """Cached jitted decode program per (config, shape, sampling) key —
     a fresh closure per generate() call would retrace and recompile the
@@ -211,7 +242,7 @@ def _build_run(
     def run(params, prompt, key):
         cache = KVCache.empty(cfg, b, max_len)
         logits, cache = _forward_chunk(params, prompt, cache, cfg)
-        first = _sample(logits[:, -1], key, temperature, top_k)
+        first = _sample(logits[:, -1], key, temperature, top_k, top_p)
 
         def step(carry, _):
             cache, tok, key = carry
@@ -219,7 +250,7 @@ def _build_run(
             logits, cache = _forward_chunk(
                 params, tok[:, None], cache, cfg
             )
-            nxt = _sample(logits[:, -1], sub, temperature, top_k)
+            nxt = _sample(logits[:, -1], sub, temperature, top_k, top_p)
             # yield the step's INPUT token: over N steps that emits
             # generated tokens 1..N exactly (the final sample is the
             # N+1-th, beyond the requested budget)

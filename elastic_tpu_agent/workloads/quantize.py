@@ -1,0 +1,147 @@
+"""Weight-only int8 quantization for the decode path.
+
+Decode throughput on TPU is bound by HBM bandwidth: every generated
+token re-reads the full parameter set, so bytes-per-weight is the
+denominator of tokens/s. Storing the big matmul weights as int8 with a
+per-output-channel f32 scale halves the read traffic vs bfloat16 while
+keeping the matmul itself in bf16 on the MXU. The dequant is
+weight-side (`q -> f32 * scale -> bf16` feeding the einsum); the
+int8-sized HBM read relies on XLA fusing that convert+multiply into
+the matmul's operand pipeline rather than materializing the
+dequantized weight — the standard XLA weight-only pattern.
+
+Design:
+- Symmetric per-channel quantization (no zero point), scale on the
+  OUTPUT feature axis of each matmul (the finest granularity that
+  keeps one scale per accumulator column).
+- Quantized params mirror the float pytree exactly, with each selected
+  weight leaf replaced by ``{"q": int8, "s": f32}``; every other leaf
+  (norm scales, embeddings' position table) passes through untouched.
+  ``wdense`` resolves either form, so forward code handles both pytrees
+  with one accessor.
+- The token embedding table is quantized per-row (vocab axis): a gather
+  of int8 rows + scale is exact the same way.
+
+No reference counterpart: the reference agent
+(/root/reference/pkg/...) has no model/inference code; this is part of
+the TPU-side workload stack (SURVEY.md §5.7's long-context/workload
+enabler family).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+
+# Leaf names eligible for quantization, with the axis index (or indices)
+# of the OUTPUT features in that weight's einsum. Everything else (norm
+# scales, pos_embed) stays float.
+#   wqkv [d, 3, n, h] -> out axes (1, 2, 3)
+#   wq   [d, n, h]    -> out axes (1, 2)
+#   wkv  [d, 2, g, h] -> out axes (1, 2, 3)
+#   wo   [n, h, d]    -> out axis 2
+#   w1   [d, f]       -> out axis 1
+#   w2   [f, d]       -> out axis 1
+#   lm_head [d, v]    -> out axis 1
+#   embed [v, d]      -> per-row (axis 0 is the gather axis)
+_OUT_AXES = {
+    "wqkv": (1, 2, 3),
+    "wq": (1, 2),
+    "wkv": (1, 2, 3),
+    "wo": (2,),
+    "w1": (1,),
+    "w2": (1,),
+    "lm_head": (1,),
+    "embed": (0,),
+    # MoE expert stacks: [e, d, f] / [e, f, d] -> per (expert, out-col)
+    "moe_w1": (0, 2),
+    "moe_w2": (0, 2),
+    "router": (1,),
+}
+
+
+def quantize_weight(w: jax.Array, out_axes) -> Dict[str, jax.Array]:
+    """Symmetric int8 over the non-out axes; scale shaped to out axes."""
+    w = w.astype(jnp.float32)
+    reduce_axes = tuple(
+        a for a in range(w.ndim) if a not in out_axes
+    )
+    absmax = jnp.max(jnp.abs(w), axis=reduce_axes, keepdims=True)
+    scale = jnp.maximum(absmax, 1e-8) / 127.0
+    q = jnp.clip(jnp.round(w / scale), -127, 127).astype(jnp.int8)
+    return {"q": q, "s": scale.astype(jnp.float32)}
+
+
+def dequantize_weight(qw: Dict[str, jax.Array], dtype=jnp.bfloat16):
+    """int8 + scale -> dtype. The convert+multiply fuses into the
+    consuming einsum under jit; the HBM read stays int8-sized."""
+    return (qw["q"].astype(jnp.float32) * qw["s"]).astype(dtype)
+
+
+def is_quantized(leaf: Any) -> bool:
+    return isinstance(leaf, dict) and set(leaf) == {"q", "s"}
+
+
+def wdense(container: Dict, name: str, dtype=jnp.bfloat16) -> jax.Array:
+    """Resolve a weight from either a float or quantized params tree."""
+    leaf = container[name]
+    if is_quantized(leaf):
+        return dequantize_weight(leaf, dtype)
+    return leaf.astype(dtype)
+
+
+def embed_lookup(params: Dict, tokens: jax.Array, dtype=jnp.bfloat16):
+    """Token-embedding gather for either params form. Quantized: gather
+    the int8 rows and their per-row scales, multiply after the gather —
+    HBM reads stay int8-sized and the result is exact per-row dequant."""
+    leaf = params["embed"]
+    if is_quantized(leaf):
+        rows = leaf["q"][tokens].astype(jnp.float32)
+        scales = leaf["s"][tokens]  # [..., 1] keepdims broadcast
+        return (rows * scales).astype(dtype)
+    return leaf.astype(dtype)[tokens]
+
+
+def quantize_params(params: Dict) -> Dict:
+    """Quantize every eligible leaf of a transformer params tree
+    (init_params shape, transformer.py). Returns a new tree; the input
+    is not modified."""
+
+    def qleaf(name: str, leaf):
+        axes = _OUT_AXES.get(name)
+        if axes is None or not hasattr(leaf, "ndim"):
+            return leaf
+        return quantize_weight(leaf, axes)
+
+    out: Dict[str, Any] = {}
+    for name, leaf in params.items():
+        if name == "layers":
+            out["layers"] = [
+                {k: qleaf(k, v) for k, v in layer.items()}
+                for layer in leaf
+            ]
+        else:
+            out[name] = qleaf(name, leaf)
+    return out
+
+
+def dequantize_params(qparams: Dict, dtype=jnp.float32) -> Dict:
+    """Inverse of quantize_params for any tree shape: every quantized
+    leaf back to dtype, everything else passed through."""
+    return jax.tree_util.tree_map(
+        lambda leaf: (
+            dequantize_weight(leaf, dtype) if is_quantized(leaf) else leaf
+        ),
+        qparams,
+        is_leaf=is_quantized,
+    )
+
+
+def quantized_bytes(params: Dict) -> int:
+    """Total parameter bytes as stored (int8 leaves count 1B + scales)."""
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(params):
+        total += leaf.size * leaf.dtype.itemsize
+    return total

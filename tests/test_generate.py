@@ -133,3 +133,47 @@ def test_rope_generates_past_max_seq():
                    max_new_tokens=24)  # total 32 > max_seq 16
     assert out.shape == (1, 32)
     assert int(out.max()) < cfg.vocab
+
+
+def test_top_p_truncates_to_nucleus():
+    """With a distribution whose top token holds > top_p mass, nucleus
+    sampling must always return that token (nucleus size 1), for every
+    draw — even at high temperature."""
+    from elastic_tpu_agent.workloads.generate import _sample
+
+    logits = jnp.array([
+        [10.0, 0.0, -1.0, -2.0],   # token 0 dominates (>0.99 mass)
+        [0.0, 10.0, -1.0, -2.0],   # token 1 dominates
+    ], jnp.float32)
+    for seed in range(8):
+        got = _sample(
+            logits, jax.random.key(seed),
+            temperature=1.0, top_k=0, top_p=0.5,
+        )
+        np.testing.assert_array_equal(np.asarray(got), [0, 1])
+
+
+def test_top_p_keeps_first_token_even_when_tiny():
+    """top_p smaller than the largest probability still keeps exactly
+    the argmax (the first nucleus token is unconditionally kept)."""
+    from elastic_tpu_agent.workloads.generate import _sample
+
+    logits = -jnp.arange(8, dtype=jnp.float32)[None]  # strictly decreasing
+    for seed in range(4):
+        got = _sample(
+            logits, jax.random.key(seed),
+            temperature=1.0, top_k=0, top_p=1e-6,
+        )
+        np.testing.assert_array_equal(np.asarray(got), [0])
+
+
+def test_top_p_generation_runs():
+    cfg = ModelConfig(**BASE)
+    params = init_params(cfg, jax.random.key(0))
+    prompt = jnp.zeros((2, 4), jnp.int32)
+    out = generate(
+        params, prompt, cfg, max_new_tokens=6, temperature=0.9,
+        top_k=0, top_p=0.9, key=jax.random.key(5),
+    )
+    assert out.shape == (2, 10)
+    assert int(out.max()) < cfg.vocab and int(out.min()) >= 0

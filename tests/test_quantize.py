@@ -1,0 +1,145 @@
+"""Weight-only int8 quantization (workloads/quantize.py): exact error
+bounds, pytree mirroring, byte accounting, and decode equivalence —
+quantized cached decode must match the full-forward oracle run on the
+dequantized weights (the quantization error itself is bounded by the
+per-channel scale, not a decode artifact)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from elastic_tpu_agent.workloads.generate import (
+    KVCache,
+    _forward_chunk,
+    decode_logits_reference,
+    generate,
+)
+from elastic_tpu_agent.workloads.quantize import (
+    dequantize_params,
+    dequantize_weight,
+    embed_lookup,
+    is_quantized,
+    quantize_params,
+    quantize_weight,
+    quantized_bytes,
+    wdense,
+)
+from elastic_tpu_agent.workloads.transformer import (
+    ModelConfig,
+    init_params,
+)
+
+BASE = dict(
+    vocab=97, d_model=32, n_heads=4, n_layers=2, d_ff=64, max_seq=64,
+    dtype=jnp.float32, attn="reference",
+)
+
+
+def test_roundtrip_error_bounded_by_half_scale():
+    """Symmetric rounding guarantees |w - dq(q(w))| <= scale/2 per
+    element (scale is per output channel)."""
+    w = jax.random.normal(jax.random.key(0), (64, 48), jnp.float32)
+    qw = quantize_weight(w, out_axes=(1,))
+    assert qw["q"].dtype == jnp.int8
+    assert qw["s"].shape == (1, 48)
+    back = dequantize_weight(qw, jnp.float32)
+    err = np.abs(np.asarray(w) - np.asarray(back))
+    bound = np.asarray(qw["s"]) / 2 + 1e-7
+    assert (err <= bound).all()
+
+
+def test_extreme_values_clip_not_overflow():
+    w = jnp.array([[3e4, -3e4, 0.0, 1e-12]], jnp.float32).T
+    qw = quantize_weight(w, out_axes=(1,))
+    assert int(np.abs(np.asarray(qw["q"])).max()) <= 127
+    back = dequantize_weight(qw, jnp.float32)
+    np.testing.assert_allclose(
+        np.asarray(back)[:2, 0], [3e4, -3e4], rtol=1e-2
+    )
+
+
+def test_wdense_passthrough_and_dequant():
+    w = jax.random.normal(jax.random.key(1), (8, 8), jnp.float32)
+    container = {"w1": w}
+    out = wdense(container, "w1", jnp.float32)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(w))
+    qc = {"w1": quantize_weight(w, (1,))}
+    dq = wdense(qc, "w1", jnp.float32)
+    np.testing.assert_allclose(
+        np.asarray(dq), np.asarray(dequantize_weight(qc["w1"], jnp.float32))
+    )
+
+
+def test_embed_lookup_matches_full_table_dequant():
+    table = jax.random.normal(jax.random.key(2), (31, 16), jnp.float32)
+    qp = {"embed": quantize_weight(table, (0,))}
+    toks = jnp.array([[0, 5, 30], [7, 7, 1]])
+    got = embed_lookup(qp, toks, jnp.float32)
+    full = dequantize_weight(qp["embed"], jnp.float32)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(full[toks]))
+
+
+def test_quantize_params_mirrors_tree_and_shrinks():
+    # rope: no learned position table (an unquantized f32 leaf that
+    # would dominate byte accounting at toy scale)
+    cfg = ModelConfig(**BASE, pos="rope")
+    params = init_params(cfg, jax.random.key(0))
+    qparams = quantize_params(params)
+    # structure mirrors: same top-level keys, same per-layer keys
+    assert set(qparams) == set(params)
+    assert set(qparams["layers"][0]) == set(params["layers"][0])
+    # norm scales untouched, big weights quantized
+    assert not is_quantized(qparams["layers"][0]["ln1_scale"])
+    assert is_quantized(qparams["layers"][0]["wqkv"])
+    assert is_quantized(qparams["embed"])
+    assert is_quantized(qparams["lm_head"])
+    f32_bytes = sum(
+        p.size * p.dtype.itemsize
+        for p in jax.tree_util.tree_leaves(params)
+    )
+    q_bytes = quantized_bytes(qparams)
+    # int8 + scales vs f32: better than 3x smaller end to end
+    assert q_bytes * 3 < f32_bytes
+
+
+@pytest.mark.parametrize(
+    "kv_heads,pos",
+    [(0, "learned"), (2, "rope")],
+    ids=["mha-learned", "gqa-rope"],
+)
+def test_quantized_decode_matches_dequantized_forward(kv_heads, pos):
+    """The quantized cached-decode path equals the full-recompute oracle
+    run on the DEQUANTIZED weights: cache mechanics introduce no error
+    beyond quantization itself."""
+    cfg = ModelConfig(**BASE, n_kv_heads=kv_heads, pos=pos)
+    params = init_params(cfg, jax.random.key(0))
+    qparams = quantize_params(params)
+    deq = dequantize_params(qparams, jnp.float32)
+    tokens = jax.random.randint(jax.random.key(1), (2, 10), 0, cfg.vocab)
+    want = decode_logits_reference(deq, tokens, cfg)
+
+    cache = KVCache.empty(cfg, 2, 10)
+    logits, cache = _forward_chunk(qparams, tokens[:, :4], cache, cfg)
+    np.testing.assert_allclose(logits, want[:, :4], atol=2e-4, rtol=2e-4)
+    for t in range(4, 10):
+        step_logits, cache = _forward_chunk(
+            qparams, tokens[:, t:t + 1], cache, cfg
+        )
+        np.testing.assert_allclose(
+            step_logits[:, 0], want[:, t], atol=2e-4, rtol=2e-4,
+        )
+
+
+def test_generate_accepts_quantized_params():
+    cfg = ModelConfig(**BASE)
+    params = init_params(cfg, jax.random.key(0))
+    qparams = quantize_params(params)
+    prompt = jax.random.randint(jax.random.key(3), (2, 5), 0, cfg.vocab)
+    out = generate(qparams, prompt, cfg, max_new_tokens=6)
+    assert out.shape == (2, 11)
+    np.testing.assert_array_equal(np.asarray(out[:, :5]),
+                                  np.asarray(prompt))
+    # deterministic: same call returns the same tokens
+    out2 = generate(qparams, prompt, cfg, max_new_tokens=6)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(out2))
