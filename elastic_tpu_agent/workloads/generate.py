@@ -142,10 +142,34 @@ def _forward_chunk(
             "btnh,nhd->btd", attn, wdense(layer, "wo", cfg.dtype)
         )
         h2 = _rmsnorm(x, layer["ln2_scale"])
-        h2 = jax.nn.gelu(
-            jnp.einsum("btd,df->btf", h2, wdense(layer, "w1", cfg.dtype))
-        )
-        x = x + jnp.einsum("btf,fd->btd", h2, wdense(layer, "w2", cfg.dtype))
+        if "moe" in layer:
+            from .moe import moe_mlp
+
+            # Capacity policy (t is static at trace time):
+            # - prefill (t > 1): the TRAINING capacity factor — exactly
+            #   transformer.forward's semantics, drops included, so
+            #   prefill logits match the full forward for any config,
+            #   and dispatch stays [T, E, C] with C = T*factor/E (the
+            #   drop-free cap == T would make it quadratic in prompt
+            #   tokens).
+            # - decode (t == 1): drop-free (cap == T == batch). A drop
+            #   here would silently skip a generated token's MLP; the
+            #   [b, E, b] dispatch is tiny.
+            factor = (
+                float(cfg.moe_experts) if t == 1
+                else cfg.moe_capacity_factor
+            )
+            y, _ = moe_mlp(h2, layer["moe"], factor, mesh=None)
+            x = x + y
+        else:
+            h2 = jax.nn.gelu(
+                jnp.einsum(
+                    "btd,df->btf", h2, wdense(layer, "w1", cfg.dtype)
+                )
+            )
+            x = x + jnp.einsum(
+                "btf,fd->btd", h2, wdense(layer, "w2", cfg.dtype)
+            )
     x = _rmsnorm(x, params["final_norm_scale"])
     logits = jnp.einsum(
         "btd,dv->btv", x, wdense(params, "lm_head", cfg.dtype)
@@ -205,10 +229,14 @@ def generate(
     Greedy when temperature == 0 (default), else temperature sampling
     with optional top-k and/or nucleus top-p truncation. Compiles to
     prefill + ONE scan; all shapes static. Accepts float params or the
-    int8 weight-only form from quantize.quantize_params. MoE models are
-    not supported (dense decode only).
+    int8 weight-only form from quantize.quantize_params. MoE: prefill
+    applies the training capacity policy (drops included — identical
+    to transformer.forward on the same tokens); per-token decode steps
+    are drop-free, which can IMPROVE on a capacity-dropped full
+    forward — exact decode-vs-forward equivalence therefore holds only
+    for configs whose capacity never drops (capacity_factor >=
+    n_experts).
     """
-    assert cfg.moe_experts == 0, "MoE decode not supported"
     b, p = prompt.shape
     total = p + max_new_tokens
     max_len = max_len or total

@@ -29,6 +29,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from .quantize import wdense
+
 
 def init_moe_params(
     key: jax.Array, d_model: int, d_ff: int, n_experts: int
@@ -117,9 +119,9 @@ def moe_mlp(
         xin = jax.lax.with_sharding_constraint(
             xin, NamedSharding(mesh, P("ep", None, None))
         )
-    h = jnp.einsum("ecd,edf->ecf", xin, params["w1"].astype(dtype))
+    h = jnp.einsum("ecd,edf->ecf", xin, wdense(params, "w1", dtype))
     h = jax.nn.gelu(h)
-    out = jnp.einsum("ecf,efd->ecd", h, params["w2"].astype(dtype))
+    out = jnp.einsum("ecf,efd->ecd", h, wdense(params, "w2", dtype))
     if mesh is not None:
         out = jax.lax.with_sharding_constraint(
             out, NamedSharding(mesh, P("ep", None, None))

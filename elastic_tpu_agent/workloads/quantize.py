@@ -55,10 +55,17 @@ _OUT_AXES = {
     "w2": (1,),
     "lm_head": (1,),
     "embed": (0,),
-    # MoE expert stacks: [e, d, f] / [e, f, d] -> per (expert, out-col)
-    "moe_w1": (0, 2),
-    "moe_w2": (0, 2),
-    "router": (1,),
+}
+
+# The MoE subtree (layer["moe"], moe.init_moe_params) nests under its
+# own key with 3-D expert stacks. The router ``wg`` stays float: its
+# argmax decides expert assignment, and quantization noise there flips
+# routing decisions rather than perturbing activations smoothly.
+#   w1 [E, d, f] -> per (expert, out-col)
+#   w2 [E, f, d] -> per (expert, out-col)
+_MOE_OUT_AXES = {
+    "w1": (0, 2),
+    "w2": (0, 2),
 }
 
 
@@ -109,21 +116,27 @@ def quantize_params(params: Dict) -> Dict:
     (init_params shape, transformer.py). Returns a new tree; the input
     is not modified."""
 
-    def qleaf(name: str, leaf):
-        axes = _OUT_AXES.get(name)
+    def qleaf(name: str, leaf, axes_table):
+        axes = axes_table.get(name)
         if axes is None or not hasattr(leaf, "ndim"):
             return leaf
         return quantize_weight(leaf, axes)
 
+    def qlayer(layer: Dict) -> Dict:
+        out = {k: qleaf(k, v, _OUT_AXES) for k, v in layer.items()}
+        if "moe" in layer:
+            out["moe"] = {
+                k: qleaf(k, v, _MOE_OUT_AXES)
+                for k, v in layer["moe"].items()
+            }
+        return out
+
     out: Dict[str, Any] = {}
     for name, leaf in params.items():
         if name == "layers":
-            out["layers"] = [
-                {k: qleaf(k, v) for k, v in layer.items()}
-                for layer in leaf
-            ]
+            out["layers"] = [qlayer(layer) for layer in leaf]
         else:
-            out[name] = qleaf(name, leaf)
+            out[name] = qleaf(name, leaf, _OUT_AXES)
     return out
 
 

@@ -177,3 +177,58 @@ def test_top_p_generation_runs():
     )
     assert out.shape == (2, 10)
     assert int(out.max()) < cfg.vocab and int(out.min()) >= 0
+
+
+def test_moe_decode_matches_full_forward():
+    """MoE layers decode drop-free; with the oracle's capacity also
+    drop-free (capacity_factor == n_experts), cached decode equals the
+    full recompute exactly as in the dense case."""
+    cfg = ModelConfig(
+        **BASE, pos="rope", moe_experts=2, moe_every=2,
+        moe_capacity_factor=2.0,
+    )
+    assert cfg.is_moe_layer(1)
+    params = init_params(cfg, jax.random.key(0))
+    tokens = jax.random.randint(jax.random.key(1), (2, 10), 0, cfg.vocab)
+    want = decode_logits_reference(params, tokens, cfg)
+
+    cache = KVCache.empty(cfg, 2, 10)
+    logits, cache = _forward_chunk(params, tokens[:, :4], cache, cfg)
+    np.testing.assert_allclose(logits, want[:, :4], atol=1e-4, rtol=1e-4)
+    for t in range(4, 10):
+        step_logits, cache = _forward_chunk(
+            params, tokens[:, t:t + 1], cache, cfg
+        )
+        np.testing.assert_allclose(
+            step_logits[:, 0], want[:, t], atol=1e-4, rtol=1e-4,
+        )
+
+
+def test_moe_generate_runs_greedy():
+    cfg = ModelConfig(
+        **BASE, pos="rope", moe_experts=2, moe_every=2,
+        moe_capacity_factor=2.0,
+    )
+    params = init_params(cfg, jax.random.key(0))
+    prompt = jax.random.randint(jax.random.key(2), (2, 5), 0, cfg.vocab)
+    out = generate(params, prompt, cfg, max_new_tokens=6)
+    assert out.shape == (2, 11)
+    np.testing.assert_array_equal(np.asarray(out[:, :5]), np.asarray(prompt))
+
+
+def test_moe_prefill_matches_forward_even_with_drops():
+    """Prefill uses the TRAINING capacity policy — identical to
+    transformer.forward on the same tokens, drops included — so prefill
+    logits match the oracle even at a tight capacity factor where
+    tokens ARE dropped. (Per-token decode steps are drop-free by design
+    and carry no such equivalence claim.)"""
+    cfg = ModelConfig(
+        **BASE, pos="rope", moe_experts=4, moe_every=2,
+        moe_capacity_factor=0.5,  # tight: drops are certain
+    )
+    params = init_params(cfg, jax.random.key(0))
+    tokens = jax.random.randint(jax.random.key(1), (2, 12), 0, cfg.vocab)
+    want = decode_logits_reference(params, tokens, cfg)
+    cache = KVCache.empty(cfg, 2, 12)
+    logits, cache = _forward_chunk(params, tokens, cache, cfg)
+    np.testing.assert_allclose(logits, want, atol=1e-4, rtol=1e-4)

@@ -143,3 +143,33 @@ def test_generate_accepts_quantized_params():
     # deterministic: same call returns the same tokens
     out2 = generate(qparams, prompt, cfg, max_new_tokens=6)
     np.testing.assert_array_equal(np.asarray(out), np.asarray(out2))
+
+
+def test_moe_subtree_quantized_and_decodes():
+    """quantize_params reaches the nested MoE subtree (w1/w2 int8, the
+    router wg stays float — quantization noise there would flip routing
+    decisions), and quantized MoE decode matches the dequantized-weight
+    oracle."""
+    cfg = ModelConfig(
+        **BASE, pos="rope", n_kv_heads=2, moe_experts=2, moe_every=2,
+        moe_capacity_factor=2.0,
+    )
+    params = init_params(cfg, jax.random.key(0))
+    qparams = quantize_params(params)
+    moe = qparams["layers"][1]["moe"]
+    assert is_quantized(moe["w1"]) and is_quantized(moe["w2"])
+    assert not is_quantized(moe["wg"])
+
+    deq = dequantize_params(qparams, jnp.float32)
+    tokens = jax.random.randint(jax.random.key(1), (2, 8), 0, cfg.vocab)
+    want = decode_logits_reference(deq, tokens, cfg)
+    cache = KVCache.empty(cfg, 2, 8)
+    logits, cache = _forward_chunk(qparams, tokens[:, :3], cache, cfg)
+    np.testing.assert_allclose(logits, want[:, :3], atol=2e-4, rtol=2e-4)
+    for t in range(3, 8):
+        step_logits, cache = _forward_chunk(
+            qparams, tokens[:, t:t + 1], cache, cfg
+        )
+        np.testing.assert_allclose(
+            step_logits[:, 0], want[:, t], atol=2e-4, rtol=2e-4,
+        )
