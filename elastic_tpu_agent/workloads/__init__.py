@@ -1,4 +1,7 @@
+from .generate import KVCache, generate
 from .moe import init_moe_params, moe_mlp, moe_param_shardings
+from .quantize import dequantize_params, quantize_params
+from .speculative import SpecStats, speculative_generate
 from .pipeline import (
     make_pipeline_mesh,
     make_pipeline_train_step,
@@ -15,10 +18,14 @@ from .transformer import (
 )
 
 __all__ = [
+    "KVCache",
     "ModelConfig",
+    "SpecStats",
     "TrainCheckpointer",
+    "dequantize_params",
     "forward",
     "forward_with_aux",
+    "generate",
     "init_moe_params",
     "init_params",
     "make_mesh",
@@ -29,6 +36,8 @@ __all__ = [
     "moe_param_shardings",
     "param_shardings",
     "pipeline_apply",
+    "quantize_params",
+    "speculative_generate",
 ]
 
 

@@ -109,10 +109,17 @@ def _cached_attention(
 
 
 def _forward_chunk(
-    params: Dict, tokens: jax.Array, cache: KVCache, cfg: ModelConfig
+    params: Dict, tokens: jax.Array, cache: KVCache, cfg: ModelConfig,
+    moe_drop_free: bool = False,
 ) -> Tuple[jax.Array, KVCache]:
     """Run a token chunk [b, t] at positions cache.length..+t; returns
-    (logits [b, t, vocab], updated cache)."""
+    (logits [b, t, vocab], updated cache).
+
+    moe_drop_free selects the MoE capacity policy explicitly (a chunk
+    being one token wide does NOT imply it's a decode step — a
+    single-token batched prompt is still prefill): False = the training
+    capacity factor, exactly transformer.forward's semantics; True =
+    cap == T, no token dropped."""
     b, t = tokens.shape
     pos = cache.length
     x = embed_lookup(params, tokens, cfg.dtype)
@@ -145,18 +152,18 @@ def _forward_chunk(
         if "moe" in layer:
             from .moe import moe_mlp
 
-            # Capacity policy (t is static at trace time):
-            # - prefill (t > 1): the TRAINING capacity factor — exactly
+            # Capacity policy (moe_drop_free is static at trace time):
+            # - prefill: the TRAINING capacity factor — exactly
             #   transformer.forward's semantics, drops included, so
             #   prefill logits match the full forward for any config,
             #   and dispatch stays [T, E, C] with C = T*factor/E (the
             #   drop-free cap == T would make it quadratic in prompt
             #   tokens).
-            # - decode (t == 1): drop-free (cap == T == batch). A drop
+            # - decode steps: drop-free (cap == T == batch). A drop
             #   here would silently skip a generated token's MLP; the
             #   [b, E, b] dispatch is tiny.
             factor = (
-                float(cfg.moe_experts) if t == 1
+                float(cfg.moe_experts) if moe_drop_free
                 else cfg.moe_capacity_factor
             )
             y, _ = moe_mlp(h2, layer["moe"], factor, mesh=None)
@@ -276,7 +283,7 @@ def _build_run(
             cache, tok, key = carry
             key, sub = jax.random.split(key)
             logits, cache = _forward_chunk(
-                params, tok[:, None], cache, cfg
+                params, tok[:, None], cache, cfg, moe_drop_free=True
             )
             nxt = _sample(logits[:, -1], sub, temperature, top_k, top_p)
             # yield the step's INPUT token: over N steps that emits

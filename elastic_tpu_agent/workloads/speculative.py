@@ -1,0 +1,276 @@
+"""Speculative decoding: a small draft model proposes, the target
+verifies — decode latency drops toward the draft's, output quality
+stays the target's.
+
+TPU-first shape discipline (everything under ONE jit):
+
+- The draft proposes ``gamma`` tokens with its own KV cache (a scan of
+  single-token steps); the target then scores all ``gamma + 1``
+  positions in ONE chunked forward — MXU-shaped verification instead
+  of gamma sequential target steps. That one-chunk-verify is the whole
+  speedup.
+- Acceptance length varies per round, so generation runs in a
+  ``lax.while_loop`` over STATIC-shape state: a padded output buffer
+  written with ``dynamic_update_slice`` at a traced cursor, and both
+  KV caches "rolled back" by resetting their length scalar only —
+  entries past the accepted point are stale but unreachable (attention
+  masks by position) and are overwritten by the next round's writes at
+  the same slots.
+- Greedy mode is EXACT: the emitted stream equals target-only greedy
+  decoding token for token (pinned by tests). Sampling mode implements
+  the Leviathan accept/reject rule: accept draft token i with
+  probability min(1, p_i/q_i), on first rejection resample from
+  ``normalize(max(p - q, 0))``, and when all gamma survive, sample the
+  bonus token from the target's last-position distribution — the
+  output distribution equals target-only sampling.
+
+Batch is 1 (asserted): per-row acceptance lengths would need per-row
+cache positions; the latency story speculative decoding exists for is
+the interactive single-stream case.
+
+No reference counterpart (the reference agent has no model code);
+part of the TPU workload stack like generate.py.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .generate import KVCache, _forward_chunk
+from .transformer import ModelConfig
+
+
+class SpecStats(NamedTuple):
+    """rounds: verify rounds run; drafted: gamma * rounds proposed;
+    accepted: drafted tokens that survived verification."""
+
+    rounds: jax.Array
+    drafted: jax.Array
+    accepted: jax.Array
+
+
+def speculative_generate(
+    params: Dict,
+    draft_params: Dict,
+    cfg: ModelConfig,
+    draft_cfg: ModelConfig,
+    prompt: jax.Array,
+    max_new_tokens: int,
+    gamma: int = 4,
+    temperature: float = 0.0,
+    key: Optional[jax.Array] = None,
+    max_len: Optional[int] = None,
+) -> Tuple[jax.Array, SpecStats]:
+    """prompt [1, p] -> ([1, p + max_new_tokens], SpecStats).
+
+    Greedy when temperature == 0 (exact match with generate()); else
+    speculative sampling (target-distribution-preserving). The two
+    configs must share the vocab; the draft is typically a narrower /
+    shallower model.
+    """
+    assert prompt.shape[0] == 1, "speculative decode is single-stream"
+    assert cfg.vocab == draft_cfg.vocab, "vocabularies must match"
+    assert cfg.moe_experts == 0 and draft_cfg.moe_experts == 0, (
+        "speculative decode supports dense models"
+    )
+    b, p = prompt.shape
+    total = p + max_new_tokens
+    # every round may write up to gamma+1 tokens past the cursor; pad
+    # the buffer so the final round's overshoot never wraps
+    buf_len = total + gamma + 1
+    max_len = max_len or buf_len
+    assert max_len >= buf_len, (max_len, buf_len)
+    if cfg.pos == "learned":
+        assert cfg.max_seq >= max_len
+    if draft_cfg.pos == "learned":
+        assert draft_cfg.max_seq >= max_len
+    if key is None:
+        key = jax.random.key(0)
+    if max_new_tokens == 0:
+        return prompt, SpecStats(
+            jnp.int32(0), jnp.int32(0), jnp.int32(0)
+        )
+
+    run = _build_spec_run(
+        cfg, draft_cfg, p, max_new_tokens, gamma, temperature, max_len
+    )
+    return run(params, draft_params, prompt, key)
+
+
+def _sample_from_probs(probs, key):
+    return jax.random.categorical(
+        key, jnp.log(jnp.maximum(probs, 1e-30)), axis=-1
+    ).astype(jnp.int32)
+
+
+@functools.lru_cache(maxsize=32)
+def _build_spec_run(
+    cfg: ModelConfig, draft_cfg: ModelConfig, p: int,
+    max_new_tokens: int, gamma: int, temperature: float, max_len: int,
+):
+    total = p + max_new_tokens
+    buf_len = total + gamma + 1
+    greedy = temperature == 0.0
+
+    def probs_of(logits):
+        if greedy:
+            # one-hot argmax: the same accept/resample algebra then
+            # reduces to exact greedy matching
+            return jax.nn.one_hot(
+                jnp.argmax(logits, axis=-1), cfg.vocab, dtype=jnp.float32
+            )
+        return jax.nn.softmax(logits / temperature, axis=-1)
+
+    @jax.jit
+    def run(params, draft_params, prompt, key):
+        tcache = KVCache.empty(cfg, 1, max_len)
+        dcache = KVCache.empty(draft_cfg, 1, max_len)
+
+        # prefill BOTH models on the prompt; the target's last-position
+        # distribution seeds the emitted stream
+        tlogits, tcache = _forward_chunk(params, prompt, tcache, cfg)
+        _, dcache = _forward_chunk(
+            draft_params, prompt, dcache, draft_cfg
+        )
+        key, sub = jax.random.split(key)
+        first = _sample_from_probs(probs_of(tlogits[:, -1]), sub)[0]
+
+        buf = jnp.zeros((buf_len,), jnp.int32)
+        buf = jax.lax.dynamic_update_slice(
+            buf, prompt[0].astype(jnp.int32), (0,)
+        )
+        buf = buf.at[p].set(first)
+
+        # cursor: index of the NEXT slot to fill; buf[p..cursor) is
+        # committed output. last committed token = buf[cursor-1].
+        state = dict(
+            buf=buf,
+            cursor=jnp.int32(p + 1),
+            tcache=tcache,
+            dcache=dcache,
+            key=key,
+            rounds=jnp.int32(0),
+            accepted=jnp.int32(0),
+        )
+
+        def cond(s):
+            return s["cursor"] < total
+
+        def body(s):
+            key = s["key"]
+            last = jax.lax.dynamic_slice(s["buf"], (s["cursor"] - 1,), (1,))
+
+            # -- draft proposes gamma tokens (sequential, cheap) -----
+            def draft_step(carry, _):
+                dcache, tok, key = carry
+                key, sub = jax.random.split(key)
+                logits, dcache = _forward_chunk(
+                    draft_params, tok[None], dcache, draft_cfg
+                )
+                q = probs_of(logits[:, -1])[0]
+                nxt = _sample_from_probs(q[None], sub)[0:1]
+                return (dcache, nxt, key), (nxt[0], q)
+
+            key, dkey = jax.random.split(key)
+            (dcache, _, _), (draft_toks, draft_q) = jax.lax.scan(
+                draft_step, (s["dcache"], last, dkey), None, length=gamma
+            )
+            # the scan cached K/V for [last, d_1..d_{gamma-1}] but never
+            # fed d_gamma; when all gamma survive verification the next
+            # round needs d_gamma's cache entry, so feed it now (logits
+            # discarded; on partial acceptance the entry is past the
+            # rolled-back length and harmlessly stale)
+            _, dcache = _forward_chunk(
+                draft_params, draft_toks[gamma - 1][None, None],
+                dcache, draft_cfg,
+            )
+
+            # -- target verifies all gamma+1 positions in ONE chunk --
+            chunk = jnp.concatenate([last, draft_toks])[None]  # [1, g+1]
+            tlogits, tcache = _forward_chunk(
+                params, chunk, s["tcache"], cfg
+            )
+            target_p = probs_of(tlogits[0])  # [g+1, vocab]
+
+            # -- accept/reject (Leviathan); greedy reduces to match --
+            p_i = jax.vmap(lambda pr, t: pr[t])(
+                target_p[:gamma], draft_toks
+            )
+            q_i = jax.vmap(lambda qr, t: qr[t])(draft_q, draft_toks)
+            key, ukey = jax.random.split(key)
+            u = jax.random.uniform(ukey, (gamma,))
+            ok = u < jnp.minimum(1.0, p_i / jnp.maximum(q_i, 1e-30))
+            # longest accepted PREFIX: a rejection cuts everything after
+            n_acc = jnp.sum(jnp.cumprod(ok.astype(jnp.int32)))
+
+            # correction token: resample from (p - q)+ at the first
+            # rejected position, or the bonus distribution after a
+            # full acceptance
+            all_ok = n_acc == gamma
+            resid = jnp.maximum(
+                target_p[jnp.minimum(n_acc, gamma - 1)]
+                - draft_q[jnp.minimum(n_acc, gamma - 1)],
+                0.0,
+            )
+            resid_sum = jnp.sum(resid)
+            # degenerate p == q: residual is empty; fall back to p
+            resid = jnp.where(
+                resid_sum > 0,
+                resid / jnp.maximum(resid_sum, 1e-30),
+                target_p[jnp.minimum(n_acc, gamma - 1)],
+            )
+            correction_dist = jnp.where(
+                all_ok, target_p[gamma], resid
+            )
+            key, ckey = jax.random.split(key)
+            correction = _sample_from_probs(correction_dist[None], ckey)[0]
+
+            # -- commit: draft_toks[:n_acc] then the correction ------
+            # slot i < n_acc takes d_{i+1}; every slot >= n_acc takes
+            # the correction value — only slot n_acc of those is real,
+            # the rest sit past the new cursor and are overwritten by
+            # the next round or sliced off at the end
+            emit = jnp.concatenate([draft_toks, correction[None]])
+            shifted = jnp.where(
+                jnp.arange(gamma + 1) < n_acc, emit, correction
+            )
+            buf = jax.lax.dynamic_update_slice(
+                s["buf"], shifted, (s["cursor"],)
+            )
+            n_emit = n_acc + 1
+            cursor = s["cursor"] + n_emit
+
+            # -- roll caches back to the committed stream ------------
+            # target consumed last + gamma drafts from cursor-1-n_emit
+            # ... keep exactly the committed positions: the cache must
+            # cover buf[0..cursor-1) as context; the NEXT round re-feeds
+            # buf[cursor-1] as its chunk head.
+            tcache = KVCache(
+                k=tcache.k, v=tcache.v, length=cursor - 1
+            )
+            dcache = KVCache(
+                k=dcache.k, v=dcache.v, length=cursor - 1
+            )
+            return dict(
+                buf=buf,
+                cursor=cursor,
+                tcache=tcache,
+                dcache=dcache,
+                key=key,
+                rounds=s["rounds"] + 1,
+                accepted=s["accepted"] + n_acc,
+            )
+
+        s = jax.lax.while_loop(cond, body, state)
+        stats = SpecStats(
+            rounds=s["rounds"],
+            drafted=s["rounds"] * gamma,
+            accepted=s["accepted"],
+        )
+        return s["buf"][None, :total], stats
+
+    return run

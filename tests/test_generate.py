@@ -232,3 +232,19 @@ def test_moe_prefill_matches_forward_even_with_drops():
     cache = KVCache.empty(cfg, 2, 12)
     logits, cache = _forward_chunk(params, tokens, cache, cfg)
     np.testing.assert_allclose(logits, want, atol=1e-4, rtol=1e-4)
+
+
+def test_moe_single_token_prefill_is_still_prefill():
+    """A [b, 1] prompt is prefill, not a decode step: the training
+    capacity policy must apply (matching the forward oracle), not the
+    drop-free decode policy — chunk width does not decide the policy."""
+    cfg = ModelConfig(
+        **BASE, pos="rope", moe_experts=4, moe_every=1,
+        moe_capacity_factor=0.5,  # cap = ceil(b*0.5/4) = 1: drops occur
+    )
+    params = init_params(cfg, jax.random.key(0))
+    tokens = jax.random.randint(jax.random.key(1), (6, 1), 0, cfg.vocab)
+    want = decode_logits_reference(params, tokens, cfg)
+    cache = KVCache.empty(cfg, 6, 4)
+    logits, _ = _forward_chunk(params, tokens, cache, cfg)
+    np.testing.assert_allclose(logits, want, atol=1e-4, rtol=1e-4)

@@ -1,0 +1,140 @@
+"""Speculative decoding (workloads/speculative.py).
+
+The load-bearing property: greedy speculative output is EXACTLY the
+target model's greedy decode, for any draft — the draft only changes
+speed, never content. Sampling mode preserves the target distribution
+(Leviathan accept/reject); tested for mechanics + determinism."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from elastic_tpu_agent.workloads.generate import generate
+from elastic_tpu_agent.workloads.speculative import speculative_generate
+from elastic_tpu_agent.workloads.transformer import (
+    ModelConfig,
+    init_params,
+)
+
+TARGET = dict(
+    vocab=97, d_model=32, n_heads=4, n_layers=2, d_ff=64, max_seq=128,
+    dtype=jnp.float32, attn="reference",
+)
+DRAFT = dict(
+    vocab=97, d_model=16, n_heads=2, n_layers=1, d_ff=32, max_seq=128,
+    dtype=jnp.float32, attn="reference",
+)
+
+
+@pytest.mark.parametrize("gamma", [1, 3, 5])
+@pytest.mark.parametrize("pos", ["learned", "rope"])
+def test_greedy_speculative_equals_target_greedy(gamma, pos):
+    cfg = ModelConfig(**TARGET, pos=pos)
+    dcfg = ModelConfig(**DRAFT, pos=pos)
+    params = init_params(cfg, jax.random.key(0))
+    draft = init_params(dcfg, jax.random.key(1))
+    prompt = jax.random.randint(jax.random.key(2), (1, 7), 0, cfg.vocab)
+
+    want = generate(params, prompt, cfg, max_new_tokens=20,
+                    max_len=7 + 20 + gamma + 1)
+    got, stats = speculative_generate(
+        params, draft, cfg, dcfg, prompt, max_new_tokens=20, gamma=gamma,
+    )
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+    assert int(stats.rounds) >= 1
+    assert 0 <= int(stats.accepted) <= int(stats.drafted)
+
+
+def test_draft_equals_target_accepts_everything():
+    """With the draft == the target, greedy verification accepts every
+    proposal: rounds ~= ceil(N / (gamma+1)) and accepted == drafted
+    (up to the final truncated round)."""
+    cfg = ModelConfig(**TARGET)
+    params = init_params(cfg, jax.random.key(0))
+    prompt = jax.random.randint(jax.random.key(2), (1, 5), 0, cfg.vocab)
+    n, gamma = 24, 3
+    got, stats = speculative_generate(
+        params, params, cfg, cfg, prompt, max_new_tokens=n, gamma=gamma,
+    )
+    want = generate(params, prompt, cfg, max_new_tokens=n,
+                    max_len=5 + n + gamma + 1)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+    assert int(stats.accepted) == int(stats.drafted)
+    assert int(stats.rounds) == -(-n // (gamma + 1))  # ceil
+
+
+def test_sampling_mode_runs_and_is_deterministic_per_key():
+    cfg = ModelConfig(**TARGET)
+    dcfg = ModelConfig(**DRAFT)
+    params = init_params(cfg, jax.random.key(0))
+    draft = init_params(dcfg, jax.random.key(1))
+    prompt = jax.random.randint(jax.random.key(2), (1, 6), 0, cfg.vocab)
+    out1, _ = speculative_generate(
+        params, draft, cfg, dcfg, prompt, max_new_tokens=12, gamma=2,
+        temperature=0.8, key=jax.random.key(9),
+    )
+    out2, _ = speculative_generate(
+        params, draft, cfg, dcfg, prompt, max_new_tokens=12, gamma=2,
+        temperature=0.8, key=jax.random.key(9),
+    )
+    assert out1.shape == (1, 18)
+    np.testing.assert_array_equal(np.asarray(out1), np.asarray(out2))
+    assert int(out1.max()) < cfg.vocab and int(out1.min()) >= 0
+    np.testing.assert_array_equal(
+        np.asarray(out1[:, :6]), np.asarray(prompt)
+    )
+
+
+def test_single_stream_only():
+    cfg = ModelConfig(**TARGET)
+    params = init_params(cfg, jax.random.key(0))
+    with pytest.raises(AssertionError, match="single-stream"):
+        speculative_generate(
+            params, params, cfg, cfg,
+            jnp.zeros((2, 4), jnp.int32), max_new_tokens=4,
+        )
+
+
+def test_sampling_preserves_target_distribution_one_step():
+    """Distributional correctness probe: for ONE generated token, the
+    speculative sampler's empirical distribution over many keys must
+    match direct sampling from the target. gamma=1, tiny vocab, loose
+    tolerance (both sides are Monte Carlo)."""
+    cfg = ModelConfig(
+        vocab=13, d_model=16, n_heads=2, n_layers=1, d_ff=32, max_seq=32,
+        dtype=jnp.float32, attn="reference",
+    )
+    dcfg = cfg
+    params = init_params(cfg, jax.random.key(0))
+    draft = init_params(dcfg, jax.random.key(3))  # different weights
+    prompt = jnp.array([[1, 4, 7]], jnp.int32)
+    n_trials = 400
+
+    def spec_tok(seed):
+        out, _ = speculative_generate(
+            params, draft, cfg, dcfg, prompt, max_new_tokens=2, gamma=1,
+            temperature=1.0, key=jax.random.key(seed),
+        )
+        return int(out[0, 4])  # the SECOND new token exercises a round
+
+    def direct_tok(seed):
+        out = generate(
+            params, prompt, cfg, max_new_tokens=2, temperature=1.0,
+            key=jax.random.key(seed),
+        )
+        return int(out[0, 4])
+
+    spec_counts = np.bincount(
+        [spec_tok(s) for s in range(n_trials)], minlength=cfg.vocab
+    ).astype(np.float64) / n_trials
+    direct_counts = np.bincount(
+        [direct_tok(s + 10_000) for s in range(n_trials)],
+        minlength=cfg.vocab,
+    ).astype(np.float64) / n_trials
+    # total-variation distance between two 400-sample empiricals of the
+    # same underlying distribution concentrates well under 0.2 for a
+    # 13-way categorical; a wrong accept/resample rule (e.g. always
+    # keeping draft proposals) lands far above
+    tv = 0.5 * np.abs(spec_counts - direct_counts).sum()
+    assert tv < 0.2, f"TV distance {tv:.3f}"
