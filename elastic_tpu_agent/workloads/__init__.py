@@ -1,4 +1,4 @@
-from .generate import KVCache, generate
+from .generate import KVCache, decode_shardings, generate
 from .moe import init_moe_params, moe_mlp, moe_param_shardings
 from .quantize import dequantize_params, quantize_params
 from .speculative import SpecStats, speculative_generate
@@ -22,6 +22,7 @@ __all__ = [
     "ModelConfig",
     "SpecStats",
     "TrainCheckpointer",
+    "decode_shardings",
     "dequantize_params",
     "forward",
     "forward_with_aux",

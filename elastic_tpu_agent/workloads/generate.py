@@ -230,8 +230,17 @@ def generate(
     top_p: float = 0.0,
     key: Optional[jax.Array] = None,
     max_len: Optional[int] = None,
+    mesh=None,
 ) -> jax.Array:
     """Generate continuations. prompt [b, p] -> [b, p + max_new_tokens].
+
+    With ``mesh`` (serving decode over devices): pass params already
+    placed by ``decode_shardings``; the KV cache is constrained
+    batch-over-dp and kv-heads-over-tp, and XLA partitions the whole
+    prefill+scan (the per-step all-reduce over tp rides ICI). A batch
+    that doesn't divide "dp" still works — GSPMD pads — but the padded
+    rows burn HBM and compute on a real mesh, so size batch as a
+    multiple of dp.
 
     Greedy when temperature == 0 (default), else temperature sampling
     with optional top-k and/or nucleus top-p truncation. Compiles to
@@ -259,23 +268,51 @@ def generate(
     if max_new_tokens == 0:
         return prompt
     run = _build_run(
-        cfg, b, max_new_tokens, temperature, top_k, top_p, max_len
+        cfg, b, max_new_tokens, temperature, top_k, top_p, max_len, mesh
     )
     return run(params, prompt, key)
+
+
+def decode_shardings(mesh, cfg: ModelConfig) -> Tuple[Dict, "KVCache"]:
+    """(param shardings, KVCache shardings) for serving decode on a
+    mesh: batch over "dp", kv heads over "tp" (cache layout
+    [L, b, s, g, h]). Place params with ``jax.device_put(params,
+    shardings)`` and pass the mesh to generate()."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from .transformer import _full_param_shardings
+
+    tp = mesh.shape.get("tp", 1)
+    assert cfg.kv_heads % tp == 0, (
+        f"kv_heads {cfg.kv_heads} must divide over tp={tp} "
+        "(the cache shards its kv-head axis)"
+    )
+    cache_ns = NamedSharding(mesh, P(None, "dp", None, "tp", None))
+    return _full_param_shardings(mesh, cfg), KVCache(
+        k=cache_ns, v=cache_ns, length=NamedSharding(mesh, P())
+    )
 
 
 @functools.lru_cache(maxsize=64)
 def _build_run(
     cfg: ModelConfig, b: int, max_new_tokens: int,
     temperature: float, top_k: int, top_p: float, max_len: int,
+    mesh=None,
 ):
-    """Cached jitted decode program per (config, shape, sampling) key —
-    a fresh closure per generate() call would retrace and recompile the
-    whole prefill+scan on every invocation."""
+    """Cached jitted decode program per (config, shape, sampling, mesh)
+    key — a fresh closure per generate() call would retrace and
+    recompile the whole prefill+scan on every invocation."""
 
     @jax.jit
     def run(params, prompt, key):
         cache = KVCache.empty(cfg, b, max_len)
+        if mesh is not None:
+            cache_shard = decode_shardings(mesh, cfg)[1]
+            cache = KVCache(
+                k=jax.lax.with_sharding_constraint(cache.k, cache_shard.k),
+                v=jax.lax.with_sharding_constraint(cache.v, cache_shard.v),
+                length=cache.length,
+            )
         logits, cache = _forward_chunk(params, prompt, cache, cfg)
         first = _sample(logits[:, -1], key, temperature, top_k, top_p)
 
