@@ -1,4 +1,9 @@
 from .generate import KVCache, decode_shardings, generate
+from .lora import (
+    init_lora_params,
+    make_lora_train_step,
+    merge_lora,
+)
 from .moe import init_moe_params, moe_mlp, moe_param_shardings
 from .quantize import dequantize_params, quantize_params
 from .speculative import SpecStats, speculative_generate
@@ -27,8 +32,11 @@ __all__ = [
     "forward",
     "forward_with_aux",
     "generate",
+    "init_lora_params",
     "init_moe_params",
     "init_params",
+    "make_lora_train_step",
+    "merge_lora",
     "make_mesh",
     "make_pipeline_mesh",
     "make_pipeline_train_step",

@@ -273,13 +273,22 @@ def generate(
     return run(params, prompt, key)
 
 
-def decode_shardings(mesh, cfg: ModelConfig) -> Tuple[Dict, "KVCache"]:
+def decode_shardings(
+    mesh, cfg: ModelConfig, params: Optional[Dict] = None
+) -> Tuple[Dict, "KVCache"]:
     """(param shardings, KVCache shardings) for serving decode on a
     mesh: batch over "dp", kv heads over "tp" (cache layout
     [L, b, s, g, h]). Place params with ``jax.device_put(params,
-    shardings)`` and pass the mesh to generate()."""
+    shardings)`` and pass the mesh to generate().
+
+    For an int8 tree (quantize.quantize_params), pass the ACTUAL
+    params: each quantized leaf becomes {"q": weight's sharding,
+    "s": that sharding with size-1 (keepdims) axes unpartitioned} —
+    the float shardings alone would try to split the scale's
+    singleton axes over tp."""
     from jax.sharding import NamedSharding, PartitionSpec as P
 
+    from .quantize import is_quantized
     from .transformer import _full_param_shardings
 
     tp = mesh.shape.get("tp", 1)
@@ -287,10 +296,48 @@ def decode_shardings(mesh, cfg: ModelConfig) -> Tuple[Dict, "KVCache"]:
         f"kv_heads {cfg.kv_heads} must divide over tp={tp} "
         "(the cache shards its kv-head axis)"
     )
+    p_shard = _full_param_shardings(mesh, cfg)
+    if params is not None:
+        def leaf_shard(leaf, ns):
+            if not is_quantized(leaf):
+                return ns
+            spec = ns.spec
+            s_spec = P(*(
+                None if dim == 1 else ax
+                for dim, ax in zip(
+                    leaf["s"].shape,
+                    tuple(spec) + (None,) * (
+                        leaf["s"].ndim - len(spec)
+                    ),
+                )
+            ))
+            return {"q": ns, "s": NamedSharding(mesh, s_spec)}
+
+        p_shard = jax.tree_util.tree_map(
+            leaf_shard,
+            params,
+            _broadcast_like(params, p_shard),
+            is_leaf=is_quantized,
+        )
     cache_ns = NamedSharding(mesh, P(None, "dp", None, "tp", None))
-    return _full_param_shardings(mesh, cfg), KVCache(
+    return p_shard, KVCache(
         k=cache_ns, v=cache_ns, length=NamedSharding(mesh, P())
     )
+
+
+def _broadcast_like(params: Dict, shardings: Dict) -> Dict:
+    """Expand a shardings tree onto params' exact structure (the layer
+    list in shardings is full-length already; this only aligns leaf
+    granularity so tree_map can pair quantized dict-leaves 1:1)."""
+    return {
+        **{k: v for k, v in shardings.items() if k != "layers"},
+        "layers": [
+            {k: layer_s[k] for k in layer_p}
+            for layer_p, layer_s in zip(
+                params["layers"], shardings["layers"]
+            )
+        ],
+    }
 
 
 @functools.lru_cache(maxsize=64)

@@ -1,0 +1,180 @@
+"""LoRA: low-rank adapters for parameter-efficient fine-tuning.
+
+Why this matters on TPU: full fine-tuning carries f32 master weights
+plus two adam moments — 12 bytes/param of HBM before activations
+(docs/perf.md measured a 1B-param model OOMing a v5e chip on exactly
+that). LoRA freezes the base model (bf16, no optimizer state) and
+trains rank-r factors A[in,r]·B[r,out] per targeted weight: optimizer
+HBM drops by ~in·out/(r·(in+out)) per target, and the train step
+differentiates ONLY the adapter pytree.
+
+Design:
+- Every targeted weight is viewed 2-D as [in, out] via a static
+  per-name split of its axes (wqkv [d|3nh], wo [nh|d], ...); the
+  delta A@B is computed at the weight's full shape INSIDE the step —
+  one [in,out] matmul, trivial next to the forward — and added to the
+  frozen base, so the model code runs unmodified on "effective"
+  params. No per-layer surgery in transformer.py.
+- B is zero-initialized: step 0 is exactly the base model (pinned).
+- ``merge_lora`` folds adapters into plain params for serving —
+  generate()/quantize_params consume the merged tree directly.
+
+Reference parity: none (the reference agent has no training code);
+part of the TPU workload stack (SURVEY.md §5.7 family).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from .transformer import ModelConfig, forward_with_aux
+
+# Targeted weight name -> number of LEADING axes forming the "in" side
+# of its matmul; the rest are "out". (Matches each einsum's contraction
+# in transformer.py.)
+_IN_AXES = {
+    "wqkv": 1,   # [d, 3, n, h]
+    "wq": 1,     # [d, n, h]
+    "wkv": 1,    # [d, 2, g, h]
+    "wo": 2,     # [n, h, d]
+    "w1": 1,     # [d, f]
+    "w2": 1,     # [f, d]
+}
+
+DEFAULT_TARGETS = ("wqkv", "wq", "wkv", "wo")
+
+
+def _in_out(shape: Tuple[int, ...], n_in: int) -> Tuple[int, int]:
+    return (
+        math.prod(shape[:n_in]), math.prod(shape[n_in:])
+    )
+
+
+def init_lora_params(
+    params: Dict,
+    key: jax.Array,
+    rank: int = 8,
+    targets: Sequence[str] = DEFAULT_TARGETS,
+) -> Dict:
+    """Adapters mirroring the layer structure: layers[i][name] ->
+    {"a": [in, r], "b": [r, out]}. A ~ N(0, 1/r), B = 0 (so the
+    adapted model starts exactly at the base).
+
+    MoE expert weights (nested under layer["moe"]) are NOT adapted —
+    like quantize.py's router exclusion, per-expert low-rank deltas
+    interact with routing in ways a frozen router can't compensate;
+    MoE layers receive attention adapters only. Target names must be
+    known (_IN_AXES — catches typos), but a known name may match zero
+    layers: DEFAULT_TARGETS deliberately lists both the fused-MHA and
+    GQA projection names so one default covers either convention."""
+    unknown = set(targets) - set(_IN_AXES)
+    assert not unknown, (
+        f"unknown LoRA targets {sorted(unknown)}; "
+        f"known: {sorted(_IN_AXES)}"
+    )
+    adapters = []
+    matched = set()
+    for layer in params["layers"]:
+        entry = {}
+        for name in targets:
+            if name not in layer:
+                continue
+            matched.add(name)
+            d_in, d_out = _in_out(layer[name].shape, _IN_AXES[name])
+            key, sub = jax.random.split(key)
+            entry[name] = {
+                "a": jax.random.normal(
+                    sub, (d_in, rank), jnp.float32
+                ) / math.sqrt(rank),
+                "b": jnp.zeros((rank, d_out), jnp.float32),
+            }
+        adapters.append(entry)
+    assert matched, (
+        f"no LoRA target in {sorted(targets)} matched any layer weight "
+        f"(per-layer names: {sorted(params['layers'][0])})"
+    )
+    return {"layers": adapters}
+
+
+def lora_param_count(lora: Dict) -> int:
+    return sum(p.size for p in jax.tree_util.tree_leaves(lora))
+
+
+def _apply_layer(base: Dict, adapters: Dict, scale: float) -> Dict:
+    out = dict(base)
+    for name, ab in adapters.items():
+        w = base[name]
+        delta = (ab["a"] @ ab["b"]).reshape(w.shape) * scale
+        out[name] = w + delta.astype(w.dtype)
+    return out
+
+
+def apply_lora(params: Dict, lora: Dict, scale: float = 1.0) -> Dict:
+    """Effective params: base + scale * (A@B) on every adapted weight.
+    Differentiable w.r.t. ``lora`` — used inside the train step; also
+    the implementation of merge_lora."""
+    return {
+        **{k: v for k, v in params.items() if k != "layers"},
+        "layers": [
+            _apply_layer(layer, ad, scale)
+            for layer, ad in zip(params["layers"], lora["layers"])
+        ],
+    }
+
+
+def merge_lora(params: Dict, lora: Dict, scale: float = 1.0) -> Dict:
+    """Fold adapters into a plain params tree for serving (generate,
+    quantize_params, checkpointing all consume the result)."""
+    return apply_lora(params, lora, scale)
+
+
+def make_lora_train_step(
+    cfg: ModelConfig,
+    rank: int = 8,
+    scale: float = 1.0,
+    learning_rate: float = 1e-3,
+    targets: Sequence[str] = DEFAULT_TARGETS,
+):
+    """(base_params, lora, opt_state, tokens) ->
+    (lora, opt_state, loss), jit'd.
+
+    The base is a non-differentiated argument: gradients and optimizer
+    state exist ONLY for the adapter pytree (that asymmetry is the
+    entire memory story). For multi-chip runs, pass a base already
+    placed by transformer.param_shardings and dp-sharded tokens — jit
+    propagates input shardings; the adapters are small enough to stay
+    replicated. Returns (step, init) where init(params, key) ->
+    (lora, opt_state)."""
+    optimizer = optax.adamw(learning_rate)
+
+    def loss_fn(lora, base, tokens):
+        eff = apply_lora(base, lora, scale)
+        logits, aux = forward_with_aux(eff, tokens[:, :-1], cfg)
+        logits = logits.astype(jnp.float32)
+        loss = jnp.mean(
+            optax.softmax_cross_entropy_with_integer_labels(
+                logits, tokens[:, 1:]
+            )
+        )
+        return loss + cfg.moe_aux_coef * aux
+
+    @jax.jit
+    def step(base, lora, opt_state, tokens):
+        loss, grads = jax.value_and_grad(loss_fn)(lora, base, tokens)
+        updates, opt_state = optimizer.update(grads, opt_state, lora)
+        lora = optax.apply_updates(lora, updates)
+        return lora, opt_state, loss
+
+    def init(params: Dict, key: Optional[jax.Array] = None):
+        lora = init_lora_params(
+            params, key if key is not None else jax.random.key(0),
+            rank=rank, targets=targets,
+        )
+        return lora, optimizer.init(lora)
+
+    return step, init

@@ -71,3 +71,23 @@ def test_decode_shardings_rejects_bad_tp():
     mesh = make_mesh(8, dp=2, sp=1, tp=4, ep=1)  # 2 kv heads, tp=4
     with pytest.raises(AssertionError, match="kv_heads"):
         decode_shardings(mesh, cfg)
+
+
+def test_sharded_int8_decode_matches_single_device():
+    """Quantized trees shard too: decode_shardings(params=...) maps
+    each {"q","s"} leaf to the weight's sharding with keepdims scale
+    axes left unpartitioned."""
+    from elastic_tpu_agent.workloads.quantize import quantize_params
+
+    cfg = ModelConfig(**BASE, pos="rope")
+    params = init_params(cfg, jax.random.key(0))
+    qparams = quantize_params(params)
+    prompt = jax.random.randint(jax.random.key(1), (4, 6), 0, cfg.vocab)
+
+    want = generate(qparams, prompt, cfg, max_new_tokens=8)
+
+    mesh = make_mesh(8, dp=4, sp=1, tp=2, ep=1)
+    p_shard, _ = decode_shardings(mesh, cfg, params=qparams)
+    sharded = jax.device_put(qparams, p_shard)
+    got = generate(sharded, prompt, cfg, max_new_tokens=8, mesh=mesh)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
