@@ -6,6 +6,7 @@ from .lora import (
 )
 from .moe import init_moe_params, moe_mlp, moe_param_shardings
 from .quantize import dequantize_params, quantize_params
+from .serving import ServingEngine
 from .speculative import SpecStats, speculative_generate
 from .pipeline import (
     make_pipeline_mesh,
@@ -25,6 +26,7 @@ from .transformer import (
 __all__ = [
     "KVCache",
     "ModelConfig",
+    "ServingEngine",
     "SpecStats",
     "TrainCheckpointer",
     "decode_shardings",

@@ -76,10 +76,14 @@ def _cached_attention(
     q: jax.Array,           # [b, t, n, h] for the current chunk
     cache_k: jax.Array,     # [b, max_len, g, h] incl. the chunk's keys
     cache_v: jax.Array,
-    q_pos: jax.Array,       # global position of q[:, 0]
+    q_pos: jax.Array,       # position of q[:, 0]: scalar, or [b] per row
     cfg: ModelConfig,
 ) -> jax.Array:
     """Causal attention of the chunk against the (masked) full cache.
+
+    q_pos may be a scalar (every row at the same depth — plain decode)
+    or a [b] vector (continuous-batching slots, each at its own depth;
+    row i attends cols <= q_pos[i] + chunk offset).
 
     The cache stays at kv_heads width through the whole computation —
     q is viewed as [b, t, g, r, h] (r q-heads per kv head, contiguous
@@ -95,12 +99,17 @@ def _cached_attention(
         "btgrh,bsgh->bgrts", q5, cache_k
     ).astype(jnp.float32) * scale
     max_len = cache_k.shape[1]
-    rows = q_pos + jax.lax.broadcasted_iota(jnp.int32, (t, max_len), 0)
-    cols = jax.lax.broadcasted_iota(jnp.int32, (t, max_len), 1)
-    keep = cols <= rows
+    q_pos = jnp.asarray(q_pos)
+    rows = (
+        q_pos[..., None, None] + jnp.arange(t, dtype=jnp.int32)[:, None]
+    )  # [t, 1] or [b, t, 1]
+    cols = jnp.arange(max_len, dtype=jnp.int32)
+    keep = cols <= rows                   # [t, s] or [b, t, s]
     if cfg.window > 0:
         keep &= rows - cols < cfg.window
-    logits = jnp.where(keep[None, None, None], logits, NEG_INF)
+    if keep.ndim == 2:
+        keep = keep[None]
+    logits = jnp.where(keep[:, None, None], logits, NEG_INF)
     probs = jax.nn.softmax(logits, axis=-1)
     out = jnp.einsum(
         "bgrts,bsgh->btgrh", probs.astype(cache_v.dtype), cache_v
@@ -108,9 +117,26 @@ def _cached_attention(
     return out.reshape(b, t, n, h)
 
 
+def _cache_write(
+    cache_layer: jax.Array,   # [b, max_len, g, h]
+    kv: jax.Array,            # [b, t, g, h]
+    pos: jax.Array,           # scalar, or [b] per-row offsets
+) -> jax.Array:
+    if pos.ndim == 0:
+        return jax.lax.dynamic_update_slice(
+            cache_layer, kv, (0, pos, 0, 0)
+        )
+    return jax.vmap(
+        lambda row, val, p: jax.lax.dynamic_update_slice(
+            row, val, (p, 0, 0)
+        )
+    )(cache_layer, kv, pos)
+
+
 def _forward_chunk(
     params: Dict, tokens: jax.Array, cache: KVCache, cfg: ModelConfig,
     moe_drop_free: bool = False,
+    positions: Optional[jax.Array] = None,
 ) -> Tuple[jax.Array, KVCache]:
     """Run a token chunk [b, t] at positions cache.length..+t; returns
     (logits [b, t, vocab], updated cache).
@@ -119,13 +145,24 @@ def _forward_chunk(
     being one token wide does NOT imply it's a decode step — a
     single-token batched prompt is still prefill): False = the training
     capacity factor, exactly transformer.forward's semantics; True =
-    cap == T, no token dropped."""
+    cap == T, no token dropped.
+
+    positions: per-row [b] start offsets for continuous-batching
+    decode, where each slot sits at its own depth — cache writes,
+    RoPE, learned-position lookup, and the attention mask all go
+    row-wise, and the returned cache keeps ``length`` UNCHANGED (the
+    caller owns per-row lengths). Default None = every row at
+    cache.length (plain decode/prefill)."""
     b, t = tokens.shape
-    pos = cache.length
+    pos = cache.length if positions is None else positions
     x = embed_lookup(params, tokens, cfg.dtype)
-    positions = pos + jnp.arange(t)
+    if positions is None:
+        posmat = pos + jnp.arange(t)                    # [t]
+    else:
+        posmat = pos[:, None] + jnp.arange(t)[None]     # [b, t]
     if cfg.pos == "learned":
-        x = x + params["pos_embed"].astype(cfg.dtype)[positions][None]
+        pe = params["pos_embed"].astype(cfg.dtype)[posmat]
+        x = x + (pe[None] if posmat.ndim == 1 else pe)
 
     new_k, new_v = cache.k, cache.v
     for i, layer in enumerate(params["layers"]):
@@ -134,14 +171,10 @@ def _forward_chunk(
         if cfg.pos == "rope":
             # rotated keys go INTO the cache (absolute rotations), so
             # decode steps never re-touch old cache entries
-            q = rope(q, positions, cfg.rope_theta)
-            k_c = rope(k_c, positions, cfg.rope_theta)
-        lk = jax.lax.dynamic_update_slice(
-            cache.k[i], k_c.astype(cache.k.dtype), (0, pos, 0, 0)
-        )
-        lv = jax.lax.dynamic_update_slice(
-            cache.v[i], v_c.astype(cache.v.dtype), (0, pos, 0, 0)
-        )
+            q = rope(q, posmat, cfg.rope_theta)
+            k_c = rope(k_c, posmat, cfg.rope_theta)
+        lk = _cache_write(cache.k[i], k_c.astype(cache.k.dtype), pos)
+        lv = _cache_write(cache.v[i], v_c.astype(cache.v.dtype), pos)
         new_k = new_k.at[i].set(lk)
         new_v = new_v.at[i].set(lv)
         attn = _cached_attention(q, lk, lv, pos, cfg)
@@ -181,7 +214,8 @@ def _forward_chunk(
     logits = jnp.einsum(
         "btd,dv->btv", x, wdense(params, "lm_head", cfg.dtype)
     ).astype(jnp.float32)
-    return logits, KVCache(k=new_k, v=new_v, length=pos + t)
+    new_len = cache.length + t if positions is None else cache.length
+    return logits, KVCache(k=new_k, v=new_v, length=new_len)
 
 
 def _sample(logits, key, temperature: float, top_k: int, top_p: float):

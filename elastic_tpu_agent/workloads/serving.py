@@ -1,0 +1,233 @@
+"""Continuous-batching serving engine: sequences join and leave a
+fixed-slot decode batch mid-flight (the Orca/vLLM scheduling idea,
+rebuilt for XLA's static-shape world).
+
+Why: naive batched decode waits for the whole batch to finish — one
+long request stalls every short one, and freed rows idle. Continuous
+batching admits a new request into a slot the moment its previous
+occupant finishes, keeping every row of the batched matmuls live.
+
+TPU-first mechanics:
+- ONE preallocated KV cache [L, slots, max_len, g, h]; a slot's row is
+  simply overwritten by its next occupant — no allocation, no shape
+  change, no retrace. Both cache buffers are donated through the step,
+  so XLA updates them in place (no per-token cache copy).
+- Per-row sequence lengths: each slot decodes at its own position.
+  The whole forward is generate._forward_chunk with ``positions=`` —
+  the SAME code path the solo-decode oracle runs, so serving cannot
+  silently diverge from it.
+- Prefill pads prompts up to a fixed bucket length (one compiled
+  program per bucket, not per prompt length); pad positions write
+  stale cache entries that are never attended (masked by row length)
+  and are overwritten by subsequent decode steps.
+- The host drives admission/release (that loop is control, not
+  compute); the per-step compute — all slots, active or not, in
+  lockstep — is a single jitted program. Inactive slots burn a row of
+  the matmul (the price of static shapes) but their state is frozen.
+
+Correctness pin (tests): every stream produced through interleaved
+admissions equals generate()'s output for that prompt alone.
+
+No reference counterpart (the reference agent has no model/serving
+code); TPU workload stack, same family as generate.py.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, List, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .generate import KVCache, _forward_chunk, _sample
+from .transformer import ModelConfig
+
+
+class ServingEngine:
+    """Host-driven continuous-batching decoder over fixed slots.
+
+    >>> eng = ServingEngine(params, cfg, slots=4, max_len=256)
+    >>> rid = eng.admit(prompt_tokens)       # prefill + first token
+    >>> toks = eng.step()                    # {rid: token} per live req
+    >>> eng.release(rid)                     # tokens; slot reusable
+
+    Requests are identified by a monotonically increasing request id —
+    never by slot, since slots are recycled. A request that fills its
+    row to max_len is auto-finished: it leaves the live set but its
+    stream stays retrievable via release()/stream() until collected.
+
+    Greedy or temperature/top-k/top-p sampling (engine-wide). The
+    per-step and per-bucket-prefill programs compile once each.
+    """
+
+    def __init__(
+        self,
+        params: Dict,
+        cfg: ModelConfig,
+        slots: int = 4,
+        max_len: int = 512,
+        prompt_buckets: Sequence[int] = (16, 64, 256),
+        temperature: float = 0.0,
+        top_k: int = 0,
+        top_p: float = 0.0,
+        seed: int = 0,
+    ):
+        self.params = params
+        self.cfg = cfg
+        self.slots = slots
+        self.max_len = max_len
+        self.buckets = tuple(sorted(set(prompt_buckets)))
+        assert self.buckets and self.buckets[-1] <= max_len
+        if cfg.pos == "learned":
+            assert cfg.max_seq >= max_len
+        self._sampling = (temperature, top_k, top_p)
+        self._key = jax.random.key(seed)
+
+        cache = KVCache.empty(cfg, slots, max_len)
+        self._k, self._v = cache.k, cache.v
+        self._lengths = jnp.zeros((slots,), jnp.int32)
+        self._last = jnp.zeros((slots,), jnp.int32)
+        self._free: List[int] = list(range(slots))
+        self._next_rid = 0
+        self._slot_of: Dict[int, int] = {}     # live rid -> slot
+        self._streams: Dict[int, List[int]] = {}  # rid -> tokens (live
+        self._finished: set = set()               # or auto-finished)
+
+        self._step_fn = self._build_step()
+        self._prefill_fns = {
+            b: self._build_prefill(b) for b in self.buckets
+        }
+
+    # -- compiled programs -------------------------------------------
+
+    def _build_step(self):
+        cfg = self.cfg
+        temperature, top_k, top_p = self._sampling
+
+        @functools.partial(jax.jit, donate_argnums=(1, 2))
+        def step(params, k, v, lengths, toks, active, key):
+            cache = KVCache(k=k, v=v, length=jnp.int32(0))
+            logits, cache = _forward_chunk(
+                params, toks[:, None], cache, cfg,
+                moe_drop_free=True, positions=lengths,
+            )
+            nxt = _sample(
+                logits[:, 0], key, temperature, top_k, top_p
+            )
+            # frozen slots keep their token and length
+            nxt = jnp.where(active, nxt, toks)
+            lengths = jnp.where(active, lengths + 1, lengths)
+            return cache.k, cache.v, lengths, nxt
+
+        return step
+
+    def _build_prefill(self, bucket: int):
+        cfg = self.cfg
+        temperature, top_k, top_p = self._sampling
+
+        @functools.partial(jax.jit, donate_argnums=(1, 2))
+        def prefill(params, k, v, padded, true_len, slot, key):
+            # single-row chunk forward in a scratch cache, then splice
+            # the row into the big cache at the slot index
+            mini = KVCache.empty(cfg, 1, bucket)
+            logits, mini = _forward_chunk(
+                params, padded[None], mini, cfg
+            )
+            k = jax.lax.dynamic_update_slice(
+                k, mini.k, (0, slot, 0, 0, 0)
+            )
+            v = jax.lax.dynamic_update_slice(
+                v, mini.v, (0, slot, 0, 0, 0)
+            )
+            first = _sample(
+                logits[:, true_len - 1], key, temperature, top_k, top_p
+            )[0]
+            return k, v, first
+
+        return prefill
+
+    # -- host API ----------------------------------------------------
+
+    def admit(self, prompt) -> int:
+        """Prefill a prompt (1-D int sequence) into a free slot;
+        returns the request id. The first generated token is already in
+        stream(rid)."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        p = len(prompt)
+        assert p > 0, "empty prompt"
+        assert p < self.max_len, (
+            f"prompt length {p} leaves no room to decode "
+            f"(max_len {self.max_len})"
+        )
+        bucket = next(
+            (b for b in self.buckets if b >= p), None
+        )
+        assert bucket is not None, (
+            f"prompt length {p} exceeds largest bucket {self.buckets[-1]}"
+        )
+        assert self._free, "no free slot; release() one first"
+        slot = self._free.pop(0)
+
+        padded = jnp.zeros((bucket,), jnp.int32)
+        padded = padded.at[:p].set(jnp.asarray(prompt))
+        self._key, sub = jax.random.split(self._key)
+        k, v, first = self._prefill_fns[bucket](
+            self.params, self._k, self._v, padded,
+            jnp.int32(p), jnp.int32(slot), sub,
+        )
+        self._k, self._v = k, v
+        self._lengths = self._lengths.at[slot].set(p)
+        self._last = self._last.at[slot].set(first)
+        rid = self._next_rid
+        self._next_rid += 1
+        self._slot_of[rid] = slot
+        self._streams[rid] = [int(first)]
+        return rid
+
+    def step(self) -> Dict[int, int]:
+        """Advance every live request one token; returns {rid: token}.
+        Requests whose row fills to max_len are auto-finished (their
+        streams remain retrievable via release())."""
+        if not self._slot_of:
+            return {}
+        live_slots = set(self._slot_of.values())
+        active = jnp.asarray(
+            [s in live_slots for s in range(self.slots)]
+        )
+        self._key, sub = jax.random.split(self._key)
+        self._k, self._v, self._lengths, self._last = self._step_fn(
+            self.params, self._k, self._v, self._lengths,
+            self._last, active, sub,
+        )
+        out = {}
+        toks = np.asarray(self._last)
+        lengths = np.asarray(self._lengths)
+        for rid, slot in list(self._slot_of.items()):
+            tok = int(toks[slot])
+            self._streams[rid].append(tok)
+            out[rid] = tok
+            # a row at max_len-1 can't take another write
+            if int(lengths[slot]) >= self.max_len - 1:
+                self._finish(rid)
+        return out
+
+    def _finish(self, rid: int) -> None:
+        slot = self._slot_of.pop(rid)
+        self._finished.add(rid)
+        self._free.append(slot)
+        self._free.sort()
+
+    def stream(self, rid: int) -> List[int]:
+        """Tokens generated so far (admission's first token onward);
+        valid for live and finished-uncollected requests."""
+        return list(self._streams[rid])
+
+    def release(self, rid: int) -> List[int]:
+        """Finish a live request (freeing its slot) or collect an
+        auto-finished one; returns its generated tokens."""
+        if rid in self._slot_of:
+            self._finish(rid)
+        self._finished.discard(rid)
+        return self._streams.pop(rid)

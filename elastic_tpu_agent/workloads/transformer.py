@@ -224,16 +224,22 @@ def rope(
     x: jax.Array, positions: jax.Array, theta: float = 10000.0
 ) -> jax.Array:
     """Rotary position embedding. x: [b, s, n, h] (h even), positions:
-    [s] global token positions. Pairs (x[2i], x[2i+1]) rotate by
+    [s] global token positions shared across the batch, or [b, s]
+    per-row positions (continuous-batching decode, where each slot
+    sits at its own depth). Pairs (x[2i], x[2i+1]) rotate by
     pos·theta^(-2i/h); elementwise per position, so it shards trivially
     over any sequence partitioning (the ring/sp layouts included)."""
     h = x.shape[-1]
     freqs = theta ** (
         -jnp.arange(0, h, 2, dtype=jnp.float32) / h
     )  # [h/2]
-    angles = positions[:, None].astype(jnp.float32) * freqs[None]  # [s, h/2]
-    cos = jnp.cos(angles)[None, :, None, :]
-    sin = jnp.sin(angles)[None, :, None, :]
+    angles = (
+        positions[..., None].astype(jnp.float32) * freqs
+    )  # [s, h/2] or [b, s, h/2]
+    cos = jnp.cos(angles)[..., None, :]   # [..., s, 1, h/2]
+    sin = jnp.sin(angles)[..., None, :]
+    if positions.ndim == 1:
+        cos, sin = cos[None], sin[None]   # broadcast over batch
     x1 = x[..., 0::2].astype(jnp.float32)
     x2 = x[..., 1::2].astype(jnp.float32)
     out = jnp.stack(

@@ -1,0 +1,151 @@
+"""Continuous-batching engine (workloads/serving.py): every stream
+produced through interleaved admissions must equal generate()'s output
+for that prompt alone — slot sharing, mid-flight admission, and slot
+reuse change scheduling, never content."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from elastic_tpu_agent.workloads.generate import generate
+from elastic_tpu_agent.workloads.serving import ServingEngine
+from elastic_tpu_agent.workloads.transformer import (
+    ModelConfig,
+    init_params,
+)
+
+BASE = dict(
+    vocab=97, d_model=32, n_heads=4, n_layers=2, d_ff=64, max_seq=96,
+    dtype=jnp.float32, attn="reference",
+)
+
+
+def _oracle(params, cfg, prompt, n):
+    out = generate(
+        params, jnp.asarray(prompt, jnp.int32)[None], cfg,
+        max_new_tokens=n,
+    )
+    return np.asarray(out[0, len(prompt):]).tolist()
+
+
+@pytest.mark.parametrize("pos", ["learned", "rope"])
+@pytest.mark.parametrize("kv_heads", [0, 2])
+def test_interleaved_streams_match_solo_generate(pos, kv_heads):
+    cfg = ModelConfig(**BASE, pos=pos, n_kv_heads=kv_heads)
+    params = init_params(cfg, jax.random.key(0))
+    eng = ServingEngine(
+        params, cfg, slots=3, max_len=64, prompt_buckets=(8, 16),
+    )
+    pa = [5, 17, 42, 9, 61]
+    pb = [3, 88, 24]
+    pc = [7, 7, 30, 2, 51, 11, 29, 4]
+
+    sa = eng.admit(pa)
+    # a runs alone for 3 steps
+    for _ in range(3):
+        eng.step()
+    sb = eng.admit(pb)          # b joins mid-flight
+    for _ in range(2):
+        eng.step()
+    sc = eng.admit(pc)          # c joins; 3 slots live
+    for _ in range(4):
+        eng.step()
+
+    got_a = eng.release(sa)
+    got_b = eng.release(sb)
+    got_c = eng.release(sc)
+    # a: 1 (admit) + 3 + 2 + 4 = 10 tokens; b: 1 + 2 + 4 = 7; c: 1 + 4
+    assert got_a == _oracle(params, cfg, pa, 10)
+    assert got_b == _oracle(params, cfg, pb, 7)
+    assert got_c == _oracle(params, cfg, pc, 5)
+
+
+def test_slot_reuse_after_release():
+    cfg = ModelConfig(**BASE, pos="rope")
+    params = init_params(cfg, jax.random.key(0))
+    eng = ServingEngine(
+        params, cfg, slots=1, max_len=64, prompt_buckets=(8,),
+    )
+    p1 = [5, 17, 42]
+    p2 = [61, 3, 88, 24, 9]  # longer than p1: exercises stale rows
+
+    r1 = eng.admit(p1)
+    for _ in range(6):
+        eng.step()
+    got1 = eng.release(r1)
+    assert got1 == _oracle(params, cfg, p1, 7)
+
+    r2 = eng.admit(p2)
+    assert r2 != r1                  # request ids never recycle
+    assert eng._slot_of[r2] == 0     # ...but the slot does
+    for _ in range(5):
+        eng.step()
+    got2 = eng.release(r2)
+    assert got2 == _oracle(params, cfg, p2, 6)
+
+
+def test_short_prompt_after_long_occupant():
+    """A reused slot whose previous occupant grew LONGER than the new
+    prompt: the new stream must be clean (stale cache rows are masked
+    or overwritten, never attended)."""
+    cfg = ModelConfig(**BASE, pos="rope")
+    params = init_params(cfg, jax.random.key(0))
+    eng = ServingEngine(
+        params, cfg, slots=1, max_len=64, prompt_buckets=(4, 16),
+    )
+    long_p = list(range(2, 14))  # 12 tokens, bucket 16
+    s = eng.admit(long_p)
+    for _ in range(10):          # occupant reaches length 23
+        eng.step()
+    eng.release(s)
+
+    short_p = [5, 9]             # 2 tokens, bucket 4
+    s2 = eng.admit(short_p)
+    for _ in range(8):
+        eng.step()
+    got = eng.release(s2)
+    assert got == _oracle(params, cfg, short_p, 9)
+
+
+def test_max_len_auto_finish_keeps_stream():
+    cfg = ModelConfig(**BASE, pos="rope")
+    params = init_params(cfg, jax.random.key(0))
+    eng = ServingEngine(
+        params, cfg, slots=1, max_len=12, prompt_buckets=(8,),
+    )
+    rid = eng.admit([5, 17, 42, 9])
+    for _ in range(20):
+        eng.step()
+    # row filled to max_len-1 and auto-finished; slot free again but
+    # the stream is NOT lost — release() collects it
+    assert rid not in eng._slot_of
+    assert eng._free == [0]
+    got = eng.release(rid)
+    # prompt 4 tokens -> lengths grew 4..11: 7 steps + admission token
+    assert got == _oracle(params, cfg, [5, 17, 42, 9], 8)
+    # a new request takes the freed slot cleanly
+    r2 = eng.admit([61, 3])
+    for _ in range(4):
+        eng.step()
+    assert eng.release(r2) == _oracle(params, cfg, [61, 3], 5)
+
+
+def test_admission_control():
+    cfg = ModelConfig(**BASE, pos="rope")
+    params = init_params(cfg, jax.random.key(0))
+    eng = ServingEngine(
+        params, cfg, slots=1, max_len=32, prompt_buckets=(4,),
+    )
+    with pytest.raises(AssertionError, match="largest bucket"):
+        eng.admit(list(range(9)))
+    eng.admit([1, 2])
+    with pytest.raises(AssertionError, match="free slot"):
+        eng.admit([3])
+
+    # a prompt that fills the whole row leaves no room to decode
+    tight = ServingEngine(
+        params, cfg, slots=1, max_len=4, prompt_buckets=(4,),
+    )
+    with pytest.raises(AssertionError, match="no room"):
+        tight.admit([1, 2, 3, 4])
