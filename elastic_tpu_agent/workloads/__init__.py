@@ -1,3 +1,4 @@
+from .beam import beam_search
 from .generate import KVCache, decode_shardings, generate
 from .lora import (
     init_lora_params,
@@ -29,6 +30,7 @@ __all__ = [
     "ServingEngine",
     "SpecStats",
     "TrainCheckpointer",
+    "beam_search",
     "decode_shardings",
     "dequantize_params",
     "forward",

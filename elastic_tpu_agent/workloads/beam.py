@@ -1,0 +1,171 @@
+"""Beam-search decoding over the KV cache.
+
+TPU-first shape discipline: the beam dimension IS the batch dimension
+of one shared KV cache [L, beam, max_len, g, h] — prefill runs once
+and broadcasts, then every step is (1) one batched single-token
+forward for all beams, (2) a top-k over the flattened
+[beam * vocab] continuation scores, (3) a gather that reorders the
+cache rows to each survivor's parent. Everything is ONE lax.scan
+under jit; no per-beam Python, no dynamic shapes.
+
+EOS handling (optional): a finished beam is frozen — it proposes
+exactly one continuation (itself, padded with eos, score unchanged) —
+so live and finished hypotheses compete in the same top-k, the
+standard "beam closing" formulation.
+
+Length normalization: each hypothesis's score divides by
+(5 + its_generated_len)^alpha / 6^alpha (the GNMT rule) when
+``length_penalty`` = alpha > 0; 0 disables. A hypothesis's length
+stops growing at its first eos, so with eos enabled short and long
+finished beams genuinely rerank. Applied at the FINAL ranking;
+in-search comparisons stay on raw cumulative logprobs (the common
+simplification — frozen beams compete at unchanged score).
+
+No reference counterpart (the reference agent has no model code);
+TPU workload stack, same family as generate.py.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .attention import NEG_INF
+from .generate import KVCache, _forward_chunk
+from .transformer import ModelConfig
+
+
+def beam_search(
+    params: Dict,
+    prompt: jax.Array,
+    cfg: ModelConfig,
+    max_new_tokens: int,
+    beam_size: int = 4,
+    length_penalty: float = 0.0,
+    eos_id: Optional[int] = None,
+    max_len: Optional[int] = None,
+) -> Tuple[jax.Array, jax.Array]:
+    """prompt [1, p] -> (sequences [beam, p + max_new_tokens],
+    scores [beam]), best beam first.
+
+    Scores are total token logprobs (length-normalized when
+    length_penalty > 0). beam_size=1 is exactly greedy decoding.
+    MoE models decode drop-free per step (generate's policy).
+    """
+    assert prompt.shape[0] == 1, "beam search expands ONE prompt"
+    b, p = prompt.shape
+    total = p + max_new_tokens
+    max_len = max_len or total
+    assert max_len >= total, (max_len, total)
+    if cfg.pos == "learned":
+        assert cfg.max_seq >= max_len
+    if max_new_tokens == 0:
+        return (
+            jnp.broadcast_to(prompt, (beam_size, p)),
+            jnp.zeros((beam_size,), jnp.float32),
+        )
+    run = _build_beam_run(
+        cfg, p, max_new_tokens, beam_size, length_penalty,
+        -1 if eos_id is None else int(eos_id), max_len,
+    )
+    return run(params, prompt)
+
+
+@functools.lru_cache(maxsize=32)
+def _build_beam_run(
+    cfg: ModelConfig, p: int, max_new_tokens: int, beam_size: int,
+    length_penalty: float, eos_id: int, max_len: int,
+):
+    k = beam_size
+    total = p + max_new_tokens
+    has_eos = eos_id >= 0
+
+    def norm(scores, n_generated):
+        if length_penalty <= 0.0:
+            return scores
+        denom = ((5.0 + n_generated) ** length_penalty) / (
+            6.0 ** length_penalty
+        )
+        return scores / denom
+
+    @jax.jit
+    def run(params, prompt):
+        cache = KVCache.empty(cfg, 1, max_len)
+        logits, cache = _forward_chunk(params, prompt, cache, cfg)
+        logp0 = jax.nn.log_softmax(
+            logits[0, -1].astype(jnp.float32)
+        )
+
+        # beam 0..k-1 start as the top-k first tokens
+        scores, first = jax.lax.top_k(logp0, k)          # [k], [k]
+        cache = KVCache(
+            k=jnp.broadcast_to(
+                cache.k, (cfg.n_layers, k) + cache.k.shape[2:]
+            ),
+            v=jnp.broadcast_to(
+                cache.v, (cfg.n_layers, k) + cache.v.shape[2:]
+            ),
+            length=cache.length,
+        )
+        buf = jnp.zeros((k, total), jnp.int32)
+        buf = buf.at[:, :p].set(prompt[0])
+        buf = buf.at[:, p].set(first)
+        finished = (
+            first == eos_id if has_eos
+            else jnp.zeros((k,), bool)
+        )
+        gen_len = jnp.ones((k,), jnp.float32)  # tokens incl. any eos
+
+        def step(carry, i):
+            cache, buf, scores, last, finished, gen_len = carry
+            logits, cache = _forward_chunk(
+                params, last[:, None], cache, cfg, moe_drop_free=True
+            )
+            logp = jax.nn.log_softmax(
+                logits[:, 0].astype(jnp.float32)
+            )  # [k, v]
+            vocab = logp.shape[-1]
+            if has_eos:
+                # frozen beams propose exactly one child: themselves
+                # padded with eos at unchanged score
+                only_eos = jnp.full(
+                    (vocab,), NEG_INF, jnp.float32
+                ).at[eos_id].set(0.0)
+                logp = jnp.where(finished[:, None], only_eos, logp)
+            cand = scores[:, None] + logp                 # [k, v]
+            flat_scores, flat_idx = jax.lax.top_k(
+                cand.reshape(-1), k
+            )
+            parent = flat_idx // vocab                    # [k]
+            token = (flat_idx % vocab).astype(jnp.int32)  # [k]
+
+            # reorder every per-beam row to its parent
+            cache = KVCache(
+                k=cache.k[:, parent], v=cache.v[:, parent],
+                length=cache.length,
+            )
+            buf = buf[parent].at[:, p + 1 + i].set(token)
+            was_finished = finished[parent]
+            # eos padding on an already-finished beam isn't length
+            gen_len = gen_len[parent] + jnp.where(was_finished, 0.0, 1.0)
+            if has_eos:
+                finished = was_finished | (token == eos_id)
+            return (
+                (cache, buf, flat_scores, token, finished, gen_len),
+                None,
+            )
+
+        (cache, buf, scores, _, finished, gen_len), _ = jax.lax.scan(
+            step,
+            (cache, buf, scores, first, finished, gen_len),
+            jnp.arange(max_new_tokens - 1),
+        )
+
+        final = norm(scores, gen_len)
+        order = jnp.argsort(-final)
+        return buf[order], final[order]
+
+    return run
