@@ -9,6 +9,7 @@ from .moe import init_moe_params, moe_mlp, moe_param_shardings
 from .quantize import dequantize_params, quantize_params
 from .serving import ServingEngine
 from .speculative import SpecStats, speculative_generate
+from .streaming import streaming_generate
 from .pipeline import (
     make_pipeline_mesh,
     make_pipeline_train_step,
@@ -51,6 +52,7 @@ __all__ = [
     "pipeline_apply",
     "quantize_params",
     "speculative_generate",
+    "streaming_generate",
 ]
 
 

@@ -78,12 +78,19 @@ def _cached_attention(
     cache_v: jax.Array,
     q_pos: jax.Array,       # position of q[:, 0]: scalar, or [b] per row
     cfg: ModelConfig,
+    key_positions: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Causal attention of the chunk against the (masked) full cache.
 
     q_pos may be a scalar (every row at the same depth — plain decode)
     or a [b] vector (continuous-batching slots, each at its own depth;
     row i attends cols <= q_pos[i] + chunk offset).
+
+    key_positions ([cache_len]) gives each cache slot's ABSOLUTE token
+    position when slots aren't position-ordered — the streaming ring
+    buffer, where slot j holds position key_positions[j] and unwritten
+    slots carry a huge sentinel that the causal compare masks out.
+    Default None = slot j holds position j.
 
     The cache stays at kv_heads width through the whole computation —
     q is viewed as [b, t, g, r, h] (r q-heads per kv head, contiguous
@@ -103,7 +110,10 @@ def _cached_attention(
     rows = (
         q_pos[..., None, None] + jnp.arange(t, dtype=jnp.int32)[:, None]
     )  # [t, 1] or [b, t, 1]
-    cols = jnp.arange(max_len, dtype=jnp.int32)
+    cols = (
+        jnp.arange(max_len, dtype=jnp.int32)
+        if key_positions is None else key_positions
+    )
     keep = cols <= rows                   # [t, s] or [b, t, s]
     if cfg.window > 0:
         keep &= rows - cols < cfg.window
@@ -137,6 +147,7 @@ def _forward_chunk(
     params: Dict, tokens: jax.Array, cache: KVCache, cfg: ModelConfig,
     moe_drop_free: bool = False,
     positions: Optional[jax.Array] = None,
+    ring: Optional[Tuple[jax.Array, jax.Array]] = None,
 ) -> Tuple[jax.Array, KVCache]:
     """Run a token chunk [b, t] at positions cache.length..+t; returns
     (logits [b, t, vocab], updated cache).
@@ -152,8 +163,22 @@ def _forward_chunk(
     RoPE, learned-position lookup, and the attention mask all go
     row-wise, and the returned cache keeps ``length`` UNCHANGED (the
     caller owns per-row lengths). Default None = every row at
-    cache.length (plain decode/prefill)."""
+    cache.length (plain decode/prefill).
+
+    ring: (write_index, key_positions) for streaming decode over a
+    rolling-window cache (streaming.py): K/V write at slot write_index
+    (= absolute_pos %% cache_len) instead of the absolute position,
+    and key_positions [cache_len] maps every slot to its absolute
+    position for the causal/window mask. RoPE still rotates by
+    ABSOLUTE position (cache.length), so entries never re-rotate.
+    Mutually exclusive with positions; the returned length is
+    unchanged (the caller tracks the absolute stream position)."""
     b, t = tokens.shape
+    assert not (positions is not None and ring is not None)
+    # ring writes one slot per call: a multi-token chunk would need a
+    # modular scatter (dynamic_update_slice clamps at the ring edge and
+    # would silently clobber a live in-window slot)
+    assert ring is None or t == 1, "ring mode decodes one token per call"
     pos = cache.length if positions is None else positions
     x = embed_lookup(params, tokens, cfg.dtype)
     if positions is None:
@@ -173,11 +198,15 @@ def _forward_chunk(
             # decode steps never re-touch old cache entries
             q = rope(q, posmat, cfg.rope_theta)
             k_c = rope(k_c, posmat, cfg.rope_theta)
-        lk = _cache_write(cache.k[i], k_c.astype(cache.k.dtype), pos)
-        lv = _cache_write(cache.v[i], v_c.astype(cache.v.dtype), pos)
+        write_at = pos if ring is None else ring[0]
+        lk = _cache_write(cache.k[i], k_c.astype(cache.k.dtype), write_at)
+        lv = _cache_write(cache.v[i], v_c.astype(cache.v.dtype), write_at)
         new_k = new_k.at[i].set(lk)
         new_v = new_v.at[i].set(lv)
-        attn = _cached_attention(q, lk, lv, pos, cfg)
+        attn = _cached_attention(
+            q, lk, lv, pos, cfg,
+            key_positions=None if ring is None else ring[1],
+        )
         x = x + jnp.einsum(
             "btnh,nhd->btd", attn, wdense(layer, "wo", cfg.dtype)
         )
@@ -214,7 +243,10 @@ def _forward_chunk(
     logits = jnp.einsum(
         "btd,dv->btv", x, wdense(params, "lm_head", cfg.dtype)
     ).astype(jnp.float32)
-    new_len = cache.length + t if positions is None else cache.length
+    new_len = (
+        cache.length + t if positions is None and ring is None
+        else cache.length
+    )
     return logits, KVCache(k=new_k, v=new_v, length=new_len)
 
 

@@ -1,0 +1,123 @@
+"""Streaming decode: unbounded-length generation for sliding-window
+models with a KV cache of FIXED size — HBM use is O(window), not
+O(generated length).
+
+A window-attention model (ModelConfig.window > 0) only ever attends
+its last ``window`` positions, so keys older than that are dead
+weight. The cache here is a ring buffer of exactly ``window`` slots:
+position P writes slot P % window, overwriting the key that just
+slid out of every future query's reach. A slot-to-absolute-position
+map feeds the causal/window mask (generate._cached_attention's
+``key_positions``), and RoPE keeps rotating by absolute position, so
+the stream is EXACTLY the computation a full cache would do — pinned
+by tests against generate() at lengths where both fit, then run far
+past any full-cache budget.
+
+The decode loop is one lax.scan; the ring state (cache, slot map) is
+scan carry. Static shapes throughout: generation length only changes
+the scan's trip count, never a buffer size.
+
+No reference counterpart (the reference agent has no model code);
+TPU workload stack, same family as generate.py.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from .generate import KVCache, _forward_chunk, _sample
+from .transformer import ModelConfig
+
+# unwritten ring slots: an absolute position no real query reaches,
+# so `cols <= rows` masks them out everywhere
+_UNWRITTEN = jnp.int32(2**30)
+
+
+def streaming_generate(
+    params: Dict,
+    prompt: jax.Array,
+    cfg: ModelConfig,
+    max_new_tokens: int,
+    temperature: float = 0.0,
+    top_k: int = 0,
+    top_p: float = 0.0,
+    key: Optional[jax.Array] = None,
+) -> jax.Array:
+    """prompt [b, p] -> [b, p + max_new_tokens], with cache HBM fixed
+    at window size regardless of max_new_tokens.
+
+    Requires cfg.window > 0 (the model must be window-trained — with
+    full attention, evicting old keys would CHANGE the computation,
+    not just bound it) and cfg.pos == "rope" (a learned position table
+    is itself O(max position), defeating unboundedness). The prompt
+    must fit the window; MoE decodes drop-free per generate's policy.
+    """
+    assert cfg.window > 0, (
+        "streaming decode needs a sliding-window model (cfg.window)"
+    )
+    assert cfg.pos == "rope", (
+        "streaming decode needs rope (a learned position table bounds "
+        "the stream at cfg.max_seq)"
+    )
+    b, p = prompt.shape
+    ring_len = cfg.window
+    assert p <= ring_len, (
+        f"prompt ({p}) must fit the attention window ({ring_len})"
+    )
+    if key is None:
+        key = jax.random.key(0)
+    if max_new_tokens == 0:
+        return prompt
+    run = _build_stream_run(
+        cfg, b, p, max_new_tokens, temperature, top_k, top_p
+    )
+    return run(params, prompt, key)
+
+
+@functools.lru_cache(maxsize=32)
+def _build_stream_run(
+    cfg: ModelConfig, b: int, p: int, max_new_tokens: int,
+    temperature: float, top_k: int, top_p: float,
+):
+    ring_len = cfg.window
+
+    @jax.jit
+    def run(params, prompt, key):
+        # prefill: p <= ring_len, no wrap — the plain path IS the ring
+        # path here (slot j == position j), so reuse it verbatim
+        cache = KVCache.empty(cfg, b, ring_len)
+        logits, cache = _forward_chunk(params, prompt, cache, cfg)
+        first = _sample(logits[:, -1], key, temperature, top_k, top_p)
+        key_pos = jnp.where(
+            jnp.arange(ring_len) < p,
+            jnp.arange(ring_len, dtype=jnp.int32),
+            _UNWRITTEN,
+        )
+
+        def step(carry, _):
+            cache, key_pos, pos, tok, key = carry
+            key, sub = jax.random.split(key)
+            slot = pos % ring_len
+            key_pos = key_pos.at[slot].set(pos)
+            # cache.length carries the ABSOLUTE position (rope, mask
+            # rows); the ring triple redirects the write + mask cols
+            logits, cache = _forward_chunk(
+                params, tok[:, None],
+                KVCache(k=cache.k, v=cache.v, length=pos),
+                cfg, moe_drop_free=True, ring=(slot, key_pos),
+            )
+            nxt = _sample(logits[:, -1], sub, temperature, top_k, top_p)
+            return (cache, key_pos, pos + 1, nxt, key), tok
+
+        init = (cache, key_pos, jnp.int32(p), first, key)
+        _, toks = jax.lax.scan(init=init, f=step, xs=None,
+                               length=max_new_tokens)
+        return jnp.concatenate(
+            [prompt, jnp.moveaxis(toks, 0, 1)], axis=1
+        )
+
+    return run

@@ -1,0 +1,82 @@
+"""Streaming ring-buffer decode (workloads/streaming.py): with a
+cache of exactly window slots, the stream must EQUAL the full-cache
+windowed decode at every length — eviction only drops keys no query
+can reach."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from elastic_tpu_agent.workloads.generate import generate
+from elastic_tpu_agent.workloads.streaming import streaming_generate
+from elastic_tpu_agent.workloads.transformer import (
+    ModelConfig,
+    init_params,
+)
+
+BASE = dict(
+    vocab=97, d_model=32, n_heads=4, n_layers=2, d_ff=64, max_seq=64,
+    dtype=jnp.float32, attn="reference", pos="rope", window=8,
+)
+
+
+@pytest.mark.parametrize("kv_heads", [0, 2], ids=["mha", "gqa"])
+def test_stream_equals_full_cache_windowed_decode(kv_heads):
+    cfg = ModelConfig(**BASE, n_kv_heads=kv_heads)
+    params = init_params(cfg, jax.random.key(0))
+    prompt = jax.random.randint(jax.random.key(1), (2, 5), 0, cfg.vocab)
+    n = 30  # total 35: nearly 4x the ring, many wrap-arounds
+    want = generate(params, prompt, cfg, max_new_tokens=n)
+    got = streaming_generate(params, prompt, cfg, max_new_tokens=n)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_stream_runs_far_past_any_full_cache_budget():
+    """400 generated tokens through an 8-slot ring: HBM for the cache
+    never exceeds window size, and the stream still matches the
+    full-cache oracle token for token."""
+    cfg = ModelConfig(**BASE)
+    params = init_params(cfg, jax.random.key(0))
+    prompt = jax.random.randint(jax.random.key(2), (1, 8), 0, cfg.vocab)
+    n = 400
+    got = streaming_generate(params, prompt, cfg, max_new_tokens=n)
+    want = generate(
+        params, prompt, cfg, max_new_tokens=n, max_len=8 + n,
+    )
+    assert got.shape == (1, 408)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_stream_sampling_deterministic_per_key():
+    cfg = ModelConfig(**BASE)
+    params = init_params(cfg, jax.random.key(0))
+    prompt = jax.random.randint(jax.random.key(3), (2, 4), 0, cfg.vocab)
+    o1 = streaming_generate(
+        params, prompt, cfg, max_new_tokens=20, temperature=0.8,
+        top_k=10, key=jax.random.key(7),
+    )
+    o2 = streaming_generate(
+        params, prompt, cfg, max_new_tokens=20, temperature=0.8,
+        top_k=10, key=jax.random.key(7),
+    )
+    np.testing.assert_array_equal(np.asarray(o1), np.asarray(o2))
+
+
+def test_stream_rejects_bad_configs():
+    params_cfg = ModelConfig(**BASE)
+    params = init_params(params_cfg, jax.random.key(0))
+    full = ModelConfig(**{**BASE, "window": 0})
+    with pytest.raises(AssertionError, match="sliding-window"):
+        streaming_generate(
+            params, jnp.zeros((1, 4), jnp.int32), full, 4
+        )
+    learned = ModelConfig(**{**BASE, "pos": "learned"})
+    with pytest.raises(AssertionError, match="rope"):
+        streaming_generate(
+            params, jnp.zeros((1, 4), jnp.int32), learned, 4
+        )
+    with pytest.raises(AssertionError, match="fit the attention window"):
+        streaming_generate(
+            params, jnp.zeros((1, 9), jnp.int32), params_cfg, 4
+        )
