@@ -14,6 +14,7 @@ Usage (inside the container):
 from __future__ import annotations
 
 import argparse
+import dataclasses
 import json
 import os
 import signal
@@ -127,6 +128,27 @@ def main(argv=None) -> int:
         help="capture a JAX/XLA profiler trace of the timed steps "
              "(open with tensorboard or xprof)",
     )
+    parser.add_argument(
+        "--mode", choices=("train", "decode"), default="train",
+        help="train: timed optimizer steps (default); decode: KV-cache "
+             "generation throughput, optionally from a checkpoint",
+    )
+    parser.add_argument(
+        "--prompt-len", type=int, default=32,
+        help="decode mode: synthetic prompt length",
+    )
+    parser.add_argument(
+        "--new-tokens", type=int, default=64,
+        help="decode mode: tokens generated per sequence",
+    )
+    parser.add_argument(
+        "--int8", action="store_true",
+        help="decode mode: int8 weight-only quantization "
+             "(workloads/quantize.py)",
+    )
+    parser.add_argument("--temperature", type=float, default=0.0)
+    parser.add_argument("--top-k", type=int, default=0)
+    parser.add_argument("--top-p", type=float, default=0.0)
     args = parser.parse_args(argv)
 
     applied = load_alloc_env()
@@ -146,6 +168,10 @@ def main(argv=None) -> int:
     cfg = ModelConfig(
         max_seq=args.seq, n_kv_heads=args.kv_heads, **PRESETS[args.preset]
     )
+    if args.mode == "decode":
+        if args.pp > 1 or args.sp != 1:
+            parser.error("decode mode shards over dp/tp only")
+        return run_decode(args, cfg, applied)
     if args.pp > 1:
         from .pipeline import make_pipeline_mesh
         from .transformer_pipeline import make_pipeline_transformer_step
@@ -290,6 +316,104 @@ def main(argv=None) -> int:
         "tokens_per_s": tokens_per_step * ran / dt,
         "alloc_env": applied,
         "preempted": preempted["flag"],
+    }
+    print(json.dumps(report))
+    return 0
+
+
+def run_decode(args, cfg, applied) -> int:
+    """Decode-mode body: synthetic prompts -> KV-cache generation
+    throughput, optionally restoring trained params from
+    --checkpoint-dir and/or quantizing to int8. Shards over dp/tp via
+    decode_shardings when the mesh has more than one device."""
+    import jax
+
+    from .generate import decode_shardings, generate
+    from .transformer import init_params, make_mesh
+
+    if jax.process_count() > 1:
+        raise SystemExit(
+            "decode mode is single-host: sharded params are created by "
+            "device_put from host arrays, which cannot target a "
+            "cross-process mesh (train mode initializes inside jit)"
+        )
+
+    max_len = args.prompt_len + args.new_tokens
+    if cfg.pos == "learned" and cfg.max_seq < max_len:
+        if args.checkpoint_dir:
+            # a trained position table has the trained length; widening
+            # the restore template would shape-mismatch orbax, and a
+            # learned table can't extrapolate anyway
+            raise SystemExit(
+                f"decode length {max_len} exceeds the checkpoint's "
+                f"max_seq {cfg.max_seq}; shorten --prompt-len/"
+                "--new-tokens or retrain with a longer --seq"
+            )
+        cfg = dataclasses.replace(cfg, max_seq=max_len)
+
+    params = init_params(cfg, jax.random.key(0))
+    restored_step = None
+    if args.checkpoint_dir:
+        import optax
+
+        from .checkpointing import TrainCheckpointer
+
+        ckpt = TrainCheckpointer(args.checkpoint_dir)
+        if ckpt.latest_step is not None:
+            # the optimizer template exists only to satisfy the saved
+            # tree's structure; its arrays are discarded immediately
+            opt_tmpl = optax.adamw(1e-3).init(params)
+            params, _, restored_step = ckpt.restore(params, opt_tmpl)
+        ckpt.close()
+
+    if args.int8:
+        from .quantize import quantize_params
+
+        params = jax.jit(quantize_params)(params)
+        jax.block_until_ready(params)
+
+    # multi-device hosts shard by default, mirroring train mode (an
+    # unsharded run would still REPORT all devices — misattributing
+    # single-chip throughput to the whole host)
+    mesh = None
+    n_dev = len(jax.devices())
+    if n_dev > 1:
+        mesh = make_mesh(dp=args.dp, sp=1, tp=args.tp, ep=1)
+        p_shard, _ = decode_shardings(mesh, cfg, params=params)
+        params = jax.device_put(params, p_shard)
+
+    prompt = jax.random.randint(
+        jax.random.key(1), (args.batch, args.prompt_len), 0, cfg.vocab
+    )
+
+    def once():
+        out = generate(
+            params, prompt, cfg, max_new_tokens=args.new_tokens,
+            temperature=args.temperature, top_k=args.top_k,
+            top_p=args.top_p, mesh=mesh,
+        )
+        jax.block_until_ready(out)
+        return out
+
+    once()  # compile + warmup
+    t0 = time.perf_counter()
+    out = once()
+    dt = time.perf_counter() - t0
+
+    report = {
+        "mode": "decode",
+        "platform": jax.devices()[0].platform,
+        "devices": n_dev,
+        "mesh": dict(mesh.shape) if mesh is not None else None,
+        "batch": args.batch,
+        "prompt_len": args.prompt_len,
+        "new_tokens": args.new_tokens,
+        "int8": bool(args.int8),
+        "restored_step": restored_step,
+        "decode_tokens_per_s": args.batch * args.new_tokens / dt,
+        "ms_per_token": dt / args.new_tokens * 1000,
+        "sample_tail": [int(t) for t in out[0, -5:]],
+        "alloc_env": applied,
     }
     print(json.dumps(report))
     return 0

@@ -32,9 +32,12 @@ import jax.numpy as jnp
 from .generate import KVCache, _forward_chunk, _sample
 from .transformer import ModelConfig
 
-# unwritten ring slots: an absolute position no real query reaches,
-# so `cols <= rows` masks them out everywhere
-_UNWRITTEN = jnp.int32(2**30)
+# Unwritten ring slots: an absolute position no real query reaches,
+# so `cols <= rows` masks them out everywhere. A plain Python int —
+# creating a jnp scalar here would initialize the JAX backend at
+# IMPORT time, before callers (runner.main, tests' conftest) have
+# pinned the platform, and a wedged TPU plugin then hangs the import.
+_UNWRITTEN = 2**30
 
 
 def streaming_generate(

@@ -91,3 +91,39 @@ def test_params_actually_sharded():
     assert w1.sharding.spec == jax.sharding.PartitionSpec(None, "tp")
     shard_shapes = {s.data.shape for s in w1.addressable_shards}
     assert shard_shapes == {(TINY.d_model, TINY.d_ff // 4)}
+
+
+def test_runner_decode_mode(tmp_path):
+    """Real runner process in decode mode: reports KV-cache generation
+    throughput as one JSON line, int8 variant included."""
+    import json
+    import subprocess
+    import sys
+
+    env = {
+        **__import__("os").environ,
+        "JAX_PLATFORMS": "cpu",
+        "ELASTIC_TPU_ENV_FILE": str(tmp_path / "absent"),
+    }
+    base = [
+        sys.executable, "-m", "elastic_tpu_agent.workloads.runner",
+        "--mode", "decode", "--preset", "tiny", "--batch", "2",
+        "--prompt-len", "8", "--new-tokens", "6",
+    ]
+    out = subprocess.run(
+        base, env=env, capture_output=True, text=True, timeout=420,
+    )
+    assert out.returncode == 0, out.stderr[-800:]
+    report = json.loads(out.stdout.strip().splitlines()[-1])
+    assert report["mode"] == "decode"
+    assert report["decode_tokens_per_s"] > 0
+    assert report["new_tokens"] == 6 and report["int8"] is False
+
+    out8 = subprocess.run(
+        base + ["--int8"], env=env, capture_output=True, text=True,
+        timeout=420,
+    )
+    assert out8.returncode == 0, out8.stderr[-800:]
+    report8 = json.loads(out8.stdout.strip().splitlines()[-1])
+    assert report8["int8"] is True
+    assert report8["decode_tokens_per_s"] > 0
