@@ -359,11 +359,18 @@ def run_decode(args, cfg, applied) -> int:
         from .checkpointing import TrainCheckpointer
 
         ckpt = TrainCheckpointer(args.checkpoint_dir)
-        if ckpt.latest_step is not None:
-            # the optimizer template exists only to satisfy the saved
-            # tree's structure; its arrays are discarded immediately
-            opt_tmpl = optax.adamw(1e-3).init(params)
-            params, _, restored_step = ckpt.restore(params, opt_tmpl)
+        if ckpt.latest_step is None:
+            # decode mode is restore-only: falling through to random
+            # init would silently benchmark an untrained model
+            raise SystemExit(
+                f"--checkpoint-dir {args.checkpoint_dir} holds no "
+                "checkpoint (decode mode serves trained params; train "
+                "first or drop the flag)"
+            )
+        # the optimizer template exists only to satisfy the saved
+        # tree's structure; its arrays are discarded immediately
+        opt_tmpl = optax.adamw(1e-3).init(params)
+        params, _, restored_step = ckpt.restore(params, opt_tmpl)
         ckpt.close()
 
     if args.int8:
@@ -386,19 +393,28 @@ def run_decode(args, cfg, applied) -> int:
         jax.random.key(1), (args.batch, args.prompt_len), 0, cfg.vocab
     )
 
-    def once():
-        out = generate(
-            params, prompt, cfg, max_new_tokens=args.new_tokens,
-            temperature=args.temperature, top_k=args.top_k,
-            top_p=args.top_p, mesh=mesh,
-        )
-        jax.block_until_ready(out)
-        return out
+    def timed(n):
+        def once():
+            out = generate(
+                params, prompt, cfg, max_new_tokens=n,
+                temperature=args.temperature, top_k=args.top_k,
+                top_p=args.top_p, mesh=mesh,
+                max_len=args.prompt_len + args.new_tokens,
+            )
+            jax.block_until_ready(out)
+            return out
 
-    once()  # compile + warmup
-    t0 = time.perf_counter()
-    out = once()
-    dt = time.perf_counter() - t0
+        once()  # compile + warmup
+        t0 = time.perf_counter()
+        out = once()
+        return out, time.perf_counter() - t0
+
+    # prefill+1 isolates the prompt pass: quoting full wall time over
+    # new_tokens would bill the prefill to the per-token decode rate
+    _, dt_prefill = timed(1)
+    out, dt_full = timed(args.new_tokens)
+    decode_dt = max(dt_full - dt_prefill, 1e-9)
+    decode_steps = args.new_tokens - 1
 
     report = {
         "mode": "decode",
@@ -410,8 +426,10 @@ def run_decode(args, cfg, applied) -> int:
         "new_tokens": args.new_tokens,
         "int8": bool(args.int8),
         "restored_step": restored_step,
-        "decode_tokens_per_s": args.batch * args.new_tokens / dt,
-        "ms_per_token": dt / args.new_tokens * 1000,
+        "prefill_ms": dt_prefill * 1000,
+        "decode_tokens_per_s": args.batch * decode_steps / decode_dt,
+        "ms_per_token": decode_dt / max(1, decode_steps) * 1000,
+        "end_to_end_s": dt_full,
         "sample_tail": [int(t) for t in out[0, -5:]],
         "alloc_env": applied,
     }

@@ -35,7 +35,7 @@ code); TPU workload stack, same family as generate.py.
 from __future__ import annotations
 
 import functools
-from typing import Dict, List, Sequence
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -99,6 +99,14 @@ class ServingEngine:
         self._prefill_fns = {
             b: self._build_prefill(b) for b in self.buckets
         }
+        self._prefix_prefill_fns: Dict[Tuple[int, int], object] = {}
+        self._prefixes: Dict[int, tuple] = {}
+        self._next_prefix_id = 0
+        # one jitted prefix-forward per engine (re-wrapping
+        # _forward_chunk per register_prefix call would recompile)
+        self._prefix_forward = jax.jit(
+            _forward_chunk, static_argnums=(3,)
+        )
 
     # -- compiled programs -------------------------------------------
 
@@ -148,24 +156,100 @@ class ServingEngine:
 
         return prefill
 
+    def _build_prefix_prefill(self, pref_bucket: int, bucket: int):
+        """Like _build_prefill, but the chunk CONTINUES a cached prefix:
+        the mini cache starts with the prefix's K/V spliced at [0, plen)
+        and the prompt runs from position plen — the prefix's forward
+        is never recomputed."""
+        cfg = self.cfg
+        temperature, top_k, top_p = self._sampling
+
+        @functools.partial(jax.jit, donate_argnums=(1, 2))
+        def prefill(
+            params, k, v, pref_k, pref_v, plen, padded, true_len,
+            slot, key,
+        ):
+            mini = KVCache.empty(cfg, 1, pref_bucket + bucket)
+            mini = KVCache(
+                k=jax.lax.dynamic_update_slice(
+                    mini.k, pref_k, (0, 0, 0, 0, 0)
+                ),
+                v=jax.lax.dynamic_update_slice(
+                    mini.v, pref_v, (0, 0, 0, 0, 0)
+                ),
+                length=plen,
+            )
+            logits, mini = _forward_chunk(params, padded[None], mini, cfg)
+            k = jax.lax.dynamic_update_slice(k, mini.k, (0, slot, 0, 0, 0))
+            v = jax.lax.dynamic_update_slice(v, mini.v, (0, slot, 0, 0, 0))
+            first = _sample(
+                logits[:, true_len - 1], key, temperature, top_k, top_p
+            )[0]
+            return k, v, first
+
+        return prefill
+
     # -- host API ----------------------------------------------------
 
-    def admit(self, prompt) -> int:
+    def register_prefix(self, tokens) -> int:
+        """Prefill a shared prefix (e.g. a system prompt) ONCE; admit()
+        with ``prefix=`` then reuses its K/V instead of recomputing the
+        prefix forward per request. Returns a prefix id."""
+        tokens = np.asarray(tokens, np.int32).reshape(-1)
+        plen = len(tokens)
+        assert plen > 0, "empty prefix"
+        bucket = next((b for b in self.buckets if b >= plen), None)
+        assert bucket is not None, (
+            f"prefix length {plen} exceeds largest bucket "
+            f"{self.buckets[-1]}"
+        )
+        padded = jnp.zeros((bucket,), jnp.int32).at[:plen].set(
+            jnp.asarray(tokens)
+        )
+        mini = KVCache.empty(self.cfg, 1, bucket)
+        _, mini = self._prefix_forward(
+            self.params, padded[None], mini, self.cfg
+        )
+        pid = self._next_prefix_id
+        self._next_prefix_id += 1
+        # stored at bucket width; pad K/V beyond plen is masked by
+        # position downstream exactly like admit()'s own padding
+        self._prefixes[pid] = (mini.k, mini.v, plen, bucket)
+        return pid
+
+    def release_prefix(self, pid: int) -> None:
+        """Drop a registered prefix's cached K/V (each one pins
+        [L, 1, bucket, g, h] arrays in device memory for the engine's
+        lifetime otherwise). In-flight requests already admitted with
+        it are unaffected — their slot rows hold a copy."""
+        del self._prefixes[pid]
+
+    def admit(self, prompt, prefix: Optional[int] = None) -> int:
         """Prefill a prompt (1-D int sequence) into a free slot;
         returns the request id. The first generated token is already in
-        stream(rid)."""
+        stream(rid). With ``prefix=``, the request's sequence is
+        (registered prefix + prompt) but only the prompt's forward
+        runs."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         p = len(prompt)
         assert p > 0, "empty prompt"
-        assert p < self.max_len, (
-            f"prompt length {p} leaves no room to decode "
-            f"(max_len {self.max_len})"
-        )
         bucket = next(
             (b for b in self.buckets if b >= p), None
         )
         assert bucket is not None, (
             f"prompt length {p} exceeds largest bucket {self.buckets[-1]}"
+        )
+        if prefix is not None:
+            pref_k, pref_v, plen, pref_bucket = self._prefixes[prefix]
+        else:
+            plen, pref_bucket = 0, 0
+        total = plen + p
+        assert total < self.max_len, (
+            f"prefix+prompt length {total} leaves no room to decode "
+            f"(max_len {self.max_len})"
+        )
+        assert pref_bucket + bucket <= self.max_len, (
+            "prefix bucket + prompt bucket exceed the slot row"
         )
         assert self._free, "no free slot; release() one first"
         slot = self._free.pop(0)
@@ -173,12 +257,26 @@ class ServingEngine:
         padded = jnp.zeros((bucket,), jnp.int32)
         padded = padded.at[:p].set(jnp.asarray(prompt))
         self._key, sub = jax.random.split(self._key)
-        k, v, first = self._prefill_fns[bucket](
-            self.params, self._k, self._v, padded,
-            jnp.int32(p), jnp.int32(slot), sub,
-        )
+        if prefix is not None:
+            fn_key = (pref_bucket, bucket)
+            if fn_key not in self._prefix_prefill_fns:
+                self._prefix_prefill_fns[fn_key] = (
+                    self._build_prefix_prefill(*fn_key)
+                )
+            # true_len is CHUNK-relative: the last real prompt token
+            # sits at chunk index p-1 (absolute plen+p-1)
+            k, v, first = self._prefix_prefill_fns[fn_key](
+                self.params, self._k, self._v, pref_k, pref_v,
+                jnp.int32(plen), padded, jnp.int32(p),
+                jnp.int32(slot), sub,
+            )
+        else:
+            k, v, first = self._prefill_fns[bucket](
+                self.params, self._k, self._v, padded,
+                jnp.int32(p), jnp.int32(slot), sub,
+            )
         self._k, self._v = k, v
-        self._lengths = self._lengths.at[slot].set(p)
+        self._lengths = self._lengths.at[slot].set(total)
         self._last = self._last.at[slot].set(first)
         rid = self._next_rid
         self._next_rid += 1

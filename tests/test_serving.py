@@ -149,3 +149,66 @@ def test_admission_control():
     )
     with pytest.raises(AssertionError, match="no room"):
         tight.admit([1, 2, 3, 4])
+
+
+def test_prefix_cache_matches_full_prompt():
+    """A registered prefix + per-request prompt must produce EXACTLY
+    the stream of solo-generating on the concatenated sequence — the
+    cached K/V replaces the prefix's forward, never changes it."""
+    cfg = ModelConfig(**BASE, pos="rope")
+    params = init_params(cfg, jax.random.key(0))
+    eng = ServingEngine(
+        params, cfg, slots=2, max_len=64, prompt_buckets=(4, 8),
+    )
+    system = [7, 7, 30, 2, 51, 11]      # shared "system prompt"
+    pid = eng.register_prefix(system)
+
+    ua = [5, 17, 42]
+    ub = [61, 3]
+    ra = eng.admit(ua, prefix=pid)
+    rb = eng.admit(ub, prefix=pid)
+    # freeing the prefix K/V must not disturb in-flight requests
+    # (their slot rows hold a copy)
+    eng.release_prefix(pid)
+    for _ in range(6):
+        eng.step()
+    got_a = eng.release(ra)
+    got_b = eng.release(rb)
+    assert got_a == _oracle(params, cfg, system + ua, 7)
+    assert got_b == _oracle(params, cfg, system + ub, 7)
+
+
+def test_prefix_and_plain_admissions_interleave():
+    cfg = ModelConfig(**BASE, pos="rope", n_kv_heads=2)
+    params = init_params(cfg, jax.random.key(0))
+    eng = ServingEngine(
+        params, cfg, slots=2, max_len=64, prompt_buckets=(4, 8),
+    )
+    pid = eng.register_prefix([9, 88, 24])
+    r1 = eng.admit([5, 17], prefix=pid)
+    r2 = eng.admit([42, 61, 3])          # no prefix
+    for _ in range(4):
+        eng.step()
+    assert eng.release(r1) == _oracle(params, cfg, [9, 88, 24, 5, 17], 5)
+    assert eng.release(r2) == _oracle(params, cfg, [42, 61, 3], 5)
+
+
+def test_prefix_slot_reuse_after_longer_occupant():
+    """Prefix admission into a recycled slot whose previous occupant
+    grew past prefix+prompt: stale rows must stay invisible."""
+    cfg = ModelConfig(**BASE, pos="rope")
+    params = init_params(cfg, jax.random.key(0))
+    eng = ServingEngine(
+        params, cfg, slots=1, max_len=64, prompt_buckets=(4, 16),
+    )
+    long_p = list(range(2, 16))          # 14 tokens
+    r = eng.admit(long_p)
+    for _ in range(10):
+        eng.step()
+    eng.release(r)
+
+    pid = eng.register_prefix([5, 9])
+    r2 = eng.admit([31], prefix=pid)
+    for _ in range(6):
+        eng.step()
+    assert eng.release(r2) == _oracle(params, cfg, [5, 9, 31], 7)
