@@ -129,6 +129,12 @@ def main(argv=None) -> int:
              "(open with tensorboard or xprof)",
     )
     parser.add_argument(
+        "--accum-steps", type=int, default=1,
+        help="gradient accumulation: split --batch into this many "
+             "micro-batches per optimizer update (activation HBM drops "
+             "to one micro-batch; not supported with --pp)",
+    )
+    parser.add_argument(
         "--mode", choices=("train", "decode"), default="train",
         help="train: timed optimizer steps (default); decode: KV-cache "
              "generation throughput, optionally from a checkpoint",
@@ -176,6 +182,11 @@ def main(argv=None) -> int:
         from .pipeline import make_pipeline_mesh
         from .transformer_pipeline import make_pipeline_transformer_step
 
+        if args.accum_steps > 1:
+            parser.error(
+                "--accum-steps composes with the dp/sp/tp step only; "
+                "pipeline mode already micro-batches via --n-micro"
+            )
         if args.sp != 1 or (args.tp or 1) != 1:
             parser.error(
                 "--pp composes with --dp only; --sp/--tp are not supported "
@@ -200,9 +211,25 @@ def main(argv=None) -> int:
         )
     else:
         mesh = make_mesh(dp=args.dp, sp=args.sp, tp=args.tp)
-        train_step, init_all, _ = make_train_step(cfg, mesh)
+        if args.accum_steps < 1:
+            parser.error(f"--accum-steps {args.accum_steps} must be >= 1")
+        if args.accum_steps > 1 and args.batch % args.accum_steps:
+            parser.error(
+                f"--batch {args.batch} must divide into "
+                f"--accum-steps {args.accum_steps}"
+            )
+        train_step, init_all, _ = make_train_step(
+            cfg, mesh, accum_steps=args.accum_steps
+        )
+        shape = (
+            (args.batch, args.seq + 1) if args.accum_steps == 1
+            else (
+                args.accum_steps, args.batch // args.accum_steps,
+                args.seq + 1,
+            )
+        )
         tokens = jax.random.randint(
-            jax.random.key(1), (args.batch, args.seq + 1), 0, cfg.vocab
+            jax.random.key(1), shape, 0, cfg.vocab
         )
     params, opt_state = init_all(jax.random.key(0))
 
@@ -220,7 +247,9 @@ def main(argv=None) -> int:
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     token_sharding = NamedSharding(
-        mesh, P(None, "dp", None) if args.pp > 1 else P("dp", None)
+        mesh,
+        P(None, "dp", None) if (args.pp > 1 or args.accum_steps > 1)
+        else P("dp", None),
     )
 
     def tokens_for(step):
@@ -234,6 +263,10 @@ def main(argv=None) -> int:
         )
         if args.pp > 1:
             b = b.reshape(args.n_micro, args.batch // args.n_micro, -1)
+        elif args.accum_steps > 1:
+            b = b.reshape(
+                args.accum_steps, args.batch // args.accum_steps, -1
+            )
         if jax.process_count() == 1:
             return b  # one process: the local batch IS the global batch
         # Multi-host: each process holds only ITS shard of the global
@@ -413,8 +446,12 @@ def run_decode(args, cfg, applied) -> int:
     # new_tokens would bill the prefill to the per-token decode rate
     _, dt_prefill = timed(1)
     out, dt_full = timed(args.new_tokens)
-    decode_dt = max(dt_full - dt_prefill, 1e-9)
+    decode_dt = dt_full - dt_prefill
     decode_steps = args.new_tokens - 1
+    # two independent wall clocks: when prefill dominates, their noise
+    # can exceed the decode time — report null rather than a rate
+    # computed from a sub-noise (or negative) denominator
+    measurable = decode_steps > 0 and decode_dt > 0.02 * dt_full
 
     report = {
         "mode": "decode",
@@ -427,8 +464,12 @@ def run_decode(args, cfg, applied) -> int:
         "int8": bool(args.int8),
         "restored_step": restored_step,
         "prefill_ms": dt_prefill * 1000,
-        "decode_tokens_per_s": args.batch * decode_steps / decode_dt,
-        "ms_per_token": decode_dt / max(1, decode_steps) * 1000,
+        "decode_tokens_per_s": (
+            args.batch * decode_steps / decode_dt if measurable else None
+        ),
+        "ms_per_token": (
+            decode_dt / decode_steps * 1000 if measurable else None
+        ),
         "end_to_end_s": dt_full,
         "sample_tail": [int(t) for t in out[0, -5:]],
         "alloc_env": applied,

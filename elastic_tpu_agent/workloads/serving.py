@@ -240,6 +240,9 @@ class ServingEngine:
             f"prompt length {p} exceeds largest bucket {self.buckets[-1]}"
         )
         if prefix is not None:
+            assert prefix in self._prefixes, (
+                f"unknown or released prefix {prefix}"
+            )
             pref_k, pref_v, plen, pref_bucket = self._prefixes[prefix]
         else:
             plen, pref_bucket = 0, 0

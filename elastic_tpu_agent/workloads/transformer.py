@@ -445,16 +445,30 @@ def make_mesh(
 
 
 def make_train_step(
-    cfg: ModelConfig, mesh: Mesh, learning_rate: float = 1e-3
+    cfg: ModelConfig, mesh: Mesh, learning_rate: float = 1e-3,
+    accum_steps: int = 1,
 ):
     """(params, opt_state, tokens) -> (params, opt_state, loss), jit'd over
-    the mesh with real dp/sp/tp shardings."""
+    the mesh with real dp/sp/tp shardings.
+
+    accum_steps > 1 enables gradient accumulation: tokens gain a leading
+    micro-batch axis [accum, batch, seq+1], a lax.scan runs the
+    forward/backward per micro-batch summing f32 gradients, and ONE
+    optimizer update applies their mean — the effective batch grows
+    accum× while activation HBM stays at one micro-batch (the grad
+    accumulator costs one extra f32 param copy). For dense models the
+    result equals the fused batch up to summation order (pinned by
+    test); MoE models route/cap per micro-batch, so the aux loss and
+    capacity drops are micro-batch-local by construction."""
     optimizer = optax.adamw(learning_rate)
     p_shard = _full_param_shardings(mesh, cfg)
     # Input tokens carry seq_len+1 (targets are the shift-by-one), which is
     # rarely divisible by sp — shard them on dp only; the activation
     # constraint below shards the model-visible seq_len over sp.
-    data_shard = NamedSharding(mesh, P("dp", None))
+    data_shard = NamedSharding(
+        mesh,
+        P("dp", None) if accum_steps == 1 else P(None, "dp", None),
+    )
     act_shard = NamedSharding(mesh, P("dp", "sp", None))
     repl = NamedSharding(mesh, P())
 
@@ -471,7 +485,34 @@ def make_train_step(
         return jnp.mean(nll) + cfg.moe_aux_coef * aux
 
     def step(params, opt_state, tokens):
-        loss, grads = jax.value_and_grad(loss_fn)(params, tokens)
+        if accum_steps == 1:
+            loss, grads = jax.value_and_grad(loss_fn)(params, tokens)
+        else:
+            def micro(carry, mtokens):
+                gsum, lsum = carry
+                mloss, grads = jax.value_and_grad(loss_fn)(
+                    params, mtokens
+                )
+                gsum = jax.tree_util.tree_map(
+                    lambda a, g: a + g.astype(jnp.float32), gsum, grads
+                )
+                return (gsum, lsum + mloss), None
+
+            zeros = jax.tree_util.tree_map(
+                lambda p: jnp.zeros(p.shape, jnp.float32), params
+            )
+            (gsum, lsum), _ = jax.lax.scan(
+                micro, (zeros, jnp.float32(0.0)), tokens
+            )
+            # cast back to each param's dtype: today params are f32
+            # masters so this is a no-op, but a non-f32 master policy
+            # would otherwise promote adamw's moments and change the
+            # opt_state avals between the AOT compile and step 2
+            grads = jax.tree_util.tree_map(
+                lambda g, pp: (g / accum_steps).astype(pp.dtype),
+                gsum, params,
+            )
+            loss = lsum / accum_steps
         updates, opt_state = optimizer.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
         return params, opt_state, loss
