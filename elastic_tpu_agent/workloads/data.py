@@ -102,6 +102,7 @@ class TokenDataset:
         seq: int,
         dp_rank: int = 0,
         dp_size: int = 1,
+        region: "tuple[int, int] | None" = None,
     ) -> np.ndarray:
         """[batch, seq+1] int32 tokens for this host's shard of ``step``.
 
@@ -109,19 +110,48 @@ class TokenDataset:
         ``t*dp_size*batch + dp_rank*batch + k``, striding the stream in
         seq-token windows and wrapping at epoch end (the +1 column is
         the shift-by-one target, overlapping the next window by one
-        token like every LM data pipeline)."""
+        token like every LM data pipeline).
+
+        ``region`` = (first_seq, n_seqs) restricts sampling to a
+        contiguous range of sequence indices — how train/eval splits
+        share one file without overlap (see split_regions)."""
         if self.n_tokens < seq + 1:
             raise ValueError(
                 f"dataset has {self.n_tokens} tokens; need >= {seq + 1}"
             )
-        per_epoch = self.sequences_per_epoch(seq)
+        first, n_seqs = region or (0, self.sequences_per_epoch(seq))
+        assert n_seqs >= 1, region
         out = np.empty((batch, seq + 1), np.int32)
         base = step * dp_size * batch + dp_rank * batch
         for k in range(batch):
-            idx = (base + k) % per_epoch
+            idx = first + (base + k) % n_seqs
             start = idx * seq
             out[k] = self._tokens[start: start + seq + 1]
         return out
+
+    def split_regions(
+        self, seq: int, eval_frac: float
+    ) -> "tuple[tuple[int, int], tuple[int, int]]":
+        """((train_first, train_n), (eval_first, eval_n)): the LAST
+        max(1, floor(per_epoch * eval_frac)) of the file's sequence
+        windows (capped so train keeps at least one) is held out —
+        train wrapping never touches it, so eval loss measures
+        generalization, not memorization. At least one window is always
+        held out, even at eval_frac == 0. A file with a single window
+        cannot be split: raising beats silently evaluating on the
+        training data."""
+        per_epoch = self.sequences_per_epoch(seq)
+        if per_epoch < 2:
+            raise ValueError(
+                f"dataset has only {per_epoch} sequence window(s) of "
+                f"seq={seq}; a held-out split needs at least 2 "
+                "(eval on the training window would measure "
+                "memorization)"
+            )
+        n_eval = min(
+            max(1, int(per_epoch * eval_frac)), per_epoch - 1
+        )
+        return (0, per_epoch - n_eval), (per_epoch - n_eval, n_eval)
 
     def batches(
         self, batch: int, seq: int, dp_rank: int = 0, dp_size: int = 1,

@@ -134,6 +134,27 @@ def main(argv=None) -> int:
              "micro-batches per optimizer update (activation HBM drops "
              "to one micro-batch; not supported with --pp)",
     )
+    parser.add_argument("--lr", type=float, default=1e-3)
+    parser.add_argument(
+        "--warmup-steps", type=int, default=0,
+        help="linear warmup to --lr then cosine decay to 10%% over "
+             "--total-steps (0 = constant lr); dp/sp/tp mode only",
+    )
+    parser.add_argument(
+        "--total-steps", type=int, default=0,
+        help="schedule horizon across ALL invocations of a "
+             "checkpoint-resumed run (default: this run's --steps); "
+             "pass the same value on every resume so the lr curve "
+             "matches an uninterrupted run",
+    )
+    parser.add_argument(
+        "--eval-every", type=int, default=0,
+        help="held-out eval loss every N steps (0 = off; dp/sp/tp "
+             "mode only). With --data the LAST --eval-frac of the "
+             "file is held out of training",
+    )
+    parser.add_argument("--eval-batches", type=int, default=2)
+    parser.add_argument("--eval-frac", type=float, default=0.1)
     parser.add_argument(
         "--mode", choices=("train", "decode"), default="train",
         help="train: timed optimizer steps (default); decode: KV-cache "
@@ -187,6 +208,11 @@ def main(argv=None) -> int:
                 "--accum-steps composes with the dp/sp/tp step only; "
                 "pipeline mode already micro-batches via --n-micro"
             )
+        if args.warmup_steps > 0:
+            parser.error(
+                "--warmup-steps is not supported with --pp "
+                "(the pipeline step takes a constant --lr)"
+            )
         if args.sp != 1 or (args.tp or 1) != 1:
             parser.error(
                 "--pp composes with --dp only; --sp/--tp are not supported "
@@ -195,7 +221,8 @@ def main(argv=None) -> int:
         dp = args.dp or max(1, len(jax.devices()) // args.pp)
         mesh = make_pipeline_mesh(pp=args.pp, dp=dp)
         train_step, init_all = make_pipeline_transformer_step(
-            cfg, mesh, n_micro=args.n_micro, schedule=args.pp_schedule
+            cfg, mesh, n_micro=args.n_micro, schedule=args.pp_schedule,
+            learning_rate=args.lr,
         )
         assert args.batch % args.n_micro == 0, (
             f"--batch {args.batch} must divide into --n-micro {args.n_micro}"
@@ -215,11 +242,29 @@ def main(argv=None) -> int:
             parser.error(f"--accum-steps {args.accum_steps} must be >= 1")
         if args.accum_steps > 1 and args.batch % args.accum_steps:
             parser.error(
-                f"--batch {args.batch} must divide into "
-                f"--accum-steps {args.accum_steps}"
+                f"--accum-steps {args.accum_steps} must divide "
+                f"--batch {args.batch}"
             )
+        if args.warmup_steps > 0:
+            import optax
+
+            # The schedule horizon is --total-steps (default: this
+            # invocation's --steps). The optimizer's restored step
+            # count indexes the schedule, so a checkpoint-resumed run
+            # continues the SAME curve — provided every invocation
+            # passes the same --total-steps (a resumed run passing
+            # only its remaining --steps would compress the decay).
+            horizon = args.total_steps or args.steps
+            lr = optax.warmup_cosine_decay_schedule(
+                init_value=0.0, peak_value=args.lr,
+                warmup_steps=args.warmup_steps,
+                decay_steps=max(args.warmup_steps + 1, horizon),
+                end_value=args.lr * 0.1,
+            )
+        else:
+            lr = args.lr
         train_step, init_all, _ = make_train_step(
-            cfg, mesh, accum_steps=args.accum_steps
+            cfg, mesh, learning_rate=lr, accum_steps=args.accum_steps
         )
         shape = (
             (args.batch, args.seq + 1) if args.accum_steps == 1
@@ -252,6 +297,44 @@ def main(argv=None) -> int:
         else P("dp", None),
     )
 
+    # Held-out eval: the file's LAST --eval-frac sequence windows never
+    # enter training, so the eval number measures generalization.
+    # dp/sp/tp mode only (the pipeline mesh has no tp/sp axes for the
+    # eval fn's shardings).
+    train_region = eval_region = None
+    eval_fn = None
+    if args.eval_every > 0:
+        if args.pp > 1:
+            parser.error("--eval-every is not supported with --pp")
+        from .transformer import make_eval_fn
+
+        eval_fn = make_eval_fn(cfg, mesh)
+        if dataset is not None:
+            train_region, eval_region = dataset.split_regions(
+                args.seq, args.eval_frac
+            )
+        eval_sharding = NamedSharding(mesh, P("dp", None))
+
+        def eval_batch(j):
+            if dataset is None:
+                # synthetic: a fixed batch disjoint from the training
+                # key stream
+                return jax.random.randint(
+                    jax.random.key(10_000 + j),
+                    (args.batch, args.seq + 1), 0, cfg.vocab,
+                )
+            b = dataset.batch(
+                j, args.batch, args.seq,
+                dp_rank=jax.process_index(),
+                dp_size=jax.process_count(),
+                region=eval_region,
+            )
+            if jax.process_count() == 1:
+                return b
+            return jax.make_array_from_process_local_data(
+                eval_sharding, b
+            )
+
     def tokens_for(step):
         """Per-step batch: deterministic dataset shard (this process's
         slice of the global batch) or the fixed synthetic tokens."""
@@ -260,6 +343,7 @@ def main(argv=None) -> int:
         b = dataset.batch(
             step, args.batch, args.seq,
             dp_rank=jax.process_index(), dp_size=jax.process_count(),
+            region=train_region,
         )
         if args.pp > 1:
             b = b.reshape(args.n_micro, args.batch // args.n_micro, -1)
@@ -308,12 +392,25 @@ def main(argv=None) -> int:
     t0 = time.perf_counter()
     ran = 0
     loss = None
+    eval_hist = []
+    eval_s = 0.0  # eval wall time, subtracted from step accounting
     try:
         for step in range(start_step, start_step + args.steps):
             params, opt_state, loss = train_step(
                 params, opt_state, tokens_for(step)
             )
             ran += 1
+            if eval_fn is not None and (step + 1) % args.eval_every == 0:
+                te = time.perf_counter()
+                vals = [
+                    float(eval_fn(params, eval_batch(j)))
+                    for j in range(max(1, args.eval_batches))
+                ]
+                eval_s += time.perf_counter() - te
+                eval_hist.append({
+                    "step": step,
+                    "loss": sum(vals) / len(vals),
+                })
             if ckpt is not None and (
                 preempted["flag"] or (every > 0 and (step + 1) % every == 0)
             ):
@@ -327,7 +424,7 @@ def main(argv=None) -> int:
         # the one whose trace you want readable
         if args.profile_dir:
             jax.profiler.stop_trace()
-    dt = time.perf_counter() - t0
+    dt = time.perf_counter() - t0 - eval_s
     if ckpt is not None:
         ckpt.wait()
         ckpt.close()
@@ -350,6 +447,12 @@ def main(argv=None) -> int:
         "alloc_env": applied,
         "preempted": preempted["flag"],
     }
+    if eval_hist:
+        report["eval"] = eval_hist
+    if args.warmup_steps > 0:
+        report["lr_schedule"] = {
+            "peak": args.lr, "warmup_steps": args.warmup_steps,
+        }
     print(json.dumps(report))
     return 0
 

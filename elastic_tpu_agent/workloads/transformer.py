@@ -459,7 +459,12 @@ def make_train_step(
     accumulator costs one extra f32 param copy). For dense models the
     result equals the fused batch up to summation order (pinned by
     test); MoE models route/cap per micro-batch, so the aux loss and
-    capacity drops are micro-batch-local by construction."""
+    capacity drops are micro-batch-local by construction.
+
+    learning_rate may be a float or any optax schedule (a callable
+    step -> lr), e.g. optax.warmup_cosine_decay_schedule — adamw
+    threads it through; the step count lives in the optimizer state,
+    so checkpoint resume continues the schedule where it left off."""
     optimizer = optax.adamw(learning_rate)
     p_shard = _full_param_shardings(mesh, cfg)
     # Input tokens carry seq_len+1 (targets are the shift-by-one), which is
@@ -554,6 +559,33 @@ def make_train_step(
         donate_argnums=(0, 1),
     )
     return train_step, init_all, optimizer
+
+
+def make_eval_fn(cfg: ModelConfig, mesh: Mesh):
+    """(params, tokens [b, seq+1]) -> mean NLL, jit'd over the mesh.
+
+    Pure next-token cross-entropy — no optimizer, no MoE aux term (aux
+    is a ROUTING regularizer; quoting it in an eval number would let
+    router balance shifts masquerade as modeling progress)."""
+    p_shard = _full_param_shardings(mesh, cfg)
+    data_shard = NamedSharding(mesh, P("dp", None))
+    act_shard = NamedSharding(mesh, P("dp", "sp", None))
+    repl = NamedSharding(mesh, P())
+
+    def eval_loss(params, tokens):
+        logits, _ = forward_with_aux(
+            params, tokens[:, :-1], cfg, activation_sharding=act_shard
+        )
+        nll = optax.softmax_cross_entropy_with_integer_labels(
+            logits.astype(jnp.float32), tokens[:, 1:]
+        )
+        return jnp.mean(nll)
+
+    return jax.jit(
+        eval_loss,
+        in_shardings=(p_shard, data_shard),
+        out_shardings=repl,
+    )
 
 
 def make_forward(cfg: ModelConfig):

@@ -122,3 +122,51 @@ def test_runner_trains_on_dataset(tmp_path):
     report = json.loads(out.stdout.strip().splitlines()[-1])
     # tiny preset vocab 2048 >= byte vocab 256; random-chance nll ~ln(256)=5.5
     assert report["final_loss"] < 3.0, report["final_loss"]
+
+
+def test_split_regions_disjoint_and_respected(tmp_path):
+    import numpy as np
+
+    from elastic_tpu_agent.workloads.data import (
+        TokenDataset,
+        write_token_file,
+    )
+
+    # token value == stream position, so a row's first token names its
+    # window index exactly (no model here — no vocab cap applies)
+    path = str(tmp_path / "t.bin")
+    write_token_file(path, np.arange(0, 1000, dtype=np.int32))
+    ds = TokenDataset(path)
+    seq = 10
+    (t0, tn), (e0, en) = ds.split_regions(seq, eval_frac=0.2)
+    per_epoch = ds.sequences_per_epoch(seq)
+    assert t0 == 0 and e0 == tn and tn + en == per_epoch
+    assert en == max(1, int(per_epoch * 0.2))
+
+    # training batches wrap INSIDE the train region: no index ever
+    # reaches the held-out windows
+    for step in range(3 * per_epoch):
+        b = ds.batch(step, 4, seq, region=(t0, tn))
+        # first token of each row identifies its window index
+        idx = (np.asarray(b)[:, 0].astype(np.int64)) // seq
+        assert (idx < tn).all(), (step, idx)
+    # eval batches come only from the held-out windows
+    b = ds.batch(0, 4, seq, region=(e0, en))
+    idx = (np.asarray(b)[:, 0].astype(np.int64)) // seq
+    assert (idx >= e0).all()
+
+
+def test_split_regions_rejects_single_window(tmp_path):
+    import numpy as np
+    import pytest as _pytest
+
+    from elastic_tpu_agent.workloads.data import (
+        TokenDataset,
+        write_token_file,
+    )
+
+    path = str(tmp_path / "small.bin")
+    write_token_file(path, np.arange(0, 15, dtype=np.int32))
+    ds = TokenDataset(path)
+    with _pytest.raises(ValueError, match="held-out split"):
+        ds.split_regions(seq=10, eval_frac=0.1)

@@ -161,3 +161,69 @@ def test_grad_accumulation_equals_fused_batch():
         np.testing.assert_allclose(
             np.asarray(a), np.asarray(b), atol=2e-5, rtol=2e-5
         )
+
+
+def test_make_eval_fn_is_plain_nll():
+    import numpy as np
+    import optax
+
+    from elastic_tpu_agent.workloads.transformer import (
+        forward, make_eval_fn,
+    )
+
+    cfg = ModelConfig(
+        vocab=128, d_model=32, n_heads=4, n_layers=2, d_ff=64,
+        max_seq=32, dtype=jnp.float32,
+    )
+    mesh = make_mesh(8, dp=2, sp=1, tp=4)
+    _, init_all, _ = make_train_step(cfg, mesh)
+    params, _ = init_all(jax.random.key(0))
+    tokens = jax.random.randint(jax.random.key(1), (4, 17), 0, cfg.vocab)
+    got = float(make_eval_fn(cfg, mesh)(params, tokens))
+    logits = forward(params, tokens[:, :-1], cfg).astype(jnp.float32)
+    want = float(jnp.mean(
+        optax.softmax_cross_entropy_with_integer_labels(
+            logits, tokens[:, 1:]
+        )
+    ))
+    assert abs(got - want) < 1e-4, (got, want)
+
+
+def test_runner_eval_and_warmup(tmp_path):
+    """Runner with held-out eval + lr warmup: the report carries the
+    eval history and schedule block; eval losses are finite."""
+    import json
+    import math
+    import subprocess
+    import sys
+
+    import numpy as np
+
+    from elastic_tpu_agent.workloads.data import write_token_file
+
+    data = str(tmp_path / "tokens.bin")
+    rng = np.random.default_rng(0)
+    write_token_file(
+        data, rng.integers(0, 2000, size=40_000).astype(np.int32)
+    )
+    env = {
+        **__import__("os").environ,
+        "JAX_PLATFORMS": "cpu",
+        "ELASTIC_TPU_ENV_FILE": str(tmp_path / "absent"),
+    }
+    out = subprocess.run(
+        [
+            sys.executable, "-m", "elastic_tpu_agent.workloads.runner",
+            "--preset", "tiny", "--steps", "4", "--batch", "4",
+            "--seq", "32", "--data", data,
+            "--eval-every", "2", "--eval-batches", "1",
+            "--warmup-steps", "2", "--lr", "3e-3",
+        ],
+        env=env, capture_output=True, text=True, timeout=420,
+    )
+    assert out.returncode == 0, out.stderr[-800:]
+    report = json.loads(out.stdout.strip().splitlines()[-1])
+    assert report["lr_schedule"] == {"peak": 3e-3, "warmup_steps": 2}
+    evals = report["eval"]
+    assert [e["step"] for e in evals] == [1, 3]
+    assert all(math.isfinite(e["loss"]) and e["loss"] > 0 for e in evals)
