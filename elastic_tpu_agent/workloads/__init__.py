@@ -57,10 +57,14 @@ __all__ = [
 
 
 def __getattr__(name):
-    # Lazy: checkpointing pulls in orbax, which plain training/bench paths
-    # (and images without orbax) must not require.
+    # Lazy: checkpointing/export pull in orbax, which plain
+    # training/bench paths (and images without orbax) must not require.
     if name == "TrainCheckpointer":
         from .checkpointing import TrainCheckpointer
 
         return TrainCheckpointer
+    if name in ("save_artifact", "load_artifact", "export_checkpoint"):
+        from . import export
+
+        return getattr(export, name)
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

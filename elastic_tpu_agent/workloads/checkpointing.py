@@ -81,6 +81,35 @@ class TrainCheckpointer:
         )
         return restored["params"], restored["opt_state"], step
 
+    def restore_params(
+        self, params_like: Any, step: Optional[int] = None,
+    ) -> Tuple[Any, int]:
+        """Params-only restore for consumers that discard the optimizer
+        (export, decode). StandardRestore matches STRUCTURE, and the
+        adamw opt_state's structure depends on how the training run
+        passed its learning rate — a float builds an empty ScaleState,
+        a schedule builds ScaleByScheduleState(count) — so try a
+        template of each form; the restored opt values are thrown away
+        either way."""
+        import optax
+
+        last_err: Optional[Exception] = None
+        for make_opt in (
+            lambda: optax.adamw(1e-3),
+            lambda: optax.adamw(optax.constant_schedule(1e-3)),
+        ):
+            opt_tmpl = make_opt().init(params_like)
+            try:
+                params, _, got = self.restore(
+                    params_like, opt_tmpl, step
+                )
+                return params, got
+            except FileNotFoundError:
+                raise
+            except Exception as e:  # noqa: BLE001 - structure mismatch
+                last_err = e
+        raise last_err
+
     def wait(self) -> None:
         """Block until any async save has committed (call before exit)."""
         self._mgr.wait_until_finished()

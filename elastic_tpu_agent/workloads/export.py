@@ -1,0 +1,165 @@
+"""Serving-artifact export: turn a training checkpoint into a
+self-contained directory a serving process loads without knowing
+anything about the training run.
+
+The train side persists (params, opt_state, step) for RESUME
+(checkpointing.py). Serving wants none of that: it needs the weights
+(optionally int8-quantized, optionally with LoRA adapters already
+merged) plus the exact ModelConfig to rebuild the decode program. An
+artifact here is:
+
+    <dir>/weights/...   one orbax StandardSave of the params pytree
+                        (float, or the int8 {"q","s"} form — orbax is
+                        structure-agnostic)
+    <dir>/config.json   the ModelConfig, with the dtype field
+                        serialized by name
+
+CLI: convert the latest train checkpoint in one shot —
+
+    python -m elastic_tpu_agent.workloads.export \
+        --checkpoint-dir /ckpt --preset small --seq 1024 \
+        --out /artifact --int8
+
+`generate`/`ServingEngine`/`decode_shardings` consume load_artifact's
+result directly; runner decode mode serves it via --params-dir.
+
+No reference counterpart (the reference agent has no model code);
+TPU workload stack, same family as checkpointing.py.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Any, Dict, Tuple
+
+import jax.numpy as jnp
+
+from .transformer import ModelConfig
+
+_DTYPES = {
+    "bfloat16": jnp.bfloat16,
+    "float32": jnp.float32,
+    "float16": jnp.float16,
+}
+
+
+def _cfg_to_json(cfg: ModelConfig) -> Dict[str, Any]:
+    d = dataclasses.asdict(cfg)
+    d["dtype"] = jnp.dtype(cfg.dtype).name
+    return d
+
+
+def _cfg_from_json(d: Dict[str, Any]) -> ModelConfig:
+    d = dict(d)
+    name = d.pop("dtype")
+    assert name in _DTYPES, f"unknown dtype {name!r} in artifact config"
+    return ModelConfig(dtype=_DTYPES[name], **d)
+
+
+def save_artifact(directory: str, params: Dict, cfg: ModelConfig) -> None:
+    """Write a serving artifact. ``params`` may be the float tree, the
+    int8 weight-only form (quantize.quantize_params), or a merged-LoRA
+    tree — any pytree of arrays."""
+    import orbax.checkpoint as ocp
+
+    directory = os.path.abspath(directory)
+    os.makedirs(directory, exist_ok=True)
+    with ocp.StandardCheckpointer() as ckptr:
+        ckptr.save(os.path.join(directory, "weights"), params)
+    with open(os.path.join(directory, "config.json"), "w") as f:
+        json.dump(_cfg_to_json(cfg), f, indent=1, sort_keys=True)
+
+
+def load_artifact(directory: str) -> Tuple[Dict, ModelConfig]:
+    """(params, cfg) from a save_artifact directory. Arrays come back
+    on the default device; shard for serving with
+    generate.decode_shardings(mesh, cfg, params=params)."""
+    import orbax.checkpoint as ocp
+
+    directory = os.path.abspath(directory)
+    with open(os.path.join(directory, "config.json")) as f:
+        cfg = _cfg_from_json(json.load(f))
+    with ocp.StandardCheckpointer() as ckptr:
+        params = ckptr.restore(os.path.join(directory, "weights"))
+    return params, cfg
+
+
+def export_checkpoint(
+    checkpoint_dir: str,
+    out_dir: str,
+    cfg: ModelConfig,
+    int8: bool = False,
+) -> Dict[str, Any]:
+    """Latest train checkpoint -> serving artifact. Returns a summary
+    dict (step, bytes, int8). LoRA adapters are not part of the train
+    checkpoint format; merge them BEFORE exporting (lora.merge_lora)
+    and export the merged tree via save_artifact directly."""
+    import jax
+
+    from .checkpointing import TrainCheckpointer
+    from .transformer import init_params
+
+    ckpt = TrainCheckpointer(checkpoint_dir)
+    if ckpt.latest_step is None:
+        raise SystemExit(
+            f"{checkpoint_dir} holds no checkpoint to export"
+        )
+    params = init_params(cfg, jax.random.key(0))
+    params, step = ckpt.restore_params(params)
+    ckpt.close()
+
+    if int8:
+        from .quantize import quantize_params
+
+        params = jax.jit(quantize_params)(params)
+        jax.block_until_ready(params)
+
+    save_artifact(out_dir, params, cfg)
+    n_bytes = sum(
+        p.size * p.dtype.itemsize
+        for p in jax.tree_util.tree_leaves(params)
+    )
+    return {
+        "step": step,
+        "int8": int8,
+        "bytes": n_bytes,
+        "out": os.path.abspath(out_dir),
+    }
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    from .runner import PRESETS
+
+    parser = argparse.ArgumentParser(
+        description="export a train checkpoint as a serving artifact"
+    )
+    parser.add_argument("--checkpoint-dir", required=True)
+    parser.add_argument("--out", required=True)
+    parser.add_argument(
+        "--preset", choices=sorted(PRESETS), default="small",
+        help="must match the training run's preset",
+    )
+    parser.add_argument("--seq", type=int, default=256)
+    parser.add_argument("--kv-heads", type=int, default=0)
+    parser.add_argument("--int8", action="store_true")
+    args = parser.parse_args(argv)
+
+    cfg = ModelConfig(
+        max_seq=args.seq, n_kv_heads=args.kv_heads,
+        **PRESETS[args.preset],
+    )
+    summary = export_checkpoint(
+        args.checkpoint_dir, args.out, cfg, int8=args.int8
+    )
+    print(json.dumps(summary))
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

@@ -173,6 +173,11 @@ def main(argv=None) -> int:
         help="decode mode: int8 weight-only quantization "
              "(workloads/quantize.py)",
     )
+    parser.add_argument(
+        "--params-dir", default="",
+        help="decode mode: serve an exported artifact "
+             "(workloads/export.py); its config overrides --preset",
+    )
     parser.add_argument("--temperature", type=float, default=0.0)
     parser.add_argument("--top-k", type=int, default=0)
     parser.add_argument("--top-p", type=float, default=0.0)
@@ -459,9 +464,11 @@ def main(argv=None) -> int:
 
 def run_decode(args, cfg, applied) -> int:
     """Decode-mode body: synthetic prompts -> KV-cache generation
-    throughput, optionally restoring trained params from
-    --checkpoint-dir and/or quantizing to int8. Shards over dp/tp via
-    decode_shardings when the mesh has more than one device."""
+    throughput. Weights come from --params-dir (a serving artifact,
+    workloads/export.py — its config overrides --preset), from
+    --checkpoint-dir (restore-only), or fresh init; --int8 quantizes
+    on the way in. Shards over dp/tp via decode_shardings when the
+    mesh has more than one device."""
     import jax
 
     from .generate import decode_shardings, generate
@@ -474,24 +481,37 @@ def run_decode(args, cfg, applied) -> int:
             "cross-process mesh (train mode initializes inside jit)"
         )
 
+    artifact_params = None
+    if args.params_dir:
+        if args.checkpoint_dir:
+            raise SystemExit(
+                "--params-dir and --checkpoint-dir are exclusive "
+                "(an artifact already IS the exported checkpoint)"
+            )
+        from .export import load_artifact
+
+        artifact_params, cfg = load_artifact(args.params_dir)
+
     max_len = args.prompt_len + args.new_tokens
     if cfg.pos == "learned" and cfg.max_seq < max_len:
-        if args.checkpoint_dir:
+        if args.checkpoint_dir or args.params_dir:
             # a trained position table has the trained length; widening
             # the restore template would shape-mismatch orbax, and a
             # learned table can't extrapolate anyway
             raise SystemExit(
-                f"decode length {max_len} exceeds the checkpoint's "
+                f"decode length {max_len} exceeds the trained "
                 f"max_seq {cfg.max_seq}; shorten --prompt-len/"
                 "--new-tokens or retrain with a longer --seq"
             )
         cfg = dataclasses.replace(cfg, max_seq=max_len)
 
-    params = init_params(cfg, jax.random.key(0))
     restored_step = None
+    if artifact_params is not None:
+        params = artifact_params
+        restored_step = "artifact"
+    else:
+        params = init_params(cfg, jax.random.key(0))
     if args.checkpoint_dir:
-        import optax
-
         from .checkpointing import TrainCheckpointer
 
         ckpt = TrainCheckpointer(args.checkpoint_dir)
@@ -503,10 +523,9 @@ def run_decode(args, cfg, applied) -> int:
                 "checkpoint (decode mode serves trained params; train "
                 "first or drop the flag)"
             )
-        # the optimizer template exists only to satisfy the saved
-        # tree's structure; its arrays are discarded immediately
-        opt_tmpl = optax.adamw(1e-3).init(params)
-        params, _, restored_step = ckpt.restore(params, opt_tmpl)
+        # params-only restore tolerating either optimizer form
+        # (float lr vs schedule) the training run used
+        params, restored_step = ckpt.restore_params(params)
         ckpt.close()
 
     if args.int8:

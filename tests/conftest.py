@@ -11,6 +11,18 @@ import sys
 # Force CPU even when the environment pre-sets JAX_PLATFORMS to a real TPU
 # backend — tests must never grab the chip (bench.py does, deliberately).
 os.environ["JAX_PLATFORMS"] = "cpu"
+
+# Strip the TPU-relay plugin's environment entirely: even under
+# JAX_PLATFORMS=cpu, PJRT_LIBRARY_PATH/AXON_* make every fresh Python
+# (including the REAL subprocesses our runner/fullchain tests spawn)
+# register the relay plugin at jax import, and a wedged relay then
+# hangs that import nondeterministically. Tests and their children
+# must be immune to relay health.
+for _k in list(os.environ):
+    if _k.startswith(("AXON_", "PALLAS_AXON_", "TPU_")) or _k in (
+        "PJRT_LIBRARY_PATH", "_AXON_REGISTERED",
+    ):
+        os.environ.pop(_k)
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
