@@ -62,6 +62,26 @@ HostDevRoot = os.environ.get("ELASTIC_TPU_HOST_DEV", "/host/dev")
 # (reference: common.go:4 UselessNumber).
 USELESS_NUMBER = -1
 
+# TPU-relay (PJRT plugin) environment: registration happens at jax
+# IMPORT regardless of the selected platform, and a wedged relay hangs
+# it nondeterministically — CPU-pinned processes (tests and their real
+# subprocesses, the driver's dryrun) strip these before importing jax.
+# One list, imported by every strip site (tests/conftest.py,
+# __graft_entry__.py): a new relay var added to one copy but not the
+# other would bring the hang back.
+RELAY_ENV_PREFIXES = ("AXON_", "PALLAS_AXON_", "TPU_")
+RELAY_ENV_VARS = ("PJRT_LIBRARY_PATH", "_AXON_REGISTERED")
+
+
+def strip_relay_env(environ=None) -> None:
+    """Remove the relay plugin's env vars in place (default:
+    os.environ). Call BEFORE the first jax import of a CPU-pinned
+    process."""
+    env = os.environ if environ is None else environ
+    for k in list(env):
+        if k.startswith(RELAY_ENV_PREFIXES) or k in RELAY_ENV_VARS:
+            env.pop(k)
+
 NEVER_STOP: "threading.Event" = threading.Event()  # never set: wait forever
 
 

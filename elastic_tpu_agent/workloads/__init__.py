@@ -34,12 +34,14 @@ __all__ = [
     "beam_search",
     "decode_shardings",
     "dequantize_params",
+    "export_checkpoint",
     "forward",
     "forward_with_aux",
     "generate",
     "init_lora_params",
     "init_moe_params",
     "init_params",
+    "load_artifact",
     "make_lora_train_step",
     "merge_lora",
     "make_mesh",
@@ -51,6 +53,7 @@ __all__ = [
     "param_shardings",
     "pipeline_apply",
     "quantize_params",
+    "save_artifact",
     "speculative_generate",
     "streaming_generate",
 ]

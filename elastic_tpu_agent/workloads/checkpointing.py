@@ -24,6 +24,17 @@ import orbax.checkpoint as ocp
 logger = logging.getLogger(__name__)
 
 
+def _as_abstract(tree: Any) -> Any:
+    """ShapeDtypeStruct mirror of a pytree, preserving shardings so
+    orbax lays restored arrays out on the same mesh."""
+    return jax.tree.map(
+        lambda a: jax.ShapeDtypeStruct(
+            a.shape, a.dtype, sharding=getattr(a, "sharding", None),
+        ),
+        tree,
+    )
+
+
 class TrainCheckpointer:
     """CheckpointManager wrapper: save/restore (params, opt_state) at a
     step, keeping the newest ``keep`` checkpoints."""
@@ -61,21 +72,12 @@ class TrainCheckpointer:
         if step is None:
             raise FileNotFoundError("no checkpoint present")
 
-        def as_abstract(tree):
-            return jax.tree.map(
-                lambda a: jax.ShapeDtypeStruct(
-                    a.shape, a.dtype,
-                    sharding=getattr(a, "sharding", None),
-                ),
-                tree,
-            )
-
         restored = self._mgr.restore(
             step,
             args=ocp.args.Composite(
-                params=ocp.args.StandardRestore(as_abstract(params_like)),
+                params=ocp.args.StandardRestore(_as_abstract(params_like)),
                 opt_state=ocp.args.StandardRestore(
-                    as_abstract(opt_state_like)
+                    _as_abstract(opt_state_like)
                 ),
             ),
         )
@@ -85,30 +87,23 @@ class TrainCheckpointer:
         self, params_like: Any, step: Optional[int] = None,
     ) -> Tuple[Any, int]:
         """Params-only restore for consumers that discard the optimizer
-        (export, decode). StandardRestore matches STRUCTURE, and the
-        adamw opt_state's structure depends on how the training run
-        passed its learning rate — a float builds an empty ScaleState,
-        a schedule builds ScaleByScheduleState(count) — so try a
-        template of each form; the restored opt values are thrown away
-        either way."""
-        import optax
-
-        last_err: Optional[Exception] = None
-        for make_opt in (
-            lambda: optax.adamw(1e-3),
-            lambda: optax.adamw(optax.constant_schedule(1e-3)),
-        ):
-            opt_tmpl = make_opt().init(params_like)
-            try:
-                params, _, got = self.restore(
-                    params_like, opt_tmpl, step
-                )
-                return params, got
-            except FileNotFoundError:
-                raise
-            except Exception as e:  # noqa: BLE001 - structure mismatch
-                last_err = e
-        raise last_err
+        (export, decode): a PARTIAL orbax restore of just the params
+        item — the opt_state is never read, so its structure (which
+        varies with how the training run passed its learning rate)
+        cannot matter and no template guessing is needed."""
+        if step is None:
+            step = self._mgr.latest_step()
+        if step is None:
+            raise FileNotFoundError("no checkpoint present")
+        restored = self._mgr.restore(
+            step,
+            args=ocp.args.Composite(
+                params=ocp.args.StandardRestore(
+                    _as_abstract(params_like)
+                ),
+            ),
+        )
+        return restored["params"], step
 
     def wait(self) -> None:
         """Block until any async save has committed (call before exit)."""

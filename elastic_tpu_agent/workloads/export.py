@@ -54,7 +54,8 @@ def _cfg_to_json(cfg: ModelConfig) -> Dict[str, Any]:
 def _cfg_from_json(d: Dict[str, Any]) -> ModelConfig:
     d = dict(d)
     name = d.pop("dtype")
-    assert name in _DTYPES, f"unknown dtype {name!r} in artifact config"
+    if name not in _DTYPES:
+        raise ValueError(f"unknown dtype {name!r} in artifact config")
     return ModelConfig(dtype=_DTYPES[name], **d)
 
 
@@ -103,7 +104,8 @@ def export_checkpoint(
 
     ckpt = TrainCheckpointer(checkpoint_dir)
     if ckpt.latest_step is None:
-        raise SystemExit(
+        # library API: catchable (main() maps it to an exit message)
+        raise FileNotFoundError(
             f"{checkpoint_dir} holds no checkpoint to export"
         )
     params = init_params(cfg, jax.random.key(0))
@@ -152,9 +154,12 @@ def main(argv=None) -> int:
         max_seq=args.seq, n_kv_heads=args.kv_heads,
         **PRESETS[args.preset],
     )
-    summary = export_checkpoint(
-        args.checkpoint_dir, args.out, cfg, int8=args.int8
-    )
+    try:
+        summary = export_checkpoint(
+            args.checkpoint_dir, args.out, cfg, int8=args.int8
+        )
+    except FileNotFoundError as e:
+        raise SystemExit(str(e)) from e
     print(json.dumps(summary))
     return 0
 

@@ -17,12 +17,12 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 # (including the REAL subprocesses our runner/fullchain tests spawn)
 # register the relay plugin at jax import, and a wedged relay then
 # hangs that import nondeterministically. Tests and their children
-# must be immune to relay health.
-for _k in list(os.environ):
-    if _k.startswith(("AXON_", "PALLAS_AXON_", "TPU_")) or _k in (
-        "PJRT_LIBRARY_PATH", "_AXON_REGISTERED",
-    ):
-        os.environ.pop(_k)
+# must be immune to relay health. (The var list lives in common.py,
+# shared with __graft_entry__.py's identical guard.)
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from elastic_tpu_agent.common import strip_relay_env  # noqa: E402
+
+strip_relay_env()
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
