@@ -16,6 +16,7 @@ The reference has no workload code at all (SURVEY.md §2); its
 from __future__ import annotations
 
 import logging
+import os
 from typing import Any, Optional, Tuple
 
 import jax
@@ -51,14 +52,20 @@ class TrainCheckpointer:
     def latest_step(self) -> Optional[int]:
         return self._mgr.latest_step()
 
-    def save(self, step: int, params: Any, opt_state: Any) -> None:
-        self._mgr.save(
-            step,
-            args=ocp.args.Composite(
-                params=ocp.args.StandardSave(params),
-                opt_state=ocp.args.StandardSave(opt_state),
-            ),
-        )
+    def save(
+        self, step: int, params: Any, opt_state: Any, ema: Any = None,
+    ) -> None:
+        """``ema``: the EMA tree (transformer.ema_params(opt_state)) as
+        its OWN item. It already lives inside opt_state for resume;
+        the separate item lets export/serving restore it with a plain
+        params template, independent of the optimizer's structure."""
+        items = {
+            "params": ocp.args.StandardSave(params),
+            "opt_state": ocp.args.StandardSave(opt_state),
+        }
+        if ema is not None:
+            items["ema"] = ocp.args.StandardSave(ema)
+        self._mgr.save(step, args=ocp.args.Composite(**items))
 
     def restore(
         self, params_like: Any, opt_state_like: Any,
@@ -85,25 +92,42 @@ class TrainCheckpointer:
 
     def restore_params(
         self, params_like: Any, step: Optional[int] = None,
+        item: str = "params",
     ) -> Tuple[Any, int]:
         """Params-only restore for consumers that discard the optimizer
-        (export, decode): a PARTIAL orbax restore of just the params
-        item — the opt_state is never read, so its structure (which
-        varies with how the training run passed its learning rate)
-        cannot matter and no template guessing is needed."""
+        (export, decode): a PARTIAL orbax restore of just one
+        param-shaped item — the opt_state is never read, so its
+        structure (which varies with how the training run passed its
+        learning rate) cannot matter and no template guessing is
+        needed. ``item='ema'`` restores the EMA weights saved by
+        save(..., ema=...)."""
         if step is None:
             step = self._mgr.latest_step()
         if step is None:
             raise FileNotFoundError("no checkpoint present")
+        # item presence is checked UP FRONT (orbax writes one subdir
+        # per item) so a real restore failure — wrong preset template,
+        # corrupt data — surfaces as itself, not as "item missing"
+        item_dir = os.path.join(
+            str(self._mgr.directory), str(step), item
+        )
+        if not os.path.isdir(item_dir):
+            raise FileNotFoundError(
+                f"checkpoint step {step} has no {item!r} item"
+                + (
+                    " (train with --ema-decay to save EMA weights)"
+                    if item == "ema" else ""
+                )
+            )
         restored = self._mgr.restore(
             step,
-            args=ocp.args.Composite(
-                params=ocp.args.StandardRestore(
+            args=ocp.args.Composite(**{
+                item: ocp.args.StandardRestore(
                     _as_abstract(params_like)
                 ),
-            ),
+            }),
         )
-        return restored["params"], step
+        return restored[item], step
 
     def wait(self) -> None:
         """Block until any async save has committed (call before exit)."""

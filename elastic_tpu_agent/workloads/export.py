@@ -92,11 +92,14 @@ def export_checkpoint(
     out_dir: str,
     cfg: ModelConfig,
     int8: bool = False,
+    ema: bool = False,
 ) -> Dict[str, Any]:
     """Latest train checkpoint -> serving artifact. Returns a summary
-    dict (step, bytes, int8). LoRA adapters are not part of the train
-    checkpoint format; merge them BEFORE exporting (lora.merge_lora)
-    and export the merged tree via save_artifact directly."""
+    dict (step, bytes, int8, ema). ``ema=True`` exports the smoothed
+    weights a --ema-decay training run saved. LoRA adapters are not
+    part of the train checkpoint format; merge them BEFORE exporting
+    (lora.merge_lora) and export the merged tree via save_artifact
+    directly."""
     import jax
 
     from .checkpointing import TrainCheckpointer
@@ -109,7 +112,9 @@ def export_checkpoint(
             f"{checkpoint_dir} holds no checkpoint to export"
         )
     params = init_params(cfg, jax.random.key(0))
-    params, step = ckpt.restore_params(params)
+    params, step = ckpt.restore_params(
+        params, item="ema" if ema else "params"
+    )
     ckpt.close()
 
     if int8:
@@ -126,6 +131,7 @@ def export_checkpoint(
     return {
         "step": step,
         "int8": int8,
+        "ema": ema,
         "bytes": n_bytes,
         "out": os.path.abspath(out_dir),
     }
@@ -148,6 +154,11 @@ def main(argv=None) -> int:
     parser.add_argument("--seq", type=int, default=256)
     parser.add_argument("--kv-heads", type=int, default=0)
     parser.add_argument("--int8", action="store_true")
+    parser.add_argument(
+        "--ema", action="store_true",
+        help="export the EMA weights (requires an --ema-decay "
+             "training run)",
+    )
     args = parser.parse_args(argv)
 
     cfg = ModelConfig(
@@ -156,7 +167,8 @@ def main(argv=None) -> int:
     )
     try:
         summary = export_checkpoint(
-            args.checkpoint_dir, args.out, cfg, int8=args.int8
+            args.checkpoint_dir, args.out, cfg,
+            int8=args.int8, ema=args.ema,
         )
     except FileNotFoundError as e:
         raise SystemExit(str(e)) from e

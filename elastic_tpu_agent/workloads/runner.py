@@ -136,6 +136,12 @@ def main(argv=None) -> int:
     )
     parser.add_argument("--lr", type=float, default=1e-3)
     parser.add_argument(
+        "--ema-decay", type=float, default=0.0,
+        help="keep an EMA of params in the optimizer state (e.g. "
+             "0.999) and save it as its own checkpoint item; export "
+             "with `export --ema`. dp/sp/tp mode only",
+    )
+    parser.add_argument(
         "--warmup-steps", type=int, default=0,
         help="linear warmup to --lr then cosine decay to 10%% over "
              "--total-steps (0 = constant lr); dp/sp/tp mode only",
@@ -218,6 +224,8 @@ def main(argv=None) -> int:
                 "--warmup-steps is not supported with --pp "
                 "(the pipeline step takes a constant --lr)"
             )
+        if args.ema_decay > 0:
+            parser.error("--ema-decay is not supported with --pp")
         if args.sp != 1 or (args.tp or 1) != 1:
             parser.error(
                 "--pp composes with --dp only; --sp/--tp are not supported "
@@ -245,6 +253,10 @@ def main(argv=None) -> int:
         mesh = make_mesh(dp=args.dp, sp=args.sp, tp=args.tp)
         if args.accum_steps < 1:
             parser.error(f"--accum-steps {args.accum_steps} must be >= 1")
+        if not 0.0 <= args.ema_decay < 1.0:
+            parser.error(
+                f"--ema-decay {args.ema_decay} must be in [0, 1)"
+            )
         if args.accum_steps > 1 and args.batch % args.accum_steps:
             parser.error(
                 f"--accum-steps {args.accum_steps} must divide "
@@ -269,7 +281,8 @@ def main(argv=None) -> int:
         else:
             lr = args.lr
         train_step, init_all, _ = make_train_step(
-            cfg, mesh, learning_rate=lr, accum_steps=args.accum_steps
+            cfg, mesh, learning_rate=lr, accum_steps=args.accum_steps,
+            ema_decay=args.ema_decay,
         )
         shape = (
             (args.batch, args.seq + 1) if args.accum_steps == 1
@@ -419,7 +432,15 @@ def main(argv=None) -> int:
             if ckpt is not None and (
                 preempted["flag"] or (every > 0 and (step + 1) % every == 0)
             ):
-                ckpt.save(step, params, opt_state)
+                if args.ema_decay > 0:
+                    from .transformer import ema_params
+
+                    ckpt.save(
+                        step, params, opt_state,
+                        ema=ema_params(opt_state),
+                    )
+                else:
+                    ckpt.save(step, params, opt_state)
             if preempted["flag"]:
                 break
         if loss is not None:

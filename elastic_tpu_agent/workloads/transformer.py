@@ -25,7 +25,7 @@ agent's graded configs actually runnable and measurable.
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Dict, Optional, Tuple
+from typing import Any, Dict, NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -444,9 +444,46 @@ def make_mesh(
 # -- training step ------------------------------------------------------------
 
 
+class EmaState(NamedTuple):
+    """Optimizer-chain stage holding the parameter EMA. Living inside
+    opt_state means checkpointing, sharding (opt_leaf_sharding maps the
+    param-shaped subtree to the param's sharding), and donation all
+    come for free — no train-step signature change."""
+
+    ema: Any
+
+
+def _ema_transform(decay: float):
+    def init_fn(params):
+        return EmaState(ema=params)
+
+    def update_fn(updates, state, params=None):
+        new_params = optax.apply_updates(params, updates)
+        ema = jax.tree_util.tree_map(
+            lambda e, p: decay * e + (1.0 - decay) * p,
+            state.ema, new_params,
+        )
+        return updates, EmaState(ema=ema)
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
+def ema_params(opt_state) -> Optional[Any]:
+    """The EMA tree from an opt_state built with ema_decay > 0 (None
+    when EMA wasn't enabled)."""
+    if isinstance(opt_state, EmaState):
+        return opt_state.ema
+    if isinstance(opt_state, tuple):
+        for s in opt_state:
+            found = ema_params(s)
+            if found is not None:
+                return found
+    return None
+
+
 def make_train_step(
     cfg: ModelConfig, mesh: Mesh, learning_rate: float = 1e-3,
-    accum_steps: int = 1,
+    accum_steps: int = 1, ema_decay: float = 0.0,
 ):
     """(params, opt_state, tokens) -> (params, opt_state, loss), jit'd over
     the mesh with real dp/sp/tp shardings.
@@ -464,8 +501,22 @@ def make_train_step(
     learning_rate may be a float or any optax schedule (a callable
     step -> lr), e.g. optax.warmup_cosine_decay_schedule — adamw
     threads it through; the step count lives in the optimizer state,
-    so checkpoint resume continues the schedule where it left off."""
+    so checkpoint resume continues the schedule where it left off.
+
+    ema_decay > 0 keeps an exponential moving average of the params
+    inside the optimizer state (extract with ema_params(opt_state);
+    serve/export the smoothed weights). Costs one param-shaped f32
+    tree of HBM."""
     optimizer = optax.adamw(learning_rate)
+    if not 0.0 <= ema_decay < 1.0:
+        # decay == 1.0 would freeze the EMA at init forever; validate
+        # unconditionally (an assert vanishes under python -O and the
+        # frozen EMA would silently export untrained weights)
+        raise ValueError(f"ema_decay must be in [0, 1), got {ema_decay}")
+    if ema_decay > 0.0:
+        optimizer = optax.chain(
+            optimizer, _ema_transform(ema_decay)
+        )
     p_shard = _full_param_shardings(mesh, cfg)
     # Input tokens carry seq_len+1 (targets are the shift-by-one), which is
     # rarely divisible by sp — shard them on dp only; the activation

@@ -111,3 +111,65 @@ def test_train_export_serve_chain(tmp_path):
     report = json.loads(serve.stdout.strip().splitlines()[-1])
     assert report["restored_step"] == "artifact"
     assert report["end_to_end_s"] > 0
+
+
+def test_ema_checkpoint_exports_smoothed_weights(tmp_path):
+    """Train with EMA, checkpoint (EMA as its own item), export --ema:
+    the artifact holds exactly ema_params(opt_state), not the raw
+    params."""
+    from elastic_tpu_agent.workloads.checkpointing import (
+        TrainCheckpointer,
+    )
+    from elastic_tpu_agent.workloads.export import export_checkpoint
+    from elastic_tpu_agent.workloads.transformer import (
+        ema_params,
+        make_mesh,
+        make_train_step,
+    )
+
+    cfg = ModelConfig(
+        vocab=128, d_model=32, n_heads=4, n_layers=2, d_ff=64,
+        max_seq=32, dtype=jnp.float32,
+    )
+    mesh = make_mesh(8, dp=2, sp=1, tp=4)
+    step_fn, init_all, _ = make_train_step(cfg, mesh, ema_decay=0.9)
+    params, opt = init_all(jax.random.key(0))
+    tokens = jax.random.randint(jax.random.key(1), (4, 17), 0, cfg.vocab)
+    for _ in range(3):
+        params, opt, _ = step_fn(params, opt, tokens)
+
+    ckpt_dir = str(tmp_path / "ckpt")
+    ckpt = TrainCheckpointer(ckpt_dir)
+    ckpt.save(2, params, opt, ema=ema_params(opt))
+    ckpt.wait()
+    ckpt.close()
+
+    out = str(tmp_path / "art")
+    summary = export_checkpoint(ckpt_dir, out, cfg, ema=True)
+    assert summary["ema"] is True
+    loaded, _ = load_artifact(out)
+    for a, b in zip(
+        jax.tree_util.tree_leaves(loaded),
+        jax.tree_util.tree_leaves(ema_params(opt)),
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # and the EMA genuinely differs from the raw params after training
+    diffs = [
+        float(np.abs(np.asarray(a) - np.asarray(b)).max())
+        for a, b in zip(
+            jax.tree_util.tree_leaves(loaded),
+            jax.tree_util.tree_leaves(params),
+        )
+    ]
+    assert max(diffs) > 0
+
+    # a checkpoint saved WITHOUT ema refuses --ema export clearly
+    ckpt_dir2 = str(tmp_path / "ckpt2")
+    c2 = TrainCheckpointer(ckpt_dir2)
+    c2.save(0, params, opt)
+    c2.wait()
+    c2.close()
+    import pytest as _pytest
+
+    with _pytest.raises(FileNotFoundError, match="ema"):
+        export_checkpoint(ckpt_dir2, str(tmp_path / "a2"), cfg, ema=True)
