@@ -16,7 +16,6 @@ The reference has no workload code at all (SURVEY.md §2); its
 from __future__ import annotations
 
 import logging
-import os
 from typing import Any, Optional, Tuple
 
 import jax
@@ -107,11 +106,12 @@ class TrainCheckpointer:
             raise FileNotFoundError("no checkpoint present")
         # item presence is checked UP FRONT (orbax writes one subdir
         # per item) so a real restore failure — wrong preset template,
-        # corrupt data — surfaces as itself, not as "item missing"
-        item_dir = os.path.join(
-            str(self._mgr.directory), str(step), item
-        )
-        if not os.path.isdir(item_dir):
+        # corrupt data — surfaces as itself, not as "item missing".
+        # self._mgr.directory is an epath.Path: the / operator and
+        # exists() work on remote stores (gs://) too, where
+        # os.path.isdir would be False for every existing item.
+        item_dir = self._mgr.directory / str(step) / item
+        if not item_dir.exists():
             raise FileNotFoundError(
                 f"checkpoint step {step} has no {item!r} item"
                 + (

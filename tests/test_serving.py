@@ -212,3 +212,53 @@ def test_prefix_slot_reuse_after_longer_occupant():
     for _ in range(6):
         eng.step()
     assert eng.release(r2) == _oracle(params, cfg, [5, 9, 31], 7)
+
+
+def test_random_schedule_soak_every_stream_exact():
+    """Property test: a random admit/step/release schedule over dozens
+    of requests (random lengths, shared prefixes, slot churn) — every
+    completed stream must equal the solo oracle for its sequence."""
+    rng = np.random.default_rng(7)
+    cfg = ModelConfig(**BASE, pos="rope", n_kv_heads=2)
+    params = init_params(cfg, jax.random.key(0))
+    eng = ServingEngine(
+        params, cfg, slots=3, max_len=48, prompt_buckets=(4, 8),
+    )
+    pid = eng.register_prefix([7, 30, 2])
+
+    expected = {}   # rid -> full sequence (prefix+prompt)
+    budget = {}     # rid -> steps remaining before we release it
+    done = []
+
+    def admit_random():
+        plen = int(rng.integers(1, 6))
+        prompt = rng.integers(0, cfg.vocab, size=plen).tolist()
+        use_prefix = bool(rng.integers(0, 2))
+        rid = eng.admit(prompt, prefix=pid if use_prefix else None)
+        expected[rid] = ([7, 30, 2] if use_prefix else []) + prompt
+        budget[rid] = int(rng.integers(1, 9))
+        return rid
+
+    for _ in range(60):
+        live = [r for r in budget if budget[r] > 0]
+        can_admit = bool(eng._free)
+        if can_admit and (not live or rng.random() < 0.4):
+            admit_random()
+            continue
+        if not live:
+            continue
+        eng.step()
+        for r in list(budget):
+            if budget[r] > 0:
+                budget[r] -= 1
+                if budget[r] == 0:
+                    done.append((r, eng.release(r)))
+    # release anything still in flight
+    for r in list(budget):
+        if budget[r] > 0:
+            done.append((r, eng.release(r)))
+
+    assert len(done) >= 10, f"soak admitted too few requests: {len(done)}"
+    for rid, got in done:
+        want = _oracle(params, cfg, expected[rid], len(got))
+        assert got == want, (rid, expected[rid], got, want)
