@@ -138,3 +138,19 @@ def test_sampling_preserves_target_distribution_one_step():
     # keeping draft proposals) lands far above
     tv = 0.5 * np.abs(spec_counts - direct_counts).sum()
     assert tv < 0.2, f"TV distance {tv:.3f}"
+
+
+def test_greedy_speculative_on_window_models():
+    """Sliding-window target + draft: the exactness guarantee holds
+    with windowed attention masks in both models' caches."""
+    cfg = ModelConfig(**TARGET, pos="rope", window=8)
+    dcfg = ModelConfig(**DRAFT, pos="rope", window=8)
+    params = init_params(cfg, jax.random.key(0))
+    draft = init_params(dcfg, jax.random.key(1))
+    prompt = jax.random.randint(jax.random.key(2), (1, 6), 0, cfg.vocab)
+    want = generate(params, prompt, cfg, max_new_tokens=24,
+                    max_len=6 + 24 + 4)
+    got, _ = speculative_generate(
+        params, draft, cfg, dcfg, prompt, max_new_tokens=24, gamma=3,
+    )
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
