@@ -2,7 +2,7 @@
 # a test target was notably absent there).
 TAG ?= elastic-tpu-agent:latest
 
-.PHONY: all native sanitize test protos image bench clean
+.PHONY: all native sanitize test test-all protos image bench clean
 
 all: native test
 
@@ -12,7 +12,12 @@ native:
 sanitize:
 	$(MAKE) -C native sanitize
 
+# fast tier: the correctness loop (<~5 min); soak/sweep/sanitized-native
+# tests carry @pytest.mark.slow and run under test-all (CI)
 test: native
+	python -m pytest tests/ -q -m "not slow"
+
+test-all: native
 	python -m pytest tests/ -q
 
 protos:

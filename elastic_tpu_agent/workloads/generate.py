@@ -286,6 +286,47 @@ def _sample(logits, key, temperature: float, top_k: int, top_p: float):
     return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
 
 
+def _sample_rowwise(logits, key, temperature, top_k, top_p):
+    """Per-ROW sampling params: logits [b, vocab], temperature [b]
+    float, top_k [b] int (0 = off), top_p [b] float (0 or 1 = off) ->
+    token ids [b].
+
+    The serving engine's step batches requests with different sampling
+    configs into one program, so the params are traced arrays, not the
+    static Python scalars _sample closes over — one compiled step
+    serves every mix. Rows with temperature == 0 take the exact argmax
+    (same as _sample's greedy path); the rest share _sample's
+    one-sort top-k/top-p algebra with per-row cutoffs."""
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    b, vocab = logits.shape
+    t = jnp.maximum(temperature, 1e-6)[:, None]
+    scaled = logits / t
+    ranked = jnp.sort(scaled, axis=-1)[:, ::-1]
+    pos = jnp.arange(vocab)
+    # top_k <= 0 means "keep all": effective k = vocab for those rows
+    k_eff = jnp.where(top_k > 0, top_k, vocab)[:, None]
+    in_k = pos[None] < k_eff
+    ranked_k = jnp.where(in_k, ranked, NEG_INF)
+    probs = jax.nn.softmax(ranked_k, axis=-1)
+    before = jnp.cumsum(probs, axis=-1) - probs
+    p_on = (top_p > 0.0) & (top_p < 1.0)
+    p_eff = jnp.where(p_on, top_p, 1.0)[:, None]
+    # smallest prefix whose mass reaches p_eff (first position always
+    # kept); the in_k conjunct keeps float residue at masked positions
+    # from sneaking past the compare when p_eff == 1
+    keep_count = jnp.maximum(
+        jnp.sum((before < p_eff) & in_k, axis=-1), 1
+    )
+    cutoff = jnp.take_along_axis(
+        ranked_k, keep_count[:, None] - 1, axis=-1
+    )
+    masked = jnp.where(scaled >= cutoff, scaled, NEG_INF)
+    sampled = jax.random.categorical(key, masked, axis=-1).astype(
+        jnp.int32
+    )
+    return jnp.where(temperature <= 0.0, greedy, sampled)
+
+
 def generate(
     params: Dict,
     prompt: jax.Array,
@@ -436,15 +477,16 @@ def _build_run(
                 params, tok[:, None], cache, cfg, moe_drop_free=True
             )
             nxt = _sample(logits[:, -1], sub, temperature, top_k, top_p)
-            # yield the step's INPUT token: over N steps that emits
-            # generated tokens 1..N exactly (the final sample is the
-            # N+1-th, beyond the requested budget)
-            return (cache, nxt, key), tok
+            return (cache, nxt, key), nxt
 
+        # prefill's sample is generated token 1; the scan emits tokens
+        # 2..N in N-1 steps — no final forward whose sample is discarded
         _, toks = jax.lax.scan(
-            step, (cache, first, key), None, length=max_new_tokens
+            step, (cache, first, key), None, length=max_new_tokens - 1
         )
-        gen = jnp.moveaxis(toks, 0, 1)  # [b, max_new_tokens]
+        gen = jnp.concatenate(
+            [first[:, None], jnp.moveaxis(toks, 0, 1)], axis=1
+        )  # [b, max_new_tokens]
         return jnp.concatenate([prompt, gen], axis=1)
 
     return run

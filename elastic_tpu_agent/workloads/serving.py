@@ -41,7 +41,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .generate import KVCache, _forward_chunk, _sample
+from .generate import KVCache, _forward_chunk, _sample_rowwise
 from .transformer import ModelConfig
 
 
@@ -55,11 +55,17 @@ class ServingEngine:
 
     Requests are identified by a monotonically increasing request id —
     never by slot, since slots are recycled. A request that fills its
-    row to max_len is auto-finished: it leaves the live set but its
-    stream stays retrievable via release()/stream() until collected.
+    row to max_len — or emits one of its stop tokens — is
+    auto-finished: it leaves the live set but its stream stays
+    retrievable via release()/stream() until collected.
 
-    Greedy or temperature/top-k/top-p sampling (engine-wide). The
-    per-step and per-bucket-prefill programs compile once each.
+    Sampling is PER REQUEST: admit() takes temperature/top_k/top_p
+    (defaulting to the engine-wide constructor values) and an optional
+    stop-token set. The step program samples row-wise
+    (generate._sample_rowwise) with the params as traced arrays, so a
+    greedy request and a top-p request share one compiled step — no
+    recompile per sampling mix. The per-step and per-bucket-prefill
+    programs compile once each.
     """
 
     def __init__(
@@ -94,8 +100,15 @@ class ServingEngine:
         self._slot_of: Dict[int, int] = {}     # live rid -> slot
         self._streams: Dict[int, List[int]] = {}  # rid -> tokens (live
         self._finished: set = set()               # or auto-finished)
+        # per-slot sampling params, set at admit() (host side; handed
+        # to the step program as traced arrays)
+        self._row_temp = np.zeros((slots,), np.float32)
+        self._row_topk = np.zeros((slots,), np.int32)
+        self._row_topp = np.zeros((slots,), np.float32)
+        self._stop: Dict[int, frozenset] = {}  # rid -> stop-token set
 
         self._step_fn = self._build_step()
+        self._step_greedy_fn = self._build_step_greedy()
         self._prefill_fns = {
             b: self._build_prefill(b) for b in self.buckets
         }
@@ -112,19 +125,38 @@ class ServingEngine:
 
     def _build_step(self):
         cfg = self.cfg
-        temperature, top_k, top_p = self._sampling
 
         @functools.partial(jax.jit, donate_argnums=(1, 2))
-        def step(params, k, v, lengths, toks, active, key):
+        def step(params, k, v, lengths, toks, active, key, temp, tk, tp):
             cache = KVCache(k=k, v=v, length=jnp.int32(0))
             logits, cache = _forward_chunk(
                 params, toks[:, None], cache, cfg,
                 moe_drop_free=True, positions=lengths,
             )
-            nxt = _sample(
-                logits[:, 0], key, temperature, top_k, top_p
-            )
+            nxt = _sample_rowwise(logits[:, 0], key, temp, tk, tp)
             # frozen slots keep their token and length
+            nxt = jnp.where(active, nxt, toks)
+            lengths = jnp.where(active, lengths + 1, lengths)
+            return cache.k, cache.v, lengths, nxt
+
+        return step
+
+    def _build_step_greedy(self):
+        """Argmax-only step: when every LIVE request is greedy (the
+        default engine config), the rowwise sampler's full-vocab sort +
+        softmax + cumsum per decode token is pure discarded overhead —
+        step() dispatches here instead and the compiled program is a
+        bare argmax."""
+        cfg = self.cfg
+
+        @functools.partial(jax.jit, donate_argnums=(1, 2))
+        def step(params, k, v, lengths, toks, active):
+            cache = KVCache(k=k, v=v, length=jnp.int32(0))
+            logits, cache = _forward_chunk(
+                params, toks[:, None], cache, cfg,
+                moe_drop_free=True, positions=lengths,
+            )
+            nxt = jnp.argmax(logits[:, 0], axis=-1).astype(jnp.int32)
             nxt = jnp.where(active, nxt, toks)
             lengths = jnp.where(active, lengths + 1, lengths)
             return cache.k, cache.v, lengths, nxt
@@ -133,10 +165,9 @@ class ServingEngine:
 
     def _build_prefill(self, bucket: int):
         cfg = self.cfg
-        temperature, top_k, top_p = self._sampling
 
         @functools.partial(jax.jit, donate_argnums=(1, 2))
-        def prefill(params, k, v, padded, true_len, slot, key):
+        def prefill(params, k, v, padded, true_len, slot, key, tkp):
             # single-row chunk forward in a scratch cache, then splice
             # the row into the big cache at the slot index
             mini = KVCache.empty(cfg, 1, bucket)
@@ -149,8 +180,9 @@ class ServingEngine:
             v = jax.lax.dynamic_update_slice(
                 v, mini.v, (0, slot, 0, 0, 0)
             )
-            first = _sample(
-                logits[:, true_len - 1], key, temperature, top_k, top_p
+            first = _sample_rowwise(
+                logits[:, true_len - 1], key,
+                tkp[0:1], tkp[1:2].astype(jnp.int32), tkp[2:3],
             )[0]
             return k, v, first
 
@@ -162,12 +194,11 @@ class ServingEngine:
         and the prompt runs from position plen — the prefix's forward
         is never recomputed."""
         cfg = self.cfg
-        temperature, top_k, top_p = self._sampling
 
         @functools.partial(jax.jit, donate_argnums=(1, 2))
         def prefill(
             params, k, v, pref_k, pref_v, plen, padded, true_len,
-            slot, key,
+            slot, key, tkp,
         ):
             mini = KVCache.empty(cfg, 1, pref_bucket + bucket)
             mini = KVCache(
@@ -182,8 +213,9 @@ class ServingEngine:
             logits, mini = _forward_chunk(params, padded[None], mini, cfg)
             k = jax.lax.dynamic_update_slice(k, mini.k, (0, slot, 0, 0, 0))
             v = jax.lax.dynamic_update_slice(v, mini.v, (0, slot, 0, 0, 0))
-            first = _sample(
-                logits[:, true_len - 1], key, temperature, top_k, top_p
+            first = _sample_rowwise(
+                logits[:, true_len - 1], key,
+                tkp[0:1], tkp[1:2].astype(jnp.int32), tkp[2:3],
             )[0]
             return k, v, first
 
@@ -197,12 +229,16 @@ class ServingEngine:
         prefix forward per request. Returns a prefix id."""
         tokens = np.asarray(tokens, np.int32).reshape(-1)
         plen = len(tokens)
-        assert plen > 0, "empty prefix"
+        # admission control raises (not assert): under python -O a
+        # vanished check would silently corrupt a slot's stream
+        if plen == 0:
+            raise ValueError("empty prefix")
         bucket = next((b for b in self.buckets if b >= plen), None)
-        assert bucket is not None, (
-            f"prefix length {plen} exceeds largest bucket "
-            f"{self.buckets[-1]}"
-        )
+        if bucket is None:
+            raise ValueError(
+                f"prefix length {plen} exceeds largest bucket "
+                f"{self.buckets[-1]}"
+            )
         padded = jnp.zeros((bucket,), jnp.int32).at[:plen].set(
             jnp.asarray(tokens)
         )
@@ -224,42 +260,80 @@ class ServingEngine:
         it are unaffected — their slot rows hold a copy."""
         del self._prefixes[pid]
 
-    def admit(self, prompt, prefix: Optional[int] = None) -> int:
+    def admit(
+        self,
+        prompt,
+        prefix: Optional[int] = None,
+        temperature: Optional[float] = None,
+        top_k: Optional[int] = None,
+        top_p: Optional[float] = None,
+        stop_tokens: Sequence[int] = (),
+    ) -> int:
         """Prefill a prompt (1-D int sequence) into a free slot;
         returns the request id. The first generated token is already in
         stream(rid). With ``prefix=``, the request's sequence is
         (registered prefix + prompt) but only the prompt's forward
-        runs."""
+        runs.
+
+        temperature/top_k/top_p override the engine-wide constructor
+        defaults FOR THIS REQUEST (None = keep the default); requests
+        with different sampling configs batch into the same step
+        program. ``stop_tokens``: emitting any of these auto-finishes
+        the request in step() — the stop token IS appended to the
+        stream (callers that want it hidden strip the tail), and the
+        slot frees without the caller polling."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         p = len(prompt)
-        assert p > 0, "empty prompt"
+        # admission control raises (not assert): under python -O the
+        # "no room to decode" check would vanish and a full-row request
+        # would clamp its decode writes at max_len-1, corrupting the
+        # slot's stream
+        if p == 0:
+            raise ValueError("empty prompt")
         bucket = next(
             (b for b in self.buckets if b >= p), None
         )
-        assert bucket is not None, (
-            f"prompt length {p} exceeds largest bucket {self.buckets[-1]}"
-        )
-        if prefix is not None:
-            assert prefix in self._prefixes, (
-                f"unknown or released prefix {prefix}"
+        if bucket is None:
+            raise ValueError(
+                f"prompt length {p} exceeds largest bucket "
+                f"{self.buckets[-1]}"
             )
+        if prefix is not None:
+            if prefix not in self._prefixes:
+                raise ValueError(
+                    f"unknown or released prefix {prefix}"
+                )
             pref_k, pref_v, plen, pref_bucket = self._prefixes[prefix]
         else:
             plen, pref_bucket = 0, 0
         total = plen + p
-        assert total < self.max_len, (
-            f"prefix+prompt length {total} leaves no room to decode "
-            f"(max_len {self.max_len})"
-        )
-        assert pref_bucket + bucket <= self.max_len, (
-            "prefix bucket + prompt bucket exceed the slot row"
-        )
-        assert self._free, "no free slot; release() one first"
+        if total >= self.max_len:
+            raise ValueError(
+                f"prefix+prompt length {total} leaves no room to "
+                f"decode (max_len {self.max_len})"
+            )
+        if pref_bucket + bucket > self.max_len:
+            raise ValueError(
+                "prefix bucket + prompt bucket exceed the slot row"
+            )
+        if not self._free:
+            raise ValueError("no free slot; release() one first")
         slot = self._free.pop(0)
+
+        d_temp, d_topk, d_topp = self._sampling
+        temp = d_temp if temperature is None else float(temperature)
+        tk = d_topk if top_k is None else int(top_k)
+        tp = d_topp if top_p is None else float(top_p)
+        self._row_temp[slot] = temp
+        self._row_topk[slot] = tk
+        self._row_topp[slot] = tp
 
         padded = jnp.zeros((bucket,), jnp.int32)
         padded = padded.at[:p].set(jnp.asarray(prompt))
         self._key, sub = jax.random.split(self._key)
+        # sampling params ride in ONE traced f32 triple (top_k cast
+        # back inside) so per-request values never retrace the prefill
+        tkp = jnp.asarray([temp, float(tk), tp], jnp.float32)
         if prefix is not None:
             fn_key = (pref_bucket, bucket)
             if fn_key not in self._prefix_prefill_fns:
@@ -271,12 +345,12 @@ class ServingEngine:
             k, v, first = self._prefix_prefill_fns[fn_key](
                 self.params, self._k, self._v, pref_k, pref_v,
                 jnp.int32(plen), padded, jnp.int32(p),
-                jnp.int32(slot), sub,
+                jnp.int32(slot), sub, tkp,
             )
         else:
             k, v, first = self._prefill_fns[bucket](
                 self.params, self._k, self._v, padded,
-                jnp.int32(p), jnp.int32(slot), sub,
+                jnp.int32(p), jnp.int32(slot), sub, tkp,
             )
         self._k, self._v = k, v
         self._lengths = self._lengths.at[slot].set(total)
@@ -285,23 +359,43 @@ class ServingEngine:
         self._next_rid += 1
         self._slot_of[rid] = slot
         self._streams[rid] = [int(first)]
+        self._stop[rid] = frozenset(int(t) for t in stop_tokens)
+        # the admission token itself may be a stop token
+        if int(first) in self._stop[rid]:
+            self._finish(rid)
         return rid
 
     def step(self) -> Dict[int, int]:
         """Advance every live request one token; returns {rid: token}.
-        Requests whose row fills to max_len are auto-finished (their
-        streams remain retrievable via release())."""
+        Requests whose row fills to max_len — or that emit one of
+        their stop tokens — are auto-finished (their streams remain
+        retrievable via release())."""
         if not self._slot_of:
             return {}
         live_slots = set(self._slot_of.values())
         active = jnp.asarray(
             [s in live_slots for s in range(self.slots)]
         )
+        # key advances every step regardless of path so a request's
+        # draws don't depend on its neighbors' admission order
         self._key, sub = jax.random.split(self._key)
-        self._k, self._v, self._lengths, self._last = self._step_fn(
-            self.params, self._k, self._v, self._lengths,
-            self._last, active, sub,
-        )
+        live = sorted(live_slots)
+        if not (self._row_temp[live] > 0.0).any():
+            # all live rows greedy: argmax-only program (no sort)
+            self._k, self._v, self._lengths, self._last = (
+                self._step_greedy_fn(
+                    self.params, self._k, self._v, self._lengths,
+                    self._last, active,
+                )
+            )
+        else:
+            self._k, self._v, self._lengths, self._last = self._step_fn(
+                self.params, self._k, self._v, self._lengths,
+                self._last, active, sub,
+                jnp.asarray(self._row_temp),
+                jnp.asarray(self._row_topk),
+                jnp.asarray(self._row_topp),
+            )
         out = {}
         toks = np.asarray(self._last)
         lengths = np.asarray(self._lengths)
@@ -309,8 +403,12 @@ class ServingEngine:
             tok = int(toks[slot])
             self._streams[rid].append(tok)
             out[rid] = tok
-            # a row at max_len-1 can't take another write
-            if int(lengths[slot]) >= self.max_len - 1:
+            # a row at max_len-1 can't take another write; a stop
+            # token ends the stream without the caller polling
+            if (
+                int(lengths[slot]) >= self.max_len - 1
+                or tok in self._stop[rid]
+            ):
                 self._finish(rid)
         return out
 
@@ -331,4 +429,5 @@ class ServingEngine:
         if rid in self._slot_of:
             self._finish(rid)
         self._finished.discard(rid)
+        self._stop.pop(rid, None)
         return self._streams.pop(rid)

@@ -262,7 +262,14 @@ def _build_spec_run(
                 dcache=dcache,
                 key=key,
                 rounds=s["rounds"] + 1,
-                accepted=s["accepted"] + n_acc,
+                # the final round may overshoot the requested budget
+                # (its committed tokens are truncated to ``total``), so
+                # only count accepted drafts that actually land in the
+                # emitted stream — acceptance rate stays honest for
+                # short generations
+                accepted=s["accepted"] + jnp.minimum(
+                    n_acc, total - s["cursor"]
+                ),
             )
 
         s = jax.lax.while_loop(cond, body, state)

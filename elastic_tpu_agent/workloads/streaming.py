@@ -114,13 +114,15 @@ def _build_stream_run(
                 cfg, moe_drop_free=True, ring=(slot, key_pos),
             )
             nxt = _sample(logits[:, -1], sub, temperature, top_k, top_p)
-            return (cache, key_pos, pos + 1, nxt, key), tok
+            return (cache, key_pos, pos + 1, nxt, key), nxt
 
+        # prefill's sample is token 1; N-1 scan steps emit tokens 2..N
+        # (no final forward whose sample would be discarded)
         init = (cache, key_pos, jnp.int32(p), first, key)
         _, toks = jax.lax.scan(init=init, f=step, xs=None,
-                               length=max_new_tokens)
+                               length=max_new_tokens - 1)
         return jnp.concatenate(
-            [prompt, jnp.moveaxis(toks, 0, 1)], axis=1
+            [prompt, first[:, None], jnp.moveaxis(toks, 0, 1)], axis=1
         )
 
     return run

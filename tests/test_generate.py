@@ -248,3 +248,51 @@ def test_moe_single_token_prefill_is_still_prefill():
     cache = KVCache.empty(cfg, 6, 4)
     logits, _ = _forward_chunk(params, tokens, cache, cfg)
     np.testing.assert_allclose(logits, want, atol=1e-4, rtol=1e-4)
+
+
+def test_sample_rowwise_matches_scalar_sampler():
+    """_sample_rowwise with every row at the same config must draw the
+    SAME tokens as _sample with that config as static scalars — the
+    serving engine's per-request path is the solo path, vectorized."""
+    from elastic_tpu_agent.workloads.generate import (
+        _sample,
+        _sample_rowwise,
+    )
+
+    key = jax.random.key(3)
+    logits = jax.random.normal(jax.random.key(4), (5, 97)) * 3.0
+    for temp, tk, tp in [
+        (0.0, 0, 0.0),
+        (1.0, 0, 0.0),
+        (0.7, 5, 0.0),
+        (1.3, 0, 0.9),
+        (0.9, 8, 0.8),
+    ]:
+        want = _sample(logits, key, temp, tk, tp)
+        got = _sample_rowwise(
+            logits, key,
+            jnp.full((5,), temp, jnp.float32),
+            jnp.full((5,), tk, jnp.int32),
+            jnp.full((5,), tp, jnp.float32),
+        )
+        np.testing.assert_array_equal(
+            np.asarray(got), np.asarray(want), err_msg=str((temp, tk, tp))
+        )
+
+
+def test_sample_rowwise_mixed_rows():
+    """Rows with different configs in one call: greedy rows take the
+    exact argmax; top-k rows never leave their top-k set."""
+    from elastic_tpu_agent.workloads.generate import _sample_rowwise
+
+    logits = jax.random.normal(jax.random.key(5), (3, 50)) * 2.0
+    temp = jnp.asarray([0.0, 1.0, 1.5], jnp.float32)
+    tk = jnp.asarray([0, 3, 0], jnp.int32)
+    tp = jnp.asarray([0.0, 0.0, 0.5], jnp.float32)
+    top3 = set(np.asarray(jnp.argsort(logits[1])[::-1][:3]).tolist())
+    for i in range(20):
+        got = np.asarray(
+            _sample_rowwise(logits, jax.random.key(100 + i), temp, tk, tp)
+        )
+        assert got[0] == int(jnp.argmax(logits[0]))
+        assert got[1] in top3

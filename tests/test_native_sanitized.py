@@ -12,6 +12,8 @@ import subprocess
 
 import pytest
 
+pytestmark = pytest.mark.slow
+
 import test_native as tn
 
 SAN_DIR = os.path.join(tn.NATIVE_DIR, "sanitized")

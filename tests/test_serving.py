@@ -137,17 +137,17 @@ def test_admission_control():
     eng = ServingEngine(
         params, cfg, slots=1, max_len=32, prompt_buckets=(4,),
     )
-    with pytest.raises(AssertionError, match="largest bucket"):
+    with pytest.raises(ValueError, match="largest bucket"):
         eng.admit(list(range(9)))
     eng.admit([1, 2])
-    with pytest.raises(AssertionError, match="free slot"):
+    with pytest.raises(ValueError, match="free slot"):
         eng.admit([3])
 
     # a prompt that fills the whole row leaves no room to decode
     tight = ServingEngine(
         params, cfg, slots=1, max_len=4, prompt_buckets=(4,),
     )
-    with pytest.raises(AssertionError, match="no room"):
+    with pytest.raises(ValueError, match="no room"):
         tight.admit([1, 2, 3, 4])
 
 
@@ -214,6 +214,7 @@ def test_prefix_slot_reuse_after_longer_occupant():
     assert eng.release(r2) == _oracle(params, cfg, [5, 9, 31], 7)
 
 
+@pytest.mark.slow
 def test_random_schedule_soak_every_stream_exact():
     """Property test: a random admit/step/release schedule over dozens
     of requests (random lengths, shared prefixes, slot churn) — every
@@ -262,3 +263,154 @@ def test_random_schedule_soak_every_stream_exact():
     for rid, got in done:
         want = _oracle(params, cfg, expected[rid], len(got))
         assert got == want, (rid, expected[rid], got, want)
+
+
+def test_per_request_sampling_mixed_batch():
+    """A greedy request and a high-temperature request share one step
+    program; the greedy stream must STILL equal the solo oracle — a
+    neighbor's sampling config can never leak into another row."""
+    cfg = ModelConfig(**BASE, pos="rope")
+    params = init_params(cfg, jax.random.key(0))
+    eng = ServingEngine(
+        params, cfg, slots=3, max_len=64, prompt_buckets=(8,),
+    )
+    pg = [5, 17, 42, 9]
+    rg = eng.admit(pg)  # engine default: greedy
+    rs = eng.admit([3, 88], temperature=1.5, top_k=7)
+    rp = eng.admit([61, 24, 7], temperature=0.9, top_p=0.8)
+    for _ in range(6):
+        eng.step()
+    got_g = eng.release(rg)
+    assert got_g == _oracle(params, cfg, pg, 7)
+    # sampled streams: right lengths, in-vocab
+    for r in (rs, rp):
+        got = eng.release(r)
+        assert len(got) == 7
+        assert all(0 <= t < cfg.vocab for t in got)
+
+
+def test_stop_token_auto_finishes():
+    """A request whose stream emits a stop token leaves the live set
+    inside step() — no host polling — and its slot frees; the stop
+    token itself is the stream's last element."""
+    cfg = ModelConfig(**BASE, pos="rope")
+    params = init_params(cfg, jax.random.key(0))
+    eng = ServingEngine(
+        params, cfg, slots=1, max_len=64, prompt_buckets=(8,),
+    )
+    prompt = [5, 17, 42]
+    ref = _oracle(params, cfg, prompt, 12)
+    stop = ref[4]  # force a stop partway through the greedy stream
+    rid = eng.admit(prompt, stop_tokens=[stop])
+    steps = 0
+    while rid in eng._slot_of and steps < 30:
+        eng.step()
+        steps += 1
+    assert rid not in eng._slot_of, "stop token never finished the rid"
+    assert eng._free == [0]
+    got = eng.release(rid)
+    first_stop = ref.index(stop)
+    assert got == ref[: first_stop + 1]
+    assert got[-1] == stop
+
+
+def test_stop_token_in_admission_token():
+    """If the very first generated token is a stop token the request
+    finishes at admit() — stream retrievable, slot free."""
+    cfg = ModelConfig(**BASE, pos="rope")
+    params = init_params(cfg, jax.random.key(0))
+    eng = ServingEngine(
+        params, cfg, slots=1, max_len=64, prompt_buckets=(8,),
+    )
+    prompt = [5, 17, 42]
+    first = _oracle(params, cfg, prompt, 1)[0]
+    rid = eng.admit(prompt, stop_tokens=[first])
+    assert rid not in eng._slot_of
+    assert eng._free == [0]
+    assert eng.release(rid) == [first]
+
+
+@pytest.mark.slow
+def test_soak_mixed_sampling_configs():
+    """Random schedule where every admission draws its own sampling
+    config (greedy / temp / top-k / top-p mixed in one batch, some with
+    stop tokens): greedy streams stay oracle-exact, sampled streams
+    stay in-vocab, stop-token requests end with their stop token."""
+    rng = np.random.default_rng(11)
+    cfg = ModelConfig(**BASE, pos="rope", n_kv_heads=2)
+    params = init_params(cfg, jax.random.key(0))
+    eng = ServingEngine(
+        params, cfg, slots=3, max_len=48, prompt_buckets=(4, 8),
+    )
+    expected = {}   # rid -> (kind, payload)
+    budget = {}
+    done = []
+
+    def admit_random():
+        plen = int(rng.integers(1, 6))
+        prompt = rng.integers(0, cfg.vocab, size=plen).tolist()
+        kind = rng.choice(["greedy", "temp", "topk", "topp", "stop"])
+        if kind == "greedy":
+            rid = eng.admit(prompt)
+            expected[rid] = ("greedy", prompt)
+        elif kind == "stop":
+            ref = _oracle(params, cfg, prompt, 12)
+            stop = ref[int(rng.integers(1, 6))]
+            rid = eng.admit(prompt, stop_tokens=[stop])
+            expected[rid] = ("stop", (prompt, stop, ref))
+        elif kind == "temp":
+            rid = eng.admit(prompt, temperature=float(rng.uniform(0.5, 1.5)))
+            expected[rid] = ("sampled", prompt)
+        elif kind == "topk":
+            rid = eng.admit(
+                prompt, temperature=1.0, top_k=int(rng.integers(2, 20))
+            )
+            expected[rid] = ("sampled", prompt)
+        else:
+            rid = eng.admit(
+                prompt, temperature=0.8, top_p=float(rng.uniform(0.5, 0.95))
+            )
+            expected[rid] = ("sampled", prompt)
+        budget[rid] = int(rng.integers(1, 9))
+        return rid
+
+    def sweep_finished():
+        # stop-token rids auto-finish mid-schedule; collect them
+        for r in list(budget):
+            if budget[r] > 0 and r in eng._finished:
+                budget[r] = 0
+                done.append((r, eng.release(r)))
+
+    for _ in range(70):
+        sweep_finished()
+        live = [r for r in budget if budget[r] > 0]
+        if eng._free and (not live or rng.random() < 0.4):
+            admit_random()
+            sweep_finished()
+            continue
+        if not live:
+            continue
+        eng.step()
+        sweep_finished()
+        for r in list(budget):
+            if budget[r] > 0 and r not in eng._finished:
+                budget[r] -= 1
+                if budget[r] == 0 and r in eng._slot_of:
+                    done.append((r, eng.release(r)))
+    for r in list(budget):
+        if budget[r] > 0 and r in eng._streams:
+            done.append((r, eng.release(r)))
+
+    assert len(done) >= 10, f"soak admitted too few requests: {len(done)}"
+    for rid, got in done:
+        kind, payload = expected[rid]
+        if kind == "greedy":
+            assert got == _oracle(params, cfg, payload, len(got)), rid
+        elif kind == "stop":
+            prompt, stop, ref = payload
+            assert got == ref[: len(got)], rid
+            if stop in got:
+                # auto-finish fired at the FIRST stop occurrence
+                assert got[-1] == stop and got.index(stop) == len(got) - 1
+        else:
+            assert all(0 <= t < cfg.vocab for t in got), rid

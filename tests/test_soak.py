@@ -3,6 +3,10 @@ flaps happening concurrently. Asserts the terminal state is clean — no
 leaked links, no leaked alloc specs, storage empty, agent still serving.
 """
 
+import pytest
+
+pytestmark = pytest.mark.slow
+
 import os
 import random
 import threading
