@@ -12,8 +12,10 @@ native:
 sanitize:
 	$(MAKE) -C native sanitize
 
-# fast tier: the correctness loop (<~5 min); soak/sweep/sanitized-native
-# tests carry @pytest.mark.slow and run under test-all (CI)
+# fast tier: the correctness loop. Soaks, runner-mode sweeps, pipeline
+# sweeps, and sanitized-native builds carry @pytest.mark.slow and run
+# only under test-all (CI). Measured on the 1-CPU CI box: fast ~20 min,
+# full ~34 min (the box is single-core; XLA compiles dominate).
 test: native
 	python -m pytest tests/ -q -m "not slow"
 

@@ -1,29 +1,37 @@
 """Continuous-batching serving engine: sequences join and leave a
 fixed-slot decode batch mid-flight (the Orca/vLLM scheduling idea,
-rebuilt for XLA's static-shape world).
+rebuilt for XLA's static-shape world), over a PAGED KV cache.
 
 Why: naive batched decode waits for the whole batch to finish — one
 long request stalls every short one, and freed rows idle. Continuous
 batching admits a new request into a slot the moment its previous
-occupant finishes, keeping every row of the batched matmuls live.
+occupant finishes, keeping every row of the batched matmuls live. And
+a dense per-slot cache reserves slots*max_len tokens of HBM however
+short the live requests are; paging reserves only what's written.
 
 TPU-first mechanics:
-- ONE preallocated KV cache [L, slots, max_len, g, h]; a slot's row is
-  simply overwritten by its next occupant — no allocation, no shape
-  change, no retrace. Both cache buffers are donated through the step,
-  so XLA updates them in place (no per-token cache copy).
-- Per-row sequence lengths: each slot decodes at its own position.
-  The whole forward is generate._forward_chunk with ``positions=`` —
-  the SAME code path the solo-decode oracle runs, so serving cannot
-  silently diverge from it.
+- KV lives in a BLOCK POOL [L, n_blocks, block, g, h]; each slot owns
+  an ordered list of pool blocks (its block table). HBM scales with
+  LIVE TOKENS, not slots*max_len, and a shared prefix is shared
+  blocks under refcounts — no per-slot prefix copies (only a partial
+  tail block is copied, once, at admission).
+- The per-step program GATHERS the live slots' blocks into a dense
+  [slots, S] view sized by a bucket over the longest live row (a
+  handful of compiled programs, not one per length), runs the SAME
+  generate._forward_chunk the solo-decode oracle runs (so serving
+  cannot silently diverge from it), then SCATTERS the one newly
+  written position per slot back to its pool block. The gather is
+  transient and bucket-bounded — short live rows touch little HBM
+  even when max_len is huge.
+- Per-row sequence lengths: each slot decodes at its own position
+  (``positions=`` row-wise machinery in _forward_chunk).
 - Prefill pads prompts up to a fixed bucket length (one compiled
   program per bucket, not per prompt length); pad positions write
   stale cache entries that are never attended (masked by row length)
   and are overwritten by subsequent decode steps.
-- The host drives admission/release (that loop is control, not
-  compute); the per-step compute — all slots, active or not, in
-  lockstep — is a single jitted program. Inactive slots burn a row of
-  the matmul (the price of static shapes) but their state is frozen.
+- The host drives admission/release and block allocation (that loop
+  is control, not compute); the per-step compute — all slots, active
+  or not, in lockstep — is a single jitted program.
 
 Correctness pin (tests): every stream produced through interleaved
 admissions equals generate()'s output for that prompt alone.
@@ -35,6 +43,7 @@ code); TPU workload stack, same family as generate.py.
 from __future__ import annotations
 
 import functools
+import math
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -44,9 +53,49 @@ import numpy as np
 from .generate import KVCache, _forward_chunk, _sample_rowwise
 from .transformer import ModelConfig
 
+# physical block 0 is the JUNK block: never allocated, the write target
+# for frozen slots and the gather source for empty table entries — its
+# contents are garbage by design and masked everywhere it could be read
+_JUNK = 0
+
+
+class BlockAllocator:
+    """Host-side pool bookkeeping: a free list plus per-block refcounts
+    (shared prefix blocks are held by several tables at once)."""
+
+    def __init__(self, n_blocks: int):
+        self.n_blocks = n_blocks
+        self._free: List[int] = list(range(n_blocks - 1, 0, -1))
+        self._ref = np.zeros((n_blocks,), np.int32)
+
+    def alloc(self) -> int:
+        if not self._free:
+            raise RuntimeError(
+                "KV block pool exhausted; release() a request or size "
+                "the engine with more pool_blocks"
+            )
+        bid = self._free.pop()
+        self._ref[bid] = 1
+        return bid
+
+    def share(self, bid: int) -> int:
+        self._ref[bid] += 1
+        return bid
+
+    def drop(self, bid: int) -> None:
+        self._ref[bid] -= 1
+        if self._ref[bid] == 0:
+            self._free.append(bid)
+
+    @property
+    def used(self) -> int:
+        """Blocks currently held (excludes the junk block)."""
+        return self.n_blocks - 1 - len(self._free)
+
 
 class ServingEngine:
-    """Host-driven continuous-batching decoder over fixed slots.
+    """Host-driven continuous-batching decoder over fixed slots and a
+    paged KV block pool.
 
     >>> eng = ServingEngine(params, cfg, slots=4, max_len=256)
     >>> rid = eng.admit(prompt_tokens)       # prefill + first token
@@ -64,8 +113,14 @@ class ServingEngine:
     stop-token set. The step program samples row-wise
     (generate._sample_rowwise) with the params as traced arrays, so a
     greedy request and a top-p request share one compiled step — no
-    recompile per sampling mix. The per-step and per-bucket-prefill
-    programs compile once each.
+    recompile per sampling mix; an all-greedy batch dispatches to an
+    argmax-only program with no sort.
+
+    ``block_size`` (None = largest power of two dividing every prompt
+    bucket and max_len) sets paging granularity; ``pool_blocks``
+    (default: one slot's worth of headroom beyond slots*max_len for
+    registered prefixes) sets total KV HBM. `used_blocks` exposes live
+    pool pressure.
     """
 
     def __init__(
@@ -79,6 +134,8 @@ class ServingEngine:
         top_k: int = 0,
         top_p: float = 0.0,
         seed: int = 0,
+        block_size: Optional[int] = None,
+        pool_blocks: Optional[int] = None,
     ):
         self.params = params
         self.cfg = cfg
@@ -91,9 +148,38 @@ class ServingEngine:
         self._sampling = (temperature, top_k, top_p)
         self._key = jax.random.key(seed)
 
-        cache = KVCache.empty(cfg, slots, max_len)
-        self._k, self._v = cache.k, cache.v
+        if block_size is None:
+            # paging granularity: largest power of two dividing every
+            # prompt bucket and max_len (so prefill chunks and rows
+            # tile into whole blocks)
+            g = math.gcd(max_len, *self.buckets)
+            block_size = g & (-g)
+        self.block_size = block_size
+        if max_len % block_size or any(
+            b % block_size for b in self.buckets
+        ):
+            raise ValueError(
+                f"block_size {block_size} must divide max_len "
+                f"{max_len} and every prompt bucket {self.buckets}"
+            )
+        self.max_blocks = max_len // block_size
+        if pool_blocks is None:
+            # all slots at max_len plus one slot's worth of headroom
+            # for registered prefixes, plus the junk block
+            pool_blocks = 1 + (slots + 1) * self.max_blocks
+        self.pool_blocks = pool_blocks
+        self._alloc = BlockAllocator(pool_blocks)
+
+        pool_shape = (
+            cfg.n_layers, pool_blocks, block_size,
+            cfg.kv_heads, cfg.head_dim,
+        )
+        self._pool_k = jnp.zeros(pool_shape, cfg.dtype)
+        self._pool_v = jnp.zeros(pool_shape, cfg.dtype)
+        # logical->physical block map per slot; 0 = unmapped (junk)
+        self._table = np.zeros((slots, self.max_blocks), np.int32)
         self._lengths = jnp.zeros((slots,), jnp.int32)
+        self._host_len = np.zeros((slots,), np.int64)
         self._last = jnp.zeros((slots,), jnp.int32)
         self._free: List[int] = list(range(slots))
         self._next_rid = 0
@@ -106,101 +192,178 @@ class ServingEngine:
         self._row_topk = np.zeros((slots,), np.int32)
         self._row_topp = np.zeros((slots,), np.float32)
         self._stop: Dict[int, frozenset] = {}  # rid -> stop-token set
+        # why each finished rid stopped: "released" | "max_len" |
+        # "stop_token" | "pool_exhausted"; cleared when release()
+        # collects the stream
+        self.finish_reason: Dict[int, str] = {}
 
-        self._step_fn = self._build_step()
-        self._step_greedy_fn = self._build_step_greedy()
+        self._step_fns: Dict[Tuple[int, bool], object] = {}
         self._prefill_fns = {
             b: self._build_prefill(b) for b in self.buckets
         }
         self._prefix_prefill_fns: Dict[Tuple[int, int], object] = {}
-        self._prefixes: Dict[int, tuple] = {}
+        self._prefixes: Dict[int, Tuple[List[int], int]] = {}
         self._next_prefix_id = 0
         # one jitted prefix-forward per engine (re-wrapping
         # _forward_chunk per register_prefix call would recompile)
         self._prefix_forward = jax.jit(
             _forward_chunk, static_argnums=(3,)
         )
+        # in-place pool scatter for register_prefix (donated like the
+        # prefill/step programs; an eager .at[].set would copy the pool)
+        self._pool_write = jax.jit(
+            lambda pk, pv, mk, mv, phys: (
+                pk.at[:, phys].set(mk), pv.at[:, phys].set(mv)
+            ),
+            donate_argnums=(0, 1),
+        )
+
+    # -- paging helpers ----------------------------------------------
+
+    def _blocks_for(self, n_positions: int) -> int:
+        """Logical blocks needed to hold positions [0, n_positions)."""
+        return -(-n_positions // self.block_size)
+
+    def _ensure_blocks(self, slot: int, n_positions: int) -> None:
+        """Allocate table entries so positions [0, n_positions) of
+        ``slot`` are backed by pool blocks."""
+        for j in range(self._blocks_for(n_positions)):
+            if self._table[slot, j] == _JUNK:
+                self._table[slot, j] = self._alloc.alloc()
+
+    def _drop_row(self, slot: int) -> None:
+        for j in range(self.max_blocks):
+            bid = int(self._table[slot, j])
+            if bid != _JUNK:
+                self._alloc.drop(bid)
+        self._table[slot, :] = _JUNK
+
+    def _gather_bucket(self, needed_blocks: int) -> int:
+        """Round a live-row block count up to a power-of-two bucket so
+        the gathered step program compiles a handful of times, not
+        once per length."""
+        b = 1
+        while b < needed_blocks:
+            b *= 2
+        return min(b, self.max_blocks)
+
+    @property
+    def used_blocks(self) -> int:
+        return self._alloc.used
 
     # -- compiled programs -------------------------------------------
 
-    def _build_step(self):
+    def _gathered_view(self, pk, pv, table_b):
+        """[L, n_blocks, bs, g, h] pool + [slots, Bb] table -> dense
+        [L, slots, Bb*bs, g, h] view (transient; bucket-bounded)."""
+        L, _, bs, g, h = pk.shape
+        slots, Bb = table_b.shape
+        flat = table_b.reshape(-1)
+        kg = pk[:, flat].reshape(L, slots, Bb * bs, g, h)
+        vg = pv[:, flat].reshape(L, slots, Bb * bs, g, h)
+        return kg, vg
+
+    def _build_step(self, greedy: bool):
+        """Step program; the gather width is carried by table_b's
+        shape (jit traces per shape, so the (bucket, greedy) cache key
+        in _step_fn matches the compiled programs 1:1)."""
         cfg = self.cfg
 
         @functools.partial(jax.jit, donate_argnums=(1, 2))
-        def step(params, k, v, lengths, toks, active, key, temp, tk, tp):
-            cache = KVCache(k=k, v=v, length=jnp.int32(0))
+        def step(
+            params, pk, pv, table_b, lengths, toks, active, key,
+            temp, tk, tp, wblk, woff,
+        ):
+            kg, vg = self._gathered_view(pk, pv, table_b)
+            cache = KVCache(k=kg, v=vg, length=jnp.int32(0))
             logits, cache = _forward_chunk(
                 params, toks[:, None], cache, cfg,
                 moe_drop_free=True, positions=lengths,
             )
-            nxt = _sample_rowwise(logits[:, 0], key, temp, tk, tp)
+            if greedy:
+                nxt = jnp.argmax(logits[:, 0], axis=-1).astype(jnp.int32)
+            else:
+                nxt = _sample_rowwise(logits[:, 0], key, temp, tk, tp)
+            # scatter the ONE written position per slot back to its
+            # pool block (frozen slots aim at the junk block). CLIP
+            # the extraction index: a frozen slot's stale length can
+            # exceed the gathered width, and the default out-of-bounds
+            # gather fill is NaN — which would land in the junk block
+            # and poison every later row that gathers it (0 * NaN at
+            # masked positions is NaN, not 0).
+            idx = lengths.reshape(1, -1, 1, 1, 1)
+            wk = jnp.take_along_axis(
+                cache.k, idx, axis=2, mode="clip"
+            )[:, :, 0]
+            wv = jnp.take_along_axis(
+                cache.v, idx, axis=2, mode="clip"
+            )[:, :, 0]
+            pk = pk.at[:, wblk, woff].set(wk)
+            pv = pv.at[:, wblk, woff].set(wv)
             # frozen slots keep their token and length
             nxt = jnp.where(active, nxt, toks)
             lengths = jnp.where(active, lengths + 1, lengths)
-            return cache.k, cache.v, lengths, nxt
+            return pk, pv, lengths, nxt
 
         return step
 
-    def _build_step_greedy(self):
-        """Argmax-only step: when every LIVE request is greedy (the
-        default engine config), the rowwise sampler's full-vocab sort +
-        softmax + cumsum per decode token is pure discarded overhead —
-        step() dispatches here instead and the compiled program is a
-        bare argmax."""
-        cfg = self.cfg
-
-        @functools.partial(jax.jit, donate_argnums=(1, 2))
-        def step(params, k, v, lengths, toks, active):
-            cache = KVCache(k=k, v=v, length=jnp.int32(0))
-            logits, cache = _forward_chunk(
-                params, toks[:, None], cache, cfg,
-                moe_drop_free=True, positions=lengths,
-            )
-            nxt = jnp.argmax(logits[:, 0], axis=-1).astype(jnp.int32)
-            nxt = jnp.where(active, nxt, toks)
-            lengths = jnp.where(active, lengths + 1, lengths)
-            return cache.k, cache.v, lengths, nxt
-
-        return step
+    def _step_fn(self, n_b: int, greedy: bool):
+        key = (n_b, greedy)
+        if key not in self._step_fns:
+            self._step_fns[key] = self._build_step(greedy)
+        return self._step_fns[key]
 
     def _build_prefill(self, bucket: int):
         cfg = self.cfg
+        bs = self.block_size
+        nb = bucket // bs
 
         @functools.partial(jax.jit, donate_argnums=(1, 2))
-        def prefill(params, k, v, padded, true_len, slot, key, tkp):
-            # single-row chunk forward in a scratch cache, then splice
-            # the row into the big cache at the slot index
+        def prefill(params, pk, pv, padded, true_len, key, tkp, phys):
+            # single-row chunk forward in a scratch cache, then
+            # scatter its blocks into the pool (phys[j] = the slot's
+            # physical block for logical block j, junk where the
+            # request doesn't need the bucket's padded tail)
             mini = KVCache.empty(cfg, 1, bucket)
             logits, mini = _forward_chunk(
                 params, padded[None], mini, cfg
             )
-            k = jax.lax.dynamic_update_slice(
-                k, mini.k, (0, slot, 0, 0, 0)
-            )
-            v = jax.lax.dynamic_update_slice(
-                v, mini.v, (0, slot, 0, 0, 0)
-            )
+            L, _, _, g, h = pk.shape
+            mk = mini.k.reshape(L, nb, bs, g, h)
+            mv = mini.v.reshape(L, nb, bs, g, h)
+            pk = pk.at[:, phys].set(mk)
+            pv = pv.at[:, phys].set(mv)
             first = _sample_rowwise(
                 logits[:, true_len - 1], key,
                 tkp[0:1], tkp[1:2].astype(jnp.int32), tkp[2:3],
             )[0]
-            return k, v, first
+            return pk, pv, first
 
         return prefill
 
-    def _build_prefix_prefill(self, pref_bucket: int, bucket: int):
-        """Like _build_prefill, but the chunk CONTINUES a cached prefix:
-        the mini cache starts with the prefix's K/V spliced at [0, plen)
-        and the prompt runs from position plen — the prefix's forward
-        is never recomputed."""
+    def _build_prefix_prefill(self, pref_padded: int, bucket: int):
+        """Like _build_prefill, but the chunk CONTINUES a cached
+        prefix: the prefix's blocks are GATHERED from the pool into
+        the scratch cache (its forward is never recomputed) and only
+        the blocks the prompt wrote scatter back — shared prefix
+        blocks are never touched, so sharing is copy-free (a partial
+        tail block lands in a private block via the same scatter).
+        ``pref_padded`` = prefix length rounded up to a block
+        multiple."""
         cfg = self.cfg
+        bs = self.block_size
+        npb = pref_padded // bs
+        nb = (pref_padded + bucket) // bs
 
         @functools.partial(jax.jit, donate_argnums=(1, 2))
         def prefill(
-            params, k, v, pref_k, pref_v, plen, padded, true_len,
-            slot, key, tkp,
+            params, pk, pv, pref_phys, plen, padded, true_len, key,
+            tkp, phys,
         ):
-            mini = KVCache.empty(cfg, 1, pref_bucket + bucket)
+            L, _, _, g, h = pk.shape
+            mini = KVCache.empty(cfg, 1, pref_padded + bucket)
+            pref_k = pk[:, pref_phys].reshape(L, 1, pref_padded, g, h)
+            pref_v = pv[:, pref_phys].reshape(L, 1, pref_padded, g, h)
             mini = KVCache(
                 k=jax.lax.dynamic_update_slice(
                     mini.k, pref_k, (0, 0, 0, 0, 0)
@@ -211,22 +374,25 @@ class ServingEngine:
                 length=plen,
             )
             logits, mini = _forward_chunk(params, padded[None], mini, cfg)
-            k = jax.lax.dynamic_update_slice(k, mini.k, (0, slot, 0, 0, 0))
-            v = jax.lax.dynamic_update_slice(v, mini.v, (0, slot, 0, 0, 0))
+            mk = mini.k.reshape(L, nb, bs, g, h)
+            mv = mini.v.reshape(L, nb, bs, g, h)
+            pk = pk.at[:, phys].set(mk)
+            pv = pv.at[:, phys].set(mv)
             first = _sample_rowwise(
                 logits[:, true_len - 1], key,
                 tkp[0:1], tkp[1:2].astype(jnp.int32), tkp[2:3],
             )[0]
-            return k, v, first
+            return pk, pv, first
 
         return prefill
 
     # -- host API ----------------------------------------------------
 
     def register_prefix(self, tokens) -> int:
-        """Prefill a shared prefix (e.g. a system prompt) ONCE; admit()
-        with ``prefix=`` then reuses its K/V instead of recomputing the
-        prefix forward per request. Returns a prefix id."""
+        """Prefill a shared prefix (e.g. a system prompt) ONCE into
+        pool blocks; admit() with ``prefix=`` then maps those blocks
+        into the request's table under refcounts instead of
+        recomputing (or copying) the prefix. Returns a prefix id."""
         tokens = np.asarray(tokens, np.int32).reshape(-1)
         plen = len(tokens)
         # admission control raises (not assert): under python -O a
@@ -246,19 +412,44 @@ class ServingEngine:
         _, mini = self._prefix_forward(
             self.params, padded[None], mini, self.cfg
         )
+        # scatter the prefix's blocks into the pool; bucket-padding
+        # blocks past the prefix go to junk
+        bs = self.block_size
+        need = self._blocks_for(plen)
+        block_ids: List[int] = []
+        try:
+            for _ in range(need):
+                block_ids.append(self._alloc.alloc())
+        except RuntimeError as e:
+            # free the partial grab — a failed registration must not
+            # wedge the pool — and raise the admission-control type
+            for bid in block_ids:
+                self._alloc.drop(bid)
+            raise ValueError(str(e)) from e
+        phys = np.full((bucket // bs,), _JUNK, np.int32)
+        phys[:need] = block_ids
+        L = self.cfg.n_layers
+        g, h = self.cfg.kv_heads, self.cfg.head_dim
+        mk = mini.k.reshape(L, bucket // bs, bs, g, h)
+        mv = mini.v.reshape(L, bucket // bs, bs, g, h)
+        # donated write: the pool is the engine's dominant HBM
+        # allocation, an undonated .at[].set would transiently double it
+        self._pool_k, self._pool_v = self._pool_write(
+            self._pool_k, self._pool_v, mk, mv, jnp.asarray(phys)
+        )
         pid = self._next_prefix_id
         self._next_prefix_id += 1
-        # stored at bucket width; pad K/V beyond plen is masked by
-        # position downstream exactly like admit()'s own padding
-        self._prefixes[pid] = (mini.k, mini.v, plen, bucket)
+        self._prefixes[pid] = (block_ids, plen)
         return pid
 
     def release_prefix(self, pid: int) -> None:
-        """Drop a registered prefix's cached K/V (each one pins
-        [L, 1, bucket, g, h] arrays in device memory for the engine's
-        lifetime otherwise). In-flight requests already admitted with
-        it are unaffected — their slot rows hold a copy."""
-        del self._prefixes[pid]
+        """Drop the prefix's hold on its pool blocks. In-flight
+        requests admitted with it are unaffected — their tables hold
+        refcounted shares, and the blocks free only when the last
+        sharer releases."""
+        block_ids, _ = self._prefixes.pop(pid)
+        for bid in block_ids:
+            self._alloc.drop(bid)
 
     def admit(
         self,
@@ -273,7 +464,7 @@ class ServingEngine:
         returns the request id. The first generated token is already in
         stream(rid). With ``prefix=``, the request's sequence is
         (registered prefix + prompt) but only the prompt's forward
-        runs.
+        runs, and full prefix blocks are SHARED, not copied.
 
         temperature/top_k/top_p override the engine-wide constructor
         defaults FOR THIS REQUEST (None = keep the default); requests
@@ -284,10 +475,6 @@ class ServingEngine:
         slot frees without the caller polling."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         p = len(prompt)
-        # admission control raises (not assert): under python -O the
-        # "no room to decode" check would vanish and a full-row request
-        # would clamp its decode writes at max_len-1, corrupting the
-        # slot's stream
         if p == 0:
             raise ValueError("empty prompt")
         bucket = next(
@@ -303,16 +490,17 @@ class ServingEngine:
                 raise ValueError(
                     f"unknown or released prefix {prefix}"
                 )
-            pref_k, pref_v, plen, pref_bucket = self._prefixes[prefix]
+            pref_blocks, plen = self._prefixes[prefix]
+            pref_padded = self._blocks_for(plen) * self.block_size
         else:
-            plen, pref_bucket = 0, 0
+            pref_blocks, plen, pref_padded = [], 0, 0
         total = plen + p
         if total >= self.max_len:
             raise ValueError(
                 f"prefix+prompt length {total} leaves no room to "
                 f"decode (max_len {self.max_len})"
             )
-        if pref_bucket + bucket > self.max_len:
+        if pref_padded + bucket > self.max_len:
             raise ValueError(
                 "prefix bucket + prompt bucket exceed the slot row"
             )
@@ -328,6 +516,21 @@ class ServingEngine:
         self._row_topk[slot] = tk
         self._row_topp[slot] = tp
 
+        # -- block mapping: share full prefix blocks, allocate the
+        # rest (incl. the next decode write's block) ------------------
+        bs = self.block_size
+        n_shared = plen // bs          # only FULL blocks are shared
+        try:
+            for j in range(n_shared):
+                self._table[slot, j] = self._alloc.share(pref_blocks[j])
+            self._ensure_blocks(slot, total + 1)
+        except RuntimeError as e:
+            self._drop_row(slot)
+            self._free.append(slot)
+            self._free.sort()
+            raise ValueError(str(e)) from e
+        nb_req = self._blocks_for(total + 1)
+
         padded = jnp.zeros((bucket,), jnp.int32)
         padded = padded.at[:p].set(jnp.asarray(prompt))
         self._key, sub = jax.random.split(self._key)
@@ -335,25 +538,41 @@ class ServingEngine:
         # back inside) so per-request values never retrace the prefill
         tkp = jnp.asarray([temp, float(tk), tp], jnp.float32)
         if prefix is not None:
-            fn_key = (pref_bucket, bucket)
+            fn_key = (pref_padded, bucket)
             if fn_key not in self._prefix_prefill_fns:
                 self._prefix_prefill_fns[fn_key] = (
                     self._build_prefix_prefill(*fn_key)
                 )
+            # scatter map over the mini's logical blocks: shared
+            # prefix blocks are NOT written back (junk), the partial
+            # prefix tail + prompt land in this slot's private blocks,
+            # bucket padding past the request's need goes to junk
+            nb_mini = (pref_padded + bucket) // bs
+            phys = np.full((nb_mini,), _JUNK, np.int32)
+            for j in range(n_shared, min(nb_req, nb_mini)):
+                phys[j] = self._table[slot, j]
+            # gather map for the prefix's own blocks (pref_padded is
+            # exactly len(pref_blocks) * block_size by construction)
+            pref_phys = np.asarray(pref_blocks, np.int32)
             # true_len is CHUNK-relative: the last real prompt token
             # sits at chunk index p-1 (absolute plen+p-1)
-            k, v, first = self._prefix_prefill_fns[fn_key](
-                self.params, self._k, self._v, pref_k, pref_v,
-                jnp.int32(plen), padded, jnp.int32(p),
-                jnp.int32(slot), sub, tkp,
+            pk, pv, first = self._prefix_prefill_fns[fn_key](
+                self.params, self._pool_k, self._pool_v,
+                jnp.asarray(pref_phys), jnp.int32(plen), padded,
+                jnp.int32(p), sub, tkp, jnp.asarray(phys),
             )
         else:
-            k, v, first = self._prefill_fns[bucket](
-                self.params, self._k, self._v, padded,
-                jnp.int32(p), jnp.int32(slot), sub, tkp,
+            nb_mini = bucket // bs
+            phys = np.full((nb_mini,), _JUNK, np.int32)
+            for j in range(min(nb_req, nb_mini)):
+                phys[j] = self._table[slot, j]
+            pk, pv, first = self._prefill_fns[bucket](
+                self.params, self._pool_k, self._pool_v, padded,
+                jnp.int32(p), sub, tkp, jnp.asarray(phys),
             )
-        self._k, self._v = k, v
+        self._pool_k, self._pool_v = pk, pv
         self._lengths = self._lengths.at[slot].set(total)
+        self._host_len[slot] = total
         self._last = self._last.at[slot].set(first)
         rid = self._next_rid
         self._next_rid += 1
@@ -362,59 +581,84 @@ class ServingEngine:
         self._stop[rid] = frozenset(int(t) for t in stop_tokens)
         # the admission token itself may be a stop token
         if int(first) in self._stop[rid]:
-            self._finish(rid)
+            self._finish(rid, "stop_token")
         return rid
 
     def step(self) -> Dict[int, int]:
         """Advance every live request one token; returns {rid: token}.
         Requests whose row fills to max_len — or that emit one of
         their stop tokens — are auto-finished (their streams remain
-        retrievable via release())."""
+        retrievable via release()).
+
+        Pool pressure: if a request's next token has no block and the
+        pool is exhausted, that request is auto-finished with
+        ``finish_reason[rid] == "pool_exhausted"`` (its stream so far
+        stays intact and exact) and the OTHER requests keep decoding —
+        step() never raises mid-decode. Size pool_blocks for the
+        worst case to avoid cut-short streams."""
+        if not self._slot_of:
+            return {}
+        # back each write position with a pool block; a slot that
+        # can't get one is finished (freeing ITS blocks may unblock
+        # later slots in the same sweep)
+        rid_of_slot = {s: r for r, s in self._slot_of.items()}
+        for s in sorted(rid_of_slot):
+            try:
+                self._ensure_blocks(s, int(self._host_len[s]) + 1)
+            except RuntimeError:
+                self._finish(rid_of_slot[s], "pool_exhausted")
         if not self._slot_of:
             return {}
         live_slots = set(self._slot_of.values())
+        live = sorted(live_slots)
+        bs = self.block_size
+        wblk = np.full((self.slots,), _JUNK, np.int32)
+        woff = np.zeros((self.slots,), np.int32)
+        for s in live:
+            w = int(self._host_len[s])
+            wblk[s] = self._table[s, w // bs]
+            woff[s] = w % bs
+        n_b = self._gather_bucket(
+            max(self._blocks_for(int(self._host_len[s]) + 1)
+                for s in live)
+        )
+        table_b = jnp.asarray(self._table[:, :n_b])
         active = jnp.asarray(
             [s in live_slots for s in range(self.slots)]
         )
         # key advances every step regardless of path so a request's
         # draws don't depend on its neighbors' admission order
         self._key, sub = jax.random.split(self._key)
-        live = sorted(live_slots)
-        if not (self._row_temp[live] > 0.0).any():
-            # all live rows greedy: argmax-only program (no sort)
-            self._k, self._v, self._lengths, self._last = (
-                self._step_greedy_fn(
-                    self.params, self._k, self._v, self._lengths,
-                    self._last, active,
-                )
-            )
-        else:
-            self._k, self._v, self._lengths, self._last = self._step_fn(
-                self.params, self._k, self._v, self._lengths,
-                self._last, active, sub,
-                jnp.asarray(self._row_temp),
-                jnp.asarray(self._row_topk),
-                jnp.asarray(self._row_topp),
-            )
+        greedy = not (self._row_temp[live] > 0.0).any()
+        fn = self._step_fn(n_b, greedy)
+        self._pool_k, self._pool_v, self._lengths, self._last = fn(
+            self.params, self._pool_k, self._pool_v, table_b,
+            self._lengths, self._last, active, sub,
+            jnp.asarray(self._row_temp),
+            jnp.asarray(self._row_topk),
+            jnp.asarray(self._row_topp),
+            jnp.asarray(wblk), jnp.asarray(woff),
+        )
+        self._host_len[live] += 1
         out = {}
         toks = np.asarray(self._last)
-        lengths = np.asarray(self._lengths)
         for rid, slot in list(self._slot_of.items()):
             tok = int(toks[slot])
             self._streams[rid].append(tok)
             out[rid] = tok
             # a row at max_len-1 can't take another write; a stop
             # token ends the stream without the caller polling
-            if (
-                int(lengths[slot]) >= self.max_len - 1
-                or tok in self._stop[rid]
-            ):
-                self._finish(rid)
+            if int(self._host_len[slot]) >= self.max_len - 1:
+                self._finish(rid, "max_len")
+            elif tok in self._stop[rid]:
+                self._finish(rid, "stop_token")
         return out
 
-    def _finish(self, rid: int) -> None:
+    def _finish(self, rid: int, reason: str = "released") -> None:
         slot = self._slot_of.pop(rid)
         self._finished.add(rid)
+        self.finish_reason[rid] = reason
+        self._drop_row(slot)
         self._free.append(slot)
         self._free.sort()
 
@@ -424,10 +668,11 @@ class ServingEngine:
         return list(self._streams[rid])
 
     def release(self, rid: int) -> List[int]:
-        """Finish a live request (freeing its slot) or collect an
-        auto-finished one; returns its generated tokens."""
+        """Finish a live request (freeing its slot and blocks) or
+        collect an auto-finished one; returns its generated tokens."""
         if rid in self._slot_of:
             self._finish(rid)
         self._finished.discard(rid)
         self._stop.pop(rid, None)
+        self.finish_reason.pop(rid, None)
         return self._streams.pop(rid)

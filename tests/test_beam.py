@@ -2,6 +2,7 @@
 never score worse than greedy, EOS freezes hypotheses, ranking is
 sorted."""
 
+import pytest
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -28,6 +29,7 @@ def _seq_logprob(params, cfg, seq, p):
     return float(jnp.sum(logp[idx, seq[p:]]))
 
 
+@pytest.mark.slow
 def test_beam_one_is_greedy():
     cfg = ModelConfig(**BASE, pos="rope")
     params = init_params(cfg, jax.random.key(0))

@@ -7,6 +7,8 @@ import os
 import subprocess
 import sys
 
+import pytest
+
 import jax
 import numpy as np
 
@@ -94,6 +96,7 @@ def test_retention_keeps_newest(tmp_path):
     ckpt.close()
 
 
+@pytest.mark.slow
 def test_runner_resumes_from_checkpoint(tmp_path):
     """Two real runner processes sharing a checkpoint dir: the second
     resumes where the first stopped."""

@@ -7,6 +7,8 @@ import os
 import subprocess
 import sys
 
+import pytest
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -62,6 +64,7 @@ def test_int8_round_trip_keeps_quantized_form(tmp_path):
     np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
 
 
+@pytest.mark.slow
 def test_train_export_serve_chain(tmp_path):
     """Three real processes: train 2 steps with checkpoints, export the
     checkpoint as an int8 artifact, then serve the artifact through

@@ -5,6 +5,7 @@ the dense MLP it degenerates to, static-capacity drop behavior, and the
 full sharded train step with experts on the "ep" axis.
 """
 
+import pytest
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -79,6 +80,7 @@ def test_moe_transformer_params_and_shardings():
     assert "w1" not in params["layers"][1]
 
 
+@pytest.mark.slow
 def test_moe_sharded_train_step_learns():
     cfg = ModelConfig(vocab=256, d_model=32, n_heads=2, n_layers=2, d_ff=64,
                       max_seq=48, moe_experts=4)

@@ -145,6 +145,7 @@ def test_generate_accepts_quantized_params():
     np.testing.assert_array_equal(np.asarray(out), np.asarray(out2))
 
 
+@pytest.mark.slow
 def test_moe_subtree_quantized_and_decodes():
     """quantize_params reaches the nested MoE subtree (w1/w2 int8, the
     router wg stays float — quantization noise there would flip routing

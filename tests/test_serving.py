@@ -414,3 +414,153 @@ def test_soak_mixed_sampling_configs():
                 assert got[-1] == stop and got.index(stop) == len(got) - 1
         else:
             assert all(0 <= t < cfg.vocab for t in got), rid
+
+
+def test_paged_blocks_scale_with_live_tokens():
+    """N slots holding SHORT sequences must pin ~proportional pool
+    blocks — not slots*max_len worth. This is the paged cache's whole
+    point: HBM follows live tokens."""
+    cfg = ModelConfig(**BASE, pos="rope")
+    params = init_params(cfg, jax.random.key(0))
+    eng = ServingEngine(
+        params, cfg, slots=4, max_len=64, prompt_buckets=(8,),
+        block_size=4,
+    )
+    assert eng.used_blocks == 0
+    rids = [eng.admit([5, 17, 42]) for _ in range(4)]
+    # each slot: 3 prompt tokens + 1 write headroom -> 1 block of 4
+    assert eng.used_blocks == 4, eng.used_blocks
+    for _ in range(3):
+        eng.step()   # lengths 4..6 -> 2 blocks each
+    assert eng.used_blocks == 8, eng.used_blocks
+    # a dense cache would hold 4 slots * 64/4 = 64 blocks regardless
+    assert eng.used_blocks < 16
+    for r in rids:
+        eng.release(r)
+    assert eng.used_blocks == 0, "release must return blocks to pool"
+
+
+def test_paged_prefix_sharing_is_copy_free():
+    """A block-aligned prefix admitted into N slots pins its blocks
+    ONCE (refcounted), not once per slot."""
+    cfg = ModelConfig(**BASE, pos="rope")
+    params = init_params(cfg, jax.random.key(0))
+    eng = ServingEngine(
+        params, cfg, slots=3, max_len=64, prompt_buckets=(8, 16),
+        block_size=4,
+    )
+    system = [7, 7, 30, 2, 51, 11, 29, 4]   # 8 tokens = 2 full blocks
+    pid = eng.register_prefix(system)
+    base = eng.used_blocks
+    assert base == 2
+    r1 = eng.admit([5, 17], prefix=pid)
+    one = eng.used_blocks
+    r2 = eng.admit([61, 3], prefix=pid)
+    r3 = eng.admit([9, 88], prefix=pid)
+    # sharing: admissions 2 and 3 added only their PRIVATE blocks
+    # (same count as admission 1's private blocks), no prefix copies
+    private = one - base
+    assert eng.used_blocks == base + 3 * private, (
+        eng.used_blocks, base, private
+    )
+    # streams still exact vs the solo oracle
+    for _ in range(4):
+        eng.step()
+    assert eng.release(r1) == _oracle(params, cfg, system + [5, 17], 5)
+    assert eng.release(r2) == _oracle(params, cfg, system + [61, 3], 5)
+    assert eng.release(r3) == _oracle(params, cfg, system + [9, 88], 5)
+    # sharers gone; only the registered prefix itself holds blocks
+    assert eng.used_blocks == base
+    eng.release_prefix(pid)
+    assert eng.used_blocks == 0
+
+
+def test_paged_unaligned_prefix_still_exact():
+    """A prefix that does NOT end on a block boundary: full blocks
+    shared, the partial tail copied into a private block — streams
+    must stay oracle-exact."""
+    cfg = ModelConfig(**BASE, pos="rope", n_kv_heads=2)
+    params = init_params(cfg, jax.random.key(0))
+    eng = ServingEngine(
+        params, cfg, slots=2, max_len=64, prompt_buckets=(8,),
+        block_size=4,
+    )
+    system = [7, 7, 30, 2, 51, 11]          # 6 tokens: 1 full + tail 2
+    pid = eng.register_prefix(system)
+    ra = eng.admit([5, 17, 42], prefix=pid)
+    rb = eng.admit([61], prefix=pid)
+    for _ in range(5):
+        eng.step()
+    assert eng.release(ra) == _oracle(params, cfg, system + [5, 17, 42], 6)
+    assert eng.release(rb) == _oracle(params, cfg, system + [61], 6)
+
+
+def test_paged_pool_exhaustion_admission_fails_clean():
+    """An undersized pool rejects admission with ValueError and leaks
+    nothing — the engine keeps serving its live requests."""
+    cfg = ModelConfig(**BASE, pos="rope")
+    params = init_params(cfg, jax.random.key(0))
+    eng = ServingEngine(
+        params, cfg, slots=2, max_len=64, prompt_buckets=(8,),
+        block_size=4, pool_blocks=4,        # junk + 3 usable
+    )
+    r1 = eng.admit([5, 17, 42])             # 1 block (positions 0..3)
+    eng.step()                               # writes position 3
+    eng.step()                               # position 4 -> 2nd block
+    assert eng.used_blocks == 2
+    with pytest.raises(ValueError, match="pool exhausted"):
+        eng.admit(list(range(7)))           # needs 2 blocks; 1 left
+    assert eng.used_blocks == 2, "failed admit leaked blocks"
+    assert eng._free == [1]
+    # the live request still decodes exactly
+    for _ in range(3):
+        eng.step()
+    assert eng.release(r1) == _oracle(params, cfg, [5, 17, 42], 6)
+
+
+def test_paged_pool_pressure_cuts_stream_not_engine():
+    """Decode-time pool exhaustion: the starving request auto-finishes
+    with finish_reason 'pool_exhausted' (stream intact and exact);
+    step() never raises and the engine keeps serving."""
+    cfg = ModelConfig(**BASE, pos="rope")
+    params = init_params(cfg, jax.random.key(0))
+    eng = ServingEngine(
+        params, cfg, slots=2, max_len=64, prompt_buckets=(8,),
+        block_size=4, pool_blocks=4,        # junk + 3 usable
+    )
+    r1 = eng.admit([5, 17, 42])
+    steps = 0
+    while r1 in eng._slot_of and steps < 30:
+        eng.step()                           # must never raise
+        steps += 1
+    assert r1 not in eng._slot_of
+    assert eng.finish_reason[r1] == "pool_exhausted"
+    got = eng.release(r1)
+    # the cut-short stream is an exact prefix of the solo stream
+    assert got == _oracle(params, cfg, [5, 17, 42], len(got))
+    # 3 blocks cover positions < 12; growth stopped there
+    assert len(got) >= 5
+    # the engine still serves: blocks freed, new admission decodes
+    assert eng.used_blocks == 0
+    r2 = eng.admit([61, 3])
+    for _ in range(3):
+        eng.step()
+    assert eng.release(r2) == _oracle(params, cfg, [61, 3], 4)
+
+
+def test_register_prefix_pool_exhaustion_fails_clean():
+    """A prefix registration that cannot get all its blocks must free
+    its partial grab and raise ValueError — not wedge the pool."""
+    cfg = ModelConfig(**BASE, pos="rope")
+    params = init_params(cfg, jax.random.key(0))
+    eng = ServingEngine(
+        params, cfg, slots=1, max_len=64, prompt_buckets=(16,),
+        block_size=4, pool_blocks=3,        # junk + 2 usable
+    )
+    with pytest.raises(ValueError, match="pool exhausted"):
+        eng.register_prefix(list(range(12)))   # needs 3 blocks
+    assert eng.used_blocks == 0, "partial grab leaked"
+    # pool still fully usable
+    rid = eng.admit([5, 17])
+    eng.step()
+    assert eng.release(rid) == _oracle(params, cfg, [5, 17], 2)

@@ -68,6 +68,7 @@ def _copy(tree):
     return jax.tree.map(jnp.copy, tree)
 
 
+@pytest.mark.slow
 def test_gpipe_matches_unpipelined(mesh, params, tokens):
     step, init_all = make_pipeline_transformer_step(
         CFG, mesh, n_micro=M, schedule="gpipe"
@@ -79,6 +80,7 @@ def test_gpipe_matches_unpipelined(mesh, params, tokens):
     np.testing.assert_allclose(float(loss), want, rtol=1e-5)
 
 
+@pytest.mark.slow
 def test_1f1b_loss_matches_unpipelined(mesh, params, tokens):
     step, init_all = make_pipeline_transformer_step(
         CFG, mesh, n_micro=M, schedule="1f1b"
@@ -89,6 +91,7 @@ def test_1f1b_loss_matches_unpipelined(mesh, params, tokens):
     np.testing.assert_allclose(float(loss), want, rtol=1e-5)
 
 
+@pytest.mark.slow
 def test_1f1b_grads_match_gpipe_autodiff(mesh, params, tokens):
     """The explicit-vjp 1F1B backward against autodiff of the oracle."""
     want = jax.grad(unpipelined_loss)(params, tokens)
@@ -146,6 +149,7 @@ def test_training_reduces_loss_both_schedules(mesh, tokens):
         )
 
 
+@pytest.mark.slow
 def test_runner_pipeline_mode(tmp_path):
     """The in-pod runner trains the pipelined flagship end-to-end in a
     real process (--pp), both schedules."""
@@ -179,6 +183,7 @@ def test_runner_pipeline_mode(tmp_path):
         assert report["final_loss"] == report["final_loss"], schedule
 
 
+@pytest.mark.slow
 def test_pipeline_checkpoint_resume(tmp_path, mesh, tokens):
     """Orbax checkpointing round-trips the pipelined (pp-sharded) params:
     save mid-training, restore onto the live mesh, losses continue
@@ -209,6 +214,7 @@ def test_pipeline_checkpoint_resume(tmp_path, mesh, tokens):
     )
 
 
+@pytest.mark.slow
 def test_rope_pipeline_smoke(tokens):
     """pos='rope' works under the pipeline (stages see full sequences, so
     local indices are global positions); no pos_embed param exists."""
@@ -228,6 +234,7 @@ def test_rope_pipeline_smoke(tokens):
         assert np.isfinite(float(loss)), schedule
 
 
+@pytest.mark.slow
 def test_pp2_also_works(tokens):
     mesh2 = make_pipeline_mesh(pp=2, dp=2)
     cfg = ModelConfig(

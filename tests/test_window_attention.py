@@ -107,6 +107,7 @@ def test_model_windowed_forward_and_decode_agree():
         )
 
 
+@pytest.mark.slow
 def test_pipeline_honors_window():
     """The pipelined stages apply the same window as the unpipelined
     model (review r4: pipeline silently ignored it)."""

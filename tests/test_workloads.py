@@ -63,6 +63,7 @@ def test_train_step_dp_tp_loss_decreases():
     assert np.isfinite(losses).all()
 
 
+@pytest.mark.slow
 def test_train_step_with_sequence_parallelism():
     """sp>1 shards the sequence axis — long-context layout compiles and
     matches the sp=1 loss on the same data."""
@@ -93,6 +94,7 @@ def test_params_actually_sharded():
     assert shard_shapes == {(TINY.d_model, TINY.d_ff // 4)}
 
 
+@pytest.mark.slow
 def test_runner_decode_mode(tmp_path):
     """Real runner process in decode mode: reports KV-cache generation
     throughput as one JSON line, int8 variant included."""
@@ -131,6 +133,7 @@ def test_runner_decode_mode(tmp_path):
     assert report8["end_to_end_s"] > 0
 
 
+@pytest.mark.slow
 def test_grad_accumulation_equals_fused_batch():
     """accum_steps=4 over micro-batches must produce the same updated
     params and loss as one fused step on the concatenated batch (dense
@@ -189,6 +192,7 @@ def test_make_eval_fn_is_plain_nll():
     assert abs(got - want) < 1e-4, (got, want)
 
 
+@pytest.mark.slow
 def test_runner_eval_and_warmup(tmp_path):
     """Runner with held-out eval + lr warmup: the report carries the
     eval history and schedule block; eval losses are finite."""
@@ -229,6 +233,7 @@ def test_runner_eval_and_warmup(tmp_path):
     assert all(math.isfinite(e["loss"]) and e["loss"] > 0 for e in evals)
 
 
+@pytest.mark.slow
 def test_ema_tracks_param_trajectory_exactly():
     """ema_decay keeps d*ema + (1-d)*params inside opt_state; verified
     against a hand-unrolled recurrence over three real steps."""
