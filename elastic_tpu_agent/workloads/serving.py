@@ -121,6 +121,23 @@ class ServingEngine:
     (default: one slot's worth of headroom beyond slots*max_len for
     registered prefixes) sets total KV HBM. `used_blocks` exposes live
     pool pressure.
+
+    SPECULATIVE MODE: pass ``draft_params``/``draft_cfg`` (and
+    optionally ``gamma``) and every step() becomes a speculative
+    multi-token step — the draft proposes gamma tokens per live slot,
+    the target verifies all slots' gamma+1 positions in ONE batched
+    chunk (per-row positions), and each row commits its own accepted
+    prefix + correction (per-slot acceptance cursors). step() then
+    returns {rid: [tokens...]} — a LIST per request, variable length
+    per row per step. Greedy rows are EXACT: the stream equals the
+    target-only greedy stream token for token (the solo
+    speculative.py guarantee, vectorized). Sampling is per-request
+    temperature only (the Leviathan accept/resample rule needs the
+    draft and target distributions in the same family; top-k/top-p
+    admissions are rejected in spec mode). The draft uses a small
+    dense [slots, max_len] cache — it is narrow by design, so paging
+    it would complicate the rollback-by-length trick for no real HBM
+    win; the paged pool covers the target, where the memory is.
     """
 
     def __init__(
@@ -136,6 +153,9 @@ class ServingEngine:
         seed: int = 0,
         block_size: Optional[int] = None,
         pool_blocks: Optional[int] = None,
+        draft_params: Optional[Dict] = None,
+        draft_cfg: Optional[ModelConfig] = None,
+        gamma: int = 4,
     ):
         self.params = params
         self.cfg = cfg
@@ -202,7 +222,9 @@ class ServingEngine:
             b: self._build_prefill(b) for b in self.buckets
         }
         self._prefix_prefill_fns: Dict[Tuple[int, int], object] = {}
-        self._prefixes: Dict[int, Tuple[List[int], int]] = {}
+        # pid -> (pool block ids, token count, the tokens themselves —
+        # kept so spec-mode admissions can re-run the draft forward)
+        self._prefixes: Dict[int, Tuple[List[int], int, np.ndarray]] = {}
         self._next_prefix_id = 0
         # one jitted prefix-forward per engine (re-wrapping
         # _forward_chunk per register_prefix call would recompile)
@@ -217,6 +239,37 @@ class ServingEngine:
             ),
             donate_argnums=(0, 1),
         )
+
+        # -- speculative mode ----------------------------------------
+        self.draft_params = draft_params
+        self.draft_cfg = draft_cfg
+        self.gamma = gamma
+        if draft_params is not None:
+            assert draft_cfg is not None
+            if cfg.vocab != draft_cfg.vocab:
+                raise ValueError("draft/target vocabularies must match")
+            if cfg.moe_experts or draft_cfg.moe_experts:
+                raise ValueError(
+                    "speculative serving supports dense models"
+                )
+            if gamma < 1:
+                raise ValueError(f"gamma must be >= 1, got {gamma}")
+            if top_k or top_p:
+                raise ValueError(
+                    "speculative serving supports greedy/temperature "
+                    "sampling only (no engine-wide top-k/top-p)"
+                )
+            if draft_cfg.pos == "learned":
+                assert draft_cfg.max_seq >= max_len
+            dshape = (
+                draft_cfg.n_layers, slots, max_len,
+                draft_cfg.kv_heads, draft_cfg.head_dim,
+            )
+            self._draft_k = jnp.zeros(dshape, draft_cfg.dtype)
+            self._draft_v = jnp.zeros(dshape, draft_cfg.dtype)
+            self._draft_prefill_fns: Dict[int, object] = {}
+            self._spec_step_fn = self._build_spec_step()
+            self._draft_catchup_fn = self._build_draft_catchup()
 
     # -- paging helpers ----------------------------------------------
 
@@ -386,6 +439,191 @@ class ServingEngine:
 
         return prefill
 
+    # -- speculative-mode programs -----------------------------------
+
+    def _build_draft_prefill(self, width: int):
+        """Prefill the DRAFT's dense cache row for an admission: the
+        full (prefix + prompt) token run as one chunk (the draft is
+        cheap — recomputing its prefix forward per admission beats
+        keeping a second paged pool coherent)."""
+        dcfg = self.draft_cfg
+
+        @functools.partial(jax.jit, donate_argnums=(1, 2))
+        def prefill(draft_params, dk, dv, padded, slot):
+            mini = KVCache.empty(dcfg, 1, width)
+            _, mini = _forward_chunk(
+                draft_params, padded[None], mini, dcfg
+            )
+            dk = jax.lax.dynamic_update_slice(
+                dk, mini.k, (0, slot, 0, 0, 0)
+            )
+            dv = jax.lax.dynamic_update_slice(
+                dv, mini.v, (0, slot, 0, 0, 0)
+            )
+            return dk, dv
+
+        return prefill
+
+    def _build_draft_catchup(self):
+        """Feed ``last`` through the draft at each row's position —
+        used when a near-max_len row forces a plain (non-speculative)
+        step, so the draft cache keeps mirroring the target's
+        'cached = everything but last' invariant."""
+        dcfg = self.draft_cfg
+
+        @functools.partial(jax.jit, donate_argnums=(1, 2))
+        def catchup(draft_params, dk, dv, lengths, toks):
+            cache = KVCache(k=dk, v=dv, length=jnp.int32(0))
+            _, cache = _forward_chunk(
+                draft_params, toks[:, None], cache, dcfg,
+                moe_drop_free=True, positions=lengths,
+            )
+            return cache.k, cache.v
+
+        return catchup
+
+    @staticmethod
+    def _probs_rowwise(logits, temp, vocab):
+        """Per-row sampling distribution: one-hot argmax for greedy
+        rows (temp == 0, which makes the accept/resample algebra
+        reduce to exact greedy matching), softmax(logits/T) else.
+        logits [..., b, vocab], temp [b]."""
+        t = jnp.maximum(temp, 1e-6)[..., None]
+        p = jax.nn.softmax(logits / t, axis=-1)
+        onehot = jax.nn.one_hot(
+            jnp.argmax(logits, axis=-1), vocab, dtype=jnp.float32
+        )
+        return jnp.where((temp <= 0.0)[..., None], onehot, p)
+
+    def _build_spec_step(self):
+        """The speculative step over ALL slots in lockstep: draft
+        scan (gamma single-token rows), ONE target verify chunk of
+        width gamma+1 at per-row positions, per-row Leviathan
+        accept/resample, commit + scatter-back. Invariant (same as
+        speculative.py's cursor-1): ``lengths`` counts CACHED
+        positions — every committed token except the trailing
+        ``last``, which each round re-feeds as its chunk head."""
+        cfg = self.cfg
+        dcfg = self.draft_cfg
+        gamma = self.gamma
+        vocab = cfg.vocab
+
+        @functools.partial(jax.jit, donate_argnums=(1, 2, 3, 4))
+        def spec_step(
+            params, pk, pv, dk, dv, table_b, lengths, toks, active,
+            key, temp, wblk, woff, draft_params,
+        ):
+            slots = toks.shape[0]
+
+            # -- draft proposes gamma tokens per row -----------------
+            def draft_step(carry, i):
+                dk, dv, tok, key = carry
+                key, sub = jax.random.split(key)
+                cache = KVCache(k=dk, v=dv, length=jnp.int32(0))
+                logits, cache = _forward_chunk(
+                    draft_params, tok[:, None], cache, dcfg,
+                    moe_drop_free=True, positions=lengths + i,
+                )
+                q = self._probs_rowwise(logits[:, 0], temp, vocab)
+                nxt = jax.random.categorical(
+                    sub, jnp.log(jnp.maximum(q, 1e-30)), axis=-1
+                ).astype(jnp.int32)
+                return (cache.k, cache.v, nxt, key), (nxt, q)
+
+            key, dkey = jax.random.split(key)
+            (dk, dv, _, _), (draft_toks, draft_q) = jax.lax.scan(
+                draft_step, (dk, dv, toks, dkey),
+                jnp.arange(gamma),
+            )
+            draft_toks = jnp.moveaxis(draft_toks, 0, 1)  # [slots, g]
+            draft_q = jnp.moveaxis(draft_q, 0, 1)        # [slots, g, V]
+            # cache d_gamma too: a fully-accepted round needs its
+            # entry next round (stale-but-masked on partial accepts)
+            cache = KVCache(k=dk, v=dv, length=jnp.int32(0))
+            _, cache = _forward_chunk(
+                draft_params, draft_toks[:, gamma - 1][:, None],
+                cache, dcfg, moe_drop_free=True,
+                positions=lengths + gamma,
+            )
+            dk, dv = cache.k, cache.v
+
+            # -- target verifies all rows' gamma+1 positions at once -
+            kg, vg = self._gathered_view(pk, pv, table_b)
+            chunk = jnp.concatenate(
+                [toks[:, None], draft_toks], axis=1
+            )  # [slots, gamma+1]
+            tcache = KVCache(k=kg, v=vg, length=jnp.int32(0))
+            tlogits, tcache = _forward_chunk(
+                params, chunk, tcache, cfg,
+                moe_drop_free=True, positions=lengths,
+            )
+            target_p = self._probs_rowwise(
+                tlogits, temp[:, None], vocab
+            )  # [slots, gamma+1, V]
+
+            # scatter ALL gamma+1 written positions back to the pool
+            # (rejected tails are stale-but-masked, overwritten by the
+            # next round's chunk at the same positions)
+            pos = lengths[:, None] + jnp.arange(gamma + 1)[None]
+            idx = jnp.minimum(
+                pos, kg.shape[2] - 1
+            ).reshape(1, slots, gamma + 1, 1, 1)
+            wk = jnp.take_along_axis(tcache.k, idx, axis=2, mode="clip")
+            wv = jnp.take_along_axis(tcache.v, idx, axis=2, mode="clip")
+            pk = pk.at[:, wblk, woff].set(wk)
+            pv = pv.at[:, wblk, woff].set(wv)
+
+            # -- per-row Leviathan accept / resample -----------------
+            p_i = jnp.take_along_axis(
+                target_p[:, :gamma], draft_toks[..., None], axis=-1
+            )[..., 0]                                   # [slots, g]
+            q_i = jnp.take_along_axis(
+                draft_q, draft_toks[..., None], axis=-1
+            )[..., 0]
+            key, ukey = jax.random.split(key)
+            u = jax.random.uniform(ukey, (slots, gamma))
+            ok = u < jnp.minimum(1.0, p_i / jnp.maximum(q_i, 1e-30))
+            n_acc = jnp.sum(
+                jnp.cumprod(ok.astype(jnp.int32), axis=1), axis=1
+            )                                           # [slots]
+
+            cut = jnp.minimum(n_acc, gamma - 1)
+            p_cut = jnp.take_along_axis(
+                target_p[:, :gamma], cut[:, None, None], axis=1
+            )[:, 0]                                     # [slots, V]
+            q_cut = jnp.take_along_axis(
+                draft_q, cut[:, None, None], axis=1
+            )[:, 0]
+            resid = jnp.maximum(p_cut - q_cut, 0.0)
+            rsum = jnp.sum(resid, axis=-1, keepdims=True)
+            resid = jnp.where(rsum > 0, resid / jnp.maximum(rsum, 1e-30), p_cut)
+            correction_dist = jnp.where(
+                (n_acc == gamma)[:, None], target_p[:, gamma], resid
+            )
+            key, ckey = jax.random.split(key)
+            correction = jax.random.categorical(
+                ckey, jnp.log(jnp.maximum(correction_dist, 1e-30)),
+                axis=-1,
+            ).astype(jnp.int32)                         # [slots]
+
+            # committed tokens this round: draft_toks[:n_acc] then the
+            # correction; slots >= n_acc carry the correction value
+            # (only slot n_acc of those is real — the host slices by
+            # n_emit)
+            emit = jnp.concatenate(
+                [draft_toks, correction[:, None]], axis=1
+            )
+            committed = jnp.where(
+                jnp.arange(gamma + 1)[None] < n_acc[:, None],
+                emit, correction[:, None],
+            )                                           # [slots, g+1]
+            n_emit = jnp.where(active, n_acc + 1, 0)
+            lengths = jnp.where(active, lengths + n_acc + 1, lengths)
+            last = jnp.where(active, correction, toks)
+            return pk, pv, dk, dv, lengths, last, committed, n_emit
+
+        return spec_step
+
     # -- host API ----------------------------------------------------
 
     def register_prefix(self, tokens) -> int:
@@ -439,7 +677,9 @@ class ServingEngine:
         )
         pid = self._next_prefix_id
         self._next_prefix_id += 1
-        self._prefixes[pid] = (block_ids, plen)
+        # tokens kept for speculative mode: the draft re-runs the
+        # full (prefix + prompt) forward at admission
+        self._prefixes[pid] = (block_ids, plen, tokens)
         return pid
 
     def release_prefix(self, pid: int) -> None:
@@ -447,7 +687,7 @@ class ServingEngine:
         requests admitted with it are unaffected — their tables hold
         refcounted shares, and the blocks free only when the last
         sharer releases."""
-        block_ids, _ = self._prefixes.pop(pid)
+        block_ids, _, _ = self._prefixes.pop(pid)
         for bid in block_ids:
             self._alloc.drop(bid)
 
@@ -490,10 +730,11 @@ class ServingEngine:
                 raise ValueError(
                     f"unknown or released prefix {prefix}"
                 )
-            pref_blocks, plen = self._prefixes[prefix]
+            pref_blocks, plen, pref_tokens = self._prefixes[prefix]
             pref_padded = self._blocks_for(plen) * self.block_size
         else:
             pref_blocks, plen, pref_padded = [], 0, 0
+            pref_tokens = np.zeros((0,), np.int32)
         total = plen + p
         if total >= self.max_len:
             raise ValueError(
@@ -512,6 +753,12 @@ class ServingEngine:
         temp = d_temp if temperature is None else float(temperature)
         tk = d_topk if top_k is None else int(top_k)
         tp = d_topp if top_p is None else float(top_p)
+        if self.draft_params is not None and (tk or tp):
+            self._free.insert(0, slot)
+            raise ValueError(
+                "speculative serving supports greedy/temperature "
+                "sampling only (no top-k/top-p)"
+            )
         self._row_temp[slot] = temp
         self._row_topk[slot] = tk
         self._row_topp[slot] = tp
@@ -571,6 +818,24 @@ class ServingEngine:
                 jnp.int32(p), sub, tkp, jnp.asarray(phys),
             )
         self._pool_k, self._pool_v = pk, pv
+        if self.draft_params is not None:
+            # prefill the draft's dense row on the FULL sequence (the
+            # prefix's tokens were kept at registration); width is the
+            # same static (pref_padded + bucket) family as the target
+            width = pref_padded + bucket
+            run = np.zeros((width,), np.int32)
+            run[:plen] = pref_tokens
+            run[plen:total] = prompt
+            if width not in self._draft_prefill_fns:
+                self._draft_prefill_fns[width] = (
+                    self._build_draft_prefill(width)
+                )
+            self._draft_k, self._draft_v = self._draft_prefill_fns[
+                width
+            ](
+                self.draft_params, self._draft_k, self._draft_v,
+                jnp.asarray(run), jnp.int32(slot),
+            )
         self._lengths = self._lengths.at[slot].set(total)
         self._host_len[slot] = total
         self._last = self._last.at[slot].set(first)
@@ -584,18 +849,22 @@ class ServingEngine:
             self._finish(rid, "stop_token")
         return rid
 
-    def step(self) -> Dict[int, int]:
-        """Advance every live request one token; returns {rid: token}.
-        Requests whose row fills to max_len — or that emit one of
-        their stop tokens — are auto-finished (their streams remain
-        retrievable via release()).
+    def step(self) -> Dict[int, object]:
+        """Advance every live request; auto-finishes rows that fill
+        to max_len, emit a stop token, or starve for pool blocks
+        (``finish_reason`` says which; streams stay retrievable via
+        release(); step() never raises mid-decode).
 
-        Pool pressure: if a request's next token has no block and the
-        pool is exhausted, that request is auto-finished with
-        ``finish_reason[rid] == "pool_exhausted"`` (its stream so far
-        stays intact and exact) and the OTHER requests keep decoding —
-        step() never raises mid-decode. Size pool_blocks for the
-        worst case to avoid cut-short streams."""
+        Plain engines return {rid: token} — one token per live
+        request. SPECULATIVE engines (constructed with draft_params)
+        return {rid: [tokens...]} — each row commits its accepted
+        draft prefix + correction, so lists have variable length ≥ 1
+        per step."""
+        if self.draft_params is not None:
+            return self._step_speculative()
+        return self._step_plain()
+
+    def _step_plain(self) -> Dict[int, int]:
         if not self._slot_of:
             return {}
         # back each write position with a pool block; a slot that
@@ -652,6 +921,86 @@ class ServingEngine:
                 self._finish(rid, "max_len")
             elif tok in self._stop[rid]:
                 self._finish(rid, "stop_token")
+        return out
+
+    def _step_speculative(self) -> Dict[int, List[int]]:
+        if not self._slot_of:
+            return {}
+        g = self.gamma
+        # a row within gamma of max_len can't take a full verify
+        # chunk: catch the draft cache up and take a plain step (the
+        # row auto-finishes at max_len within a few of these)
+        if any(
+            int(self._host_len[s]) + g >= self.max_len
+            for s in self._slot_of.values()
+        ):
+            self._draft_k, self._draft_v = self._draft_catchup_fn(
+                self.draft_params, self._draft_k, self._draft_v,
+                self._lengths, self._last,
+            )
+            return {
+                rid: [tok] for rid, tok in self._step_plain().items()
+            }
+        # back the whole verify chunk (positions len..len+gamma) with
+        # pool blocks, per live slot
+        rid_of_slot = {s: r for r, s in self._slot_of.items()}
+        for s in sorted(rid_of_slot):
+            try:
+                self._ensure_blocks(s, int(self._host_len[s]) + g + 1)
+            except RuntimeError:
+                self._finish(rid_of_slot[s], "pool_exhausted")
+        if not self._slot_of:
+            return {}
+        live_slots = set(self._slot_of.values())
+        live = sorted(live_slots)
+        bs = self.block_size
+        wblk = np.full((self.slots, g + 1), _JUNK, np.int32)
+        woff = np.zeros((self.slots, g + 1), np.int32)
+        for s in live:
+            for i in range(g + 1):
+                w = int(self._host_len[s]) + i
+                wblk[s, i] = self._table[s, w // bs]
+                woff[s, i] = w % bs
+        n_b = self._gather_bucket(
+            max(self._blocks_for(int(self._host_len[s]) + g + 1)
+                for s in live)
+        )
+        table_b = jnp.asarray(self._table[:, :n_b])
+        active = jnp.asarray(
+            [s in live_slots for s in range(self.slots)]
+        )
+        self._key, sub = jax.random.split(self._key)
+        # one jit wrapper; jax retraces per table_b gather width
+        (
+            self._pool_k, self._pool_v, self._draft_k, self._draft_v,
+            self._lengths, self._last, committed, n_emit,
+        ) = self._spec_step_fn(
+            self.params, self._pool_k, self._pool_v,
+            self._draft_k, self._draft_v, table_b, self._lengths,
+            self._last, active, sub, jnp.asarray(self._row_temp),
+            jnp.asarray(wblk), jnp.asarray(woff), self.draft_params,
+        )
+        committed = np.asarray(committed)
+        n_emit = np.asarray(n_emit)
+        out: Dict[int, List[int]] = {}
+        for rid, slot in list(self._slot_of.items()):
+            toks = committed[slot][: int(n_emit[slot])].tolist()
+            self._host_len[slot] += int(n_emit[slot])
+            # stop-token truncation: the stream ends AT the first
+            # stop; later tokens from the same round are dropped
+            # (they're the oracle's continuation past the stop)
+            cut = next(
+                (i for i, t in enumerate(toks)
+                 if t in self._stop[rid]), None,
+            )
+            if cut is not None:
+                toks = toks[: cut + 1]
+            self._streams[rid].extend(toks)
+            out[rid] = toks
+            if cut is not None:
+                self._finish(rid, "stop_token")
+            elif int(self._host_len[slot]) >= self.max_len - 1:
+                self._finish(rid, "max_len")
         return out
 
     def _finish(self, rid: int, reason: str = "released") -> None:

@@ -1,0 +1,252 @@
+"""Speculative decoding inside the serving engine
+(workloads/serving.py spec mode): batched draft-propose/target-verify
+with PER-SLOT acceptance cursors. The pin is the same as solo
+speculative.py's — greedy streams equal target-only greedy decoding
+token for token — but now it must hold for every slot of a churning
+continuous batch."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from elastic_tpu_agent.workloads.generate import generate
+from elastic_tpu_agent.workloads.serving import ServingEngine
+from elastic_tpu_agent.workloads.transformer import (
+    ModelConfig,
+    init_params,
+)
+
+BASE = dict(
+    vocab=97, d_model=32, n_heads=4, n_layers=2, d_ff=64, max_seq=128,
+    dtype=jnp.float32, attn="reference", pos="rope",
+)
+DRAFT = dict(
+    vocab=97, d_model=16, n_heads=2, n_layers=1, d_ff=32, max_seq=128,
+    dtype=jnp.float32, attn="reference", pos="rope",
+)
+
+
+def _models():
+    cfg = ModelConfig(**BASE)
+    dcfg = ModelConfig(**DRAFT)
+    params = init_params(cfg, jax.random.key(0))
+    dparams = init_params(dcfg, jax.random.key(7))
+    return cfg, params, dcfg, dparams
+
+
+def _oracle(params, cfg, prompt, n):
+    out = generate(
+        params, jnp.asarray(prompt, jnp.int32)[None], cfg,
+        max_new_tokens=n,
+    )
+    return np.asarray(out[0, len(prompt):]).tolist()
+
+
+def test_spec_greedy_streams_exact_with_churn():
+    """Interleaved admissions through a speculative engine: every
+    greedy stream equals the target-only oracle."""
+    cfg, params, dcfg, dparams = _models()
+    eng = ServingEngine(
+        params, cfg, slots=3, max_len=64, prompt_buckets=(8,),
+        draft_params=dparams, draft_cfg=dcfg, gamma=3,
+    )
+    pa, pb, pc = [5, 17, 42, 9], [3, 88], [61, 24, 7]
+    ra = eng.admit(pa)
+    rb = eng.admit(pb)
+    for _ in range(4):
+        out = eng.step()
+        for toks in out.values():
+            assert isinstance(toks, list) and len(toks) >= 1
+    rc = eng.admit(pc)      # joins mid-flight
+    for _ in range(3):
+        eng.step()
+    for rid, prompt in [(ra, pa), (rb, pb), (rc, pc)]:
+        got = eng.release(rid)
+        assert got == _oracle(params, cfg, prompt, len(got)), prompt
+
+
+def test_spec_draft_equals_target_commits_full_rounds():
+    """With the TARGET as its own draft every proposal is accepted:
+    each live row commits gamma+1 tokens per step — the multi-token
+    per-slot commit path, exercised at full width."""
+    cfg, params, _, _ = _models()
+    eng = ServingEngine(
+        params, cfg, slots=2, max_len=64, prompt_buckets=(8,),
+        draft_params=params, draft_cfg=cfg, gamma=3,
+    )
+    pa, pb = [5, 17, 42], [61, 3]
+    ra, rb = eng.admit(pa), eng.admit(pb)
+    out = eng.step()
+    assert len(out[ra]) == 4 and len(out[rb]) == 4, out
+    got_a, got_b = eng.release(ra), eng.release(rb)
+    assert got_a == _oracle(params, cfg, pa, 5)
+    assert got_b == _oracle(params, cfg, pb, 5)
+
+
+def test_spec_stop_token_truncates_round():
+    """A stop token landing mid-commit ends the stream AT the stop —
+    tokens the same round committed after it are dropped."""
+    cfg, params, dcfg, dparams = _models()
+    # target-as-draft so rounds commit full gamma+1 chunks
+    eng = ServingEngine(
+        params, cfg, slots=1, max_len=64, prompt_buckets=(8,),
+        draft_params=params, draft_cfg=cfg, gamma=4,
+    )
+    prompt = [5, 17, 42, 9]
+    ref = _oracle(params, cfg, prompt, 12)
+    stop = ref[2]            # lands inside the first verify round
+    rid = eng.admit(prompt, stop_tokens=[stop])
+    steps = 0
+    while rid in eng._slot_of and steps < 10:
+        eng.step()
+        steps += 1
+    assert eng.finish_reason[rid] == "stop_token"
+    got = eng.release(rid)
+    first = ref.index(stop)
+    assert got == ref[: first + 1]
+
+
+def test_spec_near_max_len_falls_back_and_finishes():
+    """Rows within gamma of max_len take plain single-token steps
+    (draft kept in sync) and auto-finish at the row end — exactly."""
+    cfg, params, dcfg, dparams = _models()
+    eng = ServingEngine(
+        params, cfg, slots=1, max_len=16, prompt_buckets=(8,),
+        draft_params=dparams, draft_cfg=dcfg, gamma=4,
+    )
+    prompt = [5, 17, 42, 9, 61, 3, 88, 24]
+    rid = eng.admit(prompt)
+    steps = 0
+    while rid in eng._slot_of and steps < 20:
+        eng.step()
+        steps += 1
+    assert eng.finish_reason[rid] == "max_len"
+    got = eng.release(rid)
+    assert got == _oracle(params, cfg, prompt, len(got))
+    # row filled: prompt 8 + 7 generated = 15 = max_len - 1
+    assert len(got) >= 7
+
+
+def test_spec_prefix_admissions_exact():
+    """Prefix sharing works under speculative decode: the target uses
+    the shared blocks, the draft re-runs the full sequence, and the
+    streams stay oracle-exact."""
+    cfg, params, dcfg, dparams = _models()
+    eng = ServingEngine(
+        params, cfg, slots=2, max_len=64, prompt_buckets=(8,),
+        draft_params=dparams, draft_cfg=dcfg, gamma=3,
+    )
+    system = [7, 7, 30, 2, 51, 11, 29, 4]
+    pid = eng.register_prefix(system)
+    ra = eng.admit([5, 17], prefix=pid)
+    rb = eng.admit([61, 3, 9], prefix=pid)
+    for _ in range(4):
+        eng.step()
+    got_a, got_b = eng.release(ra), eng.release(rb)
+    assert got_a == _oracle(params, cfg, system + [5, 17], len(got_a))
+    assert got_b == _oracle(params, cfg, system + [61, 3, 9], len(got_b))
+
+
+def test_spec_rejects_topk_topp():
+    cfg, params, dcfg, dparams = _models()
+    eng = ServingEngine(
+        params, cfg, slots=1, max_len=32, prompt_buckets=(8,),
+        draft_params=dparams, draft_cfg=dcfg,
+    )
+    with pytest.raises(ValueError, match="temperature"):
+        eng.admit([5, 17], top_k=5)
+    # the failed admission must not leak the slot
+    rid = eng.admit([5, 17])
+    assert rid in eng._slot_of
+
+
+def test_spec_mixed_greedy_and_sampled_rows():
+    """A greedy row batched with temperature rows: the greedy stream
+    stays exact, sampled rows stay in-vocab."""
+    cfg, params, dcfg, dparams = _models()
+    eng = ServingEngine(
+        params, cfg, slots=3, max_len=64, prompt_buckets=(8,),
+        draft_params=dparams, draft_cfg=dcfg, gamma=3,
+    )
+    pg = [5, 17, 42, 9]
+    rg = eng.admit(pg)
+    rs = eng.admit([3, 88], temperature=1.2)
+    rt = eng.admit([61, 24], temperature=0.7)
+    for _ in range(5):
+        eng.step()
+    got_g = eng.release(rg)
+    assert got_g == _oracle(params, cfg, pg, len(got_g))
+    for r in (rs, rt):
+        got = eng.release(r)
+        assert all(0 <= t < cfg.vocab for t in got) and len(got) >= 1
+
+
+@pytest.mark.slow
+def test_spec_soak_random_schedule_greedy_exact():
+    """Randomized spec-mode soak: churn of greedy and temperature
+    admissions with random release budgets — every greedy stream must
+    equal the solo oracle; every sampled stream stays in-vocab."""
+    rng = np.random.default_rng(23)
+    cfg, params, dcfg, dparams = _models()
+    eng = ServingEngine(
+        params, cfg, slots=3, max_len=64, prompt_buckets=(4, 8),
+        draft_params=dparams, draft_cfg=dcfg, gamma=3,
+    )
+    pid = eng.register_prefix([7, 30, 2, 9])
+    expected, budget, done = {}, {}, []
+
+    def admit_random():
+        plen = int(rng.integers(1, 6))
+        prompt = rng.integers(0, cfg.vocab, size=plen).tolist()
+        use_prefix = bool(rng.integers(0, 2))
+        greedy = bool(rng.integers(0, 2))
+        rid = eng.admit(
+            prompt,
+            prefix=pid if use_prefix else None,
+            temperature=0.0 if greedy else float(rng.uniform(0.5, 1.3)),
+        )
+        seq = ([7, 30, 2, 9] if use_prefix else []) + prompt
+        expected[rid] = (greedy, seq)
+        budget[rid] = int(rng.integers(1, 6))
+
+    for _ in range(50):
+        live = [r for r in budget if budget[r] > 0]
+        if eng._free and (not live or rng.random() < 0.4):
+            admit_random()
+            continue
+        if not live:
+            continue
+        eng.step()
+        for r in list(budget):
+            if budget[r] > 0 and r in eng._streams:
+                budget[r] -= 1
+                if budget[r] == 0:
+                    done.append((r, eng.release(r)))
+    for r in list(budget):
+        if budget[r] > 0 and r in eng._streams:
+            done.append((r, eng.release(r)))
+
+    assert len(done) >= 8, f"soak admitted too few: {len(done)}"
+    n_greedy = 0
+    for rid, got in done:
+        greedy, seq = expected[rid]
+        if greedy:
+            n_greedy += 1
+            assert got == _oracle(params, cfg, seq, len(got)), (rid, seq)
+        else:
+            assert all(0 <= t < cfg.vocab for t in got), rid
+    assert n_greedy >= 3
+
+
+def test_spec_constructor_validation():
+    cfg, params, dcfg, dparams = _models()
+    with pytest.raises(ValueError, match="gamma"):
+        ServingEngine(
+            params, cfg, draft_params=dparams, draft_cfg=dcfg, gamma=0,
+        )
+    with pytest.raises(ValueError, match="engine-wide top-k"):
+        ServingEngine(
+            params, cfg, top_k=50,
+            draft_params=dparams, draft_cfg=dcfg,
+        )
