@@ -295,8 +295,82 @@ def tpu_measure_once():
         "final_loss": final_loss,
         "n_params_m": n_params / 1e6,
     }
+
+    # -- master-weights layout (docs/perf.md "(1)+(2) lever"): bf16
+    # live tree, f32 masters updated by the optimizer, re-rounded per
+    # step — same numerics contract, roughly half the weight HBM
+    # traffic and zero per-step f32->bf16 cast reads.
+    decode_tree = params
+    decode_dtype = "float32-stored"
     try:
-        result["decode"] = tpu_decode_measure(params, cfg)
+        def one_step_mw(carry, _):
+            live, opt_state, masters, tokens = carry
+            loss, grads = jax.value_and_grad(loss_fn)(live, tokens)
+            grads = jax.tree_util.tree_map(
+                lambda g: g.astype(jnp.float32), grads
+            )
+            updates, opt_state = optimizer.update(
+                grads, opt_state, masters
+            )
+            masters = optax.apply_updates(masters, updates)
+            live = jax.tree_util.tree_map(
+                lambda m, l: m.astype(l.dtype), masters, live
+            )
+            return (live, opt_state, masters, tokens), loss
+
+        # masters (argnum 2) deliberately NOT donated: `params` doubles
+        # as the decode fallback tree, and a mid-execution failure in a
+        # donated call would leave it deleted. The baseline opt_state
+        # is dead weight from here — free its 8 B/param before the mw
+        # run allocates fresh moments + masters + the bf16 live tree.
+        del opt_state
+
+        @functools.partial(jax.jit, donate_argnums=(0, 1))
+        def run_steps_mw(live, opt_state, masters, tokens):
+            (live, opt_state, masters, _), losses = jax.lax.scan(
+                one_step_mw, (live, opt_state, masters, tokens),
+                None, length=steps,
+            )
+            return live, opt_state, masters, losses[-1]
+
+        live = jax.tree_util.tree_map(
+            lambda p: p.astype(cfg.dtype), params
+        )
+        mw_opt = optimizer.init(params)
+        live, mw_opt, params, mw_loss = run_steps_mw(
+            live, mw_opt, params, tokens
+        )
+        float(mw_loss)  # warmup barrier
+        t0 = time.perf_counter()
+        live, mw_opt, params, mw_loss = run_steps_mw(
+            live, mw_opt, params, tokens
+        )
+        float(mw_loss)
+        dt_mw = time.perf_counter() - t0
+        mw_tflops = flops_per_step * steps / dt_mw / 1e12
+        result["master_weights"] = {
+            "step_time_ms": dt_mw / steps * 1000,
+            "achieved_tflops": mw_tflops,
+            "mxu_util_pct": 100 * mw_tflops / peak,
+            "speedup_vs_f32_store": dt / dt_mw,
+        }
+        # headline MFU: the better layout (both recorded)
+        if mw_tflops > achieved_tflops:
+            result["achieved_tflops"] = mw_tflops
+            result["mxu_util_pct"] = 100 * mw_tflops / peak
+            result["step_time_ms"] = dt_mw / steps * 1000
+            result["tokens_per_s"] = tokens_per_step * steps / dt_mw
+            result["headline_layout"] = "master_weights"
+        del mw_opt
+        # decode below runs on the bf16 live tree — the form a
+        # serving artifact actually ships
+        decode_tree, decode_dtype = live, "bfloat16"
+    except Exception as e:  # noqa: BLE001 - bonus metric
+        result["master_weights"] = {"error": f"{type(e).__name__}: {e}"}
+
+    try:
+        result["decode"] = tpu_decode_measure(decode_tree, cfg)
+        result["decode"]["weights_dtype"] = decode_dtype
     except Exception as e:  # noqa: BLE001 - decode is a bonus metric
         result["decode"] = {"error": f"{type(e).__name__}: {e}"}
     return result
@@ -464,7 +538,29 @@ def tpu_only_main():
         print(json.dumps({"error": f"{type(e).__name__}: {e}"}))
 
 
+# Fixed CPU workload for load normalization, pinned to its at-rest
+# duration on the 1-CPU CI box (measured round 5, 3 trials: 0.0153 s
+# ±0.0002). When the measured/pinned ratio exceeds the tolerance the
+# box is running something else, and the ABSOLUTE control-plane
+# milliseconds of this round are not comparable to other rounds' — the
+# headline is therefore the same-process ratio (ours vs
+# reference-style uncached locate), which divides the load out.
+_HOST_PROBE_REF_S = 0.0153
+_HOST_PROBE_SKEW_TOLERANCE = 1.5
+
+
+def host_load_probe() -> float:
+    import hashlib
+
+    t0 = time.perf_counter()
+    h = hashlib.sha256()
+    for _ in range(20000):
+        h.update(b"x" * 1000)
+    return time.perf_counter() - t0
+
+
 def main():
+    probe_s = host_load_probe()
     ours = run_control_plane(disable_locator_cache=False)
     ours_0ms = run_control_plane(
         disable_locator_cache=False, sandbox_sleep_s=0.0
@@ -472,12 +568,24 @@ def main():
     ref = run_control_plane(disable_locator_cache=True)
     tpu = run_tpu_throughput()
     vs_baseline = ref["bind_p50_ms"] / ours["bind_p50_ms"]
+    load_ratio = probe_s / _HOST_PROBE_REF_S
+    # Headline = the RATIO: both sides of it ran in this process under
+    # this host load, so it self-normalizes; raw milliseconds stay in
+    # extra, flagged when the load probe says they're skewed.
     result = {
-        "metric": "alloc_bind_p50_ms",
-        "value": round(ours["bind_p50_ms"], 3),
-        "unit": "ms",
+        "metric": "bind_p50_vs_reference_speedup",
+        "value": round(vs_baseline, 3),
+        "unit": "x",
         "vs_baseline": round(vs_baseline, 3),
         "extra": {
+            "abs_bind_p50_ms": round(ours["bind_p50_ms"], 3),
+            "host_load": {
+                "probe_s": round(probe_s, 5),
+                "ratio_vs_rest": round(load_ratio, 2),
+                "absolute_ms_load_skewed": bool(
+                    load_ratio > _HOST_PROBE_SKEW_TOLERANCE
+                ),
+            },
             "ours": {k: round(v, 3) for k, v in ours.items()},
             # Same flow with NO synthetic sandbox gap: prefetch overlap
             # gets zero help here, so this is the un-gifted number.

@@ -136,6 +136,19 @@ def main(argv=None) -> int:
     )
     parser.add_argument("--lr", type=float, default=1e-3)
     parser.add_argument(
+        "--master-weights", action="store_true",
+        help="store live params in the model dtype (bf16) with f32 "
+             "masters inside the optimizer state: halves weight HBM "
+             "reads and removes the per-step f32->bf16 casts. "
+             "dp/sp/tp mode only",
+    )
+    parser.add_argument(
+        "--zero1", action="store_true",
+        help="shard optimizer state (moments, masters, EMA) over the "
+             "dp mesh axis (ZeRO-1): optimizer HBM drops to 1/dp per "
+             "rank. dp/sp/tp mode only",
+    )
+    parser.add_argument(
         "--ema-decay", type=float, default=0.0,
         help="keep an EMA of params in the optimizer state (e.g. "
              "0.999) and save it as its own checkpoint item; export "
@@ -226,6 +239,11 @@ def main(argv=None) -> int:
             )
         if args.ema_decay > 0:
             parser.error("--ema-decay is not supported with --pp")
+        if args.master_weights or args.zero1:
+            parser.error(
+                "--master-weights/--zero1 compose with the dp/sp/tp "
+                "step only (the pipeline step owns its own state)"
+            )
         if args.sp != 1 or (args.tp or 1) != 1:
             parser.error(
                 "--pp composes with --dp only; --sp/--tp are not supported "
@@ -283,6 +301,7 @@ def main(argv=None) -> int:
         train_step, init_all, _ = make_train_step(
             cfg, mesh, learning_rate=lr, accum_steps=args.accum_steps,
             ema_decay=args.ema_decay,
+            master_weights=args.master_weights, zero1=args.zero1,
         )
         shape = (
             (args.batch, args.seq + 1) if args.accum_steps == 1

@@ -484,6 +484,7 @@ def ema_params(opt_state) -> Optional[Any]:
 def make_train_step(
     cfg: ModelConfig, mesh: Mesh, learning_rate: float = 1e-3,
     accum_steps: int = 1, ema_decay: float = 0.0,
+    master_weights: bool = False, zero1: bool = False,
 ):
     """(params, opt_state, tokens) -> (params, opt_state, loss), jit'd over
     the mesh with real dp/sp/tp shardings.
@@ -506,7 +507,22 @@ def make_train_step(
     ema_decay > 0 keeps an exponential moving average of the params
     inside the optimizer state (extract with ema_params(opt_state);
     serve/export the smoothed weights). Costs one param-shaped f32
-    tree of HBM."""
+    tree of HBM.
+
+    master_weights=True stores the LIVE params in cfg.dtype (bf16 on
+    TPU) and keeps f32 masters inside opt_state: the forward/backward
+    read half the weight HBM and the per-step f32->bf16 weight casts
+    disappear (the compute path already ran in cfg.dtype via wdense —
+    storing rounded weights reads the same values the casts produced).
+    The optimizer updates the f32 masters, then the step re-rounds
+    them into the live tree; opt_state becomes (inner_state, masters).
+
+    zero1=True shards the optimizer state — adamw moments, masters,
+    EMA — over the "dp" mesh axis (ZeRO-1): each dp rank keeps 1/dp of
+    the optimizer HBM and XLA's partitioner turns the elementwise
+    update into shard-local math plus an all-gather of the fresh
+    params. Gradients are already dp-replicated by the psum, so the
+    math is unchanged — pinned by a loss-equality test."""
     optimizer = optax.adamw(learning_rate)
     if not 0.0 <= ema_decay < 1.0:
         # decay == 1.0 would freeze the EMA at init forever; validate
@@ -560,37 +576,97 @@ def make_train_step(
             (gsum, lsum), _ = jax.lax.scan(
                 micro, (zeros, jnp.float32(0.0)), tokens
             )
-            # cast back to each param's dtype: today params are f32
-            # masters so this is a no-op, but a non-f32 master policy
-            # would otherwise promote adamw's moments and change the
-            # opt_state avals between the AOT compile and step 2
-            grads = jax.tree_util.tree_map(
-                lambda g, pp: (g / accum_steps).astype(pp.dtype),
-                gsum, params,
-            )
+            # Under master_weights the f32 accumulator feeds the f32
+            # optimizer DIRECTLY — rounding it through the bf16 live
+            # dtype here would throw away exactly the small summed
+            # components the accumulator exists to keep. Otherwise
+            # cast back to each param's dtype (no-op for f32 params)
+            # so the opt_state avals stay stable.
+            if master_weights:
+                grads = jax.tree_util.tree_map(
+                    lambda g: g / accum_steps, gsum
+                )
+            else:
+                grads = jax.tree_util.tree_map(
+                    lambda g, pp: (g / accum_steps).astype(pp.dtype),
+                    gsum, params,
+                )
             loss = lsum / accum_steps
-        updates, opt_state = optimizer.update(grads, opt_state, params)
-        params = optax.apply_updates(params, updates)
+        if master_weights:
+            inner, masters = opt_state
+            grads = jax.tree_util.tree_map(
+                lambda g: g.astype(jnp.float32), grads
+            )
+            updates, inner = optimizer.update(grads, inner, masters)
+            masters = optax.apply_updates(masters, updates)
+            # re-round the masters into the live (cfg.dtype) tree —
+            # the ONLY f32->bf16 traffic in the step
+            params = jax.tree_util.tree_map(
+                lambda m, p: m.astype(p.dtype), masters, params
+            )
+            opt_state = (inner, masters)
+        else:
+            updates, opt_state = optimizer.update(
+                grads, opt_state, params
+            )
+            params = optax.apply_updates(params, updates)
         return params, opt_state, loss
+
+    def stored(p):
+        """Live-tree dtype policy: cfg.dtype under master_weights."""
+        return p.astype(cfg.dtype) if master_weights else p
+
+    def opt_init(params):
+        """Full optimizer state for the stored params: plain optax
+        state, or (inner_state, f32 masters) under master_weights."""
+        if not master_weights:
+            return optimizer.init(params)
+        masters = jax.tree_util.tree_map(
+            lambda p: p.astype(jnp.float32), params
+        )
+        return (optimizer.init(masters), masters)
 
     # Optimizer-state shardings must be pinned explicitly: with
     # out_shardings=None XLA may re-shard a replicated param's moment (or
     # the param itself) between steps, and the next call's in_shardings
-    # then mismatch. The adamw state embeds param-shaped subtrees (mu/nu),
-    # so map each opt leaf whose key-path *ends with* a param path to that
-    # param's sharding, everything else (step counts) replicated.
-    params_struct = jax.eval_shape(lambda k: init_params(cfg, k),
-                                   jax.random.key(0))
-    opt_struct = jax.eval_shape(optimizer.init, params_struct)
+    # then mismatch. The state embeds param-shaped subtrees (adamw's
+    # mu/nu, the EMA, the f32 masters), so map each opt leaf whose
+    # key-path *ends with* a param path to that param's sharding —
+    # further sharded over "dp" when zero1 is on — everything else
+    # (step counts) replicated.
+    params_struct = jax.eval_shape(
+        lambda k: jax.tree_util.tree_map(
+            stored, init_params(cfg, k)
+        ),
+        jax.random.key(0),
+    )
+    opt_struct = jax.eval_shape(opt_init, params_struct)
     param_paths = {
         tuple(str(k) for k in path): shard
         for path, shard in jax.tree_util.tree_flatten_with_path(p_shard)[0]
     }
+    dp_size = mesh.shape.get("dp", 1)
 
-    def opt_leaf_sharding(path, leaf):  # noqa: ARG001
+    def zero1_shard(shard, shape):
+        """Add "dp" to the first unsharded axis the dp size divides;
+        a leaf with no such axis stays at the param's sharding (its
+        HBM is then replicated — logged nowhere because the big
+        2D/3D weights always have one)."""
+        parts = list(shard.spec) + [None] * (
+            len(shape) - len(shard.spec)
+        )
+        for i, (ax, dim) in enumerate(zip(parts, shape)):
+            if ax is None and dim % dp_size == 0 and dim >= dp_size:
+                parts[i] = "dp"
+                return NamedSharding(mesh, P(*parts))
+        return shard
+
+    def opt_leaf_sharding(path, leaf):
         keys = tuple(str(k) for k in path)
         for ppath, shard in param_paths.items():
             if len(keys) >= len(ppath) and keys[-len(ppath):] == ppath:
+                if zero1 and dp_size > 1:
+                    return zero1_shard(shard, leaf.shape)
                 return shard
         return repl
 
@@ -598,9 +674,12 @@ def make_train_step(
 
     def init_all(key):
         params = jax.jit(
-            lambda k: init_params(cfg, k), out_shardings=p_shard
+            lambda k: jax.tree_util.tree_map(
+                stored, init_params(cfg, k)
+            ),
+            out_shardings=p_shard,
         )(key)
-        opt_state = jax.jit(optimizer.init, out_shardings=o_shard)(params)
+        opt_state = jax.jit(opt_init, out_shardings=o_shard)(params)
         return params, opt_state
 
     train_step = jax.jit(
