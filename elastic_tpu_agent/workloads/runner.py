@@ -21,6 +21,8 @@ import signal
 import sys
 import time
 
+import numpy as np
+
 ENV_FILE = "/run/elastic-tpu/env"
 
 PRESETS = {
@@ -77,7 +79,8 @@ def maybe_join_slice() -> None:
     import jax
 
     worker_id = int(os.environ.get("TPU_WORKER_ID", "0"))
-    coordinator = hostnames.split(",")[0] + ":8476"
+    port = os.environ.get("ELASTIC_TPU_COORD_PORT", "8476")
+    coordinator = f"{hostnames.split(',')[0]}:{port}"
     jax.distributed.initialize(
         coordinator_address=coordinator,
         num_processes=len(hostnames.split(",")),
@@ -334,6 +337,20 @@ def main(argv=None) -> int:
         else P("dp", None),
     )
 
+    def replicate_global(arr, sharding):
+        """Assemble a process-replicated value (every process computed
+        the SAME array, e.g. from a shared seed) into a global
+        jax.Array: each process contributes the slices its devices
+        own. (A raw numpy/single-device array into a cross-process
+        jit is rejected by JAX.)"""
+        arr_np = np.asarray(arr)
+        return jax.make_array_from_callback(
+            arr_np.shape, sharding, lambda idx: arr_np[idx]
+        )
+
+    if dataset is None and jax.process_count() > 1:
+        tokens = replicate_global(tokens, token_sharding)
+
     # Held-out eval: the file's LAST --eval-frac sequence windows never
     # enter training, so the eval number measures generalization.
     # dp/sp/tp mode only (the pipeline mesh has no tp/sp axes for the
@@ -355,11 +372,15 @@ def main(argv=None) -> int:
         def eval_batch(j):
             if dataset is None:
                 # synthetic: a fixed batch disjoint from the training
-                # key stream
-                return jax.random.randint(
+                # key stream (assembled globally under multi-host, as
+                # for the training tokens)
+                b = jax.random.randint(
                     jax.random.key(10_000 + j),
                     (args.batch, args.seq + 1), 0, cfg.vocab,
                 )
+                if jax.process_count() == 1:
+                    return b
+                return replicate_global(b, eval_sharding)
             b = dataset.batch(
                 j, args.batch, args.seq,
                 dp_rank=jax.process_index(),
