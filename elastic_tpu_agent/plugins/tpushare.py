@@ -516,13 +516,18 @@ class _TPUSharePluginBase(_ListAndWatchMixin, rpc.DevicePluginServicer):
         expected = self._chips_for_request(len(device.ids))
         if len(chip_indexes) != expected:
             # Allocate guessed minimum packing (ceil(units/chip)); a
-            # scheduler that spreads wider than that still binds correctly
-            # through the hook path (alloc spec carries the real chips), but
-            # the Allocate-time DeviceSpec fast path only covered
-            # ``expected`` chips — surface it.
+            # scheduler that spreads wider binds all annotated chips into
+            # the alloc spec, but kubelet installed device-cgroup allow
+            # rules only for Allocate's ``expected`` DeviceSpecs. The NRI
+            # adjustment re-derives LinuxDevice (cgroup) entries from the
+            # spec, so spread works there; the hooks.d path mknods the
+            # extra nodes WITHOUT cgroup rules — a non-privileged
+            # container gets EPERM on them (docs/operations.md).
             logger.warning(
                 "%s %s: scheduler spread %d chips, Allocate assumed %d; "
-                "container device visibility relies on the OCI hook",
+                "extra chips are usable via the NRI path only — on "
+                "hooks.d a non-privileged container will get EPERM on "
+                "them (see docs/operations.md)",
                 self.resource, device.hash, len(chip_indexes), expected,
             )
         self._require_known_chips(chip_indexes)

@@ -346,3 +346,73 @@ def test_dual_resource_concurrent_prestarts_still_merge(tmp_path):
         }
     finally:
         c.stop()
+
+
+def test_scheduler_spread_carries_all_chips_on_nri_path(tmp_path):
+    """Scheduler spread (annotation names MORE chips than Allocate's
+    minimum packing): the bind must materialize EVERY annotated chip
+    into the alloc spec, and the NRI adjustment must carry a
+    LinuxDevice (device-cgroup allow) for each — Allocate's
+    DeviceSpec fast path only covered its ceil(units/chip) guess.
+
+    The hooks.d path cannot fix up the cgroup after Allocate (mknod
+    adds nodes but no allow rules for non-privileged containers);
+    that limitation is documented in docs/operations.md — NRI is the
+    supported path for spread placements."""
+    from elastic_tpu_agent.nri import adjustment_from_spec
+    from elastic_tpu_agent.common import ResourceTPUCore
+    from elastic_tpu_agent.plugins.tpushare import (
+        CORE_ENDPOINT,
+        core_device_id,
+    )
+
+    c = Cluster(tmp_path)
+    c.start()
+    try:
+        # 40 core-units => Allocate assumes ceil(40/100) = 1 chip, but
+        # the scheduler spread the request over chips 0,2,3
+        c.apiserver.upsert_pod(
+            make_pod(
+                "ml", "spread", c.node,
+                annotations={
+                    AnnotationAssumed: "true",
+                    container_annotation("jax"): "0,2,3",
+                },
+                containers=[{"name": "jax"}],
+            )
+        )
+        assert wait_until(
+            lambda: c.manager.sitter.get_pod("ml", "spread") is not None
+        )
+        ids = [core_device_id(0, u) for u in range(40)]
+        c.kubelet.kubelet_allocate_flow(
+            CORE_ENDPOINT, "ml", "spread", "jax", ResourceTPUCore, ids
+        )
+        dev_hash = Device(ids, ResourceTPUCore).hash
+        spec_path = os.path.join(
+            str(c.tmp / "alloc"), f"{dev_hash}.json"
+        )
+        assert os.path.exists(spec_path)
+        spec = json.load(open(spec_path))
+        # the bind honored the SCHEDULER's placement, not the guess
+        assert spec["chip_indexes"] == [0, 2, 3]
+        assert len(spec["device_paths"]) == 3
+
+        # NRI: every spread chip becomes a LinuxDevice entry (cgroup
+        # allow), densely renumbered for the container
+        spec["device_paths"] = ["/dev/null"] * 3  # stand-in chardevs
+        adjust = adjustment_from_spec(spec)
+        devs = [(d.path, d.type) for d in adjust.linux.devices]
+        assert devs == [
+            ("/dev/accel0", "c"),
+            ("/dev/accel1", "c"),
+            ("/dev/accel2", "c"),
+        ]
+        st = os.stat("/dev/null")
+        assert all(
+            d.major == os.major(st.st_rdev)
+            and d.minor == os.minor(st.st_rdev)
+            for d in adjust.linux.devices
+        )
+    finally:
+        c.stop()
