@@ -102,6 +102,12 @@ class ServingEngine:
     >>> toks = eng.step()                    # {rid: token} per live req
     >>> eng.release(rid)                     # tokens; slot reusable
 
+    admit() prefills synchronously (every live decode waits for the
+    whole prompt); enqueue() instead spreads the prefill one
+    block-sized chunk per step() — the chunked-prefill interleave —
+    so decodes advance every step and the request activates when its
+    last chunk lands.
+
     Requests are identified by a monotonically increasing request id —
     never by slot, since slots are recycled. A request that fills its
     row to max_len — or emits one of its stop tokens — is
@@ -212,6 +218,13 @@ class ServingEngine:
         self._row_topk = np.zeros((slots,), np.int32)
         self._row_topp = np.zeros((slots,), np.float32)
         self._stop: Dict[int, frozenset] = {}  # rid -> stop-token set
+        # chunked admissions mid-prefill (enqueue()): FIFO of rids;
+        # per-rid host state in _pending_state. _settling holds slots
+        # whose request activated THIS step (they sit the decode out)
+        self._pending: List[int] = []
+        self._pending_state: Dict[int, Dict] = {}
+        self._chunk_prefill_fns: Dict[int, object] = {}
+        self._settling: set = set()
         # why each finished rid stopped: "released" | "max_len" |
         # "stop_token" | "pool_exhausted"; cleared when release()
         # collects the stream
@@ -439,6 +452,87 @@ class ServingEngine:
 
         return prefill
 
+    def _build_chunk_prefill(self, n_b: int):
+        """One block-sized prefill CHUNK for a single pending row:
+        gather the row's first ``n_b`` blocks, run the chunk at
+        positions [start, start+block), scatter the one written block
+        back. enqueue()+step() drives this once per step so live
+        decodes never stall behind a long prompt (the chunked-prefill
+        interleave lever). Returns the chunk's logits so the FINAL
+        chunk can sample the first token host-side."""
+        cfg = self.cfg
+        bs = self.block_size
+
+        @functools.partial(jax.jit, donate_argnums=(1, 2))
+        def chunk_prefill(params, pk, pv, row_blocks, toks, start, wphys):
+            L, _, _, g, h = pk.shape
+            kg = pk[:, row_blocks].reshape(L, 1, n_b * bs, g, h)
+            vg = pv[:, row_blocks].reshape(L, 1, n_b * bs, g, h)
+            cache = KVCache(k=kg, v=vg, length=start)
+            logits, cache = _forward_chunk(
+                params, toks[None], cache, cfg
+            )
+            wk = jax.lax.dynamic_slice(
+                cache.k, (0, 0, start, 0, 0), (L, 1, bs, g, h)
+            )[:, 0]
+            wv = jax.lax.dynamic_slice(
+                cache.v, (0, 0, start, 0, 0), (L, 1, bs, g, h)
+            )[:, 0]
+            pk = pk.at[:, wphys].set(wk)
+            pv = pv.at[:, wphys].set(wv)
+            return pk, pv, logits[0]
+
+        return chunk_prefill
+
+    def _pump_prefill(self) -> Dict[int, int]:
+        """Advance the OLDEST pending admission by one chunk; on its
+        final chunk, sample the first token and activate the row.
+        Returns {rid: first_token} when a row activates, else {}."""
+        rid = self._pending[0]
+        st = self._pending_state[rid]
+        slot, seq, total = st["slot"], st["seq"], st["total"]
+        bs = self.block_size
+        start = st["next_pos"]
+        chunk = np.zeros((bs,), np.int32)
+        avail = min(bs, total - start)
+        chunk[:avail] = seq[start:start + avail]
+        n_b = self._gather_bucket(self._blocks_for(start + bs))
+        if n_b not in self._chunk_prefill_fns:
+            self._chunk_prefill_fns[n_b] = self._build_chunk_prefill(n_b)
+        row_blocks = self._table[slot, :n_b].astype(np.int32)
+        self._pool_k, self._pool_v, logits = self._chunk_prefill_fns[
+            n_b
+        ](
+            self.params, self._pool_k, self._pool_v,
+            jnp.asarray(row_blocks), jnp.asarray(chunk),
+            jnp.int32(start), jnp.int32(self._table[slot, start // bs]),
+        )
+        st["next_pos"] = start + bs
+        if st["next_pos"] < total:
+            return {}
+        # final chunk: sample the first token from the last REAL
+        # prompt position and activate the row
+        self._pending.pop(0)
+        self._pending_state.pop(rid)
+        self._key, sub = jax.random.split(self._key)
+        tkp = st["tkp"]
+        first = int(_sample_rowwise(
+            logits[(total - 1) - start][None], sub,
+            jnp.asarray([tkp[0]], jnp.float32),
+            jnp.asarray([tkp[1]], jnp.int32),
+            jnp.asarray([tkp[2]], jnp.float32),
+        )[0])
+        if self.draft_params is not None:
+            self._draft_prefill_row(slot, seq, total)
+        self._lengths = self._lengths.at[slot].set(total)
+        self._host_len[slot] = total
+        self._last = self._last.at[slot].set(first)
+        self._slot_of[rid] = slot
+        self._streams[rid] = [first]
+        if first in self._stop[rid]:
+            self._finish(rid, "stop_token")
+        return {rid: first}
+
     # -- speculative-mode programs -----------------------------------
 
     def _build_draft_prefill(self, width: int):
@@ -463,6 +557,28 @@ class ServingEngine:
             return dk, dv
 
         return prefill
+
+    def _draft_prefill_row(self, slot, seq, total, width=None):
+        """Prefill the draft's dense row for positions [0, total) of
+        ``seq`` (full recompute — the draft is cheap by design). The
+        default width rounds through the power-of-two block buckets so
+        activations compile a handful of programs, not one per prompt
+        length."""
+        if width is None:
+            width = (
+                self._gather_bucket(self._blocks_for(total))
+                * self.block_size
+            )
+        run = np.zeros((width,), np.int32)
+        run[:total] = seq[:total]
+        if width not in self._draft_prefill_fns:
+            self._draft_prefill_fns[width] = (
+                self._build_draft_prefill(width)
+            )
+        self._draft_k, self._draft_v = self._draft_prefill_fns[width](
+            self.draft_params, self._draft_k, self._draft_v,
+            jnp.asarray(run), jnp.int32(slot),
+        )
 
     def _build_draft_catchup(self):
         """Feed ``last`` through the draft at each row's position —
@@ -691,6 +807,89 @@ class ServingEngine:
         for bid in block_ids:
             self._alloc.drop(bid)
 
+    def _claim_admission(
+        self, prompt, prefix, temperature, top_k, top_p,
+        need_bucket: bool,
+    ):
+        """Shared admission control for admit() and enqueue():
+        validate, claim a slot, resolve per-request sampling, and map
+        blocks (shared full prefix blocks + private allocations),
+        rolling everything back on failure. Returns the claim as a
+        dict; ``need_bucket`` additionally resolves the synchronous
+        path's prompt bucket."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        p = len(prompt)
+        if p == 0:
+            raise ValueError("empty prompt")
+        bucket = None
+        if need_bucket:
+            bucket = next(
+                (b for b in self.buckets if b >= p), None
+            )
+            if bucket is None:
+                raise ValueError(
+                    f"prompt length {p} exceeds largest bucket "
+                    f"{self.buckets[-1]}"
+                )
+        if prefix is not None:
+            if prefix not in self._prefixes:
+                raise ValueError(
+                    f"unknown or released prefix {prefix}"
+                )
+            pref_blocks, plen, pref_tokens = self._prefixes[prefix]
+            pref_padded = self._blocks_for(plen) * self.block_size
+        else:
+            pref_blocks, plen, pref_padded = [], 0, 0
+            pref_tokens = np.zeros((0,), np.int32)
+        total = plen + p
+        if total >= self.max_len:
+            raise ValueError(
+                f"prefix+prompt length {total} leaves no room to "
+                f"decode (max_len {self.max_len})"
+            )
+        if need_bucket and pref_padded + bucket > self.max_len:
+            raise ValueError(
+                "prefix bucket + prompt bucket exceed the slot row"
+            )
+        if not self._free:
+            raise ValueError("no free slot; release() one first")
+        slot = self._free.pop(0)
+
+        d_temp, d_topk, d_topp = self._sampling
+        temp = d_temp if temperature is None else float(temperature)
+        tk = d_topk if top_k is None else int(top_k)
+        tp = d_topp if top_p is None else float(top_p)
+        if self.draft_params is not None and (tk or tp):
+            self._free.insert(0, slot)
+            raise ValueError(
+                "speculative serving supports greedy/temperature "
+                "sampling only (no top-k/top-p)"
+            )
+        self._row_temp[slot] = temp
+        self._row_topk[slot] = tk
+        self._row_topp[slot] = tp
+
+        # block mapping: share full prefix blocks, allocate the rest
+        # (incl. the next decode write's block)
+        bs = self.block_size
+        n_shared = plen // bs          # only FULL blocks are shared
+        try:
+            for j in range(n_shared):
+                self._table[slot, j] = self._alloc.share(pref_blocks[j])
+            self._ensure_blocks(slot, total + 1)
+        except RuntimeError as e:
+            self._drop_row(slot)
+            self._free.append(slot)
+            self._free.sort()
+            raise ValueError(str(e)) from e
+        return dict(
+            prompt=prompt, p=p, bucket=bucket,
+            pref_blocks=pref_blocks, plen=plen,
+            pref_tokens=pref_tokens, pref_padded=pref_padded,
+            total=total, slot=slot, n_shared=n_shared,
+            temp=temp, tk=tk, tp=tp,
+        )
+
     def admit(
         self,
         prompt,
@@ -713,69 +912,20 @@ class ServingEngine:
         the request in step() — the stop token IS appended to the
         stream (callers that want it hidden strip the tail), and the
         slot frees without the caller polling."""
-        prompt = np.asarray(prompt, np.int32).reshape(-1)
-        p = len(prompt)
-        if p == 0:
-            raise ValueError("empty prompt")
-        bucket = next(
-            (b for b in self.buckets if b >= p), None
+        claim = self._claim_admission(
+            prompt, prefix, temperature, top_k, top_p,
+            need_bucket=True,
         )
-        if bucket is None:
-            raise ValueError(
-                f"prompt length {p} exceeds largest bucket "
-                f"{self.buckets[-1]}"
-            )
-        if prefix is not None:
-            if prefix not in self._prefixes:
-                raise ValueError(
-                    f"unknown or released prefix {prefix}"
-                )
-            pref_blocks, plen, pref_tokens = self._prefixes[prefix]
-            pref_padded = self._blocks_for(plen) * self.block_size
-        else:
-            pref_blocks, plen, pref_padded = [], 0, 0
-            pref_tokens = np.zeros((0,), np.int32)
-        total = plen + p
-        if total >= self.max_len:
-            raise ValueError(
-                f"prefix+prompt length {total} leaves no room to "
-                f"decode (max_len {self.max_len})"
-            )
-        if pref_padded + bucket > self.max_len:
-            raise ValueError(
-                "prefix bucket + prompt bucket exceed the slot row"
-            )
-        if not self._free:
-            raise ValueError("no free slot; release() one first")
-        slot = self._free.pop(0)
-
-        d_temp, d_topk, d_topp = self._sampling
-        temp = d_temp if temperature is None else float(temperature)
-        tk = d_topk if top_k is None else int(top_k)
-        tp = d_topp if top_p is None else float(top_p)
-        if self.draft_params is not None and (tk or tp):
-            self._free.insert(0, slot)
-            raise ValueError(
-                "speculative serving supports greedy/temperature "
-                "sampling only (no top-k/top-p)"
-            )
-        self._row_temp[slot] = temp
-        self._row_topk[slot] = tk
-        self._row_topp[slot] = tp
-
-        # -- block mapping: share full prefix blocks, allocate the
-        # rest (incl. the next decode write's block) ------------------
+        prompt, p, bucket = claim["prompt"], claim["p"], claim["bucket"]
+        pref_blocks, plen = claim["pref_blocks"], claim["plen"]
+        pref_tokens, pref_padded = (
+            claim["pref_tokens"], claim["pref_padded"]
+        )
+        total, slot, n_shared = (
+            claim["total"], claim["slot"], claim["n_shared"]
+        )
+        temp, tk, tp = claim["temp"], claim["tk"], claim["tp"]
         bs = self.block_size
-        n_shared = plen // bs          # only FULL blocks are shared
-        try:
-            for j in range(n_shared):
-                self._table[slot, j] = self._alloc.share(pref_blocks[j])
-            self._ensure_blocks(slot, total + 1)
-        except RuntimeError as e:
-            self._drop_row(slot)
-            self._free.append(slot)
-            self._free.sort()
-            raise ValueError(str(e)) from e
         nb_req = self._blocks_for(total + 1)
 
         padded = jnp.zeros((bucket,), jnp.int32)
@@ -822,19 +972,11 @@ class ServingEngine:
             # prefill the draft's dense row on the FULL sequence (the
             # prefix's tokens were kept at registration); width is the
             # same static (pref_padded + bucket) family as the target
-            width = pref_padded + bucket
-            run = np.zeros((width,), np.int32)
-            run[:plen] = pref_tokens
-            run[plen:total] = prompt
-            if width not in self._draft_prefill_fns:
-                self._draft_prefill_fns[width] = (
-                    self._build_draft_prefill(width)
-                )
-            self._draft_k, self._draft_v = self._draft_prefill_fns[
-                width
-            ](
-                self.draft_params, self._draft_k, self._draft_v,
-                jnp.asarray(run), jnp.int32(slot),
+            seq = np.concatenate(
+                [pref_tokens, prompt]
+            ).astype(np.int32)
+            self._draft_prefill_row(
+                slot, seq, total, width=pref_padded + bucket
             )
         self._lengths = self._lengths.at[slot].set(total)
         self._host_len[slot] = total
@@ -849,6 +991,47 @@ class ServingEngine:
             self._finish(rid, "stop_token")
         return rid
 
+    def enqueue(
+        self,
+        prompt,
+        prefix: Optional[int] = None,
+        temperature: Optional[float] = None,
+        top_k: Optional[int] = None,
+        top_p: Optional[float] = None,
+        stop_tokens: Sequence[int] = (),
+    ) -> int:
+        """CHUNKED admission: claim a slot and blocks now, but run the
+        prefill one block-sized chunk per step() — live decodes
+        advance every step instead of stalling behind the whole
+        prompt (admit() runs the prefill synchronously). The request
+        activates — its first token appears in a step() result — once
+        its last chunk lands. A pending rid can be cancelled with
+        release() (returns []).
+
+        Chunks re-run the sequence from the first NON-SHARED block
+        boundary: full prefix blocks stay shared untouched, and an
+        unaligned prefix tail is simply recomputed into the private
+        tail block (the tokens were kept at registration), which is
+        why no tail copy exists on this path."""
+        claim = self._claim_admission(
+            prompt, prefix, temperature, top_k, top_p,
+            need_bucket=False,
+        )
+        rid = self._next_rid
+        self._next_rid += 1
+        self._stop[rid] = frozenset(int(t) for t in stop_tokens)
+        self._pending.append(rid)
+        self._pending_state[rid] = dict(
+            slot=claim["slot"],
+            seq=np.concatenate(
+                [claim["pref_tokens"], claim["prompt"]]
+            ).astype(np.int32),
+            total=claim["total"],
+            next_pos=claim["n_shared"] * self.block_size,
+            tkp=(claim["temp"], float(claim["tk"]), claim["tp"]),
+        )
+        return rid
+
     def step(self) -> Dict[int, object]:
         """Advance every live request; auto-finishes rows that fill
         to max_len, emit a stop token, or starve for pool blocks
@@ -860,9 +1043,24 @@ class ServingEngine:
         return {rid: [tokens...]} — each row commits its accepted
         draft prefix + correction, so lists have variable length ≥ 1
         per step."""
-        if self.draft_params is not None:
-            return self._step_speculative()
-        return self._step_plain()
+        # one pending-prefill chunk per step (enqueue()): live decodes
+        # never stall behind a long admission. A row activating here
+        # SITS OUT this step's decode (it "settles"): its entry in the
+        # returned dict is its activation token, never silently
+        # overwritten by a same-step decode token.
+        activated = self._pump_prefill() if self._pending else {}
+        self._settling = {
+            self._slot_of[r] for r in activated if r in self._slot_of
+        }
+        try:
+            if self.draft_params is not None:
+                out = self._step_speculative()
+                return {
+                    **{r: [t] for r, t in activated.items()}, **out
+                }
+            return {**activated, **self._step_plain()}
+        finally:
+            self._settling = set()
 
     def _step_plain(self) -> Dict[int, int]:
         if not self._slot_of:
@@ -870,7 +1068,10 @@ class ServingEngine:
         # back each write position with a pool block; a slot that
         # can't get one is finished (freeing ITS blocks may unblock
         # later slots in the same sweep)
-        rid_of_slot = {s: r for r, s in self._slot_of.items()}
+        rid_of_slot = {
+            s: r for r, s in self._slot_of.items()
+            if s not in self._settling
+        }
         for s in sorted(rid_of_slot):
             try:
                 self._ensure_blocks(s, int(self._host_len[s]) + 1)
@@ -878,7 +1079,11 @@ class ServingEngine:
                 self._finish(rid_of_slot[s], "pool_exhausted")
         if not self._slot_of:
             return {}
-        live_slots = set(self._slot_of.values())
+        live_slots = (
+            set(self._slot_of.values()) - self._settling
+        )
+        if not live_slots:
+            return {}
         live = sorted(live_slots)
         bs = self.block_size
         wblk = np.full((self.slots,), _JUNK, np.int32)
@@ -912,6 +1117,8 @@ class ServingEngine:
         out = {}
         toks = np.asarray(self._last)
         for rid, slot in list(self._slot_of.items()):
+            if slot in self._settling:
+                continue
             tok = int(toks[slot])
             self._streams[rid].append(tok)
             out[rid] = tok
@@ -933,6 +1140,7 @@ class ServingEngine:
         if any(
             int(self._host_len[s]) + g >= self.max_len
             for s in self._slot_of.values()
+            if s not in self._settling
         ):
             self._draft_k, self._draft_v = self._draft_catchup_fn(
                 self.draft_params, self._draft_k, self._draft_v,
@@ -943,7 +1151,10 @@ class ServingEngine:
             }
         # back the whole verify chunk (positions len..len+gamma) with
         # pool blocks, per live slot
-        rid_of_slot = {s: r for r, s in self._slot_of.items()}
+        rid_of_slot = {
+            s: r for r, s in self._slot_of.items()
+            if s not in self._settling
+        }
         for s in sorted(rid_of_slot):
             try:
                 self._ensure_blocks(s, int(self._host_len[s]) + g + 1)
@@ -951,7 +1162,11 @@ class ServingEngine:
                 self._finish(rid_of_slot[s], "pool_exhausted")
         if not self._slot_of:
             return {}
-        live_slots = set(self._slot_of.values())
+        live_slots = (
+            set(self._slot_of.values()) - self._settling
+        )
+        if not live_slots:
+            return {}
         live = sorted(live_slots)
         bs = self.block_size
         wblk = np.full((self.slots, g + 1), _JUNK, np.int32)
@@ -984,6 +1199,8 @@ class ServingEngine:
         n_emit = np.asarray(n_emit)
         out: Dict[int, List[int]] = {}
         for rid, slot in list(self._slot_of.items()):
+            if slot in self._settling:
+                continue
             toks = committed[slot][: int(n_emit[slot])].tolist()
             self._host_len[slot] += int(n_emit[slot])
             # stop-token truncation: the stream ends AT the first
@@ -1013,12 +1230,25 @@ class ServingEngine:
 
     def stream(self, rid: int) -> List[int]:
         """Tokens generated so far (admission's first token onward);
-        valid for live and finished-uncollected requests."""
+        valid for live and finished-uncollected requests. A pending
+        (still-prefilling) enqueue() rid has no tokens yet: []."""
+        if rid in self._pending_state:
+            return []
         return list(self._streams[rid])
 
     def release(self, rid: int) -> List[int]:
         """Finish a live request (freeing its slot and blocks) or
-        collect an auto-finished one; returns its generated tokens."""
+        collect an auto-finished one; returns its generated tokens.
+        Releasing a PENDING enqueue() rid cancels its prefill
+        mid-flight (blocks freed, slot reusable) and returns []."""
+        if rid in self._pending_state:
+            st = self._pending_state.pop(rid)
+            self._pending.remove(rid)
+            self._drop_row(st["slot"])
+            self._free.append(st["slot"])
+            self._free.sort()
+            self._stop.pop(rid, None)
+            return []
         if rid in self._slot_of:
             self._finish(rid)
         self._finished.discard(rid)

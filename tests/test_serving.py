@@ -564,3 +564,121 @@ def test_register_prefix_pool_exhaustion_fails_clean():
     rid = eng.admit([5, 17])
     eng.step()
     assert eng.release(rid) == _oracle(params, cfg, [5, 17], 2)
+
+
+def test_enqueue_chunked_prefill_exact_and_nonblocking():
+    """enqueue() splits a long prompt's prefill into per-step chunks:
+    the live decode row must emit a token EVERY step while the
+    admission is pending, and both streams stay oracle-exact."""
+    cfg = ModelConfig(**BASE, pos="rope")
+    params = init_params(cfg, jax.random.key(0))
+    eng = ServingEngine(
+        params, cfg, slots=2, max_len=64, prompt_buckets=(8,),
+        block_size=4,
+    )
+    live_p = [5, 17, 42]
+    r_live = eng.admit(live_p)
+    long_p = list(range(2, 2 + 22))          # 22 tokens = 6 chunks
+    r_new = eng.enqueue(long_p)
+    assert eng.stream(r_new) == []
+    pend_steps = 0
+    while r_new not in eng._slot_of and pend_steps < 12:
+        out = eng.step()
+        # the live row NEVER stalls during the chunked prefill
+        assert r_live in out, out
+        pend_steps += 1
+    assert pend_steps == 6, pend_steps       # ceil(22/4) chunks
+    for _ in range(3):
+        eng.step()
+    got_live = eng.release(r_live)
+    got_new = eng.release(r_new)
+    assert got_live == _oracle(params, cfg, live_p, len(got_live))
+    assert got_new == _oracle(params, cfg, long_p, len(got_new))
+
+
+def test_enqueue_matches_admit_stream():
+    """A chunk-prefilled request produces EXACTLY the stream a
+    synchronous admit() would."""
+    cfg = ModelConfig(**BASE, pos="rope", n_kv_heads=2)
+    params = init_params(cfg, jax.random.key(0))
+    prompt = [7, 7, 30, 2, 51, 11, 29, 4, 9]
+    eng = ServingEngine(
+        params, cfg, slots=1, max_len=64, prompt_buckets=(16,),
+        block_size=4,
+    )
+    rid = eng.enqueue(prompt)
+    for _ in range(12):
+        eng.step()
+    got = eng.release(rid)
+    assert got == _oracle(params, cfg, prompt, len(got))
+
+
+def test_enqueue_with_unaligned_prefix_exact():
+    """Chunked admission under a block-UNALIGNED shared prefix: full
+    blocks shared, the tail recomputed into the private block —
+    stream oracle-exact, sharing copy-free for the full blocks."""
+    cfg = ModelConfig(**BASE, pos="rope")
+    params = init_params(cfg, jax.random.key(0))
+    eng = ServingEngine(
+        params, cfg, slots=2, max_len=64, prompt_buckets=(8,),
+        block_size=4,
+    )
+    system = [7, 7, 30, 2, 51, 11]           # 6 tokens: 1 full + tail
+    pid = eng.register_prefix(system)
+    base = eng.used_blocks
+    ra = eng.enqueue([5, 17, 42], prefix=pid)
+    rb = eng.enqueue([61], prefix=pid)
+    for _ in range(10):
+        eng.step()
+    got_a = eng.release(ra)
+    got_b = eng.release(rb)
+    assert got_a == _oracle(params, cfg, system + [5, 17, 42], len(got_a))
+    assert got_b == _oracle(params, cfg, system + [61], len(got_b))
+    assert eng.used_blocks == base           # sharers returned blocks
+
+
+def test_enqueue_cancel_pending_frees_blocks():
+    cfg = ModelConfig(**BASE, pos="rope")
+    params = init_params(cfg, jax.random.key(0))
+    eng = ServingEngine(
+        params, cfg, slots=1, max_len=64, prompt_buckets=(8,),
+        block_size=4,
+    )
+    rid = eng.enqueue(list(range(2, 20)))
+    eng.step()                               # one chunk lands
+    assert rid not in eng._slot_of
+    assert eng.release(rid) == []            # cancel mid-prefill
+    assert eng.used_blocks == 0
+    assert eng._free == [0]
+    # the engine still serves
+    r2 = eng.admit([5, 17])
+    eng.step()
+    assert eng.release(r2) == _oracle(params, cfg, [5, 17], 2)
+
+
+def test_enqueue_speculative_engine_exact():
+    """Chunked admission composes with speculative decoding: the
+    draft prefills at activation and greedy streams stay exact."""
+    from elastic_tpu_agent.workloads.transformer import ModelConfig as MC
+
+    cfg = ModelConfig(**BASE, pos="rope")
+    dcfg = MC(
+        vocab=97, d_model=16, n_heads=2, n_layers=1, d_ff=32,
+        max_seq=96, dtype=jnp.float32, attn="reference", pos="rope",
+    )
+    params = init_params(cfg, jax.random.key(0))
+    dparams = init_params(dcfg, jax.random.key(7))
+    eng = ServingEngine(
+        params, cfg, slots=2, max_len=64, prompt_buckets=(8,),
+        block_size=4, draft_params=dparams, draft_cfg=dcfg, gamma=3,
+    )
+    ra = eng.admit([5, 17, 42])
+    rb = eng.enqueue(list(range(2, 2 + 10)))
+    for _ in range(8):
+        eng.step()
+    got_a = eng.release(ra)
+    got_b = eng.release(rb)
+    assert got_a == _oracle(params, cfg, [5, 17, 42], len(got_a))
+    assert got_b == _oracle(
+        params, cfg, list(range(2, 2 + 10)), len(got_b)
+    )
