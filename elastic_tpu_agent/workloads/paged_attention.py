@@ -1,0 +1,197 @@
+"""Pallas paged-attention decode kernel for the serving engine.
+
+The paged serving step (serving.py) gathers each live slot's blocks
+into a dense transient view, runs the shared forward, and scatters
+the written position back — correct, but the gather MATERIALIZES a
+copy the attention then re-reads: ~2x the HBM traffic of the cache
+itself per decode step. This kernel removes the copy: the block
+table rides in as a SCALAR-PREFETCH argument and the k/v BlockSpec
+index maps dereference it, so each pool block streams HBM->VMEM
+exactly once, straight into the flash-style online-softmax
+accumulation (the standard TPU paged-attention shape; see the
+jax-ml scaling playbook's serving chapter for the design space).
+
+Decode-only (one query token per slot): no backward pass needed, the
+carry is tiny ([r, h] per kv head), and blocks past a slot's length
+contribute nothing through the mask (their reads come from the junk
+block or stale pool entries — finite by the pool's NaN discipline in
+serving.py — and exp(-inf)=0 drops them).
+
+Interpret mode on CPU for hermetic CI, like attention.py's flash
+kernels. No reference counterpart (the reference agent has no model
+code); TPU workload stack.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .attention import NEG_INF
+
+
+def _paged_kernel(
+    table_ref,    # scalar prefetch: [slots, nb] physical block ids
+    lengths_ref,  # scalar prefetch: [slots] VALID positions per slot
+    q_ref,        # [1, 1, r, h] this (slot, kv head)'s queries
+    k_ref,        # [1, bs, 1, h] the current block, this kv head
+    v_ref,        # [1, bs, 1, h]
+    o_ref,        # [1, 1, r, h]
+    m_scr,        # [r, 1] running max
+    l_scr,        # [r, 1] running denominator
+    acc_scr,      # [r, h] running numerator
+    *,
+    scale: float,
+    block_size: int,
+    window: int,
+):
+    s = pl.program_id(0)
+    j = pl.program_id(2)
+    nb = pl.num_programs(2)
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    q = q_ref[0, 0].astype(jnp.float32)          # [r, h]
+    k = k_ref[:, :, 0, :][0].astype(jnp.float32)  # [bs, h]
+    v = v_ref[:, :, 0, :][0].astype(jnp.float32)
+
+    scores = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) * scale                                     # [r, bs]
+    pos = j * block_size + jax.lax.broadcasted_iota(
+        jnp.int32, (1, block_size), 1
+    )
+    valid = pos < lengths_ref[s]                  # [1, bs]
+    if window > 0:
+        # sliding window: the query sits at position n_valid-1 and
+        # attends only the last ``window`` positions (matches
+        # _cached_attention's rows - cols < window)
+        valid &= (lengths_ref[s] - 1 - pos) < window
+    scores = jnp.where(valid, scores, NEG_INF)
+
+    m_prev = m_scr[:]                             # [r, 1]
+    m_new = jnp.maximum(m_prev, jnp.max(scores, axis=1, keepdims=True))
+    alpha = jnp.exp(m_prev - m_new)               # [r, 1]
+    p = jnp.exp(scores - m_new)                   # [r, bs]
+    l_new = l_scr[:] * alpha + jnp.sum(p, axis=1, keepdims=True)
+    acc_new = acc_scr[:] * alpha + jax.lax.dot_general(
+        p, v, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    m_scr[:] = m_new
+    l_scr[:] = l_new
+    acc_scr[:] = acc_new
+
+    @pl.when(j == nb - 1)
+    def _finish():
+        o_ref[0, 0] = (
+            acc_scr[:] / jnp.maximum(l_scr[:], 1e-30)
+        ).astype(o_ref.dtype)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("kv_heads", "interpret", "window")
+)
+def paged_decode_attention(
+    q, pool_k, pool_v, table, lengths, kv_heads: int,
+    interpret: bool = False, window: int = 0,
+):
+    """One decode token per slot against the paged KV pool.
+
+    q [slots, n, h]; pool_k/pool_v [n_blocks, bs, g, h] (ONE layer's
+    pool); table [slots, nb] physical block ids (junk 0 where
+    unmapped); lengths [slots] = number of VALID positions (i.e. the
+    row's cached length INCLUDING the just-written decode token).
+    Returns [slots, n, h].
+
+    Heads are grouped GQA-style: query head i reads kv head i // r,
+    matching generate._cached_attention's contiguous-group reshape.
+    """
+    slots, n, h = q.shape
+    g = kv_heads
+    r = n // g
+    nb = table.shape[1]
+    bs = pool_k.shape[1]
+    scale = 1.0 / np.sqrt(h)
+    q4 = q.reshape(slots, g, r, h)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(slots, g, nb),
+        in_specs=[
+            pl.BlockSpec(
+                (1, 1, r, h),
+                lambda s, kv, j, table, lens: (s, kv, 0, 0),
+            ),
+            pl.BlockSpec(
+                (1, bs, 1, h),
+                lambda s, kv, j, table, lens: (table[s, j], 0, kv, 0),
+            ),
+            pl.BlockSpec(
+                (1, bs, 1, h),
+                lambda s, kv, j, table, lens: (table[s, j], 0, kv, 0),
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, 1, r, h),
+            lambda s, kv, j, table, lens: (s, kv, 0, 0),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((r, 1), jnp.float32),
+            pltpu.VMEM((r, 1), jnp.float32),
+            pltpu.VMEM((r, h), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(
+            _paged_kernel, scale=scale, block_size=bs,
+            window=window,
+        ),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((slots, g, r, h), q.dtype),
+        interpret=interpret,
+    )(table, lengths, q4, pool_k, pool_v)
+    return out.reshape(slots, n, h)
+
+
+def paged_decode_attention_reference(
+    q, pool_k, pool_v, table, lengths, kv_heads: int, window: int = 0
+):
+    """Gather-based oracle: materialize each slot's dense view and
+    run masked softmax attention — the exact computation the kernel
+    must reproduce (and the serving engine's current step path)."""
+    slots, n, h = q.shape
+    g = kv_heads
+    r = n // g
+    nb = table.shape[1]
+    bs = pool_k.shape[1]
+    kg = pool_k[table.reshape(-1)].reshape(slots, nb * bs, g, h)
+    vg = pool_v[table.reshape(-1)].reshape(slots, nb * bs, g, h)
+    q5 = q.reshape(slots, g, r, h).astype(jnp.float32)
+    scale = 1.0 / np.sqrt(h)
+    scores = jnp.einsum(
+        "sgrh,ssgh->sgrS".replace("ss", "sS"),
+        q5, kg.astype(jnp.float32),
+    ) * scale
+    cols = jnp.arange(nb * bs)
+    keep = cols[None, :] < lengths[:, None]       # [slots, S]
+    if window > 0:
+        keep &= (lengths[:, None] - 1 - cols[None, :]) < window
+    scores = jnp.where(
+        keep[:, None, None, :], scores, NEG_INF
+    )
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum(
+        "sgrS,sSgh->sgrh", probs, vg.astype(jnp.float32)
+    )
+    return out.reshape(slots, n, h).astype(q.dtype)

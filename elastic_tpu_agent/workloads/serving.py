@@ -50,8 +50,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .generate import KVCache, _forward_chunk, _sample_rowwise
-from .transformer import ModelConfig
+from .generate import KVCache, _forward_chunk, _qkv, _sample_rowwise
+from .quantize import embed_lookup, wdense
+from .transformer import ModelConfig, _rmsnorm, rope
 
 # physical block 0 is the JUNK block: never allocated, the write target
 # for frozen slots and the gather source for empty table entries — its
@@ -126,7 +127,12 @@ class ServingEngine:
     bucket and max_len) sets paging granularity; ``pool_blocks``
     (default: one slot's worth of headroom beyond slots*max_len for
     registered prefixes) sets total KV HBM. `used_blocks` exposes live
-    pool pressure.
+    pool pressure. ``paged_kernel=True`` switches plain decode steps
+    to the Pallas paged-attention path (paged_attention.py): K/V
+    writes land directly in pool blocks and attention streams each
+    block from HBM once — no gathered transient. Streams are pinned
+    identical to the gather path; prefill/spec steps keep the gather
+    (they are multi-token).
 
     SPECULATIVE MODE: pass ``draft_params``/``draft_cfg`` (and
     optionally ``gamma``) and every step() becomes a speculative
@@ -162,6 +168,7 @@ class ServingEngine:
         draft_params: Optional[Dict] = None,
         draft_cfg: Optional[ModelConfig] = None,
         gamma: int = 4,
+        paged_kernel: bool = False,
     ):
         self.params = params
         self.cfg = cfg
@@ -230,6 +237,11 @@ class ServingEngine:
         # collects the stream
         self.finish_reason: Dict[int, str] = {}
 
+        # paged_kernel=True: plain decode steps run the Pallas
+        # paged-attention path (no gather transient; pool blocks read
+        # once). Interpret mode on CPU so tests stay hermetic.
+        self.paged_kernel = paged_kernel
+        self._interpret = jax.default_backend() == "cpu"
         self._step_fns: Dict[Tuple[int, bool], object] = {}
         self._prefill_fns = {
             b: self._build_prefill(b) for b in self.buckets
@@ -329,6 +341,95 @@ class ServingEngine:
         vg = pv[:, flat].reshape(L, slots, Bb * bs, g, h)
         return kg, vg
 
+    def _decode_forward_paged(
+        self, params, toks, pool_k, pool_v, table_b, lengths,
+        wblk, woff,
+    ):
+        """One decode token per slot DIRECTLY against the pool: each
+        layer writes its new K/V entry straight to the slot's block
+        and attends through the Pallas paged kernel — no dense gather
+        copy, each pool block read once (paged_attention.py). Plain
+        single-token steps only (the spec step's gamma+1-wide verify
+        keeps the gather path).
+
+        This loop deliberately mirrors generate._forward_chunk's
+        layer body (cache write + attention swapped for the pool
+        forms); the cross-path stream-identity pins in
+        tests/test_paged_attention.py are the tripwire for any future
+        drift between the two."""
+        from .paged_attention import paged_decode_attention
+
+        cfg = self.cfg
+        x = embed_lookup(params, toks[:, None], cfg.dtype)  # [s,1,d]
+        posmat = lengths[:, None]                           # [s,1]
+        if cfg.pos == "learned":
+            x = x + params["pos_embed"].astype(cfg.dtype)[posmat]
+        n_valid = lengths + 1  # incl. this step's written position
+        for i, layer in enumerate(params["layers"]):
+            h = _rmsnorm(x, layer["ln1_scale"])
+            q, k_c, v_c = _qkv(h, layer, cfg)
+            if cfg.pos == "rope":
+                q = rope(q, posmat, cfg.rope_theta)
+                k_c = rope(k_c, posmat, cfg.rope_theta)
+            pool_k = pool_k.at[i, wblk, woff].set(
+                k_c[:, 0].astype(pool_k.dtype)
+            )
+            pool_v = pool_v.at[i, wblk, woff].set(
+                v_c[:, 0].astype(pool_v.dtype)
+            )
+            attn = paged_decode_attention(
+                q[:, 0], pool_k[i], pool_v[i], table_b, n_valid,
+                cfg.kv_heads, interpret=self._interpret,
+                window=cfg.window,
+            )
+            x = x + jnp.einsum(
+                "snh,nhd->sd", attn, wdense(layer, "wo", cfg.dtype)
+            )[:, None]
+            h2 = _rmsnorm(x, layer["ln2_scale"])
+            if "moe" in layer:
+                from .moe import moe_mlp
+
+                y, _ = moe_mlp(
+                    h2, layer["moe"], float(cfg.moe_experts),
+                    mesh=None,
+                )
+                x = x + y
+            else:
+                h2 = jax.nn.gelu(jnp.einsum(
+                    "std,df->stf", h2, wdense(layer, "w1", cfg.dtype)
+                ))
+                x = x + jnp.einsum(
+                    "stf,fd->std", h2, wdense(layer, "w2", cfg.dtype)
+                )
+        x = _rmsnorm(x, params["final_norm_scale"])
+        logits = jnp.einsum(
+            "std,dv->stv", x, wdense(params, "lm_head", cfg.dtype)
+        ).astype(jnp.float32)
+        return logits[:, 0], pool_k, pool_v
+
+    def _build_step_kernel(self, greedy: bool):
+        """Plain step via the Pallas paged-attention path (engine
+        constructed with paged_kernel=True): same signature/results
+        as _build_step, no gather transient."""
+
+        @functools.partial(jax.jit, donate_argnums=(1, 2))
+        def step(
+            params, pk, pv, table_b, lengths, toks, active, key,
+            temp, tk, tp, wblk, woff,
+        ):
+            logits, pk, pv = self._decode_forward_paged(
+                params, toks, pk, pv, table_b, lengths, wblk, woff
+            )
+            if greedy:
+                nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            else:
+                nxt = _sample_rowwise(logits, key, temp, tk, tp)
+            nxt = jnp.where(active, nxt, toks)
+            lengths = jnp.where(active, lengths + 1, lengths)
+            return pk, pv, lengths, nxt
+
+        return step
+
     def _build_step(self, greedy: bool):
         """Step program; the gather width is carried by table_b's
         shape (jit traces per shape, so the (bucket, greedy) cache key
@@ -376,7 +477,10 @@ class ServingEngine:
     def _step_fn(self, n_b: int, greedy: bool):
         key = (n_b, greedy)
         if key not in self._step_fns:
-            self._step_fns[key] = self._build_step(greedy)
+            self._step_fns[key] = (
+                self._build_step_kernel(greedy)
+                if self.paged_kernel else self._build_step(greedy)
+            )
         return self._step_fns[key]
 
     def _build_prefill(self, bucket: int):
