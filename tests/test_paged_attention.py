@@ -179,3 +179,31 @@ def test_engine_paged_kernel_moe_exact():
         return eng.release(ra), eng.release(rb)
 
     assert run(True) == run(False)
+
+
+def test_spec_engine_with_paged_kernel_fallback_exact():
+    """A speculative engine with paged_kernel=True: spec steps keep
+    the gather verify, but the near-max_len PLAIN fallback routes
+    through the kernel step — streams must stay target-exact through
+    the transition."""
+    cfg = ModelConfig(**BASE, pos="rope")
+    dcfg = ModelConfig(
+        vocab=97, d_model=16, n_heads=2, n_layers=1, d_ff=32,
+        max_seq=96, dtype=jnp.float32, attn="reference", pos="rope",
+    )
+    params = init_params(cfg, jax.random.key(0))
+    dparams = init_params(dcfg, jax.random.key(7))
+    eng = ServingEngine(
+        params, cfg, slots=1, max_len=16, prompt_buckets=(8,),
+        block_size=4, draft_params=dparams, draft_cfg=dcfg, gamma=4,
+        paged_kernel=True,
+    )
+    prompt = [5, 17, 42, 9, 61, 3, 88, 24]
+    rid = eng.admit(prompt)
+    steps = 0
+    while rid in eng._slot_of and steps < 20:
+        eng.step()
+        steps += 1
+    got = eng.release(rid)
+    assert got == _oracle(params, cfg, prompt, len(got))
+    assert len(got) >= 7   # filled to max_len-1 through the fallback
