@@ -39,6 +39,19 @@ def drop_hook(metrics) -> Optional[Callable[[], None]]:
     return None
 
 
+def register_sink_metrics(sink: "AsyncSink", metrics) -> None:
+    """Export a sink's queue depth / failure streak / disabled flag as
+    labeled gauges (metrics.AgentMetrics.register_sink) so the
+    self-disabling observability paths are themselves observable. One
+    place, same rationale as drop_hook."""
+    if metrics is not None and hasattr(metrics, "register_sink"):
+        try:
+            metrics.register_sink(sink)
+        except Exception:  # noqa: BLE001 - metrics must not break sinks
+            logger.exception("sink metric registration failed for %s",
+                             sink.name)
+
+
 class AsyncSink:
     """Single worker thread draining a bounded, coalescing op queue;
     self-disables after ``max_failures`` consecutive errors."""
@@ -71,6 +84,10 @@ class AsyncSink:
         self._thread.start()
 
     @property
+    def name(self) -> str:
+        return self._name
+
+    @property
     def disabled(self) -> bool:
         return self._disabled
 
@@ -78,6 +95,17 @@ class AsyncSink:
     def dropped(self) -> int:
         """Ops discarded by the queue bound since start."""
         return self._dropped
+
+    @property
+    def queue_depth(self) -> int:
+        """Ops currently queued (racy read — it feeds a gauge)."""
+        return len(self._items)
+
+    @property
+    def consecutive_failures(self) -> int:
+        """Current failure streak (resets on success; the sink disables
+        itself at max_failures)."""
+        return self._failures
 
     def submit(self, op: Callable, key: Optional[object] = None) -> None:
         """Enqueue a thunk; non-blocking, never raises. A non-None ``key``

@@ -72,7 +72,13 @@ def parse_args(argv=None) -> argparse.Namespace:
              "them onto healthy chips",
     )
     p.add_argument("--metrics-port", type=int, default=9478,
-                   help="prometheus metrics port (0 = off)")
+                   help="observability HTTP port serving /metrics, "
+                        "/debug/traces and /healthz (0 = off)")
+    p.add_argument("--metrics-addr", default="127.0.0.1",
+                   help="bind address for the observability endpoint "
+                        "(default loopback; set 0.0.0.0 to allow "
+                        "off-host Prometheus scrapes, as the shipped "
+                        "DaemonSet does)")
     p.add_argument("--no-events", action="store_true",
                    help="disable k8s Event emission (e.g. RBAC without "
                         "events:create)")
@@ -99,10 +105,18 @@ def main(argv=None) -> int:
 
     metrics = None
     if args.metrics_port:
-        from .metrics import AgentMetrics
+        from .metrics import AgentMetrics, MetricsServerError
 
         metrics = AgentMetrics()
-        metrics.serve(args.metrics_port)
+        try:
+            metrics.serve(args.metrics_port, addr=args.metrics_addr)
+        except MetricsServerError as e:
+            # A busy port must not take the allocation path down with it:
+            # keep the agent (and its in-process metric objects, which
+            # gauges/events still update) and run without the endpoint.
+            logging.getLogger(__name__).error(
+                "%s — continuing WITHOUT the observability endpoint", e
+            )
 
     manager = TPUManager(
         ManagerOptions(

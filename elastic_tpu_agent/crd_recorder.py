@@ -26,7 +26,7 @@ from __future__ import annotations
 import logging
 from typing import Iterable, List, Optional
 
-from .async_sink import AsyncSink, drop_hook
+from .async_sink import AsyncSink, drop_hook, register_sink_metrics
 from .common import ResourceTPUCore, ResourceTPUMemory, TPUPercentEachChip
 from .crd import (
     ElasticTPU,
@@ -55,6 +55,7 @@ class CRDRecorder:
         self._node = node_name
         self._accelerator_type = accelerator_type
         self._sink = AsyncSink("crd-recorder", on_drop=drop_hook(metrics))
+        register_sink_metrics(self._sink, metrics)
 
     # -- public API (called from plugin bind / GC / manager restore) ----------
 
@@ -108,7 +109,13 @@ class CRDRecorder:
         pod: str,
         container: str,
         chip_indexes: List[int],
+        trace_id: str = "",
     ) -> None:
+        message = f"bound by elastic-tpu-agent on {self._node}"
+        if trace_id:
+            # the CRD record carries the bind's allocation-trace id so a
+            # consumer can jump to the agent's /debug/traces dump
+            message += f" [trace {trace_id}]"
         obj = ElasticTPU(
             name=self.object_name(alloc_hash),
             node_name=self._node,
@@ -119,7 +126,7 @@ class CRDRecorder:
             claim_name=pod,
             claim_container=container,
             phase=PhaseBound,
-            message=f"bound by elastic-tpu-agent on {self._node}",
+            message=message,
         )
         # keyed per object: a queued-but-unwritten Bound for this hash is
         # superseded by a newer write (e.g. its Released) instead of both

@@ -20,7 +20,7 @@ import time
 from datetime import datetime, timezone
 from typing import Dict, Optional, Tuple
 
-from ..async_sink import AsyncSink, drop_hook
+from ..async_sink import AsyncSink, drop_hook, register_sink_metrics
 
 logger = logging.getLogger(__name__)
 
@@ -53,6 +53,7 @@ class EventRecorder:
         self._client = kube_client
         self._node = node_name
         self._sink = AsyncSink("event-recorder", on_drop=drop_hook(metrics))
+        register_sink_metrics(self._sink, metrics)
         # key -> (last_emit_monotonic, suppressed_since_then, emit_ctx)
         # where emit_ctx = (namespace, base, involved, reason, message, type_)
         # is kept so suppressed tails can be surfaced after the window.
@@ -110,6 +111,17 @@ class EventRecorder:
 
     # -- emitters -------------------------------------------------------------
 
+    @staticmethod
+    def _tag_trace(message: str, trace_id: str) -> str:
+        """Suffix the allocation trace id so `kubectl describe pod`
+        hands the operator the key into /debug/traces (tracing.py).
+        Falls back to the caller's current trace when none is given."""
+        if not trace_id:
+            from ..tracing import get_tracer
+
+            trace_id = get_tracer().current_id()
+        return f"{message} [trace {trace_id}]" if trace_id else message
+
     def pod_event(
         self,
         namespace: str,
@@ -118,6 +130,7 @@ class EventRecorder:
         message: str,
         type_: str = "Normal",
         uid: str = "",
+        trace_id: str = "",
     ) -> None:
         involved = {
             "kind": "Pod",
@@ -127,13 +140,23 @@ class EventRecorder:
         }
         if uid:
             involved["uid"] = uid
-        self._emit(namespace, pod, involved, reason, message, type_)
+        self._emit(
+            namespace, pod, involved, reason, message, type_,
+            display=self._tag_trace(message, trace_id),
+        )
 
     def node_event(
-        self, reason: str, message: str, type_: str = "Normal"
+        self,
+        reason: str,
+        message: str,
+        type_: str = "Normal",
+        trace_id: str = "",
     ) -> None:
         involved = {"kind": "Node", "apiVersion": "v1", "name": self._node}
-        self._emit("default", self._node, involved, reason, message, type_)
+        self._emit(
+            "default", self._node, involved, reason, message, type_,
+            display=self._tag_trace(message, trace_id),
+        )
 
     def _should_emit(self, key: Tuple, ctx: Tuple) -> int:
         """0 = suppress (inside the aggregation window); otherwise the
@@ -165,8 +188,12 @@ class EventRecorder:
     def _emit(
         self, namespace: str, base: str, involved: dict,
         reason: str, message: str, type_: str,
+        display: Optional[str] = None,
     ) -> None:
-        ctx = (namespace, base, involved, reason, message, type_)
+        # The aggregation key uses the RAW message: the displayed form
+        # may carry a per-attempt trace id, and keying on that would
+        # defeat the fold (every crash-loop retry would be "new").
+        ctx = (namespace, base, involved, reason, display or message, type_)
         count = self._should_emit(
             (namespace, involved.get("kind"), involved.get("name"),
              reason, message),

@@ -25,6 +25,7 @@ from abc import ABC, abstractmethod
 from typing import Dict, Optional
 
 from ..rpc import PodResourcesClient
+from ..tracing import get_tracer
 from ..types import Device, PodContainer, device_hash
 
 logger = logging.getLogger(__name__)
@@ -79,7 +80,11 @@ class KubeletDeviceLocator(DeviceLocator):
             seq = self._refresh_seq
             self._refreshing += 1
         try:
-            resp = self._client.list()
+            with get_tracer().span(
+                "pod_resources_list", resource=self._resource
+            ) as sp:
+                resp = self._client.list()
+                sp.set(pods=len(resp.pod_resources))
             fresh: Dict[str, PodContainer] = {}
             for pod in resp.pod_resources:
                 for container in pod.containers:
@@ -119,6 +124,14 @@ class KubeletDeviceLocator(DeviceLocator):
                 self._cond.notify_all()
 
     def locate(self, device: Device) -> PodContainer:
+        with get_tracer().span(
+            "locator_locate", resource=self._resource, hash=device.hash
+        ) as sp:
+            owner = self._locate(device, sp)
+            sp.set(pod=owner.pod_key, container=owner.container)
+            return owner
+
+    def _locate(self, device: Device, sp) -> PodContainer:
         key = device.hash
         with self._cond:
             hit = self._cache.get(key)
@@ -141,7 +154,9 @@ class KubeletDeviceLocator(DeviceLocator):
                 )
                 hit = self._cache.get(key)
         if hit is not None:
+            sp.set(cache_hit=True)
             return hit
+        sp.set(cache_hit=False)
         # Miss: refresh inline, consulting OUR OWN snapshot (the shared
         # cache may be concurrently replaced by a prefetch). One retry
         # absorbs transient channel resets from concurrent users.

@@ -214,48 +214,66 @@ class TPUManager:
     def restore(self) -> dict:
         """Reconcile checkpoint state with reality at boot; returns a small
         report (also exported via metrics)."""
+        from .tracing import get_tracer
+
+        with get_tracer().trace("restore") as tr:
+            report = self._restore()
+            tr.set(**report)
+        return report
+
+    def _restore(self) -> dict:
+        from .tracing import get_tracer
+
         report = {"restored_links": 0, "reclaimed_pods": 0, "kept_pods": 0,
                   "corrupt_records": 0, "orphan_links": 0, "orphan_specs": 0}
         report["corrupt_records"] = len(self.storage.corrupt_keys())
-        for _, info in list(self.storage.items()):
-            pod = self.sitter.get_pod(info.namespace, info.name)
-            if pod is None:
-                try:
-                    pod = self.sitter.get_pod_from_api(info.namespace, info.name)
-                except Exception as e:  # noqa: BLE001
-                    logger.warning(
-                        "restore: apiserver check failed for %s (%s); keeping",
-                        info.key, e,
-                    )
-                    report["kept_pods"] += 1
-                    continue
-            if pod is None:
-                # Pod is gone: reclaim now rather than waiting for GC.
-                for record in info.records():
-                    for link_id in record.created_node_ids:
-                        try:
-                            self.operator.delete(link_id)
-                        except Exception:  # noqa: BLE001
-                            logger.warning("restore: delete %s failed", link_id)
-                    if hasattr(self.plugin, "core"):
-                        self.plugin.core.remove_alloc_spec(record.device.hash)
-                self.storage.delete(info.namespace, info.name)
-                report["reclaimed_pods"] += 1
-                continue
-            # Pod lives: ensure its virtual nodes exist (Check -> Create).
-            report["kept_pods"] += 1
-            for record in info.records():
-                for pos, link_id in enumerate(record.created_node_ids):
-                    if not self.operator.check(link_id):
-                        try:
-                            idx = record.chip_indexes[pos]
-                            self.operator.create(idx, link_id)
-                            report["restored_links"] += 1
-                        except Exception:  # noqa: BLE001
-                            logger.exception(
-                                "restore: re-create %s failed", link_id
+        with get_tracer().span("reconcile_checkpoints"):
+            for _, info in list(self.storage.items()):
+                pod = self.sitter.get_pod(info.namespace, info.name)
+                if pod is None:
+                    try:
+                        pod = self.sitter.get_pod_from_api(
+                            info.namespace, info.name
+                        )
+                    except Exception as e:  # noqa: BLE001
+                        logger.warning(
+                            "restore: apiserver check failed for %s (%s); "
+                            "keeping", info.key, e,
+                        )
+                        report["kept_pods"] += 1
+                        continue
+                if pod is None:
+                    # Pod is gone: reclaim now rather than waiting for GC.
+                    for record in info.records():
+                        for link_id in record.created_node_ids:
+                            try:
+                                self.operator.delete(link_id)
+                            except Exception:  # noqa: BLE001
+                                logger.warning(
+                                    "restore: delete %s failed", link_id
+                                )
+                        if hasattr(self.plugin, "core"):
+                            self.plugin.core.remove_alloc_spec(
+                                record.device.hash
                             )
-        self._sweep_orphans(report)
+                    self.storage.delete(info.namespace, info.name)
+                    report["reclaimed_pods"] += 1
+                    continue
+                # Pod lives: ensure its virtual nodes exist (Check -> Create).
+                report["kept_pods"] += 1
+                for record in info.records():
+                    for pos, link_id in enumerate(record.created_node_ids):
+                        if not self.operator.check(link_id):
+                            try:
+                                idx = record.chip_indexes[pos]
+                                self.operator.create(idx, link_id)
+                                report["restored_links"] += 1
+                            except Exception:  # noqa: BLE001
+                                logger.exception(
+                                    "restore: re-create %s failed", link_id
+                                )
+        with get_tracer().span("sweep_orphans"):
+            self._sweep_orphans(report)
         if self.crd_recorder is not None:
             # Sweep stale ElasticTPU objects this node published for
             # allocations that no longer exist after the reconcile above;
@@ -269,7 +287,8 @@ class TPUManager:
                 chips = [c.index for c in self.operator.devices()]
             except Exception:  # noqa: BLE001 - discovery failure
                 chips = []
-            self.crd_recorder.reconcile(live, chip_indexes=chips)
+            with get_tracer().span("crd_reconcile", live=len(live)):
+                self.crd_recorder.reconcile(live, chip_indexes=chips)
         logger.info("restore report: %s", report)
         if self.events is not None and (
             report["restored_links"] or report["reclaimed_pods"]

@@ -1,28 +1,59 @@
-"""Prometheus metrics endpoint.
+"""Prometheus metrics + the agent's unified observability endpoint.
 
 The reference had none (SURVEY.md §5.5 — klog only, RBAC granted events it
 never recorded). BASELINE.md's north-star metric is Allocate() p50 latency
 plus chip utilization, so both are first-class here.
+
+One HTTP server (replacing prometheus_client's bare start_http_server)
+serves three paths:
+
+- ``/metrics``  — Prometheus scrape, names unchanged;
+- ``/debug/traces`` — JSON dump of the allocation-trace ring buffer
+  (tracing.py), newest first; ``?pod=<ns/name|name>`` filters,
+  ``?limit=N`` caps;
+- ``/healthz`` — liveness: 200 + a small JSON status.
+
+The server binds loopback by default (``--metrics-addr`` widens it) and
+a port conflict raises MetricsServerError with an actionable message
+instead of an unhandled traceback at agent startup.
 """
 
 from __future__ import annotations
 
+import json
+import logging
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
+from urllib.parse import parse_qs, urlparse
 
 from prometheus_client import (
+    REGISTRY,
     Counter,
     Gauge,
     Histogram,
-    start_http_server,
+    generate_latest,
 )
+from prometheus_client.exposition import CONTENT_TYPE_LATEST
+
+logger = logging.getLogger(__name__)
 
 _BUCKETS = (
     0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
 )
 
+DEFAULT_BIND_ADDR = "127.0.0.1"
+
+
+class MetricsServerError(RuntimeError):
+    """The observability HTTP endpoint could not start (e.g. the port is
+    already bound). Deliberately NOT an OSError: callers must be able to
+    catch exactly this and keep the agent running without the endpoint."""
+
 
 class AgentMetrics:
     def __init__(self, registry=None) -> None:
+        self._registry = registry if registry is not None else REGISTRY
         kw = {"registry": registry} if registry is not None else {}
         self.allocate_latency = Histogram(
             "elastic_tpu_allocate_seconds",
@@ -70,6 +101,46 @@ class AgentMetrics:
             "Containers adjusted (devices injected) via the NRI plugin",
             **kw,
         )
+        # AsyncSink introspection (async_sink.py): the observability
+        # paths self-disable after consecutive failures — without these
+        # the self-disabling is itself invisible until someone wonders
+        # where the Events went.
+        self.sink_queue_depth = Gauge(
+            "elastic_tpu_sink_queue_depth",
+            "Ops queued in an async observability sink",
+            ["sink"],
+            **kw,
+        )
+        self.sink_consecutive_failures = Gauge(
+            "elastic_tpu_sink_consecutive_failures",
+            "Consecutive write failures of an async observability sink "
+            "(resets to 0 on success; the sink disables at its limit)",
+            ["sink"],
+            **kw,
+        )
+        self.sink_disabled = Gauge(
+            "elastic_tpu_sink_disabled",
+            "1 when an async observability sink has self-disabled after "
+            "repeated failures, else 0",
+            ["sink"],
+            **kw,
+        )
+        self._httpd: Optional[ThreadingHTTPServer] = None
+
+    def register_sink(self, sink) -> None:
+        """Export a live AsyncSink's internals as gauges. Uses
+        set_function so the scrape always reads current state — no
+        update calls sprinkled through the sink's hot path."""
+        name = sink.name
+        self.sink_queue_depth.labels(sink=name).set_function(
+            lambda: sink.queue_depth
+        )
+        self.sink_consecutive_failures.labels(sink=name).set_function(
+            lambda: sink.consecutive_failures
+        )
+        self.sink_disabled.labels(sink=name).set_function(
+            lambda: float(sink.disabled)
+        )
 
     def observe_allocate(self, seconds: float) -> None:
         self.allocate_latency.observe(seconds)
@@ -77,5 +148,134 @@ class AgentMetrics:
     def observe_prestart(self, seconds: float) -> None:
         self.prestart_latency.observe(seconds)
 
-    def serve(self, port: int) -> None:
-        start_http_server(port)
+    # -- the unified HTTP endpoint --------------------------------------------
+
+    def serve(
+        self,
+        port: int,
+        addr: str = DEFAULT_BIND_ADDR,
+        tracer=None,
+    ) -> ThreadingHTTPServer:
+        """Start the observability endpoint on ``addr:port`` (port 0 =
+        ephemeral, for tests; the bound server is returned and kept on
+        self). ``tracer`` defaults to the process-wide tracing ring."""
+        if tracer is None:
+            from .tracing import get_tracer
+
+            tracer = get_tracer()
+        registry = self._registry
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):  # noqa: A003 - silence stderr
+                logger.debug("metrics http: " + fmt, *args)
+
+            def _reply(self, code, content_type, body: bytes) -> None:
+                self.send_response(code)
+                self.send_header("Content-Type", content_type)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _reply_json(self, payload, code=200) -> None:
+                self._reply(
+                    code, "application/json",
+                    json.dumps(payload).encode(),
+                )
+
+            def do_GET(self):  # noqa: N802
+                try:
+                    parsed = urlparse(self.path)
+                    if parsed.path == "/metrics":
+                        self._reply(
+                            200, CONTENT_TYPE_LATEST,
+                            generate_latest(registry),
+                        )
+                    elif parsed.path == "/debug/traces":
+                        # Debug dumps stay node-local even when the bind
+                        # is widened for Prometheus (--metrics-addr
+                        # 0.0.0.0 on hostNetwork): traces name every
+                        # pod/chip/device on the node — not for
+                        # cross-tenant eyes. Reach it via the node shell
+                        # or kubectl port-forward.
+                        if self.client_address[0] not in (
+                            "127.0.0.1", "::1", "::ffff:127.0.0.1",
+                        ):
+                            self._reply_json(
+                                {"error": "/debug/traces is served to "
+                                          "loopback clients only"},
+                                code=403,
+                            )
+                            return
+                        q = parse_qs(parsed.query)
+                        pod = q.get("pod", [None])[0]
+                        limit = None
+                        if q.get("limit"):
+                            try:
+                                limit = max(0, int(q["limit"][0]))
+                            except ValueError:
+                                self._reply_json(
+                                    {"error": "limit must be an integer"},
+                                    code=400,
+                                )
+                                return
+                        self._reply_json({
+                            "traces": tracer.dump(pod=pod, limit=limit),
+                            "completed_total": tracer.completed,
+                            "capacity": tracer.capacity,
+                        })
+                    elif parsed.path == "/healthz":
+                        self._reply_json({
+                            "status": "ok",
+                            "traces_completed": tracer.completed,
+                        })
+                    else:
+                        self._reply_json(
+                            {"error": f"no such path {parsed.path}",
+                             "paths": ["/metrics", "/debug/traces",
+                                       "/healthz"]},
+                            code=404,
+                        )
+                except BrokenPipeError:  # client went away mid-reply
+                    pass
+                except Exception:  # noqa: BLE001 - never kill the server
+                    logger.exception("metrics http handler failed")
+                    try:
+                        self._reply_json(
+                            {"error": "internal error"}, code=500
+                        )
+                    except Exception:  # noqa: BLE001
+                        pass
+
+        try:
+            httpd = ThreadingHTTPServer((addr, port), Handler)
+        except OSError as e:
+            raise MetricsServerError(
+                f"observability endpoint cannot bind {addr}:{port}: {e} "
+                "(is another agent or exporter already listening? pass a "
+                "different --metrics-port, or 0 to disable)"
+            ) from e
+        httpd.daemon_threads = True
+        threading.Thread(
+            target=httpd.serve_forever, daemon=True, name="metrics-http"
+        ).start()
+        self._httpd = httpd
+        logger.info(
+            "observability endpoint on %s:%d "
+            "(/metrics /debug/traces /healthz)",
+            addr, httpd.server_address[1],
+        )
+        return httpd
+
+    @property
+    def http_port(self) -> Optional[int]:
+        """The bound port of the observability endpoint (None until
+        serve(); useful with port 0)."""
+        if self._httpd is None:
+            return None
+        return self._httpd.server_address[1]
+
+    def close(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None

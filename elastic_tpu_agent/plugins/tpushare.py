@@ -65,6 +65,7 @@ from ..kube.events import (
 from ..kube.locator import DeviceLocator, LocateError
 from ..qos import qos_env
 from ..slice_env import slice_env_for_pod
+from ..tracing import get_tracer
 from ..tpu.topology import chip_grid, ici_distance
 from ..types import AllocationRecord, Device, PodContainer, PodInfo
 from .base import DevicePluginServer, PluginConfig
@@ -361,27 +362,41 @@ class _TPUSharePluginBase(_ListAndWatchMixin, rpc.DevicePluginServicer):
 
     def Allocate(self, request, context):  # noqa: N802, ARG002
         t0 = time.monotonic()
-        responses = []
-        for creq in request.container_requests:
-            device = Device(creq.devicesIDs, self.resource)
-            n_chips = self._chips_for_request(len(creq.devicesIDs))
-            responses.append(
-                dp.ContainerAllocateResponse(
-                    envs=self._alloc_envs(device, n_chips),
-                    devices=self._alloc_device_specs(device, n_chips),
+        with get_tracer().trace(
+            "Allocate", resource=self.resource,
+            requests=len(request.container_requests),
+        ) as tr:
+            responses = []
+            hashes = []
+            for creq in request.container_requests:
+                device = Device(creq.devicesIDs, self.resource)
+                hashes.append(device.hash)
+                n_chips = self._chips_for_request(len(creq.devicesIDs))
+                with get_tracer().span(
+                    "build_response", hash=device.hash,
+                    n_ids=len(creq.devicesIDs), n_chips=n_chips,
+                ):
+                    responses.append(
+                        dp.ContainerAllocateResponse(
+                            envs=self._alloc_envs(device, n_chips),
+                            devices=self._alloc_device_specs(device, n_chips),
+                        )
+                    )
+                logger.info(
+                    "Allocate %s: %d ids -> hash %s (%d chip slots) "
+                    "[trace %s]",
+                    self.resource, len(creq.devicesIDs), device.hash,
+                    n_chips, tr.trace_id,
                 )
-            )
-            logger.info(
-                "Allocate %s: %d ids -> hash %s (%d chip slots)",
-                self.resource, len(creq.devicesIDs), device.hash, n_chips,
-            )
-        resp = dp.AllocateResponse(container_responses=responses)
-        if self._metrics is not None:
-            self._metrics.observe_allocate(time.monotonic() - t0)
-        # Warm the locate cache while kubelet sets up the sandbox, so the
-        # upcoming PreStartContainer skips the O(node pods) List.
-        if hasattr(self._locator, "prefetch_async"):
-            self._locator.prefetch_async()
+            tr.set(hashes=hashes)
+            resp = dp.AllocateResponse(container_responses=responses)
+            if self._metrics is not None:
+                self._metrics.observe_allocate(time.monotonic() - t0)
+            # Warm the locate cache while kubelet sets up the sandbox, so
+            # the upcoming PreStartContainer skips the O(node pods) List.
+            if hasattr(self._locator, "prefetch_async"):
+                with get_tracer().span("prefetch_locator"):
+                    self._locator.prefetch_async()
         return resp
 
     # -- GetPreferredAllocation ----------------------------------------------
@@ -439,22 +454,31 @@ class _TPUSharePluginBase(_ListAndWatchMixin, rpc.DevicePluginServicer):
     def PreStartContainer(self, request, context):  # noqa: N802, ARG002
         t0 = time.monotonic()
         device = Device(request.devicesIDs, self.resource)
-        try:
-            self._bind(device)
-        except Exception:
-            logger.exception(
-                "PreStartContainer %s failed for %s", self.resource, device.hash
-            )
-            raise
-        finally:
-            if self._metrics is not None:
-                self._metrics.observe_prestart(time.monotonic() - t0)
+        with get_tracer().trace(
+            "PreStartContainer", resource=self.resource, hash=device.hash,
+            n_ids=len(request.devicesIDs),
+        ) as tr:
+            try:
+                self._bind(device)
+            except Exception:
+                logger.exception(
+                    "PreStartContainer %s failed for %s [trace %s]",
+                    self.resource, device.hash, tr.trace_id,
+                )
+                raise
+            finally:
+                if self._metrics is not None:
+                    self._metrics.observe_prestart(time.monotonic() - t0)
         return dp.PreStartContainerResponse()
 
     def _lookup_pod(self, owner) -> Optional[dict]:
-        pod = self._sitter.get_pod(owner.namespace, owner.name)
-        if pod is None:
-            pod = self._sitter.get_pod_from_api(owner.namespace, owner.name)
+        with get_tracer().span(
+            "pod_lookup", pod=f"{owner.namespace}/{owner.name}"
+        ) as sp:
+            pod = self._sitter.get_pod(owner.namespace, owner.name)
+            sp.set(informer_hit=pod is not None)
+            if pod is None:
+                pod = self._sitter.get_pod_from_api(owner.namespace, owner.name)
         return pod
 
     def _bind(self, device: Device) -> None:
@@ -469,6 +493,11 @@ class _TPUSharePluginBase(_ListAndWatchMixin, rpc.DevicePluginServicer):
             pod = self._lookup_pod(owner)
         if pod is None:
             raise LocateError(f"pod {owner.pod_key} not found anywhere")
+        # From here the trace is attributable to a pod — /debug/traces
+        # filters on exactly this attribute.
+        get_tracer().annotate(
+            pod=f"{owner.namespace}/{owner.name}", container=owner.container
+        )
         try:
             self._bind_located(device, owner, pod)
         except Exception as e:
@@ -536,10 +565,13 @@ class _TPUSharePluginBase(_ListAndWatchMixin, rpc.DevicePluginServicer):
         # (reference: gpushare.go:133-142).
         created: List[str] = []
         try:
-            for p, idx in enumerate(chip_indexes):
-                link_id = f"{device.hash}-{p}"
-                self._operator.create(idx, link_id)
-                created.append(link_id)
+            with get_tracer().span(
+                "materialize_nodes", chips=list(chip_indexes)
+            ):
+                for p, idx in enumerate(chip_indexes):
+                    link_id = f"{device.hash}-{p}"
+                    self._operator.create(idx, link_id)
+                    created.append(link_id)
         except Exception:
             self._rollback_created(created)
             raise
@@ -578,9 +610,10 @@ class _TPUSharePluginBase(_ListAndWatchMixin, rpc.DevicePluginServicer):
             own_path = os.path.join(self._alloc_dir, f"{device.hash}.json")
             fresh_bind = not os.path.exists(own_path)
             try:
-                self._write_alloc_spec(
-                    device, owner, chip_indexes, annotations, pod
-                )
+                with get_tracer().span("write_alloc_spec", hash=device.hash):
+                    self._write_alloc_spec(
+                        device, owner, chip_indexes, annotations, pod
+                    )
             except Exception:
                 # Sibling files are merged before the own file lands; a
                 # mid-write failure may have left them naming this failed
@@ -602,9 +635,12 @@ class _TPUSharePluginBase(_ListAndWatchMixin, rpc.DevicePluginServicer):
                 chip_indexes=chip_indexes,
                 created_node_ids=created,
             )
-            info = self._storage.load_or_create(owner.namespace, owner.name)
-            info.set_allocation(owner.container, record)
-            self._storage.save(info)
+            with get_tracer().span("checkpoint"):
+                info = self._storage.load_or_create(
+                    owner.namespace, owner.name
+                )
+                info.set_allocation(owner.container, record)
+                self._storage.save(info)
         if self._metrics is not None:
             self._metrics.bound_allocations.set(
                 sum(1 for _ in self._storage.items())
@@ -613,6 +649,7 @@ class _TPUSharePluginBase(_ListAndWatchMixin, rpc.DevicePluginServicer):
             self._crd.record_bound(
                 device.hash, self.resource, len(device.ids),
                 owner.namespace, owner.name, owner.container, chip_indexes,
+                trace_id=get_tracer().current_id(),
             )
         if self._events is not None:
             self._events.pod_event(
@@ -661,6 +698,12 @@ class _TPUSharePluginBase(_ListAndWatchMixin, rpc.DevicePluginServicer):
         env.update(
             slice_env_for_pod(annotations, topo, worker_id, hostnames)
         )
+        trace_id = get_tracer().current_id()
+        if trace_id:
+            # Propagated through the hook-authored env file so the
+            # in-pod flight recorder (workloads/telemetry.py) tags its
+            # step records with the bind's trace id.
+            env["ELASTIC_TPU_TRACE_ID"] = trace_id
         return {
             "hash": device.hash,
             "resource": self.resource,
@@ -1054,30 +1097,48 @@ class TPUSharePlugin:
 
     def gc_once(self) -> int:
         """Reclaim allocations of pods that no longer exist; returns count."""
+        with get_tracer().trace("gc_sweep") as tr:
+            reclaimed = self._gc_sweep()
+            tr.set(reclaimed=reclaimed)
+            if reclaimed == 0:
+                # the 60s tick fires forever; empty sweeps would churn
+                # real allocation traces out of the bounded ring
+                tr.discard()
+        return reclaimed
+
+    def _gc_sweep(self) -> int:
         reclaimed = 0
         storage = self._config.storage
         operator = self._config.operator
         for key, info in list(storage.items()):
             if not self._pod_is_gone(info.namespace, info.name):
                 continue
-            for container, by_resource in info.allocations.items():
-                owner = PodContainer(info.namespace, info.name, container)
-                for record in by_resource.values():
-                    for link_id in record.created_node_ids:
-                        try:
-                            operator.delete(link_id)
-                        except Exception:  # noqa: BLE001
-                            logger.warning(
-                                "GC: failed deleting node %s", link_id
+            with get_tracer().span(
+                "reclaim_pod", pod=f"{info.namespace}/{info.name}"
+            ) as sp:
+                get_tracer().annotate_pod(f"{info.namespace}/{info.name}")
+                hashes = []
+                for container, by_resource in info.allocations.items():
+                    owner = PodContainer(info.namespace, info.name, container)
+                    for record in by_resource.values():
+                        hashes.append(record.device.hash)
+                        for link_id in record.created_node_ids:
+                            try:
+                                operator.delete(link_id)
+                            except Exception:  # noqa: BLE001
+                                logger.warning(
+                                    "GC: failed deleting node %s", link_id
+                                )
+                        # owner passed so a sibling that outlives this
+                        # unlink (iteration order) never names the freed
+                        # devices
+                        self.core.remove_alloc_spec(record.device.hash, owner)
+                        if self._config.crd_recorder is not None:
+                            self._config.crd_recorder.record_released(
+                                record.device.hash
                             )
-                    # owner passed so a sibling that outlives this unlink
-                    # (iteration order) never names the freed devices
-                    self.core.remove_alloc_spec(record.device.hash, owner)
-                    if self._config.crd_recorder is not None:
-                        self._config.crd_recorder.record_released(
-                            record.device.hash
-                        )
-            storage.delete(info.namespace, info.name)
+                sp.set(hashes=hashes)
+                storage.delete(info.namespace, info.name)
             reclaimed += 1
             events = self._config.events
             if events is not None:

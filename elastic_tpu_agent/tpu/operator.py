@@ -27,6 +27,7 @@ from dataclasses import dataclass, field
 from typing import List, Optional
 
 from ..common import VirtualDevPrefix
+from ..tracing import get_tracer
 
 logger = logging.getLogger(__name__)
 
@@ -120,25 +121,27 @@ class LinkingOperator(TPUOperator):
     def create(self, index: int, link_id: str) -> None:
         link = self.link_path(link_id)
         target = self.target_path(index)
-        try:
-            if os.path.islink(link):
-                if os.readlink(link) == target:
-                    return  # idempotent re-create (Restore path)
-                os.unlink(link)
-            os.symlink(target, link)
-        except OSError as e:
-            raise OperatorError(f"create {link} -> {target}: {e}") from e
+        with get_tracer().span("operator_create", link=link, target=target):
+            try:
+                if os.path.islink(link):
+                    if os.readlink(link) == target:
+                        return  # idempotent re-create (Restore path)
+                    os.unlink(link)
+                os.symlink(target, link)
+            except OSError as e:
+                raise OperatorError(f"create {link} -> {target}: {e}") from e
         logger.info("created virtual TPU node %s -> %s", link, target)
 
     def delete(self, link_id: str) -> None:
         link = self.link_path(link_id)
-        try:
-            os.unlink(link)
-            logger.info("removed virtual TPU node %s", link)
-        except FileNotFoundError:
-            pass
-        except OSError as e:
-            raise OperatorError(f"delete {link}: {e}") from e
+        with get_tracer().span("operator_delete", link=link):
+            try:
+                os.unlink(link)
+                logger.info("removed virtual TPU node %s", link)
+            except FileNotFoundError:
+                pass
+            except OSError as e:
+                raise OperatorError(f"delete {link}: {e}") from e
 
     def check(self, link_id: str) -> bool:
         return os.path.islink(self.link_path(link_id))

@@ -203,6 +203,12 @@ def main(argv=None) -> int:
     parser.add_argument("--temperature", type=float, default=0.0)
     parser.add_argument("--top-k", type=int, default=0)
     parser.add_argument("--top-p", type=float, default=0.0)
+    parser.add_argument(
+        "--flight-recorder", default="",
+        help="write per-step flight-recorder JSONL here (default: "
+             "$ELASTIC_TPU_FLIGHT_RECORDER, or in-memory only); records "
+             "carry the agent-propagated ELASTIC_TPU_TRACE_ID",
+    )
     args = parser.parse_args(argv)
 
     applied = load_alloc_env()
@@ -445,6 +451,21 @@ def main(argv=None) -> int:
     train_step.lower(params, opt_state, tokens_for(start_step)).compile()
 
     every = max(0, args.checkpoint_every)  # 0 = save only on preemption
+    # Flight recorder (telemetry.py): per-step wall time, tokens/s, jit
+    # recompiles and device-memory stats, tagged with the trace id the
+    # agent propagated through the env file — load_alloc_env() above
+    # already applied it, so the default constructor picks it up.
+    from .telemetry import FlightRecorder
+
+    # dataset mode feeds a global batch of local*process_count rows;
+    # synthetic mode replicates one global batch of args.batch rows
+    global_batch = args.batch * (
+        jax.process_count() if dataset is not None else 1
+    )
+    tokens_per_step = global_batch * args.seq
+    recorder = FlightRecorder(
+        path=args.flight_recorder or None, jit_fns=(train_step,)
+    )
     if args.profile_dir:
         jax.profiler.start_trace(args.profile_dir)
     t0 = time.perf_counter()
@@ -454,9 +475,10 @@ def main(argv=None) -> int:
     eval_s = 0.0  # eval wall time, subtracted from step accounting
     try:
         for step in range(start_step, start_step + args.steps):
-            params, opt_state, loss = train_step(
-                params, opt_state, tokens_for(step)
-            )
+            with recorder.step(step, tokens=tokens_per_step):
+                params, opt_state, loss = train_step(
+                    params, opt_state, tokens_for(step)
+                )
             ran += 1
             if eval_fn is not None and (step + 1) % args.eval_every == 0:
                 te = time.perf_counter()
@@ -464,11 +486,16 @@ def main(argv=None) -> int:
                     float(eval_fn(params, eval_batch(j)))
                     for j in range(max(1, args.eval_batches))
                 ]
-                eval_s += time.perf_counter() - te
+                ev_dt = time.perf_counter() - te
+                eval_s += ev_dt
                 eval_hist.append({
                     "step": step,
                     "loss": sum(vals) / len(vals),
                 })
+                recorder.record(
+                    "eval", step=step, loss=eval_hist[-1]["loss"],
+                    duration_ms=round(ev_dt * 1000, 3),
+                )
             if ckpt is not None and (
                 preempted["flag"] or (every > 0 and (step + 1) % every == 0)
             ):
@@ -495,12 +522,6 @@ def main(argv=None) -> int:
         ckpt.wait()
         ckpt.close()
 
-    # dataset mode feeds a global batch of local*process_count rows;
-    # synthetic mode replicates one global batch of args.batch rows
-    global_batch = args.batch * (
-        jax.process_count() if dataset is not None else 1
-    )
-    tokens_per_step = global_batch * args.seq
     report = {
         "platform": jax.devices()[0].platform,
         "devices": len(jax.devices()),
@@ -519,6 +540,11 @@ def main(argv=None) -> int:
         report["lr_schedule"] = {
             "peak": args.lr, "warmup_steps": args.warmup_steps,
         }
+    recorder.record("run_summary", **{
+        k: report[k] for k in ("steps", "step_time_ms", "tokens_per_s")
+    })
+    report["flight_recorder"] = recorder.summary()
+    recorder.close()
     print(json.dumps(report))
     return 0
 

@@ -44,6 +44,7 @@ from __future__ import annotations
 
 import functools
 import math
+import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -169,7 +170,13 @@ class ServingEngine:
         draft_cfg: Optional[ModelConfig] = None,
         gamma: int = 4,
         paged_kernel: bool = False,
+        recorder=None,
     ):
+        # optional flight recorder (workloads/telemetry.py): every
+        # admit/step emits a JSONL record tagged with the agent's
+        # propagated trace id, so broker-side sharing decisions can be
+        # validated against measured serving throughput
+        self._recorder = recorder
         self.params = params
         self.cfg = cfg
         self.slots = slots
@@ -1016,6 +1023,7 @@ class ServingEngine:
         the request in step() — the stop token IS appended to the
         stream (callers that want it hidden strip the tail), and the
         slot frees without the caller polling."""
+        t0 = time.perf_counter() if self._recorder is not None else 0.0
         claim = self._claim_admission(
             prompt, prefix, temperature, top_k, top_p,
             need_bucket=True,
@@ -1093,6 +1101,13 @@ class ServingEngine:
         # the admission token itself may be a stop token
         if int(first) in self._stop[rid]:
             self._finish(rid, "stop_token")
+        if self._recorder is not None:
+            self._recorder.record(
+                "serving_admit", rid=rid, prompt_len=p,
+                prefix_len=plen, bucket=bucket,
+                duration_ms=round((time.perf_counter() - t0) * 1000, 3),
+                used_blocks=self.used_blocks,
+            )
         return rid
 
     def enqueue(
@@ -1147,6 +1162,7 @@ class ServingEngine:
         return {rid: [tokens...]} — each row commits its accepted
         draft prefix + correction, so lists have variable length ≥ 1
         per step."""
+        t0 = time.perf_counter() if self._recorder is not None else 0.0
         # one pending-prefill chunk per step (enqueue()): live decodes
         # never stall behind a long admission. A row activating here
         # SITS OUT this step's decode (it "settles"): its entry in the
@@ -1159,12 +1175,25 @@ class ServingEngine:
         try:
             if self.draft_params is not None:
                 out = self._step_speculative()
-                return {
-                    **{r: [t] for r, t in activated.items()}, **out
-                }
-            return {**activated, **self._step_plain()}
+                out = {**{r: [t] for r, t in activated.items()}, **out}
+            else:
+                out = {**activated, **self._step_plain()}
         finally:
             self._settling = set()
+        if self._recorder is not None:
+            self._recorder.record(
+                "serving_step",
+                duration_ms=round((time.perf_counter() - t0) * 1000, 3),
+                emitted_tokens=sum(
+                    len(v) if isinstance(v, list) else 1
+                    for v in out.values()
+                ),
+                live_requests=len(self._slot_of),
+                pending_prefills=len(self._pending),
+                used_blocks=self.used_blocks,
+                pool_blocks=self.pool_blocks,
+            )
+        return out
 
     def _step_plain(self) -> Dict[int, int]:
         if not self._slot_of:

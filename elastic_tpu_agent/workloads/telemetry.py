@@ -1,0 +1,275 @@
+"""In-pod workload flight recorder: the other half of the agent's
+allocation tracing.
+
+The agent can prove it *gave* a pod its slice (tracing.py, /debug/traces)
+but not what the workload *achieved* on it — and a broker that co-locates
+jobs (fractional core/HBM shares) needs exactly that feedback to validate
+its sharing decisions. This module captures per-step facts from inside
+the pod:
+
+- wall time per step (dispatch-to-dispatch; JAX dispatch is async, so in
+  a saturated loop this converges on true device step time),
+- tokens/sec when the caller supplies a token count,
+- jit recompile count (cache-size delta of the watched jitted fns — a
+  recompile mid-run is the classic silent throughput killer),
+- JAX device memory stats where the backend reports them (bytes_in_use
+  against the pod's cooperative HBM quota).
+
+Records are JSONL, tagged with the **propagated trace id**: the agent
+writes ``ELASTIC_TPU_TRACE_ID`` into the alloc-spec env, the OCI
+hook/NRI adjustment copies it into ``/run/elastic-tpu/env``, the runner
+applies that file to its environment, and this recorder reads it — so
+one id links `kubectl describe pod`, the agent's /debug/traces dump,
+and these step records.
+
+Output is bounded: the JSONL file rotates to ``<path>.1`` past
+``max_bytes`` (≤ 2x max_bytes on disk, ever) and the in-memory ring
+keeps the newest ``max_memory_records`` for end-of-run summaries.
+Everything is best-effort — a broken disk must not fail a train step.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import logging
+import os
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional
+
+logger = logging.getLogger(__name__)
+
+ENV_TRACE_ID = "ELASTIC_TPU_TRACE_ID"
+ENV_RECORDER_PATH = "ELASTIC_TPU_FLIGHT_RECORDER"
+
+DEFAULT_MAX_BYTES = 4 * 1024 * 1024
+DEFAULT_MEMORY_RECORDS = 512
+
+
+def device_memory_stats() -> Optional[dict]:
+    """bytes_in_use/peak/limit of the first local device, when the
+    backend exposes them (TPU does; CPU returns None). Never raises."""
+    try:
+        import jax
+
+        devs = jax.local_devices()
+        if not devs:
+            return None
+        stats = devs[0].memory_stats()
+        if not stats:
+            return None
+        keep = ("bytes_in_use", "peak_bytes_in_use", "bytes_limit")
+        return {k: int(stats[k]) for k in keep if k in stats}
+    except Exception:  # noqa: BLE001 - telemetry, never load-bearing
+        return None
+
+
+class StepTimer:
+    """Context manager timing one step; created by FlightRecorder.step."""
+
+    def __init__(self, recorder: "FlightRecorder", step: int,
+                 tokens: Optional[int], attrs: Dict) -> None:
+        self._recorder = recorder
+        self.step = step
+        self.tokens = tokens
+        self.attrs = dict(attrs)
+        self._t0 = 0.0
+
+    def set(self, **attrs) -> None:
+        self.attrs.update(attrs)
+
+    def __enter__(self) -> "StepTimer":
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        dt = time.perf_counter() - self._t0
+        fields = {"step": self.step, "duration_ms": round(dt * 1000, 3)}
+        if self.tokens is not None and dt > 0:
+            fields["tokens"] = self.tokens
+            fields["tokens_per_s"] = round(self.tokens / dt, 3)
+        recompiles = self._recorder._recompile_delta()
+        if recompiles is not None:
+            fields["jit_recompiles"] = recompiles
+        mem = device_memory_stats()
+        if mem:
+            fields["device_memory"] = mem
+        if exc is not None:
+            fields["error"] = f"{type(exc).__name__}: {exc}"
+        fields.update(self.attrs)
+        self._recorder.record("step", **fields)
+        # never suppress the exception
+
+
+class FlightRecorder:
+    """Bounded JSONL step recorder, correlated to the agent's trace id.
+
+    ``path`` None/"" -> in-memory only (the ring still feeds summary()).
+    ``jit_fns`` are watched for cache growth: each recorded step carries
+    the number of NEW compilations since the previous record.
+    """
+
+    def __init__(
+        self,
+        path: Optional[str] = None,
+        trace_id: Optional[str] = None,
+        max_bytes: int = DEFAULT_MAX_BYTES,
+        max_memory_records: int = DEFAULT_MEMORY_RECORDS,
+        jit_fns: tuple = (),
+    ) -> None:
+        self.trace_id = (
+            trace_id if trace_id is not None
+            else os.environ.get(ENV_TRACE_ID, "")
+        )
+        self.path = (
+            path if path is not None
+            else os.environ.get(ENV_RECORDER_PATH, "")
+        )
+        self.max_bytes = max_bytes
+        self.records: "deque[dict]" = deque(maxlen=max_memory_records)
+        self._jit_fns = [f for f in jit_fns if hasattr(f, "_cache_size")]
+        self._last_cache_size: Optional[int] = None
+        self._lock = threading.Lock()
+        self._file = None
+        self._file_broken = False
+        self.written = 0  # lines that reached the file
+        if self.path:
+            self._open_file()
+
+    # -- file plumbing --------------------------------------------------------
+
+    def _open_file(self) -> None:
+        try:
+            d = os.path.dirname(self.path)
+            if d:
+                os.makedirs(d, exist_ok=True)
+            self._file = open(self.path, "a")
+        except OSError as e:
+            self._file = None
+            self._file_broken = True
+            logger.warning(
+                "flight recorder: cannot open %s (%s); recording "
+                "in-memory only", self.path, e,
+            )
+
+    def _rotate_locked(self) -> None:
+        try:
+            self._file.close()
+        except OSError:
+            pass
+        mode = "w"
+        try:
+            os.replace(self.path, self.path + ".1")
+        except OSError as e:
+            # Rotation failed (e.g. <path>.1 is a directory): reopen
+            # APPEND — truncating now would destroy the newest records
+            # the recorder exists to preserve. The size bound is lost
+            # until rotation succeeds; data loss would be worse.
+            mode = "a"
+            if not self._file_broken:
+                logger.warning(
+                    "flight recorder: rotating %s failed (%s); "
+                    "continuing unrotated", self.path, e,
+                )
+        try:
+            self._file = open(self.path, mode)
+        except OSError:
+            self._file = None
+            self._file_broken = True
+
+    # -- recording ------------------------------------------------------------
+
+    def record(self, kind: str, **fields) -> dict:
+        rec = {"ts": round(time.time(), 3), "kind": kind}
+        if self.trace_id:
+            rec["trace_id"] = self.trace_id
+        rec.update(fields)
+        with self._lock:
+            self.records.append(rec)
+            if self._file is not None:
+                try:
+                    self._file.write(json.dumps(rec) + "\n")
+                    self._file.flush()
+                    self.written += 1
+                    if self._file.tell() > self.max_bytes:
+                        self._rotate_locked()
+                except (OSError, ValueError):
+                    # ValueError: write on a closed file after close()
+                    if not self._file_broken:
+                        self._file_broken = True
+                        logger.warning(
+                            "flight recorder: write to %s failed; "
+                            "continuing in-memory only", self.path,
+                        )
+                    self._file = None
+        return rec
+
+    def step(self, step: int, tokens: Optional[int] = None,
+             **attrs) -> StepTimer:
+        """``with recorder.step(i, tokens=n): train_step(...)``"""
+        return StepTimer(self, step, tokens, attrs)
+
+    def _recompile_delta(self) -> Optional[int]:
+        if not self._jit_fns:
+            return None
+        try:
+            size = sum(int(f._cache_size()) for f in self._jit_fns)
+        except Exception:  # noqa: BLE001 - private API, may shift
+            return None
+        prev, self._last_cache_size = self._last_cache_size, size
+        return size - prev if prev is not None else size
+
+    # -- reading --------------------------------------------------------------
+
+    def summary(self) -> dict:
+        with self._lock:
+            steps = [r for r in self.records if r.get("kind") == "step"]
+            n = len(self.records)
+        out = {
+            "trace_id": self.trace_id,
+            "path": self.path or None,
+            "records": n,
+            "steps": len(steps),
+        }
+        if steps:
+            durs = [r["duration_ms"] for r in steps if "duration_ms" in r]
+            if durs:
+                out["mean_step_ms"] = round(sum(durs) / len(durs), 3)
+            out["jit_recompiles"] = sum(
+                r.get("jit_recompiles", 0) for r in steps
+            )
+            rates = [r["tokens_per_s"] for r in steps if "tokens_per_s" in r]
+            if rates:
+                out["mean_tokens_per_s"] = round(
+                    sum(rates) / len(rates), 3
+                )
+        return out
+
+    def close(self) -> None:
+        with self._lock:
+            if self._file is not None:
+                with contextlib.suppress(OSError):
+                    self._file.close()
+                self._file = None
+
+
+def load_jsonl(path: str) -> List[dict]:
+    """Read back a recorder file (rotated generation first, so records
+    come out oldest-to-newest); tolerates a torn final line."""
+    out: List[dict] = []
+    for p in (path + ".1", path):
+        try:
+            with open(p) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        out.append(json.loads(line))
+                    except ValueError:
+                        continue
+        except OSError:
+            continue
+    return out

@@ -1,0 +1,200 @@
+"""End-to-end allocation tracing: Allocate -> PreStartContainer -> GC
+driven through the fake kubelet/apiserver/stub-operator stack, traces
+retrieved over the REAL /debug/traces HTTP endpoint, and the trace id
+propagated through the alloc-spec env file into a real runner step loop
+whose flight-recorder JSONL carries the same id (the ISSUE 1 acceptance
+flow, both sides of the correlation)."""
+
+import json
+import os
+import urllib.request
+
+import pytest
+
+from elastic_tpu_agent import tracing
+from elastic_tpu_agent.common import (
+    AnnotationAssumed,
+    ResourceTPUCore,
+    container_annotation,
+)
+from elastic_tpu_agent.metrics import AgentMetrics
+from elastic_tpu_agent.plugins.tpushare import CORE_ENDPOINT, core_device_id
+from elastic_tpu_agent.types import Device
+from prometheus_client import CollectorRegistry
+
+from fake_apiserver import make_pod
+from test_e2e import Cluster, wait_until
+
+
+@pytest.fixture()
+def traced_cluster(tmp_path):
+    """Fresh tracer + full Cluster + the unified HTTP endpoint."""
+    prev = tracing.set_tracer(tracing.Tracer())
+    c = Cluster(tmp_path)
+    c.start()
+    metrics = AgentMetrics(registry=CollectorRegistry())
+    metrics.serve(0)  # ephemeral loopback port
+    c.metrics = metrics
+    try:
+        yield c
+    finally:
+        metrics.close()
+        c.stop()
+        tracing.set_tracer(prev)
+
+
+def _traces(port, query=""):
+    url = f"http://127.0.0.1:{port}/debug/traces{query}"
+    with urllib.request.urlopen(url, timeout=10) as resp:
+        return json.loads(resp.read())["traces"]
+
+
+def test_allocation_trace_and_flight_recorder_correlate(
+    traced_cluster, tmp_path, monkeypatch, capsys
+):
+    c = traced_cluster
+    port = c.metrics.http_port
+
+    # -- scheduler places the pod; kubelet drives Allocate + PreStart -----
+    c.apiserver.upsert_pod(
+        make_pod(
+            "default", "traced", c.node,
+            annotations={
+                AnnotationAssumed: "true",
+                container_annotation("jax"): "1",
+            },
+            containers=[{"name": "jax"}],
+        )
+    )
+    assert wait_until(
+        lambda: c.manager.sitter.get_pod("default", "traced") is not None
+    )
+    ids = [core_device_id(1, i) for i in range(100)]
+    c.kubelet.kubelet_allocate_flow(
+        CORE_ENDPOINT, "default", "traced", "jax", ResourceTPUCore, ids
+    )
+    dev_hash = Device(ids, ResourceTPUCore).hash
+
+    # -- the agent side: one PreStart trace, >= 4 named spans, over HTTP --
+    all_traces = _traces(port)
+    assert any(t["name"] == "Allocate" for t in all_traces)
+    pod_traces = _traces(port, "?pod=default/traced")
+    prestarts = [t for t in pod_traces if t["name"] == "PreStartContainer"]
+    assert len(prestarts) == 1
+    trace = prestarts[0]
+    span_names = {s["name"] for s in trace["spans"]}
+    assert len(span_names) >= 4
+    assert {
+        "locator_locate", "pod_lookup", "materialize_nodes",
+        "write_alloc_spec", "checkpoint",
+    } <= span_names
+    assert all(s["duration_ms"] >= 0 for s in trace["spans"])
+    trace_id = trace["trace_id"]
+    assert trace["attrs"]["pod"] == "default/traced"
+
+    # the bind event carries the trace id for kubectl describe
+    assert c.manager.events.flush()
+    bound = [
+        e for e in c.apiserver.core_events if e["reason"] == "TPUBound"
+    ]
+    assert bound and f"[trace {trace_id}]" in bound[0]["message"]
+
+    # -- the spec env propagates the id to the hook-authored env file -----
+    spec_path = os.path.join(str(c.tmp / "alloc"), f"{dev_hash}.json")
+    with open(spec_path) as f:
+        spec = json.load(f)
+    assert spec["env"]["ELASTIC_TPU_TRACE_ID"] == trace_id
+
+    # -- workload side: a real runner train loop, flight-recorder JSONL --
+    env_file = tmp_path / "hook-env"
+    env_file.write_text(
+        f"ELASTIC_TPU_TRACE_ID={spec['env']['ELASTIC_TPU_TRACE_ID']}\n"
+    )
+    flight = tmp_path / "flight.jsonl"
+    monkeypatch.setenv("ELASTIC_TPU_ENV_FILE", str(env_file))
+    monkeypatch.setenv("ELASTIC_TPU_TRACE_ID", "pre-existing-must-lose")
+    from elastic_tpu_agent.workloads import runner
+
+    rc = runner.main([
+        "--steps", "2", "--batch", "2", "--seq", "16",
+        "--preset", "tiny", "--flight-recorder", str(flight),
+    ])
+    assert rc == 0
+    report = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert report["alloc_env"]["ELASTIC_TPU_TRACE_ID"] == trace_id
+    assert report["flight_recorder"]["trace_id"] == trace_id
+    records = [
+        json.loads(line)
+        for line in flight.read_text().splitlines() if line.strip()
+    ]
+    steps = [r for r in records if r["kind"] == "step"]
+    assert len(steps) == 2
+    assert all(r["trace_id"] == trace_id for r in steps)
+    assert all(r["duration_ms"] > 0 for r in steps)
+    assert all(r.get("tokens_per_s", 0) > 0 for r in steps)
+
+    # -- GC closes the loop: reclaim is traced under the same pod ---------
+    c.apiserver.delete_pod("default", "traced")
+    c.kubelet.unassign_pod("default", "traced")
+    assert wait_until(
+        lambda: c.manager.storage.load("default", "traced") is None,
+        timeout=15.0,
+    )
+    gc_traces = [
+        t for t in _traces(port, "?pod=default/traced")
+        if t["name"] == "gc_sweep"
+    ]
+    assert gc_traces, "the reclaiming GC sweep must be traced"
+    assert gc_traces[0]["attrs"]["reclaimed"] >= 1
+    reclaim_spans = [
+        s for s in gc_traces[0]["spans"] if s["name"] == "reclaim_pod"
+    ]
+    assert reclaim_spans
+    assert reclaim_spans[0]["attrs"]["pod"] == "default/traced"
+    assert dev_hash in reclaim_spans[0]["attrs"]["hashes"]
+
+
+def test_healthz_and_metrics_serve_alongside_traces(traced_cluster):
+    port = traced_cluster.metrics.http_port
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/healthz", timeout=10
+    ) as resp:
+        assert json.loads(resp.read())["status"] == "ok"
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/metrics", timeout=10
+    ) as resp:
+        body = resp.read()
+    assert b"elastic_tpu_prestart_seconds" in body
+
+
+def test_bind_failure_trace_records_error(traced_cluster):
+    """A PreStart against a pod the scheduler never assumed: the failed
+    trace is kept, carries the error, and the TPUBindFailed event links
+    to it."""
+    c = traced_cluster
+    port = c.metrics.http_port
+    c.apiserver.upsert_pod(
+        make_pod("default", "unassumed", c.node, annotations={},
+                 containers=[{"name": "jax"}])
+    )
+    assert wait_until(
+        lambda: c.manager.sitter.get_pod("default", "unassumed") is not None
+    )
+    ids = [core_device_id(0, i) for i in range(10)]
+    client = c.kubelet.plugin_client(CORE_ENDPOINT)
+    client.allocate(ids)
+    c.kubelet.assign("default", "unassumed", "jax", ResourceTPUCore, ids)
+    with pytest.raises(Exception):
+        client.pre_start_container(ids)
+    failed = [
+        t for t in _traces(port, "?pod=default/unassumed")
+        if t["name"] == "PreStartContainer"
+    ]
+    assert failed and "not assumed" in failed[0]["error"]
+    assert c.manager.events.flush()
+    bind_failed = [
+        e for e in c.apiserver.core_events
+        if e["reason"] == "TPUBindFailed"
+    ]
+    assert bind_failed
+    assert f"[trace {failed[0]['trace_id']}]" in bind_failed[0]["message"]

@@ -1,0 +1,469 @@
+"""Tracer + unified observability endpoint + flight recorder units.
+
+The tier-1 contract pieces: the ring buffer stays bounded under churn,
+concurrent traces never interleave attributes, ELASTIC_TPU_TRACE_ID
+round-trips through the hook env file into workloads.runner.load_alloc_env,
+the /metrics//debug/traces//healthz endpoint behaves, port conflicts fail
+with the typed error, and AsyncSink internals surface as gauges.
+"""
+
+import json
+import os
+import threading
+import urllib.error
+import urllib.request
+
+import pytest
+from prometheus_client import CollectorRegistry
+
+from elastic_tpu_agent import tracing
+from elastic_tpu_agent.async_sink import AsyncSink, register_sink_metrics
+from elastic_tpu_agent.metrics import AgentMetrics, MetricsServerError
+from elastic_tpu_agent.workloads.runner import load_alloc_env
+from elastic_tpu_agent.workloads.telemetry import (
+    ENV_TRACE_ID,
+    FlightRecorder,
+    load_jsonl,
+)
+
+
+# -- tracer core --------------------------------------------------------------
+
+
+def test_ring_buffer_stays_bounded_under_churn():
+    tr = tracing.Tracer(capacity=8)
+    for i in range(100):
+        with tr.trace("allocate", i=i):
+            with tr.span("inner"):
+                pass
+    dump = tr.dump()
+    assert len(dump) == 8
+    assert tr.completed == 100
+    # newest first
+    assert [t["attrs"]["i"] for t in dump] == list(range(99, 91, -1))
+
+
+def test_failed_trace_is_kept_with_error():
+    tr = tracing.Tracer()
+    with pytest.raises(ValueError):
+        with tr.trace("prestart"):
+            with pytest.raises(KeyError):
+                with tr.span("locate"):
+                    raise KeyError("missing")
+            raise ValueError("bind failed")
+    (dumped,) = tr.dump()
+    assert "ValueError" in dumped["error"]
+    assert dumped["spans"][0]["name"] == "locate"
+    assert "KeyError" in dumped["spans"][0]["error"]
+
+
+def test_span_without_active_trace_is_noop():
+    tr = tracing.Tracer()
+    with tr.span("orphan") as sp:
+        sp.set(x=1)  # settable, but recorded nowhere
+    assert tr.dump() == []
+    assert tr.current() is None and tr.current_id() == ""
+
+
+def test_discarded_trace_not_recorded():
+    tr = tracing.Tracer()
+    with tr.trace("gc_sweep") as t:
+        t.discard()
+    assert tr.dump() == [] and tr.completed == 0
+
+
+def test_concurrent_traces_do_not_interleave():
+    """Two threads churning traces concurrently: every recorded trace's
+    spans must carry ONLY that thread's attributes (contextvar
+    confinement — the defect this guards against is a shared 'current
+    span' getting both threads' attrs)."""
+    tr = tracing.Tracer(capacity=1000)
+    n_each = 50
+    barrier = threading.Barrier(2)
+    errors = []
+
+    def churn(owner):
+        try:
+            barrier.wait(timeout=5)
+            for i in range(n_each):
+                with tr.trace("bind", owner=owner, seq=i):
+                    with tr.span("step1", owner=owner, seq=i):
+                        pass
+                    tr.annotate(annotated_by=owner)
+                    with tr.span("step2", owner=owner, seq=i):
+                        pass
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    threads = [
+        threading.Thread(target=churn, args=(name,))
+        for name in ("alpha", "beta")
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    assert not errors
+    dump = tr.dump()
+    assert len(dump) == 2 * n_each
+    for trace in dump:
+        owner = trace["attrs"]["owner"]
+        assert trace["attrs"]["annotated_by"] == owner
+        assert len(trace["spans"]) == 2
+        for span in trace["spans"]:
+            assert span["attrs"]["owner"] == owner
+            assert span["attrs"]["seq"] == trace["attrs"]["seq"]
+
+
+def test_dump_filters_by_pod_and_limit():
+    tr = tracing.Tracer()
+    for i, pod in enumerate(["ns/a", "ns/b", "ns/a", "other/a"]):
+        with tr.trace("prestart", pod=pod, i=i):
+            pass
+    assert [t["attrs"]["i"] for t in tr.dump(pod="ns/a")] == [2, 0]
+    # bare pod name matches any namespace
+    assert [t["attrs"]["i"] for t in tr.dump(pod="a")] == [3, 2, 0]
+    assert len(tr.dump(limit=1)) == 1
+    assert tr.dump(limit=0) == []  # 0 means zero, not "first one"
+    assert len(tr.dump(pod="nope")) == 0
+
+
+def test_multi_pod_sweep_findable_under_every_pod():
+    """A GC sweep reclaiming several pods accumulates them via
+    annotate_pod; the dump filter must match EACH, not just the last."""
+    tr = tracing.Tracer()
+    with tr.trace("gc_sweep"):
+        tr.annotate_pod("ns/a")
+        tr.annotate_pod("ns/b")
+        tr.annotate_pod("ns/b")  # repeat reclaim: no duplicate
+    for query in ("ns/a", "ns/b", "a", "b"):
+        hits = tr.dump(pod=query)
+        assert len(hits) == 1, query
+    assert hits[0]["attrs"]["pods"] == ["ns/a", "ns/b"]
+    assert tr.dump(pod="ns/c") == []
+
+
+def test_slow_span_logged(caplog):
+    tr = tracing.Tracer(slow_span_s=0.0)
+    with caplog.at_level("WARNING", logger="elastic_tpu_agent.tracing"):
+        with tr.trace("bind"):
+            with tr.span("crawl"):
+                pass
+    assert any("slow span crawl" in r.message for r in caplog.records)
+
+
+# -- trace-id propagation round trip ------------------------------------------
+
+
+def test_trace_id_roundtrips_env_file_into_runner_env(tmp_path, monkeypatch):
+    """agent spec env -> hook env file -> load_alloc_env -> FlightRecorder:
+    the agent's value must OVERRIDE any ambient/stale trace id."""
+    monkeypatch.setenv(ENV_TRACE_ID, "stale-ambient-id")
+    monkeypatch.setenv("TPU_VISIBLE_CHIPS", "9,9")
+    env_file = tmp_path / "env"
+    env_file.write_text(
+        "ELASTIC_TPU_TRACE_ID=deadbeef01234567\nTPU_VISIBLE_CHIPS=0\n"
+    )
+    applied = load_alloc_env(str(env_file))
+    assert applied["ELASTIC_TPU_TRACE_ID"] == "deadbeef01234567"
+    assert os.environ[ENV_TRACE_ID] == "deadbeef01234567"
+    rec = FlightRecorder()  # trace id defaults from the applied env
+    assert rec.trace_id == "deadbeef01234567"
+    rec.record("step", step=0)
+    assert rec.records[-1]["trace_id"] == "deadbeef01234567"
+
+
+# -- unified HTTP endpoint ----------------------------------------------------
+
+
+def _get(port, path):
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{port}{path}", timeout=10
+    ) as resp:
+        return resp.status, resp.headers.get("Content-Type"), resp.read()
+
+
+@pytest.fixture()
+def fresh_tracer():
+    prev = tracing.set_tracer(tracing.Tracer())
+    yield tracing.get_tracer()
+    tracing.set_tracer(prev)
+
+
+def test_unified_endpoint_serves_all_three_paths(fresh_tracer):
+    m = AgentMetrics(registry=CollectorRegistry())
+    m.serve(0)
+    try:
+        port = m.http_port
+        with fresh_tracer.trace("prestart", pod="default/p1"):
+            with fresh_tracer.span("locate"):
+                pass
+        m.observe_allocate(0.001)
+
+        status, ctype, body = _get(port, "/metrics")
+        assert status == 200 and "text/plain" in ctype
+        assert b"elastic_tpu_allocate_seconds" in body
+
+        status, ctype, body = _get(port, "/debug/traces")
+        assert status == 200 and ctype == "application/json"
+        payload = json.loads(body)
+        assert payload["completed_total"] == 1
+        assert payload["traces"][0]["trace_id"]
+        assert payload["traces"][0]["spans"][0]["name"] == "locate"
+
+        # pod filter: miss then hit
+        _, _, body = _get(port, "/debug/traces?pod=nope")
+        assert json.loads(body)["traces"] == []
+        _, _, body = _get(port, "/debug/traces?pod=default/p1&limit=1")
+        assert len(json.loads(body)["traces"]) == 1
+
+        status, _, body = _get(port, "/healthz")
+        assert status == 200 and json.loads(body)["status"] == "ok"
+
+        with pytest.raises(urllib.error.HTTPError) as exc_info:
+            _get(port, "/nope")
+        assert exc_info.value.code == 404
+    finally:
+        m.close()
+
+
+def test_debug_traces_refused_for_nonloopback_clients(fresh_tracer):
+    """The bind may be widened for Prometheus (0.0.0.0 + hostNetwork),
+    but /debug/traces must stay node-local: a connection arriving from a
+    non-loopback address gets 403 while /metrics still serves."""
+    import socket
+
+    with fresh_tracer.trace("prestart", pod="ns/p"):
+        pass
+    # a non-loopback local address to originate from
+    host_ip = None
+    try:
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        s.connect(("203.0.113.1", 9))  # no traffic sent (UDP)
+        host_ip = s.getsockname()[0]
+        s.close()
+    except OSError:
+        pass
+    if not host_ip or host_ip.startswith("127."):
+        pytest.skip("no non-loopback interface available")
+    m = AgentMetrics(registry=CollectorRegistry())
+    m.serve(0, addr="0.0.0.0")
+    try:
+        port = m.http_port
+
+        def fetch(path):
+            # source-bind to the host IP so client_address is non-loopback
+            conn = socket.create_connection(
+                (host_ip, port), timeout=10, source_address=(host_ip, 0)
+            )
+            with conn:
+                conn.sendall(
+                    f"GET {path} HTTP/1.1\r\nHost: x\r\n"
+                    "Connection: close\r\n\r\n".encode()
+                )
+                data = b""
+                while chunk := conn.recv(65536):
+                    data += chunk
+            return data
+
+        assert b"403" in fetch("/debug/traces").split(b"\r\n", 1)[0]
+        metrics_resp = fetch("/metrics")
+        assert b"200" in metrics_resp.split(b"\r\n", 1)[0]
+        assert b"elastic_tpu_allocate_seconds" in metrics_resp
+        # loopback keeps full access
+        status, _, body = _get(port, "/debug/traces")
+        assert status == 200 and json.loads(body)["traces"]
+    finally:
+        m.close()
+
+
+def test_port_in_use_raises_typed_error():
+    m1 = AgentMetrics(registry=CollectorRegistry())
+    m1.serve(0)
+    try:
+        m2 = AgentMetrics(registry=CollectorRegistry())
+        with pytest.raises(MetricsServerError) as exc_info:
+            m2.serve(m1.http_port)
+        assert "--metrics-port" in str(exc_info.value)
+    finally:
+        m1.close()
+
+
+def test_cli_continues_when_metrics_port_busy(tmp_path):
+    """Satellite: a bound port must not crash agent startup — the CLI
+    logs the typed error and proceeds (we exercise the same guard the
+    CLI uses, without booting a manager)."""
+    from elastic_tpu_agent import cli
+
+    args = cli.parse_args(["--node-name", "n"])
+    assert args.metrics_addr == "127.0.0.1"  # loopback default
+    blocker = AgentMetrics(registry=CollectorRegistry())
+    blocker.serve(0)
+    try:
+        metrics = AgentMetrics(registry=CollectorRegistry())
+        try:
+            metrics.serve(blocker.http_port, addr=args.metrics_addr)
+            raised = False
+        except MetricsServerError:
+            raised = True
+        assert raised, "conflicting bind must raise the typed error"
+    finally:
+        blocker.close()
+
+
+def test_agent_metrics_twice_on_fresh_registries():
+    """Duplicate-metric-name regression tripwire (the `make verify`
+    smoke check): two AgentMetrics on fresh registries must coexist."""
+    a = AgentMetrics(registry=CollectorRegistry())
+    b = AgentMetrics(registry=CollectorRegistry())
+    assert a is not b
+
+
+# -- AsyncSink gauges ---------------------------------------------------------
+
+
+def test_sink_internals_exported_as_gauges():
+    reg = CollectorRegistry()
+    m = AgentMetrics(registry=reg)
+    sink = AsyncSink("test-sink", max_failures=2)
+    register_sink_metrics(sink, m)
+
+    def val(name):
+        return reg.get_sample_value(name, {"sink": "test-sink"})
+
+    assert val("elastic_tpu_sink_disabled") == 0.0
+    assert val("elastic_tpu_sink_queue_depth") == 0.0
+    assert val("elastic_tpu_sink_consecutive_failures") == 0.0
+
+    def boom():
+        raise RuntimeError("nope")
+
+    sink.submit(boom)
+    sink.flush()
+    assert val("elastic_tpu_sink_consecutive_failures") == 1.0
+    assert val("elastic_tpu_sink_disabled") == 0.0
+    sink.submit(boom)
+    sink.flush()
+    assert val("elastic_tpu_sink_consecutive_failures") == 2.0
+    assert val("elastic_tpu_sink_disabled") == 1.0
+    assert val("elastic_tpu_sink_queue_depth") == 0.0
+    sink.stop()
+
+
+def test_sink_gauge_registration_survives_metricsless_callers():
+    # None metrics / metrics without register_sink: both must be no-ops
+    sink = AsyncSink("quiet-sink")
+    register_sink_metrics(sink, None)
+    register_sink_metrics(sink, object())
+    sink.stop()
+
+
+# -- flight recorder ----------------------------------------------------------
+
+
+def test_flight_recorder_jsonl_bounded_by_rotation(tmp_path):
+    path = str(tmp_path / "flight.jsonl")
+    rec = FlightRecorder(path=path, trace_id="t1", max_bytes=2000)
+    for i in range(300):
+        rec.record("step", step=i, duration_ms=1.0)
+    rec.close()
+    assert os.path.getsize(path) <= 2000 + 200  # one record of slack
+    assert os.path.exists(path + ".1")
+    assert os.path.getsize(path + ".1") <= 2000 + 200
+    back = load_jsonl(path)
+    assert back, "rotation must keep the newest records readable"
+    assert back[-1]["step"] == 299
+    assert all(r["trace_id"] == "t1" for r in back)
+
+
+def test_step_timer_records_rate_recompiles_and_errors(tmp_path):
+    class FakeJit:
+        def __init__(self):
+            self.size = 0
+
+        def _cache_size(self):
+            return self.size
+
+    jit = FakeJit()
+    rec = FlightRecorder(
+        path=str(tmp_path / "f.jsonl"), trace_id="tid", jit_fns=(jit,)
+    )
+    jit.size = 1  # first step compiles
+    with rec.step(0, tokens=1000):
+        pass
+    with rec.step(1, tokens=1000):
+        jit.size = 3  # mid-loop recompile (x2)
+    with pytest.raises(RuntimeError):
+        with rec.step(2):
+            raise RuntimeError("step exploded")
+    rec.close()
+    steps = [r for r in load_jsonl(str(tmp_path / "f.jsonl"))
+             if r["kind"] == "step"]
+    assert [s["step"] for s in steps] == [0, 1, 2]
+    assert steps[0]["jit_recompiles"] == 1
+    assert steps[1]["jit_recompiles"] == 2
+    assert steps[0]["tokens_per_s"] > 0
+    assert "RuntimeError" in steps[2]["error"]
+    summary = rec.summary()
+    assert summary["steps"] == 3 and summary["jit_recompiles"] == 3
+    assert summary["trace_id"] == "tid"
+
+
+def test_rotation_failure_never_destroys_records(tmp_path):
+    """If os.replace to <path>.1 fails (here: .1 is a directory), the
+    recorder must keep APPENDING — truncating would destroy the newest
+    records it exists to preserve."""
+    path = tmp_path / "f.jsonl"
+    (tmp_path / "f.jsonl.1").mkdir()  # blocks rotation
+    rec = FlightRecorder(path=str(path), trace_id="t", max_bytes=500)
+    for i in range(100):
+        rec.record("step", step=i, duration_ms=1.0)
+    rec.close()
+    lines = [json.loads(l) for l in path.read_text().splitlines()]
+    assert len(lines) == 100, "rotation failure must not drop records"
+    assert lines[0]["step"] == 0 and lines[-1]["step"] == 99
+
+
+def test_flight_recorder_survives_unwritable_path(tmp_path):
+    blocker = tmp_path / "blocker"
+    blocker.write_text("a file where a directory is needed")
+    rec = FlightRecorder(
+        path=str(blocker / "sub" / "f.jsonl"), trace_id="t"
+    )
+    # the open failed (ENOTDIR) but recording must not raise
+    rec.record("step", step=0)
+    assert rec.records[-1]["step"] == 0
+    assert rec.written == 0
+    rec.close()
+
+
+def test_serving_engine_emits_flight_records():
+    """ServingEngine(recorder=...) tags admits and decode steps."""
+    jax = pytest.importorskip("jax")  # noqa: F841 - hermetic CPU jax
+    from elastic_tpu_agent.workloads.serving import ServingEngine
+    from elastic_tpu_agent.workloads.transformer import (
+        ModelConfig,
+        init_params,
+    )
+
+    cfg = ModelConfig(
+        vocab=64, d_model=16, n_heads=2, n_layers=1, d_ff=32, max_seq=64
+    )
+    params = init_params(cfg, jax.random.key(0))
+    rec = FlightRecorder(trace_id="serve-tid")
+    eng = ServingEngine(
+        params, cfg, slots=2, max_len=32, prompt_buckets=(8,),
+        recorder=rec,
+    )
+    rid = eng.admit([1, 2, 3])
+    eng.step()
+    eng.step()
+    eng.release(rid)
+    kinds = [r["kind"] for r in rec.records]
+    assert kinds.count("serving_admit") == 1
+    assert kinds.count("serving_step") == 2
+    step_rec = [r for r in rec.records if r["kind"] == "serving_step"][0]
+    assert step_rec["trace_id"] == "serve-tid"
+    assert step_rec["emitted_tokens"] == 1
+    assert step_rec["live_requests"] == 1
+    assert step_rec["used_blocks"] >= 1
