@@ -5,7 +5,7 @@ TAG ?= elastic-tpu-agent:latest
 # verify's tier-1 line uses pipefail, which /bin/sh (dash) lacks
 SHELL := /bin/bash
 
-.PHONY: all native sanitize test test-all verify protos image bench clean
+.PHONY: all native sanitize test test-all verify doctor-smoke protos image bench clean
 
 all: native test
 
@@ -31,8 +31,21 @@ test-all: native
 # before a scrape ever hits the endpoint). T1_TIMEOUT: the ROADMAP
 # budget by default; raise it on boxes slower than the reference
 # (`make verify T1_TIMEOUT=1800`).
+# node-doctor smoke: generate a diagnostics bundle against the stub
+# operator in a scratch dir, then schema-validate it — catches a broken
+# doctor/bundle path at build time, before support ever needs one.
+doctor-smoke:
+	@tmp=$$(mktemp -d) && \
+	  python -m elastic_tpu_agent.cli node-doctor \
+	    --operator stub:v5litepod-4 --node-name smoke \
+	    --dev-root $$tmp/dev --db-file $$tmp/meta.db \
+	    --alloc-spec-dir $$tmp/alloc --samples 2 --interval 0 \
+	    > $$tmp/bundle.json && \
+	  python -m elastic_tpu_agent.cli node-doctor --validate $$tmp/bundle.json && \
+	  rm -rf $$tmp && echo "doctor smoke: OK"
+
 T1_TIMEOUT ?= 870
-verify:
+verify: doctor-smoke
 	python -c "from prometheus_client import CollectorRegistry; \
 	  from elastic_tpu_agent.metrics import AgentMetrics; \
 	  AgentMetrics(registry=CollectorRegistry()); \

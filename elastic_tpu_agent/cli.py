@@ -1,4 +1,4 @@
-"""Agent process entry point.
+"""Agent process entry point + the node-doctor diagnostics subcommand.
 
 Capability parity with ``cmd/main.go`` (SURVEY.md §1 L1): flags -> manager
 -> run -> block on exit signals, with a SIGUSR1 stack-dump side channel.
@@ -8,14 +8,22 @@ factory) is not replicated: defaults here are runnable.
 Usage:
     python -m elastic_tpu_agent.cli --node-name $NODE_NAME \
         --db-file /host/var/lib/elastic-tpu/meta.db --operator tpuvm
+
+    # one-shot diagnostics bundle for support escalation
+    python -m elastic_tpu_agent.cli node-doctor \
+        --agent-url http://127.0.0.1:9478 > bundle.json
+    python -m elastic_tpu_agent.cli node-doctor --validate bundle.json
 """
 
 from __future__ import annotations
 
 import argparse
+import json
 import logging
+import os
 import sys
 import threading
+import time
 
 from .common import install_dump_signal, wait_for_exit_signal
 from .manager import ManagerOptions, TPUManager
@@ -79,6 +87,11 @@ def parse_args(argv=None) -> argparse.Namespace:
                         "(default loopback; set 0.0.0.0 to allow "
                         "off-host Prometheus scrapes, as the shipped "
                         "DaemonSet does)")
+    p.add_argument("--sampler-period", type=float, default=10.0,
+                   help="seconds between utilization/health samples "
+                        "(sampler.py)")
+    p.add_argument("--no-sampler", action="store_true",
+                   help="disable the utilization & health sampler")
     p.add_argument("--no-events", action="store_true",
                    help="disable k8s Event emission (e.g. RBAC without "
                         "events:create)")
@@ -94,7 +107,128 @@ def parse_args(argv=None) -> argparse.Namespace:
     return args
 
 
+# -- node-doctor --------------------------------------------------------------
+
+
+def parse_doctor_args(argv=None) -> argparse.Namespace:
+    p = argparse.ArgumentParser(
+        prog="elastic-tpu-agent node-doctor",
+        description="Snapshot devices, health, error counters, "
+                    "allocations, sampler windows and recent traces into "
+                    "one JSON diagnostics bundle (stdout).",
+    )
+    p.add_argument("--node-name", default="", help="node name for the bundle")
+    p.add_argument(
+        "--operator", default="tpuvm",
+        help="device operator to inspect: tpuvm | stub[:<type>] | "
+             "exclusive[:<inner>]",
+    )
+    p.add_argument("--dev-root", default="/host/dev", help="host /dev mount")
+    p.add_argument(
+        "--db-file", default="/host/var/lib/elastic-tpu/meta.db",
+        help="checkpoint db to read allocations from (skipped if absent)",
+    )
+    p.add_argument(
+        "--alloc-spec-dir", default="/host/var/lib/elastic-tpu/alloc",
+        help="alloc-spec dir (trace-id correlation)",
+    )
+    p.add_argument(
+        "--agent-url", default="",
+        help="base URL of a running agent's observability endpoint "
+             "(e.g. http://127.0.0.1:9478) to include live traces and "
+             "the live allocation table",
+    )
+    p.add_argument(
+        "--samples", type=int, default=3,
+        help="utilization samples to take before bundling",
+    )
+    p.add_argument(
+        "--interval", type=float, default=1.0,
+        help="seconds between samples",
+    )
+    p.add_argument(
+        "--trace-limit", type=int, default=50,
+        help="max traces pulled into the bundle",
+    )
+    p.add_argument(
+        "--validate", default="", metavar="BUNDLE_JSON",
+        help="validate an existing bundle file against the schema and "
+             "exit (no snapshot is taken)",
+    )
+    return p.parse_args(argv)
+
+
+def doctor_main(argv=None) -> int:
+    from .sampler import (
+        UtilizationSampler,
+        build_diagnostics_bundle,
+        validate_bundle,
+    )
+
+    args = parse_doctor_args(argv)
+    # Keep stdout pure JSON — everything else goes to stderr.
+    logging.basicConfig(
+        level=logging.WARNING,
+        format="%(levelname).1s %(name)s %(message)s",
+        stream=sys.stderr,
+    )
+    if args.validate:
+        try:
+            with open(args.validate) as f:
+                bundle = json.load(f)
+        except (OSError, ValueError) as e:
+            print(f"cannot read bundle {args.validate}: {e}", file=sys.stderr)
+            return 1
+        problems = validate_bundle(bundle)
+        if problems:
+            for problem in problems:
+                print(f"INVALID: {problem}", file=sys.stderr)
+            return 1
+        print(f"bundle {args.validate} is valid", file=sys.stderr)
+        return 0
+
+    from .manager import build_operator
+
+    operator = build_operator(
+        ManagerOptions(operator_kind=args.operator, dev_root=args.dev_root)
+    )
+    storage = None
+    if os.path.exists(args.db_file):
+        from .storage import Storage
+
+        storage = Storage(args.db_file)
+    sampler = UtilizationSampler(
+        operator,
+        storage=storage,
+        alloc_spec_dir=args.alloc_spec_dir,
+        period_s=max(args.interval, 0.0),
+    )
+    for i in range(max(1, args.samples)):
+        sampler.sample_once()
+        if i + 1 < max(1, args.samples) and args.interval > 0:
+            time.sleep(args.interval)
+    from .tracing import get_tracer
+
+    bundle = build_diagnostics_bundle(
+        operator,
+        sampler=sampler,
+        tracer=None if args.agent_url else get_tracer(),
+        node_name=args.node_name,
+        agent_url=args.agent_url,
+        trace_limit=args.trace_limit,
+    )
+    if storage is not None:
+        storage.close()
+    json.dump(bundle, sys.stdout, indent=2, sort_keys=True)
+    sys.stdout.write("\n")
+    return 0
+
+
 def main(argv=None) -> int:
+    if argv is None:
+        argv = sys.argv[1:]
+    if argv and argv[0] == "node-doctor":
+        return doctor_main(argv[1:])
     args = parse_args(argv)
     logging.basicConfig(
         level=logging.DEBUG if args.verbose else logging.INFO,
@@ -135,6 +269,8 @@ def main(argv=None) -> int:
             metrics=metrics,
             enable_events=not args.no_events,
             enable_crd=not args.no_crd,
+            enable_sampler=not args.no_sampler,
+            sampler_period_s=args.sampler_period,
         )
     )
     run_thread = threading.Thread(

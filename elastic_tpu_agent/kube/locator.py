@@ -184,6 +184,20 @@ class KubeletDeviceLocator(DeviceLocator):
         with self._lock:
             self._cache = {}
 
+    def stats(self) -> Dict[str, object]:
+        """Cache introspection for the debug/diagnostics surfaces
+        (/debug/allocations, node-doctor): is the hash index warm, how
+        many device sets it holds, and whether a refresh is in flight."""
+        with self._lock:
+            return {
+                "resource": self._resource,
+                "cache_entries": len(self._cache),
+                "installed_seq": self._installed_seq,
+                "refresh_seq": self._refresh_seq,
+                "refreshing": self._refreshing,
+                "prefetch_pending": self._prefetch_wake.is_set(),
+            }
+
     def prefetch_async(self) -> None:
         """Refresh the hash index in the background.
 

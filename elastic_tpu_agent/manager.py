@@ -68,6 +68,11 @@ class ManagerOptions:
     # eviction is the only in-band recovery; kubelet restarts the pod
     # onto healthy chips.
     nri_evict_on_chip_failure: bool = False
+    # Utilization & health sampler (sampler.py): per-chip duty-cycle/HBM
+    # sampling joined against the allocation store, exported via metrics,
+    # /debug/allocations and node-doctor.
+    enable_sampler: bool = True
+    sampler_period_s: float = 10.0
     # test seams
     kube_client: Optional[KubeClient] = None
     operator: object = None
@@ -157,6 +162,21 @@ class TPUManager:
             self.events = build_event_recorder(
                 self.client, opts.node_name, metrics=self.metrics
             )
+        self.sampler = None
+        if opts.enable_sampler:
+            from .sampler import UtilizationSampler
+
+            self.sampler = UtilizationSampler(
+                self.operator,
+                storage=self.storage,
+                metrics=self.metrics,
+                alloc_spec_dir=opts.alloc_spec_dir,
+                period_s=opts.sampler_period_s,
+            )
+            if self.metrics is not None and hasattr(
+                self.metrics, "attach_sampler"
+            ):
+                self.metrics.attach_sampler(self.sampler)
         pr_client = rpc.PodResourcesClient(opts.pod_resources_socket)
         self.pr_client = pr_client
         self.config = PluginConfig(
@@ -170,11 +190,19 @@ class TPUManager:
             metrics=self.metrics,
             crd_recorder=self.crd_recorder,
             events=self.events,
+            sampler=self.sampler,
             extra={"alloc_spec_dir": opts.alloc_spec_dir, **opts.extra},
         )
         from .plugins.base import plugin_factory
 
         self.plugin = plugin_factory(opts.plugin_kind, self.config)
+        if self.sampler is not None and hasattr(self.plugin, "locator_stats"):
+            self.sampler.locator_stats_fn = self.plugin.locator_stats
+        if self.sampler is not None and hasattr(self.plugin, "core"):
+            # Snapshot health from the plugin's applied view, not a fresh
+            # operator probe — debug HTTP threads must not race the
+            # health poller through TPUVMOperator's unsynchronized state.
+            self.sampler.unhealthy_view_fn = self.plugin.core.unhealthy_chips
         self.nri_plugin = None
         if opts.nri_socket:
             from .nri import NRIPlugin
@@ -465,6 +493,8 @@ class TPUManager:
         self._gc_thread = self.plugin.start_gc(self.gc_queue, self._stop)
         if hasattr(self.plugin, "start_health"):
             self._health_thread = self.plugin.start_health(self._stop)
+        if self.sampler is not None:
+            self._sampler_thread = self.sampler.start(self._stop)
         if self.nri_plugin is not None:
             self._nri_thread = self.nri_plugin.start(self._stop)
         threading.Thread(
@@ -487,6 +517,9 @@ class TPUManager:
         health_thread = getattr(self, "_health_thread", None)
         if health_thread is not None:
             health_thread.join(timeout=10.0)
+        sampler_thread = getattr(self, "_sampler_thread", None)
+        if sampler_thread is not None:
+            sampler_thread.join(timeout=10.0)
         if self.nri_plugin is not None:
             self.nri_plugin.stop()
         if hasattr(self.plugin, "core"):

@@ -5,13 +5,22 @@ never recorded). BASELINE.md's north-star metric is Allocate() p50 latency
 plus chip utilization, so both are first-class here.
 
 One HTTP server (replacing prometheus_client's bare start_http_server)
-serves three paths:
+serves four paths:
 
 - ``/metrics``  — Prometheus scrape, names unchanged;
 - ``/debug/traces`` — JSON dump of the allocation-trace ring buffer
   (tracing.py), newest first; ``?pod=<ns/name|name>`` filters,
   ``?limit=N`` caps;
+- ``/debug/allocations`` — the live chip->pod binding table with
+  per-pod granted vs used core percent, chip health, and last trace
+  id, straight from the utilization sampler (sampler.py; 503 until a
+  sampler is attached);
 - ``/healthz`` — liveness: 200 + a small JSON status.
+
+Per-pod labeled gauges go through a cardinality guard
+(BoundedLabeledGauge): pods churn, and without an eviction bound every
+pod that ever ran on the node would leave a live series in the
+registry forever.
 
 The server binds loopback by default (``--metrics-addr`` widens it) and
 a port conflict raises MetricsServerError with an actionable message
@@ -23,6 +32,7 @@ from __future__ import annotations
 import json
 import logging
 import threading
+from collections import OrderedDict
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 from urllib.parse import parse_qs, urlparse
@@ -51,8 +61,65 @@ class MetricsServerError(RuntimeError):
     catch exactly this and keep the agent running without the endpoint."""
 
 
+# Distinct pod label sets kept per pod-labeled gauge. Sized for a busy
+# node (kubelet caps ~a few hundred pods); beyond it the OLDEST-touched
+# series is evicted, so live pods always win over churned ones.
+DEFAULT_MAX_POD_SERIES = 512
+
+
+class BoundedLabeledGauge:
+    """Cardinality guard around a labeled Gauge: at most ``max_series``
+    distinct label sets, evicting the least-recently-set. Each set()
+    refreshes its series' recency, so only series nothing updates any
+    more (churned pods) age out."""
+
+    def __init__(self, gauge, max_series: int, evicted=None) -> None:
+        self._gauge = gauge
+        self._max = max(1, max_series)
+        self._evicted = evicted  # optional Counter
+        self._lock = threading.Lock()
+        self._series: "OrderedDict[tuple, None]" = OrderedDict()
+
+    def _key(self, labels: dict) -> tuple:
+        return tuple(labels[name] for name in self._gauge._labelnames)
+
+    def set(self, value: float, **labels) -> None:
+        key = self._key(labels)
+        evicted = []
+        with self._lock:
+            self._series[key] = None
+            self._series.move_to_end(key)
+            while len(self._series) > self._max:
+                old, _ = self._series.popitem(last=False)
+                evicted.append(old)
+        self._gauge.labels(**labels).set(value)
+        for old in evicted:
+            try:
+                self._gauge.remove(*old)
+            except KeyError:
+                pass
+            if self._evicted is not None:
+                self._evicted.inc()
+
+    def remove(self, **labels) -> None:
+        key = self._key(labels)
+        with self._lock:
+            self._series.pop(key, None)
+        try:
+            self._gauge.remove(*key)
+        except KeyError:
+            pass
+
+    @property
+    def series_count(self) -> int:
+        with self._lock:
+            return len(self._series)
+
+
 class AgentMetrics:
-    def __init__(self, registry=None) -> None:
+    def __init__(
+        self, registry=None, max_pod_series: int = DEFAULT_MAX_POD_SERIES
+    ) -> None:
         self._registry = registry if registry is not None else REGISTRY
         kw = {"registry": registry} if registry is not None else {}
         self.allocate_latency = Histogram(
@@ -125,7 +192,58 @@ class AgentMetrics:
             ["sink"],
             **kw,
         )
+        # -- utilization & health accounting (sampler.py) -----------------
+        self.chip_duty_cycle = Gauge(
+            "elastic_tpu_chip_duty_cycle_percent",
+            "Last sampled per-chip duty cycle (0-100)",
+            ["chip"],
+            **kw,
+        )
+        self.chip_hbm_used = Gauge(
+            "elastic_tpu_chip_hbm_used_bytes",
+            "Last sampled per-chip HBM usage",
+            ["chip"],
+            **kw,
+        )
+        self.series_evicted = Counter(
+            "elastic_tpu_metric_series_evicted_total",
+            "Labeled metric series evicted by the cardinality guard",
+            **kw,
+        )
+        self.pod_core_granted = BoundedLabeledGauge(
+            Gauge(
+                "elastic_tpu_pod_core_granted_percent",
+                "Fractional tpu-core percent granted to a pod",
+                ["pod"],
+                **kw,
+            ),
+            max_series=max_pod_series,
+            evicted=self.series_evicted,
+        )
+        self.pod_core_used = BoundedLabeledGauge(
+            Gauge(
+                "elastic_tpu_pod_core_used_percent",
+                "Sampler-attributed tpu-core percent a pod is using",
+                ["pod"],
+                **kw,
+            ),
+            max_series=max_pod_series,
+            evicted=self.series_evicted,
+        )
+        self.overcommit_detected = Counter(
+            "elastic_tpu_overcommit_detected_total",
+            "Sustained-overcommit episodes: a pod's attributed core usage "
+            "stayed above its fractional grant",
+            **kw,
+        )
+        self._sampler = None
         self._httpd: Optional[ThreadingHTTPServer] = None
+
+    def attach_sampler(self, sampler) -> None:
+        """Point /debug/allocations at a live UtilizationSampler. Late
+        attachment is deliberate: the endpoint starts before the manager
+        (cli.py) and answers 503 until the sampler exists."""
+        self._sampler = sampler
 
     def register_sink(self, sink) -> None:
         """Export a live AsyncSink's internals as gauges. Uses
@@ -164,6 +282,7 @@ class AgentMetrics:
 
             tracer = get_tracer()
         registry = self._registry
+        agent_metrics = self
 
         class Handler(BaseHTTPRequestHandler):
             def log_message(self, fmt, *args):  # noqa: A003 - silence stderr
@@ -182,6 +301,24 @@ class AgentMetrics:
                     json.dumps(payload).encode(),
                 )
 
+            def _require_loopback(self) -> bool:
+                # Debug dumps stay node-local even when the bind is
+                # widened for Prometheus (--metrics-addr 0.0.0.0 on
+                # hostNetwork): they name every pod/chip/device on the
+                # node — not for cross-tenant eyes. Reach them via the
+                # node shell or kubectl port-forward.
+                parsed = urlparse(self.path)
+                if self.client_address[0] in (
+                    "127.0.0.1", "::1", "::ffff:127.0.0.1",
+                ):
+                    return True
+                self._reply_json(
+                    {"error": f"{parsed.path} is served to "
+                              "loopback clients only"},
+                    code=403,
+                )
+                return False
+
             def do_GET(self):  # noqa: N802
                 try:
                     parsed = urlparse(self.path)
@@ -191,20 +328,7 @@ class AgentMetrics:
                             generate_latest(registry),
                         )
                     elif parsed.path == "/debug/traces":
-                        # Debug dumps stay node-local even when the bind
-                        # is widened for Prometheus (--metrics-addr
-                        # 0.0.0.0 on hostNetwork): traces name every
-                        # pod/chip/device on the node — not for
-                        # cross-tenant eyes. Reach it via the node shell
-                        # or kubectl port-forward.
-                        if self.client_address[0] not in (
-                            "127.0.0.1", "::1", "::ffff:127.0.0.1",
-                        ):
-                            self._reply_json(
-                                {"error": "/debug/traces is served to "
-                                          "loopback clients only"},
-                                code=403,
-                            )
+                        if not self._require_loopback():
                             return
                         q = parse_qs(parsed.query)
                         pod = q.get("pod", [None])[0]
@@ -223,16 +347,34 @@ class AgentMetrics:
                             "completed_total": tracer.completed,
                             "capacity": tracer.capacity,
                         })
+                    elif parsed.path == "/debug/allocations":
+                        if not self._require_loopback():
+                            return
+                        sampler = agent_metrics._sampler
+                        if sampler is None:
+                            self._reply_json(
+                                {"error": "utilization sampler not "
+                                          "attached (agent starting, or "
+                                          "sampling disabled)"},
+                                code=503,
+                            )
+                            return
+                        self._reply_json(sampler.allocations_snapshot())
                     elif parsed.path == "/healthz":
-                        self._reply_json({
+                        status = {
                             "status": "ok",
                             "traces_completed": tracer.completed,
-                        })
+                        }
+                        if agent_metrics._sampler is not None:
+                            status["sampler_samples"] = (
+                                agent_metrics._sampler.samples_total
+                            )
+                        self._reply_json(status)
                     else:
                         self._reply_json(
                             {"error": f"no such path {parsed.path}",
                              "paths": ["/metrics", "/debug/traces",
-                                       "/healthz"]},
+                                       "/debug/allocations", "/healthz"]},
                             code=404,
                         )
                 except BrokenPipeError:  # client went away mid-reply
@@ -261,7 +403,7 @@ class AgentMetrics:
         self._httpd = httpd
         logger.info(
             "observability endpoint on %s:%d "
-            "(/metrics /debug/traces /healthz)",
+            "(/metrics /debug/traces /debug/allocations /healthz)",
             addr, httpd.server_address[1],
         )
         return httpd

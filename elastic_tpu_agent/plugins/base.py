@@ -47,6 +47,10 @@ class PluginConfig:
     # Optional k8s Event emitter (kube.events.EventRecorder); same
     # fire-and-forget contract.
     events: object = None
+    # Optional UtilizationSampler (sampler.py): its chip-health view is
+    # folded into the health poll so a chip whose telemetry is failing
+    # degrades to Unhealthy in the ListAndWatch stream.
+    sampler: object = None
     extra: dict = field(default_factory=dict)
 
 

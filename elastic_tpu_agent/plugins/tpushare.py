@@ -309,6 +309,13 @@ class _TPUSharePluginBase(_ListAndWatchMixin, rpc.DevicePluginServicer):
         cross-check must not depend on private state)."""
         return set(self._unhealthy_chips)
 
+    def locator_stats(self) -> Dict:
+        """Locator cache introspection for /debug/allocations and the
+        node-doctor bundle (empty when the locator has no stats)."""
+        if hasattr(self._locator, "stats"):
+            return self._locator.stats()
+        return {}
+
     def _chip_health(self, chip_index: int) -> str:
         return (
             rpc.UNHEALTHY if chip_index in self._unhealthy_chips
@@ -970,6 +977,13 @@ class TPUSharePlugin:
             ),
         ]
 
+    def locator_stats(self) -> Dict[str, Dict]:
+        """Per-resource locator cache stats (debug/diagnostics surface)."""
+        return {
+            ResourceTPUCore: self.core.locator_stats(),
+            ResourceTPUMemory: self.memory.locator_stats(),
+        }
+
     def run(self, stop: threading.Event) -> None:
         for server in self.servers:
             server.start(stop)
@@ -989,12 +1003,25 @@ class TPUSharePlugin:
     def health_once(self) -> bool:
         """One health poll: probe the operator ONCE, apply the same view to
         both resources (they must never disagree about a chip), emit events
-        + metrics on transitions. Returns True when anything changed."""
+        + metrics on transitions. The utilization sampler's flags are
+        folded in — a chip whose telemetry reads keep failing is degraded
+        exactly like one the operator reports broken. Returns True when
+        anything changed."""
         try:
-            healthy = self._config.operator.healthy_indexes()
+            healthy = set(self._config.operator.healthy_indexes())
         except Exception:  # noqa: BLE001 - a broken probe must not wedge
             logger.exception("health probe failed")
             return False
+        sampler = self._config.sampler
+        sampler_reasons: Dict[int, str] = {}
+        if sampler is not None:
+            try:
+                flagged = sampler.unhealthy_chips()
+                if flagged:
+                    sampler_reasons = sampler.health_reasons()
+                    healthy -= flagged
+            except Exception:  # noqa: BLE001 - sampler is never load-bearing
+                logger.exception("sampler health view failed")
         went_bad, recovered = self.core.apply_health(healthy)
         self.memory.apply_health(healthy)
         reasons = {}
@@ -1003,6 +1030,10 @@ class TPUSharePlugin:
                 reasons = self._config.operator.health_reasons()
             except Exception:  # noqa: BLE001 - reasons are best-effort
                 reasons = {}
+            # Operator reasons win (they are more specific); the sampler
+            # fills in for chips only it flagged.
+            for idx, why in sampler_reasons.items():
+                reasons.setdefault(idx, why)
         recorder = self._config.crd_recorder
         if recorder is not None:
             # Keep the CRD inventory truthful: a chip that died flips its

@@ -1,0 +1,752 @@
+"""Utilization & health accounting: who is using which chip, right now?
+
+PR 1's tracing answers *what happened* to an allocation; nothing so far
+answers *what is happening* on the chips. The agent advertises
+fractional resources (tpu-core in percent, tpu-memory in MiB) but a
+fractional grant without utilization attribution is an honor system
+nobody can audit (docs/operations.md "honest QoS boundary"). This
+module closes that gap:
+
+- ``UtilizationSampler`` periodically pulls per-chip duty cycle and HBM
+  usage from the operator (``TPUOperator.utilization()`` — sysfs-backed
+  on TPU-VMs, injectable on the stub), joins each sample against the
+  allocation store to attribute usage to pods, and maintains rolling
+  1m/5m windows per chip and per pod.
+- Per-pod *used* core percent is attributed proportionally to each
+  pod's granted share of its chips (TPUs expose no per-process duty
+  counters, so chip-level duty split by grant share is the honest
+  attribution — a sole tenant's used == the chip's duty cycle).
+- A pod whose attributed usage stays above its grant for
+  ``overcommit_sustain_samples`` consecutive samples is a detected
+  **overcommit**: the ``elastic_tpu_overcommit_detected_total`` counter
+  increments once per episode and a structured JSON log record
+  (``"kind": "tpu_overcommit"``, carrying the bind's trace id) is
+  emitted so log pipelines can join it with /debug/traces.
+- A chip whose telemetry read *fails* ``unhealthy_after_failures``
+  times in a row is flagged; the plugin health poll folds that flag
+  into the ListAndWatch stream (tpushare.health_once), so a chip the
+  sampler can no longer read degrades to Unhealthy in kubelet's view.
+
+Everything is observable three ways: labeled Prometheus gauges
+(metrics.py), the live ``/debug/allocations`` table on the agent
+endpoint, and the ``node-doctor`` diagnostics bundle
+(build_diagnostics_bundle / validate_bundle, cli.py).
+
+Like tracing.py, this module is dependency-free and never
+load-bearing: a sampler failure must not affect binding.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+import time
+from collections import deque
+from typing import Callable, Dict, List, Optional
+
+from .common import BytesPerMemoryUnit, ResourceTPUCore, TPUPercentEachChip
+
+logger = logging.getLogger(__name__)
+
+DEFAULT_PERIOD_S = 10.0
+# Rolling windows served per chip and per pod; keys are the public names
+# used in /debug/allocations and the doctor bundle.
+WINDOWS = {"1m": 60.0, "5m": 300.0}
+# A pod is overcommitting when attributed usage exceeds grant by this
+# margin (percentage points) — duty-cycle counters jitter; a pod at
+# 31% of a 30% grant is noise, not theft.
+DEFAULT_OVERCOMMIT_MARGIN = 5.0
+# ... for this many consecutive samples ("sustained").
+DEFAULT_OVERCOMMIT_SUSTAIN = 3
+# Telemetry-read failures before a chip is flagged unhealthy.
+DEFAULT_UNHEALTHY_AFTER_FAILURES = 3
+
+# Window deques are pruned by horizon on write; the maxlen is only a
+# backstop against a clock that never advances.
+_MAX_WINDOW_SAMPLES = 720
+
+
+def _window_stats(samples, horizon_s: float, now: float) -> dict:
+    """{"samples", "mean", "max", "last"} over (ts, value) pairs within
+    ``horizon_s`` of ``now``."""
+    vals = [v for ts, v in samples if now - ts <= horizon_s]
+    if not vals:
+        return {"samples": 0, "mean": None, "max": None, "last": None}
+    return {
+        "samples": len(vals),
+        "mean": round(sum(vals) / len(vals), 3),
+        "max": round(max(vals), 3),
+        "last": round(vals[-1], 3),
+    }
+
+
+class UtilizationSampler:
+    """Continuous per-chip / per-pod utilization accounting daemon."""
+
+    def __init__(
+        self,
+        operator,
+        storage=None,
+        metrics=None,
+        alloc_spec_dir: Optional[str] = None,
+        period_s: float = DEFAULT_PERIOD_S,
+        overcommit_margin_percent: float = DEFAULT_OVERCOMMIT_MARGIN,
+        overcommit_sustain_samples: int = DEFAULT_OVERCOMMIT_SUSTAIN,
+        unhealthy_after_failures: int = DEFAULT_UNHEALTHY_AFTER_FAILURES,
+    ) -> None:
+        self._operator = operator
+        self._storage = storage
+        self._metrics = metrics
+        self._alloc_spec_dir = alloc_spec_dir
+        self.period_s = period_s
+        self.overcommit_margin = overcommit_margin_percent
+        self.overcommit_sustain = max(1, overcommit_sustain_samples)
+        self.unhealthy_after = max(1, unhealthy_after_failures)
+        # Set by the manager once the plugin exists: () -> {resource:
+        # {cache_entries, ...}} — locator cache introspection for the
+        # debug table and the doctor bundle.
+        self.locator_stats_fn: Optional[Callable[[], dict]] = None
+        # Also manager-set: () -> set of unhealthy chip indexes, the
+        # plugin's APPLIED health view. Snapshots must read this (a
+        # plain set copy) instead of re-probing the operator:
+        # TPUVMOperator.healthy_indexes() mutates sticky state with no
+        # lock and is owned by the single health-poll thread — calling
+        # it from ThreadingHTTPServer handler threads would race it.
+        self.unhealthy_view_fn: Optional[Callable[[], set]] = None
+
+        self._lock = threading.Lock()
+        # chip index -> deque[(ts, duty_percent)] / deque[(ts, hbm_bytes)]
+        self._chip_duty: Dict[int, deque] = {}
+        self._chip_hbm: Dict[int, deque] = {}
+        # pod key ("ns/name") -> deque[(ts, used_percent)]
+        self._pod_used: Dict[str, deque] = {}
+        self._fail_streak: Dict[int, int] = {}
+        self._flagged: Dict[int, str] = {}      # chip -> unhealthy reason
+        self._overcommit_streak: Dict[str, int] = {}
+        self._overcommit_active: set = set()
+        self._trace_ids: Dict[str, str] = {}    # alloc hash -> trace id
+        self._last_pods: Dict[str, dict] = {}   # last join, keyed by pod
+        self._last_chips: Dict[int, dict] = {}  # last sample, keyed by chip
+        self._last_sample_ts: Optional[float] = None
+        self.samples_total = 0
+        self.overcommit_episodes = 0
+
+    # -- the periodic loop ----------------------------------------------------
+
+    def start(self, stop: threading.Event) -> threading.Thread:
+        t = threading.Thread(
+            target=self._loop, args=(stop,), daemon=True, name="tpu-sampler"
+        )
+        t.start()
+        return t
+
+    def _loop(self, stop: threading.Event) -> None:
+        while not stop.is_set():
+            try:
+                self.sample_once()
+            except Exception:  # noqa: BLE001 - sampling must never wedge
+                logger.exception("utilization sample failed")
+            if stop.wait(self.period_s):
+                return
+
+    # -- one sample -----------------------------------------------------------
+
+    def sample_once(self, now: Optional[float] = None) -> dict:
+        """Take one sample; returns the join result (also kept for
+        snapshot/debug readers). ``now`` is a test seam."""
+        now = time.time() if now is None else now
+        try:
+            util = self._operator.utilization() or {}
+        except Exception as e:  # noqa: BLE001 - backend failure != crash
+            logger.warning("operator utilization read failed: %s", e)
+            util = {}
+        try:
+            chips = {c.index: c for c in self._operator.devices()}
+        except Exception:  # noqa: BLE001
+            chips = {}
+        grants = self._join_allocations()
+        with self._lock:
+            self._record_chip_samples(util, chips, now)
+            self._attribute_pods(util, grants, now)
+            self._last_pods = grants
+            self._last_sample_ts = now
+            self.samples_total += 1
+        self._export_metrics(util, grants)
+        return {"chips": dict(self._last_chips), "pods": grants}
+
+    def _record_chip_samples(self, util: dict, chips: dict, now: float) -> None:
+        """(lock held) Fold the raw backend samples into the chip windows
+        and the telemetry-failure streaks."""
+        self._last_chips = {}
+        for idx in chips:
+            entry = util.get(idx)
+            if entry is None:
+                # No telemetry for this chip (backend unsupported or
+                # silent): not a failure signal — never flag on absence,
+                # and RELEASE any standing flag (a driver reload that
+                # removes the telemetry file must not leave the chip
+                # Unhealthy until agent restart).
+                self._fail_streak.pop(idx, None)
+                if self._flagged.pop(idx, None) is not None:
+                    logger.info(
+                        "chip %d: telemetry gone; clearing sampler "
+                        "health flag", idx,
+                    )
+                continue
+            if entry.get("error"):
+                streak = self._fail_streak.get(idx, 0) + 1
+                self._fail_streak[idx] = streak
+                if streak >= self.unhealthy_after and idx not in self._flagged:
+                    reason = (
+                        f"utilization telemetry failing "
+                        f"({streak} consecutive samples): {entry['error']}"
+                    )
+                    self._flagged[idx] = reason
+                    logger.warning("chip %d: %s", idx, reason)
+                self._last_chips[idx] = {"error": entry["error"]}
+                continue
+            if self._fail_streak.pop(idx, 0) and idx in self._flagged:
+                logger.info(
+                    "chip %d: utilization telemetry recovered", idx
+                )
+            self._flagged.pop(idx, None)
+            duty = float(entry.get("duty_cycle_percent", 0.0))
+            hbm = int(entry.get("hbm_used_bytes", 0))
+            self._chip_duty.setdefault(
+                idx, deque(maxlen=_MAX_WINDOW_SAMPLES)
+            ).append((now, duty))
+            self._chip_hbm.setdefault(
+                idx, deque(maxlen=_MAX_WINDOW_SAMPLES)
+            ).append((now, hbm))
+            self._prune(self._chip_duty[idx], now)
+            self._prune(self._chip_hbm[idx], now)
+            self._last_chips[idx] = {
+                "duty_cycle_percent": duty, "hbm_used_bytes": hbm,
+            }
+
+    @staticmethod
+    def _prune(samples: deque, now: float) -> None:
+        horizon = max(WINDOWS.values())
+        while samples and now - samples[0][0] > horizon:
+            samples.popleft()
+
+    # -- allocation join ------------------------------------------------------
+
+    def _join_allocations(self) -> Dict[str, dict]:
+        """Snapshot the allocation store into
+        pod key -> {containers, chips: {chip: core grant %}, granted_percent,
+        hbm_granted_bytes, resources, hashes, last_trace_id}."""
+        out: Dict[str, dict] = {}
+        if self._storage is None:
+            return out
+        whole_chip = not getattr(self._operator, "virtual_nodes", True)
+        try:
+            items = list(self._storage.items())
+        except Exception:  # noqa: BLE001 - storage trouble is not ours
+            logger.exception("sampler: allocation-store snapshot failed")
+            return out
+        for key, info in items:
+            pod = out.setdefault(key, {
+                "containers": [], "chips": {}, "granted_percent": 0.0,
+                "hbm_granted_bytes": 0, "resources": [], "hashes": [],
+                "last_trace_id": "",
+            })
+            for container, by_resource in info.allocations.items():
+                if container not in pod["containers"]:
+                    pod["containers"].append(container)
+                for resource, rec in by_resource.items():
+                    if resource not in pod["resources"]:
+                        pod["resources"].append(resource)
+                    pod["hashes"].append(rec.device.hash)
+                    trace_id = self._trace_id_for(rec.device.hash)
+                    if trace_id:
+                        pod["last_trace_id"] = trace_id
+                    if resource == ResourceTPUCore:
+                        if whole_chip:
+                            granted = TPUPercentEachChip * max(
+                                1, len(rec.chip_indexes)
+                            )
+                        else:
+                            granted = float(len(rec.device.ids))
+                        pod["granted_percent"] += granted
+                        n = max(1, len(rec.chip_indexes))
+                        for chip in rec.chip_indexes:
+                            pod["chips"][chip] = (
+                                pod["chips"].get(chip, 0.0) + granted / n
+                            )
+                    else:
+                        pod["hbm_granted_bytes"] += (
+                            len(rec.device.ids) * BytesPerMemoryUnit
+                        )
+                        for chip in rec.chip_indexes:
+                            pod["chips"].setdefault(chip, 0.0)
+        return out
+
+    def _trace_id_for(self, alloc_hash: str) -> str:
+        """The trace id of the bind that produced this allocation, read
+        (once) from its alloc-spec env — the same id that names the
+        /debug/traces entry and the pod's TPUBound event."""
+        if alloc_hash in self._trace_ids:
+            return self._trace_ids[alloc_hash]
+        trace_id = ""
+        if self._alloc_spec_dir:
+            path = os.path.join(self._alloc_spec_dir, f"{alloc_hash}.json")
+            try:
+                with open(path) as f:
+                    spec = json.load(f)
+                trace_id = str(
+                    spec.get("env", {}).get("ELASTIC_TPU_TRACE_ID", "")
+                )
+            except (OSError, ValueError):
+                # Spec not written yet (bind in flight): retry next sample.
+                return ""
+        self._trace_ids[alloc_hash] = trace_id
+        return trace_id
+
+    # -- attribution + overcommit ---------------------------------------------
+
+    def _attribute_pods(self, util: dict, grants: dict, now: float) -> None:
+        """(lock held) Split each chip's duty cycle across the pods bound
+        to it, proportionally to their grant share, and run the sustained
+        overcommit detector."""
+        chip_total_grant: Dict[int, float] = {}
+        for pod in grants.values():
+            for chip, share in pod["chips"].items():
+                chip_total_grant[chip] = (
+                    chip_total_grant.get(chip, 0.0) + share
+                )
+        for key, pod in grants.items():
+            used = 0.0
+            covered = False
+            for chip, share in pod["chips"].items():
+                sample = self._last_chips.get(chip)
+                if not sample or "duty_cycle_percent" not in sample:
+                    continue
+                covered = True
+                total = chip_total_grant.get(chip, 0.0)
+                if total > 0:
+                    used += sample["duty_cycle_percent"] * (share / total)
+                elif len(
+                    [p for p in grants.values() if chip in p["chips"]]
+                ) == 1:
+                    # Memory-only sole tenant: the whole duty is its.
+                    used += sample["duty_cycle_percent"]
+            pod["used_percent"] = round(used, 3) if covered else None
+            pod["granted_percent"] = round(pod["granted_percent"], 3)
+            if covered:
+                self._pod_used.setdefault(
+                    key, deque(maxlen=_MAX_WINDOW_SAMPLES)
+                ).append((now, used))
+                self._prune(self._pod_used[key], now)
+                self._detect_overcommit(key, pod, used, now)
+            else:
+                # Coverage lost (telemetry failing/gone): there is no
+                # current evidence, so stop asserting overcommit rather
+                # than freezing a stale flag in /debug/allocations.
+                self._overcommit_streak.pop(key, None)
+                if key in self._overcommit_active:
+                    self._overcommit_active.discard(key)
+                    logger.info(
+                        "pod %s: chip telemetry lost; clearing "
+                        "overcommit flag", key,
+                    )
+            pod["overcommit"] = key in self._overcommit_active
+        # Forget pods that left the store: windows, streaks, metric series.
+        for gone in set(self._pod_used) - set(grants):
+            self._pod_used.pop(gone, None)
+            self._overcommit_streak.pop(gone, None)
+            self._overcommit_active.discard(gone)
+            self._drop_pod_series(gone)
+        live_hashes = {
+            h for pod in grants.values() for h in pod["hashes"]
+        }
+        for stale in set(self._trace_ids) - live_hashes:
+            del self._trace_ids[stale]
+
+    def _detect_overcommit(
+        self, key: str, pod: dict, used: float, now: float
+    ) -> None:
+        granted = pod["granted_percent"]
+        if granted <= 0 or used <= granted + self.overcommit_margin:
+            self._overcommit_streak[key] = 0
+            if key in self._overcommit_active:
+                self._overcommit_active.discard(key)
+                logger.info(
+                    "pod %s back within its core grant "
+                    "(used %.1f%% of %.1f%%)", key, used, granted,
+                )
+            return
+        streak = self._overcommit_streak.get(key, 0) + 1
+        self._overcommit_streak[key] = streak
+        if streak < self.overcommit_sustain or key in self._overcommit_active:
+            return
+        self._overcommit_active.add(key)
+        self.overcommit_episodes += 1
+        if self._metrics is not None and hasattr(
+            self._metrics, "overcommit_detected"
+        ):
+            self._metrics.overcommit_detected.inc()
+        # Structured record (not prose): log pipelines join this with
+        # /debug/traces on trace_id and with the flight recorder's JSONL.
+        logger.warning("%s", json.dumps({
+            "kind": "tpu_overcommit",
+            "ts": now,
+            "pod": key,
+            "granted_core_percent": granted,
+            "used_core_percent": round(used, 3),
+            "chips": sorted(pod["chips"]),
+            "sustained_samples": streak,
+            "trace_id": pod.get("last_trace_id", ""),
+        }, sort_keys=True))
+
+    # -- metrics export -------------------------------------------------------
+
+    def _export_metrics(self, util: dict, grants: dict) -> None:
+        m = self._metrics
+        if m is None:
+            return
+        try:
+            for idx, sample in self._last_chips.items():
+                if "duty_cycle_percent" in sample:
+                    m.chip_duty_cycle.labels(chip=str(idx)).set(
+                        sample["duty_cycle_percent"]
+                    )
+                    m.chip_hbm_used.labels(chip=str(idx)).set(
+                        sample["hbm_used_bytes"]
+                    )
+            for key, pod in grants.items():
+                m.pod_core_granted.set(pod["granted_percent"], pod=key)
+                if pod.get("used_percent") is not None:
+                    m.pod_core_used.set(pod["used_percent"], pod=key)
+        except Exception:  # noqa: BLE001 - metrics must never break sampling
+            logger.exception("sampler metrics export failed")
+
+    def _drop_pod_series(self, key: str) -> None:
+        m = self._metrics
+        if m is None:
+            return
+        for gauge_name in ("pod_core_granted", "pod_core_used"):
+            gauge = getattr(m, gauge_name, None)
+            if gauge is not None and hasattr(gauge, "remove"):
+                try:
+                    gauge.remove(pod=key)
+                except Exception:  # noqa: BLE001 - absent series is fine
+                    pass
+
+    # -- health view (consumed by tpushare.health_once) -----------------------
+
+    def unhealthy_chips(self) -> set:
+        """Chips the sampler currently flags (telemetry failing)."""
+        with self._lock:
+            return set(self._flagged)
+
+    def health_reasons(self) -> Dict[int, str]:
+        with self._lock:
+            return dict(self._flagged)
+
+    # -- read surfaces --------------------------------------------------------
+
+    @property
+    def last_sample_ts(self) -> Optional[float]:
+        with self._lock:
+            return self._last_sample_ts
+
+    def chip_windows(self, now: Optional[float] = None) -> Dict[int, dict]:
+        """chip -> {"1m": stats, "5m": stats} over duty cycle, plus HBM."""
+        now = time.time() if now is None else now
+        with self._lock:
+            out = {}
+            for idx, samples in self._chip_duty.items():
+                out[idx] = {
+                    name: _window_stats(samples, horizon, now)
+                    for name, horizon in WINDOWS.items()
+                }
+                hbm = self._chip_hbm.get(idx)
+                if hbm:
+                    out[idx]["hbm"] = {
+                        name: _window_stats(hbm, horizon, now)
+                        for name, horizon in WINDOWS.items()
+                    }
+            return out
+
+    def pod_windows(self, now: Optional[float] = None) -> Dict[str, dict]:
+        now = time.time() if now is None else now
+        with self._lock:
+            return {
+                key: {
+                    name: _window_stats(samples, horizon, now)
+                    for name, horizon in WINDOWS.items()
+                }
+                for key, samples in self._pod_used.items()
+            }
+
+    def allocations_snapshot(self) -> dict:
+        """The live chip->pod binding table served at /debug/allocations
+        and embedded in the node-doctor bundle."""
+        try:
+            devices = self._operator.devices()
+        except Exception:  # noqa: BLE001
+            devices = []
+        healthy = None
+        if self.unhealthy_view_fn is not None:
+            # Live agent: the plugin's applied view (a set copy — safe
+            # from any thread, and already includes our own flags).
+            try:
+                healthy = (
+                    {c.index for c in devices} - self.unhealthy_view_fn()
+                )
+            except Exception:  # noqa: BLE001
+                healthy = None
+        if healthy is None:
+            # Standalone (node-doctor without a running agent): probe the
+            # operator directly — single-threaded there, so the mutation
+            # inside healthy_indexes() is unshared.
+            try:
+                healthy = set(self._operator.healthy_indexes())
+            except Exception:  # noqa: BLE001
+                healthy = set()
+        try:
+            op_reasons = dict(self._operator.health_reasons())
+        except Exception:  # noqa: BLE001
+            op_reasons = {}
+        # Windows are computed relative to the last sample's clock so a
+        # snapshot taken long after sampling stopped (doctor on a wedged
+        # agent) still shows the final windows instead of empty ones.
+        with self._lock:
+            snapshot_now = self._last_sample_ts
+        pod_windows = self.pod_windows(now=snapshot_now)
+        with self._lock:
+            flagged = dict(self._flagged)
+            pods = {k: dict(v) for k, v in self._last_pods.items()}
+            chips_last = {k: dict(v) for k, v in self._last_chips.items()}
+            last_ts = self._last_sample_ts
+            samples_total = self.samples_total
+        chip_rows: List[dict] = []
+        for chip in devices:
+            idx = chip.index
+            reason = flagged.get(idx) or op_reasons.get(idx)
+            bound = sorted(k for k, p in pods.items() if idx in p["chips"])
+            sample = chips_last.get(idx, {})
+            chip_rows.append({
+                "chip": idx,
+                "healthy": idx in healthy and idx not in flagged,
+                "health_reason": reason,
+                "duty_cycle_percent": sample.get("duty_cycle_percent"),
+                "hbm_used_bytes": sample.get("hbm_used_bytes"),
+                "hbm_total_bytes": chip.hbm_bytes,
+                "granted_core_percent": round(sum(
+                    p["chips"][idx] for p in pods.values()
+                    if idx in p["chips"]
+                ), 3),
+                "pods": bound,
+            })
+        pod_rows: List[dict] = []
+        for key in sorted(pods):
+            pod = pods[key]
+            pod_rows.append({
+                "pod": key,
+                "containers": pod["containers"],
+                "chips": sorted(pod["chips"]),
+                "resources": sorted(pod["resources"]),
+                "granted_core_percent": pod["granted_percent"],
+                "used_core_percent": pod.get("used_percent"),
+                "hbm_granted_bytes": pod["hbm_granted_bytes"],
+                "overcommit": pod.get("overcommit", False),
+                "last_trace_id": pod.get("last_trace_id", ""),
+                "windows": pod_windows.get(key, {}),
+            })
+        out = {
+            "chips": chip_rows,
+            "pods": pod_rows,
+            "sampler": {
+                "period_s": self.period_s,
+                "samples_total": samples_total,
+                "last_sample_ts": last_ts,
+                "overcommit_episodes": self.overcommit_episodes,
+                "overcommit_margin_percent": self.overcommit_margin,
+                "flagged_chips": sorted(flagged),
+            },
+        }
+        if self.locator_stats_fn is not None:
+            try:
+                out["locator"] = self.locator_stats_fn()
+            except Exception:  # noqa: BLE001 - introspection only
+                pass
+        return out
+
+
+# -- node-doctor diagnostics bundle -------------------------------------------
+
+BUNDLE_KIND = "elastic-tpu-node-doctor"
+BUNDLE_VERSION = 1
+
+
+def _fetch_json(url: str, timeout_s: float) -> dict:
+    import urllib.request
+
+    with urllib.request.urlopen(url, timeout=timeout_s) as resp:
+        return json.loads(resp.read())
+
+
+def build_diagnostics_bundle(
+    operator,
+    sampler: Optional[UtilizationSampler] = None,
+    tracer=None,
+    node_name: str = "",
+    agent_url: str = "",
+    trace_limit: int = 50,
+    http_timeout_s: float = 3.0,
+) -> dict:
+    """One JSON document with everything a support escalation needs:
+    devices, health + reasons, raw error counters, the live allocation
+    table with per-pod usage, sampler windows, and recent traces (pulled
+    from the running agent when ``agent_url`` is given, else from the
+    in-process ring)."""
+    try:
+        devices = [
+            {
+                "uuid": c.uuid, "index": c.index,
+                "device_path": c.device_path, "hbm_bytes": c.hbm_bytes,
+                "cores": c.cores, "extra_paths": list(c.extra_paths),
+            }
+            for c in operator.devices()
+        ]
+    except Exception as e:  # noqa: BLE001 - partial bundles beat no bundle
+        devices = []
+        logger.warning("doctor: device enumeration failed: %s", e)
+    try:
+        healthy = sorted(operator.healthy_indexes())
+    except Exception:  # noqa: BLE001
+        healthy = []
+    try:
+        reasons = {
+            str(i): r for i, r in operator.health_reasons().items()
+        }
+    except Exception:  # noqa: BLE001
+        reasons = {}
+    try:
+        counters = {
+            str(i): dict(v) for i, v in operator.error_counters().items()
+        }
+    except Exception:  # noqa: BLE001
+        counters = {}
+    if sampler is not None:
+        for i, r in sampler.health_reasons().items():
+            reasons.setdefault(str(i), r)
+    bundle = {
+        "kind": BUNDLE_KIND,
+        "version": BUNDLE_VERSION,
+        "generated_ts": time.time(),
+        "node": node_name,
+        "devices": devices,
+        "healthy_indexes": healthy,
+        "health_reasons": reasons,
+        "error_counters": counters,
+        "allocations": (
+            sampler.allocations_snapshot() if sampler is not None
+            else {"chips": [], "pods": [], "sampler": {}}
+        ),
+        "sampler_windows": {
+            "chips": {
+                str(i): w for i, w in (
+                    sampler.chip_windows(now=sampler.last_sample_ts)
+                    if sampler is not None else {}
+                ).items()
+            },
+            "pods": (
+                sampler.pod_windows(now=sampler.last_sample_ts)
+                if sampler is not None else {}
+            ),
+        },
+        "traces": [],
+        "agent": {"url": agent_url, "reachable": None},
+    }
+    if agent_url:
+        base = agent_url.rstrip("/")
+        try:
+            payload = _fetch_json(
+                f"{base}/debug/traces?limit={trace_limit}", http_timeout_s
+            )
+            bundle["traces"] = payload.get("traces", [])
+            bundle["agent"]["reachable"] = True
+            try:
+                bundle["agent"]["healthz"] = _fetch_json(
+                    f"{base}/healthz", http_timeout_s
+                )
+                live = _fetch_json(
+                    f"{base}/debug/allocations", http_timeout_s
+                )
+                bundle["agent"]["allocations"] = live
+            except Exception:  # noqa: BLE001 - traces were the hard part
+                pass
+        except Exception as e:  # noqa: BLE001
+            bundle["agent"]["reachable"] = False
+            bundle["agent"]["error"] = str(e)
+    elif tracer is not None:
+        bundle["traces"] = tracer.dump(limit=trace_limit)
+    return bundle
+
+
+def validate_bundle(bundle: dict) -> List[str]:
+    """Schema check for a doctor bundle; returns problems (empty = valid).
+    Consumed by `make doctor-smoke` and by support tooling that refuses
+    malformed escalation attachments."""
+    problems: List[str] = []
+
+    def expect(cond: bool, msg: str) -> None:
+        if not cond:
+            problems.append(msg)
+
+    expect(isinstance(bundle, dict), "bundle is not an object")
+    if not isinstance(bundle, dict):
+        return problems
+    expect(bundle.get("kind") == BUNDLE_KIND,
+           f"kind must be {BUNDLE_KIND!r}, got {bundle.get('kind')!r}")
+    expect(isinstance(bundle.get("version"), int) and bundle["version"] >= 1,
+           "version must be an int >= 1")
+    expect(isinstance(bundle.get("generated_ts"), (int, float)),
+           "generated_ts must be a number")
+    expect(isinstance(bundle.get("node"), str), "node must be a string")
+    devices = bundle.get("devices")
+    expect(isinstance(devices, list), "devices must be a list")
+    for i, dev in enumerate(devices if isinstance(devices, list) else []):
+        if not isinstance(dev, dict):
+            problems.append(f"devices[{i}] must be an object")
+            continue
+        for field in ("index", "device_path", "hbm_bytes", "cores"):
+            expect(field in dev, f"devices[{i}] missing {field!r}")
+    expect(
+        isinstance(bundle.get("healthy_indexes"), list)
+        and all(isinstance(i, int) for i in bundle.get("healthy_indexes", [])),
+        "healthy_indexes must be a list of ints",
+    )
+    for field in ("health_reasons", "error_counters"):
+        expect(isinstance(bundle.get(field), dict),
+               f"{field} must be an object")
+    allocations = bundle.get("allocations")
+    expect(isinstance(allocations, dict), "allocations must be an object")
+    if isinstance(allocations, dict):
+        expect(isinstance(allocations.get("chips"), list),
+               "allocations.chips must be a list")
+        expect(isinstance(allocations.get("pods"), list),
+               "allocations.pods must be a list")
+        for i, pod in enumerate(
+            allocations.get("pods")
+            if isinstance(allocations.get("pods"), list) else []
+        ):
+            if not isinstance(pod, dict):
+                problems.append(f"allocations.pods[{i}] must be an object")
+                continue
+            for field in ("pod", "granted_core_percent", "overcommit"):
+                expect(field in pod, f"allocations.pods[{i}] missing {field!r}")
+    windows = bundle.get("sampler_windows")
+    expect(isinstance(windows, dict), "sampler_windows must be an object")
+    if isinstance(windows, dict):
+        for field in ("chips", "pods"):
+            expect(isinstance(windows.get(field), dict),
+                   f"sampler_windows.{field} must be an object")
+    expect(isinstance(bundle.get("traces"), list), "traces must be a list")
+    expect(isinstance(bundle.get("agent"), dict), "agent must be an object")
+    return problems

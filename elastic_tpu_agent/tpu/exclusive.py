@@ -27,6 +27,13 @@ class ExclusiveOperator(TPUOperator):
         # it — delegate explicitly to keep the inner operator's detail.
         return self._inner.health_reasons()
 
+    def utilization(self) -> dict:
+        # Same base-class-shadowing concern as health_reasons.
+        return self._inner.utilization()
+
+    def error_counters(self) -> dict:
+        return self._inner.error_counters()
+
     def __getattr__(self, name):
         # Forward discovery-adjacent surface (topology, worker_id,
         # worker_hostnames, healthy_indexes, fault-injection seams) so

@@ -86,6 +86,20 @@ class TPUOperator(ABC):
         TPUChipUnhealthy node event. Default: no detail."""
         return {}
 
+    def utilization(self) -> dict:
+        """Per-chip telemetry snapshot for the utilization sampler
+        (sampler.py): {chip index: {"duty_cycle_percent": float,
+        "hbm_used_bytes": int}}, or {"error": str} per chip whose read
+        failed. An empty dict means "this backend has no telemetry" —
+        the sampler then records nothing rather than flagging chips
+        (absence is not failure)."""
+        return {}
+
+    def error_counters(self) -> dict:
+        """Raw error-counter snapshot {chip index: {counter path: value}}
+        for the node-doctor bundle. Default: none."""
+        return {}
+
 
 # -- shared symlink mechanics -------------------------------------------------
 

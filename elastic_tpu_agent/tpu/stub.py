@@ -36,6 +36,7 @@ class StubOperator(LinkingOperator):
         self._worker_id = worker_id
         self._worker_hostnames = list(worker_hostnames or [])
         self._unhealthy: set = set()
+        self._utilization: dict = {}
 
     @property
     def topology(self) -> TopologyInfo:
@@ -56,6 +57,38 @@ class StubOperator(LinkingOperator):
 
     def healthy_indexes(self) -> set:
         return {c.index for c in self.devices()} - self._unhealthy
+
+    # -- utilization telemetry injection (mirrors tpuvm.utilization) ----------
+
+    def set_utilization(
+        self, samples: dict, hbm_used: Optional[dict] = None
+    ) -> None:
+        """Inject per-chip telemetry: ``samples`` maps chip index ->
+        duty-cycle percent, ``hbm_used`` (optional) chip index -> bytes.
+        Chips absent from both report no telemetry (like a tpu-vm host
+        without the sysfs files)."""
+        hbm_used = hbm_used or {}
+        self._utilization = {
+            i: {
+                "duty_cycle_percent": float(duty),
+                "hbm_used_bytes": int(hbm_used.get(i, 0)),
+            }
+            for i, duty in samples.items()
+        }
+
+    def fail_utilization(
+        self, indexes, reason: str = "injected telemetry failure"
+    ) -> None:
+        """Make the telemetry read fail for these chips (the sampler
+        flags a chip unhealthy after a failure streak)."""
+        for i in indexes:
+            self._utilization[i] = {"error": reason}
+
+    def clear_utilization(self) -> None:
+        self._utilization = {}
+
+    def utilization(self) -> dict:
+        return {i: dict(v) for i, v in self._utilization.items()}
 
     def devices(self) -> List[TPUChip]:
         spec = self._topo.spec

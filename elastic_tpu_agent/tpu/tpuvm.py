@@ -54,6 +54,13 @@ _MAINTENANCE_ERROR_BACKOFF_S = 300.0
 _SYS_ACCEL_ROOT = "/sys/class/accel"
 _FATAL_COUNTER_SUBSTRINGS = ("fatal", "uncorrectable")
 
+# sysfs utilization telemetry (sampler.py): the first of these file names
+# found under accelN/ or accelN/device/ supplies each value. Override via
+# ELASTIC_TPU_SYS_DUTY_FILES / ELASTIC_TPU_SYS_HBM_FILES (comma-separated
+# names) for driver stacks exposing different names.
+_DUTY_CYCLE_FILES = ("duty_cycle_percent", "duty_cycle", "usage_percent")
+_HBM_USED_FILES = ("hbm_used_bytes", "mem_used_bytes", "memory_used")
+
 # Conservative fallback when the generation cannot be determined: assume the
 # smallest HBM of any supported generation so fractional tpu-memory is never
 # over-advertised.
@@ -159,6 +166,23 @@ def read_counter_file(path: str) -> Optional[int]:
     return total if matched else None
 
 
+def read_float_file(path: str) -> Optional[float]:
+    """One float (or int, or AER-table) out of a sysfs telemetry file.
+    Drivers report duty cycle as "37" or "37.5"; read_counter_file alone
+    would reject the fractional form and a healthy chip would look like
+    a telemetry failure."""
+    try:
+        with open(path) as f:
+            raw = f.read().strip()
+    except OSError:
+        return None
+    try:
+        return float(raw)
+    except ValueError:
+        value = read_counter_file(path)
+        return float(value) if value is not None else None
+
+
 def parse_tpu_env(raw: str) -> Dict[str, str]:
     """Parse the metadata ``tpu-env`` attribute: lines of KEY: 'value'."""
     out: Dict[str, str] = {}
@@ -205,6 +229,16 @@ class TPUVMOperator(LinkingOperator):
                 "ELASTIC_TPU_SYS_ERROR_PATTERNS", ""
             ).split(",") if p.strip()
         ) or _FATAL_COUNTER_SUBSTRINGS
+        self._duty_files = tuple(
+            p.strip() for p in self._env.get(
+                "ELASTIC_TPU_SYS_DUTY_FILES", ""
+            ).split(",") if p.strip()
+        ) or _DUTY_CYCLE_FILES
+        self._hbm_files = tuple(
+            p.strip() for p in self._env.get(
+                "ELASTIC_TPU_SYS_HBM_FILES", ""
+            ).split(",") if p.strip()
+        ) or _HBM_USED_FILES
         # chip -> {counter path -> baseline value}; a chip whose fatal
         # counter moved past its baseline stays unhealthy (sticky) until
         # agent restart — transient "recovery" of a chip that faulted is
@@ -333,6 +367,19 @@ class TPUVMOperator(LinkingOperator):
             )
         return self._maint_cached not in (None, "", "NONE")
 
+    def _matching_counter_values(self, chip_dir: str):
+        """(name, path, value) for every readable error-counter file under
+        a chip dir matching the configured patterns — the ONE scan both
+        the health fold and the node-doctor snapshot consume, so a
+        discovery fix can never apply to one and not the other."""
+        for root, name in _counter_files(chip_dir):
+            if not any(p in name for p in self._counter_patterns):
+                continue
+            path = os.path.join(root, name)
+            value = read_counter_file(path)
+            if value is not None:
+                yield name, path, value
+
     def _scan_error_counters(self, present: List[int]) -> None:
         """Fold /sys/class/accel/accelN fatal-error counters into the
         sticky error-chip set: the first observation of each counter is its
@@ -343,13 +390,7 @@ class TPUVMOperator(LinkingOperator):
             if not os.path.isdir(chip_dir):
                 continue
             base = self._counter_base.setdefault(i, {})
-            for root, name in _counter_files(chip_dir):
-                if not any(p in name for p in self._counter_patterns):
-                    continue
-                path = os.path.join(root, name)
-                value = read_counter_file(path)
-                if value is None:
-                    continue
+            for name, path, value in self._matching_counter_values(chip_dir):
                 if path not in base:
                     base[path] = value
                 elif value > base[path]:
@@ -401,3 +442,58 @@ class TPUVMOperator(LinkingOperator):
     def health_reasons(self) -> Dict[int, str]:
         """Why each currently-unhealthy chip is unhealthy (best effort)."""
         return dict(self._health_reasons)
+
+    # -- utilization telemetry (sampler.py) -----------------------------------
+
+    def _util_file(self, chip_dir: str, names) -> Optional[str]:
+        """First existing candidate file under accelN/ or accelN/device/."""
+        dev = os.path.join(chip_dir, "device")
+        for name in names:
+            for base in (chip_dir, dev):
+                path = os.path.join(base, name)
+                if os.path.isfile(path) or os.path.islink(path):
+                    return path
+        return None
+
+    def utilization(self) -> Dict[int, dict]:
+        """Per-chip duty cycle / HBM usage from sysfs. A chip with no
+        telemetry files contributes no entry (absence != failure); a chip
+        whose duty file exists but does not parse contributes an error
+        entry — the sampler flags it unhealthy after a streak."""
+        out: Dict[int, dict] = {}
+        for i in self._accel_indexes():
+            chip_dir = os.path.join(self._sys_root, f"accel{i}")
+            if not os.path.isdir(chip_dir):
+                continue
+            duty_path = self._util_file(chip_dir, self._duty_files)
+            if duty_path is None:
+                continue
+            duty = read_float_file(duty_path)
+            if duty is None:
+                out[i] = {"error": f"unreadable telemetry file {duty_path}"}
+                continue
+            entry = {"duty_cycle_percent": duty, "hbm_used_bytes": 0}
+            hbm_path = self._util_file(chip_dir, self._hbm_files)
+            if hbm_path is not None:
+                hbm = read_float_file(hbm_path)
+                if hbm is not None:
+                    entry["hbm_used_bytes"] = int(hbm)
+            out[i] = entry
+        return out
+
+    def error_counters(self) -> Dict[int, Dict[str, int]]:
+        """Current raw values of every matching error-counter file, keyed
+        by chip — the node-doctor snapshot (healthy_indexes folds these
+        into health; this is the unprocessed evidence)."""
+        out: Dict[int, Dict[str, int]] = {}
+        for i in self._accel_indexes():
+            chip_dir = os.path.join(self._sys_root, f"accel{i}")
+            if not os.path.isdir(chip_dir):
+                continue
+            counters = {
+                path: value
+                for _, path, value in self._matching_counter_values(chip_dir)
+            }
+            if counters:
+                out[i] = counters
+        return out

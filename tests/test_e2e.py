@@ -46,7 +46,10 @@ def wait_until(fn, timeout=10.0):
 class Cluster:
     """One fully-wired agent instance with fake control plane around it."""
 
-    def __init__(self, tmp_path, node="node-a", operator_kind="stub:v5litepod-4"):
+    def __init__(
+        self, tmp_path, node="node-a", operator_kind="stub:v5litepod-4",
+        metrics=None,
+    ):
         self.node = node
         self.apiserver = FakeAPIServer()
         url = self.apiserver.start()
@@ -64,6 +67,7 @@ class Cluster:
             pod_resources_socket=str(tmp_path / "pr" / "kubelet.sock"),
             alloc_spec_dir=str(tmp_path / "alloc"),
             kube_client=KubeClient(url),
+            metrics=metrics,
         )
         self.manager = TPUManager(self.opts)
 

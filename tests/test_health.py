@@ -415,6 +415,165 @@ def test_sticky_reason_survives_counter_rebaseline(tmp_path):
     )
 
 
+def test_sampler_flagged_chip_degrades_listandwatch(cluster):
+    """ISSUE 2 acceptance: a chip the utilization sampler flags
+    (telemetry failing) goes Unhealthy on the live ListAndWatch stream
+    and recovers when telemetry comes back — without the operator itself
+    ever reporting it broken."""
+    client = cluster.kubelet.plugin_client(CORE_ENDPOINT)
+    q: "queue.Queue" = queue.Queue()
+    stop = threading.Event()
+    threading.Thread(
+        target=_stream_responses, args=(client, q, stop), daemon=True
+    ).start()
+    first = q.get(timeout=10)
+    assert all(
+        h == {rpc.HEALTHY} for h in _health_by_chip(first).values()
+    )
+
+    sampler = cluster.manager.sampler
+    assert sampler is not None
+    cluster.manager.operator.set_utilization({0: 5.0})
+    cluster.manager.operator.fail_utilization({2}, reason="EIO on sysfs")
+    for _ in range(sampler.unhealthy_after):
+        sampler.sample_once()
+    # the operator's own view stays clean — only the sampler flags
+    assert cluster.manager.operator.healthy_indexes() == {0, 1, 2, 3}
+    assert cluster.manager.plugin.health_once()
+    resp = q.get(timeout=10)
+    by_chip = _health_by_chip(resp)
+    assert by_chip[2] == {rpc.UNHEALTHY}
+    for chip in (0, 1, 3):
+        assert by_chip[chip] == {rpc.HEALTHY}
+    # the node event names the telemetry failure
+    assert cluster.manager.events.flush()
+    bad = [
+        e for e in cluster.apiserver.core_events
+        if e["reason"] == "TPUChipUnhealthy"
+        and e["involvedObject"]["kind"] == "Node"
+    ]
+    assert bad and "EIO on sysfs" in bad[0]["message"]
+
+    # telemetry recovers -> chip re-advertised Healthy
+    cluster.manager.operator.set_utilization({0: 5.0, 2: 5.0})
+    sampler.sample_once()
+    assert cluster.manager.plugin.health_once()
+    resp = q.get(timeout=10)
+    assert all(
+        h == {rpc.HEALTHY} for h in _health_by_chip(resp).values()
+    )
+    stop.set()
+
+
+# -- TPUVMOperator.health_reasons / _maintenance_imminent (satellite) ---------
+
+
+def test_maintenance_poll_respects_ttl(tmp_path):
+    """_maintenance_imminent caches a successful fetch for the poll TTL —
+    the 5s health tick must not hammer the metadata server."""
+    calls = {"n": 0}
+
+    def counting():
+        calls["n"] += 1
+        return "NONE"
+
+    op = _tpuvm_op(tmp_path, maintenance=counting)
+    assert op._maintenance_imminent() is False
+    assert op._maintenance_imminent() is False
+    assert calls["n"] == 1, "fetch not cached within the TTL"
+    op._maint_next_poll = 0.0  # TTL expired
+    assert op._maintenance_imminent() is False
+    assert calls["n"] == 2
+
+
+def test_maintenance_imminent_values(tmp_path):
+    state = {"event": "NONE"}
+    op = _tpuvm_op(tmp_path, maintenance=lambda: state["event"])
+    for value, expected in (
+        ("NONE", False),
+        ("", False),
+        ("MIGRATE_ON_HOST_MAINTENANCE", True),
+        ("TERMINATE_ON_HOST_MAINTENANCE", True),
+    ):
+        state["event"] = value
+        op._maint_next_poll = 0.0
+        assert op._maintenance_imminent() is expected, value
+
+
+def test_health_reasons_device_node_missing_and_recovery(tmp_path):
+    """A chip whose /dev/accelN vanishes gets the 'device node missing'
+    reason; the reason clears when the node returns."""
+    op = _tpuvm_op(tmp_path)
+    scan = tmp_path / "hostdev"
+    assert op.healthy_indexes() == {0, 1, 2, 3}
+    assert op.health_reasons() == {}
+    (scan / "accel2").unlink()
+    assert op.healthy_indexes() == {0, 1, 3}
+    assert op.health_reasons() == {2: "device node missing"}
+    (scan / "accel2").touch()
+    assert op.healthy_indexes() == {0, 1, 2, 3}
+    assert op.health_reasons() == {}
+
+
+def test_health_reasons_degraded_counter_path(tmp_path):
+    """A degraded (risen) fatal counter puts its specific reason in
+    health_reasons; a recovered (reset) counter re-baselines without
+    clearing the sticky reason (VERDICT r3 semantics, asserted through
+    the public surface)."""
+    sys_root = tmp_path / "sysaccel"
+    err_dir = sys_root / "accel1" / "device"
+    err_dir.mkdir(parents=True)
+    fatal = err_dir / "aer_dev_fatal"
+    fatal.write_text("0\n")
+    op = _tpuvm_op(tmp_path, sys_accel_root=str(sys_root))
+    op.healthy_indexes()
+    assert op.health_reasons() == {}
+    fatal.write_text("3\n")  # degraded
+    op.healthy_indexes()
+    reasons = op.health_reasons()
+    assert set(reasons) == {1}
+    assert "aer_dev_fatal" in reasons[1] and "3" in reasons[1]
+    fatal.write_text("0\n")  # "recovered" (driver reload reset)
+    op.healthy_indexes()
+    assert op.health_reasons()[1] == reasons[1], "sticky reason lost"
+    # error_counters snapshot shows the raw current value for the doctor
+    assert list(op.error_counters()[1].values()) == [0]
+
+
+def test_health_reasons_maintenance_covers_all_then_clears(tmp_path):
+    """The maintenance-event path: every present chip carries the event
+    reason while it is announced; clearing the event clears the reasons
+    but keeps any sticky counter-error chip's specific cause."""
+    sys_root = tmp_path / "sysaccel"
+    err_dir = sys_root / "accel0" / "device"
+    err_dir.mkdir(parents=True)
+    fatal = err_dir / "aer_dev_fatal"
+    fatal.write_text("0\n")
+    state = {"event": "NONE"}
+    op = _tpuvm_op(
+        tmp_path, maintenance=lambda: state["event"],
+        sys_accel_root=str(sys_root),
+    )
+    op.healthy_indexes()
+    fatal.write_text("1\n")  # chip 0 degrades before the event
+    op.healthy_indexes()
+    state["event"] = "MIGRATE_ON_HOST_MAINTENANCE"
+    op._maint_next_poll = 0.0
+    assert op.healthy_indexes() == set()
+    reasons = op.health_reasons()
+    assert set(reasons) == {0, 1, 2, 3}
+    for i in (1, 2, 3):
+        assert "MIGRATE_ON_HOST_MAINTENANCE" in reasons[i]
+    # the error chip keeps its SPECIFIC cause through the event
+    assert "aer_dev_fatal" in reasons[0]
+    state["event"] = "NONE"
+    op._maint_next_poll = 0.0
+    assert op.healthy_indexes() == {1, 2, 3}
+    reasons = op.health_reasons()
+    assert set(reasons) == {0}
+    assert "aer_dev_fatal" in reasons[0]
+
+
 def test_sysfs_counter_reset_rebaselines(tmp_path):
     """A driver reload zeroing the counter must re-baseline downward, or
     errors below the stale baseline would be masked forever."""

@@ -1,0 +1,561 @@
+"""Utilization & health accounting layer (sampler.py): grant/usage
+attribution, rolling windows, sustained-overcommit detection, telemetry
+failure -> chip health, the metrics cardinality guard, the sysfs-backed
+tpu-vm telemetry reads, and the node-doctor diagnostics bundle."""
+
+import json
+import os
+
+import pytest
+
+from elastic_tpu_agent.common import (
+    BytesPerMemoryUnit,
+    ResourceTPUCore,
+    ResourceTPUMemory,
+)
+from elastic_tpu_agent.metrics import AgentMetrics, BoundedLabeledGauge
+from elastic_tpu_agent.plugins.tpushare import core_device_id, mem_device_id
+from elastic_tpu_agent.sampler import (
+    UtilizationSampler,
+    build_diagnostics_bundle,
+    validate_bundle,
+)
+from elastic_tpu_agent.storage import Storage
+from elastic_tpu_agent.tpu import ExclusiveOperator, StubOperator
+from elastic_tpu_agent.types import AllocationRecord, Device, PodInfo
+from prometheus_client import CollectorRegistry, generate_latest
+
+
+def bind(storage, name, chip_indexes, units, resource=ResourceTPUCore,
+         namespace="default", container="jax"):
+    """Persist an allocation like PreStartContainer would."""
+    if resource == ResourceTPUCore:
+        ids = [core_device_id(chip_indexes[0], i) for i in range(units)]
+    else:
+        ids = [mem_device_id(chip_indexes[0], i) for i in range(units)]
+    info = storage.load_or_create(namespace, name)
+    info.set_allocation(container, AllocationRecord(
+        device=Device(ids, resource),
+        chip_indexes=list(chip_indexes),
+        created_node_ids=[f"{Device(ids, resource).hash}-{p}"
+                          for p in range(len(chip_indexes))],
+    ))
+    storage.save(info)
+    return Device(ids, resource).hash
+
+
+@pytest.fixture()
+def rig(tmp_path):
+    op = StubOperator(str(tmp_path / "dev"), "v5litepod-4")
+    storage = Storage(str(tmp_path / "meta.db"))
+    sampler = UtilizationSampler(
+        op, storage=storage, alloc_spec_dir=str(tmp_path / "alloc"),
+    )
+    yield op, storage, sampler
+    storage.close()
+
+
+def test_sole_tenant_usage_is_chip_duty(rig):
+    op, storage, sampler = rig
+    bind(storage, "p1", [0], 30)
+    op.set_utilization({0: 80.0}, hbm_used={0: 123})
+    result = sampler.sample_once(now=1000.0)
+    pod = result["pods"]["default/p1"]
+    assert pod["granted_percent"] == 30.0
+    assert pod["used_percent"] == 80.0
+    assert result["chips"][0] == {
+        "duty_cycle_percent": 80.0, "hbm_used_bytes": 123,
+    }
+
+
+def test_shared_chip_usage_split_by_grant_share(rig):
+    op, storage, sampler = rig
+    bind(storage, "small", [0], 25)
+    bind(storage, "big", [0], 75)
+    op.set_utilization({0: 60.0})
+    result = sampler.sample_once(now=1000.0)
+    assert result["pods"]["default/small"]["used_percent"] == 15.0
+    assert result["pods"]["default/big"]["used_percent"] == 45.0
+
+
+def test_multi_chip_grant_spreads_evenly(rig):
+    op, storage, sampler = rig
+    # 150 units across chips 0+1 (the cross-chip split case)
+    storage_hash = bind(storage, "wide", [0, 1], 150)
+    assert storage_hash
+    op.set_utilization({0: 40.0, 1: 20.0})
+    result = sampler.sample_once(now=1000.0)
+    pod = result["pods"]["default/wide"]
+    assert pod["granted_percent"] == 150.0
+    # sole tenant on both chips: gets each chip's full duty
+    assert pod["used_percent"] == 60.0
+
+
+def test_whole_chip_mode_counts_full_chips(tmp_path):
+    op = ExclusiveOperator(StubOperator(str(tmp_path / "dev"), "v5litepod-4"))
+    storage = Storage(str(tmp_path / "meta.db"))
+    # whole-chip: ONE fake id names a whole chip
+    info = storage.load_or_create("default", "whole")
+    ids = [core_device_id(2, 0)]
+    info.set_allocation("jax", AllocationRecord(
+        device=Device(ids, ResourceTPUCore), chip_indexes=[2],
+        created_node_ids=[],
+    ))
+    storage.save(info)
+    sampler = UtilizationSampler(op, storage=storage)
+    op.set_utilization({2: 90.0})
+    result = sampler.sample_once(now=1000.0)
+    pod = result["pods"]["default/whole"]
+    assert pod["granted_percent"] == 100.0
+    assert pod["used_percent"] == 90.0
+    storage.close()
+
+
+def test_memory_only_pod_no_overcommit_but_usage_attributed(rig):
+    op, storage, sampler = rig
+    bind(storage, "memonly", [1], 1024, resource=ResourceTPUMemory)
+    op.set_utilization({1: 70.0})
+    sampler.overcommit_sustain = 1
+    result = sampler.sample_once(now=1000.0)
+    pod = result["pods"]["default/memonly"]
+    assert pod["granted_percent"] == 0.0
+    assert pod["hbm_granted_bytes"] == 1024 * BytesPerMemoryUnit
+    # sole tenant: the duty is attributed, but a zero grant never
+    # trips the overcommit detector (nothing to exceed)
+    assert pod["used_percent"] == 70.0
+    assert sampler.overcommit_episodes == 0
+
+
+def test_sustained_overcommit_counts_once_per_episode(rig, caplog):
+    op, storage, sampler = rig
+    sampler.overcommit_sustain = 3
+    bind(storage, "greedy", [0], 30)
+    op.set_utilization({0: 90.0})
+    import logging
+
+    with caplog.at_level(logging.WARNING, logger="elastic_tpu_agent.sampler"):
+        sampler.sample_once(now=0.0)
+        sampler.sample_once(now=10.0)
+        assert sampler.overcommit_episodes == 0  # not sustained yet
+        sampler.sample_once(now=20.0)
+        assert sampler.overcommit_episodes == 1
+        for t in (30.0, 40.0):
+            sampler.sample_once(now=t)
+        assert sampler.overcommit_episodes == 1  # same episode
+        # back under grant -> episode ends
+        op.set_utilization({0: 10.0})
+        sampler.sample_once(now=50.0)
+        # a new sustained burst is a NEW episode
+        op.set_utilization({0: 90.0})
+        for t in (60.0, 70.0, 80.0):
+            sampler.sample_once(now=t)
+        assert sampler.overcommit_episodes == 2
+    # the structured record is real JSON and carries the join facts
+    records = [
+        json.loads(r.message) for r in caplog.records
+        if r.message.startswith("{")
+    ]
+    assert records
+    rec = records[0]
+    assert rec["kind"] == "tpu_overcommit"
+    assert rec["pod"] == "default/greedy"
+    assert rec["granted_core_percent"] == 30.0
+    assert rec["used_core_percent"] == 90.0
+    assert rec["chips"] == [0]
+
+
+def test_overcommit_margin_tolerates_jitter(rig):
+    op, storage, sampler = rig
+    sampler.overcommit_sustain = 1
+    bind(storage, "jitter", [0], 30)
+    op.set_utilization({0: 33.0})  # within the 5-point margin
+    sampler.sample_once(now=0.0)
+    assert sampler.overcommit_episodes == 0
+
+
+def test_telemetry_failure_streak_flags_chip_and_recovers(rig):
+    op, storage, sampler = rig
+    op.set_utilization({0: 10.0, 1: 10.0})
+    op.fail_utilization({1}, reason="sysfs read EIO")
+    sampler.sample_once(now=0.0)
+    sampler.sample_once(now=10.0)
+    assert sampler.unhealthy_chips() == set()  # streak not reached
+    sampler.sample_once(now=20.0)
+    assert sampler.unhealthy_chips() == {1}
+    assert "sysfs read EIO" in sampler.health_reasons()[1]
+    # a good read clears the flag
+    op.set_utilization({0: 10.0, 1: 10.0})
+    sampler.sample_once(now=30.0)
+    assert sampler.unhealthy_chips() == set()
+
+
+def test_flag_released_when_telemetry_disappears(rig):
+    """A flagged chip whose telemetry entry vanishes entirely (driver
+    reload removed the sysfs file) must be unflagged — absence is never
+    failure, even after a failure streak."""
+    op, storage, sampler = rig
+    op.fail_utilization({1})
+    for t in range(3):
+        sampler.sample_once(now=float(t * 10))
+    assert sampler.unhealthy_chips() == {1}
+    op.clear_utilization()  # telemetry gone, not erroring
+    sampler.sample_once(now=30.0)
+    assert sampler.unhealthy_chips() == set()
+
+
+def test_overcommit_flag_released_when_coverage_lost(rig):
+    """An active overcommit episode must not freeze when the chip's
+    telemetry stops: no current evidence -> no assertion."""
+    op, storage, sampler = rig
+    sampler.overcommit_sustain = 2
+    bind(storage, "stale", [0], 30)
+    op.set_utilization({0: 90.0})
+    for t in (0.0, 10.0):
+        sampler.sample_once(now=t)
+    assert sampler.overcommit_episodes == 1
+    op.clear_utilization()
+    result = sampler.sample_once(now=20.0)
+    pod = result["pods"]["default/stale"]
+    assert pod["used_percent"] is None
+    assert pod["overcommit"] is False
+
+
+def test_snapshot_uses_plugin_health_view_when_set(rig):
+    """With unhealthy_view_fn wired (live agent), the snapshot must use
+    the plugin's applied view and never probe the operator."""
+    op, storage, sampler = rig
+
+    def boom():
+        raise AssertionError("snapshot must not probe the operator")
+
+    op.healthy_indexes = boom
+    sampler.unhealthy_view_fn = lambda: {1}
+    snap = sampler.allocations_snapshot()
+    chips = {row["chip"]: row["healthy"] for row in snap["chips"]}
+    assert chips == {0: True, 1: False, 2: True, 3: True}
+
+
+def test_absent_telemetry_is_not_failure(rig):
+    op, storage, sampler = rig
+    # backend reports nothing at all (non-instrumented host)
+    for t in range(5):
+        sampler.sample_once(now=float(t * 10))
+    assert sampler.unhealthy_chips() == set()
+    # ... and partial coverage doesn't flag the silent chips either
+    op.set_utilization({0: 50.0})
+    for t in range(5, 10):
+        sampler.sample_once(now=float(t * 10))
+    assert sampler.unhealthy_chips() == set()
+
+
+def test_rolling_windows_1m_5m(rig):
+    op, storage, sampler = rig
+    bind(storage, "w", [0], 50)
+    base = 10_000.0
+    # 5 minutes of samples, duty ramps 0..29
+    for i in range(30):
+        op.set_utilization({0: float(i * 10 % 100)})
+        sampler.sample_once(now=base + i * 10)
+    now = base + 290
+    chip = sampler.chip_windows(now=now)[0]
+    assert chip["5m"]["samples"] == 30
+    assert chip["1m"]["samples"] == 7  # 60s horizon at 10s period
+    assert chip["1m"]["last"] == chip["5m"]["last"]
+    pods = sampler.pod_windows(now=now)["default/w"]
+    assert pods["5m"]["samples"] == 30
+    assert pods["1m"]["samples"] == 7
+    assert pods["5m"]["max"] <= 100.0
+
+
+def test_departed_pod_forgotten(rig):
+    op, storage, sampler = rig
+    bind(storage, "gone", [0], 40)
+    op.set_utilization({0: 50.0})
+    sampler.sample_once(now=0.0)
+    assert "default/gone" in sampler.pod_windows(now=0.0)
+    storage.delete("default", "gone")
+    sampler.sample_once(now=10.0)
+    assert sampler.pod_windows(now=10.0) == {}
+    snap = sampler.allocations_snapshot()
+    assert snap["pods"] == []
+
+
+def test_trace_id_joined_from_alloc_spec(rig, tmp_path):
+    op, storage, sampler = rig
+    dev_hash = bind(storage, "traced", [0], 20)
+    spec_dir = tmp_path / "alloc"
+    spec_dir.mkdir(exist_ok=True)
+    (spec_dir / f"{dev_hash}.json").write_text(json.dumps({
+        "hash": dev_hash,
+        "env": {"ELASTIC_TPU_TRACE_ID": "cafe0123beef4567"},
+    }))
+    op.set_utilization({0: 5.0})
+    sampler.sample_once(now=0.0)
+    snap = sampler.allocations_snapshot()
+    assert snap["pods"][0]["last_trace_id"] == "cafe0123beef4567"
+
+
+def test_snapshot_merges_operator_and_sampler_health(rig):
+    op, storage, sampler = rig
+    op.set_unhealthy({3})
+    op.set_utilization({0: 10.0})
+    op.fail_utilization({2})
+    for t in range(3):
+        sampler.sample_once(now=float(t * 10))
+    snap = sampler.allocations_snapshot()
+    chips = {row["chip"]: row for row in snap["chips"]}
+    assert chips[0]["healthy"] is True
+    assert chips[2]["healthy"] is False
+    assert "telemetry" in chips[2]["health_reason"]
+    assert chips[3]["healthy"] is False
+    assert snap["sampler"]["flagged_chips"] == [2]
+
+
+# -- metrics cardinality guard -----------------------------------------------
+
+
+def test_bounded_label_gauge_evicts_oldest():
+    registry = CollectorRegistry()
+    metrics = AgentMetrics(registry=registry, max_pod_series=3)
+    for i in range(5):
+        metrics.pod_core_granted.set(float(i), pod=f"ns/p{i}")
+    body = generate_latest(registry).decode()
+    assert 'pod="ns/p0"' not in body
+    assert 'pod="ns/p1"' not in body
+    for i in (2, 3, 4):
+        assert f'pod="ns/p{i}"' in body
+    assert metrics.pod_core_granted.series_count == 3
+    assert "elastic_tpu_metric_series_evicted_total 2.0" in body
+
+
+def test_bounded_label_gauge_recency_refresh():
+    registry = CollectorRegistry()
+    gauge = AgentMetrics(registry=registry, max_pod_series=2).pod_core_used
+    gauge.set(1.0, pod="a")
+    gauge.set(2.0, pod="b")
+    gauge.set(1.5, pod="a")  # refresh a's recency
+    gauge.set(3.0, pod="c")  # evicts b, not a
+    body = generate_latest(registry).decode()
+    assert 'pod="a"' in body and 'pod="c"' in body
+    assert 'pod="b"' not in body
+
+
+def test_bounded_label_gauge_remove_is_idempotent():
+    gauge = BoundedLabeledGauge(
+        __import__("prometheus_client").Gauge(
+            "t_bounded_remove", "t", ["pod"], registry=CollectorRegistry()
+        ),
+        max_series=4,
+    )
+    gauge.set(1.0, pod="x")
+    gauge.remove(pod="x")
+    gauge.remove(pod="x")  # absent: no raise
+    assert gauge.series_count == 0
+
+
+# -- tpu-vm sysfs telemetry ---------------------------------------------------
+
+
+def _tpuvm(tmp_path, n=2):
+    from elastic_tpu_agent.tpu.tpuvm import TPUVMOperator
+
+    scan = tmp_path / "hostdev"
+    scan.mkdir(exist_ok=True)
+    for i in range(n):
+        (scan / f"accel{i}").touch()
+    sys_root = tmp_path / "sysaccel"
+    sys_root.mkdir(exist_ok=True)
+    op = TPUVMOperator(
+        str(tmp_path / "dev"), host_dev_scan_root=str(scan),
+        metadata=lambda a: None,
+        env={"TPU_ACCELERATOR_TYPE": "v5litepod-4"},
+        maintenance=lambda: "NONE",
+        sys_accel_root=str(sys_root),
+    )
+    return op, sys_root
+
+
+def test_tpuvm_utilization_reads_sysfs(tmp_path):
+    op, sys_root = _tpuvm(tmp_path)
+    d0 = sys_root / "accel0" / "device"
+    d0.mkdir(parents=True)
+    (d0 / "duty_cycle_percent").write_text("42\n")
+    (d0 / "hbm_used_bytes").write_text(str(3 << 30) + "\n")
+    # accel1 has the dir but no telemetry files: no entry, no failure
+    (sys_root / "accel1").mkdir()
+    util = op.utilization()
+    assert util == {
+        0: {"duty_cycle_percent": 42.0, "hbm_used_bytes": 3 << 30},
+    }
+
+
+def test_tpuvm_utilization_parses_float_duty_cycle(tmp_path):
+    """Drivers report duty cycle as "37.5" too — a fractional value must
+    parse, not masquerade as a telemetry failure that would degrade a
+    healthy chip."""
+    op, sys_root = _tpuvm(tmp_path)
+    d0 = sys_root / "accel0"
+    d0.mkdir()
+    (d0 / "duty_cycle_percent").write_text("37.5\n")
+    util = op.utilization()
+    assert util[0]["duty_cycle_percent"] == 37.5
+
+
+def test_tpuvm_utilization_unparseable_is_error_entry(tmp_path):
+    op, sys_root = _tpuvm(tmp_path)
+    d0 = sys_root / "accel0"
+    d0.mkdir()
+    (d0 / "duty_cycle").write_text("not a number\n")
+    util = op.utilization()
+    assert "error" in util[0]
+    # ... which the sampler turns into an unhealthy flag after a streak
+    sampler = UtilizationSampler(op, unhealthy_after_failures=2)
+    sampler.sample_once(now=0.0)
+    sampler.sample_once(now=10.0)
+    assert sampler.unhealthy_chips() == {0}
+
+
+def test_tpuvm_error_counters_snapshot(tmp_path):
+    op, sys_root = _tpuvm(tmp_path)
+    d0 = sys_root / "accel0" / "device"
+    d0.mkdir(parents=True)
+    (d0 / "aer_dev_fatal").write_text("7\n")
+    (d0 / "aer_dev_correctable").write_text("99\n")  # filtered out
+    counters = op.error_counters()
+    assert list(counters) == [0]
+    (path, value), = counters[0].items()
+    assert path.endswith("aer_dev_fatal") and value == 7
+
+
+# -- node-doctor bundle -------------------------------------------------------
+
+
+def test_bundle_builds_and_validates(rig, tmp_path):
+    op, storage, sampler = rig
+    dev_hash = bind(storage, "p1", [1], 60)
+    spec_dir = tmp_path / "alloc"
+    spec_dir.mkdir(exist_ok=True)
+    (spec_dir / f"{dev_hash}.json").write_text(json.dumps({
+        "hash": dev_hash, "env": {"ELASTIC_TPU_TRACE_ID": "feedface0000aaaa"},
+    }))
+    op.set_utilization({1: 55.0})
+    op.fail_utilization({3})
+    for t in range(3):
+        sampler.sample_once(now=float(t * 10))
+    bundle = build_diagnostics_bundle(
+        op, sampler=sampler, node_name="node-x",
+    )
+    assert validate_bundle(bundle) == []
+    assert bundle["node"] == "node-x"
+    assert len(bundle["devices"]) == 4
+    assert bundle["healthy_indexes"] == [0, 1, 2, 3]  # stub op view
+    assert "3" in bundle["health_reasons"]  # sampler flag folded in
+    pods = {p["pod"]: p for p in bundle["allocations"]["pods"]}
+    assert pods["default/p1"]["granted_core_percent"] == 60.0
+    assert pods["default/p1"]["used_core_percent"] == 55.0
+    assert pods["default/p1"]["last_trace_id"] == "feedface0000aaaa"
+    assert bundle["sampler_windows"]["chips"]["1"]["1m"]["samples"] >= 1
+    # round-trips through JSON (the on-disk escalation format)
+    assert validate_bundle(json.loads(json.dumps(bundle))) == []
+
+
+def test_validate_bundle_catches_malformed():
+    assert validate_bundle({}) != []
+    good_enough = {
+        "kind": "elastic-tpu-node-doctor", "version": 1,
+        "generated_ts": 0.0, "node": "", "devices": [],
+        "healthy_indexes": [], "health_reasons": {}, "error_counters": {},
+        "allocations": {"chips": [], "pods": [], "sampler": {}},
+        "sampler_windows": {"chips": {}, "pods": {}},
+        "traces": [], "agent": {},
+    }
+    assert validate_bundle(good_enough) == []
+    broken = dict(good_enough, healthy_indexes=["0"])
+    assert any("healthy_indexes" in p for p in validate_bundle(broken))
+    broken = dict(good_enough, kind="something-else")
+    assert any("kind" in p for p in validate_bundle(broken))
+    broken = dict(
+        good_enough,
+        allocations={"chips": [], "pods": [{"pod": "x"}], "sampler": {}},
+    )
+    assert any("granted_core_percent" in p for p in validate_bundle(broken))
+    # non-dict list entries report INVALID instead of raising (and a
+    # string entry must not pass via substring matching)
+    broken = dict(good_enough, devices=[5, "index device_path"])
+    problems = validate_bundle(broken)
+    assert any("devices[0]" in p for p in problems)
+    assert any("devices[1]" in p for p in problems)
+    broken = dict(
+        good_enough,
+        allocations={"chips": [], "pods": ["junk"], "sampler": {}},
+    )
+    assert any("pods[0]" in p for p in validate_bundle(broken))
+
+
+def test_doctor_cli_end_to_end(tmp_path, capsys):
+    """node-doctor against the stub operator + a real checkpoint db:
+    valid JSON on stdout, then --validate accepts the written file."""
+    from elastic_tpu_agent import cli
+
+    storage = Storage(str(tmp_path / "meta.db"))
+    bind(storage, "escalated", [0], 45)
+    storage.close()
+    rc = cli.main([
+        "node-doctor",
+        "--operator", "stub:v5litepod-4",
+        "--node-name", "doctor-node",
+        "--dev-root", str(tmp_path / "dev"),
+        "--db-file", str(tmp_path / "meta.db"),
+        "--alloc-spec-dir", str(tmp_path / "alloc"),
+        "--samples", "2", "--interval", "0",
+    ])
+    assert rc == 0
+    bundle = json.loads(capsys.readouterr().out)
+    assert validate_bundle(bundle) == []
+    assert bundle["node"] == "doctor-node"
+    pods = {p["pod"]: p for p in bundle["allocations"]["pods"]}
+    assert pods["default/escalated"]["granted_core_percent"] == 45.0
+    bundle_path = tmp_path / "bundle.json"
+    bundle_path.write_text(json.dumps(bundle))
+    assert cli.main(["node-doctor", "--validate", str(bundle_path)]) == 0
+    # a corrupted bundle is rejected
+    bundle_path.write_text(json.dumps(dict(bundle, devices="nope")))
+    assert cli.main(["node-doctor", "--validate", str(bundle_path)]) == 1
+
+
+def test_doctor_bundle_pulls_live_agent(rig, tmp_path):
+    """--agent-url mode: traces and the live allocation table come from
+    the running agent's HTTP endpoint."""
+    from elastic_tpu_agent import tracing
+
+    op, storage, sampler = rig
+    prev = tracing.set_tracer(tracing.Tracer())
+    registry = CollectorRegistry()
+    metrics = AgentMetrics(registry=registry)
+    metrics.serve(0)
+    metrics.attach_sampler(sampler)
+    try:
+        with tracing.get_tracer().trace("Allocate", resource="x"):
+            pass
+        bind(storage, "live", [0], 10)
+        op.set_utilization({0: 5.0})
+        sampler.sample_once()
+        url = f"http://127.0.0.1:{metrics.http_port}"
+        bundle = build_diagnostics_bundle(
+            op, sampler=sampler, agent_url=url,
+        )
+        assert validate_bundle(bundle) == []
+        assert bundle["agent"]["reachable"] is True
+        assert any(t["name"] == "Allocate" for t in bundle["traces"])
+        assert bundle["agent"]["allocations"]["pods"][0]["pod"] == (
+            "default/live"
+        )
+        # unreachable agent: recorded, not fatal
+        bundle = build_diagnostics_bundle(
+            op, sampler=sampler, agent_url="http://127.0.0.1:1",
+        )
+        assert bundle["agent"]["reachable"] is False
+        assert validate_bundle(bundle) == []
+    finally:
+        metrics.close()
+        tracing.set_tracer(prev)
