@@ -5,7 +5,7 @@ TAG ?= elastic-tpu-agent:latest
 # verify's tier-1 line uses pipefail, which /bin/sh (dash) lacks
 SHELL := /bin/bash
 
-.PHONY: all native sanitize test test-all verify doctor-smoke protos image bench clean
+.PHONY: all native sanitize test test-all verify doctor-smoke chaos-smoke protos image bench clean
 
 all: native test
 
@@ -44,8 +44,17 @@ doctor-smoke:
 	  python -m elastic_tpu_agent.cli node-doctor --validate $$tmp/bundle.json && \
 	  rm -rf $$tmp && echo "doctor smoke: OK"
 
+# chaos smoke: the fault-injection suite — kills every supervised loop
+# (die-thread failpoints), forces crash loops, and checks the /healthz
+# 503-vs-degraded contract. Fast (~15s); catches a broken supervisor or
+# fault registry at build time, before a node ever depends on the
+# reflexes.
+chaos-smoke:
+	JAX_PLATFORMS=cpu python -m pytest tests/test_supervisor.py -q \
+	  -p no:cacheprovider && echo "chaos smoke: OK"
+
 T1_TIMEOUT ?= 870
-verify: doctor-smoke
+verify: doctor-smoke chaos-smoke
 	python -c "from prometheus_client import CollectorRegistry; \
 	  from elastic_tpu_agent.metrics import AgentMetrics; \
 	  AgentMetrics(registry=CollectorRegistry()); \

@@ -24,6 +24,8 @@ import logging
 import threading
 from typing import Callable, List, Optional, Tuple
 
+from . import faults
+
 logger = logging.getLogger(__name__)
 
 MAX_CONSECUTIVE_FAILURES = 5
@@ -78,10 +80,13 @@ class AsyncSink:
         self._busy = False
         self._dropped = 0
         self._cond = threading.Condition()
-        self._thread = threading.Thread(
-            target=self._worker, daemon=True, name=name
-        )
-        self._thread.start()
+        self._worker_error: Optional[BaseException] = None
+        self._thread = self._spawn_worker()
+
+    def _spawn_worker(self) -> threading.Thread:
+        t = threading.Thread(target=self._worker, daemon=True, name=self._name)
+        t.start()
+        return t
 
     @property
     def name(self) -> str:
@@ -166,7 +171,41 @@ class AsyncSink:
                 self._name, timeout, len(self._items),
             )
 
+    # -- supervision (supervisor.py) ------------------------------------------
+
+    def run_supervised(self, stop: threading.Event) -> None:
+        """Supervisor target: watch the internal worker thread; if it died
+        on an uncaught exception, re-raise that error so the supervisor's
+        restart/backoff/circuit-breaker accounting applies, and respawn
+        the worker on the next (supervisor-driven) invocation. Returns
+        cleanly on global stop or owner ``stop()`` (drain-exit)."""
+        with self._cond:
+            if not self._thread.is_alive() and not self._stopping:
+                self._worker_error = None
+                self._thread = self._spawn_worker()
+        while not stop.is_set():
+            self._thread.join(timeout=0.5)
+            if not self._thread.is_alive():
+                if self._stopping:
+                    return  # drain-exit: the owner stopped this sink
+                err = self._worker_error
+                raise err if err is not None else RuntimeError(
+                    f"{self._name} worker exited without stop"
+                )
+
     def _worker(self) -> None:
+        try:
+            self._worker_body()
+        except BaseException as e:  # noqa: BLE001 - recorded for supervision
+            # A dead worker would silently stop draining the queue; record
+            # the death so run_supervised() can surface it and respawn.
+            # DieThread (fault injection) lands here too — deliberately.
+            with self._cond:
+                self._worker_error = e
+                self._busy = False
+                self._cond.notify_all()  # un-wedge flush()ers
+
+    def _worker_body(self) -> None:
         while True:
             with self._cond:
                 while not self._items and not self._stopping:
@@ -174,6 +213,12 @@ class AsyncSink:
                 if not self._items:  # stopping and drained
                     self._cond.notify_all()
                     return
+            # Failpoint BEFORE the batch is claimed: a raise/die-thread
+            # here leaves every queued op intact for the respawned worker
+            # (the chaos suite asserts nothing is dropped across a worker
+            # crash). Only this worker pops, so the re-lock is race-free.
+            faults.fire(f"sink.{self._name}")
+            with self._cond:
                 batch, self._items = list(self._items.values()), {}
                 self._busy = True
             for op in batch:

@@ -97,6 +97,17 @@ def parse_args(argv=None) -> argparse.Namespace:
                         "events:create)")
     p.add_argument("--no-crd", action="store_true",
                    help="disable ElasticTPU CRD publication")
+    p.add_argument("--crash-loop-threshold", type=int, default=5,
+                   help="supervisor circuit breaker: crashes of one "
+                        "subsystem within the sliding window before it is "
+                        "marked failed (critical subsystems then flip "
+                        "/healthz to 503 for the liveness probe)")
+    p.add_argument("--faults", default="",
+                   help="TEST-ONLY fault injection spec "
+                        "(point=spec,point=spec; e.g. "
+                        "'gc.sweep=die-thread:1,storage.save=delay:0.5'); "
+                        "also read from ELASTIC_TPU_FAULTS. Never set in "
+                        "production")
     p.add_argument("-v", "--verbose", action="count", default=0)
     args = p.parse_args(argv)
     if args.nri_evict_on_chip_failure and not args.nri_socket:
@@ -237,20 +248,30 @@ def main(argv=None) -> int:
     )
     install_dump_signal()
 
+    fault_spec = args.faults or os.environ.get("ELASTIC_TPU_FAULTS", "")
+    if fault_spec:
+        from . import faults
+
+        logging.getLogger(__name__).warning(
+            "fault injection ARMED (test-only): %s", fault_spec
+        )
+        faults.get_registry().arm_spec(fault_spec)
+
     metrics = None
     if args.metrics_port:
-        from .metrics import AgentMetrics, MetricsServerError
+        from .metrics import AgentMetrics
 
         metrics = AgentMetrics()
-        try:
-            metrics.serve(args.metrics_port, addr=args.metrics_addr)
-        except MetricsServerError as e:
-            # A busy port must not take the allocation path down with it:
-            # keep the agent (and its in-process metric objects, which
-            # gauges/events still update) and run without the endpoint.
-            logging.getLogger(__name__).error(
-                "%s — continuing WITHOUT the observability endpoint", e
-            )
+        # A busy port must not take the allocation path down with it: the
+        # agent keeps running and the endpoint keeps retrying the bind —
+        # required now that the DaemonSet liveness probe hits /healthz
+        # (a permanent no-endpoint state would probe-restart forever).
+        metrics.serve_with_retry(args.metrics_port, addr=args.metrics_addr)
+    # Process-wide net: threads nobody registered with the supervisor
+    # still can't die unobserved (elastic_tpu_thread_crashes_total).
+    from .supervisor import install_thread_excepthook
+
+    install_thread_excepthook(metrics)
 
     manager = TPUManager(
         ManagerOptions(
@@ -271,6 +292,7 @@ def main(argv=None) -> int:
             enable_crd=not args.no_crd,
             enable_sampler=not args.no_sampler,
             sampler_period_s=args.sampler_period,
+            crash_loop_threshold=args.crash_loop_threshold,
         )
     )
     run_thread = threading.Thread(

@@ -12,6 +12,7 @@ from __future__ import annotations
 import datetime
 import faulthandler
 import os
+import random
 import signal
 import sys
 import threading
@@ -121,6 +122,28 @@ def wait_for_exit_signal() -> int:
         signal.signal(s, _handler)
     ev.wait()
     return received[0] if received else 0
+
+
+class JitteredBackoff:
+    """Exponential backoff with 0.5x–1.5x jitter, shared by every retry
+    loop (sitter list/watch, subsystem supervision). The jitter matters
+    at fleet scale: one agent per node means a dead shared dependency
+    (apiserver) gets hit by every node in lockstep without it."""
+
+    def __init__(self, min_s: float, max_s: float, rng=None) -> None:
+        self._min = min_s
+        self._max = max_s
+        self._rng = rng if rng is not None else random.Random()
+        self._current = min_s
+
+    def next_delay(self) -> float:
+        """Jittered delay to sleep now; doubles the base for next time."""
+        delay = self._current * (0.5 + self._rng.random())
+        self._current = min(self._current * 2, self._max)
+        return delay
+
+    def reset(self) -> None:
+        self._current = self._min
 
 
 class FileWatcher:

@@ -199,6 +199,11 @@ class CRDRecorder:
         # few seconds would re-introduce the abandoned-queue shutdown.
         self._sink.stop(timeout=timeout)
 
+    def run_supervised(self, stop) -> None:
+        """Supervisor target (supervisor.py): watchdog over the sink's
+        internal worker thread."""
+        self._sink.run_supervised(stop)
+
     @property
     def disabled(self) -> bool:
         return self._sink.disabled

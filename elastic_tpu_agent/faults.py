@@ -1,0 +1,184 @@
+"""Deterministic fault injection: named failpoints, armed only in tests.
+
+The agent is a node-critical DaemonSet with ~8 background loops; proving
+that each one recovers from a crash needs a way to *cause* the crash
+deterministically — monkeypatching from tests cannot reach a loop that
+is already running inside the real manager. This registry is that seam:
+hot paths call ``faults.fire("<point>")`` which is a near-free no-op
+until a test (or a developer via ``ELASTIC_TPU_FAULTS`` /
+``--faults``) arms the point with a behavior spec.
+
+Specs (``<kind>[:<arg>]``):
+
+- ``raise`` / ``raise:N`` / ``raise-once`` — raise FaultError at the
+  point, every time / the next N times / once. Exercises the *handled*
+  error paths (loops that catch-and-retry, rollback on bind failure).
+- ``delay:SECONDS`` — sleep at the point (slow apiserver / slow disk).
+- ``die-thread`` / ``die-thread:N`` — raise DieThread, a BaseException
+  that sails past every ``except Exception`` trap, killing the calling
+  thread the way an uncaught bug would. This is what proves the
+  supervisor actually restarts a loop: ``raise`` alone is absorbed by
+  the loops' own catch-and-continue guards.
+
+Arming is test-only: production deployments never set the env knob, and
+an unarmed ``fire()`` is a dict-emptiness check. Points are plain
+dotted names (``sitter.relist``, ``storage.save``, ``gc.sweep``, ...);
+firing an unknown point is always safe.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Dict, Optional
+
+logger = logging.getLogger(__name__)
+
+
+class FaultError(RuntimeError):
+    """The exception a ``raise``-kind failpoint throws (an ordinary
+    Exception: the code under test is expected to handle it)."""
+
+
+class DieThread(BaseException):
+    """Thrown by ``die-thread`` failpoints. Deliberately a BaseException:
+    it must escape the broad ``except Exception`` traps that the agent's
+    loops use for *handled* failures, so the thread actually dies and
+    the supervision layer is what has to save it."""
+
+
+class _Fault:
+    __slots__ = ("kind", "arg", "remaining", "fired")
+
+    def __init__(self, kind: str, arg: Optional[float], remaining: Optional[int]):
+        self.kind = kind
+        self.arg = arg
+        self.remaining = remaining  # None = unlimited
+        self.fired = 0
+
+
+def _parse_spec(spec: str) -> _Fault:
+    spec = spec.strip()
+    if spec == "raise-once":
+        return _Fault("raise", None, 1)
+    kind, _, arg = spec.partition(":")
+    kind = kind.strip()
+    if kind == "raise":
+        n = int(arg) if arg else None
+        return _Fault("raise", None, n)
+    if kind == "delay":
+        if not arg:
+            raise ValueError("delay fault needs seconds: delay:0.5")
+        return _Fault("delay", float(arg), None)
+    if kind == "die-thread":
+        n = int(arg) if arg else None
+        return _Fault("die-thread", None, n)
+    raise ValueError(
+        f"unknown fault spec {spec!r} "
+        "(want raise[-once|:N] | delay:S | die-thread[:N])"
+    )
+
+
+class FaultRegistry:
+    """Thread-safe map of failpoint name -> armed behavior."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._armed: Dict[str, _Fault] = {}
+        self.total_fired = 0
+
+    def arm(self, point: str, spec: str) -> None:
+        fault = _parse_spec(spec)
+        with self._lock:
+            self._armed[point] = fault
+        logger.warning("FAULT ARMED (test-only): %s=%s", point, spec)
+
+    def arm_spec(self, multi: str) -> None:
+        """Arm from a comma-separated ``point=spec,point=spec`` string
+        (the ELASTIC_TPU_FAULTS / --faults format)."""
+        for part in multi.split(","):
+            part = part.strip()
+            if not part:
+                continue
+            point, _, spec = part.partition("=")
+            if not spec:
+                raise ValueError(f"bad fault entry {part!r} (want point=spec)")
+            self.arm(point.strip(), spec)
+
+    def disarm(self, point: Optional[str] = None) -> None:
+        with self._lock:
+            if point is None:
+                self._armed.clear()
+            else:
+                self._armed.pop(point, None)
+
+    def armed(self) -> Dict[str, str]:
+        with self._lock:
+            return {
+                p: f"{f.kind}"
+                + (f":{f.remaining}" if f.remaining is not None else "")
+                for p, f in self._armed.items()
+            }
+
+    def fired(self, point: str) -> int:
+        """How many times ``point`` fired while armed (assertion helper;
+        resets when the point is re-armed)."""
+        with self._lock:
+            fault = self._armed.get(point)
+            return fault.fired if fault is not None else 0
+
+    def fire(self, point: str) -> None:
+        with self._lock:
+            fault = self._armed.get(point)
+            if fault is None:
+                return
+            fault.fired += 1
+            self.total_fired += 1
+            if fault.remaining is not None:
+                fault.remaining -= 1
+                if fault.remaining <= 0:
+                    del self._armed[point]
+            kind, arg = fault.kind, fault.arg
+        # act outside the lock: delay must not serialize other points
+        if kind == "delay":
+            time.sleep(arg)
+            return
+        if kind == "die-thread":
+            logger.warning("failpoint %s: killing thread %s", point,
+                           threading.current_thread().name)
+            raise DieThread(f"failpoint {point}")
+        logger.warning("failpoint %s: raising FaultError", point)
+        raise FaultError(f"injected failure at {point}")
+
+
+_registry = FaultRegistry()
+
+
+def get_registry() -> FaultRegistry:
+    return _registry
+
+
+def fire(point: str) -> None:
+    """Module-level fast path: no-op unless the point is armed."""
+    if not _registry._armed:  # unlocked emptiness check: hot-path cheap
+        return
+    _registry.fire(point)
+
+
+class armed:
+    """Context manager for tests: arm on enter, disarm on exit.
+
+    >>> with faults.armed("gc.sweep", "die-thread:1"):
+    ...     trigger_gc()
+    """
+
+    def __init__(self, point: str, spec: str) -> None:
+        self._point = point
+        _registry.arm(point, spec)
+
+    def __enter__(self) -> "armed":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        _registry.disarm(self._point)

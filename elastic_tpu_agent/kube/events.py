@@ -75,6 +75,11 @@ class EventRecorder:
     def flush(self, timeout: float = 10.0) -> bool:
         return self._sink.flush(timeout=timeout)
 
+    def run_supervised(self, stop) -> None:
+        """Supervisor target (supervisor.py): watchdog over the sink's
+        internal worker thread."""
+        self._sink.run_supervised(stop)
+
     def stop(self, timeout: float = 30.0) -> None:
         # Generous default: the sink drains on stop (async_sink); a short
         # cap would abandon queued events at shutdown.

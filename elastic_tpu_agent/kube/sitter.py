@@ -15,13 +15,22 @@ from __future__ import annotations
 
 import logging
 import threading
+import time
 from typing import Callable, Dict, Optional, Tuple
 
+from .. import faults
+from ..common import JitteredBackoff
 from .client import KubeClient, KubeError
 
 logger = logging.getLogger(__name__)
 
 DeleteHook = Callable[[dict], None]
+
+# list/watch failure backoff: jittered exponential instead of the old
+# fixed 1.0s — a dead apiserver must not be hammered once a second by
+# every node's agent in lockstep, and recovery still starts fast.
+RETRY_MIN_S = 1.0
+RETRY_MAX_S = 30.0
 
 
 class Sitter:
@@ -40,6 +49,12 @@ class Sitter:
         self._cache: Dict[Tuple[str, str], dict] = {}
         self._synced = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        # monotonic timestamp of the last successful apiserver contact
+        # (relist success or watch event); 0.0 = never. Staleness is
+        # surfaced via /healthz and elastic_tpu_sitter_sync_age_seconds
+        # so a long apiserver outage is visible instead of silent cache
+        # rot.
+        self._last_sync_monotonic = 0.0
 
     # -- cache reads ----------------------------------------------------------
 
@@ -48,6 +63,14 @@ class Sitter:
 
     def wait_synced(self, timeout: Optional[float] = None) -> bool:
         return self._synced.wait(timeout)
+
+    def sync_age_s(self) -> Optional[float]:
+        """Seconds since the cache last heard from the apiserver, or None
+        before the first successful list."""
+        last = self._last_sync_monotonic
+        if last == 0.0:
+            return None
+        return max(0.0, time.monotonic() - last)
 
     def get_pod(self, namespace: str, name: str) -> Optional[dict]:
         with self._lock:
@@ -73,6 +96,7 @@ class Sitter:
         return md.get("namespace", ""), md.get("name", "")
 
     def _relist(self) -> str:
+        faults.fire("sitter.relist")
         items, rv = self._client.list_pods(self._node)
         fresh = {self._key(p): p for p in items}
         with self._lock:
@@ -82,6 +106,7 @@ class Sitter:
         # Deletions that happened while we were not watching still reach GC.
         for pod in gone_pods:
             self._fire_delete(pod)
+        self._last_sync_monotonic = time.monotonic()
         self._synced.set()
         return rv
 
@@ -106,23 +131,36 @@ class Sitter:
         elif etype == "ERROR":
             raise KubeError(f"watch error event: {pod}")
 
-    def _run(self, stop: threading.Event) -> None:
+    def run(self, stop: threading.Event) -> None:
+        """Blocking list+watch loop until ``stop`` (the supervised entry
+        point; ``start()`` wraps it in a thread for direct use)."""
+        backoff = JitteredBackoff(RETRY_MIN_S, RETRY_MAX_S)
         while not stop.is_set():
             try:
                 rv = self._relist()
+                backoff.reset()  # apiserver answered
                 watch_timeout = max(1, int(self._relist_s))
                 for event in self._client.watch_pods(
                     self._node, rv, timeout_s=watch_timeout
                 ):
+                    faults.fire("sitter.watch")
                     self._handle_event(event)
+                    self._last_sync_monotonic = time.monotonic()
                     if stop.is_set():
                         return
             except Exception as e:  # noqa: BLE001
-                logger.warning("sitter list/watch failed (%s); retrying", e)
-                stop.wait(1.0)
+                delay = backoff.next_delay()
+                logger.warning(
+                    "sitter list/watch failed (%s); retrying in %.1fs "
+                    "(cache age: %s)",
+                    e, delay,
+                    "never-synced" if self.sync_age_s() is None
+                    else f"{self.sync_age_s():.0f}s",
+                )
+                stop.wait(delay)
 
     def start(self, stop: threading.Event) -> None:
         self._thread = threading.Thread(
-            target=self._run, args=(stop,), daemon=True, name="sitter"
+            target=self.run, args=(stop,), daemon=True, name="sitter"
         )
         self._thread.start()

@@ -21,13 +21,14 @@ import threading
 from dataclasses import dataclass, field
 from typing import Optional
 
-from . import rpc
+from . import rpc, supervisor as supervision
 from .kube.client import KubeClient
 from .kube.locator import KubeletDeviceLocator
 from .kube.sitter import Sitter
 from .plugins.base import PluginConfig
 from .plugins.tpushare import DEFAULT_ALLOC_SPEC_DIR, TPUSharePlugin
 from .storage import Storage
+from .supervisor import CRITICAL, DEGRADED, Supervisor
 from .tpu import StubOperator, TPUVMOperator
 
 logger = logging.getLogger(__name__)
@@ -73,6 +74,12 @@ class ManagerOptions:
     # /debug/allocations and node-doctor.
     enable_sampler: bool = True
     sampler_period_s: float = 10.0
+    # Supervision (supervisor.py): a subsystem crashing this many times
+    # inside the sliding window is circuit-broken (marked failed instead
+    # of thrashing); critical subsystems then flip /healthz to 503 so the
+    # DaemonSet liveness probe restarts the pod.
+    crash_loop_threshold: int = supervision.DEFAULT_CRASH_LOOP_THRESHOLD
+    crash_loop_window_s: float = supervision.DEFAULT_CRASH_LOOP_WINDOW_S
     # test seams
     kube_client: Optional[KubeClient] = None
     operator: object = None
@@ -131,6 +138,11 @@ class TPUManager:
     def __init__(self, opts: ManagerOptions) -> None:
         self._opts = opts
         self.storage = Storage(opts.db_path)
+        self.supervisor = Supervisor(
+            metrics=opts.metrics,
+            crash_loop_threshold=opts.crash_loop_threshold,
+            crash_loop_window_s=opts.crash_loop_window_s,
+        )
         self.client = opts.kube_client or KubeClient.auto(opts.kubeconfig)
         self.gc_queue: "queue.Queue" = queue.Queue()
         self.sitter = Sitter(
@@ -140,6 +152,12 @@ class TPUManager:
         )
         self.operator = build_operator(opts)
         self.metrics = opts.metrics
+        if self.metrics is not None and hasattr(
+            self.metrics, "attach_supervisor"
+        ):
+            self.metrics.attach_supervisor(self.supervisor)
+        if self.metrics is not None and hasattr(self.metrics, "attach_sitter"):
+            self.metrics.attach_sitter(self.sitter)
         if self.metrics is not None:
             try:
                 n = len(self.operator.devices())
@@ -236,6 +254,7 @@ class TPUManager:
                         opts.plugin_kind,
                     )
         self._stop = threading.Event()
+        self._stopped = False
 
     # -- Restore (SURVEY.md §3.5: declared-but-unimplemented upstream) --------
 
@@ -464,20 +483,29 @@ class TPUManager:
     def _deferred_allocatable_check(self, stop: threading.Event) -> None:
         # Deferred: right after Register, kubelet has not consumed the
         # first ListAndWatch yet, so an immediate check would always cry
-        # drift on a fresh boot.
+        # drift on a fresh boot. Registered one-shot under the supervisor:
+        # a crash here is retried with backoff instead of being swallowed.
         if stop.wait(self._ALLOCATABLE_CHECK_DELAY_S):
             return
-        try:
-            self.check_allocatable_drift()
-        except Exception:  # noqa: BLE001
-            logger.exception("allocatable cross-check failed")
+        self.check_allocatable_drift()
 
     # -- Run ------------------------------------------------------------------
 
     def run(self, block: bool = True) -> None:
-        """Start sitter, wait for sync, restore, start plugins + GC
-        (reference: manager.go:145-156 — restore added)."""
-        self.sitter.start(self._stop)
+        """Start sitter, wait for sync, restore, start plugins + GC —
+        every background loop registered as a supervised subsystem
+        (supervisor.py): uncaught-exception trap, jittered restart
+        backoff, crash-loop circuit breaker, criticality-aware /healthz.
+
+        ``block=True`` blocks on the supervisor's terminal event (global
+        stop, or a critical subsystem circuit-breaking) — previously it
+        joined the GC thread alone, so a crashed GC exited (or wedged)
+        the whole agent arbitrarily."""
+        self.supervisor.start(self._stop)
+        # Sitter is CRITICAL: binds read annotations from its cache and GC
+        # learns deletions through it; a circuit-broken sitter means the
+        # node can neither bind correctly nor reclaim.
+        self.supervisor.register("sitter", self.sitter.run, CRITICAL)
         if not self.sitter.wait_synced(timeout=60.0):
             logger.warning("sitter not synced after 60s; continuing anyway")
         if self.crd_recorder is not None:
@@ -489,37 +517,55 @@ class TPUManager:
             except Exception:  # noqa: BLE001 - observability, never fatal
                 logger.exception("inventory publication failed")
         self.restore()
-        self.plugin.run(self._stop)
-        self._gc_thread = self.plugin.start_gc(self.gc_queue, self._stop)
-        if hasattr(self.plugin, "start_health"):
-            self._health_thread = self.plugin.start_health(self._stop)
+        # Device-plugin serve loops: one per extended resource, CRITICAL —
+        # a dead ListAndWatch leaves kubelet advertising stale devices.
+        for server in getattr(self.plugin, "servers", []):
+            self.supervisor.register(
+                f"device-plugin:{server.resource_name}", server.run, CRITICAL
+            )
+        self.supervisor.register(
+            "gc",
+            lambda stop: self.plugin.gc(self.gc_queue, stop),
+            CRITICAL,
+        )
+        if hasattr(self.plugin, "health_loop"):
+            self.supervisor.register(
+                "health", self.plugin.health_loop, DEGRADED
+            )
         if self.sampler is not None:
-            self._sampler_thread = self.sampler.start(self._stop)
+            self.supervisor.register("sampler", self.sampler.run, DEGRADED)
         if self.nri_plugin is not None:
-            self._nri_thread = self.nri_plugin.start(self._stop)
-        threading.Thread(
-            target=self._deferred_allocatable_check, args=(self._stop,),
-            daemon=True, name="allocatable-check",
-        ).start()
+            self.supervisor.register("nri", self.nri_plugin.run, DEGRADED)
+        if self.crd_recorder is not None and hasattr(
+            self.crd_recorder, "run_supervised"
+        ):
+            self.supervisor.register(
+                "crd-recorder", self.crd_recorder.run_supervised, DEGRADED
+            )
+        if self.events is not None and hasattr(self.events, "run_supervised"):
+            self.supervisor.register(
+                "events", self.events.run_supervised, DEGRADED
+            )
+        self.supervisor.register(
+            "allocatable-check", self._deferred_allocatable_check, DEGRADED,
+            one_shot=True,
+        )
         if block:
-            self._gc_thread.join()
+            self.supervisor.wait_terminal()
 
     def stop(self) -> None:
+        if self._stopped:  # idempotent: double-stop must be harmless
+            return
+        self._stopped = True
         self._stop.set()
         self.gc_queue.put(None)  # wake GC so it can observe stop
         # Join GC before stopping the recorder: an in-flight gc_once() may
         # still enqueue record_released, which would be silently dropped if
         # the recorder worker had already consumed its stop sentinel.
-        gc_thread = getattr(self, "_gc_thread", None)
-        if gc_thread is not None:
-            gc_thread.join(timeout=10.0)
+        self.supervisor.join("gc", timeout=10.0)
         # Same invariant for the health poller: it submits events too.
-        health_thread = getattr(self, "_health_thread", None)
-        if health_thread is not None:
-            health_thread.join(timeout=10.0)
-        sampler_thread = getattr(self, "_sampler_thread", None)
-        if sampler_thread is not None:
-            sampler_thread.join(timeout=10.0)
+        self.supervisor.join("health", timeout=10.0)
+        self.supervisor.join("sampler", timeout=10.0)
         if self.nri_plugin is not None:
             self.nri_plugin.stop()
         if hasattr(self.plugin, "core"):

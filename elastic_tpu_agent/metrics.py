@@ -32,6 +32,7 @@ from __future__ import annotations
 import json
 import logging
 import threading
+import time
 from collections import OrderedDict
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
@@ -236,7 +237,42 @@ class AgentMetrics:
             "stayed above its fractional grant",
             **kw,
         )
+        # -- subsystem supervision (supervisor.py) -------------------------
+        self.subsystem_up = Gauge(
+            "elastic_tpu_subsystem_up",
+            "1 while a supervised subsystem is running, 0 when crashed, "
+            "circuit-broken or stopped",
+            ["subsystem"],
+            **kw,
+        )
+        self.subsystem_restarts = Counter(
+            "elastic_tpu_subsystem_restarts_total",
+            "Crash-restarts performed by the supervisor, per subsystem",
+            ["subsystem"],
+            **kw,
+        )
+        self.subsystem_crash_loops = Counter(
+            "elastic_tpu_subsystem_crash_loops_total",
+            "Circuit-breaker openings (subsystem crashed too often inside "
+            "the sliding window and was marked failed)",
+            ["subsystem"],
+            **kw,
+        )
+        self.thread_crashes = Counter(
+            "elastic_tpu_thread_crashes_total",
+            "Threads that died on an uncaught exception (process-wide "
+            "threading.excepthook; supervised subsystems never reach it)",
+            **kw,
+        )
+        self.sitter_sync_age = Gauge(
+            "elastic_tpu_sitter_sync_age_seconds",
+            "Seconds since the pod cache last synced with the apiserver "
+            "(list success or watch event); -1 before the first sync",
+            **kw,
+        )
         self._sampler = None
+        self._supervisor = None
+        self._sitter = None
         self._httpd: Optional[ThreadingHTTPServer] = None
 
     def attach_sampler(self, sampler) -> None:
@@ -244,6 +280,23 @@ class AgentMetrics:
         attachment is deliberate: the endpoint starts before the manager
         (cli.py) and answers 503 until the sampler exists."""
         self._sampler = sampler
+
+    def attach_supervisor(self, supervisor) -> None:
+        """Fold supervisor state into /healthz: any circuit-broken
+        CRITICAL subsystem flips the endpoint to 503 so the DaemonSet
+        liveness probe restarts the pod; degraded subsystems ride along
+        in the JSON without failing the probe."""
+        self._supervisor = supervisor
+
+    def attach_sitter(self, sitter) -> None:
+        """Expose pod-cache staleness: a long apiserver outage shows up
+        as a growing sync age instead of silent cache rot."""
+        self._sitter = sitter
+        self.sitter_sync_age.set_function(
+            lambda: (
+                -1.0 if sitter.sync_age_s() is None else sitter.sync_age_s()
+            )
+        )
 
     def register_sink(self, sink) -> None:
         """Export a live AsyncSink's internals as gauges. Uses
@@ -365,11 +418,30 @@ class AgentMetrics:
                             "status": "ok",
                             "traces_completed": tracer.completed,
                         }
+                        code = 200
                         if agent_metrics._sampler is not None:
                             status["sampler_samples"] = (
                                 agent_metrics._sampler.samples_total
                             )
-                        self._reply_json(status)
+                        sitter = agent_metrics._sitter
+                        if sitter is not None:
+                            status["sitter_sync_age_s"] = sitter.sync_age_s()
+                        sup = agent_metrics._supervisor
+                        if sup is not None:
+                            snap = sup.healthz()
+                            status["subsystems"] = snap["subsystems"]
+                            status["degraded"] = snap["degraded"]
+                            status["critical_failed"] = snap["critical_failed"]
+                            if snap["critical_failed"]:
+                                # the liveness-probe contract: a 503 here
+                                # makes kubelet restart the whole pod —
+                                # the only recovery once a critical loop
+                                # is circuit-broken
+                                status["status"] = "failing"
+                                code = 503
+                            elif snap["degraded"]:
+                                status["status"] = "degraded"
+                        self._reply_json(status, code=code)
                     else:
                         self._reply_json(
                             {"error": f"no such path {parsed.path}",
@@ -407,6 +479,45 @@ class AgentMetrics:
             addr, httpd.server_address[1],
         )
         return httpd
+
+    def serve_with_retry(
+        self,
+        port: int,
+        addr: str = DEFAULT_BIND_ADDR,
+        retry_s: float = 15.0,
+    ) -> Optional[ThreadingHTTPServer]:
+        """serve(), but a bind failure starts a background retry loop
+        instead of giving up. With the DaemonSet liveness probe hitting
+        /healthz, permanently running without the endpoint would turn a
+        transient port conflict (typically the previous agent pod still
+        draining on hostNetwork) into an unfixable probe-restart loop;
+        retrying binds as soon as the old holder releases the port.
+        Returns the server, or None while the port is still contended."""
+        try:
+            return self.serve(port, addr=addr)
+        except MetricsServerError as e:
+            logger.error(
+                "%s — agent continues, retrying the bind every %.0fs "
+                "(liveness probes fail until it succeeds)", e, retry_s,
+            )
+
+        def _retry() -> None:
+            while self._httpd is None:
+                time.sleep(retry_s)
+                try:
+                    self.serve(port, addr=addr)
+                    logger.info(
+                        "observability endpoint recovered on %s:%d",
+                        addr, port,
+                    )
+                    return
+                except MetricsServerError:
+                    continue
+
+        threading.Thread(
+            target=_retry, daemon=True, name="metrics-retry"
+        ).start()
+        return None
 
     @property
     def http_port(self) -> Optional[int]:

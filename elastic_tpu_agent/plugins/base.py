@@ -20,7 +20,7 @@ from typing import Callable, Optional
 
 import grpc
 
-from .. import rpc
+from .. import faults, rpc
 from ..common import FileWatcher
 
 logger = logging.getLogger(__name__)
@@ -82,6 +82,10 @@ class DevicePluginServer:
     # -- single lifecycle steps ----------------------------------------------
 
     @property
+    def resource_name(self) -> str:
+        return self._resource
+
+    @property
     def socket_path(self) -> str:
         return os.path.join(self._config.device_plugin_dir, self._endpoint)
 
@@ -122,9 +126,25 @@ class DevicePluginServer:
     # -- the loop -------------------------------------------------------------
 
     def run(self, stop: threading.Event) -> None:
-        """Blocking serve/register/watch loop until ``stop`` is set."""
+        """Blocking serve/register/watch loop until ``stop`` is set.
+
+        The finally matters under supervision: an exception escaping the
+        loop (e.g. the watch phase) would otherwise leave self._server
+        live while the supervisor re-enters run() and serves a SECOND
+        gRPC server + thread pool on the re-created socket."""
+        try:
+            self._run_loop(stop)
+        finally:
+            self._stop_server()
+
+    def _run_loop(self, stop: threading.Event) -> None:
         while not stop.is_set():
             try:
+                # failpoint: raise-kind faults exercise the internal
+                # serve/register retry below; die-thread kills the loop so
+                # the supervisor's restart of a CRITICAL subsystem is
+                # testable end to end.
+                faults.fire("dp.run")
                 self._serve()
                 self._probe()
                 # Snapshot the kubelet socket BEFORE registering: a kubelet
@@ -153,7 +173,6 @@ class DevicePluginServer:
             self._stop_server()
             if restarted:
                 stop.wait(self._config.restart_backoff_s)
-        self._stop_server()
 
     def start(self, stop: threading.Event) -> None:
         self._thread = threading.Thread(

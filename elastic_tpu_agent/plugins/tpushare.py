@@ -42,7 +42,7 @@ import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
-from .. import rpc
+from .. import faults, rpc
 from ..common import (
     AnnotationAssumed,
     BytesPerMemoryUnit,
@@ -1007,6 +1007,7 @@ class TPUSharePlugin:
         folded in — a chip whose telemetry reads keep failing is degraded
         exactly like one the operator reports broken. Returns True when
         anything changed."""
+        faults.fire("health.poll")
         try:
             healthy = set(self._config.operator.healthy_indexes())
         except Exception:  # noqa: BLE001 - a broken probe must not wedge
@@ -1128,6 +1129,7 @@ class TPUSharePlugin:
 
     def gc_once(self) -> int:
         """Reclaim allocations of pods that no longer exist; returns count."""
+        faults.fire("gc.sweep")
         with get_tracer().trace("gc_sweep") as tr:
             reclaimed = self._gc_sweep()
             tr.set(reclaimed=reclaimed)

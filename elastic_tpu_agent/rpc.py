@@ -15,6 +15,7 @@ from typing import Callable, Iterable, Optional
 
 import grpc
 
+from . import faults
 from .gen import deviceplugin_pb2 as dp
 from .gen import podresources_pb2 as pr
 from .gen import podresources_v1_pb2 as prv1
@@ -367,6 +368,7 @@ class PodResourcesClient:
             timer.start()
 
     def list(self, timeout_s: float = 5.0):
+        faults.fire("podresources.list")
         try:
             list_fn, req_cls, _, _ = self._ensure(timeout_s)
             return list_fn(req_cls(), timeout=timeout_s)

@@ -46,6 +46,7 @@ import time
 from collections import deque
 from typing import Callable, Dict, List, Optional
 
+from . import faults
 from .common import BytesPerMemoryUnit, ResourceTPUCore, TPUPercentEachChip
 
 logger = logging.getLogger(__name__)
@@ -137,12 +138,13 @@ class UtilizationSampler:
 
     def start(self, stop: threading.Event) -> threading.Thread:
         t = threading.Thread(
-            target=self._loop, args=(stop,), daemon=True, name="tpu-sampler"
+            target=self.run, args=(stop,), daemon=True, name="tpu-sampler"
         )
         t.start()
         return t
 
-    def _loop(self, stop: threading.Event) -> None:
+    def run(self, stop: threading.Event) -> None:
+        """Blocking sample loop until ``stop`` (supervised entry point)."""
         while not stop.is_set():
             try:
                 self.sample_once()
@@ -156,6 +158,7 @@ class UtilizationSampler:
     def sample_once(self, now: Optional[float] = None) -> dict:
         """Take one sample; returns the join result (also kept for
         snapshot/debug readers). ``now`` is a test seam."""
+        faults.fire("sampler.sample")
         now = time.time() if now is None else now
         try:
             util = self._operator.utilization() or {}
@@ -584,10 +587,17 @@ BUNDLE_VERSION = 1
 
 
 def _fetch_json(url: str, timeout_s: float) -> dict:
+    import urllib.error
     import urllib.request
 
-    with urllib.request.urlopen(url, timeout=timeout_s) as resp:
-        return json.loads(resp.read())
+    try:
+        with urllib.request.urlopen(url, timeout=timeout_s) as resp:
+            return json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        # The agent endpoint replies JSON on every status — a 503
+        # /healthz (critical subsystem circuit-broken) is exactly the
+        # bundle a support escalation needs, not a fetch failure.
+        return json.loads(e.read())
 
 
 def build_diagnostics_bundle(
@@ -661,6 +671,7 @@ def build_diagnostics_bundle(
             ),
         },
         "traces": [],
+        "subsystems": {},
         "agent": {"url": agent_url, "reachable": None},
     }
     if agent_url:
@@ -672,9 +683,11 @@ def build_diagnostics_bundle(
             bundle["traces"] = payload.get("traces", [])
             bundle["agent"]["reachable"] = True
             try:
-                bundle["agent"]["healthz"] = _fetch_json(
-                    f"{base}/healthz", http_timeout_s
-                )
+                healthz = _fetch_json(f"{base}/healthz", http_timeout_s)
+                bundle["agent"]["healthz"] = healthz
+                # Lift supervision state to the top level: "which loop is
+                # dead" is the first question a support escalation asks.
+                bundle["subsystems"] = healthz.get("subsystems", {})
                 live = _fetch_json(
                     f"{base}/debug/allocations", http_timeout_s
                 )
@@ -749,4 +762,15 @@ def validate_bundle(bundle: dict) -> List[str]:
                    f"sampler_windows.{field} must be an object")
     expect(isinstance(bundle.get("traces"), list), "traces must be a list")
     expect(isinstance(bundle.get("agent"), dict), "agent must be an object")
+    if "subsystems" in bundle:  # absent only in pre-supervision bundles
+        subsystems = bundle["subsystems"]
+        expect(isinstance(subsystems, dict), "subsystems must be an object")
+        for name, sub in (
+            subsystems.items() if isinstance(subsystems, dict) else []
+        ):
+            if not isinstance(sub, dict):
+                problems.append(f"subsystems[{name!r}] must be an object")
+                continue
+            for field in ("criticality", "state", "restarts"):
+                expect(field in sub, f"subsystems[{name!r}] missing {field!r}")
     return problems

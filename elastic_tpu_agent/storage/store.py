@@ -15,8 +15,10 @@ import logging
 import os
 import sqlite3
 import threading
+import time
 from typing import Callable, Iterator, Optional, Tuple
 
+from .. import faults
 from ..types import PodInfo
 
 logger = logging.getLogger(__name__)
@@ -24,6 +26,17 @@ logger = logging.getLogger(__name__)
 
 class StorageError(Exception):
     pass
+
+
+# How long SQLite itself waits on a locked database before erroring
+# (PRAGMA busy_timeout, milliseconds). A slow WAL checkpoint — or the
+# node-doctor reading the db file while a bind commits — must not fail
+# the bind.
+BUSY_TIMEOUT_MS = 5000
+# Belt and braces on top of busy_timeout: one application-level retry
+# for transient "database is locked" errors before the write becomes a
+# StorageError.
+_LOCKED_RETRY_DELAY_S = 0.05
 
 
 _SCHEMA = """
@@ -50,10 +63,40 @@ class Storage:
             self._db = sqlite3.connect(path, check_same_thread=False)
             self._db.execute("PRAGMA journal_mode=WAL")
             self._db.execute("PRAGMA synchronous=NORMAL")
+            self._db.execute(f"PRAGMA busy_timeout={BUSY_TIMEOUT_MS}")
             self._db.execute(_SCHEMA)
             self._db.commit()
         except sqlite3.Error as e:
             raise StorageError(f"open {path}: {e}") from e
+
+    @staticmethod
+    def _is_transient_lock(e: sqlite3.Error) -> bool:
+        return isinstance(e, sqlite3.OperationalError) and (
+            "database is locked" in str(e) or "database is busy" in str(e)
+        )
+
+    def _write(self, what: str, sql: str, params: tuple) -> None:
+        """Execute+commit under the lock, retrying ONCE on a transient
+        lock error (a concurrent writer on another connection — e.g. a
+        node-doctor run against the live db — outlasting busy_timeout)."""
+        for attempt in (1, 2):
+            try:
+                self._db.execute(sql, params)
+                self._db.commit()
+                return
+            except sqlite3.Error as e:
+                transient = self._is_transient_lock(e) and attempt == 1
+                try:
+                    self._db.rollback()  # clear the failed statement
+                except sqlite3.Error:
+                    pass
+                if not transient:
+                    raise StorageError(f"{what}: {e}") from e
+                logger.warning(
+                    "%s hit %s; retrying once after %.0fms",
+                    what, e, _LOCKED_RETRY_DELAY_S * 1000,
+                )
+                time.sleep(_LOCKED_RETRY_DELAY_S)
 
     # Exceptions meaning "this stored value does not parse as a PodInfo".
     _CORRUPT = (json.JSONDecodeError, KeyError, TypeError, AttributeError)
@@ -61,16 +104,14 @@ class Storage:
     # -- CRUD ----------------------------------------------------------------
 
     def save(self, pod: PodInfo) -> None:
+        faults.fire("storage.save")
         with self._lock:
-            try:
-                self._db.execute(
-                    "INSERT INTO pods(key, value) VALUES(?, ?) "
-                    "ON CONFLICT(key) DO UPDATE SET value=excluded.value",
-                    (pod.key, pod.to_json()),
-                )
-                self._db.commit()
-            except sqlite3.Error as e:
-                raise StorageError(f"save {pod.key}: {e}") from e
+            self._write(
+                f"save {pod.key}",
+                "INSERT INTO pods(key, value) VALUES(?, ?) "
+                "ON CONFLICT(key) DO UPDATE SET value=excluded.value",
+                (pod.key, pod.to_json()),
+            )
 
     def load(self, namespace: str, name: str) -> Optional[PodInfo]:
         """Return the stored PodInfo, or None when absent (reference returns
@@ -102,14 +143,13 @@ class Storage:
             return pod
 
     def delete(self, namespace: str, name: str) -> None:
+        faults.fire("storage.delete")
         with self._lock:
-            try:
-                self._db.execute(
-                    "DELETE FROM pods WHERE key=?", (f"{namespace}/{name}",)
-                )
-                self._db.commit()
-            except sqlite3.Error as e:
-                raise StorageError(f"delete {namespace}/{name}: {e}") from e
+            self._write(
+                f"delete {namespace}/{name}",
+                "DELETE FROM pods WHERE key=?",
+                (f"{namespace}/{name}",),
+            )
 
     def for_each(self, fn: Callable[[PodInfo], None]) -> None:
         """Invoke fn on a snapshot of every stored PodInfo.

@@ -26,6 +26,7 @@ from abc import ABC, abstractmethod
 from dataclasses import dataclass, field
 from typing import List, Optional
 
+from .. import faults
 from ..common import VirtualDevPrefix
 from ..tracing import get_tracer
 
@@ -133,6 +134,7 @@ class LinkingOperator(TPUOperator):
         return os.path.join(self._target_root, f"accel{index}")
 
     def create(self, index: int, link_id: str) -> None:
+        faults.fire("operator.create")
         link = self.link_path(link_id)
         target = self.target_path(index)
         with get_tracer().span("operator_create", link=link, target=target):
@@ -147,6 +149,7 @@ class LinkingOperator(TPUOperator):
         logger.info("created virtual TPU node %s -> %s", link, target)
 
     def delete(self, link_id: str) -> None:
+        faults.fire("operator.delete")
         link = self.link_path(link_id)
         with get_tracer().span("operator_delete", link=link):
             try:

@@ -473,3 +473,90 @@ def test_pick_chip_set_greedy_respects_pinned():
     )
     grid = chip_grid(n)
     assert ici_distance(grid[order[0]], grid[pinned_chip]) <= 1, order[0]
+
+
+# -- kubelet socket flap storms (plugins/base re-register loop) ---------------
+
+
+def test_kubelet_socket_flap_storm_settles_with_one_reregister_each(harness):
+    """Rapid repeated kubelet.sock re-creation while Allocate traffic is in
+    flight: the storm must coalesce (one watcher poll sees one change) so
+    each plugin re-registers exactly once, keeps serving afterwards, and
+    no server run-loop threads are leaked or replaced."""
+    import time as _time
+
+    import grpc
+
+    def _dp_threads():
+        return {
+            t.ident for t in threading.enumerate()
+            if t.name.startswith("dp-server-") and t.is_alive()
+        }
+
+    # a prior test's server threads exit within one 1s stop-poll; wait
+    # them out so the leak assertion below sees only this harness's two
+    end = _time.monotonic() + 10.0
+    while len(_dp_threads()) != 2 and _time.monotonic() < end:
+        _time.sleep(0.05)
+    dp_threads_before = _dp_threads()
+    assert len(dp_threads_before) == 2  # one run loop per resource
+
+    before = len(harness.kubelet.registrations)
+    stop_traffic = threading.Event()
+    hard_errors = []
+
+    def traffic():
+        client = harness.kubelet.plugin_client(CORE_ENDPOINT)
+        i = 0
+        while not stop_traffic.is_set():
+            ids = [core_device_id(3, (i * 5 + u) % 100) for u in range(5)]
+            try:
+                client.allocate(ids)
+            except grpc.RpcError:
+                pass  # mid-restart blips are expected; wedging is not
+            except Exception as e:  # pragma: no cover
+                hard_errors.append(e)
+                return
+            i += 1
+            _time.sleep(0.01)
+
+    t = threading.Thread(target=traffic, daemon=True)
+    t.start()
+    try:
+        # five flaps well inside one 1s watcher poll: must coalesce
+        for _ in range(5):
+            harness.kubelet.restart_registration()
+            _time.sleep(0.03)
+        assert harness.kubelet.wait_registrations(before + 2, timeout=15.0), (
+            "plugins did not re-register after the flap storm"
+        )
+    finally:
+        stop_traffic.set()
+        t.join(timeout=10.0)
+    assert not hard_errors, f"allocate traffic wedged: {hard_errors}"
+    # settle: exactly one re-register per plugin, none trickling in later
+    settle_end = _time.monotonic() + 2.5
+    while _time.monotonic() < settle_end:
+        _time.sleep(0.1)
+    assert len(harness.kubelet.registrations) == before + 2, (
+        "flap storm did not coalesce to one re-register per plugin"
+    )
+    reregistered = {
+        r.resource_name for r in harness.kubelet.registrations[before:]
+    }
+    assert reregistered == {ResourceTPUCore, ResourceTPUMemory}
+    # no leaked or replaced run-loop threads: same two, still alive
+    dp_threads_after = {
+        t.ident for t in threading.enumerate()
+        if t.name.startswith("dp-server-") and t.is_alive()
+    }
+    assert dp_threads_after == dp_threads_before
+    # and the re-registered servers still serve the full flow
+    harness.sitter.add_pod(
+        "default", "post-flap", assumed_annotations("jax", "2")
+    )
+    ids = [core_device_id(2, i) for i in range(10)]
+    resp = harness.kubelet.kubelet_allocate_flow(
+        CORE_ENDPOINT, "default", "post-flap", "jax", ResourceTPUCore, ids
+    )
+    assert resp.container_responses[0].envs["TPU_VISIBLE_CHIPS"] == "0"

@@ -118,3 +118,101 @@ def test_concurrent_writers(store):
         t.join()
     assert not errs
     assert len(list(store.items())) == 160
+
+
+def test_concurrent_writers_across_connections(tmp_path):
+    """Two Storage instances (separate sqlite connections — the agent plus
+    a node-doctor run against the live db) hammering the same file: with
+    PRAGMA busy_timeout + the retry-once guard, no write may fail on
+    'database is locked'."""
+    path = str(tmp_path / "meta.db")
+    s1, s2 = Storage(path), Storage(path)
+    errs = []
+
+    def writer(store, tag):
+        try:
+            for j in range(40):
+                store.save(make_pod(name=f"pod-{tag}-{j}"))
+                if j % 3 == 0:
+                    store.delete("default", f"pod-{tag}-{j}")
+        except Exception as e:  # pragma: no cover
+            errs.append(e)
+
+    threads = [
+        threading.Thread(target=writer, args=(s, t))
+        for s, t in ((s1, "a"), (s2, "b"), (s1, "c"), (s2, "d"))
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errs, f"cross-connection writes failed: {errs}"
+    # every non-deleted record from both connections is visible
+    expected = {
+        f"default/pod-{tag}-{j}"
+        for tag in "abcd" for j in range(40) if j % 3 != 0
+    }
+    assert {key for key, _ in s1.items()} == expected
+    s1.close()
+    s2.close()
+
+
+def test_save_retries_once_on_transient_lock(store):
+    """A single 'database is locked' blip (WAL checkpoint outlasting
+    busy_timeout) must not fail a bind: save retries once."""
+    import sqlite3
+
+    real = store._db
+
+    class FlakyConn:
+        def __init__(self):
+            self.failed = 0
+
+        def execute(self, sql, params=()):
+            if sql.startswith("INSERT") and self.failed == 0:
+                self.failed += 1
+                raise sqlite3.OperationalError("database is locked")
+            return real.execute(sql, params)
+
+        def commit(self):
+            return real.commit()
+
+        def rollback(self):
+            return real.rollback()
+
+    store._db = FlakyConn()
+    try:
+        store.save(make_pod(name="locked-once"))
+        assert store._db.failed == 1
+    finally:
+        store._db = real
+    assert store.load("default", "locked-once") is not None
+
+
+def test_save_fails_after_persistent_lock(store):
+    """The retry is ONCE: a persistently-locked database still surfaces a
+    StorageError instead of looping forever."""
+    import sqlite3
+
+    from elastic_tpu_agent.storage.store import StorageError
+
+    real = store._db
+
+    class DeadConn:
+        def execute(self, sql, params=()):
+            if sql.startswith("INSERT"):
+                raise sqlite3.OperationalError("database is locked")
+            return real.execute(sql, params)
+
+        def commit(self):
+            return real.commit()
+
+        def rollback(self):
+            return real.rollback()
+
+    store._db = DeadConn()
+    try:
+        with pytest.raises(StorageError):
+            store.save(make_pod(name="never"))
+    finally:
+        store._db = real

@@ -1,0 +1,735 @@
+"""Subsystem supervision + fault-injection (chaos) coverage.
+
+The agent is a node-critical DaemonSet: before supervisor.py, any of its
+~8 background loops dying on an uncaught exception silently evaporated
+the thread while the node kept advertising fractional resources with
+stale health, no reclamation, or a dead ListAndWatch. These tests prove
+the reflexes: every supervised loop restarts with backoff, repeated
+crashes trip the circuit breaker instead of thrashing, critical
+failures flip /healthz to 503 (the liveness-probe contract) while
+degraded failures keep binding alive, and the faults.py registry can
+kill each real subsystem deterministically from outside.
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+from prometheus_client import CollectorRegistry
+
+from elastic_tpu_agent import faults
+from elastic_tpu_agent.common import (
+    AnnotationAssumed,
+    ResourceTPUCore,
+    container_annotation,
+)
+from elastic_tpu_agent.kube.sitter import Sitter
+from elastic_tpu_agent.metrics import AgentMetrics
+from elastic_tpu_agent.plugins.tpushare import CORE_ENDPOINT, core_device_id
+from elastic_tpu_agent.supervisor import (
+    CRITICAL,
+    DEGRADED,
+    STATE_DONE,
+    STATE_FAILED,
+    STATE_RUNNING,
+    STATE_STOPPED,
+    Supervisor,
+    install_thread_excepthook,
+    thread_crash_count,
+    uninstall_thread_excepthook,
+)
+
+from fake_apiserver import make_pod
+from test_e2e import Cluster, wait_until
+
+
+@pytest.fixture(autouse=True)
+def _disarm_faults():
+    """Faults are process-global; never leak an armed point across tests."""
+    yield
+    faults.get_registry().disarm()
+
+
+# -- supervisor unit behavior -------------------------------------------------
+
+
+def test_crashed_subsystem_restarts_and_reports():
+    sup = Supervisor(backoff_min_s=0.01, backoff_max_s=0.05)
+    stop = threading.Event()
+    crashes = {"n": 0}
+    recovered = threading.Event()
+
+    def flaky(stop_ev):
+        if crashes["n"] < 2:
+            crashes["n"] += 1
+            raise RuntimeError("boom")
+        recovered.set()
+        stop_ev.wait()
+
+    sup.register("flaky", flaky, CRITICAL)
+    sup.start(stop)
+    assert recovered.wait(10.0), "subsystem never came back"
+    st = sup.status()["flaky"]
+    assert st["restarts"] == 2
+    assert st["state"] == STATE_RUNNING
+    assert "boom" in st["last_error"]
+    assert st["criticality"] == CRITICAL
+    assert not sup.terminal.is_set()
+    stop.set()
+    assert sup.wait_terminal(5.0)
+
+
+def test_restart_backoff_is_at_least_exponential_floor():
+    """Crashes must not be restarted in a hot spin: with jitter in
+    [0.5x, 1.5x] and doubling backoff, three restarts take at least
+    0.5*(b + 2b + 4b). Lower-bound timing only — robust on slow CI."""
+    b = 0.05
+    sup = Supervisor(
+        backoff_min_s=b, backoff_max_s=10 * b, crash_loop_threshold=10
+    )
+    stop = threading.Event()
+    t0 = time.monotonic()
+
+    def always_crash(stop_ev):
+        raise RuntimeError("crash forever")
+
+    sup.register("hot", always_crash, DEGRADED)
+    sup.start(stop)
+    # restarts increments BEFORE each backoff sleep: by restart #4 the
+    # first three backoff intervals have fully elapsed.
+    assert wait_until(
+        lambda: sup.status()["hot"]["restarts"] >= 4, timeout=30.0
+    )
+    elapsed = time.monotonic() - t0
+    assert elapsed >= 0.5 * (b + 2 * b + 4 * b), (
+        f"4 restarts in {elapsed:.3f}s — backoff not applied"
+    )
+    stop.set()
+
+
+def test_crash_loop_critical_opens_breaker_and_healthz_503():
+    registry = CollectorRegistry()
+    m = AgentMetrics(registry=registry)
+    sup = Supervisor(
+        metrics=m, crash_loop_threshold=3,
+        backoff_min_s=0.01, backoff_max_s=0.02,
+    )
+    m.attach_supervisor(sup)
+    m.serve(0)
+    try:
+        stop = threading.Event()
+
+        def doa(stop_ev):
+            raise RuntimeError("dead on arrival")
+
+        sup.register("gc", doa, CRITICAL)
+        sup.start(stop)
+        # the critical circuit break IS the terminal event
+        assert sup.wait_terminal(10.0)
+        st = sup.status()["gc"]
+        assert st["state"] == STATE_FAILED
+        assert st["crash_loops"] == 1
+        assert st["restarts"] == 2  # threshold 3: two restarts, then break
+        assert sup.critical_failed() == ["gc"]
+        with pytest.raises(urllib.error.HTTPError) as exc_info:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{m.http_port}/healthz", timeout=10
+            )
+        assert exc_info.value.code == 503
+        payload = json.loads(exc_info.value.read())
+        assert payload["status"] == "failing"
+        assert payload["critical_failed"] == ["gc"]
+        assert payload["subsystems"]["gc"]["state"] == "failed"
+        # metrics contract
+        assert registry.get_sample_value(
+            "elastic_tpu_subsystem_restarts_total", {"subsystem": "gc"}
+        ) == 2
+        assert registry.get_sample_value(
+            "elastic_tpu_subsystem_crash_loops_total", {"subsystem": "gc"}
+        ) == 1
+        assert registry.get_sample_value(
+            "elastic_tpu_subsystem_up", {"subsystem": "gc"}
+        ) == 0
+        stop.set()
+    finally:
+        m.close()
+
+
+def test_crash_loop_degraded_keeps_healthz_200():
+    registry = CollectorRegistry()
+    m = AgentMetrics(registry=registry)
+    sup = Supervisor(
+        metrics=m, crash_loop_threshold=2,
+        backoff_min_s=0.01, backoff_max_s=0.02,
+    )
+    m.attach_supervisor(sup)
+    m.serve(0)
+    try:
+        stop = threading.Event()
+
+        def doa(stop_ev):
+            raise RuntimeError("sampler exploded")
+
+        sup.register("sampler", doa, DEGRADED)
+        sup.start(stop)
+        assert wait_until(
+            lambda: sup.status()["sampler"]["state"] == STATE_FAILED,
+            timeout=10.0,
+        )
+        # degraded failure must NOT kill the agent...
+        assert not sup.terminal.is_set()
+        assert sup.critical_failed() == []
+        assert "sampler" in sup.degraded_subsystems()
+        # ...and /healthz stays 200, with the state in the JSON
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{m.http_port}/healthz", timeout=10
+        ) as resp:
+            assert resp.status == 200
+            payload = json.loads(resp.read())
+        assert payload["status"] == "degraded"
+        assert "sampler" in payload["degraded"]
+        assert payload["subsystems"]["sampler"]["state"] == "failed"
+        stop.set()
+    finally:
+        m.close()
+
+
+def test_silent_return_before_stop_is_a_crash():
+    """A loop returning while the agent runs is exactly the
+    silently-evaporating-thread bug; the supervisor must treat it as one."""
+    sup = Supervisor(
+        crash_loop_threshold=2, backoff_min_s=0.01, backoff_max_s=0.02
+    )
+    stop = threading.Event()
+    sup.register("quitter", lambda stop_ev: None, DEGRADED)
+    sup.start(stop)
+    assert wait_until(
+        lambda: sup.status()["quitter"]["state"] == STATE_FAILED, timeout=10.0
+    )
+    assert "returned before stop" in sup.status()["quitter"]["last_error"]
+    stop.set()
+
+
+def test_one_shot_completes_without_restart():
+    sup = Supervisor(backoff_min_s=0.01)
+    stop = threading.Event()
+    ran = threading.Event()
+    sup.register("check", lambda stop_ev: ran.set(), DEGRADED, one_shot=True)
+    sup.start(stop)
+    assert ran.wait(5.0)
+    assert wait_until(
+        lambda: sup.status()["check"]["state"] == STATE_DONE, timeout=5.0
+    )
+    assert sup.status()["check"]["restarts"] == 0
+    stop.set()
+
+
+def test_clean_exit_predicate_recognized():
+    """An owner-stopped subsystem (e.g. a sink draining on stop()) exits
+    cleanly even though the global stop is not set."""
+    sup = Supervisor(backoff_min_s=0.01)
+    stop = threading.Event()
+    owner_stopped = threading.Event()
+
+    def loop(stop_ev):
+        owner_stopped.wait(10.0)
+
+    sup.register(
+        "sink", loop, DEGRADED, clean_exit=owner_stopped.is_set
+    )
+    sup.start(stop)
+    assert wait_until(
+        lambda: sup.status()["sink"]["state"] == STATE_RUNNING, timeout=5.0
+    )
+    owner_stopped.set()
+    assert wait_until(
+        lambda: sup.status()["sink"]["state"] == STATE_STOPPED, timeout=5.0
+    )
+    assert sup.status()["sink"]["restarts"] == 0
+    stop.set()
+
+
+def test_die_thread_fault_is_trapped_and_restarted():
+    """die-thread raises a BaseException that sails past the loops' own
+    `except Exception` guards — only the supervisor can catch it."""
+    sup = Supervisor(backoff_min_s=0.01, backoff_max_s=0.02)
+    stop = threading.Event()
+    recovered = threading.Event()
+    faults.get_registry().arm("test.die", "die-thread:1")
+
+    def loop(stop_ev):
+        while not stop_ev.is_set():
+            try:
+                faults.fire("test.die")
+            except faults.FaultError:
+                pass  # the Exception-level trap a real loop would have
+            recovered.set()
+            stop_ev.wait(0.05)
+
+    sup.register("victim", loop, DEGRADED)
+    sup.start(stop)
+    assert recovered.wait(10.0)
+    assert wait_until(
+        lambda: sup.status()["victim"]["restarts"] == 1, timeout=10.0
+    )
+    assert "DieThread" in sup.status()["victim"]["last_error"]
+    stop.set()
+
+
+def test_duplicate_registration_rejected():
+    sup = Supervisor()
+    sup.register("x", lambda stop_ev: None)
+    with pytest.raises(ValueError):
+        sup.register("x", lambda stop_ev: None)
+
+
+# -- faults registry ----------------------------------------------------------
+
+
+def test_fault_specs_parse_and_count():
+    reg = faults.get_registry()
+    reg.arm("p.raise", "raise:2")
+    for _ in range(2):
+        with pytest.raises(faults.FaultError):
+            faults.fire("p.raise")
+    faults.fire("p.raise")  # exhausted: disarmed, no-op
+    assert "p.raise" not in reg.armed()
+
+    reg.arm("p.delay", "delay:0.05")
+    t0 = time.monotonic()
+    faults.fire("p.delay")
+    assert time.monotonic() - t0 >= 0.04
+    assert reg.fired("p.delay") == 1
+    reg.disarm("p.delay")
+
+    with pytest.raises(ValueError):
+        reg.arm("p.bad", "explode")
+    with pytest.raises(ValueError):
+        reg.arm_spec("no-equals-sign")
+
+    reg.arm_spec("a.b=raise-once, c.d=die-thread:1")
+    assert set(reg.armed()) >= {"a.b", "c.d"}
+    with pytest.raises(faults.DieThread):
+        faults.fire("c.d")
+    reg.disarm()
+    faults.fire("a.b")  # disarmed registry: everything is a no-op
+
+
+# -- process-wide thread-death accounting -------------------------------------
+
+
+def test_thread_excepthook_counts_unsupervised_deaths():
+    registry = CollectorRegistry()
+    m = AgentMetrics(registry=registry)
+    # silence the chained previous hook (pytest installs its own reporter)
+    saved = threading.excepthook
+    threading.excepthook = lambda args: None
+    prev = install_thread_excepthook(m)
+    try:
+        base = thread_crash_count()
+        t = threading.Thread(target=lambda: 1 / 0, name="doomed")
+        t.start()
+        t.join(5.0)
+        assert wait_until(lambda: thread_crash_count() == base + 1)
+        assert registry.get_sample_value(
+            "elastic_tpu_thread_crashes_total"
+        ) == 1
+    finally:
+        uninstall_thread_excepthook(prev)
+        threading.excepthook = saved
+
+
+# -- sitter resilience (satellite) --------------------------------------------
+
+
+class _FlakyKubeClient:
+    """list_pods fails N times, then succeeds; watch expires instantly."""
+
+    def __init__(self, fail_n):
+        self.fails_left = fail_n
+        self.list_calls = 0
+
+    def list_pods(self, node):
+        self.list_calls += 1
+        if self.fails_left > 0:
+            self.fails_left -= 1
+            raise RuntimeError("injected: apiserver down")
+        return [], "rv-1"
+
+    def watch_pods(self, node, rv, timeout_s):
+        time.sleep(0.02)  # a short-lived watch, then re-list
+        return iter(())
+
+
+def test_sitter_retries_with_backoff_and_tracks_sync_age(monkeypatch):
+    import elastic_tpu_agent.kube.sitter as sitter_mod
+
+    monkeypatch.setattr(sitter_mod, "RETRY_MIN_S", 0.02)
+    monkeypatch.setattr(sitter_mod, "RETRY_MAX_S", 0.1)
+    client = _FlakyKubeClient(fail_n=3)
+    sitter = Sitter(client, "node-x")
+    assert sitter.sync_age_s() is None, "never synced yet"
+    stop = threading.Event()
+    t = threading.Thread(target=sitter.run, args=(stop,), daemon=True)
+    t.start()
+    try:
+        assert sitter.wait_synced(10.0), "sitter never recovered"
+        assert client.list_calls >= 4  # 3 failures + the success
+        age = sitter.sync_age_s()
+        assert age is not None and age < 5.0
+    finally:
+        stop.set()
+        t.join(timeout=5.0)
+
+
+# -- integration: kill each supervised subsystem in the real manager ----------
+
+
+def _annotate(cluster, pod_name, chips):
+    cluster.apiserver.upsert_pod(
+        make_pod(
+            "default", pod_name, cluster.node,
+            annotations={
+                AnnotationAssumed: "true",
+                container_annotation("jax"): chips,
+            },
+            containers=[{"name": "jax"}],
+        )
+    )
+    assert wait_until(
+        lambda: cluster.manager.sitter.get_pod("default", pod_name) is not None
+    )
+
+
+@pytest.fixture()
+def supervised_cluster(tmp_path):
+    registry = CollectorRegistry()
+    metrics = AgentMetrics(registry=registry)
+    c = Cluster(tmp_path, metrics=metrics)
+    # fast reflexes for the test: short restart backoff + tight loops
+    sup = c.manager.supervisor
+    sup._backoff_min_s = 0.02
+    sup._backoff_max_s = 0.1
+    c.manager.sampler.period_s = 0.1
+    c.manager.plugin.HEALTH_PERIOD_S = 0.1
+    c.registry = registry
+    c.start()
+    yield c
+    faults.get_registry().disarm()
+    c.stop()
+    metrics.close()
+
+
+def test_each_supervised_subsystem_recovers_from_thread_death(
+    supervised_cluster,
+):
+    """Acceptance: with fault injection armed, killing each supervised
+    subsystem in turn shows a restart and a restarts_total increment."""
+    c = supervised_cluster
+    sup = c.manager.supervisor
+    reg = faults.get_registry()
+    pod_seq = iter(range(100))
+
+    def poke_sitter():
+        # any watch event fires the sitter.watch failpoint
+        _ = next(pod_seq)
+        c.apiserver.upsert_pod(
+            make_pod("default", f"poke-{_}", c.node, annotations={},
+                     containers=[{"name": "jax"}])
+        )
+
+    def poke_gc():
+        c.manager.gc_queue.put(
+            {"metadata": {"namespace": "default", "name": "nonexistent"}}
+        )
+
+    cases = [
+        ("sitter", "sitter.watch", poke_sitter),
+        ("gc", "gc.sweep", poke_gc),
+        ("health", "health.poll", None),
+        ("sampler", "sampler.sample", None),
+    ]
+    for name, point, poke in cases:
+        before = sup.status()[name]["restarts"]
+        reg.arm(point, "die-thread:1")
+        if poke is not None:
+            poke()
+        assert wait_until(
+            lambda: sup.status()[name]["restarts"] >= before + 1,
+            timeout=20.0,
+        ), f"{name} was not restarted after thread death"
+        assert wait_until(
+            lambda: sup.status()[name]["state"] == STATE_RUNNING,
+            timeout=20.0,
+        ), f"{name} did not come back to running"
+        assert c.registry.get_sample_value(
+            "elastic_tpu_subsystem_restarts_total", {"subsystem": name}
+        ) >= 1, f"restart metric missing for {name}"
+    # the storm is over: the node is healthy again
+    assert sup.critical_failed() == []
+
+
+def test_forced_crash_loop_critical_gc_fails_healthz(tmp_path):
+    """Acceptance: a forced crash loop on a CRITICAL subsystem opens the
+    circuit breaker and flips /healthz to 503 (liveness-probe contract)."""
+    registry = CollectorRegistry()
+    metrics = AgentMetrics(registry=registry)
+    metrics.serve(0)
+    c = Cluster(tmp_path, metrics=metrics)
+    sup = c.manager.supervisor
+    sup._crash_loop_threshold = 3
+    sup._backoff_min_s = 0.02
+    sup._backoff_max_s = 0.05
+    try:
+        c.start()
+        faults.get_registry().arm("gc.sweep", "die-thread")  # every time
+        # each restart consumes one queue item before crashing again
+        for _ in range(6):
+            c.manager.gc_queue.put(
+                {"metadata": {"namespace": "default", "name": "x"}}
+            )
+        assert wait_until(
+            lambda: sup.status()["gc"]["state"] == STATE_FAILED, timeout=20.0
+        ), "gc circuit breaker never opened"
+        assert sup.terminal.is_set(), (
+            "critical circuit break must fire the terminal event"
+        )
+        with pytest.raises(urllib.error.HTTPError) as exc_info:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{metrics.http_port}/healthz", timeout=10
+            )
+        assert exc_info.value.code == 503
+        payload = json.loads(exc_info.value.read())
+        assert "gc" in payload["critical_failed"]
+        assert registry.get_sample_value(
+            "elastic_tpu_subsystem_crash_loops_total", {"subsystem": "gc"}
+        ) == 1
+    finally:
+        faults.get_registry().disarm()
+        c.stop()
+        metrics.close()
+
+
+def test_forced_crash_loop_degraded_sampler_keeps_binding(tmp_path):
+    """Acceptance counterpart: a crash-looping NON-critical subsystem
+    degrades /healthz JSON but answers 200, and binds still work."""
+    registry = CollectorRegistry()
+    metrics = AgentMetrics(registry=registry)
+    metrics.serve(0)
+    c = Cluster(tmp_path, metrics=metrics)
+    sup = c.manager.supervisor
+    sup._crash_loop_threshold = 3
+    sup._backoff_min_s = 0.02
+    sup._backoff_max_s = 0.05
+    c.manager.sampler.period_s = 0.05
+    try:
+        c.start()
+        faults.get_registry().arm("sampler.sample", "die-thread")
+        assert wait_until(
+            lambda: sup.status()["sampler"]["state"] == STATE_FAILED,
+            timeout=20.0,
+        ), "sampler circuit breaker never opened"
+        assert not sup.terminal.is_set()
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{metrics.http_port}/healthz", timeout=10
+        ) as resp:
+            assert resp.status == 200
+            payload = json.loads(resp.read())
+        assert payload["status"] == "degraded"
+        assert "sampler" in payload["degraded"]
+        # staleness surfaced too (satellite): the cache is fresh here
+        assert payload["sitter_sync_age_s"] is not None
+        assert registry.get_sample_value(
+            "elastic_tpu_sitter_sync_age_seconds"
+        ) is not None
+        # binding is ALIVE despite the degraded subsystem
+        faults.get_registry().disarm()  # sampler stays failed; binds clean
+        _annotate(c, "still-binds", "1")
+        ids = [core_device_id(1, i) for i in range(50)]
+        resp = c.kubelet.kubelet_allocate_flow(
+            CORE_ENDPOINT, "default", "still-binds", "jax",
+            ResourceTPUCore, ids,
+        )
+        assert resp.container_responses[0].envs["TPU_VISIBLE_CHIPS"] == "0"
+    finally:
+        faults.get_registry().disarm()
+        c.stop()
+        metrics.close()
+
+
+def test_chaos_soak_all_loops_recover_and_agent_converges(supervised_cluster):
+    """Chaos soak: kill every supervised loop while bind/delete traffic is
+    in flight; after disarming, every subsystem is running, a fresh bind
+    succeeds, GC reclaims, and nothing circuit-broke."""
+    c = supervised_cluster
+    sup = c.manager.supervisor
+    reg = faults.get_registry()
+    stop_traffic = threading.Event()
+    errors = []
+
+    def traffic():
+        i = 0
+        while not stop_traffic.is_set() and i < 50:
+            name = f"chaos-{i}"
+            chip = i % 4
+            try:
+                _annotate(c, name, str(chip))
+                ids = [core_device_id(chip, (i * 7) % 50 + u)
+                       for u in range(10)]
+                c.kubelet.kubelet_allocate_flow(
+                    CORE_ENDPOINT, "default", name, "jax",
+                    ResourceTPUCore, ids,
+                )
+                c.apiserver.delete_pod("default", name)
+                c.kubelet.unassign_pod("default", name)
+            except Exception as e:  # noqa: BLE001
+                errors.append((name, e))
+            i += 1
+            time.sleep(0.02)
+
+    t = threading.Thread(target=traffic, daemon=True)
+    t.start()
+    try:
+        for point in ("sitter.watch", "gc.sweep", "health.poll",
+                      "sampler.sample"):
+            reg.arm(point, "die-thread:1")
+            time.sleep(0.3)
+        c.manager.gc_queue.put(
+            {"metadata": {"namespace": "default", "name": "wake"}}
+        )
+        # transient storage + operator hiccups ride along (handled paths)
+        reg.arm("storage.save", "raise:1")
+        reg.arm("operator.create", "raise:1")
+        time.sleep(1.0)
+    finally:
+        stop_traffic.set()
+        t.join(timeout=30.0)
+        reg.disarm()
+    # convergence: every loop is back, nothing circuit-broke
+    for name in ("sitter", "gc", "health", "sampler"):
+        assert wait_until(
+            lambda: sup.status()[name]["state"] == STATE_RUNNING,
+            timeout=20.0,
+        ), f"{name} did not recover: {sup.status()[name]}"
+    assert sup.critical_failed() == []
+    assert not sup.terminal.is_set()
+    # a clean bind works end to end after the storm
+    _annotate(c, "post-chaos", "2")
+    ids = [core_device_id(2, i) for i in range(100)]
+    resp = c.kubelet.kubelet_allocate_flow(
+        CORE_ENDPOINT, "default", "post-chaos", "jax", ResourceTPUCore, ids
+    )
+    assert resp.container_responses[0].envs["TPU_VISIBLE_CHIPS"] == "0"
+    # and GC still reclaims
+    c.apiserver.delete_pod("default", "post-chaos")
+    c.kubelet.unassign_pod("default", "post-chaos")
+    assert wait_until(
+        lambda: c.manager.storage.load("default", "post-chaos") is None,
+        timeout=20.0,
+    ), "GC did not reclaim after the chaos storm"
+
+
+def test_sink_worker_death_is_supervised(tmp_path):
+    """The CRD/event sink workers are watchdogged: a fault-killed worker
+    thread is respawned by the supervisor and keeps draining."""
+    registry = CollectorRegistry()
+    metrics = AgentMetrics(registry=registry)
+    c = Cluster(tmp_path, metrics=metrics)
+    sup = c.manager.supervisor
+    sup._backoff_min_s = 0.02
+    sup._backoff_max_s = 0.1
+    try:
+        c.start()
+        assert c.manager.events is not None
+        before = sup.status()["events"]["restarts"]
+        faults.get_registry().arm("sink.event-recorder", "die-thread:1")
+        # any event submission wakes the worker into the failpoint
+        c.manager.events.node_event("ChaosPoke", "poke the sink")
+        assert wait_until(
+            lambda: sup.status()["events"]["restarts"] >= before + 1,
+            timeout=20.0,
+        ), "events sink worker death went unnoticed"
+        assert wait_until(
+            lambda: sup.status()["events"]["state"] == STATE_RUNNING,
+            timeout=20.0,
+        )
+        # the failpoint fires BEFORE the batch is claimed: the queued poke
+        # event must survive the worker crash and land via the respawn
+        assert wait_until(
+            lambda: any(
+                e.get("reason") == "ChaosPoke"
+                for e in c.apiserver.core_events
+            ),
+            timeout=20.0,
+        ), "event queued at crash time was dropped"
+        # and the respawned worker keeps draining new work
+        faults.get_registry().disarm()
+        c.manager.events.node_event("ChaosPoke2", "post-restart event")
+        assert c.manager.events.flush(timeout=10.0)
+    finally:
+        faults.get_registry().disarm()
+        c.stop()
+        metrics.close()
+
+
+def test_doctor_bundle_carries_subsystem_states(tmp_path):
+    """node-doctor pulls supervision state through the live agent's
+    /healthz into a top-level `subsystems` section (schema-checked)."""
+    from elastic_tpu_agent.sampler import (
+        build_diagnostics_bundle,
+        validate_bundle,
+    )
+
+    registry = CollectorRegistry()
+    metrics = AgentMetrics(registry=registry)
+    metrics.serve(0)
+    c = Cluster(tmp_path, metrics=metrics)
+    try:
+        c.start()
+        assert wait_until(
+            lambda: c.manager.supervisor.status()["gc"]["state"]
+            == STATE_RUNNING,
+            timeout=10.0,
+        )
+        bundle = build_diagnostics_bundle(
+            c.manager.operator,
+            sampler=c.manager.sampler,
+            node_name=c.node,
+            agent_url=f"http://127.0.0.1:{metrics.http_port}",
+        )
+        assert validate_bundle(bundle) == []
+        assert bundle["agent"]["reachable"] is True
+        assert bundle["subsystems"]["gc"]["state"] == "running"
+        assert bundle["subsystems"]["gc"]["criticality"] == "critical"
+        assert "sitter" in bundle["subsystems"]
+    finally:
+        c.stop()
+        metrics.close()
+
+
+def test_metrics_serve_with_retry_recovers_contended_port():
+    """A contended metrics port (old agent pod draining on hostNetwork)
+    must not leave the agent permanently endpoint-less now that the
+    liveness probe depends on /healthz: the bind retries until the port
+    frees and the probe starts answering."""
+    holder = AgentMetrics(registry=CollectorRegistry())
+    holder.serve(0)
+    port = holder.http_port
+    contender = AgentMetrics(registry=CollectorRegistry())
+    try:
+        assert contender.serve_with_retry(port, retry_s=0.1) is None
+        assert contender.http_port is None  # still contended
+        holder.close()  # the old pod finishes draining
+        assert wait_until(lambda: contender.http_port == port, timeout=10.0), (
+            "endpoint did not recover after the port freed"
+        )
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/healthz", timeout=10
+        ) as resp:
+            assert resp.status == 200
+    finally:
+        holder.close()
+        contender.close()
