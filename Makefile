@@ -5,7 +5,7 @@ TAG ?= elastic-tpu-agent:latest
 # verify's tier-1 line uses pipefail, which /bin/sh (dash) lacks
 SHELL := /bin/bash
 
-.PHONY: all native sanitize test test-all verify doctor-smoke chaos-smoke protos image bench clean
+.PHONY: all native sanitize test test-all verify doctor-smoke chaos-smoke bench-smoke protos image bench clean
 
 all: native test
 
@@ -53,8 +53,19 @@ chaos-smoke:
 	JAX_PLATFORMS=cpu python -m pytest tests/test_supervisor.py -q \
 	  -p no:cacheprovider && echo "chaos smoke: OK"
 
+# bench smoke: a tiny, deterministic concurrent-churn burst (bench.py
+# --churn-smoke) on the stub cluster, run in BOTH pipeline shapes
+# (striped+shared and the global-lock/dual-locator baseline), checked
+# against structural sanity thresholds — every bind succeeds, exactly
+# one record per pod, no O(n) storage scan on the bind path, the shared
+# snapshot actually reduces kubelet List traffic. Timing thresholds are
+# deliberately loose (5s p99 bound): the CI box's speed must not flake
+# the gate.
+bench-smoke:
+	JAX_PLATFORMS=cpu python3 bench.py --churn-smoke
+
 T1_TIMEOUT ?= 870
-verify: doctor-smoke chaos-smoke
+verify: doctor-smoke chaos-smoke bench-smoke
 	python -c "from prometheus_client import CollectorRegistry; \
 	  from elastic_tpu_agent.metrics import AgentMetrics; \
 	  AgentMetrics(registry=CollectorRegistry()); \

@@ -87,6 +87,11 @@ def parse_args(argv=None) -> argparse.Namespace:
                         "(default loopback; set 0.0.0.0 to allow "
                         "off-host Prometheus scrapes, as the shipped "
                         "DaemonSet does)")
+    p.add_argument("--dp-pool-size", type=int, default=8,
+                   help="gRPC worker threads per device-plugin resource "
+                        "server; kubelet binds containers concurrently, "
+                        "so size this to the expected bind burst "
+                        "(visible in /debug/allocations under 'bind')")
     p.add_argument("--sampler-period", type=float, default=10.0,
                    help="seconds between utilization/health samples "
                         "(sampler.py)")
@@ -292,6 +297,7 @@ def main(argv=None) -> int:
             enable_crd=not args.no_crd,
             enable_sampler=not args.no_sampler,
             sampler_period_s=args.sampler_period,
+            dp_pool_size=args.dp_pool_size,
             crash_loop_threshold=args.crash_loop_threshold,
         )
     )

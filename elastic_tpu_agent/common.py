@@ -9,6 +9,7 @@ themselves are TPU-native.
 
 from __future__ import annotations
 
+import contextlib
 import datetime
 import faulthandler
 import os
@@ -16,6 +17,8 @@ import random
 import signal
 import sys
 import threading
+import time
+import zlib
 
 # -- Extended resource names (TPU-native; reference: vendor types.go:105-112) --
 ResourceTPUCore = "elasticgpu.io/tpu-core"
@@ -122,6 +125,79 @@ def wait_for_exit_signal() -> int:
         signal.signal(s, _handler)
     ev.wait()
     return received[0] if received else 0
+
+
+class StripedLockSet:
+    """A fixed array of locks indexed by a stable hash of a string key.
+
+    The concurrency primitive behind the bind pipeline: kubelet drives
+    Allocate/PreStartContainer from a gRPC thread pool, so core+memory
+    sibling pairs for ONE container (same pod key) must serialize while
+    unrelated pods proceed in parallel. Striping (rather than a lock per
+    key) keeps memory bounded under pod churn; crc32 (not ``hash()``)
+    keys the stripes so the mapping is stable across processes and
+    PYTHONHASHSEED — reproducible in benchmarks and debuggable from a
+    stack dump.
+
+    ``stripes=1`` degenerates to a single global lock (the pre-striping
+    behavior; bench.py uses it as the same-run baseline).
+    """
+
+    def __init__(self, stripes: int = 64) -> None:
+        self._locks = tuple(
+            threading.Lock() for _ in range(max(1, stripes))
+        )
+        self._stats_lock = threading.Lock()
+        self.acquires_total = 0
+        self.contended_total = 0
+        self.wait_seconds_total = 0.0
+
+    @property
+    def stripes(self) -> int:
+        return len(self._locks)
+
+    def lock_for(self, key: str) -> "threading.Lock":
+        return self._locks[zlib.crc32(key.encode("utf-8")) % len(self._locks)]
+
+    def acquire_key(self, key: str) -> float:
+        """Block until the stripe for ``key`` is held; returns the seconds
+        spent waiting (0.0 when uncontended) so callers can export
+        contention. Pair with release_key(key)."""
+        lock = self.lock_for(key)
+        contended = not lock.acquire(blocking=False)
+        wait_s = 0.0
+        if contended:
+            t0 = time.monotonic()
+            lock.acquire()
+            wait_s = time.monotonic() - t0
+        with self._stats_lock:
+            self.acquires_total += 1
+            if contended:
+                self.contended_total += 1
+                self.wait_seconds_total += wait_s
+        return wait_s
+
+    def release_key(self, key: str) -> None:
+        self.lock_for(key).release()
+
+    @contextlib.contextmanager
+    def acquire(self, key: str):
+        """Context-manager form of acquire_key/release_key; yields the
+        wait seconds."""
+        wait_s = self.acquire_key(key)
+        try:
+            yield wait_s
+        finally:
+            self.release_key(key)
+
+    def stats(self) -> dict:
+        with self._stats_lock:
+            return {
+                "stripes": len(self._locks),
+                "acquires_total": self.acquires_total,
+                "contended_total": self.contended_total,
+                "wait_seconds_total": round(self.wait_seconds_total, 6),
+            }
 
 
 class JitteredBackoff:

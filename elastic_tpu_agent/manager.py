@@ -23,7 +23,7 @@ from typing import Optional
 
 from . import rpc, supervisor as supervision
 from .kube.client import KubeClient
-from .kube.locator import KubeletDeviceLocator
+from .kube.locator import KubeletDeviceLocator, PodResourcesSnapshotSource
 from .kube.sitter import Sitter
 from .plugins.base import PluginConfig
 from .plugins.tpushare import DEFAULT_ALLOC_SPEC_DIR, TPUSharePlugin
@@ -74,6 +74,15 @@ class ManagerOptions:
     # /debug/allocations and node-doctor.
     enable_sampler: bool = True
     sampler_period_s: float = 10.0
+    # One pod-resources snapshot shared by the core and memory locators:
+    # a cold core+memory bind pair costs ONE kubelet List instead of two,
+    # and either resource's Allocate-time prefetch warms both PreStarts.
+    # False restores the historical one-cache-per-resource shape (the
+    # bench's same-run baseline).
+    shared_locator_snapshot: bool = True
+    # gRPC worker threads per device-plugin resource server
+    # (plugins/base.py; CLI --dp-pool-size).
+    dp_pool_size: int = 8
     # Supervision (supervisor.py): a subsystem crashing this many times
     # inside the sliding window is circuit-broken (marked failed instead
     # of thrashing); critical subsystems then flip /healthz to 503 so the
@@ -197,14 +206,24 @@ class TPUManager:
                 self.metrics.attach_sampler(self.sampler)
         pr_client = rpc.PodResourcesClient(opts.pod_resources_socket)
         self.pr_client = pr_client
+        if opts.shared_locator_snapshot:
+            shared_source = PodResourcesSnapshotSource(pr_client)
+            locator_factory = lambda res: KubeletDeviceLocator(  # noqa: E731
+                res, source=shared_source
+            )
+        else:
+            locator_factory = lambda res: KubeletDeviceLocator(  # noqa: E731
+                res, pr_client
+            )
         self.config = PluginConfig(
             node_name=opts.node_name,
             device_plugin_dir=opts.device_plugin_dir,
             pod_resources_socket=opts.pod_resources_socket,
+            grpc_pool_size=opts.dp_pool_size,
             operator=self.operator,
             sitter=self.sitter,
             storage=self.storage,
-            locator_factory=lambda res: KubeletDeviceLocator(res, pr_client),
+            locator_factory=locator_factory,
             metrics=self.metrics,
             crd_recorder=self.crd_recorder,
             events=self.events,
@@ -216,6 +235,8 @@ class TPUManager:
         self.plugin = plugin_factory(opts.plugin_kind, self.config)
         if self.sampler is not None and hasattr(self.plugin, "locator_stats"):
             self.sampler.locator_stats_fn = self.plugin.locator_stats
+        if self.sampler is not None and hasattr(self.plugin, "bind_stats"):
+            self.sampler.bind_stats_fn = self.plugin.bind_stats
         if self.sampler is not None and hasattr(self.plugin, "core"):
             # Snapshot health from the plugin's applied view, not a fresh
             # operator probe — debug HTTP threads must not race the
@@ -353,9 +374,7 @@ class TPUManager:
             )
         if self.metrics is not None:
             self.metrics.restored_links.inc(report["restored_links"])
-            self.metrics.bound_allocations.set(
-                sum(1 for _ in self.storage.items())
-            )
+            self.metrics.bound_allocations.set(self.storage.count())
         return report
 
     def _sweep_orphans(self, report: dict) -> None:

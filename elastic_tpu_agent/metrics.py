@@ -149,6 +149,19 @@ class AgentMetrics:
             "Live pod->chip bindings recorded in storage",
             **kw,
         )
+        self.bind_inflight = Gauge(
+            "elastic_tpu_bind_inflight",
+            "PreStartContainer binds currently in flight across both "
+            "resource servers",
+            **kw,
+        )
+        self.bind_lock_wait = Histogram(
+            "elastic_tpu_bind_lock_wait_seconds",
+            "Time a bind spent waiting for its per-owner bind-lock stripe "
+            "(contention = sibling core/memory pair, or stripe collision)",
+            buckets=_BUCKETS,
+            **kw,
+        )
         self.gc_reclaimed = Counter(
             "elastic_tpu_gc_reclaimed_total",
             "Allocations reclaimed by GC",

@@ -35,6 +35,12 @@ class PluginConfig:
     device_plugin_dir: str = rpc.DEVICE_PLUGIN_DIR
     pod_resources_socket: str = rpc.POD_RESOURCES_SOCKET
     restart_backoff_s: float = 1.0
+    # gRPC worker threads per resource server. Kubelet issues concurrent
+    # Allocate/PreStartContainer pairs (one per container) and a node
+    # restart re-binds every pod at once; size this to the expected bind
+    # burst (CLI: --dp-pool-size). Surfaced via the plugin's bind_stats()
+    # on /debug/allocations and in the doctor bundle.
+    grpc_pool_size: int = 8
     # seams injected by the manager:
     operator: object = None
     sitter: object = None
@@ -98,7 +104,12 @@ class DevicePluginServer:
     def _serve(self) -> None:
         if os.path.exists(self.socket_path):
             os.unlink(self.socket_path)  # stale socket from a previous run
-        server = grpc.server(futures.ThreadPoolExecutor(max_workers=8))
+        # Named threads: a stack dump of a wedged bind burst must say
+        # WHICH resource's pool it sits in.
+        server = grpc.server(futures.ThreadPoolExecutor(
+            max_workers=max(1, self._config.grpc_pool_size),
+            thread_name_prefix=f"dp-grpc-{self._resource}",
+        ))
         rpc.add_device_plugin_servicer(server, self._servicer)
         server.add_insecure_port(rpc.unix_target(self.socket_path))
         server.start()

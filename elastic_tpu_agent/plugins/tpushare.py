@@ -51,6 +51,7 @@ from ..common import (
     EnvTPUVisibleDevices,
     ResourceTPUCore,
     ResourceTPUMemory,
+    StripedLockSet,
     TPUPercentEachChip,
     container_annotation,
 )
@@ -81,10 +82,32 @@ DEFAULT_ALLOC_SPEC_DIR = "/host/var/lib/elastic-tpu/alloc"
 
 GC_PERIOD_S = 60.0  # reference: base.go:248
 
-# Serializes alloc-spec writes across the core and memory plugin servers
-# (both live in the one agent process) so concurrent PreStarts for the same
-# container can't interleave their sibling merges.
-_SPEC_MERGE_LOCK = threading.Lock()
+# Per-owner (namespace/name) striped locks serializing alloc-spec writes
+# across the core and memory plugin servers (both live in the one agent
+# process): concurrent PreStarts for the SAME container can't interleave
+# their sibling merges — they share a pod key, hence a stripe — while
+# unrelated pods bind in parallel. The predecessor was one process-global
+# lock, which serialized the whole node's bind traffic through a single
+# critical section; kubelet drives these handlers from a thread pool and
+# a node restart re-binds every pod at once, so the global lock was the
+# pipeline's scaling limit (BENCH churn phase measures the difference).
+# 256 stripes keeps the collision odds low for a full device-plugin
+# handler pool's worth of concurrent binds while costing ~10KB of locks.
+DEFAULT_BIND_LOCK_STRIPES = 256
+_BIND_LOCKS = StripedLockSet(DEFAULT_BIND_LOCK_STRIPES)
+
+
+def set_bind_lock_stripes(stripes: int) -> StripedLockSet:
+    """Reconfigure the bind-lock striping (bench/test seam; ``1`` restores
+    the historical global-lock behavior as a same-run baseline). Only safe
+    with no binds in flight."""
+    global _BIND_LOCKS
+    _BIND_LOCKS = StripedLockSet(stripes)
+    return _BIND_LOCKS
+
+
+def bind_lock_stats() -> Dict:
+    return _BIND_LOCKS.stats()
 
 
 def _write_json_atomic(path: str, payload: Dict) -> None:
@@ -300,6 +323,10 @@ class _TPUSharePluginBase(_ListAndWatchMixin, rpc.DevicePluginServicer):
         self._alloc_dir = config.extra.get(
             "alloc_spec_dir", DEFAULT_ALLOC_SPEC_DIR
         )
+        self._inflight_lock = threading.Lock()
+        self._binds_inflight = 0
+        self._binds_total = 0
+        self._bind_failures_total = 0
 
     # -- health ---------------------------------------------------------------
 
@@ -461,22 +488,46 @@ class _TPUSharePluginBase(_ListAndWatchMixin, rpc.DevicePluginServicer):
     def PreStartContainer(self, request, context):  # noqa: N802, ARG002
         t0 = time.monotonic()
         device = Device(request.devicesIDs, self.resource)
+        with self._inflight_lock:
+            self._binds_inflight += 1
+        if self._metrics is not None and hasattr(
+            self._metrics, "bind_inflight"
+        ):
+            self._metrics.bind_inflight.inc()
         with get_tracer().trace(
             "PreStartContainer", resource=self.resource, hash=device.hash,
             n_ids=len(request.devicesIDs),
         ) as tr:
             try:
                 self._bind(device)
+                with self._inflight_lock:
+                    self._binds_total += 1
             except Exception:
                 logger.exception(
                     "PreStartContainer %s failed for %s [trace %s]",
                     self.resource, device.hash, tr.trace_id,
                 )
+                with self._inflight_lock:
+                    self._bind_failures_total += 1
                 raise
             finally:
+                with self._inflight_lock:
+                    self._binds_inflight -= 1
                 if self._metrics is not None:
+                    if hasattr(self._metrics, "bind_inflight"):
+                        self._metrics.bind_inflight.dec()
                     self._metrics.observe_prestart(time.monotonic() - t0)
         return dp.PreStartContainerResponse()
+
+    def bind_stats(self) -> Dict:
+        """Bind-pipeline introspection for /debug/allocations and the
+        node-doctor bundle."""
+        with self._inflight_lock:
+            return {
+                "inflight": self._binds_inflight,
+                "binds_total": self._binds_total,
+                "bind_failures_total": self._bind_failures_total,
+            }
 
     def _lookup_pod(self, owner) -> Optional[dict]:
         with get_tracer().span(
@@ -607,13 +658,24 @@ class _TPUSharePluginBase(_ListAndWatchMixin, rpc.DevicePluginServicer):
         chip_indexes: List[int],
         created: List[str],
     ) -> None:
-        # One lock spans sibling discovery, the spec write, AND the storage
-        # save that publishes this allocation: a core/memory PreStart pair
-        # for the same container racing here could otherwise both miss the
-        # sibling (save not yet visible) and write unmerged specs — and the
-        # load_or_create/save below is a read-modify-write that would lose
-        # one record. Binds are rare; global lock contention is noise.
-        with _SPEC_MERGE_LOCK:
+        # One PER-OWNER lock spans sibling discovery, the spec write, AND
+        # the storage save that publishes this allocation: a core/memory
+        # PreStart pair for the same container racing here could otherwise
+        # both miss the sibling (save not yet visible) and write unmerged
+        # specs — and the checkpoint below is a read-modify-write that
+        # would lose one record. Sibling pairs share a pod key, hence a
+        # stripe; unrelated pods take different stripes and bind in
+        # parallel (a node restart re-binds every pod at once — the burst
+        # the striping exists for).
+        locks = _BIND_LOCKS  # one reference: acquire/release must pair
+        with get_tracer().span("bind_lock_wait") as sp:
+            lock_wait_s = locks.acquire_key(owner.pod_key)
+            sp.set(wait_ms=round(lock_wait_s * 1000, 3))
+        try:
+            if self._metrics is not None and hasattr(
+                self._metrics, "bind_lock_wait"
+            ):
+                self._metrics.bind_lock_wait.observe(lock_wait_s)
             own_path = os.path.join(self._alloc_dir, f"{device.hash}.json")
             fresh_bind = not os.path.exists(own_path)
             try:
@@ -643,15 +705,20 @@ class _TPUSharePluginBase(_ListAndWatchMixin, rpc.DevicePluginServicer):
                 created_node_ids=created,
             )
             with get_tracer().span("checkpoint"):
-                info = self._storage.load_or_create(
-                    owner.namespace, owner.name
+                # mutate() adds storage's own per-key serialization on
+                # top of the bind lock, so the read-modify-write stays
+                # atomic even against writers that don't hold a bind
+                # stripe (restore, tools).
+                self._storage.mutate(
+                    owner.namespace, owner.name,
+                    lambda info: info.set_allocation(owner.container, record),
                 )
-                info.set_allocation(owner.container, record)
-                self._storage.save(info)
+        finally:
+            locks.release_key(owner.pod_key)
         if self._metrics is not None:
-            self._metrics.bound_allocations.set(
-                sum(1 for _ in self._storage.items())
-            )
+            # O(1) COUNT(*) — the per-bind gauge update must not
+            # deserialize the whole store (it used to scan every row).
+            self._metrics.bound_allocations.set(self._storage.count())
         if self._crd is not None:
             self._crd.record_bound(
                 device.hash, self.resource, len(device.ids),
@@ -764,8 +831,9 @@ class _TPUSharePluginBase(_ListAndWatchMixin, rpc.DevicePluginServicer):
         resources), so the hook's injection is identical no matter which
         hash survives the merge.
         """
-        # Caller (_finish_bind) holds _SPEC_MERGE_LOCK across this write and
-        # the storage save that makes the allocation visible to siblings.
+        # Caller (_finish_bind) holds the owner's bind stripe across this
+        # write and the storage save that makes the allocation visible to
+        # siblings.
         os.makedirs(self._alloc_dir, exist_ok=True)
         payload = self._spec_payload(device, owner, chip_indexes, annotations, pod)
         # Pre-merge snapshot: lets a later single-resource release restore
@@ -787,7 +855,7 @@ class _TPUSharePluginBase(_ListAndWatchMixin, rpc.DevicePluginServicer):
         )
 
     def _restore_sibling_specs(self, owner, released_hash: str) -> None:
-        """(_SPEC_MERGE_LOCK held) Rewrite the container's surviving
+        """(owner's bind stripe held) Rewrite the container's surviving
         sibling specs from their pre-merge ``own`` snapshots, so the
         released allocation's devices/env stop appearing in them (the
         stale-union defect, ADVICE r2/r3)."""
@@ -819,7 +887,7 @@ class _TPUSharePluginBase(_ListAndWatchMixin, rpc.DevicePluginServicer):
         except FileNotFoundError:
             pass
         if owner is not None:
-            with _SPEC_MERGE_LOCK:
+            with _BIND_LOCKS.acquire(owner.pod_key):
                 self._restore_sibling_specs(owner, alloc_hash)
 
 
@@ -982,6 +1050,20 @@ class TPUSharePlugin:
         return {
             ResourceTPUCore: self.core.locator_stats(),
             ResourceTPUMemory: self.memory.locator_stats(),
+        }
+
+    def bind_stats(self) -> Dict:
+        """Bind-pipeline introspection: in-flight binds, totals, the gRPC
+        pool size each resource server runs, and bind-lock contention —
+        the numbers that answer "is the bind path queueing?" from
+        /debug/allocations or a doctor bundle."""
+        return {
+            "grpc_pool_size": self._config.grpc_pool_size,
+            "bind_locks": bind_lock_stats(),
+            "resources": {
+                ResourceTPUCore: self.core.bind_stats(),
+                ResourceTPUMemory: self.memory.bind_stats(),
+            },
         }
 
     def run(self, stop: threading.Event) -> None:
@@ -1185,9 +1267,7 @@ class TPUSharePlugin:
         if metrics is not None:
             if reclaimed:
                 metrics.gc_reclaimed.inc(reclaimed)
-            metrics.bound_allocations.set(
-                sum(1 for _ in storage.items())
-            )
+            metrics.bound_allocations.set(storage.count())
         return reclaimed
 
     def gc(self, gc_queue: "queue.Queue", stop: threading.Event) -> None:

@@ -109,6 +109,10 @@ class UtilizationSampler:
         # {cache_entries, ...}} — locator cache introspection for the
         # debug table and the doctor bundle.
         self.locator_stats_fn: Optional[Callable[[], dict]] = None
+        # Also manager-set: () -> bind-pipeline stats (in-flight binds,
+        # gRPC pool size, bind-lock contention) from the plugin's
+        # bind_stats(); rides into /debug/allocations and the bundle.
+        self.bind_stats_fn: Optional[Callable[[], dict]] = None
         # Also manager-set: () -> set of unhealthy chip indexes, the
         # plugin's APPLIED health view. Snapshots must read this (a
         # plain set copy) instead of re-probing the operator:
@@ -575,6 +579,11 @@ class UtilizationSampler:
         if self.locator_stats_fn is not None:
             try:
                 out["locator"] = self.locator_stats_fn()
+            except Exception:  # noqa: BLE001 - introspection only
+                pass
+        if self.bind_stats_fn is not None:
+            try:
+                out["bind"] = self.bind_stats_fn()
             except Exception:  # noqa: BLE001 - introspection only
                 pass
         return out

@@ -19,6 +19,7 @@ import time
 from typing import Callable, Iterator, Optional, Tuple
 
 from .. import faults
+from ..common import StripedLockSet
 from ..types import PodInfo
 
 logger = logging.getLogger(__name__)
@@ -59,6 +60,24 @@ class Storage:
         os.makedirs(directory, exist_ok=True)
         self._path = path
         self._lock = threading.RLock()
+        # Per-key striping for composite read-modify-writes (mutate()):
+        # the sqlite connection itself stays serialized under self._lock,
+        # but two RMWs for DIFFERENT pods never wait on each other's
+        # load->save window.
+        self._key_locks = StripedLockSet(64)
+        # Read-through record cache: pod key -> parsed PodInfo snapshot
+        # (None = the stored row fails to parse). Once a full scan has
+        # populated it, items()/for_each/corrupt_keys serve from memory —
+        # GC sweeps, health fan-outs and the sampler join stop re-parsing
+        # every row each tick. Our own writes keep it coherent; writes
+        # from OTHER connections (node-doctor against the live db) are
+        # detected via PRAGMA data_version, which sqlite bumps only for
+        # foreign modifications, and drop the cache wholesale.
+        self._cache: dict = {}
+        self._cache_complete = False
+        self._data_version: Optional[int] = None
+        self.scans = 0         # full-table SQL scans actually paid
+        self.cache_serves = 0  # full iterations answered from the cache
         try:
             self._db = sqlite3.connect(path, check_same_thread=False)
             self._db.execute("PRAGMA journal_mode=WAL")
@@ -101,17 +120,54 @@ class Storage:
     # Exceptions meaning "this stored value does not parse as a PodInfo".
     _CORRUPT = (json.JSONDecodeError, KeyError, TypeError, AttributeError)
 
+    # -- record cache ---------------------------------------------------------
+
+    def _check_foreign_writes(self) -> None:
+        """(lock held) Drop the cache when another connection modified the
+        db file since we last looked. PRAGMA data_version is unchanged by
+        this connection's own writes, so the cache survives the agent's
+        steady-state write traffic and invalidates exactly when an outside
+        writer (e.g. a doctor run) touches the file."""
+        try:
+            dv = self._db.execute("PRAGMA data_version").fetchone()[0]
+        except sqlite3.Error:
+            # Can't tell — stay safe and drop the cache.
+            self._cache = {}
+            self._cache_complete = False
+            return
+        if dv != self._data_version:
+            if self._data_version is not None:
+                self._cache = {}
+                self._cache_complete = False
+            self._data_version = dv
+
+    def invalidate_cache(self) -> None:
+        """Drop the read-through record cache (test seam / escape hatch;
+        foreign-connection writes are detected automatically)."""
+        with self._lock:
+            self._cache = {}
+            self._cache_complete = False
+
     # -- CRUD ----------------------------------------------------------------
 
     def save(self, pod: PodInfo) -> None:
         faults.fire("storage.save")
+        value = pod.to_json()
         with self._lock:
+            self._check_foreign_writes()
             self._write(
                 f"save {pod.key}",
                 "INSERT INTO pods(key, value) VALUES(?, ?) "
                 "ON CONFLICT(key) DO UPDATE SET value=excluded.value",
-                (pod.key, pod.to_json()),
+                (pod.key, value),
             )
+            # Cache a snapshot parsed back from the persisted JSON — never
+            # the caller's object, which the caller may keep mutating.
+            try:
+                self._cache[pod.key] = PodInfo.from_json(value)
+            except self._CORRUPT:  # pragma: no cover - to_json round-trips
+                self._cache.pop(pod.key, None)
+                self._cache_complete = False
 
     def load(self, namespace: str, name: str) -> Optional[PodInfo]:
         """Return the stored PodInfo, or None when absent (reference returns
@@ -142,14 +198,50 @@ class Storage:
             self.save(pod)
             return pod
 
+    def mutate(self, namespace: str, name: str, fn) -> PodInfo:
+        """Atomic per-key read-modify-write: load-or-create the record,
+        apply ``fn(info)``, save. Two mutate() calls for the same pod
+        serialize on a striped per-key lock (never on each other's SQL
+        alone, which would lose one update); mutations of UNRELATED pods
+        proceed in parallel up to the sqlite connection itself."""
+        with self._key_locks.acquire(f"{namespace}/{name}"):
+            info = self.load_or_create(namespace, name)
+            fn(info)
+            self.save(info)
+            return info
+
     def delete(self, namespace: str, name: str) -> None:
         faults.fire("storage.delete")
         with self._lock:
+            self._check_foreign_writes()
             self._write(
                 f"delete {namespace}/{name}",
                 "DELETE FROM pods WHERE key=?",
                 (f"{namespace}/{name}",),
             )
+            self._cache.pop(f"{namespace}/{name}", None)
+
+    def count(self) -> int:
+        """O(1)-per-bind record count — the gauge-update path must not
+        deserialize every record just to count them.
+
+        Once the record cache is warm this counts parseable records
+        exactly like the pre-cache ``items()`` accounting did (corrupt
+        rows excluded); before the first full scan it falls back to SQL
+        COUNT(*), which includes a corrupt row until a scanner (GC,
+        sampler — seconds after boot) warms the cache."""
+        with self._lock:
+            self._check_foreign_writes()
+            if self._cache_complete:
+                return sum(
+                    1 for v in self._cache.values() if v is not None
+                )
+            try:
+                return self._db.execute(
+                    "SELECT COUNT(*) FROM pods"
+                ).fetchone()[0]
+            except sqlite3.Error as e:
+                raise StorageError(f"count: {e}") from e
 
     def for_each(self, fn: Callable[[PodInfo], None]) -> None:
         """Invoke fn on a snapshot of every stored PodInfo.
@@ -164,19 +256,38 @@ class Storage:
             fn(pod)
 
     def _rows(self) -> Iterator[Tuple[str, Optional[PodInfo]]]:
-        """Snapshot all rows; parse each to PodInfo or None when corrupt."""
+        """Snapshot all rows; parse each to PodInfo or None when corrupt.
+
+        Served from the read-through cache once a full scan has warmed it
+        (and no foreign connection has written since). The yielded
+        PodInfo objects are shared snapshots: callers may read them or
+        re-save a fresh load(), but must not mutate them in place."""
         with self._lock:
-            try:
-                rows = self._db.execute(
-                    "SELECT key, value FROM pods"
-                ).fetchall()
-            except sqlite3.Error as e:
-                raise StorageError(f"scan: {e}") from e
-        for key, value in rows:
-            try:
-                yield key, PodInfo.from_json(value)
-            except self._CORRUPT:
-                yield key, None
+            self._check_foreign_writes()
+            if self._cache_complete:
+                self.cache_serves += 1
+                snapshot = list(self._cache.items())
+            else:
+                try:
+                    rows = self._db.execute(
+                        "SELECT key, value FROM pods"
+                    ).fetchall()
+                except sqlite3.Error as e:
+                    raise StorageError(f"scan: {e}") from e
+                self.scans += 1
+                snapshot = []
+                for key, value in rows:
+                    try:
+                        snapshot.append((key, PodInfo.from_json(value)))
+                    except self._CORRUPT:
+                        snapshot.append((key, None))
+                # Parsing ran under the lock, so no save/delete raced the
+                # rebuild: installing the parsed rows is race-free.
+                self._cache = dict(snapshot)
+                self._cache_complete = True
+        # Lock released before yielding: callers iterate (and may call
+        # save/delete) without holding the storage lock hostage.
+        yield from snapshot
 
     def items(self) -> Iterator[Tuple[str, PodInfo]]:
         for key, pod in self._rows():

@@ -1,0 +1,488 @@
+"""Concurrent bind pipeline tests: striped per-owner locks, the shared
+single-flight pod-resources snapshot, and O(1) store accounting.
+
+Kubelet drives Allocate/PreStartContainer from a concurrent gRPC pool
+(core + memory pairs land in parallel per container; a node restart
+re-binds every pod at once). These tests pin the pipeline's contracts
+under exactly that concurrency:
+
+- a core+memory PreStart pair for the SAME container racing from two
+  threads yields merged alloc specs and exactly one storage record;
+- binds of UNRELATED pods do not serialize (a stalled bind of pod A
+  must not block pod B);
+- no full storage scan runs on the per-bind path, and the periodic
+  scanners (GC, sampler join) hit the record cache instead of
+  re-parsing every row each tick;
+- concurrent cold locates coalesce onto a single-flight List instead of
+  stampeding the kubelet, and one List serves both resources.
+"""
+
+import json
+import os
+import threading
+import time
+
+import pytest
+
+from elastic_tpu_agent import rpc
+from elastic_tpu_agent.common import (
+    AnnotationAssumed,
+    ResourceTPUCore,
+    ResourceTPUMemory,
+    container_annotation,
+)
+from elastic_tpu_agent.gen import deviceplugin_pb2 as dp
+from elastic_tpu_agent.kube.locator import (
+    KubeletDeviceLocator,
+    PodResourcesSnapshotSource,
+)
+from elastic_tpu_agent.plugins import tpushare
+from elastic_tpu_agent.plugins.base import PluginConfig
+from elastic_tpu_agent.plugins.tpushare import (
+    TPUSharePlugin,
+    core_device_id,
+    mem_device_id,
+)
+from elastic_tpu_agent.rpc import PodResourcesClient
+from elastic_tpu_agent.storage import Storage
+from elastic_tpu_agent.tpu import StubOperator
+from elastic_tpu_agent.types import Device
+
+from fake_kubelet import FakeKubelet, FakeSitter
+
+
+class CountingClient(PodResourcesClient):
+    def __init__(self, socket_path):
+        super().__init__(socket_path)
+        self.lists = 0
+
+    def list(self, timeout_s: float = 5.0):
+        self.lists += 1
+        return super().list(timeout_s=timeout_s)
+
+
+@pytest.fixture()
+def rig(tmp_path):
+    """Fake kubelet + stub operator + plugin bundle sharing ONE
+    pod-resources snapshot source (the manager's wiring), with the
+    servicers exposed for direct in-process calls."""
+    dp_dir = str(tmp_path / "dp")
+    pr_sock = str(tmp_path / "pr" / "kubelet.sock")
+    dev_root = str(tmp_path / "dev")
+    os.makedirs(dev_root)
+    kubelet = FakeKubelet(dp_dir, pr_sock)
+    kubelet.start()
+    sitter = FakeSitter()
+    storage = Storage(str(tmp_path / "meta.db"))
+    client = CountingClient(pr_sock)
+    source = PodResourcesSnapshotSource(client)
+    config = PluginConfig(
+        node_name="test-node",
+        device_plugin_dir=dp_dir,
+        pod_resources_socket=pr_sock,
+        operator=StubOperator(dev_root, "v5litepod-4"),
+        sitter=sitter,
+        storage=storage,
+        locator_factory=lambda res: KubeletDeviceLocator(res, source=source),
+        extra={"alloc_spec_dir": str(tmp_path / "alloc")},
+    )
+    plugin = TPUSharePlugin(config)
+
+    class R:
+        pass
+
+    r = R()
+    r.kubelet, r.sitter, r.storage = kubelet, sitter, storage
+    r.plugin, r.client, r.source = plugin, client, source
+    r.alloc_dir = str(tmp_path / "alloc")
+    yield r
+    plugin.core.stop_streams()
+    plugin.memory.stop_streams()
+    kubelet.stop()
+    storage.close()
+
+
+def both_annotations(container="jax", chips="0"):
+    return {
+        AnnotationAssumed: "true",
+        container_annotation(container): chips,
+    }
+
+
+def bind_pair_ids(i, chip=0):
+    core = [core_device_id(chip, f"{i}x{j}") for j in range(10)]
+    mem = [mem_device_id(chip, f"{i}x{j}") for j in range(16)]
+    return core, mem
+
+
+def prestart(servicer, ids):
+    servicer.PreStartContainer(
+        dp.PreStartContainerRequest(devicesIDs=ids), None
+    )
+
+
+# -- the sibling race ---------------------------------------------------------
+
+
+def test_sibling_race_merges_specs_and_keeps_one_record(rig):
+    """Core and memory PreStartContainer for the SAME container racing
+    from two threads: the specs must come out merged (union devices/env)
+    and storage must hold exactly one record carrying BOTH resources —
+    the lost-update the per-owner lock exists to prevent."""
+    rounds = 6
+    for i in range(rounds):
+        pod = f"race-{i}"
+        rig.sitter.add_pod("default", pod, both_annotations())
+        core_ids, mem_ids = bind_pair_ids(i)
+        rig.kubelet.assign("default", pod, "jax", ResourceTPUCore, core_ids)
+        rig.kubelet.assign("default", pod, "jax", ResourceTPUMemory, mem_ids)
+        barrier = threading.Barrier(2)
+        errors = []
+
+        def race(servicer, ids):
+            try:
+                barrier.wait(timeout=5)
+                prestart(servicer, ids)
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+
+        t1 = threading.Thread(
+            target=race, args=(rig.plugin.core, core_ids)
+        )
+        t2 = threading.Thread(
+            target=race, args=(rig.plugin.memory, mem_ids)
+        )
+        t1.start(); t2.start()
+        t1.join(10); t2.join(10)
+        assert not errors, f"round {i}: {errors}"
+
+        # exactly one storage record, carrying both resources
+        info = rig.storage.load("default", pod)
+        assert info is not None
+        by_resource = info.allocations["jax"]
+        assert set(by_resource) == {ResourceTPUCore, ResourceTPUMemory}, (
+            f"round {i}: sibling record lost: {sorted(by_resource)}"
+        )
+
+        # both spec files exist and carry the merged union
+        core_hash = Device(core_ids, ResourceTPUCore).hash
+        mem_hash = Device(mem_ids, ResourceTPUMemory).hash
+        specs = []
+        for h in (core_hash, mem_hash):
+            with open(os.path.join(rig.alloc_dir, f"{h}.json")) as f:
+                specs.append(json.load(f))
+        for spec in specs:
+            assert sorted(spec["resources"]) == sorted(
+                [ResourceTPUCore, ResourceTPUMemory]
+            ), f"round {i}: unmerged spec {spec['hash']}"
+        assert specs[0]["chip_indexes"] == specs[1]["chip_indexes"]
+        assert specs[0]["env"] == specs[1]["env"]
+        # cleanup between rounds keeps chip unit space unambiguous
+        rig.sitter.remove_pod("default", pod)
+        rig.kubelet.unassign_pod("default", pod)
+
+
+def test_unrelated_pods_do_not_serialize(rig):
+    """A bind of pod A stalled INSIDE its critical section (storage save
+    gated) must not block pod B's bind — the global-lock predecessor
+    serialized exactly this. Pod names are chosen onto different stripes
+    (crc32 striping is deterministic)."""
+    # pick two pod names on different stripes
+    locks = tpushare._BIND_LOCKS
+    name_a = "par-a"
+    name_b = next(
+        n for n in (f"par-b{i}" for i in range(64))
+        if locks.lock_for(f"default/{n}")
+        is not locks.lock_for(f"default/{name_a}")
+    )
+    for i, name in ((0, name_a), (1, name_b)):
+        rig.sitter.add_pod("default", name, both_annotations())
+        core_ids, _ = bind_pair_ids(10 + i)
+        rig.kubelet.assign(
+            "default", name, "jax", ResourceTPUCore, core_ids
+        )
+
+    a_entered = threading.Event()
+    gate = threading.Event()
+    real_mutate = rig.storage.mutate
+
+    # Gate pod A inside its bind critical section (the checkpoint step),
+    # BEFORE any storage-internal lock — the single sqlite connection
+    # legitimately serializes raw row writes, so gating under the
+    # storage lock would block everyone by construction.
+    def gated_mutate(namespace, name, fn):
+        if name == name_a:
+            a_entered.set()
+            assert gate.wait(timeout=10), "test gate never released"
+        return real_mutate(namespace, name, fn)
+
+    rig.storage.mutate = gated_mutate
+    errors = []
+
+    def bind_a():
+        try:
+            core_ids, _ = bind_pair_ids(10)
+            prestart(rig.plugin.core, core_ids)
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    t = threading.Thread(target=bind_a)
+    t.start()
+    try:
+        assert a_entered.wait(timeout=10), "pod A never reached its save"
+        # A holds its stripe, blocked in the critical section; B must
+        # still bind.
+        t0 = time.monotonic()
+        core_ids_b, _ = bind_pair_ids(11)
+        prestart(rig.plugin.core, core_ids_b)
+        elapsed = time.monotonic() - t0
+        assert rig.storage.load("default", name_b) is not None
+        assert t.is_alive(), "pod A finished early; the test proved nothing"
+        assert elapsed < 5.0, f"pod B serialized behind pod A ({elapsed:.1f}s)"
+    finally:
+        gate.set()
+        t.join(timeout=10)
+        rig.storage.mutate = real_mutate
+    assert not errors
+    assert rig.storage.load("default", name_a) is not None
+
+
+def test_sibling_pair_still_serializes_via_shared_stripe(rig):
+    """The same pod's core and memory binds must share a stripe — that
+    is the merge-correctness half of the striping contract."""
+    locks = tpushare._BIND_LOCKS
+    assert locks.lock_for("ns/pod-x") is locks.lock_for("ns/pod-x")
+    # and the global-mode escape hatch degenerates to one lock
+    one = tpushare.set_bind_lock_stripes(1)
+    try:
+        assert one.lock_for("a/b") is one.lock_for("c/d")
+    finally:
+        tpushare.set_bind_lock_stripes(
+            tpushare.DEFAULT_BIND_LOCK_STRIPES
+        )
+
+
+# -- O(1) accounting / record cache -------------------------------------------
+
+
+def bind_whole(rig, i, pod):
+    rig.sitter.add_pod("default", pod, both_annotations())
+    core_ids, mem_ids = bind_pair_ids(i)
+    rig.kubelet.assign("default", pod, "jax", ResourceTPUCore, core_ids)
+    rig.kubelet.assign("default", pod, "jax", ResourceTPUMemory, mem_ids)
+    prestart(rig.plugin.core, core_ids)
+    prestart(rig.plugin.memory, mem_ids)
+
+
+def test_no_full_scans_on_bind_path(rig):
+    """The per-bind path must be O(1) in stored pods: no full storage
+    scan per bind. The periodic scanners (GC, health fan-out, sampler
+    join) pay ONE scan to warm the record cache and are cache-served
+    afterwards — even across interleaved binds."""
+    bind_whole(rig, 20, "scan-0")
+    scans_after_first = rig.storage.scans
+    for i in (21, 22, 23):
+        bind_whole(rig, i, f"scan-{i - 20}")
+    assert rig.storage.scans == scans_after_first, (
+        "a bind triggered a full storage scan — O(n) crept back onto "
+        "the hot path"
+    )
+    assert rig.storage.count() == 4
+
+    # GC warms the cache once...
+    rig.plugin.gc_once()
+    scans_warm = rig.storage.scans
+    assert scans_warm >= scans_after_first
+    serves0 = rig.storage.cache_serves
+    # ...then repeated sweeps, sampler joins and even interleaved binds
+    # stay scan-free.
+    rig.plugin.gc_once()
+    bind_whole(rig, 24, "scan-4")
+    rig.plugin.gc_once()
+
+    from elastic_tpu_agent.sampler import UtilizationSampler
+
+    sampler = UtilizationSampler(
+        rig.plugin.core._operator, storage=rig.storage,
+        alloc_spec_dir=rig.alloc_dir, period_s=0,
+    )
+    sampler.sample_once()
+    sampler.sample_once()
+    assert rig.storage.scans == scans_warm, (
+        "periodic scanners re-scanned despite a warm record cache"
+    )
+    assert rig.storage.cache_serves > serves0
+    assert rig.storage.count() == 5
+
+
+def test_bind_stats_surface(rig):
+    """bind_stats(): the /debug + doctor-bundle introspection for the
+    concurrent pipeline (pool size, lock striping, totals)."""
+    bind_whole(rig, 30, "stats-0")
+    stats = rig.plugin.bind_stats()
+    assert stats["grpc_pool_size"] == 8  # PluginConfig default
+    assert stats["bind_locks"]["stripes"] == tpushare._BIND_LOCKS.stripes
+    core = stats["resources"][ResourceTPUCore]
+    assert core["binds_total"] >= 1
+    assert core["inflight"] == 0
+    # and the sampler snapshot carries it (manager wiring contract)
+    from elastic_tpu_agent.sampler import UtilizationSampler
+
+    sampler = UtilizationSampler(
+        rig.plugin.core._operator, storage=rig.storage,
+        alloc_spec_dir=rig.alloc_dir, period_s=0,
+    )
+    sampler.bind_stats_fn = rig.plugin.bind_stats
+    sampler.sample_once()
+    snap = sampler.allocations_snapshot()
+    assert snap["bind"]["grpc_pool_size"] == 8
+    assert "bind_locks" in snap["bind"]
+
+
+# -- shared snapshot + single-flight ------------------------------------------
+
+
+RESOURCE_IDS = {
+    ResourceTPUCore: ["tpu-core-0-a", "tpu-core-0-b"],
+    ResourceTPUMemory: ["tpu-mem-0-a", "tpu-mem-0-b"],
+}
+
+
+def test_one_list_serves_both_resources(tmp_path):
+    """A cold core locate warms the MEMORY locator too: the shared
+    snapshot halves cold-locate Lists for core+memory sibling pairs."""
+    k = FakeKubelet(str(tmp_path / "dp"), str(tmp_path / "pr" / "k.sock"))
+    k.start()
+    try:
+        for res, ids in RESOURCE_IDS.items():
+            k.assign("ns", "p", "jax", res, ids)
+        client = CountingClient(k.pod_resources_socket)
+        source = PodResourcesSnapshotSource(client)
+        core_loc = KubeletDeviceLocator(ResourceTPUCore, source=source)
+        mem_loc = KubeletDeviceLocator(ResourceTPUMemory, source=source)
+        owner = core_loc.locate(
+            Device(RESOURCE_IDS[ResourceTPUCore], ResourceTPUCore)
+        )
+        assert owner.name == "p"
+        assert client.lists == 1
+        owner = mem_loc.locate(
+            Device(RESOURCE_IDS[ResourceTPUMemory], ResourceTPUMemory)
+        )
+        assert owner.name == "p"
+        assert client.lists == 1, (
+            "memory locate paid its own List despite the shared snapshot"
+        )
+        stats = core_loc.stats()
+        assert stats["shared_source"] is True
+        assert stats["lists_total"] == 1
+    finally:
+        k.stop()
+
+
+def test_stalled_list_does_not_serialize_misses(tmp_path, monkeypatch):
+    """A wedged kubelet List must not queue every miss behind it one
+    stalled deadline at a time: after STALL_WAIT_TIMEOUT_S a waiter
+    abandons single-flight and pays its own List concurrently."""
+    k = FakeKubelet(str(tmp_path / "dp"), str(tmp_path / "pr" / "k.sock"))
+    k.start()
+    try:
+        ids = ["tpu-core-0-s0", "tpu-core-0-s1"]
+        k.assign("ns", "p", "jax", ResourceTPUCore, ids)
+        client = CountingClient(k.pod_resources_socket)
+        source = PodResourcesSnapshotSource(client)
+        monkeypatch.setattr(source, "STALL_WAIT_TIMEOUT_S", 0.2)
+        loc = KubeletDeviceLocator(ResourceTPUCore, source=source)
+        stall = threading.Event()
+        orig_list = client.list
+        first = {"armed": True}
+
+        def wedged_first_list(timeout_s=5.0):
+            if first["armed"]:
+                first["armed"] = False
+                stall.wait(10.0)  # the wedged List
+                raise RuntimeError("kubelet deadline")
+            return orig_list(timeout_s=timeout_s)
+
+        client.list = wedged_first_list
+        wedged_err = []
+
+        def wedged_runner():
+            try:
+                source.refresh()
+            except Exception as e:  # noqa: BLE001 - expected deadline
+                wedged_err.append(e)
+
+        t = threading.Thread(target=wedged_runner)
+        t.start()
+        time.sleep(0.05)
+        t0 = time.monotonic()
+        owner = loc.locate(Device(ids, ResourceTPUCore))
+        elapsed = time.monotonic() - t0
+        assert owner.name == "p"
+        assert elapsed < 2.0, (
+            f"miss served only after the stalled List ({elapsed:.1f}s) — "
+            "the stall escape is broken"
+        )
+        stall.set()
+        t.join(timeout=10)
+    finally:
+        k.stop()
+
+
+def test_concurrent_cold_misses_coalesce_single_flight(tmp_path):
+    """N threads missing concurrently while a (stale) List is in flight
+    must coalesce onto ONE fresh List, not stampede the kubelet with N.
+    Budget: 1 stale List + at most 2 coalesced generations."""
+    k = FakeKubelet(str(tmp_path / "dp"), str(tmp_path / "pr" / "k.sock"))
+    k.start()
+    try:
+        client = CountingClient(k.pod_resources_socket)
+        source = PodResourcesSnapshotSource(client)
+        loc = KubeletDeviceLocator(ResourceTPUCore, source=source)
+        gate = threading.Event()
+        orig_list = client.list
+        gated = {"armed": True}
+
+        def slow_list(timeout_s=5.0):
+            if gated["armed"]:
+                gated["armed"] = False
+                gate.wait(5.0)
+            return orig_list(timeout_s=timeout_s)
+
+        client.list = slow_list
+        # a prefetch whose List is gated open — and predates the assigns
+        loc.prefetch_async()
+        time.sleep(0.05)
+        ids = {
+            i: [f"tpu-core-0-m{i}-{u}" for u in range(3)] for i in range(4)
+        }
+        for i, devs in ids.items():
+            k.assign("ns", f"pod-{i}", "jax", ResourceTPUCore, devs)
+        owners = {}
+        errors = []
+
+        def locate(i):
+            try:
+                owners[i] = loc.locate(Device(ids[i], ResourceTPUCore))
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+
+        threads = [
+            threading.Thread(target=locate, args=(i,)) for i in ids
+        ]
+        for t in threads:
+            t.start()
+        time.sleep(0.05)
+        gate.set()  # release the stale List; misses now coalesce
+        for t in threads:
+            t.join(timeout=10)
+        assert not errors
+        assert {o.name for o in owners.values()} == {
+            f"pod-{i}" for i in ids
+        }
+        assert client.lists <= 3, (
+            f"{client.lists} Lists for 4 concurrent misses — the "
+            "single-flight coalescing is broken"
+        )
+    finally:
+        k.stop()

@@ -157,6 +157,95 @@ def test_concurrent_writers_across_connections(tmp_path):
     s2.close()
 
 
+def test_count_is_accurate_without_scanning(store):
+    """count() (SQL COUNT(*)) tracks saves/deletes and never pays a full
+    scan — it is the per-bind gauge-update path."""
+    assert store.count() == 0
+    for i in range(7):
+        store.save(make_pod(name=f"pod-{i}"))
+    scans = store.scans
+    assert store.count() == 7
+    store.delete("default", "pod-0")
+    assert store.count() == 6
+    assert store.scans == scans, "count() paid a full scan"
+
+
+def test_mutate_concurrent_same_key_loses_no_update(store):
+    """Two threads mutate()-ing the same pod (different containers, the
+    core/memory sibling shape) must both land — the read-modify-write
+    races that lost one record under plain load_or_create/save."""
+    barrier = threading.Barrier(2)
+    errs = []
+
+    def add(container):
+        try:
+            barrier.wait(timeout=5)
+            for j in range(10):
+                pod = make_pod(container=f"{container}-{j}")
+                rec = pod.allocations[f"{container}-{j}"][
+                    "elasticgpu.io/tpu-core"
+                ]
+                store.mutate(
+                    "default", "pod-a",
+                    lambda info, c=f"{container}-{j}", r=rec: (
+                        info.set_allocation(c, r)
+                    ),
+                )
+        except Exception as e:  # pragma: no cover
+            errs.append(e)
+
+    threads = [
+        threading.Thread(target=add, args=(c,)) for c in ("core", "mem")
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errs
+    got = store.load("default", "pod-a")
+    assert len(got.allocations) == 20, (
+        f"lost updates: {sorted(got.allocations)}"
+    )
+
+
+def test_items_served_from_cache_after_warmup(store):
+    """One scan warms the record cache; subsequent items() — including
+    after interleaved saves/deletes — are cache-served and coherent."""
+    for i in range(5):
+        store.save(make_pod(name=f"pod-{i}"))
+    assert store.scans == 0
+    assert len(list(store.items())) == 5
+    assert store.scans == 1
+    serves = store.cache_serves
+    store.save(make_pod(name="pod-5"))
+    store.delete("default", "pod-0")
+    keys = {k for k, _ in store.items()}
+    assert keys == {f"default/pod-{i}" for i in range(1, 6)}
+    assert store.scans == 1, "cache dropped by own writes"
+    assert store.cache_serves > serves
+
+
+def test_cache_invalidated_by_foreign_connection_writes(tmp_path):
+    """A write from ANOTHER connection (node-doctor against the live db)
+    must invalidate the read-through cache — PRAGMA data_version flags
+    it — so items() never serves a stale view across connections."""
+    path = str(tmp_path / "meta.db")
+    s1, s2 = Storage(path), Storage(path)
+    try:
+        s1.save(make_pod(name="mine"))
+        assert {k for k, _ in s1.items()} == {"default/mine"}
+        # foreign write lands...
+        s2.save(make_pod(name="theirs"))
+        # ...and the warmed cache must not hide it
+        assert {k for k, _ in s1.items()} == {
+            "default/mine", "default/theirs"
+        }
+        assert s1.count() == 2
+    finally:
+        s1.close()
+        s2.close()
+
+
 def test_save_retries_once_on_transient_lock(store):
     """A single 'database is locked' blip (WAL checkpoint outlasting
     busy_timeout) must not fail a bind: save retries once."""
