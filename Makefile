@@ -5,7 +5,7 @@ TAG ?= elastic-tpu-agent:latest
 # verify's tier-1 line uses pipefail, which /bin/sh (dash) lacks
 SHELL := /bin/bash
 
-.PHONY: all native sanitize test test-all verify doctor-smoke chaos-smoke bench-smoke protos image bench clean
+.PHONY: all native sanitize test test-all verify doctor-smoke chaos-smoke bench-smoke crash-replay-smoke protos image bench clean
 
 all: native test
 
@@ -64,8 +64,17 @@ chaos-smoke:
 bench-smoke:
 	JAX_PLATFORMS=cpu python3 bench.py --churn-smoke
 
+# crash-replay smoke: the kill-at-every-failpoint suite — dies at each
+# mid-bind crash window (die-thread failpoints), restarts the manager
+# over the surviving store + fake kubelet, and asserts convergence to
+# the crash-free end state with an empty bind-intent journal.
+# Deterministic: in-process bind drive, no sleeps on the replay path.
+crash-replay-smoke:
+	JAX_PLATFORMS=cpu python -m pytest tests/test_reconciler.py -q \
+	  -p no:cacheprovider && echo "crash replay smoke: OK"
+
 T1_TIMEOUT ?= 870
-verify: doctor-smoke chaos-smoke bench-smoke
+verify: doctor-smoke chaos-smoke bench-smoke crash-replay-smoke
 	python -c "from prometheus_client import CollectorRegistry; \
 	  from elastic_tpu_agent.metrics import AgentMetrics; \
 	  AgentMetrics(registry=CollectorRegistry()); \

@@ -102,6 +102,15 @@ def parse_args(argv=None) -> argparse.Namespace:
                         "events:create)")
     p.add_argument("--no-crd", action="store_true",
                    help="disable ElasticTPU CRD publication")
+    p.add_argument("--reconcile-period", type=float, default=30.0,
+                   help="seconds between continuous-reconciler passes "
+                        "(store <-> kubelet <-> disk <-> live-pod drift "
+                        "repair; jittered 0.75x-1.25x)")
+    p.add_argument("--reconcile-dry-run", action="store_true",
+                   help="reconciler observes and reports divergences "
+                        "(/debug/allocations 'reconcile' block, doctor "
+                        "bundle) without repairing; the boot-time restore "
+                        "pass still repairs")
     p.add_argument("--crash-loop-threshold", type=int, default=5,
                    help="supervisor circuit breaker: crashes of one "
                         "subsystem within the sliding window before it is "
@@ -232,6 +241,7 @@ def doctor_main(argv=None) -> int:
         node_name=args.node_name,
         agent_url=args.agent_url,
         trace_limit=args.trace_limit,
+        storage=storage,
     )
     if storage is not None:
         storage.close()
@@ -299,6 +309,8 @@ def main(argv=None) -> int:
             sampler_period_s=args.sampler_period,
             dp_pool_size=args.dp_pool_size,
             crash_loop_threshold=args.crash_loop_threshold,
+            reconcile_period_s=args.reconcile_period,
+            reconcile_dry_run=args.reconcile_dry_run,
         )
     )
     run_thread = threading.Thread(

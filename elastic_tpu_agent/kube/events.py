@@ -41,6 +41,7 @@ ReasonBound = "TPUBound"
 ReasonBindFailed = "TPUBindFailed"
 ReasonReclaimed = "TPUReclaimed"
 ReasonRestored = "TPURestored"
+ReasonReconciled = "TPUReconciled"
 ReasonChipUnhealthy = "TPUChipUnhealthy"
 ReasonChipHealthy = "TPUChipHealthy"
 ReasonAllocatableDrift = "TPUAllocatableDrift"

@@ -95,6 +95,11 @@ class PodResourcesSnapshotSource:
         self._refresh_active = 0
         self._refreshing = 0        # in-flight List count (join target)
         self._last_full: Dict[str, Dict[str, PodContainer]] = {}
+        # resource -> hash -> (owner, device-id tuple): the same List,
+        # with the raw ids retained. The reconciler needs them — a bind
+        # replay must reconstruct the exact Device from kubelet's
+        # assignment, not just learn who owns a hash.
+        self._last_assign: Dict[str, Dict[str, tuple]] = {}
         self._prefetch_wake = threading.Event()
         self._prefetch_thread: Optional[threading.Thread] = None
         self._prefetch_debounce_s = 0.0005
@@ -126,8 +131,12 @@ class PodResourcesSnapshotSource:
             return hit
 
     @staticmethod
-    def _build_index(resp) -> Dict[str, Dict[str, PodContainer]]:
-        fresh: Dict[str, Dict[str, PodContainer]] = {}
+    def _build_index(resp) -> tuple:
+        """One pass over the List: (hash->owner index, hash->(owner, ids)
+        assignment map), both keyed per resource. The owner index is
+        DERIVED from the assignment map so the two views can never
+        drift."""
+        assign: Dict[str, Dict[str, tuple]] = {}
         for pod in resp.pod_resources:
             for container in pod.containers:
                 ids_by_resource: Dict[str, list] = {}
@@ -139,12 +148,19 @@ class PodResourcesSnapshotSource:
                     ).extend(dev.device_ids)
                 for resource, ids in ids_by_resource.items():
                     if ids:
-                        fresh.setdefault(resource, {})[
+                        assign.setdefault(resource, {})[
                             device_hash(ids)
-                        ] = PodContainer(
-                            pod.namespace, pod.name, container.name
+                        ] = (
+                            PodContainer(
+                                pod.namespace, pod.name, container.name
+                            ),
+                            tuple(sorted(ids)),
                         )
-        return fresh
+        fresh = {
+            resource: {h: owner_ids[0] for h, owner_ids in entries.items()}
+            for resource, entries in assign.items()
+        }
+        return fresh, assign
 
     @staticmethod
     def _capped(
@@ -220,13 +236,14 @@ class PodResourcesSnapshotSource:
                 resp = self._client.list()
                 self.lists_total += 1
                 sp.set(pods=len(resp.pod_resources))
-            fresh = self._build_index(resp)
+            fresh, assign = self._build_index(resp)
             install = self._capped(fresh)
             with self._cond:
                 if seq > self._installed_seq:
                     self._installed_seq = seq
                     self._snapshot = install
                     self._last_full = fresh
+                    self._last_assign = assign
                 self._done_seq = max(self._done_seq, seq)
             return fresh
         finally:
@@ -260,6 +277,20 @@ class PodResourcesSnapshotSource:
     def resource_entries(self, resource: str) -> Dict[str, PodContainer]:
         with self._lock:
             return self._snapshot.get(resource, {})
+
+    def assignments(
+        self, fresh_start: bool = True
+    ) -> Dict[str, Dict[str, tuple]]:
+        """Fresh kubelet view with device ids retained:
+        ``{resource: {hash: (owner, ids)}}`` — the reconciler's side of
+        the store<->kubelet diff. ``fresh_start`` has refresh()'s
+        semantics (True = a List that started after this call)."""
+        self.refresh(fresh_start=fresh_start)
+        with self._lock:
+            return {
+                res: dict(entries)
+                for res, entries in self._last_assign.items()
+            }
 
     def prefetch_async(self) -> None:
         """Refresh the snapshot in the background.

@@ -89,6 +89,13 @@ class ManagerOptions:
     # DaemonSet liveness probe restarts the pod.
     crash_loop_threshold: int = supervision.DEFAULT_CRASH_LOOP_THRESHOLD
     crash_loop_window_s: float = supervision.DEFAULT_CRASH_LOOP_WINDOW_S
+    # Continuous reconciler (reconciler.py): the boot-time restore
+    # promoted to a supervised loop that keeps diffing store <-> kubelet
+    # <-> disk <-> live pods and repairing drift (CLI --reconcile-period
+    # / --reconcile-dry-run; dry-run makes periodic passes observe-only
+    # — the boot pass always repairs).
+    reconcile_period_s: float = 30.0
+    reconcile_dry_run: bool = False
     # test seams
     kube_client: Optional[KubeClient] = None
     operator: object = None
@@ -208,10 +215,15 @@ class TPUManager:
         self.pr_client = pr_client
         if opts.shared_locator_snapshot:
             shared_source = PodResourcesSnapshotSource(pr_client)
+            # The reconciler diffs against the same snapshot layer the
+            # locators use, so its periodic List rides the single-flight
+            # machinery instead of adding independent kubelet load.
+            self.locator_source = shared_source
             locator_factory = lambda res: KubeletDeviceLocator(  # noqa: E731
                 res, source=shared_source
             )
         else:
+            self.locator_source = PodResourcesSnapshotSource(pr_client)
             locator_factory = lambda res: KubeletDeviceLocator(  # noqa: E731
                 res, pr_client
             )
@@ -242,6 +254,25 @@ class TPUManager:
             # operator probe — debug HTTP threads must not race the
             # health poller through TPUVMOperator's unsynchronized state.
             self.sampler.unhealthy_view_fn = self.plugin.core.unhealthy_chips
+        from .reconciler import Reconciler
+
+        self.reconciler = Reconciler(
+            storage=self.storage,
+            operator=self.operator,
+            plugin=self.plugin,
+            sitter=self.sitter,
+            snapshot_source=self.locator_source,
+            alloc_spec_dir=opts.alloc_spec_dir,
+            metrics=self.metrics,
+            events=self.events,
+            crd_recorder=self.crd_recorder,
+            period_s=opts.reconcile_period_s,
+            dry_run=opts.reconcile_dry_run,
+        )
+        if self.sampler is not None:
+            # /debug/allocations and the doctor bundle carry the live
+            # reconcile/journal state (open intents, per-class repairs).
+            self.sampler.reconcile_status_fn = self.reconciler.status
         self.nri_plugin = None
         if opts.nri_socket:
             from .nri import NRIPlugin
@@ -280,87 +311,28 @@ class TPUManager:
     # -- Restore (SURVEY.md §3.5: declared-but-unimplemented upstream) --------
 
     def restore(self) -> dict:
-        """Reconcile checkpoint state with reality at boot; returns a small
-        report (also exported via metrics)."""
+        """Boot-time convergence: one reconciler pass with boot semantics
+        (acts immediately — the device-plugin servers are not registered
+        yet, so no bind can be in flight). The same logic then keeps
+        running periodically as the supervised ``reconciler`` subsystem;
+        this entry point survives for its callers (run(), tests, tools)
+        and for the Restored node event + restore metrics."""
         from .tracing import get_tracer
 
         with get_tracer().trace("restore") as tr:
-            report = self._restore()
-            tr.set(**report)
-        return report
-
-    def _restore(self) -> dict:
-        from .tracing import get_tracer
-
-        report = {"restored_links": 0, "reclaimed_pods": 0, "kept_pods": 0,
-                  "corrupt_records": 0, "orphan_links": 0, "orphan_specs": 0}
-        report["corrupt_records"] = len(self.storage.corrupt_keys())
-        with get_tracer().span("reconcile_checkpoints"):
-            for _, info in list(self.storage.items()):
-                pod = self.sitter.get_pod(info.namespace, info.name)
-                if pod is None:
-                    try:
-                        pod = self.sitter.get_pod_from_api(
-                            info.namespace, info.name
-                        )
-                    except Exception as e:  # noqa: BLE001
-                        logger.warning(
-                            "restore: apiserver check failed for %s (%s); "
-                            "keeping", info.key, e,
-                        )
-                        report["kept_pods"] += 1
-                        continue
-                if pod is None:
-                    # Pod is gone: reclaim now rather than waiting for GC.
-                    for record in info.records():
-                        for link_id in record.created_node_ids:
-                            try:
-                                self.operator.delete(link_id)
-                            except Exception:  # noqa: BLE001
-                                logger.warning(
-                                    "restore: delete %s failed", link_id
-                                )
-                        if hasattr(self.plugin, "core"):
-                            self.plugin.core.remove_alloc_spec(
-                                record.device.hash
-                            )
-                    self.storage.delete(info.namespace, info.name)
-                    report["reclaimed_pods"] += 1
-                    continue
-                # Pod lives: ensure its virtual nodes exist (Check -> Create).
-                report["kept_pods"] += 1
-                for record in info.records():
-                    for pos, link_id in enumerate(record.created_node_ids):
-                        if not self.operator.check(link_id):
-                            try:
-                                idx = record.chip_indexes[pos]
-                                self.operator.create(idx, link_id)
-                                report["restored_links"] += 1
-                            except Exception:  # noqa: BLE001
-                                logger.exception(
-                                    "restore: re-create %s failed", link_id
-                                )
-        with get_tracer().span("sweep_orphans"):
-            self._sweep_orphans(report)
-        if self.crd_recorder is not None:
-            # Sweep stale ElasticTPU objects this node published for
-            # allocations that no longer exist after the reconcile above;
-            # chip-inventory objects for still-present chips are kept.
-            live = [
-                record.device.hash
-                for _, info in self.storage.items()
-                for record in info.records()
-            ]
-            try:
-                chips = [c.index for c in self.operator.devices()]
-            except Exception:  # noqa: BLE001 - discovery failure
-                chips = []
-            with get_tracer().span("crd_reconcile", live=len(live)):
-                self.crd_recorder.reconcile(live, chip_indexes=chips)
+            report = self.reconciler.reconcile_once(boot=True)
+            tr.set(**{
+                k: v for k, v in report.items()
+                if isinstance(v, (int, float)) and not isinstance(v, bool)
+            })
         logger.info("restore report: %s", report)
+        replayed = (
+            report["intents_committed"] + report["intents_rolled_back"]
+            + report["replayed_binds"] + report["rebound_drift"]
+        )
         if self.events is not None and (
             report["restored_links"] or report["reclaimed_pods"]
-            or report["orphan_links"] or report["orphan_specs"]
+            or report["orphan_links"] or report["orphan_specs"] or replayed
         ):
             from .kube.events import ReasonRestored
 
@@ -370,61 +342,13 @@ class TPUManager:
                 f"{report['restored_links']} link(s) restored, "
                 f"{report['reclaimed_pods']} dead pod(s) reclaimed, "
                 f"{report['orphan_links'] + report['orphan_specs']} "
-                "orphan artifact(s) swept",
+                "orphan artifact(s) swept, "
+                f"{replayed} interrupted bind(s) recovered",
             )
         if self.metrics is not None:
             self.metrics.restored_links.inc(report["restored_links"])
             self.metrics.bound_allocations.set(self.storage.count())
         return report
-
-    def _sweep_orphans(self, report: dict) -> None:
-        """Reclaim virtual nodes and alloc specs with no checkpoint record.
-
-        A bind creates nodes, writes the alloc spec, THEN checkpoints
-        (tpushare._bind); an agent crash inside that window leaves artifacts
-        no storage-driven path (GC, the restore loop above) will ever see.
-        Links created for live pods are recorded before kubelet starts the
-        container, so at boot time anything unrecorded is garbage."""
-        if self.storage.corrupt_keys():
-            # A corrupt checkpoint row may describe a LIVE allocation whose
-            # links/specs we can no longer enumerate; sweeping now would
-            # destroy state out from under a running container. Stay
-            # non-destructive (pre-sweep behavior) until the row is gone.
-            logger.warning(
-                "restore: skipping orphan sweep — %d corrupt checkpoint "
-                "record(s) present", len(self.storage.corrupt_keys()),
-            )
-            return
-        known_links = set()
-        known_hashes = set()
-        for _, info in self.storage.items():
-            for record in info.records():
-                known_links.update(record.created_node_ids)
-                known_hashes.add(record.device.hash)
-        if hasattr(self.operator, "list_links"):
-            for link_id in self.operator.list_links():
-                if link_id in known_links:
-                    continue
-                try:
-                    self.operator.delete(link_id)
-                    report["orphan_links"] += 1
-                except Exception:  # noqa: BLE001
-                    logger.warning("restore: orphan delete %s failed", link_id)
-        spec_dir = self._opts.alloc_spec_dir
-        try:
-            spec_files = os.listdir(spec_dir)
-        except FileNotFoundError:
-            return
-        for fname in spec_files:
-            if not fname.endswith(".json"):
-                continue
-            if fname[: -len(".json")] in known_hashes:
-                continue
-            try:
-                os.unlink(os.path.join(spec_dir, fname))
-                report["orphan_specs"] += 1
-            except OSError:
-                logger.warning("restore: orphan spec unlink %s failed", fname)
 
     def check_allocatable_drift(self) -> Optional[dict]:
         """Cross-check kubelet's allocatable-device view (pod-resources v1
@@ -551,6 +475,10 @@ class TPUManager:
             self.supervisor.register(
                 "health", self.plugin.health_loop, DEGRADED
             )
+        # Continuous reconciler: DEGRADED — a broken reconciler leaves the
+        # node binding (with the boot-converged state) while /healthz and
+        # the doctor bundle surface the loss of self-repair.
+        self.supervisor.register("reconciler", self.reconciler.run, DEGRADED)
         if self.sampler is not None:
             self.supervisor.register("sampler", self.sampler.run, DEGRADED)
         if self.nri_plugin is not None:
@@ -585,6 +513,9 @@ class TPUManager:
         # Same invariant for the health poller: it submits events too.
         self.supervisor.join("health", timeout=10.0)
         self.supervisor.join("sampler", timeout=10.0)
+        # The reconciler both writes storage and submits CRD releases:
+        # join it before the recorder stops and the db closes.
+        self.supervisor.join("reconciler", timeout=10.0)
         if self.nri_plugin is not None:
             self.nri_plugin.stop()
         if hasattr(self.plugin, "core"):

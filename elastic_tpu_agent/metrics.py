@@ -172,6 +172,31 @@ class AgentMetrics:
             "Virtual device nodes re-created by restore()",
             **kw,
         )
+        # -- continuous reconciler (reconciler.py) -------------------------
+        self.reconcile_repairs = Counter(
+            "elastic_tpu_reconcile_repairs_total",
+            "Divergences repaired by the reconciler, per divergence class",
+            ["kind"],
+            **kw,
+        )
+        self.reconcile_runs = Counter(
+            "elastic_tpu_reconcile_runs_total",
+            "Reconciler passes completed (boot restore included)",
+            **kw,
+        )
+        self.orphan_sweep_failures = Counter(
+            "elastic_tpu_orphan_sweep_failures_total",
+            "Orphan link/spec deletions that failed; each is retried on "
+            "the next reconcile pass instead of being dropped",
+            **kw,
+        )
+        self.open_bind_intents = Gauge(
+            "elastic_tpu_bind_intents_open",
+            "Uncommitted bind intents in the write-ahead journal "
+            "(sustained non-zero = a bind crashed and was not yet "
+            "recovered, or a bind is wedged mid-flight)",
+            **kw,
+        )
         self.observability_dropped = Counter(
             "elastic_tpu_observability_dropped_total",
             "CRD/event writes dropped by the bounded async queue",

@@ -110,6 +110,16 @@ def bind_lock_stats() -> Dict:
     return _BIND_LOCKS.stats()
 
 
+def bind_lock(pod_key: str):
+    """Context manager holding the owner's bind stripe — the reconciler
+    serializes intent rollback / drift repair against live binds with
+    exactly the lock the bind path itself uses. NOT reentrant: never
+    call back into plugin methods that take the stripe themselves
+    (``remove_alloc_spec``) while holding it — use the ``_locked``
+    variants."""
+    return _BIND_LOCKS.acquire(pod_key)
+
+
 def _write_json_atomic(path: str, payload: Dict) -> None:
     tmp = path + ".tmp"
     with open(tmp, "w") as f:
@@ -576,8 +586,28 @@ class _TPUSharePluginBase(_ListAndWatchMixin, rpc.DevicePluginServicer):
             if c is not None
         })
 
+    def _journal_intent(
+        self, owner, device: Device, chip_indexes: List[int],
+        planned: List[str],
+    ) -> int:
+        """Write-ahead intent: everything recovery needs to roll this
+        bind back (the link ids it will create, the spec hash) or replay
+        it (the exact device ids), durably recorded BEFORE the first
+        side effect."""
+        return self._storage.journal_intent(
+            owner.pod_key, owner.container, self.resource, device.hash,
+            {
+                "device_ids": list(device.ids),
+                "chip_indexes": list(chip_indexes),
+                "planned_link_ids": list(planned),
+            },
+        )
+
     def _bind_located(self, device: Device, owner, pod: dict) -> None:
         annotations = pod.get("metadata", {}).get("annotations", {}) or {}
+        # Crash-window failpoints (test-only): each names the window a
+        # process death is injected into, proving the journal recovers it.
+        faults.fire("bind.pre_journal")
         if self._whole_chip:
             # Whole-chip mode (reference: the nvidia no-op operator,
             # pkg/operator/nvidia.go): kubelet's device choice IS the
@@ -586,9 +616,24 @@ class _TPUSharePluginBase(_ListAndWatchMixin, rpc.DevicePluginServicer):
             # physical /dev/accel* paths.
             chip_indexes = self._chips_from_ids(device)
             self._require_known_chips(chip_indexes)
-            self._finish_bind(
-                device, owner, pod, annotations, chip_indexes, created=[],
-            )
+            intent_id = self._journal_intent(owner, device, chip_indexes, [])
+            try:
+                faults.fire("bind.post_journal")
+                try:
+                    self._finish_bind(
+                        device, owner, pod, annotations, chip_indexes,
+                        created=[], intent_id=intent_id,
+                    )
+                except Exception:
+                    # Handled failure: the bind rolled itself back, so
+                    # the intent must not linger for the reconciler.
+                    self._storage.journal_remove(intent_id)
+                    raise
+            finally:
+                # On EVERY exit (BaseException included) this thread
+                # stops shielding the intent from the reconciler; a
+                # dead thread's row becomes recoverable immediately.
+                self._storage.intent_done(intent_id)
             return
         if annotations.get(AnnotationAssumed) != "true":
             raise LocateError(
@@ -619,21 +664,43 @@ class _TPUSharePluginBase(_ListAndWatchMixin, rpc.DevicePluginServicer):
             )
         self._require_known_chips(chip_indexes)
 
-        # Materialize virtual nodes; roll back on partial failure
-        # (reference: gpushare.go:133-142).
-        created: List[str] = []
+        # Intent journaled before the first side effect; materialize
+        # virtual nodes; roll back on partial failure (reference:
+        # gpushare.go:133-142).
+        planned = [f"{device.hash}-{p}" for p in range(len(chip_indexes))]
+        intent_id = self._journal_intent(owner, device, chip_indexes, planned)
         try:
-            with get_tracer().span(
-                "materialize_nodes", chips=list(chip_indexes)
-            ):
-                for p, idx in enumerate(chip_indexes):
-                    link_id = f"{device.hash}-{p}"
-                    self._operator.create(idx, link_id)
-                    created.append(link_id)
-        except Exception:
-            self._rollback_created(created)
-            raise
-        self._finish_bind(device, owner, pod, annotations, chip_indexes, created)
+            faults.fire("bind.post_journal")
+            created: List[str] = []
+            try:
+                with get_tracer().span(
+                    "materialize_nodes", chips=list(chip_indexes)
+                ):
+                    for p, idx in enumerate(chip_indexes):
+                        link_id = f"{device.hash}-{p}"
+                        self._operator.create(idx, link_id)
+                        created.append(link_id)
+                faults.fire("bind.post_create")
+            except Exception:
+                self._rollback_created(created)
+                self._storage.journal_remove(intent_id)
+                raise
+            try:
+                self._finish_bind(
+                    device, owner, pod, annotations, chip_indexes, created,
+                    intent_id=intent_id,
+                )
+            except Exception:
+                # Handled failure: _finish_bind already rolled back the
+                # spec/links; clear the intent so only a real crash
+                # leaves one.
+                self._storage.journal_remove(intent_id)
+                raise
+        finally:
+            # On EVERY exit (BaseException included) this thread stops
+            # shielding the intent from the reconciler; a dead thread's
+            # row becomes recoverable immediately.
+            self._storage.intent_done(intent_id)
 
     def _rollback_created(self, created: List[str]) -> None:
         for link_id in created:
@@ -657,6 +724,7 @@ class _TPUSharePluginBase(_ListAndWatchMixin, rpc.DevicePluginServicer):
         annotations: Dict,
         chip_indexes: List[int],
         created: List[str],
+        intent_id: Optional[int] = None,
     ) -> None:
         # One PER-OWNER lock spans sibling discovery, the spec write, AND
         # the storage save that publishes this allocation: a core/memory
@@ -698,6 +766,7 @@ class _TPUSharePluginBase(_ListAndWatchMixin, rpc.DevicePluginServicer):
                     self._restore_sibling_specs(owner, device.hash)
                 self._rollback_created(created)
                 raise
+            faults.fire("bind.post_spec")
 
             record = AllocationRecord(
                 device=device,
@@ -713,6 +782,13 @@ class _TPUSharePluginBase(_ListAndWatchMixin, rpc.DevicePluginServicer):
                     owner.namespace, owner.name,
                     lambda info: info.set_allocation(owner.container, record),
                 )
+            faults.fire("bind.post_checkpoint")
+            if intent_id is not None:
+                # Commit = drop the journal row, INSIDE the stripe: the
+                # reconciler's "intent still open?" re-check holds this
+                # stripe too, so open-at-recheck implies no concurrent
+                # bind is past its checkpoint for this pod.
+                self._storage.journal_commit(intent_id)
         finally:
             locks.release_key(owner.pod_key)
         if self._metrics is not None:
@@ -882,13 +958,48 @@ class _TPUSharePluginBase(_ListAndWatchMixin, rpc.DevicePluginServicer):
         """Unlink an allocation's spec; when ``owner`` is given, also
         restore the container's surviving sibling specs to their own
         (unmerged) content."""
+        if owner is None:
+            try:
+                os.unlink(
+                    os.path.join(self._alloc_dir, f"{alloc_hash}.json")
+                )
+            except FileNotFoundError:
+                pass
+            return
+        with _BIND_LOCKS.acquire(owner.pod_key):
+            self.remove_alloc_spec_locked(alloc_hash, owner)
+
+    def remove_alloc_spec_locked(self, alloc_hash: str, owner) -> None:
+        """remove_alloc_spec for a caller ALREADY holding the owner's
+        bind stripe (the reconciler's intent rollback / drift repair —
+        the stripes are not reentrant)."""
         try:
             os.unlink(os.path.join(self._alloc_dir, f"{alloc_hash}.json"))
         except FileNotFoundError:
             pass
-        if owner is not None:
-            with _BIND_LOCKS.acquire(owner.pod_key):
-                self._restore_sibling_specs(owner, alloc_hash)
+        self._restore_sibling_specs(owner, alloc_hash)
+
+    def alloc_spec_exists(self, alloc_hash: str) -> bool:
+        """Whether the OCI-hook spec file for an allocation is on disk
+        (reconciler divergence check)."""
+        return os.path.exists(
+            os.path.join(self._alloc_dir, f"{alloc_hash}.json")
+        )
+
+    def rebind(self, owner, device: Device) -> None:
+        """Reconciler entry point: run the full bind transaction for an
+        already-located owner — journals its own intent, re-creates
+        virtual nodes (idempotent), rewrites/merges the alloc spec and
+        re-checkpoints. Used to replay a bind that kubelet's assignment
+        proves happened but that a crash cut short, and to re-bind after
+        a kubelet restart handed the container different device ids."""
+        pod = self._lookup_pod(owner)
+        if pod is None:
+            raise LocateError(f"pod {owner.pod_key} not found anywhere")
+        get_tracer().annotate(
+            pod=f"{owner.namespace}/{owner.name}", container=owner.container
+        )
+        self._bind_located(device, owner, pod)
 
 
 class TPUShareCorePlugin(_TPUSharePluginBase):
@@ -1051,6 +1162,15 @@ class TPUSharePlugin:
             ResourceTPUCore: self.core.locator_stats(),
             ResourceTPUMemory: self.memory.locator_stats(),
         }
+
+    def plugin_for_resource(self, resource: str):
+        """The per-resource server handling ``resource`` (None when it
+        is not one of ours — the reconciler skips foreign extended
+        resources in kubelet's pod-resources dump)."""
+        return {
+            ResourceTPUCore: self.core,
+            ResourceTPUMemory: self.memory,
+        }.get(resource)
 
     def bind_stats(self) -> Dict:
         """Bind-pipeline introspection: in-flight binds, totals, the gRPC

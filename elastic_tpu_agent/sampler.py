@@ -113,6 +113,11 @@ class UtilizationSampler:
         # gRPC pool size, bind-lock contention) from the plugin's
         # bind_stats(); rides into /debug/allocations and the bundle.
         self.bind_stats_fn: Optional[Callable[[], dict]] = None
+        # Also manager-set: () -> reconciler status (last run, per-class
+        # repair totals, open bind intents with age) from
+        # Reconciler.status(); rides into /debug/allocations and the
+        # doctor bundle so a stuck intent is diagnosable from either.
+        self.reconcile_status_fn: Optional[Callable[[], dict]] = None
         # Also manager-set: () -> set of unhealthy chip indexes, the
         # plugin's APPLIED health view. Snapshots must read this (a
         # plain set copy) instead of re-probing the operator:
@@ -586,6 +591,11 @@ class UtilizationSampler:
                 out["bind"] = self.bind_stats_fn()
             except Exception:  # noqa: BLE001 - introspection only
                 pass
+        if self.reconcile_status_fn is not None:
+            try:
+                out["reconcile"] = self.reconcile_status_fn()
+            except Exception:  # noqa: BLE001 - introspection only
+                pass
         return out
 
 
@@ -617,6 +627,7 @@ def build_diagnostics_bundle(
     agent_url: str = "",
     trace_limit: int = 50,
     http_timeout_s: float = 3.0,
+    storage=None,
 ) -> dict:
     """One JSON document with everything a support escalation needs:
     devices, health + reasons, raw error counters, the live allocation
@@ -681,8 +692,23 @@ def build_diagnostics_bundle(
         },
         "traces": [],
         "subsystems": {},
+        "reconcile": {},
         "agent": {"url": agent_url, "reachable": None},
     }
+    # Journal/reconciler state: from the live sampler hook when attached,
+    # else straight from the checkpoint db — open intents must be
+    # readable from a bundle even when the agent is down (that IS the
+    # crashed-mid-bind case the journal exists for).
+    live_reconcile = bundle["allocations"].get("reconcile")
+    if isinstance(live_reconcile, dict):
+        bundle["reconcile"] = live_reconcile
+    elif storage is not None:
+        try:
+            bundle["reconcile"] = {
+                "open_intents": storage.open_intents_brief(),
+            }
+        except Exception as e:  # noqa: BLE001 - partial bundles beat none
+            logger.warning("doctor: journal read failed: %s", e)
     if agent_url:
         base = agent_url.rstrip("/")
         try:
@@ -701,6 +727,10 @@ def build_diagnostics_bundle(
                     f"{base}/debug/allocations", http_timeout_s
                 )
                 bundle["agent"]["allocations"] = live
+                if isinstance(live.get("reconcile"), dict):
+                    # Same top-level lift as subsystems: "is a bind
+                    # stuck?" is a first-page question.
+                    bundle["reconcile"] = live["reconcile"]
             except Exception:  # noqa: BLE001 - traces were the hard part
                 pass
         except Exception as e:  # noqa: BLE001
@@ -771,6 +801,24 @@ def validate_bundle(bundle: dict) -> List[str]:
                    f"sampler_windows.{field} must be an object")
     expect(isinstance(bundle.get("traces"), list), "traces must be a list")
     expect(isinstance(bundle.get("agent"), dict), "agent must be an object")
+    if "reconcile" in bundle:  # absent only in pre-reconciler bundles
+        reconcile = bundle["reconcile"]
+        expect(isinstance(reconcile, dict), "reconcile must be an object")
+        if isinstance(reconcile, dict) and "open_intents" in reconcile:
+            intents = reconcile["open_intents"]
+            expect(isinstance(intents, list),
+                   "reconcile.open_intents must be a list")
+            for i, intent in enumerate(
+                intents if isinstance(intents, list) else []
+            ):
+                if not isinstance(intent, dict):
+                    problems.append(
+                        f"reconcile.open_intents[{i}] must be an object"
+                    )
+                    continue
+                for field in ("pod", "resource", "hash", "age_s"):
+                    expect(field in intent,
+                           f"reconcile.open_intents[{i}] missing {field!r}")
     if "subsystems" in bundle:  # absent only in pre-supervision bundles
         subsystems = bundle["subsystems"]
         expect(isinstance(subsystems, dict), "subsystems must be an object")

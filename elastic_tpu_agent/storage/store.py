@@ -47,6 +47,26 @@ CREATE TABLE IF NOT EXISTS pods (
 );
 """
 
+# Write-ahead bind intent journal (reconciler.py). A bind writes an
+# intent row BEFORE its first side effect (virtual-node creation) and
+# removes it only after the allocation record has been checkpointed —
+# the pods-table record IS the commit marker, so a surviving journal
+# row means "this bind never (provably) completed": the reconciler
+# replays or rolls it back at the next boot/tick. Kept in the same
+# SQLite file so the intent write and the checkpoint share one durable
+# store (one fsync domain, one thing to hostPath-mount).
+_JOURNAL_SCHEMA = """
+CREATE TABLE IF NOT EXISTS bind_intents (
+    id         INTEGER PRIMARY KEY AUTOINCREMENT,
+    pod_key    TEXT NOT NULL,    -- "namespace/name"
+    container  TEXT NOT NULL,
+    resource   TEXT NOT NULL,
+    hash       TEXT NOT NULL,    -- device-set hash the bind will commit
+    payload    TEXT NOT NULL,    -- JSON: device_ids/chip_indexes/planned_link_ids
+    created_ts REAL NOT NULL     -- wall clock, for open-intent age display
+);
+"""
+
 
 class Storage:
     """Thread-safe persistent map of pod key -> PodInfo.
@@ -78,12 +98,22 @@ class Storage:
         self._data_version: Optional[int] = None
         self.scans = 0         # full-table SQL scans actually paid
         self.cache_serves = 0  # full iterations answered from the cache
+        # Intent ids with a LIVE bind thread in THIS process between
+        # journal-write and commit. The reconciler must never roll back
+        # an intent that is merely slow (sqlite busy retries, a stalled
+        # hostPath, stripe queueing in a rebind burst) rather than
+        # crashed: membership here is exact — the bind's finally removes
+        # the id on every exit including BaseException, and a real
+        # process death takes the set with it, leaving exactly the
+        # orphaned rows recovery exists for.
+        self._inflight_intents: set = set()
         try:
             self._db = sqlite3.connect(path, check_same_thread=False)
             self._db.execute("PRAGMA journal_mode=WAL")
             self._db.execute("PRAGMA synchronous=NORMAL")
             self._db.execute(f"PRAGMA busy_timeout={BUSY_TIMEOUT_MS}")
             self._db.execute(_SCHEMA)
+            self._db.execute(_JOURNAL_SCHEMA)
             self._db.commit()
         except sqlite3.Error as e:
             raise StorageError(f"open {path}: {e}") from e
@@ -242,6 +272,144 @@ class Storage:
                 ).fetchone()[0]
             except sqlite3.Error as e:
                 raise StorageError(f"count: {e}") from e
+
+    # -- bind intent journal (write-ahead log for the bind transaction) -------
+
+    def journal_intent(
+        self,
+        pod_key: str,
+        container: str,
+        resource: str,
+        alloc_hash: str,
+        payload: dict,
+    ) -> int:
+        """Record a bind intent BEFORE the bind's first side effect;
+        returns the intent id the bind later commits. The payload must
+        name everything recovery needs to undo or replay the bind
+        (device ids, chip indexes, planned virtual-node link ids)."""
+        faults.fire("storage.journal")
+        value = json.dumps(payload, sort_keys=True)
+        with self._lock:
+            for attempt in (1, 2):
+                try:
+                    cur = self._db.execute(
+                        "INSERT INTO bind_intents"
+                        "(pod_key, container, resource, hash, payload, "
+                        "created_ts) VALUES(?, ?, ?, ?, ?, ?)",
+                        (pod_key, container, resource, alloc_hash, value,
+                         time.time()),
+                    )
+                    self._db.commit()
+                    self._inflight_intents.add(cur.lastrowid)
+                    return cur.lastrowid
+                except sqlite3.Error as e:
+                    transient = self._is_transient_lock(e) and attempt == 1
+                    try:
+                        self._db.rollback()
+                    except sqlite3.Error:
+                        pass
+                    if not transient:
+                        raise StorageError(
+                            f"journal intent {pod_key}/{container}: {e}"
+                        ) from e
+                    time.sleep(_LOCKED_RETRY_DELAY_S)
+        raise StorageError(f"journal intent {pod_key}/{container}: retries "
+                           "exhausted")  # pragma: no cover - loop returns
+
+    def journal_commit(self, intent_id: int) -> None:
+        """Mark a bind intent committed. The checkpointed allocation
+        record (pods table) is the durable commit marker, so committing
+        an intent simply removes its row — an intent that survives a
+        crash is, by construction, one whose bind never provably
+        finished."""
+        with self._lock:
+            self._write(
+                f"journal commit {intent_id}",
+                "DELETE FROM bind_intents WHERE id=?",
+                (intent_id,),
+            )
+            self._inflight_intents.discard(intent_id)
+
+    # A rolled-back intent leaves the journal the same way a committed
+    # one does; the distinct name keeps call sites self-describing.
+    journal_remove = journal_commit
+
+    def intent_done(self, intent_id: int) -> None:
+        """Drop the in-process in-flight marker WITHOUT touching the
+        journal row — the bind path's finally, so a thread that dies on
+        an uncaught exception stops shielding its intent from recovery
+        (the row itself survives for the reconciler)."""
+        with self._lock:
+            self._inflight_intents.discard(intent_id)
+
+    def intent_inflight(self, intent_id: int) -> bool:
+        """True while a live bind thread in this process owns the
+        intent; the reconciler must not resolve such a row no matter
+        how slow the bind is going."""
+        with self._lock:
+            return intent_id in self._inflight_intents
+
+    def intent_open(self, intent_id: int) -> bool:
+        """True while the intent row still exists (reconciler re-checks
+        under the owner's bind stripe before rolling an intent back)."""
+        with self._lock:
+            try:
+                row = self._db.execute(
+                    "SELECT 1 FROM bind_intents WHERE id=?", (intent_id,)
+                ).fetchone()
+            except sqlite3.Error as e:
+                raise StorageError(f"intent_open {intent_id}: {e}") from e
+        return row is not None
+
+    def open_intents(self) -> list:
+        """All uncommitted bind intents, oldest first, with wall-clock
+        age — consumed by the reconciler, /debug/allocations and the
+        node-doctor bundle (a stuck intent must be diagnosable from a
+        bundle alone)."""
+        with self._lock:
+            try:
+                rows = self._db.execute(
+                    "SELECT id, pod_key, container, resource, hash, "
+                    "payload, created_ts FROM bind_intents ORDER BY id"
+                ).fetchall()
+            except sqlite3.Error as e:
+                raise StorageError(f"open_intents: {e}") from e
+        now = time.time()
+        out = []
+        for row in rows:
+            try:
+                payload = json.loads(row[5])
+            except ValueError:
+                payload = {}
+            out.append({
+                "id": row[0],
+                "pod_key": row[1],
+                "container": row[2],
+                "resource": row[3],
+                "hash": row[4],
+                "payload": payload,
+                "created_ts": row[6],
+                "age_s": round(max(0.0, now - row[6]), 3),
+            })
+        return out
+
+    def open_intents_brief(self) -> list:
+        """open_intents() projected to the public diagnostics shape
+        (``{id,pod,container,resource,hash,age_s}``) shared by the
+        reconciler's status(), /debug/allocations and the node-doctor
+        bundle — one place to evolve the field set, validated by
+        sampler.validate_bundle."""
+        return [
+            {
+                "id": i["id"],
+                "pod": i["pod_key"],
+                "container": i["container"],
+                "resource": i["resource"],
+                "hash": i["hash"],
+                "age_s": i["age_s"],
+            }
+            for i in self.open_intents()
+        ]
 
     def for_each(self, fn: Callable[[PodInfo], None]) -> None:
         """Invoke fn on a snapshot of every stored PodInfo.

@@ -22,6 +22,7 @@ from __future__ import annotations
 import logging
 import os
 import re
+import threading
 from abc import ABC, abstractmethod
 from dataclasses import dataclass, field
 from typing import List, Optional
@@ -134,21 +135,58 @@ class LinkingOperator(TPUOperator):
         return os.path.join(self._target_root, f"accel{index}")
 
     def create(self, index: int, link_id: str) -> None:
+        """Crash-atomic, idempotent create with verify-after-write.
+
+        The link is made under a temp name and renamed into place
+        (``os.replace`` = one atomic rename syscall), so no crash point
+        can leave a half-made or wrong-target link at the final path:
+        either the old state survives intact or the complete new link
+        does. A leaked temp (crash between symlink and rename) carries
+        the virtual prefix, so the reconciler's orphan sweep reclaims
+        it like any other unrecorded link. Re-creating an existing,
+        correct link is a no-op (journal replay / restore path)."""
         faults.fire("operator.create")
         link = self.link_path(link_id)
         target = self.target_path(index)
         with get_tracer().span("operator_create", link=link, target=target):
             try:
-                if os.path.islink(link):
-                    if os.readlink(link) == target:
-                        return  # idempotent re-create (Restore path)
-                    os.unlink(link)
-                os.symlink(target, link)
+                if os.path.islink(link) and os.readlink(link) == target:
+                    return  # idempotent re-create (replay/restore path)
+                # Unique per pid AND thread: the reconciler's repair of
+                # a missing link can race a kubelet-driven rebind of the
+                # SAME link id — two threads sharing one temp path would
+                # delete each other's pending temps and fail a healthy
+                # bind. A temp leaked by a crash carries the virtual
+                # prefix, so the orphan sweep reclaims it.
+                tmp = f"{link}.{os.getpid()}.{threading.get_ident()}.tmp"
+                try:
+                    os.unlink(tmp)  # stale temp from this thread's retry
+                except FileNotFoundError:
+                    pass
+                os.symlink(target, tmp)
+                os.replace(tmp, link)
             except OSError as e:
                 raise OperatorError(f"create {link} -> {target}: {e}") from e
+            # Verify-after-write: a create the journal replays must be
+            # trustworthy — read the link back instead of assuming the
+            # rename landed (NFS-ish hostPaths do lie).
+            try:
+                back = os.readlink(link)
+            except OSError as e:
+                raise OperatorError(
+                    f"create {link}: verify-after-write failed: {e}"
+                ) from e
+            if back != target:
+                raise OperatorError(
+                    f"create {link}: verify-after-write mismatch "
+                    f"({back!r} != {target!r})"
+                )
         logger.info("created virtual TPU node %s -> %s", link, target)
 
     def delete(self, link_id: str) -> None:
+        """Idempotent delete: ENOENT is success (journal rollback and
+        orphan sweeps replay deletes freely), and the removal is
+        verified before being reported successful."""
         faults.fire("operator.delete")
         link = self.link_path(link_id)
         with get_tracer().span("operator_delete", link=link):
@@ -159,6 +197,8 @@ class LinkingOperator(TPUOperator):
                 pass
             except OSError as e:
                 raise OperatorError(f"delete {link}: {e}") from e
+            if os.path.islink(link):  # verify-after-write
+                raise OperatorError(f"delete {link}: link still present")
 
     def check(self, link_id: str) -> bool:
         return os.path.islink(self.link_path(link_id))

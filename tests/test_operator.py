@@ -173,3 +173,68 @@ def test_tpuvm_worker_hostnames_env(tmp_path):
     )
     assert op.worker_hostnames() == ["h0", "h1"]
     assert op.worker_id() == 1
+
+
+def test_create_is_atomic_via_rename(dev_root, monkeypatch):
+    """A crash can never leave a half-made or wrong-target link at the
+    final path: the link materializes under a temp name and lands via
+    one atomic rename."""
+    op = StubOperator(dev_root, "v5litepod-4")
+    observed = []
+    real_replace = os.replace
+
+    def spying_replace(src, dst):
+        observed.append((src, dst))
+        real_replace(src, dst)
+
+    monkeypatch.setattr(os, "replace", spying_replace)
+    op.create(2, "cafe-0")
+    ((src, dst),) = observed
+    assert dst == os.path.join(dev_root, "elastic-tpu-cafe-0")
+    assert src.startswith(dst)  # temp name in the same directory
+    assert src != dst
+    assert op.resolve("cafe-0") == 2
+    # no temp debris after a clean create
+    assert sorted(os.listdir(dev_root)) == ["elastic-tpu-cafe-0"]
+
+
+def test_create_cleans_stale_temp_and_leaks_are_sweepable(dev_root):
+    import threading
+
+    op = StubOperator(dev_root, "v5litepod-4")
+    link = os.path.join(dev_root, "elastic-tpu-cafe-0")
+    # this thread's own stale temp (a retry after its earlier failure)
+    own_tmp = f"{link}.{os.getpid()}.{threading.get_ident()}.tmp"
+    os.symlink("/dev/accel9", own_tmp)
+    # a crashed OTHER process/thread's temp: not ours to touch inline...
+    foreign_tmp = f"{link}.99999.11.tmp"
+    os.symlink("/dev/accel8", foreign_tmp)
+    op.create(1, "cafe-0")
+    assert os.readlink(link) == "/dev/accel1"
+    assert not os.path.lexists(own_tmp)
+    # ...but it carries the virtual prefix, so the reconciler's orphan
+    # sweep sees it (list_links) and can delete it by its listed id.
+    leaked_id = "cafe-0.99999.11.tmp"
+    assert leaked_id in op.list_links()
+    op.delete(leaked_id)
+    assert not os.path.lexists(foreign_tmp)
+
+
+def test_create_verify_after_write_catches_lying_fs(dev_root, monkeypatch):
+    from elastic_tpu_agent.tpu.operator import OperatorError
+
+    op = StubOperator(dev_root, "v5litepod-4")
+
+    def lying_replace(src, dst):
+        os.unlink(src)  # the rename "succeeds" but nothing lands
+
+    monkeypatch.setattr(os, "replace", lying_replace)
+    with pytest.raises(OperatorError, match="verify-after-write"):
+        op.create(0, "bad0-0")
+
+
+def test_delete_missing_link_is_success(dev_root):
+    """Idempotent replay: journal rollback deletes links that may never
+    have been created."""
+    op = StubOperator(dev_root, "v5litepod-4")
+    op.delete("never-existed-0")  # no raise

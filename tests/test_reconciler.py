@@ -1,0 +1,599 @@
+"""Crash-consistent bind transactions + the continuous reconciler.
+
+The acceptance bar (ISSUE 5): for EVERY mid-bind crash window, restarting
+the manager against the surviving store + fake kubelet converges to the
+exact same allocation table, symlink set and spec files as the crash-free
+run, with zero orphaned intents left in the journal. The crash windows
+are the `bind.*` failpoints threaded through tpushare's bind transaction:
+
+    pre_journal  -> nothing durable yet (kubelet assignment is the proof)
+    post_journal -> intent only
+    post_create  -> intent + symlinks
+    post_spec    -> intent + symlinks + (merged) alloc specs
+    post_checkpoint -> everything but the journal commit
+
+`make crash-replay-smoke` runs this file: deterministic (die-thread
+failpoints, in-process bind drive, no sleeps on the replay path).
+"""
+
+import json
+import os
+import sqlite3
+
+import pytest
+
+from elastic_tpu_agent import faults
+from elastic_tpu_agent.common import (
+    AnnotationAssumed,
+    ResourceTPUCore,
+    ResourceTPUMemory,
+    container_annotation,
+)
+from elastic_tpu_agent.manager import TPUManager
+from elastic_tpu_agent.plugins.tpushare import (
+    CORE_ENDPOINT,
+    core_device_id,
+    mem_device_id,
+)
+from elastic_tpu_agent.tpu.operator import OperatorError
+from elastic_tpu_agent.types import Device
+
+from test_e2e import Cluster, wait_until
+
+from fake_apiserver import make_pod
+
+FAILPOINTS = [
+    "bind.pre_journal",
+    "bind.post_journal",
+    "bind.post_create",
+    "bind.post_spec",
+    "bind.post_checkpoint",
+]
+
+POD = "crashy"
+CORE_IDS = [core_device_id(1, i) for i in range(100)]
+MEM_IDS = [mem_device_id(1, u) for u in range(1024)]
+
+
+# -- harness ------------------------------------------------------------------
+
+
+def _make_cluster(tmp_path, name):
+    d = tmp_path / name
+    d.mkdir()
+    c = Cluster(d)
+    c.start()
+    return c
+
+
+def _annotate(c, pod_name, chips):
+    c.apiserver.upsert_pod(
+        make_pod(
+            "default", pod_name, c.node,
+            annotations={
+                AnnotationAssumed: "true",
+                container_annotation("jax"): chips,
+            },
+            containers=[{"name": "jax"}],
+        )
+    )
+    assert wait_until(
+        lambda: c.manager.sitter.get_pod("default", pod_name) is not None
+    )
+
+
+def _bind_inprocess(c, pod_name, resource, ids):
+    """The kubelet flow driven in-process (assignment recorded, then the
+    PreStart bind handler called directly) so a die-thread failpoint
+    kills exactly the bind call under test — no gRPC in between."""
+    c.kubelet.assign("default", pod_name, "jax", resource, ids)
+    plugin = (
+        c.manager.plugin.core if resource == ResourceTPUCore
+        else c.manager.plugin.memory
+    )
+    plugin._bind(Device(ids, resource))
+
+
+def _crash_and_restart(c, failpoint, resource, ids):
+    """Run the bind into a die-thread failpoint, 'crash' the agent, and
+    boot a second generation over the surviving store + fake kubelet."""
+    c.kubelet.assign("default", POD, "jax", resource, ids)
+    plugin = (
+        c.manager.plugin.core if resource == ResourceTPUCore
+        else c.manager.plugin.memory
+    )
+    with faults.armed(failpoint, "die-thread:1"):
+        with pytest.raises(faults.DieThread):
+            plugin._bind(Device(ids, resource))
+    c.manager.stop()
+    mgr2 = TPUManager(c.opts)
+    mgr2.run(block=False)  # boot restore == reconcile_once(boot=True)
+    c.manager = mgr2
+
+
+def _strip_trace(obj):
+    """Trace ids differ per run by design; everything else must match."""
+    if isinstance(obj, dict):
+        return {
+            k: _strip_trace(v) for k, v in obj.items()
+            if k != "ELASTIC_TPU_TRACE_ID"
+        }
+    if isinstance(obj, list):
+        return [_strip_trace(v) for v in obj]
+    return obj
+
+
+def _end_state(c):
+    """Normalized durable state: symlink set, spec files, allocation
+    table, open journal intents."""
+    links = {}
+    for name in sorted(os.listdir(c.opts.dev_root)):
+        links[name] = os.readlink(os.path.join(c.opts.dev_root, name))
+    specs = {}
+    alloc = str(c.tmp / "alloc")
+    if os.path.isdir(alloc):
+        for fname in sorted(os.listdir(alloc)):
+            with open(os.path.join(alloc, fname)) as f:
+                specs[fname] = _strip_trace(json.load(f))
+    records = {
+        key: json.loads(info.to_json())
+        for key, info in c.manager.storage.items()
+    }
+    return {
+        "links": links,
+        "specs": specs,
+        "records": records,
+        "open_intents": len(c.manager.storage.open_intents()),
+    }
+
+
+# -- the acceptance test: kill at EVERY failpoint, converge -------------------
+
+
+def _run_single_bind(tmp_path, name, failpoint):
+    c = _make_cluster(tmp_path, name)
+    try:
+        _annotate(c, POD, "1")
+        if failpoint is None:
+            _bind_inprocess(c, POD, ResourceTPUCore, CORE_IDS)
+        else:
+            _crash_and_restart(c, failpoint, ResourceTPUCore, CORE_IDS)
+        return _end_state(c)
+    finally:
+        c.stop()
+
+
+@pytest.mark.slow
+def test_kill_at_every_failpoint_converges(tmp_path):
+    # slow tier by runtime only (6 full cluster generations) — `make
+    # crash-replay-smoke`, wired into `make verify`, always runs it.
+    # Short scenario dir names: the kubelet sockets under them must stay
+    # inside the 107-char AF_UNIX path limit.
+    baseline = _run_single_bind(tmp_path, "b", None)
+    assert baseline["records"], "baseline bind did not commit"
+    assert baseline["links"], "baseline bind made no links"
+    assert baseline["open_intents"] == 0, "baseline left an intent behind"
+    for i, failpoint in enumerate(FAILPOINTS):
+        state = _run_single_bind(tmp_path, f"f{i}", failpoint)
+        assert state == baseline, (
+            f"restart after crash at {failpoint} did not converge to the "
+            "crash-free end state"
+        )
+
+
+def _run_sibling_bind(tmp_path, name, failpoint):
+    """Memory bind committed, then the core bind crashes mid-flight: the
+    recovery must un-merge the survivor's spec on rollback and re-merge
+    it on replay."""
+    c = _make_cluster(tmp_path, name)
+    try:
+        _annotate(c, POD, "1")
+        _bind_inprocess(c, POD, ResourceTPUMemory, MEM_IDS)
+        if failpoint is None:
+            _bind_inprocess(c, POD, ResourceTPUCore, CORE_IDS)
+        else:
+            _crash_and_restart(c, failpoint, ResourceTPUCore, CORE_IDS)
+        return _end_state(c)
+    finally:
+        c.stop()
+
+
+@pytest.mark.slow
+def test_kill_at_every_failpoint_with_committed_sibling(tmp_path):
+    baseline = _run_sibling_bind(tmp_path, "sb", None)
+    core_hash = Device(CORE_IDS, ResourceTPUCore).hash
+    mem_hash = Device(MEM_IDS, ResourceTPUMemory).hash
+    merged = baseline["specs"][f"{mem_hash}.json"]
+    assert set(merged["resources"]) == {ResourceTPUCore, ResourceTPUMemory}
+    assert f"{core_hash}.json" in baseline["specs"]
+    for i, failpoint in enumerate(FAILPOINTS):
+        state = _run_sibling_bind(tmp_path, f"s{i}", failpoint)
+        assert state == baseline, (
+            f"sibling-merge state after crash at {failpoint} diverged"
+        )
+
+
+# -- periodic reconciler behaviors --------------------------------------------
+
+
+@pytest.fixture()
+def cluster(tmp_path):
+    c = Cluster(tmp_path)
+    c.start()
+    yield c
+    c.stop()
+
+
+def _full_bind(cluster, pod_name, chips, ids):
+    _annotate(cluster, pod_name, chips)
+    cluster.kubelet.kubelet_allocate_flow(
+        CORE_ENDPOINT, "default", pod_name, "jax", ResourceTPUCore, ids
+    )
+
+
+def test_reconciler_repairs_missing_link_between_ticks(cluster):
+    """Post-startup drift (somebody rm'ed the virtual node) is repaired
+    by a periodic pass, not only at boot."""
+    ids = [core_device_id(2, i) for i in range(100)]
+    _full_bind(cluster, "relink", "2", ids)
+    dev_hash = Device(ids, ResourceTPUCore).hash
+    link = os.path.join(cluster.opts.dev_root, f"elastic-tpu-{dev_hash}-0")
+    os.unlink(link)
+    report = cluster.manager.reconciler.reconcile_once()
+    assert report["restored_links"] == 1
+    assert os.readlink(link) == "/dev/accel2"
+
+
+def test_reconciler_rebuilds_missing_spec(cluster):
+    ids = [core_device_id(3, i) for i in range(100)]
+    _full_bind(cluster, "respec", "3", ids)
+    dev_hash = Device(ids, ResourceTPUCore).hash
+    spec = os.path.join(str(cluster.tmp / "alloc"), f"{dev_hash}.json")
+    os.unlink(spec)
+    report = cluster.manager.reconciler.reconcile_once()
+    assert report["restored_specs"] == 1
+    with open(spec) as f:
+        assert json.load(f)["chip_indexes"] == [3]
+
+
+def test_orphan_sweep_failure_is_counted_and_retried(cluster):
+    """The old warn-and-drop-forever path: a failed orphan delete now
+    bumps the failure counter and succeeds on the next pass."""
+    operator = cluster.manager.operator
+    operator.create(0, "0badc0de-0")
+    real_delete = operator.delete
+
+    def failing_delete(link_id):
+        if link_id.startswith("0badc0de"):
+            raise OperatorError("injected: EBUSY")
+        real_delete(link_id)
+
+    operator.delete = failing_delete
+    try:
+        r1 = cluster.manager.reconciler.reconcile_once()
+    finally:
+        operator.delete = real_delete
+    assert r1["sweep_failures"] == 1
+    assert r1["orphan_links"] == 0
+    assert operator.check("0badc0de-0"), "failed delete should leave link"
+    r2 = cluster.manager.reconciler.reconcile_once()
+    assert r2["orphan_links"] == 1
+    assert not operator.check("0badc0de-0")
+    status = cluster.manager.reconciler.status()
+    assert status["sweep_failures_total"] >= 1
+    assert status["repairs_total"].get("orphan_link") == 1
+
+
+def test_dry_run_observes_without_repairing(cluster):
+    reconciler = cluster.manager.reconciler
+    cluster.manager.operator.create(1, "feedc0de-0")
+    reconciler.dry_run = True
+    try:
+        report = reconciler.reconcile_once()
+        assert report["dry_run"] is True
+        assert report["orphan_links"] == 0
+        assert report["divergences_observed"] >= 1
+        assert cluster.manager.operator.check("feedc0de-0")
+    finally:
+        reconciler.dry_run = False
+    report = reconciler.reconcile_once()
+    assert report["orphan_links"] == 1
+    assert not cluster.manager.operator.check("feedc0de-0")
+
+
+def test_unbound_assignment_replayed_after_confirmation(cluster):
+    """kubelet assigned devices but the PreStart never happened (crash
+    before any durable artifact): the periodic loop confirms across two
+    passes, then replays the whole bind."""
+    _annotate(cluster, "ghost", "0")
+    ids = [core_device_id(0, i) for i in range(50)]
+    cluster.kubelet.assign("default", "ghost", "jax", ResourceTPUCore, ids)
+    r1 = cluster.manager.reconciler.reconcile_once()
+    assert r1["replayed_binds"] == 0, "first sighting must only confirm"
+    assert cluster.manager.storage.load("default", "ghost") is None
+    r2 = cluster.manager.reconciler.reconcile_once()
+    assert r2["replayed_binds"] == 1
+    info = cluster.manager.storage.load("default", "ghost")
+    rec = info.allocations["jax"][ResourceTPUCore]
+    assert rec.chip_indexes == [0]
+    assert all(
+        cluster.manager.operator.check(link_id)
+        for link_id in rec.created_node_ids
+    )
+    assert cluster.manager.storage.open_intents() == []
+
+
+def test_kubelet_device_id_drift_rebinds(cluster):
+    """kubelet restart reassigned the container different fake ids: the
+    store record, links and spec must follow kubelet's view (its ids are
+    what the container's device cgoup rules were built from)."""
+    old_ids = [core_device_id(1, i) for i in range(50)]
+    _full_bind(cluster, "drifty", "1", old_ids)
+    old_hash = Device(old_ids, ResourceTPUCore).hash
+    new_ids = [core_device_id(1, i) for i in range(50, 100)]
+    new_hash = Device(new_ids, ResourceTPUCore).hash
+    # simulate the kubelet-restart reassignment
+    cluster.kubelet.assign("default", "drifty", "jax", ResourceTPUCore, new_ids)
+    r1 = cluster.manager.reconciler.reconcile_once()
+    assert r1["rebound_drift"] == 0, "first sighting must only confirm"
+    r2 = cluster.manager.reconciler.reconcile_once()
+    assert r2["rebound_drift"] == 1
+    info = cluster.manager.storage.load("default", "drifty")
+    rec = info.allocations["jax"][ResourceTPUCore]
+    assert rec.device.hash == new_hash
+    alloc = str(cluster.tmp / "alloc")
+    assert os.path.exists(os.path.join(alloc, f"{new_hash}.json"))
+    assert not os.path.exists(os.path.join(alloc, f"{old_hash}.json"))
+    links = cluster.manager.operator.list_links()
+    assert links and all(link.startswith(new_hash) for link in links)
+
+
+def test_open_intents_surface_in_status_and_debug_table(cluster):
+    storage = cluster.manager.storage
+    intent_id = storage.journal_intent(
+        "default/stuck", "jax", ResourceTPUCore, "deadbeef",
+        {"device_ids": [], "chip_indexes": [], "planned_link_ids": []},
+    )
+    try:
+        status = cluster.manager.reconciler.status()
+        (row,) = [
+            i for i in status["open_intents"] if i["hash"] == "deadbeef"
+        ]
+        assert row["pod"] == "default/stuck"
+        assert row["age_s"] >= 0
+        snap = cluster.manager.sampler.allocations_snapshot()
+        assert any(
+            i["hash"] == "deadbeef"
+            for i in snap["reconcile"]["open_intents"]
+        )
+    finally:
+        storage.journal_remove(intent_id)
+
+
+def test_periodic_repair_emits_batched_node_event(cluster):
+    """A periodic pass that repaired something announces it once per
+    pass on the Node — `kubectl describe node` must show that bindings
+    changed underneath the pods (boot passes use the Restored event)."""
+    cluster.manager.operator.create(0, "0badbeef-0")
+    report = cluster.manager.reconciler.reconcile_once()
+    assert report["orphan_links"] == 1
+    assert wait_until(lambda: any(
+        e.get("reason") == "TPUReconciled"
+        and "1 orphan_link" in e.get("message", "")
+        for e in cluster.apiserver.core_events
+    )), f"no TPUReconciled event: {cluster.apiserver.core_events}"
+
+
+def test_pending_create_temp_needs_two_pass_confirmation(cluster):
+    """A mid-rename atomic-create temp is never named by any journal
+    intent (temp names embed pid+thread), so the sweep must confirm it
+    across two periodic passes before deleting — crash debris is still
+    there next pass, a live create's pending temp is not."""
+    dev_root = cluster.opts.dev_root
+    tmp_link = os.path.join(dev_root, "elastic-tpu-feed0-0.99999.11.tmp")
+    os.symlink("/dev/accel0", tmp_link)
+    r1 = cluster.manager.reconciler.reconcile_once()
+    assert os.path.lexists(tmp_link), "temp swept without confirmation"
+    assert r1["orphan_links"] == 0
+    r2 = cluster.manager.reconciler.reconcile_once()
+    assert r2["orphan_links"] == 1
+    assert not os.path.lexists(tmp_link)
+
+
+def test_crash_leaked_spec_temp_is_swept(cluster):
+    """A <hash>.json.tmp leaked by a crash inside _write_json_atomic is
+    reclaimed like any other unrecorded artifact; a temp whose hash has
+    an open intent (a spec write in flight) is left alone."""
+    alloc = str(cluster.tmp / "alloc")
+    os.makedirs(alloc, exist_ok=True)
+    with open(os.path.join(alloc, "0dead0.json.tmp"), "w") as f:
+        f.write("{}")
+    storage = cluster.manager.storage
+    live_intent = storage.journal_intent(
+        "default/mid-write", "jax", ResourceTPUCore, "0live0",
+        {"planned_link_ids": []},
+    )
+    with open(os.path.join(alloc, "0live0.json.tmp"), "w") as f:
+        f.write("{}")
+    try:
+        report = cluster.manager.reconciler.reconcile_once()
+        assert report["orphan_specs"] == 1
+        assert not os.path.exists(os.path.join(alloc, "0dead0.json.tmp"))
+        assert os.path.exists(os.path.join(alloc, "0live0.json.tmp"))
+    finally:
+        storage.journal_remove(live_intent)
+        os.unlink(os.path.join(alloc, "0live0.json.tmp"))
+
+
+def test_reconcile_once_raises_on_broken_storage(cluster):
+    """A journal/store read failure must surface as an exception (run()
+    escalates persistent ones to the supervisor) — not masquerade as a
+    healthy quiet pass while the node has lost self-repair."""
+    from elastic_tpu_agent.storage.store import StorageError
+
+    real = cluster.manager.storage.open_intents
+    cluster.manager.storage.open_intents = lambda: (_ for _ in ()).throw(
+        StorageError("injected: journal table wedged")
+    )
+    try:
+        with pytest.raises(StorageError):
+            cluster.manager.reconciler.reconcile_once()
+    finally:
+        cluster.manager.storage.open_intents = real
+
+
+def test_unbindable_assignment_backs_off(cluster):
+    """An assignment whose replay fails by design (pod not assumed by
+    the elastic scheduler) is retried with exponential pass backoff,
+    not warn-logged every pass forever."""
+    cluster.apiserver.upsert_pod(
+        make_pod("default", "rogue", cluster.node, annotations={},
+                 containers=[{"name": "jax"}])
+    )
+    assert wait_until(
+        lambda: cluster.manager.sitter.get_pod("default", "rogue")
+        is not None
+    )
+    ids = [core_device_id(3, i) for i in range(10)]
+    cluster.kubelet.assign("default", "rogue", "jax", ResourceTPUCore, ids)
+    reconciler = cluster.manager.reconciler
+    reconciler.reconcile_once()                      # pass 1: confirm
+    r2 = reconciler.reconcile_once()                 # pass 2: try, fail
+    assert r2["replay_failures"] == 1
+    r3 = reconciler.reconcile_once()                 # pass 3: backing off
+    assert r3["replay_failures"] == 0
+    # the failure is visible in status regardless of the backoff
+    assert reconciler.status()["replay_failures_total"] >= 1
+
+
+def test_inflight_intent_is_never_rolled_back(cluster):
+    """An intent whose bind thread is alive in this process must survive
+    any number of reconcile passes untouched — a slow bind (sqlite busy
+    retries, stalled hostPath, stripe queueing) is not debris. Only once
+    the thread exits (the bind's finally drops the marker) does the row
+    become recoverable."""
+    storage = cluster.manager.storage
+    cluster.manager.operator.create(2, "feedbeef-0")
+    intent_id = storage.journal_intent(
+        "default/slowpoke", "jax", ResourceTPUCore, "feedbeef",
+        {"device_ids": [], "chip_indexes": [2],
+         "planned_link_ids": ["feedbeef-0"]},
+    )
+    reconciler = cluster.manager.reconciler
+    for _ in range(3):  # even boot passes must not touch it
+        reconciler.reconcile_once(boot=True)
+    assert storage.intent_open(intent_id)
+    assert cluster.manager.operator.check("feedbeef-0")
+    # the bind thread "dies" -> next pass rolls the intent back
+    storage.intent_done(intent_id)
+    report = reconciler.reconcile_once(boot=True)
+    assert report["intents_rolled_back"] == 1
+    assert not storage.intent_open(intent_id)
+    assert not cluster.manager.operator.check("feedbeef-0")
+
+
+# -- corrupt-record pins (satellite) ------------------------------------------
+
+
+def test_corrupt_record_guards_sweep_but_not_restores(tmp_path):
+    """Pins: corrupt_records accounting, the skip-orphan-sweep guard when
+    corrupt checkpoints exist, and a corrupt row never blocking healthy
+    records from restoring."""
+    c = _make_cluster(tmp_path, "cr")
+    _annotate(c, "healthy", "2")
+    ids = [core_device_id(2, i) for i in range(100)]
+    c.kubelet.kubelet_allocate_flow(
+        CORE_ENDPOINT, "default", "healthy", "jax", ResourceTPUCore, ids
+    )
+    dev_hash = Device(ids, ResourceTPUCore).hash
+    link = os.path.join(c.opts.dev_root, f"elastic-tpu-{dev_hash}-0")
+    # an orphan whose sweep must be SUPPRESSED while corruption exists
+    c.manager.operator.create(0, "0badc0de-0")
+    c.manager.stop()
+    # corrupt a row + wipe the healthy pod's link while the agent is down
+    db = sqlite3.connect(str(c.tmp / "meta.db"))
+    db.execute(
+        "INSERT INTO pods(key, value) VALUES('default/garbage', '{not json')"
+    )
+    db.commit()
+    db.close()
+    os.unlink(link)
+
+    mgr2 = TPUManager(c.opts)
+    try:
+        mgr2.run(block=False)
+        report = mgr2.restore()  # second, clean pass for stable counters
+        assert report["corrupt_records"] == 1
+        # healthy record restored despite the corrupt row...
+        assert os.readlink(link) == "/dev/accel2"
+        # ...but the orphan sweep stayed non-destructive
+        assert mgr2.operator.check("0badc0de-0")
+        assert report["orphan_links"] == 0
+
+        # the corrupt row gone -> the next pass sweeps the orphan
+        mgr2.storage.delete("default", "garbage")
+        report = mgr2.reconciler.reconcile_once()
+        assert report["corrupt_records"] == 0
+        assert report["orphan_links"] == 1
+        assert not mgr2.operator.check("0badc0de-0")
+    finally:
+        mgr2.stop()
+        c.kubelet.stop()
+        c.apiserver.stop()
+
+
+def test_corrupt_record_leaves_its_intent_open(tmp_path):
+    """An open intent whose checkpoint row is corrupt must NOT be rolled
+    back — we cannot prove the bind un-happened."""
+    c = _make_cluster(tmp_path, "ci")
+    try:
+        storage = c.manager.storage
+        intent_id = storage.journal_intent(
+            "default/broken", "jax", ResourceTPUCore, "cafebabe",
+            {"device_ids": [], "chip_indexes": [],
+             "planned_link_ids": ["cafebabe-0"]},
+        )
+        storage.intent_done(intent_id)  # its bind thread is "dead"
+        c.manager.operator.create(0, "cafebabe-0")
+        db = sqlite3.connect(str(c.tmp / "meta.db"))
+        db.execute(
+            "INSERT INTO pods(key, value) VALUES('default/broken', 'junk')"
+        )
+        db.commit()
+        db.close()
+        report = c.manager.reconciler.reconcile_once(boot=True)
+        assert report["intents_rolled_back"] == 0
+        assert len(storage.open_intents()) == 1
+        assert c.manager.operator.check("cafebabe-0")
+    finally:
+        c.stop()
+
+
+# -- doctor bundle ------------------------------------------------------------
+
+
+def test_doctor_bundle_carries_journal_state(tmp_path):
+    """A bundle built against a dead agent's db still shows open intents
+    — the crashed-mid-bind case is exactly when support needs them."""
+    from elastic_tpu_agent.sampler import (
+        build_diagnostics_bundle,
+        validate_bundle,
+    )
+    from elastic_tpu_agent.storage import Storage
+    from elastic_tpu_agent.tpu import StubOperator
+
+    dev = tmp_path / "dev"
+    dev.mkdir()
+    storage = Storage(str(tmp_path / "meta.db"))
+    storage.journal_intent(
+        "default/stuck", "jax", ResourceTPUCore, "deadbeef",
+        {"device_ids": ["tpu-core-0-0"], "chip_indexes": [0],
+         "planned_link_ids": ["deadbeef-0"]},
+    )
+    bundle = build_diagnostics_bundle(
+        StubOperator(str(dev), "v5litepod-4"), storage=storage
+    )
+    storage.close()
+    assert validate_bundle(bundle) == []
+    (row,) = bundle["reconcile"]["open_intents"]
+    assert row["pod"] == "default/stuck" and row["hash"] == "deadbeef"

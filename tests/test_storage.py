@@ -305,3 +305,62 @@ def test_save_fails_after_persistent_lock(store):
             store.save(make_pod(name="never"))
     finally:
         store._db = real
+
+
+# -- bind intent journal (write-ahead log for the bind transaction) -----------
+
+
+def test_journal_intent_roundtrip(store):
+    payload = {
+        "device_ids": ["tpu-core-1-0", "tpu-core-1-1"],
+        "chip_indexes": [1],
+        "planned_link_ids": ["abcd1234-0"],
+    }
+    intent_id = store.journal_intent(
+        "default/pod-a", "main", "elasticgpu.io/tpu-core", "abcd1234", payload
+    )
+    assert store.intent_open(intent_id)
+    (row,) = store.open_intents()
+    assert row["id"] == intent_id
+    assert row["pod_key"] == "default/pod-a"
+    assert row["container"] == "main"
+    assert row["resource"] == "elasticgpu.io/tpu-core"
+    assert row["hash"] == "abcd1234"
+    assert row["payload"] == payload
+    assert row["age_s"] >= 0
+    store.journal_commit(intent_id)
+    assert not store.intent_open(intent_id)
+    assert store.open_intents() == []
+
+
+def test_journal_commit_and_remove_are_idempotent(store):
+    intent_id = store.journal_intent("ns/p", "c", "res", "h", {})
+    store.journal_commit(intent_id)
+    store.journal_commit(intent_id)  # double-commit: harmless
+    store.journal_remove(intent_id)  # remove after commit: harmless
+    assert store.open_intents() == []
+
+
+def test_journal_survives_reopen(tmp_path):
+    """An uncommitted intent is exactly what must outlive a crash."""
+    path = str(tmp_path / "j.db")
+    s1 = Storage(path)
+    s1.journal_intent(
+        "default/crashy", "jax", "elasticgpu.io/tpu-core", "deadbeef",
+        {"planned_link_ids": ["deadbeef-0"]},
+    )
+    s1.close()
+    with Storage(path) as s2:
+        (row,) = s2.open_intents()
+        assert row["hash"] == "deadbeef"
+        assert row["payload"]["planned_link_ids"] == ["deadbeef-0"]
+
+
+def test_journal_is_ordered_and_independent_of_pods_table(store):
+    a = store.journal_intent("ns/a", "c", "res", "h1", {})
+    b = store.journal_intent("ns/b", "c", "res", "h2", {})
+    store.save(make_pod())  # unrelated pods-table traffic
+    assert [r["id"] for r in store.open_intents()] == [a, b]
+    store.journal_remove(a)
+    assert [r["hash"] for r in store.open_intents()] == ["h2"]
+    store.journal_remove(b)
