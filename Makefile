@@ -5,7 +5,7 @@ TAG ?= elastic-tpu-agent:latest
 # verify's tier-1 line uses pipefail, which /bin/sh (dash) lacks
 SHELL := /bin/bash
 
-.PHONY: all native sanitize test test-all verify doctor-smoke chaos-smoke bench-smoke crash-replay-smoke protos image bench clean
+.PHONY: all native sanitize test test-all verify doctor-smoke chaos-smoke bench-smoke crash-replay-smoke fleet-smoke protos image bench clean
 
 all: native test
 
@@ -73,8 +73,19 @@ crash-replay-smoke:
 	JAX_PLATFORMS=cpu python -m pytest tests/test_reconciler.py -q \
 	  -p no:cacheprovider && echo "crash replay smoke: OK"
 
+# fleet smoke: the cluster-in-a-box simulator (bench.py --fleet-smoke):
+# 4 in-process agents x 100 pods against one shared fake apiserver,
+# churned fleet-wide and read back through the scraping aggregator.
+# Structural assertions only — every bind lands, every node
+# reconcile-converges after the churn, kubelet/apiserver request
+# amplification stays within bound, admission->bind trace continuity
+# holds — so a broken fleet observability layer (or a bind path that
+# stopped scaling past one node) fails the build, not a dashboard.
+fleet-smoke:
+	JAX_PLATFORMS=cpu python3 bench.py --fleet-smoke
+
 T1_TIMEOUT ?= 870
-verify: doctor-smoke chaos-smoke bench-smoke crash-replay-smoke
+verify: doctor-smoke chaos-smoke bench-smoke crash-replay-smoke fleet-smoke
 	python -c "from prometheus_client import CollectorRegistry; \
 	  from elastic_tpu_agent.metrics import AgentMetrics; \
 	  AgentMetrics(registry=CollectorRegistry()); \

@@ -509,6 +509,181 @@ def churn_smoke_main():
     return 0
 
 
+# -- fleet: cluster-in-a-box (ROADMAP item 1) ---------------------------------
+#
+# N complete in-process agents, each against its own fake kubelet, all
+# sharing one fake apiserver (elastic_tpu_agent/sim). The fleet leg churns
+# concurrent binds across every node at once and reports what the FLEET
+# OBSERVATORY measures — fleet bind p50/p99 from merged scraped
+# histograms, per-node reconcile convergence time, kubelet/apiserver
+# request amplification per bind, and admission->bind trace continuity —
+# with the driver's own stopwatch percentiles as a cross-check.
+
+FLEET_NODES = 8
+FLEET_PODS_PER_NODE = 125          # 8 x 125 = 1000 pods
+FLEET_RECONCILE_PERIOD_S = 2.0
+FLEET_TRACE_SAMPLES = 25
+
+
+def run_fleet(
+    nodes=FLEET_NODES,
+    pods_per_node=FLEET_PODS_PER_NODE,
+    reconcile_period_s=FLEET_RECONCILE_PERIOD_S,
+    workers_per_node=2,
+    trace_samples=FLEET_TRACE_SAMPLES,
+    convergence_timeout_s=60.0,
+):
+    from elastic_tpu_agent.sim import FleetAggregator, FleetSim
+
+    with tempfile.TemporaryDirectory(prefix="etpu-fleet") as tmp:
+        sim = FleetSim(
+            tmp, nodes=nodes, reconcile_period_s=reconcile_period_s,
+        )
+        try:
+            t_start = time.perf_counter()
+            sim.start()
+            startup_s = time.perf_counter() - t_start
+            agg = FleetAggregator(sim.targets())
+            refs = sim.admit_pods(pods_per_node)
+            sim.wait_synced(refs)
+            driver = sim.churn(refs, workers_per_node=workers_per_node)
+            # Convergence: how long after the churn stops until every
+            # node's reconciler reports a fully-converged pass.
+            convergence = agg.convergence_summary(agg.wait_converged(
+                driver["churn_end_ts"], timeout_s=convergence_timeout_s,
+            ))
+            rollup = agg.rollup()
+            # Continuity sample STRIDED across the whole ref list: refs
+            # are node-major, so a tail slice would sample only the last
+            # node and a per-node adoption regression could slip the
+            # gate. (The sim sizes the trace ring to hold every bind, so
+            # any ref is still resolvable.)
+            stride = max(1, len(refs) // trace_samples)
+            sample_refs = refs[::stride][:trace_samples]
+            continuity = agg.check_continuity([
+                (sim.nodes[r.node_idx].name, r.trace_id, r.pod_key)
+                for r in sample_refs
+            ])
+            stored = sim.stored_binds()
+        finally:
+            sim.stop()
+        fleet = rollup["fleet"]
+        return {
+            "nodes": nodes,
+            "pods": nodes * pods_per_node,
+            "pods_per_node": pods_per_node,
+            "startup_s": round(startup_s, 3),
+            "fleet_bind_p50_ms": fleet["fleet_bind_p50_ms"],
+            "fleet_bind_p99_ms": fleet["fleet_bind_p99_ms"],
+            "reconcile_convergence_s": convergence,
+            "request_amplification": fleet["request_amplification"],
+            "trace_continuity": continuity,
+            "series_evicted_total": fleet["series_evicted_total"],
+            "driver": driver,
+            "stored_binds": stored,
+            "per_node": rollup["per_node"],
+        }
+
+
+def fleet_main():
+    """`bench.py --fleet`: the fleet leg alone, full scale, one JSON
+    line (same shape the main bench embeds under extra.fleet)."""
+    try:
+        result = run_fleet()
+    except Exception as e:  # noqa: BLE001 - explicit skip, never silence
+        result = {
+            "skipped": True,
+            "reason": f"fleet sim failed: {type(e).__name__}: {e}",
+        }
+    print(json.dumps({"fleet": result}))
+    return 0 if not result.get("skipped") else 1
+
+
+# `make fleet-smoke` thresholds: STRUCTURAL, not timing — the CI box's
+# speed must never flake the gate. Lists: shared-snapshot binds coalesce
+# onto far fewer than one List per bind; the reconcilers add one List
+# per pass per node. Sinks: ~1 event + ~1 CRD write per bind plus boot
+# inventory. The bounds below leave generous headroom over both.
+FLEET_SMOKE_NODES = 4
+FLEET_SMOKE_PODS_PER_NODE = 100
+FLEET_SMOKE_LISTS_PER_BIND_MAX = 3.0
+FLEET_SMOKE_SINK_WRITES_PER_BIND_MAX = 4.0
+
+
+def fleet_smoke_main():
+    """`make fleet-smoke`: a small deterministic fleet (4 nodes x 100
+    pods) with structural assertions — every bind lands, every node
+    reconcile-converges after the churn, request amplification stays
+    within bound, and admission->bind trace continuity holds. Exits
+    nonzero with reasons on violation."""
+    problems = []
+    try:
+        r = run_fleet(
+            nodes=FLEET_SMOKE_NODES,
+            pods_per_node=FLEET_SMOKE_PODS_PER_NODE,
+            reconcile_period_s=1.0,
+            trace_samples=20,
+        )
+    except Exception as e:  # noqa: BLE001
+        print(json.dumps({"fleet_smoke": {
+            "error": f"{type(e).__name__}: {e}"
+        }}))
+        print(f"fleet smoke FAILED: {type(e).__name__}: {e}",
+              file=sys.stderr)
+        return 1
+    total = FLEET_SMOKE_NODES * FLEET_SMOKE_PODS_PER_NODE
+    if r["driver"]["timed_out_workers"]:
+        problems.append(
+            f"{r['driver']['timed_out_workers']} bind worker(s) still "
+            "running at the churn deadline — a bind is wedged"
+        )
+    if r["driver"]["error_count"]:
+        problems.append(
+            f"{r['driver']['error_count']} bind errors "
+            f"(first: {r['driver']['errors']})"
+        )
+    stored_total = sum(r["stored_binds"].values())
+    if stored_total != total:
+        problems.append(
+            f"{stored_total} checkpoint records across the fleet, "
+            f"want {total} — a bind did not land"
+        )
+    convergence = r["reconcile_convergence_s"]
+    if convergence["unconverged_nodes"]:
+        problems.append(
+            "nodes never reconcile-converged after the churn: "
+            f"{convergence['unconverged_nodes']}"
+        )
+    amp = r["request_amplification"]
+    lists_per_bind = amp["kubelet_lists_per_bind"]
+    if lists_per_bind is None or lists_per_bind > FLEET_SMOKE_LISTS_PER_BIND_MAX:
+        problems.append(
+            f"kubelet List amplification {lists_per_bind} per bind "
+            f"exceeds the {FLEET_SMOKE_LISTS_PER_BIND_MAX} bound"
+        )
+    sink_per_bind = amp["sink_writes_per_bind"]
+    sink_total = (sink_per_bind["events"] or 0) + (sink_per_bind["crd"] or 0)
+    if sink_total > FLEET_SMOKE_SINK_WRITES_PER_BIND_MAX:
+        problems.append(
+            f"sink write amplification {sink_total} per bind exceeds "
+            f"the {FLEET_SMOKE_SINK_WRITES_PER_BIND_MAX} bound"
+        )
+    if r["trace_continuity"]["fraction"] != 1.0:
+        problems.append(
+            "admission->bind trace continuity broken: "
+            f"{r['trace_continuity']}"
+        )
+    if not r["fleet_bind_p99_ms"]:
+        problems.append("fleet bind p99 missing from scraped histograms")
+    print(json.dumps({"fleet_smoke": r, "problems": problems}))
+    if problems:
+        for p in problems:
+            print(f"fleet smoke FAILED: {p}", file=sys.stderr)
+        return 1
+    print("fleet smoke: OK", file=sys.stderr)
+    return 0
+
+
 # Peak bf16 TFLOP/s per chip (public spec sheet numbers).
 PEAK_TFLOPS = {"v2": 23, "v3": 61, "v4": 137.5, "v5e": 197, "v5p": 229.5,
                "v6e": 459}
@@ -987,7 +1162,13 @@ def _run_tpu_child():
 
 
 def run_tpu_throughput():
-    """Measure in an isolated subprocess with retry + backoff."""
+    """Measure in an isolated subprocess with retry + backoff.
+
+    NEVER returns an absent/None leg: a leg that cannot run comes back
+    as an explicit ``{"skipped": true, "reason": ...}`` block, so a
+    round whose chip was unreachable reads as 'skipped, here is why' in
+    the BENCH json instead of silently losing the key (the round-3/4
+    failure mode the trajectory called out)."""
     last_err = None
     timeouts = 0
     for delay in _TPU_RETRY_DELAYS_S:
@@ -1002,11 +1183,18 @@ def run_tpu_throughput():
                     break
             continue
         if result.get("skipped"):
-            return None  # genuinely no accelerator; not an error
+            # genuinely no accelerator; not an error
+            return {
+                "skipped": True,
+                "reason": f"no accelerator attached ({result['skipped']})",
+            }
         if "error" not in result:
             return result
         last_err = result["error"]
     return {
+        "skipped": True,
+        "reason": "TPU backend absent or failed after "
+                  f"{len(_TPU_RETRY_DELAYS_S)} attempts: {last_err}",
         "error": last_err,
         "attempts": len(_TPU_RETRY_DELAYS_S),
         "hardware": "absent_or_failed_after_retries",
@@ -1188,17 +1376,30 @@ def main():
     try:
         churn = run_churn_phase()
     except Exception as e:  # noqa: BLE001 - churn must not erase the rest
-        churn = {"error": f"{type(e).__name__}: {e}"}
+        churn = {
+            "skipped": True,
+            "reason": f"churn phase failed: {type(e).__name__}: {e}",
+        }
+    try:
+        fleet = run_fleet()
+    except Exception as e:  # noqa: BLE001 - fleet must not erase the rest
+        fleet = {
+            "skipped": True,
+            "reason": f"fleet sim failed: {type(e).__name__}: {e}",
+        }
     tpu = run_tpu_throughput()
     # QoS co-location only makes sense when the chip is reachable at
     # all (its children would just burn the same init timeout)
-    if tpu is not None and "error" not in tpu:
+    if not tpu.get("skipped") and "error" not in tpu:
         try:
             qos = run_qos_colocation()
         except Exception as e:  # noqa: BLE001 - bonus measurement
-            qos = {"error": f"{type(e).__name__}: {e}"}
+            qos = {
+                "skipped": True,
+                "reason": f"qos leg failed: {type(e).__name__}: {e}",
+            }
     else:
-        qos = {"skipped": "chip unreachable this round"}
+        qos = {"skipped": True, "reason": "chip unreachable this round"}
     vs_baseline = ref["bind_p50_ms"] / ours["bind_p50_ms"]
     load_ratio = probe_s / _HOST_PROBE_REF_S
     # Headline = the RATIO: both sides of it ran in this process under
@@ -1231,6 +1432,11 @@ def main():
             # shared pod-resources snapshot vs the same-run global-lock /
             # dual-locator baseline.
             "churn": churn,
+            # Cluster-in-a-box: 8 in-process agents x 125 pods churned
+            # fleet-wide, read back through the scraping aggregator
+            # (fleet bind p50/p99, reconcile convergence, request
+            # amplification, trace continuity).
+            "fleet": fleet,
             "pods": N_PODS,
             "tpu": tpu,
             "qos_colocation": qos,
@@ -1246,5 +1452,9 @@ if __name__ == "__main__":
         qos_child_main()
     elif "--churn-smoke" in sys.argv:
         sys.exit(churn_smoke_main())
+    elif "--fleet-smoke" in sys.argv:
+        sys.exit(fleet_smoke_main())
+    elif "--fleet" in sys.argv:
+        sys.exit(fleet_main())
     else:
         main()

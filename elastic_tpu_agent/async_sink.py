@@ -69,6 +69,12 @@ class AsyncSink:
         self._max_failures = max_failures
         self._max_queue = max_queue
         self._on_drop = on_drop
+        # Invoked once per successfully drained op (request-amplification
+        # accounting; metrics.AgentMetrics.register_sink points it at the
+        # per-sink elastic_tpu_sink_writes_total counter). Note ops are
+        # thunks: a batched op (boot inventory publish) counts once.
+        self.on_write: Optional[Callable[[], None]] = None
+        self._writes = 0
         # Insertion-ordered op store: coalescing keys map to their newest
         # op in O(1); un-keyed ops get a unique sequence number. Dict
         # order gives O(1) drop-oldest and preserves submit order.
@@ -100,6 +106,12 @@ class AsyncSink:
     def dropped(self) -> int:
         """Ops discarded by the queue bound since start."""
         return self._dropped
+
+    @property
+    def writes_total(self) -> int:
+        """Ops successfully drained since start (racy read — a gauge/
+        introspection feed, not an invariant)."""
+        return self._writes
 
     @property
     def queue_depth(self) -> int:
@@ -226,6 +238,13 @@ class AsyncSink:
                     if not self._disabled:
                         op()
                         self._failures = 0
+                        self._writes += 1
+                        cb = self.on_write
+                        if cb is not None:
+                            try:
+                                cb()
+                            except Exception:  # noqa: BLE001
+                                pass
                 except Exception as e:  # noqa: BLE001 - must not wedge
                     self._failures += 1
                     if self._failures >= self._max_failures:

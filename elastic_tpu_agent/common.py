@@ -36,6 +36,13 @@ BytesPerMemoryUnit = 1024 * 1024
 AnnotationAssumed = "elasticgpu.io/assumed"
 AnnotationContainerPrefix = "elasticgpu.io/container-"
 
+# Cross-component trace continuity: whoever admits/schedules the pod may
+# stamp a correlation id here; the agent that binds it adopts the id for
+# its bind trace (tracing.Tracer.adopt_id), so one trace id follows the
+# pod from apiserver admission to the node that bound it. Optional — an
+# unstamped pod just gets a node-local id as before.
+AnnotationTraceID = "elasticgpu.io/trace-id"
+
 # Multi-host slice annotations (TPU-native addition; SURVEY.md §2 note on
 # slice enablement / BASELINE config 5).
 AnnotationSliceName = "elasticgpu.io/tpu-slice"

@@ -80,8 +80,13 @@ class PodResourcesSnapshotSource:
     # serializing misses one stalled List at a time.
     STALL_WAIT_TIMEOUT_S = 6.0
 
-    def __init__(self, client: PodResourcesClient) -> None:
+    def __init__(self, client: PodResourcesClient, metrics=None) -> None:
         self._client = client
+        # Optional AgentMetrics: every List issued is counted in
+        # elastic_tpu_kubelet_list_total so per-bind kubelet request
+        # amplification is measured at the source (fleet aggregator),
+        # not inferred from locator stats after the fact.
+        self._metrics = metrics
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
         # resource -> device-set hash -> owner
@@ -235,6 +240,12 @@ class PodResourcesSnapshotSource:
             with get_tracer().span("pod_resources_list") as sp:
                 resp = self._client.list()
                 self.lists_total += 1
+                m = self._metrics
+                if m is not None and hasattr(m, "kubelet_lists"):
+                    try:
+                        m.kubelet_lists.inc()
+                    except Exception:  # noqa: BLE001 - never fail a List
+                        pass
                 sp.set(pods=len(resp.pod_resources))
             fresh, assign = self._build_index(resp)
             install = self._capped(fresh)

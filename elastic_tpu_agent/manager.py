@@ -214,7 +214,9 @@ class TPUManager:
         pr_client = rpc.PodResourcesClient(opts.pod_resources_socket)
         self.pr_client = pr_client
         if opts.shared_locator_snapshot:
-            shared_source = PodResourcesSnapshotSource(pr_client)
+            shared_source = PodResourcesSnapshotSource(
+                pr_client, metrics=self.metrics
+            )
             # The reconciler diffs against the same snapshot layer the
             # locators use, so its periodic List rides the single-flight
             # machinery instead of adding independent kubelet load.
@@ -223,9 +225,14 @@ class TPUManager:
                 res, source=shared_source
             )
         else:
-            self.locator_source = PodResourcesSnapshotSource(pr_client)
+            self.locator_source = PodResourcesSnapshotSource(
+                pr_client, metrics=self.metrics
+            )
             locator_factory = lambda res: KubeletDeviceLocator(  # noqa: E731
-                res, pr_client
+                res,
+                source=PodResourcesSnapshotSource(
+                    pr_client, metrics=self.metrics
+                ),
             )
         self.config = PluginConfig(
             node_name=opts.node_name,

@@ -53,6 +53,18 @@ _BUCKETS = (
     0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
 )
 
+# Reconcile passes span ms (quiet tick) to seconds (a full node repair
+# diffing four sources of truth), so they get their own wider buckets.
+_RECONCILE_BUCKETS = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
+    5.0, 10.0,
+)
+
+# Prometheus label values for the async observability sinks: the fleet
+# aggregator sums per-sink apiserver traffic, and "events"/"crd" read
+# better on a dashboard than the internal worker-thread names.
+SINK_LABELS = {"event-recorder": "events", "crd-recorder": "crd"}
+
 DEFAULT_BIND_ADDR = "127.0.0.1"
 
 
@@ -85,31 +97,35 @@ class BoundedLabeledGauge:
         return tuple(labels[name] for name in self._gauge._labelnames)
 
     def set(self, value: float, **labels) -> None:
+        # Tracking AND the underlying gauge mutations happen under the
+        # one lock: with them split (the original shape), a concurrent
+        # writer could re-set a key between this thread's eviction pop
+        # and its child remove(), silently deleting a series the tracker
+        # still counted — the 10k-series fleet-churn test catches exactly
+        # that. The prometheus-client child ops take their own internal
+        # lock and never call back into ours, so nesting is safe.
         key = self._key(labels)
-        evicted = []
         with self._lock:
             self._series[key] = None
             self._series.move_to_end(key)
+            self._gauge.labels(**labels).set(value)
             while len(self._series) > self._max:
                 old, _ = self._series.popitem(last=False)
-                evicted.append(old)
-        self._gauge.labels(**labels).set(value)
-        for old in evicted:
-            try:
-                self._gauge.remove(*old)
-            except KeyError:
-                pass
-            if self._evicted is not None:
-                self._evicted.inc()
+                try:
+                    self._gauge.remove(*old)
+                except KeyError:
+                    pass
+                if self._evicted is not None:
+                    self._evicted.inc()
 
     def remove(self, **labels) -> None:
         key = self._key(labels)
         with self._lock:
             self._series.pop(key, None)
-        try:
-            self._gauge.remove(*key)
-        except KeyError:
-            pass
+            try:
+                self._gauge.remove(*key)
+            except KeyError:
+                pass
 
     @property
     def series_count(self) -> int:
@@ -184,6 +200,22 @@ class AgentMetrics:
             "Reconciler passes completed (boot restore included)",
             **kw,
         )
+        self.reconcile_duration = Histogram(
+            "elastic_tpu_reconcile_duration_seconds",
+            "Wall time of one full reconcile pass (store <-> kubelet <-> "
+            "disk <-> live-pod diff plus repairs)",
+            buckets=_RECONCILE_BUCKETS,
+            **kw,
+        )
+        self.reconcile_last_converged = Gauge(
+            "elastic_tpu_reconcile_last_converged_timestamp",
+            "Unix time of the last reconcile pass that ended with the "
+            "node fully converged: no failed sweeps/replays, no snapshot "
+            "error, no corrupt records, nothing observed diverged or "
+            "pending confirmation. A node whose value stops advancing "
+            "while the fleet's does is the one to triage.",
+            **kw,
+        )
         self.orphan_sweep_failures = Counter(
             "elastic_tpu_orphan_sweep_failures_total",
             "Orphan link/spec deletions that failed; each is retried on "
@@ -211,6 +243,21 @@ class AgentMetrics:
         # paths self-disable after consecutive failures — without these
         # the self-disabling is itself invisible until someone wonders
         # where the Events went.
+        self.sink_writes = Counter(
+            "elastic_tpu_sink_writes_total",
+            "Apiserver write ops drained by an async observability sink "
+            "(request-amplification accounting: the fleet aggregator "
+            "divides this by binds to get sink traffic per bind)",
+            ["sink"],
+            **kw,
+        )
+        self.kubelet_lists = Counter(
+            "elastic_tpu_kubelet_list_total",
+            "Full pod-resources List RPCs issued to kubelet (locator "
+            "refresh/prefetch + reconciler snapshots) — the kubelet side "
+            "of per-bind request amplification",
+            **kw,
+        )
         self.sink_queue_depth = Gauge(
             "elastic_tpu_sink_queue_depth",
             "Ops queued in an async observability sink",
@@ -350,6 +397,13 @@ class AgentMetrics:
         self.sink_disabled.labels(sink=name).set_function(
             lambda: float(sink.disabled)
         )
+        # Real write traffic, counted at the source (async_sink invokes
+        # on_write once per successfully drained op): the fleet
+        # aggregator sums these instead of inferring apiserver load.
+        if hasattr(sink, "on_write"):
+            sink.on_write = self.sink_writes.labels(
+                sink=SINK_LABELS.get(name, name)
+            ).inc
 
     def observe_allocate(self, seconds: float) -> None:
         self.allocate_latency.observe(seconds)
@@ -423,6 +477,7 @@ class AgentMetrics:
                             return
                         q = parse_qs(parsed.query)
                         pod = q.get("pod", [None])[0]
+                        trace_id = q.get("trace", [None])[0]
                         limit = None
                         if q.get("limit"):
                             try:
@@ -434,7 +489,9 @@ class AgentMetrics:
                                 )
                                 return
                         self._reply_json({
-                            "traces": tracer.dump(pod=pod, limit=limit),
+                            "traces": tracer.dump(
+                                pod=pod, limit=limit, trace_id=trace_id
+                            ),
                             "completed_total": tracer.completed,
                             "capacity": tracer.capacity,
                         })

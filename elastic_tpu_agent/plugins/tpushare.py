@@ -45,6 +45,7 @@ from typing import Dict, List, Optional, Tuple
 from .. import faults, rpc
 from ..common import (
     AnnotationAssumed,
+    AnnotationTraceID,
     BytesPerMemoryUnit,
     EnvAllocationHash,
     EnvTPUVisibleChips,
@@ -409,6 +410,7 @@ class _TPUSharePluginBase(_ListAndWatchMixin, rpc.DevicePluginServicer):
         with get_tracer().trace(
             "Allocate", resource=self.resource,
             requests=len(request.container_requests),
+            node=self._config.node_name,
         ) as tr:
             responses = []
             hashes = []
@@ -507,6 +509,7 @@ class _TPUSharePluginBase(_ListAndWatchMixin, rpc.DevicePluginServicer):
         with get_tracer().trace(
             "PreStartContainer", resource=self.resource, hash=device.hash,
             n_ids=len(request.devicesIDs),
+            node=self._config.node_name,
         ) as tr:
             try:
                 self._bind(device)
@@ -566,6 +569,16 @@ class _TPUSharePluginBase(_ListAndWatchMixin, rpc.DevicePluginServicer):
         get_tracer().annotate(
             pod=f"{owner.namespace}/{owner.name}", container=owner.container
         )
+        # Cross-node continuity: if admission stamped a trace id on the
+        # pod, this bind continues under it — the fleet observatory can
+        # then follow one id from apiserver admission to whichever node's
+        # agent bound the pod (both the core and the memory bind of a
+        # container adopt the same id: they are one logical allocation).
+        admission_id = (
+            pod.get("metadata", {}).get("annotations", {}) or {}
+        ).get(AnnotationTraceID, "")
+        if admission_id:
+            get_tracer().adopt_id(admission_id)
         try:
             self._bind_located(device, owner, pod)
         except Exception as e:

@@ -150,6 +150,8 @@ class Reconciler:
         self._replay_failures_total = 0
         self._runs_total = 0
         self._last_run_ts: Optional[float] = None
+        self._last_duration_s: Optional[float] = None
+        self._last_converged_ts: Optional[float] = None
         self._last_report: dict = {}
         # Two-pass confirmation state: candidates seen on the previous
         # completed pass; acted on when seen again.
@@ -234,6 +236,7 @@ class Reconciler:
         passes and honor ``dry_run``.
         """
         faults.fire("reconciler.tick")
+        t_pass = time.monotonic()
         active = boot or not self.dry_run
         report = _new_report(boot, self.dry_run and not boot)
         self._tick_candidates = set()
@@ -319,17 +322,41 @@ class Reconciler:
                 )
             except Exception:  # noqa: BLE001 - observability only
                 logger.exception("reconcile event emit failed")
+        duration_s = time.monotonic() - t_pass
+        report["duration_s"] = duration_s
+        # Converged = the pass ended with NOTHING outstanding: no failed
+        # sweep/replay, kubelet answerable, no corrupt rows, nothing
+        # observed diverged (dry-run) or awaiting confirmation. Repairs
+        # that SUCCEEDED don't block convergence — the node is converged
+        # at the end of the pass that fixed it. Fleet-level "reconcile
+        # convergence time" is measured off this timestamp.
+        converged = (
+            report["sweep_failures"] == 0
+            and report["replay_failures"] == 0
+            and report["snapshot_error"] is None
+            and report["corrupt_records"] == 0
+            and report["divergences_observed"] == 0
+            and report["pending_confirmation"] == 0
+        )
+        wall_now = time.time() if now is None else now
         with self._lock:
             self._prev_candidates = self._tick_candidates
             self._tick_candidates = set()
             self._runs_total += 1
-            self._last_run_ts = time.time() if now is None else now
+            self._last_run_ts = wall_now
+            self._last_duration_s = duration_s
+            if converged:
+                self._last_converged_ts = wall_now
             self._last_report = dict(report)
         m = self._metrics
         if m is not None:
             try:
                 if hasattr(m, "reconcile_runs"):
                     m.reconcile_runs.inc()
+                if hasattr(m, "reconcile_duration"):
+                    m.reconcile_duration.observe(duration_s)
+                if converged and hasattr(m, "reconcile_last_converged"):
+                    m.reconcile_last_converged.set(wall_now)
                 if hasattr(m, "open_bind_intents"):
                     m.open_bind_intents.set(
                         len(self._storage.open_intents())
@@ -892,6 +919,8 @@ class Reconciler:
                 "dry_run": self.dry_run,
                 "runs_total": self._runs_total,
                 "last_run_ts": self._last_run_ts,
+                "last_duration_s": self._last_duration_s,
+                "last_converged_ts": self._last_converged_ts,
                 "repairs_total": {
                     k: v for k, v in self._repairs.items() if v
                 },

@@ -1,0 +1,21 @@
+"""Cluster-in-a-box fleet simulator + the observability layer over it.
+
+ROADMAP open item 1: production is a fleet, and nothing node-local can
+measure fleet bind p99, reconcile convergence, or kubelet/apiserver
+request amplification. This package runs N complete in-process agents —
+each against its own fake kubelet and its own stub operator, all sharing
+ONE fake apiserver — and reads the result the way production would: by
+scraping every agent's /metrics endpoint.
+
+- fleet.py: FleetSim — builds/starts/drives/stops the simulated fleet
+  (reuses the hermetic rigs in tests/fake_apiserver.py and
+  tests/fake_kubelet.py; this is a dev/bench tool, never shipped in the
+  DaemonSet image).
+- aggregator.py: FleetAggregator — scrapes each agent over HTTP, merges
+  histogram buckets for fleet-level quantiles, computes per-bind request
+  amplification, tracks per-node reconcile convergence, and follows
+  admission-stamped trace ids to whichever node bound the pod.
+"""
+
+from .aggregator import FleetAggregator, histogram_quantile  # noqa: F401
+from .fleet import FleetSim  # noqa: F401

@@ -1,0 +1,333 @@
+"""FleetAggregator: the fleet-level read side of the observatory.
+
+Scrapes every agent's /metrics endpoint (prometheus text format — the
+same bytes a production Prometheus would ingest), then rolls the node
+samples up into the three fleet questions ROADMAP item 1 asks:
+
+- **fleet bind latency**: per-node elastic_tpu_prestart_seconds
+  histograms merged bucket-wise, quantiles estimated the
+  histogram_quantile() way (linear interpolation inside the bucket) —
+  so fleet p50/p99 is computed from scraped data, not from driver-side
+  stopwatches (the driver's exact percentiles ride along as a
+  cross-check).
+- **reconcile convergence**: per-node
+  elastic_tpu_reconcile_last_converged_timestamp; convergence time
+  after an event (churn end, fault clear) = first converged timestamp
+  past the anchor, minus the anchor.
+- **request amplification**: elastic_tpu_kubelet_list_total and
+  elastic_tpu_sink_writes_total{sink=} divided by binds — how many
+  kubelet Lists and apiserver sink writes the fleet pays per bind.
+
+Trace continuity rides the same targets' /debug/traces?trace=<id>
+endpoint: admission stamps the id, the binding agent adopts it, and the
+aggregator follows it to the node that bound the pod.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import statistics
+import time
+import urllib.request
+from typing import Dict, List, Optional, Tuple
+
+
+def _parse_le(value: str) -> float:
+    return math.inf if value == "+Inf" else float(value)
+
+
+def histogram_quantile(
+    buckets: Dict[float, float], q: float
+) -> Optional[float]:
+    """Prometheus-style quantile estimate over cumulative ``le ->
+    count`` buckets (merged across nodes by summing counts per bound).
+    Returns seconds, or None for an empty histogram. Values past the
+    largest finite bucket clamp to that bound, like histogram_quantile().
+    """
+    if not buckets:
+        return None
+    bounds = sorted(buckets)
+    total = buckets[bounds[-1]]
+    if total <= 0:
+        return None
+    rank = q * total
+    prev_le, prev_count = 0.0, 0.0
+    for le in bounds:
+        count = buckets[le]
+        if count >= rank:
+            if math.isinf(le):
+                # +Inf bucket: report the largest finite bound
+                return prev_le
+            if count == prev_count:
+                return le
+            return prev_le + (le - prev_le) * (
+                (rank - prev_count) / (count - prev_count)
+            )
+        prev_le, prev_count = le, count
+    return bounds[-1] if not math.isinf(bounds[-1]) else prev_le
+
+
+class NodeScrape:
+    """One node's parsed /metrics payload: sample name -> [(labels,
+    value)], plus O(1) helpers."""
+
+    def __init__(self, samples: Dict[str, List[Tuple[dict, float]]]) -> None:
+        self.samples = samples
+
+    def value(
+        self, name: str, labels: Optional[dict] = None, default: float = 0.0
+    ) -> float:
+        for sample_labels, value in self.samples.get(name, []):
+            if labels is None or all(
+                sample_labels.get(k) == v for k, v in labels.items()
+            ):
+                return value
+        return default
+
+    def buckets(self, histogram: str) -> Dict[float, float]:
+        out: Dict[float, float] = {}
+        for sample_labels, value in self.samples.get(
+            f"{histogram}_bucket", []
+        ):
+            if "le" in sample_labels:
+                out[_parse_le(sample_labels["le"])] = value
+        return out
+
+
+class FleetAggregator:
+    def __init__(
+        self, targets: Dict[str, str], timeout_s: float = 5.0
+    ) -> None:
+        self.targets = dict(targets)  # node name -> http://host:port
+        self.timeout_s = timeout_s
+
+    # -- scraping -------------------------------------------------------------
+
+    def _get(self, url: str) -> bytes:
+        with urllib.request.urlopen(url, timeout=self.timeout_s) as resp:
+            return resp.read()
+
+    def scrape_node(self, node: str) -> NodeScrape:
+        from prometheus_client.parser import text_string_to_metric_families
+
+        text = self._get(f"{self.targets[node]}/metrics").decode()
+        samples: Dict[str, List[Tuple[dict, float]]] = {}
+        for family in text_string_to_metric_families(text):
+            for sample in family.samples:
+                samples.setdefault(sample.name, []).append(
+                    (dict(sample.labels), sample.value)
+                )
+        return NodeScrape(samples)
+
+    def scrape(self) -> Dict[str, NodeScrape]:
+        return {node: self.scrape_node(node) for node in self.targets}
+
+    # -- the fleet rollup -----------------------------------------------------
+
+    def rollup(
+        self, scrapes: Optional[Dict[str, NodeScrape]] = None
+    ) -> dict:
+        """One fleet snapshot: per-node rows plus the fleet aggregates
+        (merged-histogram bind quantiles, request-amplification ratios,
+        convergence timestamps)."""
+        if scrapes is None:
+            scrapes = self.scrape()
+        per_node: Dict[str, dict] = {}
+        merged_bind: Dict[float, float] = {}
+        totals = {
+            "binds": 0.0, "allocates": 0.0, "kubelet_lists": 0.0,
+            "sink_writes_events": 0.0, "sink_writes_crd": 0.0,
+            "series_evicted": 0.0,
+        }
+        for node, scrape in scrapes.items():
+            binds = scrape.value("elastic_tpu_prestart_seconds_count")
+            row = {
+                "binds": binds,
+                "allocates": scrape.value(
+                    "elastic_tpu_allocate_seconds_count"
+                ),
+                "bound_allocations": scrape.value(
+                    "elastic_tpu_bound_allocations"
+                ),
+                "kubelet_lists": scrape.value(
+                    "elastic_tpu_kubelet_list_total"
+                ),
+                "sink_writes": {
+                    "events": scrape.value(
+                        "elastic_tpu_sink_writes_total", {"sink": "events"}
+                    ),
+                    "crd": scrape.value(
+                        "elastic_tpu_sink_writes_total", {"sink": "crd"}
+                    ),
+                },
+                "reconcile_runs": scrape.value(
+                    "elastic_tpu_reconcile_runs_total"
+                ),
+                "reconcile_last_converged_ts": scrape.value(
+                    "elastic_tpu_reconcile_last_converged_timestamp"
+                ),
+                "reconcile_duration_p50_s": histogram_quantile(
+                    scrape.buckets("elastic_tpu_reconcile_duration_seconds"),
+                    0.5,
+                ),
+                "series_evicted": scrape.value(
+                    "elastic_tpu_metric_series_evicted_total"
+                ),
+                "open_bind_intents": scrape.value(
+                    "elastic_tpu_bind_intents_open"
+                ),
+            }
+            node_buckets = scrape.buckets("elastic_tpu_prestart_seconds")
+            for le, count in node_buckets.items():
+                merged_bind[le] = merged_bind.get(le, 0.0) + count
+            for q, key in ((0.5, "bind_p50_ms"), (0.99, "bind_p99_ms")):
+                quantile = histogram_quantile(node_buckets, q)
+                row[key] = (
+                    None if quantile is None else round(quantile * 1000, 3)
+                )
+            per_node[node] = row
+            totals["binds"] += binds
+            totals["allocates"] += row["allocates"]
+            totals["kubelet_lists"] += row["kubelet_lists"]
+            totals["sink_writes_events"] += row["sink_writes"]["events"]
+            totals["sink_writes_crd"] += row["sink_writes"]["crd"]
+            totals["series_evicted"] += row["series_evicted"]
+        binds = totals["binds"]
+        p50 = histogram_quantile(merged_bind, 0.5)
+        p99 = histogram_quantile(merged_bind, 0.99)
+        return {
+            "nodes": len(per_node),
+            "per_node": per_node,
+            "fleet": {
+                "binds_total": binds,
+                "fleet_bind_p50_ms": (
+                    None if p50 is None else round(p50 * 1000, 3)
+                ),
+                "fleet_bind_p99_ms": (
+                    None if p99 is None else round(p99 * 1000, 3)
+                ),
+                "request_amplification": {
+                    "kubelet_lists_total": totals["kubelet_lists"],
+                    "kubelet_lists_per_bind": (
+                        round(totals["kubelet_lists"] / binds, 4)
+                        if binds else None
+                    ),
+                    "sink_writes_per_bind": {
+                        "events": (
+                            round(totals["sink_writes_events"] / binds, 4)
+                            if binds else None
+                        ),
+                        "crd": (
+                            round(totals["sink_writes_crd"] / binds, 4)
+                            if binds else None
+                        ),
+                    },
+                },
+                "series_evicted_total": totals["series_evicted"],
+            },
+        }
+
+    # -- reconcile convergence ------------------------------------------------
+
+    def wait_converged(
+        self,
+        after_ts: float,
+        timeout_s: float = 60.0,
+        poll_s: float = 0.25,
+    ) -> Dict[str, Optional[float]]:
+        """Per-node reconcile convergence time after the ``after_ts``
+        anchor (e.g. churn end): seconds until the node's last-converged
+        timestamp first advanced past the anchor; None = never converged
+        inside the timeout (THE divergent node to triage)."""
+        pending = set(self.targets)
+        out: Dict[str, Optional[float]] = {n: None for n in self.targets}
+        deadline = time.monotonic() + timeout_s
+        while pending and time.monotonic() < deadline:
+            for node in sorted(pending):
+                try:
+                    scrape = self.scrape_node(node)
+                except Exception:  # noqa: BLE001 - scrape blip: retry
+                    continue
+                ts = scrape.value(
+                    "elastic_tpu_reconcile_last_converged_timestamp"
+                )
+                if ts > after_ts:
+                    out[node] = round(ts - after_ts, 3)
+                    pending.discard(node)
+            if pending:
+                time.sleep(poll_s)
+        return out
+
+    @staticmethod
+    def convergence_summary(
+        per_node: Dict[str, Optional[float]]
+    ) -> dict:
+        done = [v for v in per_node.values() if v is not None]
+        return {
+            "per_node": per_node,
+            "converged_nodes": len(done),
+            "unconverged_nodes": sorted(
+                n for n, v in per_node.items() if v is None
+            ),
+            "median_s": round(statistics.median(done), 3) if done else None,
+            "max_s": round(max(done), 3) if done else None,
+        }
+
+    # -- trace continuity -----------------------------------------------------
+
+    def trace_lookup(self, trace_id: str) -> List[dict]:
+        """Every completed trace carrying ``trace_id``, across all
+        targets, deduplicated (in-process sims share one ring, so every
+        node answers with the same traces; a real fleet has per-node
+        rings and only the binding node answers)."""
+        found: List[dict] = []
+        seen = set()
+        for node in sorted(self.targets):
+            try:
+                payload = json.loads(self._get(
+                    f"{self.targets[node]}/debug/traces?trace={trace_id}"
+                ))
+            except Exception:  # noqa: BLE001 - an unreachable node: skip
+                continue
+            for trace in payload.get("traces", []):
+                key = (
+                    trace.get("trace_id"), trace.get("name"),
+                    trace.get("start_ts"),
+                )
+                if key in seen:
+                    continue
+                seen.add(key)
+                found.append(trace)
+        return found
+
+    def check_continuity(
+        self, samples: List[Tuple[str, str, str]]
+    ) -> dict:
+        """``samples`` = (expected_node, admission_trace_id, pod_key)
+        triples; a sample is continuous when a completed bind
+        (PreStartContainer) trace under the admission id exists AND its
+        ``node`` attribute names the node kubelet actually bound the pod
+        on. Returns the continuity fraction + the broken samples."""
+        broken: List[dict] = []
+        for expected_node, trace_id, pod_key in samples:
+            traces = self.trace_lookup(trace_id)
+            binds = [
+                t for t in traces
+                if t.get("name") == "PreStartContainer"
+                and t.get("attrs", {}).get("node") == expected_node
+            ]
+            if not binds:
+                broken.append({
+                    "pod": pod_key,
+                    "trace_id": trace_id,
+                    "expected_node": expected_node,
+                    "found_traces": len(traces),
+                })
+        n = len(samples)
+        return {
+            "sampled": n,
+            "continuous": n - len(broken),
+            "fraction": round((n - len(broken)) / n, 4) if n else None,
+            "broken": broken[:5],
+        }

@@ -1,0 +1,383 @@
+"""FleetSim: N in-process agents, N fake kubelets, ONE fake apiserver.
+
+Every node is a complete agent — real TPUManager, real gRPC device-plugin
+servers registered with its own FakeKubelet, real supervised reconciler,
+real CRD/Event sinks writing to the shared FakeAPIServer — with its own
+AgentMetrics on a private registry served on an ephemeral loopback port,
+so the FleetAggregator reads the fleet exactly the way a production
+Prometheus would: one scrape target per node.
+
+The bind drive is in-process (the Allocate/PreStartContainer servicers
+are invoked directly, like the bench churn phase): on the small CI box,
+per-RPC gRPC overhead at fleet concurrency would benchmark the loopback
+fabric instead of the agent. The pod-resources Lists the locators and
+reconcilers issue still cross real gRPC to each node's fake kubelet, and
+the sinks still cross real HTTP to the shared apiserver — the traffic
+the fleet observatory meters is real.
+
+Admission stamps ``elasticgpu.io/trace-id`` on every pod, so one trace
+id follows the pod from the shared apiserver to whichever agent binds it
+(the bind adopts the id; plugins/tpushare.py). All in-process agents
+share the one process-wide trace ring, so bind traces also carry a
+``node`` attribute — the aggregator attributes a trace to its binding
+node by that attribute, exactly as it would pick the one answering ring
+in a real multi-process fleet.
+"""
+
+from __future__ import annotations
+
+import os
+import statistics
+import sys
+import threading
+import time
+from typing import Dict, List, Optional
+
+from ..common import (
+    AnnotationAssumed,
+    AnnotationTraceID,
+    ResourceTPUCore,
+    container_annotation,
+)
+from ..gen import deviceplugin_pb2 as dp
+from ..kube.client import KubeClient
+from ..manager import ManagerOptions, TPUManager
+from ..tracing import Tracer, new_trace_id, set_tracer
+
+_REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)
+)))
+
+
+def _import_fakes():
+    """The fake control-plane rigs live in tests/ (they are test/bench
+    material, not agent code); make them importable from bench and
+    tooling without an installed package."""
+    tests_dir = os.path.join(_REPO, "tests")
+    if tests_dir not in sys.path:
+        sys.path.insert(0, tests_dir)
+    try:
+        from fake_apiserver import FakeAPIServer, make_pod
+        from fake_kubelet import FakeKubelet
+    except ImportError as e:  # pragma: no cover - repo layout broken
+        raise RuntimeError(
+            "FleetSim needs tests/fake_apiserver.py and "
+            "tests/fake_kubelet.py next to the package "
+            f"(looked in {tests_dir}): {e}"
+        ) from e
+    return FakeAPIServer, FakeKubelet, make_pod
+
+
+class SimNode:
+    """One simulated node: fake kubelet + full agent + metrics endpoint."""
+
+    def __init__(self, name: str, root: str) -> None:
+        self.name = name
+        self.root = root
+        self.kubelet = None
+        self.manager: Optional[TPUManager] = None
+        self.metrics = None
+        self.metrics_url: str = ""
+
+    @property
+    def storage(self):
+        return self.manager.storage
+
+
+class PodRef:
+    """One admitted pod: where it was scheduled and its admission id."""
+
+    __slots__ = ("node_idx", "namespace", "name", "chip", "trace_id")
+
+    def __init__(self, node_idx, namespace, name, chip, trace_id) -> None:
+        self.node_idx = node_idx
+        self.namespace = namespace
+        self.name = name
+        self.chip = chip
+        self.trace_id = trace_id
+
+    @property
+    def pod_key(self) -> str:
+        return f"{self.namespace}/{self.name}"
+
+
+class FleetSim:
+    """Build, drive and tear down an N-node simulated fleet.
+
+    ``base_dir`` must be SHORT (AF_UNIX socket paths cap at ~107 chars;
+    each node's kubelet sockets live under ``base_dir/n<i>/``).
+    """
+
+    def __init__(
+        self,
+        base_dir: str,
+        nodes: int = 8,
+        operator_kind: str = "stub:v5litepod-4",
+        reconcile_period_s: float = 2.0,
+        dp_pool_size: int = 4,
+        enable_sampler: bool = False,
+        core_units_per_pod: int = 10,
+    ) -> None:
+        self.base_dir = base_dir
+        self.n_nodes = nodes
+        self.operator_kind = operator_kind
+        self.reconcile_period_s = reconcile_period_s
+        self.dp_pool_size = dp_pool_size
+        self.enable_sampler = enable_sampler
+        self.core_units_per_pod = core_units_per_pod
+        self.nodes: List[SimNode] = []
+        self.apiserver = None
+        self.api_url = ""
+        self._prev_tracer = None
+        self._started = False
+
+    # -- lifecycle ------------------------------------------------------------
+
+    def start(self, trace_capacity: Optional[int] = None) -> None:
+        FakeAPIServer, FakeKubelet, _ = _import_fakes()
+        from prometheus_client import CollectorRegistry
+
+        from ..metrics import AgentMetrics
+
+        # One ring serves all in-process agents; churning thousands of
+        # binds through the default 256-slot ring would evict the very
+        # traces the continuity check follows. Swapped back at stop().
+        if trace_capacity is None:
+            trace_capacity = max(1024, 4 * self.n_nodes * 256)
+        self._prev_tracer = set_tracer(Tracer(capacity=trace_capacity))
+
+        self.apiserver = FakeAPIServer()
+        self.api_url = self.apiserver.start()
+        try:
+            self._start_nodes(FakeKubelet, AgentMetrics, CollectorRegistry)
+        except BaseException:
+            # A node that failed to come up must not leak the ones that
+            # did (or the swapped global tracer) into the caller's test.
+            self.stop()
+            raise
+        self._started = True
+
+    def _start_nodes(
+        self, FakeKubelet, AgentMetrics, CollectorRegistry
+    ) -> None:
+        for i in range(self.n_nodes):
+            node = SimNode(f"sim-{i}", os.path.join(self.base_dir, f"n{i}"))
+            os.makedirs(os.path.join(node.root, "dev"), exist_ok=True)
+            node.kubelet = FakeKubelet(
+                os.path.join(node.root, "dp"),
+                os.path.join(node.root, "pr", "kubelet.sock"),
+            )
+            node.kubelet.start()
+            node.metrics = AgentMetrics(registry=CollectorRegistry())
+            httpd = node.metrics.serve(0)  # ephemeral loopback port
+            node.metrics_url = f"http://127.0.0.1:{httpd.server_address[1]}"
+            opts = ManagerOptions(
+                node_name=node.name,
+                db_path=os.path.join(node.root, "meta.db"),
+                operator_kind=self.operator_kind,
+                dev_root=os.path.join(node.root, "dev"),
+                device_plugin_dir=os.path.join(node.root, "dp"),
+                pod_resources_socket=os.path.join(
+                    node.root, "pr", "kubelet.sock"
+                ),
+                alloc_spec_dir=os.path.join(node.root, "alloc"),
+                kube_client=KubeClient(self.api_url),
+                metrics=node.metrics,
+                dp_pool_size=self.dp_pool_size,
+                enable_sampler=self.enable_sampler,
+                reconcile_period_s=self.reconcile_period_s,
+            )
+            node.manager = TPUManager(opts)
+            node.manager.run(block=False)
+            self.nodes.append(node)  # appended first: stop() reaps it
+            if not node.kubelet.wait_registrations(2, timeout=20):
+                raise RuntimeError(
+                    f"{node.name}: agent failed to register with its "
+                    "fake kubelet"
+                )
+
+    def stop(self) -> None:
+        for node in self.nodes:
+            try:
+                node.manager.stop()
+            except Exception:  # noqa: BLE001 - teardown keeps going
+                pass
+            try:
+                node.metrics.close()
+            except Exception:  # noqa: BLE001
+                pass
+            try:
+                node.kubelet.stop()
+            except Exception:  # noqa: BLE001
+                pass
+        self.nodes = []
+        if self.apiserver is not None:
+            self.apiserver.stop()
+            self.apiserver = None
+        if self._prev_tracer is not None:
+            set_tracer(self._prev_tracer)
+            self._prev_tracer = None
+        self._started = False
+
+    def targets(self) -> Dict[str, str]:
+        """node name -> metrics base URL (the aggregator's scrape list)."""
+        return {node.name: node.metrics_url for node in self.nodes}
+
+    # -- admission (the scheduler's half) -------------------------------------
+
+    def _n_chips(self, node: SimNode) -> int:
+        return len(node.manager.operator.devices())
+
+    def admit_pods(
+        self, pods_per_node: int, namespace: str = "fleet"
+    ) -> List[PodRef]:
+        """Schedule pods round-robin over each node's chips, stamping the
+        elastic-scheduler annotations plus an admission trace id."""
+        _, _, make_pod = _import_fakes()
+        refs: List[PodRef] = []
+        for i, node in enumerate(self.nodes):
+            n_chips = self._n_chips(node)
+            for j in range(pods_per_node):
+                ref = PodRef(
+                    i, namespace, f"p{i}-{j}", j % n_chips, new_trace_id()
+                )
+                self.apiserver.upsert_pod(make_pod(
+                    ref.namespace, ref.name, node.name,
+                    annotations={
+                        AnnotationAssumed: "true",
+                        container_annotation("jax"): str(ref.chip),
+                        AnnotationTraceID: ref.trace_id,
+                    },
+                    containers=[{"name": "jax"}],
+                ))
+                refs.append(ref)
+        return refs
+
+    def wait_synced(self, refs: List[PodRef], timeout_s: float = 60.0) -> None:
+        """Wait until every node's sitter has seen its LAST admitted pod
+        (watch events are ordered per node, so the last one suffices)."""
+        last_by_node: Dict[int, PodRef] = {}
+        for ref in refs:
+            last_by_node[ref.node_idx] = ref
+        deadline = time.monotonic() + timeout_s
+        for i, ref in last_by_node.items():
+            sitter = self.nodes[i].manager.sitter
+            while sitter.get_pod(ref.namespace, ref.name) is None:
+                if time.monotonic() > deadline:
+                    raise RuntimeError(
+                        f"{self.nodes[i].name}: sitter never saw "
+                        f"{ref.pod_key}"
+                    )
+                time.sleep(0.005)
+
+    # -- the bind drive (kubelet's half) --------------------------------------
+
+    def _core_ids(self, ref: PodRef) -> List[str]:
+        # The unit field of a fake id is never parsed (only the chip
+        # is), so embedding the pod name makes every pod's id set
+        # pairwise distinct on its node without unit-space bookkeeping.
+        from ..plugins.tpushare import core_device_id
+
+        return [
+            core_device_id(ref.chip, f"{ref.name}u{j}")
+            for j in range(self.core_units_per_pod)
+        ]
+
+    def bind_pod(self, ref: PodRef) -> None:
+        """One kubelet-shaped bind on the pod's node: Allocate, record
+        the assignment in pod-resources, PreStartContainer — servicers
+        invoked in-process, Lists/sinks over real transports."""
+        node = self.nodes[ref.node_idx]
+        core = node.manager.plugin.core
+        ids = self._core_ids(ref)
+        core.Allocate(dp.AllocateRequest(container_requests=[
+            dp.ContainerAllocateRequest(devicesIDs=ids)
+        ]), None)
+        node.kubelet.assign(
+            ref.namespace, ref.name, "jax", ResourceTPUCore, ids
+        )
+        core.PreStartContainer(
+            dp.PreStartContainerRequest(devicesIDs=ids), None
+        )
+
+    def churn(
+        self,
+        refs: List[PodRef],
+        workers_per_node: int = 2,
+        timeout_s: float = 600.0,
+    ) -> dict:
+        """Bind every admitted pod, ``workers_per_node`` concurrent
+        binders per node across the whole fleet at once; returns driver-
+        side latency/throughput stats plus ``churn_end_ts`` (the anchor
+        for reconcile-convergence measurement)."""
+        by_node: Dict[int, List[PodRef]] = {}
+        for ref in refs:
+            by_node.setdefault(ref.node_idx, []).append(ref)
+        bind_ms: List[Optional[float]] = [None] * len(refs)
+        index_of = {id(ref): i for i, ref in enumerate(refs)}
+        errors: List[str] = []
+        err_lock = threading.Lock()
+        n_workers = sum(
+            min(workers_per_node, len(v)) for v in by_node.values()
+        )
+        barrier = threading.Barrier(n_workers + 1)
+
+        def worker(chunk: List[PodRef]) -> None:
+            barrier.wait()
+            for ref in chunk:
+                try:
+                    t0 = time.perf_counter()
+                    self.bind_pod(ref)
+                    bind_ms[index_of[id(ref)]] = (
+                        time.perf_counter() - t0
+                    ) * 1000
+                except Exception as e:  # noqa: BLE001 - collected, not fatal
+                    with err_lock:
+                        errors.append(
+                            f"{ref.pod_key}: {type(e).__name__}: {e}"
+                        )
+
+        threads = []
+        for node_refs in by_node.values():
+            w = min(workers_per_node, len(node_refs))
+            for k in range(w):
+                threads.append(threading.Thread(
+                    target=worker, args=(node_refs[k::w],), daemon=True,
+                ))
+        for t in threads:
+            t.start()
+        barrier.wait()
+        wall_t0 = time.perf_counter()
+        # One shared deadline, not one per join: 16 wedged workers must
+        # not stack 16 timeouts. Workers still alive afterwards are
+        # REPORTED (timed_out_workers) — the numbers below would
+        # otherwise read as a healthy-but-slow fleet while daemon
+        # threads keep mutating the stores under the caller's reads.
+        deadline = time.monotonic() + timeout_s
+        for t in threads:
+            t.join(timeout=max(0.0, deadline - time.monotonic()))
+        timed_out = sum(1 for t in threads if t.is_alive())
+        wall_s = time.perf_counter() - wall_t0
+        done = sorted(v for v in bind_ms if v is not None)
+        return {
+            "pods": len(refs),
+            "bound": len(done),
+            "errors": errors[:5],
+            "error_count": len(errors),
+            "workers": n_workers,
+            "timed_out_workers": timed_out,
+            "bind_p50_ms": statistics.median(done) if done else None,
+            "bind_p99_ms": (
+                done[max(0, int(len(done) * 0.99) - 1)] if done else None
+            ),
+            "binds_per_s": len(done) / wall_s if wall_s > 0 else None,
+            "wall_s": wall_s,
+            "churn_end_ts": time.time(),
+        }
+
+    # -- fleet-side ground truth (assertions, not metrics) --------------------
+
+    def stored_binds(self) -> Dict[str, int]:
+        """Per-node checkpoint-store record counts (the 'every bind
+        landed' ground truth the smoke asserts against)."""
+        return {node.name: node.storage.count() for node in self.nodes}

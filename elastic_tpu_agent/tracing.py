@@ -219,13 +219,32 @@ class Tracer:
         if pod not in pods:
             pods.append(pod)
 
+    def adopt_id(self, trace_id: str) -> None:
+        """Adopt an externally-assigned correlation id for the current
+        trace (cross-component continuity: the scheduler/admission side
+        stamps ``elasticgpu.io/trace-id`` on the pod, and the agent that
+        ends up binding it continues under the SAME id, so one string
+        follows the pod from apiserver admission to whichever node bound
+        it). The locally-generated id is preserved as an attribute for
+        log-line correlation."""
+        tr = _current_trace.get()
+        if tr is None or not trace_id or tr.trace_id == trace_id:
+            return
+        tr.attrs.setdefault("local_trace_id", tr.trace_id)
+        tr.trace_id = trace_id
+
     # -- reading --------------------------------------------------------------
 
     def dump(
-        self, pod: Optional[str] = None, limit: Optional[int] = None
+        self,
+        pod: Optional[str] = None,
+        limit: Optional[int] = None,
+        trace_id: Optional[str] = None,
     ) -> List[dict]:
         """Completed traces, newest first; ``pod`` filters on the
-        trace's pod attribute (exact "ns/name" or bare pod name)."""
+        trace's pod attribute (exact "ns/name" or bare pod name);
+        ``trace_id`` filters on the exact correlation id (the fleet
+        aggregator's continuity lookup)."""
         with self._lock:
             traces = list(self._ring)
         traces.reverse()
@@ -233,6 +252,8 @@ class Tracer:
         for tr in traces:
             if limit is not None and len(out) >= limit:
                 break
+            if trace_id and tr.trace_id != trace_id:
+                continue
             if pod:
                 candidates = [str(tr.attrs.get("pod", ""))]
                 candidates.extend(

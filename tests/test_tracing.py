@@ -115,6 +115,27 @@ def test_concurrent_traces_do_not_interleave():
             assert span["attrs"]["seq"] == trace["attrs"]["seq"]
 
 
+def test_adopt_id_continues_admission_trace():
+    """Cross-node continuity: a trace adopting an admission-stamped id
+    is findable under THAT id (dump trace_id filter), with the local id
+    preserved as an attribute for log correlation."""
+    tr = tracing.Tracer(capacity=8)
+    with tr.trace("PreStartContainer") as t:
+        local = t.trace_id
+        tr.adopt_id("feedc0ffee123456")
+        # idempotent: re-adopting the same id must not clobber local_trace_id
+        tr.adopt_id("feedc0ffee123456")
+    assert t.trace_id == "feedc0ffee123456"
+    assert t.attrs["local_trace_id"] == local
+    with tr.trace("Allocate"):
+        tr.adopt_id("")  # unstamped pod: a no-op
+    found = tr.dump(trace_id="feedc0ffee123456")
+    assert len(found) == 1
+    assert found[0]["name"] == "PreStartContainer"
+    assert tr.dump(trace_id=local) == []
+    tr.adopt_id("ffff")  # no active trace: a no-op, never raises
+
+
 def test_dump_filters_by_pod_and_limit():
     tr = tracing.Tracer()
     for i, pod in enumerate(["ns/a", "ns/b", "ns/a", "other/a"]):
@@ -355,6 +376,32 @@ def test_sink_gauge_registration_survives_metricsless_callers():
     sink = AsyncSink("quiet-sink")
     register_sink_metrics(sink, None)
     register_sink_metrics(sink, object())
+    sink.stop()
+
+
+def test_sink_writes_counted_at_the_source():
+    """Request-amplification accounting: every successfully drained op
+    bumps elastic_tpu_sink_writes_total under the sink's fleet label
+    (event-recorder -> events); failed ops don't count as traffic."""
+    reg = CollectorRegistry()
+    m = AgentMetrics(registry=reg)
+    sink = AsyncSink("event-recorder", max_failures=5)
+    register_sink_metrics(sink, m)
+
+    wrote = []
+    for i in range(3):
+        sink.submit(lambda i=i: wrote.append(i))
+
+    def boom():
+        raise RuntimeError("nope")
+
+    sink.submit(boom)
+    sink.flush()
+    assert len(wrote) == 3
+    assert sink.writes_total == 3
+    assert reg.get_sample_value(
+        "elastic_tpu_sink_writes_total", {"sink": "events"}
+    ) == 3.0
     sink.stop()
 
 
