@@ -5,7 +5,7 @@ TAG ?= elastic-tpu-agent:latest
 # verify's tier-1 line uses pipefail, which /bin/sh (dash) lacks
 SHELL := /bin/bash
 
-.PHONY: all native sanitize test test-all verify doctor-smoke chaos-smoke bench-smoke crash-replay-smoke fleet-smoke protos image bench clean
+.PHONY: all native sanitize test test-all verify doctor-smoke chaos-smoke bench-smoke crash-replay-smoke fleet-smoke slice-smoke protos image bench clean
 
 all: native test
 
@@ -84,8 +84,19 @@ crash-replay-smoke:
 fleet-smoke:
 	JAX_PLATFORMS=cpu python3 bench.py --fleet-smoke
 
+# slice smoke: the slice-orchestrator chaos gate (bench.py
+# --slice-smoke): a 4-agent multi-host slice forms against the shared
+# fake apiserver (consistent TPU_WORKER_ID/HOSTNAMES env on every
+# member), then one member agent is killed and its pod evicted — the
+# survivors' reconcilers must re-form the slice at world size 3 with
+# re-emitted topology env, a bumped epoch, a counted reform on every
+# survivor and a TPUSliceReformed event per member. Structural and
+# deterministic (no timing thresholds).
+slice-smoke:
+	JAX_PLATFORMS=cpu python3 bench.py --slice-smoke
+
 T1_TIMEOUT ?= 870
-verify: doctor-smoke chaos-smoke bench-smoke crash-replay-smoke fleet-smoke
+verify: doctor-smoke chaos-smoke bench-smoke crash-replay-smoke fleet-smoke slice-smoke
 	python -c "from prometheus_client import CollectorRegistry; \
 	  from elastic_tpu_agent.metrics import AgentMetrics; \
 	  AgentMetrics(registry=CollectorRegistry()); \

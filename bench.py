@@ -532,6 +532,7 @@ def run_fleet(
     workers_per_node=2,
     trace_samples=FLEET_TRACE_SAMPLES,
     convergence_timeout_s=60.0,
+    slice_scenario=True,
 ):
     from elastic_tpu_agent.sim import FleetAggregator, FleetSim
 
@@ -565,6 +566,27 @@ def run_fleet(
                 for r in sample_refs
             ])
             stored = sim.stored_binds()
+            # Slice formation + elastic recovery, LAST: it kills a node.
+            if slice_scenario and nodes >= 2:
+                try:
+                    slice_report = run_slice_scenario(
+                        sim, list(range(min(4, nodes))),
+                        timeout_s=convergence_timeout_s,
+                    )
+                except Exception as e:  # noqa: BLE001 - surfaced, not skipped
+                    # A scenario that THROWS is a failure, not a skip:
+                    # "skipped" is the contract for legs that cannot run
+                    # (disabled/missing deps), and a consumer filtering
+                    # on it must not mistake a regression for intent.
+                    slice_report = {
+                        "failed": True,
+                        "error": f"{type(e).__name__}: {e}",
+                    }
+            else:
+                slice_report = {
+                    "skipped": True,
+                    "reason": "slice scenario disabled for this run",
+                }
         finally:
             sim.stop()
         fleet = rollup["fleet"]
@@ -579,6 +601,9 @@ def run_fleet(
             "request_amplification": fleet["request_amplification"],
             "trace_continuity": continuity,
             "series_evicted_total": fleet["series_evicted_total"],
+            # slice formation latency + reform convergence (or an
+            # explicit skip, like every other leg that can't run)
+            "slice": slice_report,
             "driver": driver,
             "stored_binds": stored,
             "per_node": rollup["per_node"],
@@ -623,6 +648,9 @@ def fleet_smoke_main():
             pods_per_node=FLEET_SMOKE_PODS_PER_NODE,
             reconcile_period_s=1.0,
             trace_samples=20,
+            # `make slice-smoke` owns the slice chaos gate; keep this
+            # one focused (and its runtime bounded).
+            slice_scenario=False,
         )
     except Exception as e:  # noqa: BLE001
         print(json.dumps({"fleet_smoke": {
@@ -681,6 +709,174 @@ def fleet_smoke_main():
             print(f"fleet smoke FAILED: {p}", file=sys.stderr)
         return 1
     print("fleet smoke: OK", file=sys.stderr)
+    return 0
+
+
+# -- slices: formation + elastic recovery (ROADMAP item 4) --------------------
+#
+# A multi-host slice formed across cooperating agents (annotation-driven,
+# zero agent-to-agent coordination), then one member agent killed and its
+# pod evicted: the survivors' reconcilers must detect the member loss via
+# the shared apiserver and re-form the slice — topology env re-emitted at
+# the new world size, worker ids re-derived, epoch bumped. The two
+# numbers the fleet leg reports are slice FORMATION latency (admit ->
+# every member stamped consistently) and REFORM convergence (kill ->
+# every survivor stamped at the new world).
+
+SLICE_NODES = 4
+SLICE_ACCEL = "v4-32"  # 4 hosts x 4 chips/host
+
+
+def run_slice_scenario(
+    sim, node_idxs, slice_id="bench-slice", timeout_s=60.0
+):
+    """Drive the slice form/kill/reform scenario on a RUNNING FleetSim.
+
+    DESTRUCTIVE: the victim node is dead afterwards — callers run this
+    after every other measurement on the sim. Returns the report dict
+    (``problems`` empty = the scenario held all its invariants)."""
+    from elastic_tpu_agent.common import EnvSliceEpoch
+    from elastic_tpu_agent.slice_env import ordered_worker_hostnames
+
+    problems = []
+    hosts = [sim.nodes[i].name for i in node_idxs]
+    t0 = time.perf_counter()
+    refs = sim.admit_slice(slice_id, node_idxs, accelerator_type=SLICE_ACCEL)
+    sim.wait_synced(refs)
+    for ref in refs:
+        sim.bind_pod(ref)
+    formation_s = time.perf_counter() - t0
+    envs = [sim.slice_env_of(ref) for ref in refs]
+    # Expectations come from the SAME pure function of the host set the
+    # registry stamps with — not from admission order, which only
+    # coincides with it while sim node names happen to sort like their
+    # indexes.
+    want_order, _ = ordered_worker_hostnames(hosts)
+    want_hosts = ",".join(want_order)
+    for w, env in enumerate(envs):
+        if env.get("TPU_WORKER_HOSTNAMES") != want_hosts:
+            problems.append(
+                f"member {w}: hosts "
+                f"{env.get('TPU_WORKER_HOSTNAMES')!r} != {want_hosts!r}"
+            )
+        if env.get("TPU_WORKER_ID") != str(want_order.index(hosts[w])):
+            problems.append(
+                f"member {w}: worker id {env.get('TPU_WORKER_ID')!r}"
+            )
+        if env.get(EnvSliceEpoch) != "0":
+            problems.append(
+                f"member {w}: epoch {env.get(EnvSliceEpoch)!r} at formation"
+            )
+    for key in ("TPU_HOST_BOUNDS", "TPU_CHIPS_PER_HOST_BOUNDS"):
+        values = {env.get(key) for env in envs}
+        if len(values) != 1:
+            problems.append(
+                f"inconsistent {key} across members: {sorted(map(str, values))}"
+            )
+    # Kill the LAST member: agent down hard, pod evicted (the node
+    # controller's half, done by the driver).
+    victim = refs[-1]
+    survivors = refs[:-1]
+    surviving_order, _ = ordered_worker_hostnames(hosts[:-1])
+    t1 = time.perf_counter()
+    sim.kill_node(victim.node_idx)
+    sim.apiserver.delete_pod(victim.namespace, victim.name)
+    try:
+        sim.wait_slice_reformed(
+            survivors, surviving_order, expected_epoch=1,
+            timeout_s=timeout_s
+        )
+    except RuntimeError as e:
+        problems.append(str(e))
+        reform_s = None
+    else:
+        reform_s = time.perf_counter() - t1
+        envs2 = [sim.slice_env_of(ref) for ref in survivors]
+        for w, env in enumerate(envs2):
+            want_wid = str(surviving_order.index(hosts[w]))
+            if env.get("TPU_WORKER_ID") != want_wid:
+                problems.append(
+                    f"survivor {w}: reformed worker id "
+                    f"{env.get('TPU_WORKER_ID')!r} != {want_wid}"
+                )
+    reforms = {}
+    for ref in survivors:
+        node = sim.nodes[ref.node_idx]
+        reforms[node.name] = (
+            node.manager.slice_registry.status()
+            .get(slice_id, {}).get("reforms_total", 0)
+        )
+    if any(v < 1 for v in reforms.values()):
+        problems.append(f"reform not counted on every survivor: {reforms}")
+    # TPUSliceReformed events ride the async sinks; give them a moment.
+    deadline = time.monotonic() + 10.0
+    while time.monotonic() < deadline:
+        reformed_events = [
+            e for e in sim.apiserver.core_events
+            if e.get("reason") == "TPUSliceReformed"
+        ]
+        if len(reformed_events) >= len(survivors):
+            break
+        time.sleep(0.05)
+    else:
+        reformed_events = [
+            e for e in sim.apiserver.core_events
+            if e.get("reason") == "TPUSliceReformed"
+        ]
+        problems.append(
+            f"{len(reformed_events)} TPUSliceReformed event(s) for "
+            f"{len(survivors)} survivors"
+        )
+    return {
+        "slice_id": slice_id,
+        "accelerator_type": SLICE_ACCEL,
+        "world": len(node_idxs),
+        "formation_s": round(formation_s, 3),
+        "reform_convergence_s": (
+            round(reform_s, 3) if reform_s is not None else None
+        ),
+        "reforms_per_survivor": reforms,
+        "reform_events": len(reformed_events),
+        "problems": problems,
+    }
+
+
+SLICE_SMOKE_TIMEOUT_S = 90.0
+
+
+def slice_smoke_main():
+    """`make slice-smoke`: a 4-agent slice chaos scenario — form, kill
+    one member, assert reform to world size 3 with consistent env on
+    every survivor, a counted reform and a TPUSliceReformed event.
+    Structural, deterministic (no timing thresholds)."""
+    from elastic_tpu_agent.sim import FleetSim
+
+    with tempfile.TemporaryDirectory(prefix="etpu-slc") as tmp:
+        sim = FleetSim(
+            tmp, nodes=SLICE_NODES, reconcile_period_s=0.5,
+            slice_membership_ttl_s=0.25,
+        )
+        try:
+            sim.start()
+            r = run_slice_scenario(
+                sim, list(range(SLICE_NODES)), slice_id="smoke-slice",
+                timeout_s=SLICE_SMOKE_TIMEOUT_S,
+            )
+        except Exception as e:  # noqa: BLE001
+            print(json.dumps({"slice_smoke": {
+                "error": f"{type(e).__name__}: {e}"
+            }}))
+            print(f"slice smoke FAILED: {type(e).__name__}: {e}",
+                  file=sys.stderr)
+            return 1
+        finally:
+            sim.stop()
+    print(json.dumps({"slice_smoke": r}))
+    if r["problems"]:
+        for p in r["problems"]:
+            print(f"slice smoke FAILED: {p}", file=sys.stderr)
+        return 1
+    print("slice smoke: OK", file=sys.stderr)
     return 0
 
 
@@ -1454,6 +1650,8 @@ if __name__ == "__main__":
         sys.exit(churn_smoke_main())
     elif "--fleet-smoke" in sys.argv:
         sys.exit(fleet_smoke_main())
+    elif "--slice-smoke" in sys.argv:
+        sys.exit(slice_smoke_main())
     elif "--fleet" in sys.argv:
         sys.exit(fleet_main())
     else:

@@ -111,6 +111,11 @@ def parse_args(argv=None) -> argparse.Namespace:
                         "(/debug/allocations 'reconcile' block, doctor "
                         "bundle) without repairing; the boot-time restore "
                         "pass still repairs")
+    p.add_argument("--slice-membership-ttl", type=float, default=5.0,
+                   help="seconds one apiserver slice-membership snapshot "
+                        "stays fresh (slices/registry.py) — bounds the "
+                        "slice orchestrator's apiserver traffic; lower it "
+                        "for faster member-loss detection")
     p.add_argument("--crash-loop-threshold", type=int, default=5,
                    help="supervisor circuit breaker: crashes of one "
                         "subsystem within the sliding window before it is "
@@ -311,6 +316,7 @@ def main(argv=None) -> int:
             crash_loop_threshold=args.crash_loop_threshold,
             reconcile_period_s=args.reconcile_period,
             reconcile_dry_run=args.reconcile_dry_run,
+            slice_membership_ttl_s=args.slice_membership_ttl,
         )
     )
     run_thread = threading.Thread(

@@ -48,6 +48,19 @@ AnnotationTraceID = "elasticgpu.io/trace-id"
 AnnotationSliceName = "elasticgpu.io/tpu-slice"
 AnnotationSliceWorkerID = "elasticgpu.io/tpu-slice-worker-id"
 AnnotationSliceWorkerHosts = "elasticgpu.io/tpu-slice-hosts"
+# Job-unique slice identity (slices/registry.py): pods carrying the same
+# id are members of ONE logical slice — the registry groups membership,
+# validates consistency and drives elastic reform by this key. The
+# `tpu-slice` annotation above names the SHAPE (accelerator type); this
+# one names the instance.
+AnnotationSliceID = "elasticgpu.io/tpu-slice-id"
+
+# Slice-orchestrator env stamped alongside the TPU_* topology contract
+# (slices/registry.py): the slice's identity, and a generation counter
+# the runner can watch — the reconciler bumps it when it re-forms the
+# slice at a new world size, signalling checkpoint-restore.
+EnvSliceName = "ELASTIC_TPU_SLICE_NAME"
+EnvSliceEpoch = "ELASTIC_TPU_SLICE_EPOCH"
 
 # -- Container env contract ---------------------------------------------------
 # Env carrying the allocation hash into the container; the OCI hook resolves

@@ -174,6 +174,29 @@ class KubeClient:
         rv = body.get("metadata", {}).get("resourceVersion", "")
         return body.get("items", []), rv
 
+    def list_all_pods(self, page_limit: int = 500) -> list:
+        """Every pod in the cluster (no node fieldSelector) — the slice
+        registry's membership source: cooperating slice members live on
+        OTHER nodes, so the node-scoped sitter cannot see them. Callers
+        (slices/registry.py) TTL-cache the result and count it
+        (`elastic_tpu_apiserver_pod_list_total`); paginated so one
+        agent's membership refresh never asks a 10k-pod apiserver for
+        the whole cluster in one response."""
+        items: list = []
+        cont = ""
+        while True:
+            params = {"limit": str(page_limit)}
+            if cont:
+                params["continue"] = cont
+            r = self._get("/api/v1/pods", params=params)
+            if r.status_code != 200:
+                raise KubeError(f"list all pods: {r.status_code}")
+            body = r.json()
+            items.extend(body.get("items", []))
+            cont = (body.get("metadata") or {}).get("continue", "")
+            if not cont:
+                return items
+
     def create_event(self, namespace: str, event: dict) -> dict:
         """POST a core/v1 Event (reference RBAC granted this and never
         used it; see kube/events.py)."""

@@ -45,6 +45,8 @@ ReasonReconciled = "TPUReconciled"
 ReasonChipUnhealthy = "TPUChipUnhealthy"
 ReasonChipHealthy = "TPUChipHealthy"
 ReasonAllocatableDrift = "TPUAllocatableDrift"
+ReasonSliceReformed = "TPUSliceReformed"
+ReasonSliceInconsistent = "TPUSliceInconsistent"
 
 
 class EventRecorder:

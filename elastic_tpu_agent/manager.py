@@ -96,6 +96,10 @@ class ManagerOptions:
     # — the boot pass always repairs).
     reconcile_period_s: float = 30.0
     reconcile_dry_run: bool = False
+    # Slice orchestration (slices/registry.py): how long one apiserver
+    # membership snapshot stays fresh — bounds slice-tracking apiserver
+    # traffic from the bind path and the reconciler alike.
+    slice_membership_ttl_s: float = 5.0
     # test seams
     kube_client: Optional[KubeClient] = None
     operator: object = None
@@ -211,6 +215,18 @@ class TPUManager:
                 self.metrics, "attach_sampler"
             ):
                 self.metrics.attach_sampler(self.sampler)
+        from .slices import SliceRegistry
+
+        # Slice orchestration (slices/): the registry owns multi-host
+        # slice membership/identity; PreStart stamps through it and the
+        # reconciler's reformer advances it on member loss.
+        self.slice_registry = SliceRegistry(
+            node_name=opts.node_name,
+            kube_client=self.client,
+            metrics=self.metrics,
+            events=self.events,
+            membership_ttl_s=opts.slice_membership_ttl_s,
+        )
         pr_client = rpc.PodResourcesClient(opts.pod_resources_socket)
         self.pr_client = pr_client
         if opts.shared_locator_snapshot:
@@ -247,6 +263,7 @@ class TPUManager:
             crd_recorder=self.crd_recorder,
             events=self.events,
             sampler=self.sampler,
+            slice_registry=self.slice_registry,
             extra={"alloc_spec_dir": opts.alloc_spec_dir, **opts.extra},
         )
         from .plugins.base import plugin_factory
@@ -262,7 +279,12 @@ class TPUManager:
             # health poller through TPUVMOperator's unsynchronized state.
             self.sampler.unhealthy_view_fn = self.plugin.core.unhealthy_chips
         from .reconciler import Reconciler
+        from .slices import SliceReformer
 
+        self.slice_reformer = SliceReformer(
+            self.slice_registry, self.plugin,
+            metrics=self.metrics, events=self.events,
+        )
         self.reconciler = Reconciler(
             storage=self.storage,
             operator=self.operator,
@@ -275,11 +297,13 @@ class TPUManager:
             crd_recorder=self.crd_recorder,
             period_s=opts.reconcile_period_s,
             dry_run=opts.reconcile_dry_run,
+            slice_reformer=self.slice_reformer,
         )
         if self.sampler is not None:
             # /debug/allocations and the doctor bundle carry the live
             # reconcile/journal state (open intents, per-class repairs).
             self.sampler.reconcile_status_fn = self.reconciler.status
+            self.sampler.slice_status_fn = self.slice_registry.status
         self.nri_plugin = None
         if opts.nri_socket:
             from .nri import NRIPlugin

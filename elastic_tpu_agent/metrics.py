@@ -229,6 +229,46 @@ class AgentMetrics:
             "recovered, or a bind is wedged mid-flight)",
             **kw,
         )
+        self.series_evicted = Counter(
+            "elastic_tpu_metric_series_evicted_total",
+            "Labeled metric series evicted by the cardinality guard",
+            **kw,
+        )
+        # -- slice orchestration (slices/) ---------------------------------
+        self.packing_span = Histogram(
+            "elastic_tpu_packing_ici_span",
+            "Total pairwise ICI hop count of a bind's chip set (the "
+            "packing score: 0 = single chip, 1 = one adjacent pair; a "
+            "rising distribution means grants are landing scattered "
+            "across the mesh instead of on adjacent sub-grids)",
+            buckets=(0, 1, 2, 3, 4, 6, 8, 12, 16),
+            **kw,
+        )
+        # Bounded like every per-pod series: slice ids are job-unique,
+        # and under --reconcile-dry-run nothing prunes them, so a plain
+        # labeled gauge would grow the scrape without bound under churn.
+        # (slice_reforms stays a plain Counter: its series only appear
+        # when a reform EXECUTES, which dry-run never does, and prune
+        # removes them with the slice.)
+        self.slice_members = BoundedLabeledGauge(
+            Gauge(
+                "elastic_tpu_slice_members",
+                "Current world size (member hosts) of a multi-host "
+                "slice this node hosts a member of",
+                ["slice"],
+                **kw,
+            ),
+            max_series=max_pod_series,
+            evicted=self.series_evicted,
+        )
+        self.slice_reforms = Counter(
+            "elastic_tpu_slice_reforms_total",
+            "Elastic slice reforms executed on this node (member loss "
+            "or rejoin -> topology env re-emitted at the new world "
+            "size, epoch bumped)",
+            ["slice"],
+            **kw,
+        )
         self.observability_dropped = Counter(
             "elastic_tpu_observability_dropped_total",
             "CRD/event writes dropped by the bounded async queue",
@@ -256,6 +296,14 @@ class AgentMetrics:
             "Full pod-resources List RPCs issued to kubelet (locator "
             "refresh/prefetch + reconciler snapshots) — the kubelet side "
             "of per-bind request amplification",
+            **kw,
+        )
+        self.apiserver_pod_lists = Counter(
+            "elastic_tpu_apiserver_pod_list_total",
+            "Full-cluster pod LISTs issued to the apiserver (slice "
+            "membership refresh, TTL-cached) — the apiserver side of "
+            "request amplification; every list is counted at the "
+            "source, never inferred",
             **kw,
         )
         self.sink_queue_depth = Gauge(
@@ -289,11 +337,6 @@ class AgentMetrics:
             "elastic_tpu_chip_hbm_used_bytes",
             "Last sampled per-chip HBM usage",
             ["chip"],
-            **kw,
-        )
-        self.series_evicted = Counter(
-            "elastic_tpu_metric_series_evicted_total",
-            "Labeled metric series evicted by the cardinality guard",
             **kw,
         )
         self.pod_core_granted = BoundedLabeledGauge(

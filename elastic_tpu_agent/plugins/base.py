@@ -57,6 +57,11 @@ class PluginConfig:
     # folded into the health poll so a chip whose telemetry is failing
     # degrades to Unhealthy in the ListAndWatch stream.
     sampler: object = None
+    # Optional SliceRegistry (slices/registry.py): when set, PreStart
+    # stamps the registry-derived slice env (deterministic worker
+    # ordering, reform-aware world, slice name + epoch) instead of the
+    # bare annotation-order slice_env_for_pod derivation.
+    slice_registry: object = None
     extra: dict = field(default_factory=dict)
 
 

@@ -32,7 +32,6 @@ records are keyed per resource.
 
 from __future__ import annotations
 
-import itertools
 import json
 import logging
 import math
@@ -45,8 +44,10 @@ from typing import Dict, List, Optional, Tuple
 from .. import faults, rpc
 from ..common import (
     AnnotationAssumed,
+    AnnotationSliceID,
     AnnotationTraceID,
     BytesPerMemoryUnit,
+    EnvSliceName,
     EnvAllocationHash,
     EnvTPUVisibleChips,
     EnvTPUVisibleDevices,
@@ -67,8 +68,8 @@ from ..kube.events import (
 from ..kube.locator import DeviceLocator, LocateError
 from ..qos import qos_env
 from ..slice_env import slice_env_for_pod
+from ..slices import packing
 from ..tracing import get_tracer
-from ..tpu.topology import chip_grid, ici_distance
 from ..types import AllocationRecord, Device, PodContainer, PodInfo
 from .base import DevicePluginServer, PluginConfig
 
@@ -180,89 +181,13 @@ def chip_of_device_id(device_id: str) -> Optional[int]:
         return None
 
 
-def _pick_chip_set(
-    by_chip: Dict[int, List[str]],
-    need: int,
-    chips_per_host: int,
-    pinned: Optional[set] = None,
-) -> List[int]:
-    """Order of chips to draw fake ids from for a request of ``need`` units.
-
-    Picks the minimal number of chips whose free units cover ``need``, and
-    among minimal sets the one with the smallest total pairwise ICI hop
-    distance over the chosen chips *plus* any ``pinned`` chips the request's
-    must-include ids already sit on (then most free capacity). Up to 8
-    candidate chips the subset search is exhaustive and exact (<= C(8,k));
-    beyond that (future larger hosts) a greedy nearest-chip build keeps the
-    cost O(n^2 * k) at the price of exactness.
-    """
-    pinned = pinned or set()
-    free = sorted(by_chip.items(), key=lambda kv: (-len(kv[1]), kv[0]))
-    # minimal chip count k: fullest-first prefix covering `need`
-    total, k = 0, 0
-    for _, ids in free:
-        total += len(ids)
-        k += 1
-        if total >= need:
-            break
-    if total < need:
-        # Not satisfiable from availables; fall back to fullest-first order
-        # (kubelet will fail the admission itself).
-        return [c for c, _ in free]
-    if k == 1 and not pinned:
-        return [c for c, _ in free]
-    grid = chip_grid(
-        max(chips_per_host, max(by_chip) + 1, max(pinned, default=0) + 1)
-    )
-    if len(by_chip) > _EXACT_PACK_MAX_CHIPS:
-        return _greedy_chip_set(by_chip, need, grid, pinned)
-    best: Optional[tuple] = None
-    for combo in itertools.combinations(sorted(by_chip), k):
-        cap = sum(len(by_chip[c]) for c in combo)
-        if cap < need:
-            continue
-        pod_chips = set(combo) | pinned
-        span = sum(
-            ici_distance(grid[a], grid[b])
-            for a, b in itertools.combinations(sorted(pod_chips), 2)
-        )
-        key = (span, -cap, combo)
-        if best is None or key < best:
-            best = key
-    chosen = best[2] if best else tuple(c for c, _ in free[:k])
-    return sorted(chosen, key=lambda c: (-len(by_chip[c]), c))
-
-
-# Exhaustive ICI-span packing is exact up to this many candidate chips;
-# current TPU-VM hosts top out at 8 (v4/v5p host = 4 chips, v5e host = 8).
-_EXACT_PACK_MAX_CHIPS = 8
-
-
-def _greedy_chip_set(
-    by_chip: Dict[int, List[str]],
-    need: int,
-    grid: Dict[int, tuple],
-    pinned: set,
-) -> List[int]:
-    """Greedy fallback for hosts with more chips than the exact search
-    handles: seed with the pinned chips (else the fullest chip), then
-    repeatedly add the chip minimizing added ICI span (ties: most free
-    units) until the chosen set covers ``need``."""
-    chosen: List[int] = []
-    anchor = set(pinned)
-    remaining = dict(by_chip)
-    covered = 0
-    while covered < need and remaining:
-        best_key, best_chip = None, None
-        for c, ids in remaining.items():
-            span = sum(ici_distance(grid[c], grid[a]) for a in anchor)
-            key = (span, -len(ids), c)
-            if best_key is None or key < best_key:
-                best_key, best_chip = key, c
-        chosen.append(best_chip)
-        anchor.add(best_chip)
-        covered += len(remaining.pop(best_chip))
-    return chosen
+# The packing policy (minimal chip count, minimal ICI span, deterministic
+# tie-break) moved to the slice-orchestration layer — placement is a slice
+# concern shared with the registry/recovery machinery. These aliases keep
+# the historical seam for tests and external callers.
+_pick_chip_set = packing.pick_chip_set
+_greedy_chip_set = packing.greedy_chip_set
+_EXACT_PACK_MAX_CHIPS = packing.EXACT_PACK_MAX_CHIPS
 
 
 def _parse_chip_annotation(value: str) -> List[int]:
@@ -334,6 +259,7 @@ class _TPUSharePluginBase(_ListAndWatchMixin, rpc.DevicePluginServicer):
         self._alloc_dir = config.extra.get(
             "alloc_spec_dir", DEFAULT_ALLOC_SPEC_DIR
         )
+        self._slices = getattr(config, "slice_registry", None)
         self._inflight_lock = threading.Lock()
         self._binds_inflight = 0
         self._binds_total = 0
@@ -490,6 +416,11 @@ class _TPUSharePluginBase(_ListAndWatchMixin, rpc.DevicePluginServicer):
                         break
                 if need > 0 and unparseable:
                     chosen.extend(unparseable[:need])
+            self._note_packing(
+                (c for c in (chip_of_device_id(d) for d in chosen)
+                 if c is not None),
+                observe=False,  # proposal, not a bind
+            )
             responses.append(
                 dp.ContainerPreferredAllocationResponse(deviceIDs=chosen)
             )
@@ -599,6 +530,24 @@ class _TPUSharePluginBase(_ListAndWatchMixin, rpc.DevicePluginServicer):
             if c is not None
         })
 
+    def _note_packing(self, chip_indexes, observe: bool = True) -> None:
+        """Export the packing score (total ICI span of the chip set) —
+        per bind as the ``elastic_tpu_packing_ici_span`` histogram and as
+        a ``packing_span`` attribute on the active trace, so a scheduler
+        that spreads a grant across the mesh is a visible regression.
+        ``observe=False`` annotates the trace only: admission-time
+        proposals (GetPreferredAllocation) may never bind, and counting
+        them would double the per-BIND histogram."""
+        span = packing.packing_score(chip_indexes, len(self._chips))
+        get_tracer().annotate(packing_span=span)
+        if observe and self._metrics is not None and hasattr(
+            self._metrics, "packing_span"
+        ):
+            try:
+                self._metrics.packing_span.observe(span)
+            except Exception:  # noqa: BLE001 - metrics never break a bind
+                pass
+
     def _journal_intent(
         self, owner, device: Device, chip_indexes: List[int],
         planned: List[str],
@@ -618,6 +567,11 @@ class _TPUSharePluginBase(_ListAndWatchMixin, rpc.DevicePluginServicer):
 
     def _bind_located(self, device: Device, owner, pod: dict) -> None:
         annotations = pod.get("metadata", {}).get("annotations", {}) or {}
+        slice_id = annotations.get(AnnotationSliceID, "")
+        if slice_id:
+            # Slice-aware traces: /debug/traces and the fleet observatory
+            # can follow every member bind of one slice by this attribute.
+            get_tracer().annotate(slice=slice_id)
         # Crash-window failpoints (test-only): each names the window a
         # process death is injected into, proving the journal recovers it.
         faults.fire("bind.pre_journal")
@@ -629,6 +583,7 @@ class _TPUSharePluginBase(_ListAndWatchMixin, rpc.DevicePluginServicer):
             # physical /dev/accel* paths.
             chip_indexes = self._chips_from_ids(device)
             self._require_known_chips(chip_indexes)
+            self._note_packing(chip_indexes)
             intent_id = self._journal_intent(owner, device, chip_indexes, [])
             try:
                 faults.fire("bind.post_journal")
@@ -657,7 +612,15 @@ class _TPUSharePluginBase(_ListAndWatchMixin, rpc.DevicePluginServicer):
             raise LocateError(
                 f"pod {owner.pod_key} missing annotation {ann_key}"
             )
-        chip_indexes = _parse_chip_annotation(annotations[ann_key])
+        # Canonical device ordering (satellite of the slice orchestrator):
+        # the in-container numbering (TPU_VISIBLE_CHIPS position p ->
+        # /dev/accel<p>) follows the grid walk of the chip set, not the
+        # order the scheduler happened to write the annotation in — a
+        # reformed or replayed slice member gets identical device
+        # numbering every time.
+        chip_indexes = packing.canonical_chip_order(
+            _parse_chip_annotation(annotations[ann_key]), len(self._chips)
+        )
         expected = self._chips_for_request(len(device.ids))
         if len(chip_indexes) != expected:
             # Allocate guessed minimum packing (ceil(units/chip)); a
@@ -676,6 +639,7 @@ class _TPUSharePluginBase(_ListAndWatchMixin, rpc.DevicePluginServicer):
                 self.resource, device.hash, len(chip_indexes), expected,
             )
         self._require_known_chips(chip_indexes)
+        self._note_packing(chip_indexes)
 
         # Intent journaled before the first side effect; materialize
         # virtual nodes; roll back on partial failure (reference:
@@ -858,9 +822,26 @@ class _TPUSharePluginBase(_ListAndWatchMixin, rpc.DevicePluginServicer):
         }
         env.update(qos_env(annotations, pod_spec=pod, **self._qos_kwargs(device)))
         topo, worker_id, hostnames = self._host_slice_facts()
-        env.update(
-            slice_env_for_pod(annotations, topo, worker_id, hostnames)
-        )
+        if self._slices is not None:
+            # Registry-derived slice env: deterministic worker ordering,
+            # reform-aware world size, slice name + epoch (slices/).
+            slice_env = self._slices.pod_env(
+                annotations, topo, worker_id, hostnames
+            )
+            if slice_env.get(EnvSliceName):
+                try:
+                    wid = int(slice_env.get("TPU_WORKER_ID", "0"))
+                except ValueError:
+                    wid = 0
+                self._slices.record_local_pod(
+                    slice_env[EnvSliceName],
+                    f"{owner.namespace}/{owner.name}", wid,
+                )
+        else:
+            slice_env = slice_env_for_pod(
+                annotations, topo, worker_id, hostnames
+            )
+        env.update(slice_env)
         trace_id = get_tracer().current_id()
         if trace_id:
             # Propagated through the hook-authored env file so the
@@ -991,6 +972,45 @@ class _TPUSharePluginBase(_ListAndWatchMixin, rpc.DevicePluginServicer):
         except FileNotFoundError:
             pass
         self._restore_sibling_specs(owner, alloc_hash)
+
+    def read_alloc_spec(self, alloc_hash: str) -> Optional[Dict]:
+        """The on-disk alloc-spec payload for an allocation, or None
+        when absent/corrupt (slice-divergence detection reads the
+        stamped env through this)."""
+        try:
+            with open(
+                os.path.join(self._alloc_dir, f"{alloc_hash}.json")
+            ) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return None
+
+    def restamp_spec_env_locked(
+        self, owner, records: Dict, env_updates: Dict[str, str]
+    ) -> int:
+        """(owner's bind stripe held) Update env keys in EVERY on-disk
+        spec of this container — the merged env and the pre-merge ``own``
+        snapshot both, atomic per file — without re-running the bind.
+        The slice reformer re-emits topology env at a new world size
+        through this; devices/chips stay untouched, so the container's
+        cgroup reality is never contradicted. Returns files restamped."""
+        restamped = 0
+        for record in records.values():
+            path = os.path.join(
+                self._alloc_dir, f"{record.device.hash}.json"
+            )
+            try:
+                with open(path) as f:
+                    spec = json.load(f)
+            except (OSError, ValueError):
+                continue
+            spec.setdefault("env", {}).update(env_updates)
+            own = spec.get("own")
+            if isinstance(own, dict):
+                own.setdefault("env", {}).update(env_updates)
+            _write_json_atomic(path, spec)
+            restamped += 1
+        return restamped
 
     def alloc_spec_exists(self, alloc_hash: str) -> bool:
         """Whether the OCI-hook spec file for an allocation is on disk

@@ -72,6 +72,7 @@ KIND_INTENT_COMMITTED = "intent_committed"  # journal row outlived its commit
 KIND_INTENT_ROLLED_BACK = "intent_rolled_back"  # crashed mid-bind: undo
 KIND_REPLAYED_BIND = "replayed_bind"        # kubelet assignment, no record
 KIND_REBOUND_DRIFT = "rebound_drift"        # kubelet reassigned device ids
+KIND_SLICE_REFORMED = "slice_reformed"      # slice membership changed: re-form
 
 # The single source of truth for divergence classes: metric label ->
 # report counter key. _count(), _new_report() and run()'s repaired sum
@@ -86,6 +87,7 @@ KIND_REPORT_KEY = {
     KIND_INTENT_ROLLED_BACK: "intents_rolled_back",
     KIND_REPLAYED_BIND: "replayed_binds",
     KIND_REBOUND_DRIFT: "rebound_drift",
+    KIND_SLICE_REFORMED: "slice_reforms",
 }
 ALL_KINDS = tuple(KIND_REPORT_KEY)
 
@@ -100,6 +102,8 @@ def _new_report(boot: bool, dry_run: bool) -> dict:
         "corrupt_records": 0,
         "sweep_failures": 0,
         "replay_failures": 0,
+        "slice_check_errors": 0,  # membership unknowable this pass
+        "slice_reform_failures": 0,
         "divergences_observed": 0,  # dry-run: repairs that WOULD run
         "snapshot_error": None,
         "boot": boot,
@@ -131,6 +135,7 @@ class Reconciler:
         period_s: float = DEFAULT_PERIOD_S,
         dry_run: bool = False,
         rng=None,
+        slice_reformer=None,
     ) -> None:
         self._storage = storage
         self._operator = operator
@@ -143,11 +148,15 @@ class Reconciler:
         self._crd = crd_recorder
         self.period_s = period_s
         self.dry_run = dry_run
+        # SliceReformer (slices/recovery.py): slice membership is a
+        # divergence class — member loss re-forms the survivors.
+        self._slices = slice_reformer
         self._rng = rng if rng is not None else random.Random()
         self._lock = threading.Lock()
         self._repairs: Dict[str, int] = {k: 0 for k in ALL_KINDS}
         self._sweep_failures_total = 0
         self._replay_failures_total = 0
+        self._slice_reform_failures_total = 0
         self._runs_total = 0
         self._last_run_ts: Optional[float] = None
         self._last_duration_s: Optional[float] = None
@@ -286,6 +295,14 @@ class Reconciler:
                     "(%s); skipping kubelet-diff repairs", e,
                 )
 
+        if boot and self._slices is not None:
+            # BEFORE any repair that can rebind (intent replay, drift
+            # rebind): a cold registry's pod_env would restamp the stale
+            # annotation world at epoch 0 over a reformed spec. Feeding
+            # the stamped views in first re-arms the reform override and
+            # the epoch floor for every repair this pass runs.
+            with get_tracer().span("reconcile_slice_prelearn"):
+                self._prelearn_slices()
         with get_tracer().span("reconcile_intents"):
             self._resolve_intents(intents, report, boot, active)
         with get_tracer().span("reconcile_records"):
@@ -296,6 +313,9 @@ class Reconciler:
             )
         with get_tracer().span("reconcile_unbound"):
             self._replay_unbound(assignments, report, boot, active)
+        if self._slices is not None:
+            with get_tracer().span("reconcile_slices"):
+                self._reconcile_slices(report, boot, active)
         if boot and self._crd is not None:
             self._reconcile_crd()
 
@@ -337,6 +357,11 @@ class Reconciler:
             and report["corrupt_records"] == 0
             and report["divergences_observed"] == 0
             and report["pending_confirmation"] == 0
+            # slice membership unknowable (apiserver unanswerable) is the
+            # apiserver's analogue of snapshot_error: a lost member may
+            # be going undetected, so the node is NOT converged.
+            and report["slice_check_errors"] == 0
+            and report["slice_reform_failures"] == 0
         )
         wall_now = time.time() if now is None else now
         with self._lock:
@@ -833,6 +858,166 @@ class Reconciler:
         for key in [k for k in self._replay_backoff if k not in live_keys]:
             del self._replay_backoff[key]
 
+    # -- slice membership (slices/recovery.py) --------------------------------
+
+    def _prelearn_slices(self) -> None:
+        """Boot-only: re-learn every stamped slice world/epoch from the
+        on-disk specs before any repair runs. The registry is process
+        memory; a reboot must not let the first drift rebind of the
+        pass stamp annotation-world/epoch-0 over a reformed spec."""
+        for _key, info in list(self._storage.items()):
+            for by_resource in list(info.allocations.values()):
+                try:
+                    stamped = self._slices.stamped_view(by_resource)
+                except Exception:  # noqa: BLE001 - best-effort pre-learn
+                    continue
+                if stamped is not None:
+                    self._slices.observe(stamped)
+
+    def _reconcile_slices(
+        self, report: dict, boot: bool, active: bool
+    ) -> None:
+        """Slice membership as a divergence class: for every bound pod
+        carrying a slice identity, diff the hosts stamped into its
+        alloc-spec env against the shared apiserver's live membership.
+        A persistent mismatch (confirmed across two passes, like every
+        absence-based repair) re-forms the survivors: topology env
+        re-emitted at the new world size under the bind stripe, epoch
+        bumped, ``TPUSliceReformed`` emitted."""
+        from .common import AnnotationSliceID
+        from .slices.recovery import SliceMembershipError
+
+        seen_slices: set = set()
+        local_members: Dict[str, set] = {}  # slice -> pod keys seen bound
+        live_cache: Dict[str, set] = {}  # one apiserver view per pass
+        for key, info in list(self._storage.items()):
+            pod = self._sitter.get_pod(info.namespace, info.name)
+            ann = (
+                (pod or {}).get("metadata", {}).get("annotations", {}) or {}
+            )
+            slice_id = ann.get(AnnotationSliceID, "")
+            if slice_id:
+                seen_slices.add(slice_id)
+            for container, by_resource in list(info.allocations.items()):
+                if pod is not None and not slice_id:
+                    # The live pod visibly carries no slice annotation:
+                    # authoritative non-member, skip the spec reads (a
+                    # slice-free node must not pay per-pod JSON parses
+                    # every pass just to conclude "not a slice").
+                    continue
+                # The stamped spec is the durable membership record:
+                # collect + re-learn it even when the sitter momentarily
+                # cannot return the pod, so a watch blip never prunes a
+                # live slice's registry state (epoch included).
+                stamped = self._slices.stamped_view(by_resource)
+                if stamped is None:
+                    continue  # unstamped: nothing to diff or reform yet
+                seen_slices.add(stamped[0])
+                local_members.setdefault(stamped[0], set()).add(
+                    f"{info.namespace}/{info.name}"
+                )
+                self._slices.observe(stamped)
+                if pod is None:
+                    continue  # dead/unknown pods are the record walk's job
+                stamped_slice = stamped[0]
+                owner = PodContainer(info.namespace, info.name, container)
+                try:
+                    div = self._slices.divergence(
+                        owner, by_resource, live_hosts_cache=live_cache,
+                        stamped=stamped,
+                    )
+                except SliceMembershipError as e:
+                    # Membership UNKNOWABLE (apiserver down): never treat
+                    # it as loss. Reported, retried next pass.
+                    report["slice_check_errors"] += 1
+                    logger.warning(
+                        "reconcile: slice membership for %s unknowable: "
+                        "%s", stamped_slice, e,
+                    )
+                    continue
+                if div is None:
+                    continue
+                skey = (
+                    "slice", stamped_slice, owner.pod_key, container,
+                    tuple(div["new_hosts"]),
+                )
+                if not active:
+                    self._candidate(skey)
+                    report["divergences_observed"] += 1
+                    continue
+                if not boot and not self._confirmed(skey):
+                    # First sighting: a member mid-registration (or a
+                    # watch blip) must not trigger a spurious reform.
+                    continue
+                if not boot:
+                    # The confirming sighting must come from an
+                    # INDEPENDENT apiserver LIST: with a reconcile
+                    # period shorter than the membership TTL, both
+                    # passes would otherwise read the same cached
+                    # snapshot and "two sightings" would be one stale
+                    # observation wearing two hats.
+                    try:
+                        fresh = {
+                            stamped_slice:
+                                self._slices.registry.live_hosts(
+                                    stamped_slice, refresh=True
+                                ),
+                        }
+                    except SliceMembershipError as e:
+                        report["slice_check_errors"] += 1
+                        logger.warning(
+                            "reconcile: slice %s reform confirmation "
+                            "blocked, membership unknowable: %s",
+                            stamped_slice, e,
+                        )
+                        continue
+                    live_cache.update(fresh)
+                    div = self._slices.divergence(
+                        owner, by_resource, live_hosts_cache=fresh,
+                        stamped=stamped,
+                    )
+                    if div is None:
+                        continue  # healthy on the fresh view after all
+                    fresh_skey = (
+                        "slice", stamped_slice, owner.pod_key, container,
+                        tuple(div["new_hosts"]),
+                    )
+                    if fresh_skey != skey:
+                        # The world moved between sightings: restart
+                        # confirmation for the NEW shape.
+                        self._candidate(fresh_skey)
+                        continue
+                try:
+                    self._slices.reform(owner, by_resource, div)
+                    self._count(report, KIND_SLICE_REFORMED)
+                except Exception as e:  # noqa: BLE001 - retried next pass
+                    logger.warning(
+                        "reconcile: slice reform for %s (%s) failed: %s",
+                        owner.pod_key, stamped_slice, e,
+                    )
+                    # Counted under its OWN key: a failing reform must
+                    # point triage at the slice runbook, not at
+                    # replayed_bind's.
+                    report["slice_reform_failures"] += 1
+                    with self._lock:
+                        self._slice_reform_failures_total += 1
+        if active:
+            # Dry-run passes are observe-only: pruning mutates registry
+            # state (epoch, reform counts, member gauges).
+            registry = self._slices.registry
+            registry.prune(seen_slices)
+            # Per-POD housekeeping for slices that survive the prune: a
+            # reclaimed member pod must not stay listed as a live local
+            # member. Only dropped once its store record is gone —
+            # re-checked per pod so a bind landing mid-pass is kept.
+            for sid, st in registry.status().items():
+                for pod_key in list(st.get("local_pods", {})):
+                    if pod_key in local_members.get(sid, ()):
+                        continue
+                    ns, _, name = pod_key.partition("/")
+                    if self._storage.load(ns, name) is None:
+                        registry.drop_local_pod(sid, pod_key)
+
     # -- CRD inventory (boot only, as restore() always did) -------------------
 
     def _reconcile_crd(self) -> None:
@@ -926,6 +1111,9 @@ class Reconciler:
                 },
                 "sweep_failures_total": self._sweep_failures_total,
                 "replay_failures_total": self._replay_failures_total,
+                "slice_reform_failures_total": (
+                    self._slice_reform_failures_total
+                ),
                 "last_error": self._last_error,
                 "pending_confirmation": len(self._prev_candidates),
                 "open_intents": intents,

@@ -118,6 +118,11 @@ class UtilizationSampler:
         # Reconciler.status(); rides into /debug/allocations and the
         # doctor bundle so a stuck intent is diagnosable from either.
         self.reconcile_status_fn: Optional[Callable[[], dict]] = None
+        # Also manager-set: () -> slice-registry status (per-slice world,
+        # epoch, local member pods, reform count, validation verdicts)
+        # from SliceRegistry.status(); the `slices` block of
+        # /debug/allocations and the doctor bundle.
+        self.slice_status_fn: Optional[Callable[[], dict]] = None
         # Also manager-set: () -> set of unhealthy chip indexes, the
         # plugin's APPLIED health view. Snapshots must read this (a
         # plain set copy) instead of re-probing the operator:
@@ -596,6 +601,11 @@ class UtilizationSampler:
                 out["reconcile"] = self.reconcile_status_fn()
             except Exception:  # noqa: BLE001 - introspection only
                 pass
+        if self.slice_status_fn is not None:
+            try:
+                out["slices"] = self.slice_status_fn()
+            except Exception:  # noqa: BLE001 - introspection only
+                pass
         return out
 
 
@@ -793,6 +803,23 @@ def validate_bundle(bundle: dict) -> List[str]:
                 continue
             for field in ("pod", "granted_core_percent", "overcommit"):
                 expect(field in pod, f"allocations.pods[{i}] missing {field!r}")
+    if isinstance(allocations, dict) and "slices" in allocations:
+        # absent in pre-slice-orchestrator bundles and when no slice
+        # registry is attached (standalone node-doctor)
+        slices = allocations["slices"]
+        expect(isinstance(slices, dict), "allocations.slices must be an "
+                                         "object")
+        for name, sl in (
+            slices.items() if isinstance(slices, dict) else []
+        ):
+            if not isinstance(sl, dict):
+                problems.append(
+                    f"allocations.slices[{name!r}] must be an object"
+                )
+                continue
+            for field in ("hosts", "world_size", "epoch", "reforms_total"):
+                expect(field in sl,
+                       f"allocations.slices[{name!r}] missing {field!r}")
     windows = bundle.get("sampler_windows")
     expect(isinstance(windows, dict), "sampler_windows must be an object")
     if isinstance(windows, dict):

@@ -35,7 +35,12 @@ from typing import Dict, List, Optional
 
 from ..common import (
     AnnotationAssumed,
+    AnnotationSliceID,
+    AnnotationSliceName,
+    AnnotationSliceWorkerHosts,
+    AnnotationSliceWorkerID,
     AnnotationTraceID,
+    EnvSliceEpoch,
     ResourceTPUCore,
     container_annotation,
 )
@@ -78,6 +83,7 @@ class SimNode:
         self.manager: Optional[TPUManager] = None
         self.metrics = None
         self.metrics_url: str = ""
+        self.dead = False  # killed by a chaos scenario (kill_node)
 
     @property
     def storage(self):
@@ -117,6 +123,7 @@ class FleetSim:
         dp_pool_size: int = 4,
         enable_sampler: bool = False,
         core_units_per_pod: int = 10,
+        slice_membership_ttl_s: float = 1.0,
     ) -> None:
         self.base_dir = base_dir
         self.n_nodes = nodes
@@ -125,6 +132,9 @@ class FleetSim:
         self.dp_pool_size = dp_pool_size
         self.enable_sampler = enable_sampler
         self.core_units_per_pod = core_units_per_pod
+        # Short TTL: a chaos scenario expects reform within a few
+        # reconcile periods, not after a production-sized cache window.
+        self.slice_membership_ttl_s = slice_membership_ttl_s
         self.nodes: List[SimNode] = []
         self.apiserver = None
         self.api_url = ""
@@ -186,6 +196,7 @@ class FleetSim:
                 dp_pool_size=self.dp_pool_size,
                 enable_sampler=self.enable_sampler,
                 reconcile_period_s=self.reconcile_period_s,
+                slice_membership_ttl_s=self.slice_membership_ttl_s,
             )
             node.manager = TPUManager(opts)
             node.manager.run(block=False)
@@ -220,8 +231,34 @@ class FleetSim:
         self._started = False
 
     def targets(self) -> Dict[str, str]:
-        """node name -> metrics base URL (the aggregator's scrape list)."""
-        return {node.name: node.metrics_url for node in self.nodes}
+        """node name -> metrics base URL (the aggregator's scrape list);
+        killed nodes drop out, exactly as they would from a production
+        scrape discovery."""
+        return {
+            node.name: node.metrics_url
+            for node in self.nodes if not node.dead
+        }
+
+    # -- chaos: kill one agent ------------------------------------------------
+
+    def kill_node(self, idx: int) -> SimNode:
+        """Take one node down hard: agent stopped, kubelet gone, metrics
+        endpoint dark. The node's PODS stay at the apiserver until the
+        caller deletes them (in production that is the node controller's
+        eviction, not the dead agent's doing) — slice chaos scenarios
+        delete the member pod to model the eviction."""
+        node = self.nodes[idx]
+        node.dead = True
+        for closer in (
+            lambda: node.manager.stop(),
+            lambda: node.metrics.close(),
+            lambda: node.kubelet.stop(),
+        ):
+            try:
+                closer()
+            except Exception:  # noqa: BLE001 - a kill is best-effort
+                pass
+        return node
 
     # -- admission (the scheduler's half) -------------------------------------
 
@@ -380,4 +417,91 @@ class FleetSim:
     def stored_binds(self) -> Dict[str, int]:
         """Per-node checkpoint-store record counts (the 'every bind
         landed' ground truth the smoke asserts against)."""
-        return {node.name: node.storage.count() for node in self.nodes}
+        return {
+            node.name: node.storage.count()
+            for node in self.nodes if not node.dead
+        }
+
+    # -- multi-host slices (slices/) ------------------------------------------
+
+    def admit_slice(
+        self,
+        slice_id: str,
+        node_idxs: List[int],
+        accelerator_type: str = "v4-32",
+        namespace: str = "slice",
+    ) -> List[PodRef]:
+        """Admit one slice-member pod per named node, carrying the full
+        slice contract: identity, shape, index-ordered host list and
+        this member's worker id — what the elastic scheduler would
+        stamp."""
+        _, _, make_pod = _import_fakes()
+        hosts = ",".join(self.nodes[i].name for i in node_idxs)
+        refs: List[PodRef] = []
+        for w, i in enumerate(node_idxs):
+            node = self.nodes[i]
+            ref = PodRef(
+                i, namespace, f"m{w}-{slice_id}", 0, new_trace_id()
+            )
+            self.apiserver.upsert_pod(make_pod(
+                ref.namespace, ref.name, node.name,
+                annotations={
+                    AnnotationAssumed: "true",
+                    container_annotation("jax"): "0",
+                    AnnotationTraceID: ref.trace_id,
+                    AnnotationSliceID: slice_id,
+                    AnnotationSliceName: accelerator_type,
+                    AnnotationSliceWorkerID: str(w),
+                    AnnotationSliceWorkerHosts: hosts,
+                },
+                containers=[{"name": "jax"}],
+            ))
+            refs.append(ref)
+        return refs
+
+    def slice_env_of(self, ref: PodRef) -> Dict[str, str]:
+        """The env stamped into ``ref``'s on-disk alloc spec (empty when
+        unbound) — the ground truth slice assertions read."""
+        node = self.nodes[ref.node_idx]
+        info = node.storage.load(ref.namespace, ref.name)
+        if info is None:
+            return {}
+        core = node.manager.plugin.core
+        for by_resource in info.allocations.values():
+            for rec in by_resource.values():
+                spec = core.read_alloc_spec(rec.device.hash)
+                if spec and spec.get("env"):
+                    return dict(spec["env"])
+        return {}
+
+    def wait_slice_reformed(
+        self,
+        refs: List[PodRef],
+        expected_hosts: List[str],
+        expected_epoch: int,
+        timeout_s: float = 60.0,
+    ) -> float:
+        """Block until every surviving member's stamped env shows the
+        expected host list AND epoch; returns the wait in seconds."""
+        want_hosts = ",".join(expected_hosts)
+        want_epoch = str(expected_epoch)
+        t0 = time.monotonic()
+        deadline = t0 + timeout_s
+        for ref in refs:
+            if self.nodes[ref.node_idx].dead:
+                continue
+            while True:
+                env = self.slice_env_of(ref)
+                if (
+                    env.get("TPU_WORKER_HOSTNAMES") == want_hosts
+                    and env.get(EnvSliceEpoch) == want_epoch
+                ):
+                    break
+                if time.monotonic() > deadline:
+                    raise RuntimeError(
+                        f"{ref.pod_key} never re-formed to "
+                        f"[{want_hosts}] epoch {want_epoch}; env now: "
+                        f"{ {k: v for k, v in env.items() if k.startswith(('TPU_', 'ELASTIC_TPU_SLICE'))} }"
+                    )
+                time.sleep(0.02)
+        return time.monotonic() - t0

@@ -28,6 +28,38 @@ from .common import (
 from .tpu.topology import TopologyInfo, host_bounds, parse_accelerator_type
 
 
+def split_hosts(value: str) -> List[str]:
+    """The ONE host-list grammar: comma-separated hostnames, empty
+    entries dropped. The annotation parse (registry), the PreStart
+    stamp parse and the stamped-spec parse (recovery) all read it —
+    they must never disagree about the same list."""
+    return [h for h in (value or "").split(",") if h]
+
+
+def ordered_worker_hostnames(
+    hostnames: List[str], own_host: str = ""
+) -> "tuple[List[str], int]":
+    """Deterministic worker ordering for annotation-driven slices:
+    hostnames de-duplicated and sorted lexicographically, plus the
+    worker index of ``own_host`` in that order (-1 when absent).
+
+    Every cooperating agent derives the slice env independently from the
+    shared apiserver state (SURVEY.md §7: no agent-to-agent
+    coordination), so the ordering must be a pure function of the host
+    SET — any dependence on annotation write order or map iteration
+    order would let two hosts disagree about who is worker 0 and the
+    ``jax.distributed`` rendezvous would deadlock. The slices property
+    test pins this: every permutation of the input yields the identical
+    ordering and bounds.
+    """
+    ordered = sorted(set(h for h in hostnames if h))
+    try:
+        own_index = ordered.index(own_host)
+    except ValueError:
+        own_index = -1
+    return ordered, own_index
+
+
 def slice_env_from_topology(
     topo: TopologyInfo,
     worker_id: int,

@@ -1,0 +1,26 @@
+"""Slice orchestration: topology-aware packing, slice membership, and
+elastic multi-host recovery (ROADMAP item 4).
+
+- :mod:`packing` — ICI-span scoring, canonical chip ordering, and the
+  chip-set picker behind GetPreferredAllocation and the scheduler-spread
+  bind path.
+- :mod:`registry` — SliceRegistry: membership from pod annotations plus
+  the shared apiserver state, deterministic worker ordering, PreStart
+  env stamping with slice name and reform epoch.
+- :mod:`recovery` — SliceReformer: the reconciler's repair executor for
+  slice-membership divergence (member loss -> re-formed survivors).
+"""
+
+from .packing import canonical_chip_order, packing_score, pick_chip_set
+from .recovery import SliceReformer
+from .registry import SliceMembershipError, SliceRegistry, member_from_pod
+
+__all__ = [
+    "SliceMembershipError",
+    "SliceReformer",
+    "SliceRegistry",
+    "canonical_chip_order",
+    "member_from_pod",
+    "packing_score",
+    "pick_chip_set",
+]

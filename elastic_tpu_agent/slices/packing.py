@@ -1,0 +1,155 @@
+"""Topology-aware chip packing: ICI-span scoring and canonical ordering.
+
+The packing policy the device-plugin layer applies (GetPreferredAllocation
+picks chip sets, the scheduler-spread bind path scores what the external
+scheduler chose) lives here, one floor below the plugins: placement is a
+*slice* concern — the same scoring that keeps a fractional grant on one
+chip keeps a multi-chip grant on an adjacent sub-grid, and the same
+canonical ordering that numbers a fresh bind's devices numbers a reformed
+slice's. Arax (PAPERS.md) argues the runtime, not the workload, should
+own this accelerator mapping; this module is that ownership made
+explicit.
+
+Scoring model: chips on one host form the x,y ICI grid of
+``tpu.topology.chip_grid``; the cost of a chip set is the total pairwise
+Manhattan hop count (``ici_distance``) over it — the metric intra-pod
+collectives actually pay. Ties break deterministically (most free
+capacity, then lowest chip indexes) so two agents given the same state
+pick the same set.
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Dict, Iterable, List, Optional, Tuple
+
+from ..tpu.topology import chip_grid, ici_distance
+
+# Exhaustive ICI-span packing is exact up to this many candidate chips;
+# current TPU-VM hosts top out at 8 (v4/v5p host = 4 chips, v5e host = 8).
+EXACT_PACK_MAX_CHIPS = 8
+
+
+def packing_score(
+    chip_indexes: Iterable[int], chips_per_host: int
+) -> int:
+    """Total pairwise ICI hop count over a chip set (0 for <= 1 chip).
+
+    The packing-score metric: a 2-chip set on adjacent grid slots scores
+    1; the same request scattered to opposite corners of a 4-chip host
+    scores 2 per pair. Exported per bind as
+    ``elastic_tpu_packing_ici_span`` and attached to bind traces, so a
+    scheduler that spreads grants across the mesh is visible as a score
+    regression, not a vague slowdown.
+    """
+    chips = sorted(set(chip_indexes))
+    if len(chips) <= 1:
+        return 0
+    grid = chip_grid(max(chips_per_host, max(chips) + 1))
+    return sum(
+        ici_distance(grid[a], grid[b])
+        for a, b in itertools.combinations(chips, 2)
+    )
+
+
+def canonical_chip_order(
+    chip_indexes: Iterable[int], chips_per_host: Optional[int] = None
+) -> List[int]:
+    """Deterministic device ordering: sorted by grid coordinate (row,
+    then column), duplicates dropped.
+
+    The container-visible device numbering (``TPU_VISIBLE_CHIPS`` and the
+    dense ``/dev/accel<p>`` renumbering) is position-ordered over this
+    list, so the same physical chip set always yields the same in-pod
+    device numbering — a reformed slice restarts with stable device ids
+    no matter what order the scheduler annotation (or a replay) listed
+    the chips in. For the row-major host grids ``chip_grid`` emits this
+    coincides with ascending chip index, but the contract is the grid
+    walk, not the integer sort.
+    """
+    chips = sorted(set(chip_indexes))
+    if not chips:
+        return []
+    grid = chip_grid(max(chips_per_host or 0, chips[-1] + 1))
+    return sorted(chips, key=lambda c: (grid[c][1], grid[c][0], c))
+
+
+def pick_chip_set(
+    by_chip: Dict[int, List[str]],
+    need: int,
+    chips_per_host: int,
+    pinned: Optional[set] = None,
+) -> List[int]:
+    """Order of chips to draw fake ids from for a request of ``need`` units.
+
+    Picks the minimal number of chips whose free units cover ``need``, and
+    among minimal sets the one with the smallest total pairwise ICI hop
+    distance over the chosen chips *plus* any ``pinned`` chips the request's
+    must-include ids already sit on (then most free capacity, then lowest
+    indexes — fully deterministic). Up to EXACT_PACK_MAX_CHIPS candidate
+    chips the subset search is exhaustive and exact (<= C(8,k)); beyond
+    that (future larger hosts) a greedy nearest-chip build keeps the cost
+    O(n^2 * k) at the price of exactness.
+    """
+    pinned = pinned or set()
+    free = sorted(by_chip.items(), key=lambda kv: (-len(kv[1]), kv[0]))
+    # minimal chip count k: fullest-first prefix covering `need`
+    total, k = 0, 0
+    for _, ids in free:
+        total += len(ids)
+        k += 1
+        if total >= need:
+            break
+    if total < need:
+        # Not satisfiable from availables; fall back to fullest-first order
+        # (kubelet will fail the admission itself).
+        return [c for c, _ in free]
+    if k == 1 and not pinned:
+        return [c for c, _ in free]
+    grid = chip_grid(
+        max(chips_per_host, max(by_chip) + 1, max(pinned, default=0) + 1)
+    )
+    if len(by_chip) > EXACT_PACK_MAX_CHIPS:
+        return greedy_chip_set(by_chip, need, grid, pinned)
+    best: Optional[tuple] = None
+    for combo in itertools.combinations(sorted(by_chip), k):
+        cap = sum(len(by_chip[c]) for c in combo)
+        if cap < need:
+            continue
+        pod_chips = set(combo) | pinned
+        span = sum(
+            ici_distance(grid[a], grid[b])
+            for a, b in itertools.combinations(sorted(pod_chips), 2)
+        )
+        key = (span, -cap, combo)
+        if best is None or key < best:
+            best = key
+    chosen = best[2] if best else tuple(c for c, _ in free[:k])
+    return sorted(chosen, key=lambda c: (-len(by_chip[c]), c))
+
+
+def greedy_chip_set(
+    by_chip: Dict[int, List[str]],
+    need: int,
+    grid: Dict[int, Tuple[int, int]],
+    pinned: set,
+) -> List[int]:
+    """Greedy fallback for hosts with more chips than the exact search
+    handles: seed with the pinned chips (else the fullest chip), then
+    repeatedly add the chip minimizing added ICI span (ties: most free
+    units, then lowest index) until the chosen set covers ``need``."""
+    chosen: List[int] = []
+    anchor = set(pinned)
+    remaining = dict(by_chip)
+    covered = 0
+    while covered < need and remaining:
+        best_key, best_chip = None, None
+        for c, ids in remaining.items():
+            span = sum(ici_distance(grid[c], grid[a]) for a in anchor)
+            key = (span, -len(ids), c)
+            if best_key is None or key < best_key:
+                best_key, best_chip = key, c
+        chosen.append(best_chip)
+        anchor.add(best_chip)
+        covered += len(remaining.pop(best_chip))
+    return chosen

@@ -103,6 +103,27 @@ def parse_accelerator_type(acc_type: str) -> Optional[TopologyInfo]:
     )
 
 
+def topology_for_hosts(topo: TopologyInfo, num_hosts: int) -> TopologyInfo:
+    """``topo`` resized to ``num_hosts`` hosts (chips-per-host kept).
+
+    The elastic-recovery shape: a slice annotated ``v4-32`` (4 hosts)
+    that loses a member re-forms as the same generation and per-host
+    chip grid at world size 3 — the accelerator-type string is kept
+    verbatim so the workload can still see what it was scheduled as,
+    while the host-count-derived env (``TPU_HOST_BOUNDS``) follows the
+    surviving world.
+    """
+    n = max(1, num_hosts)
+    return TopologyInfo(
+        accelerator_type=topo.accelerator_type,
+        spec=topo.spec,
+        total_chips=topo.chips_per_host * n,
+        total_cores=topo.chips_per_host * n * topo.spec.cores_per_chip,
+        chips_per_host=topo.chips_per_host,
+        num_hosts=n,
+    )
+
+
 def spec_for_family(family: str) -> Optional[ChipSpec]:
     key = _FAMILY_ALIASES.get(family.lower())
     return _SPECS.get(key) if key else None

@@ -1,0 +1,958 @@
+"""Slice orchestrator tests (slices/): topology-aware packing, the
+SliceRegistry's coordination-free membership model, and elastic reform.
+
+Three layers, cheapest first:
+
+- pure functions: packing scores, canonical chip ordering, and the
+  property test pinning that slice identity env is a function of the
+  host SET — never of annotation write order or map iteration order
+  (two agents disagreeing about who is worker 0 deadlocks the
+  ``jax.distributed`` rendezvous).
+- registry units against a fake apiserver client: membership parsing,
+  TTL caching, UNKNOWN-vs-empty semantics, formation validation,
+  reform epoch bookkeeping.
+- the real bind path (fake kubelet over gRPC, stub operator): canonical
+  TPU_VISIBLE_CHIPS/device numbering, registry-stamped slice env, and a
+  SliceReformer detect->repair pass over genuinely bound alloc specs.
+
+The full multi-agent kill-a-member chaos gate is `make slice-smoke`
+(bench.py --slice-smoke); these stay in the fast tier.
+"""
+
+import itertools
+import json
+import os
+import random
+import threading
+
+import pytest
+
+from elastic_tpu_agent import rpc
+from elastic_tpu_agent.common import (
+    AnnotationAssumed,
+    AnnotationSliceID,
+    AnnotationSliceName,
+    AnnotationSliceWorkerHosts,
+    AnnotationSliceWorkerID,
+    EnvSliceEpoch,
+    EnvSliceName,
+    ResourceTPUCore,
+    container_annotation,
+)
+from elastic_tpu_agent.kube.locator import KubeletDeviceLocator
+from elastic_tpu_agent.plugins.base import PluginConfig
+from elastic_tpu_agent.plugins.tpushare import (
+    CORE_ENDPOINT,
+    TPUSharePlugin,
+    core_device_id,
+)
+from elastic_tpu_agent.slice_env import (
+    ordered_worker_hostnames,
+    slice_env_from_topology,
+)
+from elastic_tpu_agent.slices import (
+    SliceMembershipError,
+    SliceReformer,
+    SliceRegistry,
+    member_from_pod,
+    packing,
+)
+from elastic_tpu_agent.storage import Storage
+from elastic_tpu_agent.tpu import StubOperator
+from elastic_tpu_agent.tpu.topology import (
+    parse_accelerator_type,
+    topology_for_hosts,
+)
+from elastic_tpu_agent.types import PodContainer
+
+from fake_kubelet import FakeKubelet, FakeSitter
+
+
+# -- packing: ICI-span scoring + canonical ordering ---------------------------
+
+
+def test_packing_score_is_total_pairwise_ici_span():
+    # v4-style host: 4 chips in a 2x2 grid (0,1 top row; 2,3 bottom).
+    assert packing.packing_score([0], 4) == 0
+    assert packing.packing_score([], 4) == 0
+    assert packing.packing_score([0, 1], 4) == 1  # adjacent pair
+    assert packing.packing_score([0, 3], 4) == 2  # diagonal
+    # all four: 4 edges of span 1 + 2 diagonals of span 2
+    assert packing.packing_score([0, 1, 2, 3], 4) == 8
+
+
+def test_canonical_chip_order_is_grid_walk_and_dedupes():
+    assert packing.canonical_chip_order([3, 1, 3, 0], 4) == [0, 1, 3]
+    assert packing.canonical_chip_order([], 4) == []
+    # every permutation of a chip set yields the identical ordering
+    for perm in itertools.permutations([2, 0, 3, 1]):
+        assert packing.canonical_chip_order(list(perm), 4) == [0, 1, 2, 3]
+
+
+def test_pick_chip_set_prefers_adjacent_subgrid():
+    # two free units needed; chips 0 and 1 are adjacent, 0 and 3 are not
+    by_chip = {0: ["a"], 1: ["b"], 3: ["c"]}
+    assert packing.pick_chip_set(by_chip, 2, 4) == [0, 1]
+    # pinned chip pulls the choice toward its neighborhood: 3's neighbors
+    # are 1 (span 1) and 2 — chip 0 is the diagonal
+    assert packing.pick_chip_set({0: ["a"], 1: ["b"]}, 1, 4,
+                                 pinned={3}) == [1]
+
+
+def test_pick_chip_set_deterministic_under_dict_order():
+    items = [(0, ["a"]), (1, ["b"]), (2, ["c"]), (3, ["d"])]
+    want = packing.pick_chip_set(dict(items), 2, 4)
+    for perm in itertools.permutations(items):
+        assert packing.pick_chip_set(dict(perm), 2, 4) == want
+
+
+def test_greedy_chip_set_covers_need_from_pinned_anchor():
+    # force the greedy path (more chips than the exact search handles)
+    by_chip = {c: ["u"] for c in range(packing.EXACT_PACK_MAX_CHIPS + 2)}
+    grid_n = packing.EXACT_PACK_MAX_CHIPS + 2
+    from elastic_tpu_agent.tpu.topology import chip_grid
+
+    chosen = packing.greedy_chip_set(by_chip, 3, chip_grid(grid_n), set())
+    assert len(chosen) == 3
+    assert len(set(chosen)) == 3
+
+
+# -- satellite: slice env is a pure function of the host SET ------------------
+
+
+def test_ordered_worker_hostnames_permutation_invariant():
+    rng = random.Random(7)
+    for trial in range(20):
+        n = rng.randint(2, 5)
+        hosts = [f"host-{rng.randrange(1000)}-{i}" for i in range(n)]
+        canonical, _ = ordered_worker_hostnames(hosts, hosts[0])
+        orderings = set()
+        for _ in range(10):
+            shuffled = list(hosts)
+            rng.shuffle(shuffled)
+            ordered, own = ordered_worker_hostnames(shuffled, hosts[0])
+            orderings.add(tuple(ordered))
+            assert ordered[own] == hosts[0]
+        assert orderings == {tuple(canonical)}
+        # duplicates collapse; an absent host indexes -1
+        dup, own = ordered_worker_hostnames(hosts + hosts, hosts[-1])
+        assert dup == canonical and dup[own] == hosts[-1]
+        assert ordered_worker_hostnames(hosts, "nope")[1] == -1
+
+
+def test_slice_env_identical_across_member_derivations():
+    """The formation property the smoke relies on: every member derives
+    the identity env independently (its own registry instance, its own
+    shuffled annotation order) and they all agree — same
+    TPU_WORKER_HOSTNAMES string, same bounds, worker ids exactly
+    0..N-1."""
+    rng = random.Random(11)
+    hosts = [f"tpu-host-{c}" for c in "dacb"]
+    topo = parse_accelerator_type("v4-32")
+    envs = []
+    for own in hosts:
+        shuffled = list(hosts)
+        rng.shuffle(shuffled)
+        registry = SliceRegistry(node_name=own)  # no client: UNKNOWN ok
+        env = registry.pod_env(
+            {
+                AnnotationSliceID: "job-1",
+                AnnotationSliceName: "v4-32",
+                AnnotationSliceWorkerID: str(shuffled.index(own)),
+                AnnotationSliceWorkerHosts: ",".join(shuffled),
+            },
+            topo,
+        )
+        envs.append(env)
+    for key in ("TPU_WORKER_HOSTNAMES", "TPU_ACCELERATOR_TYPE",
+                "TPU_CHIPS_PER_HOST_BOUNDS", "TPU_HOST_BOUNDS",
+                EnvSliceName, EnvSliceEpoch):
+        assert len({e[key] for e in envs}) == 1, key
+    assert envs[0]["TPU_WORKER_HOSTNAMES"] == ",".join(sorted(hosts))
+    assert sorted(e["TPU_WORKER_ID"] for e in envs) == ["0", "1", "2", "3"]
+    assert envs[0][EnvSliceName] == "job-1"
+    assert envs[0][EnvSliceEpoch] == "0"
+
+
+def test_topology_for_hosts_resizes_world_keeps_shape():
+    topo = parse_accelerator_type("v4-32")
+    resized = topology_for_hosts(topo, 3)
+    assert resized.num_hosts == 3
+    assert resized.chips_per_host == topo.chips_per_host
+    assert resized.total_chips == 12
+    assert resized.accelerator_type == "v4-32"  # scheduled-as, kept
+    env4 = slice_env_from_topology(topo, 0, ["a", "b", "c", "d"])
+    env3 = slice_env_from_topology(resized, 0, ["a", "b", "c"])
+    assert env4["TPU_HOST_BOUNDS"] != env3["TPU_HOST_BOUNDS"]
+    assert (env4["TPU_CHIPS_PER_HOST_BOUNDS"]
+            == env3["TPU_CHIPS_PER_HOST_BOUNDS"])
+
+
+# -- registry: membership, validation, epochs ---------------------------------
+
+
+def make_member_pod(slice_id, name, node, host, wid, hosts,
+                    deleted=False):
+    meta = {
+        "namespace": "ml",
+        "name": name,
+        "annotations": {
+            AnnotationSliceID: slice_id,
+            AnnotationSliceWorkerID: str(wid),
+            AnnotationSliceWorkerHosts: ",".join(hosts),
+        },
+    }
+    if deleted:
+        meta["deletionTimestamp"] = "2026-01-01T00:00:00Z"
+    return {"metadata": meta, "spec": {"nodeName": node}}
+
+
+class FakeKube:
+    """list_all_pods stand-in: a mutable pod list + a call counter."""
+
+    def __init__(self, pods=None):
+        self.pods = list(pods or [])
+        self.calls = 0
+        self.fail = False
+
+    def list_all_pods(self):
+        self.calls += 1
+        if self.fail:
+            raise RuntimeError("apiserver down")
+        return [json.loads(json.dumps(p)) for p in self.pods]
+
+
+def test_member_from_pod_parses_and_normalizes():
+    pod = make_member_pod("s", "m1", "n1", "host-b", 0,
+                          ["host-b", "host-a"])
+    m = member_from_pod(pod)
+    assert m is not None
+    assert m.pod_key == "ml/m1"
+    assert m.hosts == ("host-a", "host-b")  # normalized ordering
+    assert m.worker_id == 1  # host-b's index in the normalized order
+    assert member_from_pod({"metadata": {}}) is None
+    # out-of-range worker id: not a usable claim
+    bad = make_member_pod("s", "m2", "n", "x", 5, ["x", "y"])
+    assert member_from_pod(bad) is None
+
+
+def test_live_members_filters_ttl_caches_and_surfaces_unknown():
+    hosts = ["host-a", "host-b"]
+    kube = FakeKube([
+        make_member_pod("s1", "m0", "na", "host-a", 0, hosts),
+        make_member_pod("s1", "m1", "nb", "host-b", 1, hosts),
+        make_member_pod("s1", "gone", "nc", "host-b", 1, hosts,
+                        deleted=True),
+        make_member_pod("other", "x", "nd", "host-a", 0, hosts),
+    ])
+    reg = SliceRegistry(kube_client=kube, membership_ttl_s=60.0)
+    members = reg.live_members("s1")
+    assert [m.pod_key for m in members] == ["ml/m0", "ml/m1"]
+    assert reg.live_hosts("s1") == {"host-a", "host-b"}
+    # TTL cache: no second apiserver hit within the window...
+    reg.live_members("s1")
+    assert kube.calls == 1
+    # ...refresh forces one
+    reg.live_members("s1", refresh=True)
+    assert kube.calls == 2
+    # an apiserver failure is UNKNOWN, never an empty slice — and it is
+    # not cached (recovery is visible immediately)
+    kube.fail = True
+    with pytest.raises(SliceMembershipError):
+        reg.live_members("s1", refresh=True)
+    kube.fail = False
+    assert reg.live_hosts("s1", refresh=True) == {"host-a", "host-b"}
+    # no client at all: membership is unknowable
+    with pytest.raises(SliceMembershipError):
+        SliceRegistry().live_members("s1")
+
+
+def test_validate_members_flags_divergent_formations():
+    hosts = ["host-a", "host-b"]
+    kube = FakeKube([
+        make_member_pod("s1", "m0", "na", "host-a", 0, hosts),
+        # m1 believes in a DIFFERENT host set (a torn annotation write)
+        make_member_pod("s1", "m1", "nb", "host-b", 1,
+                        ["host-b", "host-z"]),
+    ])
+    reg = SliceRegistry(kube_client=kube, membership_ttl_s=0.0)
+    problems = reg.validate_members("s1", ("host-a", "host-b"))
+    assert problems and "ml/m1" in problems[0]
+    # consistent formation: clean verdict
+    kube.pods[1] = make_member_pod("s1", "m1", "nb", "host-b", 1, hosts)
+    assert reg.validate_members("s1", ("host-a", "host-b")) == []
+    # duplicate worker id across two hosts
+    kube.pods[1] = make_member_pod("s1", "m1", "nb", "host-b", 0,
+                                   ["host-b"])
+    problems = reg.validate_members("s1", ("host-a", "host-b"))
+    assert any("claimed by both" in p for p in problems)
+
+
+def test_note_reform_epochs_are_idempotent_per_world():
+    reg = SliceRegistry()
+    assert reg.epoch("s") == 0
+    assert reg.note_reform("s", ("a", "b", "c")) == 1
+    # second member container on this node, same world: SAME epoch
+    assert reg.note_reform("s", ("a", "b", "c")) == 1
+    # a further loss advances it
+    assert reg.note_reform("s", ("a", "b")) == 2
+    assert reg.current_hosts("s") == ("a", "b")
+    st = reg.status()["s"]
+    assert st["epoch"] == 2 and st["reforms_total"] == 2
+    assert st["world_size"] == 2
+    # prune forgets slices with no local members left
+    reg.prune(set())
+    assert reg.status() == {}
+
+
+def test_pod_env_survives_prune_race_during_validation():
+    """A reconciler prune landing while pod_env validates membership
+    OUTSIDE the registry lock (first bind of a slice: the pod's record
+    is not in the store yet, so the slice looks inactive) must not
+    KeyError the bind — the state is re-created, not resurrected with a
+    stale epoch (formation-time epoch is 0 either way)."""
+    topo = parse_accelerator_type("v4-32")
+    ann = {
+        AnnotationSliceID: "job",
+        AnnotationSliceName: "v4-32",
+        AnnotationSliceWorkerID: "0",
+        AnnotationSliceWorkerHosts: "host-a,host-b,host-c,host-d",
+    }
+    reg = SliceRegistry(node_name="host-a")
+    orig_validate = reg.validate_members
+
+    def racing_validate(slice_id, hosts):
+        reg.prune(set())  # the reconciler saw no store record for it yet
+        return orig_validate(slice_id, hosts)
+
+    reg.validate_members = racing_validate
+    env = reg.pod_env(ann, topo)
+    assert env[EnvSliceName] == "job"
+    assert env["TPU_WORKER_HOSTNAMES"] == "host-a,host-b,host-c,host-d"
+    st = reg.status()["job"]
+    assert st["hosts"] == ["host-a", "host-b", "host-c", "host-d"]
+    assert st["epoch"] == 0
+
+
+def test_pod_env_reform_override_wins_over_stale_annotation():
+    """A drift rebind AFTER a reform must stamp the reformed world, not
+    silently resurrect the annotation's dead member."""
+    topo = parse_accelerator_type("v4-32")
+    hosts = ["host-a", "host-b", "host-c", "host-d"]
+    ann = {
+        AnnotationSliceID: "job",
+        AnnotationSliceName: "v4-32",
+        AnnotationSliceWorkerID: "0",
+        AnnotationSliceWorkerHosts: ",".join(hosts),
+    }
+    reg = SliceRegistry(node_name="host-a")
+    env0 = reg.pod_env(ann, topo)
+    assert env0["TPU_WORKER_HOSTNAMES"] == ",".join(hosts)
+    reg.note_reform("job", ("host-a", "host-b", "host-c"))
+    env1 = reg.pod_env(ann, topo)  # same stale annotations
+    assert env1["TPU_WORKER_HOSTNAMES"] == "host-a,host-b,host-c"
+    assert env1[EnvSliceEpoch] == "1"
+    assert env1["TPU_WORKER_ID"] == "0"
+
+
+# -- the real bind path: canonical numbering + registry stamping --------------
+
+
+@pytest.fixture()
+def slice_harness(tmp_path):
+    """test_plugins-style rig plus a SliceRegistry wired into the
+    plugin config (fake apiserver client owned by the test)."""
+    dp_dir = str(tmp_path / "dp")
+    pr_sock = str(tmp_path / "pr" / "kubelet.sock")
+    dev_root = str(tmp_path / "dev")
+    os.makedirs(dev_root)
+    kubelet = FakeKubelet(dp_dir, pr_sock)
+    kubelet.start()
+    sitter = FakeSitter()
+    storage = Storage(str(tmp_path / "meta.db"))
+    operator = StubOperator(dev_root, "v5litepod-4", hostname="host-a")
+    pr_client = rpc.PodResourcesClient(pr_sock)
+    kube = FakeKube()
+    registry = SliceRegistry(
+        node_name="host-a", kube_client=kube, membership_ttl_s=0.0
+    )
+    config = PluginConfig(
+        node_name="test-node",
+        device_plugin_dir=dp_dir,
+        pod_resources_socket=pr_sock,
+        operator=operator,
+        sitter=sitter,
+        storage=storage,
+        locator_factory=lambda res: KubeletDeviceLocator(res, pr_client),
+        slice_registry=registry,
+        extra={"alloc_spec_dir": str(tmp_path / "alloc")},
+    )
+    plugin = TPUSharePlugin(config)
+    stop = threading.Event()
+    plugin.run(stop)
+    assert kubelet.wait_registrations(2), "plugins failed to register"
+
+    class H:
+        pass
+
+    h = H()
+    h.kubelet, h.sitter, h.storage = kubelet, sitter, storage
+    h.plugin, h.registry, h.kube = plugin, registry, kube
+    h.alloc_dir = str(tmp_path / "alloc")
+    yield h
+    stop.set()
+    plugin.core.stop_streams()
+    plugin.memory.stop_streams()
+    kubelet.stop()
+    storage.close()
+
+
+def bind_pod(h, name, chips, extra_annotations=None, namespace="ml"):
+    """Drive the kubelet's Allocate/assign/PreStart flow for one pod and
+    return its on-disk alloc spec."""
+    ann = {
+        AnnotationAssumed: "true",
+        container_annotation("jax"): chips,
+    }
+    ann.update(extra_annotations or {})
+    h.sitter.add_pod(namespace, name, annotations=ann)
+    ids = [
+        core_device_id(int(c), u)
+        for c in chips.split(",") for u in range(100)
+    ]
+    h.kubelet.kubelet_allocate_flow(
+        CORE_ENDPOINT, namespace, name, "jax", ResourceTPUCore, ids
+    )
+    from elastic_tpu_agent.types import Device
+
+    dev_hash = Device(ids, ResourceTPUCore).hash
+    with open(os.path.join(h.alloc_dir, f"{dev_hash}.json")) as f:
+        return json.load(f)
+
+
+def test_visible_chip_numbering_ignores_annotation_order(slice_harness):
+    """Satellite: TPU_VISIBLE_CHIPS position p maps to the p-th chip of
+    the CANONICAL (grid-sorted) order, however the scheduler wrote the
+    annotation — a reformed/replayed member gets identical device
+    numbering every time."""
+    spec_a = bind_pod(slice_harness, "fwd", "1,3")
+    spec_b = bind_pod(slice_harness, "rev", "3,1")
+    assert spec_a["chip_indexes"] == [1, 3]
+    assert spec_b["chip_indexes"] == [1, 3]
+    assert spec_a["env"]["TPU_VISIBLE_CHIPS"] == "0,1"
+    assert (spec_a["device_paths"] == spec_b["device_paths"]
+            != sorted(spec_a["device_paths"], reverse=True))
+
+
+def slice_annotations(slice_id, wid, hosts, accel="v4-16"):
+    return {
+        AnnotationSliceID: slice_id,
+        AnnotationSliceName: accel,
+        AnnotationSliceWorkerID: str(wid),
+        AnnotationSliceWorkerHosts: ",".join(hosts),
+    }
+
+
+def test_prestart_stamps_registry_slice_env(slice_harness):
+    h = slice_harness
+    hosts = ["host-a", "host-b"]
+    h.kube.pods = [
+        make_member_pod("job", "m0", "n0", "host-a", 0, hosts),
+        make_member_pod("job", "m1", "n1", "host-b", 1, hosts),
+    ]
+    spec = bind_pod(
+        h, "m0", "0,1",
+        extra_annotations=slice_annotations("job", 0, hosts),
+    )
+    env = spec["env"]
+    assert env[EnvSliceName] == "job"
+    assert env[EnvSliceEpoch] == "0"
+    assert env["TPU_WORKER_ID"] == "0"
+    assert env["TPU_WORKER_HOSTNAMES"] == "host-a,host-b"
+    assert env["TPU_ACCELERATOR_TYPE"] == "v4-16"
+    # the registry tracked the local member for /debug + doctor
+    st = h.registry.status()["job"]
+    assert st["local_pods"] == {"ml/m0": 0}
+    assert st["validation_problems"] == []
+
+
+def test_restamp_spec_env_updates_env_only(slice_harness):
+    h = slice_harness
+    spec = bind_pod(h, "pod-r", "0")
+    core = h.plugin.core
+    info = h.storage.load("ml", "pod-r")
+    records = info.allocations["jax"]
+    owner = PodContainer("ml", "pod-r", "jax")
+    n = core.restamp_spec_env_locked(
+        owner, records, {"TPU_WORKER_ID": "7", EnvSliceEpoch: "3"}
+    )
+    assert n == 1
+    rec = next(iter(records.values()))
+    restamped = core.read_alloc_spec(rec.device.hash)
+    assert restamped["env"]["TPU_WORKER_ID"] == "7"
+    assert restamped["env"][EnvSliceEpoch] == "3"
+    # pre-merge `own` snapshot follows, devices/chips are untouched
+    assert restamped["own"]["env"][EnvSliceEpoch] == "3"
+    assert restamped["chip_indexes"] == spec["chip_indexes"]
+    assert restamped["device_paths"] == spec["device_paths"]
+    assert core.read_alloc_spec("no-such-hash") is None
+
+
+# -- elastic recovery: detect member loss, re-form survivors ------------------
+
+
+class EventLog:
+    def __init__(self):
+        self.pod_events = []
+
+    def pod_event(self, namespace, name, reason, message, type_="Normal"):
+        self.pod_events.append((namespace, name, reason, message))
+
+    def node_event(self, reason, message, type_="Normal"):
+        pass
+
+
+def bind_slice_member(h, hosts, wid=0, name="m0"):
+    h.kube.pods = [
+        make_member_pod("job", f"m{w}", f"n{w}", host, w, hosts)
+        for w, host in enumerate(hosts)
+    ]
+    return bind_pod(
+        h, name, "0,1",
+        extra_annotations=slice_annotations("job", wid, hosts),
+    )
+
+
+def test_reformer_detects_member_loss_and_reforms(slice_harness):
+    h = slice_harness
+    hosts = ["host-a", "host-b"]
+    bind_slice_member(h, hosts)
+    events = EventLog()
+    reformer = SliceReformer(h.registry, h.plugin, events=events)
+    owner = PodContainer("ml", "m0", "jax")
+    records = h.storage.load("ml", "m0").allocations["jax"]
+    # both members live: consistent, nothing to do
+    assert reformer.divergence(owner, records) is None
+    # host-b's member pod vanishes from the apiserver (evicted)
+    h.kube.pods = h.kube.pods[:1]
+    div = reformer.divergence(owner, records)
+    assert div is not None
+    assert div["lost"] == ["host-b"] and div["joined"] == []
+    assert div["new_hosts"] == ["host-a"]
+    assert div["new_worker_id"] == 0
+    epoch = reformer.reform(owner, records, div)
+    assert epoch == 1
+    env = next(
+        iter(records.values()),
+    )
+    spec = h.plugin.core.read_alloc_spec(env.device.hash)
+    assert spec["env"]["TPU_WORKER_HOSTNAMES"] == "host-a"
+    assert spec["env"][EnvSliceEpoch] == "1"
+    assert spec["env"]["TPU_WORKER_ID"] == "0"
+    # world-size env follows the survivors (v4-16 two hosts -> one)
+    assert spec["env"]["TPU_HOST_BOUNDS"] == "1,1,1"
+    # the runner's restart signal went out
+    assert [(e[0], e[1], e[2]) for e in events.pod_events] == [
+        ("ml", "m0", "TPUSliceReformed")
+    ]
+    assert "world size 1" in events.pod_events[0][3]
+    # and a subsequent pass sees a consistent slice again
+    assert reformer.divergence(owner, records) is None
+
+
+def test_reformer_never_reforms_on_unknown_membership(slice_harness):
+    h = slice_harness
+    bind_slice_member(h, ["host-a", "host-b"])
+    reformer = SliceReformer(h.registry, h.plugin)
+    owner = PodContainer("ml", "m0", "jax")
+    records = h.storage.load("ml", "m0").allocations["jax"]
+    h.kube.fail = True
+    with pytest.raises(SliceMembershipError):
+        reformer.divergence(owner, records)
+
+
+def test_reformer_waits_while_own_member_is_invisible(slice_harness):
+    """Our own pod missing at the apiserver is a watch/list race, not a
+    member loss: reforming ourselves out of our own slice can never be
+    right."""
+    h = slice_harness
+    bind_slice_member(h, ["host-a", "host-b"])
+    reformer = SliceReformer(h.registry, h.plugin)
+    owner = PodContainer("ml", "m0", "jax")
+    records = h.storage.load("ml", "m0").allocations["jax"]
+    h.kube.pods = []  # nobody visible, including ourselves
+    assert reformer.divergence(owner, records) is None
+
+
+def test_reformer_grows_slice_back_on_rejoin(slice_harness):
+    h = slice_harness
+    hosts = ["host-a", "host-b"]
+    bind_slice_member(h, hosts)
+    reformer = SliceReformer(h.registry, h.plugin)
+    owner = PodContainer("ml", "m0", "jax")
+    records = h.storage.load("ml", "m0").allocations["jax"]
+    # lose b -> world 1
+    h.kube.pods = h.kube.pods[:1]
+    reformer.reform(
+        owner, records, reformer.divergence(owner, records)
+    )
+    # a replacement member appears on host-c: grow back to world 2
+    h.kube.pods.append(
+        make_member_pod("job", "m9", "n9", "host-c", 1,
+                        ["host-a", "host-c"])
+    )
+    div = reformer.divergence(owner, records)
+    assert div["joined"] == ["host-c"]
+    assert div["new_hosts"] == ["host-a", "host-c"]  # survivor keeps rank
+    epoch = reformer.reform(owner, records, div)
+    assert epoch == 2
+    rec = next(iter(records.values()))
+    env = h.plugin.core.read_alloc_spec(rec.device.hash)["env"]
+    assert env["TPU_WORKER_HOSTNAMES"] == "host-a,host-c"
+    assert env[EnvSliceEpoch] == "2"
+
+
+def test_reform_epoch_survives_agent_restart(slice_harness):
+    """The registry is process memory; the stamped spec is the durable
+    record. A reform after an agent restart must bump PAST the stamped
+    epoch (the runner's restart signal is the bump), never repeat it."""
+    h = slice_harness
+    hosts = ["host-a", "host-b", "host-c"]
+    bind_slice_member(h, hosts)
+    reformer = SliceReformer(h.registry, h.plugin)
+    owner = PodContainer("ml", "m0", "jax")
+    records = h.storage.load("ml", "m0").allocations["jax"]
+    # lose c -> epoch 1 stamped into the spec
+    h.kube.pods = h.kube.pods[:2]
+    reformer.reform(owner, records, reformer.divergence(owner, records))
+    # agent restart: fresh registry + reformer, same on-disk specs
+    fresh = SliceRegistry(
+        node_name="host-a", kube_client=h.kube, membership_ttl_s=0.0
+    )
+    reformer2 = SliceReformer(fresh, h.plugin)
+    # consistent world: divergence() alone re-learns the stamped state
+    assert reformer2.divergence(owner, records) is None
+    assert fresh.epoch("job") == 1
+    assert fresh.current_hosts("job") == ("host-a", "host-b")
+    # now lose b: the reform must stamp epoch 2, not repeat 1
+    h.kube.pods = h.kube.pods[:1]
+    div = reformer2.divergence(owner, records)
+    assert div["new_hosts"] == ["host-a"]
+    assert reformer2.reform(owner, records, div) == 2
+    rec = next(iter(records.values()))
+    env = h.plugin.core.read_alloc_spec(rec.device.hash)["env"]
+    assert env[EnvSliceEpoch] == "2"
+
+
+def test_observe_stamped_rearms_reform_override_and_never_regresses():
+    """After a restart (or an over-eager prune), re-learning the stamped
+    world re-arms pod_env's reform override: a drift rebind stamps the
+    REFORMED hosts, not the stale annotation set. And a stale stamp
+    (older epoch) never drags the registry backwards."""
+    topo = parse_accelerator_type("v4-32")
+    hosts = ["host-a", "host-b", "host-c", "host-d"]
+    ann = {
+        AnnotationSliceID: "job",
+        AnnotationSliceName: "v4-32",
+        AnnotationSliceWorkerID: "0",
+        AnnotationSliceWorkerHosts: ",".join(hosts),
+    }
+    reg = SliceRegistry(node_name="host-a")  # fresh: restarted agent
+    reg.observe_stamped("job", ("host-a", "host-b", "host-c"), 1)
+    env = reg.pod_env(ann, topo)  # drift rebind with stale annotations
+    assert env["TPU_WORKER_HOSTNAMES"] == "host-a,host-b,host-c"
+    assert env[EnvSliceEpoch] == "1"
+    # a sibling spec still stamped at the OLD world must not regress
+    reg.observe_stamped("job", tuple(hosts), 0)
+    assert reg.epoch("job") == 1
+    assert reg.current_hosts("job") == ("host-a", "host-b", "host-c")
+
+
+def test_grow_back_ordering_agrees_with_joiners_formation_env(slice_harness):
+    """A joining replacement's FRESH agent derives its world from its
+    own annotations (pure function of the host set). The survivors'
+    reform must compute the identical ordering — tail-appending the
+    joiner would leave two members both claiming worker 0 forever,
+    undetectably (membership SETS match). Regression for exactly the
+    lexicographically-unfriendly case the smoke can't hit."""
+    h = slice_harness
+    bind_slice_member(h, ["host-b", "host-c"], wid=1, name="m1")
+    reformer = SliceReformer(h.registry, h.plugin)
+    owner = PodContainer("ml", "m1", "jax")
+    records = h.storage.load("ml", "m1").allocations["jax"]
+    # lose host-b (we are host-c's member here for ordering purposes)
+    h.kube.pods = h.kube.pods[1:]
+    reformer.reform(owner, records, reformer.divergence(owner, records))
+    # a replacement joins on host-a, annotated with the NEW host set
+    h.kube.pods.append(
+        make_member_pod("job", "m9", "n9", "host-a", 0,
+                        ["host-a", "host-c"])
+    )
+    div = reformer.divergence(owner, records)
+    # canonical (lexicographic) ordering of the set — NOT [host-c, host-a]
+    assert div["new_hosts"] == ["host-a", "host-c"]
+    assert div["new_worker_id"] == 1  # we are host-c: id 1, joiner is 0
+    # ...which is exactly what the joiner's own pod_env derives
+    joiner_reg = SliceRegistry(node_name="host-a", kube_client=h.kube,
+                               membership_ttl_s=0.0)
+    env = joiner_reg.pod_env(
+        slice_annotations("job", 0, ["host-a", "host-c"]),
+        parse_accelerator_type("v4-16"),
+    )
+    assert env["TPU_WORKER_HOSTNAMES"] == "host-a,host-c"
+    assert env["TPU_WORKER_ID"] == "0"
+    epoch = reformer.reform(owner, records, div)
+    rec = next(iter(records.values()))
+    stamped = h.plugin.core.read_alloc_spec(rec.device.hash)["env"]
+    assert stamped["TPU_WORKER_HOSTNAMES"] == "host-a,host-c"
+    assert stamped["TPU_WORKER_ID"] == "1"
+    assert stamped[EnvSliceEpoch] == str(epoch) == "2"
+    # healed and canonical: no further divergence
+    assert reformer.divergence(owner, records) is None
+
+
+def test_validate_members_flags_duplicate_pods_for_one_slot():
+    """Two LIVE pods claiming the same worker slot on the same host
+    (a torn replacement) must surface, not silently rendezvous as the
+    same worker."""
+    hosts = ["host-a", "host-b"]
+    kube = FakeKube([
+        make_member_pod("s1", "m0", "na", "host-a", 0, hosts),
+        make_member_pod("s1", "m0b", "na", "host-a", 0, hosts),
+        make_member_pod("s1", "m1", "nb", "host-b", 1, hosts),
+    ])
+    reg = SliceRegistry(kube_client=kube, membership_ttl_s=0.0)
+    problems = reg.validate_members("s1", ("host-a", "host-b"))
+    assert any("two live pods" in p and "ml/m0" in p and "ml/m0b" in p
+               for p in problems)
+
+
+def test_torn_restamp_is_detected_and_healed():
+    """A crash between restamp_spec_env_locked's per-file writes leaves
+    sibling specs of one container at different worlds/epochs. The
+    highest-epoch stamp wins, the tear is a divergence even with
+    membership consistent, and the repair re-stamps every sibling into
+    ONE generation without bumping the epoch again."""
+
+    class FakeRecord:
+        def __init__(self, h):
+            self.device = type("D", (), {"hash": h})()
+
+    class FakeCore:
+        def __init__(self, specs):
+            self.specs = specs
+
+        def read_alloc_spec(self, h):
+            return self.specs.get(h)
+
+        def restamp_spec_env_locked(self, owner, records, env_updates):
+            for spec in self.specs.values():
+                spec["env"].update(env_updates)
+            return len(self.specs)
+
+    def stamp(hosts, wid, epoch):
+        return {"env": {
+            EnvSliceName: "job",
+            EnvSliceEpoch: str(epoch),
+            "TPU_WORKER_ID": str(wid),
+            "TPU_WORKER_HOSTNAMES": ",".join(hosts),
+            "TPU_ACCELERATOR_TYPE": "v4-16",
+        }}
+
+    core = FakeCore({
+        "a": stamp(["host-a"], 0, 1),              # reformed world
+        "b": stamp(["host-a", "host-b"], 0, 0),    # crashed before restamp
+    })
+    plugin = type("P", (), {"core": core})()
+    kube = FakeKube([
+        make_member_pod("job", "m0", "n0", "host-a", 0, ["host-a"]),
+    ])
+    reg = SliceRegistry(
+        node_name="host-a", kube_client=kube, membership_ttl_s=0.0
+    )
+    reformer = SliceReformer(reg, plugin)
+    records = {"a": FakeRecord("a"), "b": FakeRecord("b")}
+    owner = PodContainer("ml", "m0", "jax")
+    div = reformer.divergence(owner, records)
+    assert div is not None and div["torn"]
+    assert div["new_hosts"] == ["host-a"]  # max-epoch stamp wins
+    assert div["lost"] == [] and div["joined"] == []
+    assert reformer.reform(owner, records, div) == 1  # epoch NOT re-bumped
+    assert core.specs["b"]["env"][EnvSliceEpoch] == "1"
+    assert core.specs["b"]["env"]["TPU_WORKER_HOSTNAMES"] == "host-a"
+    # healed: no further divergence
+    assert reformer.divergence(owner, records) is None
+
+
+def test_prune_removes_both_per_slice_metric_series():
+    from prometheus_client import CollectorRegistry, generate_latest
+
+    from elastic_tpu_agent.metrics import AgentMetrics
+
+    preg = CollectorRegistry()
+    metrics = AgentMetrics(registry=preg)
+    reg = SliceRegistry(metrics=metrics)
+    reg.note_reform("gone-job", ("host-a", "host-b"))
+    scrape = generate_latest(preg).decode()
+    assert 'elastic_tpu_slice_members{slice="gone-job"}' in scrape
+    assert 'elastic_tpu_slice_reforms_total{slice="gone-job"}' in scrape
+    reg.prune(set())
+    scrape = generate_latest(preg).decode()
+    # ids are job-unique: dead slices must not leak series forever
+    assert "gone-job" not in scrape
+
+
+def test_boot_prelearn_arms_reform_override_before_repairs(slice_harness):
+    """After a node reboot the FIRST boot-pass repair that rebinds (a
+    drift rebind) calls pod_env on a cold registry — without the boot
+    pre-learn it would restamp the stale annotation world at epoch 0
+    over a reformed spec, regressing an epoch the runner already saw."""
+    h = slice_harness
+    hosts = ["host-a", "host-b", "host-c"]
+    bind_slice_member(h, hosts)
+    reformer = SliceReformer(h.registry, h.plugin)
+    owner = PodContainer("ml", "m0", "jax")
+    records = h.storage.load("ml", "m0").allocations["jax"]
+    h.kube.pods = h.kube.pods[:2]  # lose host-c -> reform to epoch 1
+    reformer.reform(owner, records, reformer.divergence(owner, records))
+    # reboot: cold registry, same store + specs
+    fresh = SliceRegistry(
+        node_name="host-a", kube_client=h.kube, membership_ttl_s=0.0
+    )
+    rec = make_reconciler(h, h.sitter, SliceReformer(fresh, h.plugin))
+    rec._prelearn_slices()
+    assert fresh.epoch("job") == 1
+    assert fresh.current_hosts("job") == ("host-a", "host-b")
+    # the very first pod_env (what a drift rebind calls) now stamps the
+    # REFORMED world, not the stale 3-host annotation set at epoch 0
+    env = fresh.pod_env(
+        slice_annotations("job", 0, hosts), parse_accelerator_type("v4-16")
+    )
+    assert env["TPU_WORKER_HOSTNAMES"] == "host-a,host-b"
+    assert env[EnvSliceEpoch] == "1"
+
+
+def test_terminal_phase_pods_are_not_live_members():
+    """A member pod that OOMed/exited (phase Failed/Succeeded) but was
+    never deleted (job controllers retain them) must count as LOST: the
+    fabric is already missing its worker, and keeping it 'live' would
+    block reform forever."""
+    hosts = ["host-a", "host-b"]
+    dead = make_member_pod("s1", "m1", "nb", "host-b", 1, hosts)
+    dead["status"] = {"phase": "Failed"}
+    kube = FakeKube([
+        make_member_pod("s1", "m0", "na", "host-a", 0, hosts),
+        dead,
+    ])
+    reg = SliceRegistry(kube_client=kube, membership_ttl_s=0.0)
+    assert reg.live_hosts("s1") == {"host-a"}
+    dead["status"] = {"phase": "Running"}
+    assert reg.live_hosts("s1") == {"host-a", "host-b"}
+
+
+def test_live_members_single_flight_coalesces_concurrent_refreshes():
+    """TTL-expiry arrivals must not stampede the apiserver: concurrent
+    cold misses coalesce onto ONE full-cluster LIST."""
+    started = threading.Event()
+    release = threading.Event()
+
+    class SlowKube(FakeKube):
+        def list_all_pods(self):
+            started.set()
+            release.wait(timeout=10.0)
+            return super().list_all_pods()
+
+    kube = SlowKube([
+        make_member_pod("s1", "m0", "na", "host-a", 0, ["host-a"]),
+    ])
+    reg = SliceRegistry(kube_client=kube, membership_ttl_s=60.0)
+    results = []
+
+    def call():
+        results.append(reg.live_hosts("s1"))
+
+    threads = [threading.Thread(target=call) for _ in range(4)]
+    threads[0].start()
+    assert started.wait(timeout=5.0)
+    for t in threads[1:]:
+        t.start()
+    release.set()
+    for t in threads:
+        t.join(timeout=10.0)
+    assert results == [{"host-a"}] * 4
+    assert kube.calls == 1  # four callers, ONE list
+
+
+def test_live_members_one_list_serves_all_slices():
+    """A node hosting members of M slices issues ONE full-cluster list
+    per TTL window, not M — the snapshot is shared across slice ids."""
+    kube = FakeKube([
+        make_member_pod("s1", "m0", "na", "host-a", 0, ["host-a"]),
+        make_member_pod("s2", "x0", "nb", "host-b", 0, ["host-b"]),
+    ])
+    reg = SliceRegistry(kube_client=kube, membership_ttl_s=60.0)
+    assert reg.live_hosts("s1") == {"host-a"}
+    assert reg.live_hosts("s2") == {"host-b"}
+    assert reg.live_hosts("s1") == {"host-a"}
+    assert kube.calls == 1
+
+
+def make_reconciler(h, sitter, reformer, dry_run=False):
+    from elastic_tpu_agent.reconciler import Reconciler
+
+    return Reconciler(
+        h.storage, None, h.plugin, sitter,
+        alloc_spec_dir=h.alloc_dir, dry_run=dry_run,
+        slice_reformer=reformer,
+    )
+
+
+def test_reconcile_drops_reclaimed_local_member_listing(slice_harness):
+    """A reclaimed member pod (record gone from the store) must drop out
+    of the slice's local_pods listing while the slice itself survives —
+    /debug and the doctor bundle must not show dead pods as members."""
+    h = slice_harness
+    bind_slice_member(h, ["host-a", "host-b"])
+    reformer = SliceReformer(h.registry, h.plugin)
+    h.registry.record_local_pod("job", "ml/ghost", 1)  # no store record
+    rec = make_reconciler(h, h.sitter, reformer)
+    rec._reconcile_slices(
+        {"slice_check_errors": 0, "divergences_observed": 0,
+         "replay_failures": 0, "slice_reform_failures": 0},
+        boot=False, active=True,
+    )
+    st = h.registry.status()["job"]
+    assert "ml/ghost" not in st["local_pods"]
+    assert "ml/m0" in st["local_pods"]  # the genuinely bound member stays
+
+
+def test_reconcile_slices_sitter_blip_does_not_prune(slice_harness):
+    """A pod the sitter momentarily cannot return (watch break mid
+    re-list) must not prune its slice's registry state — the stamped
+    spec on disk proves the slice is live here. Dry-run passes must not
+    prune at all (observe-only contract)."""
+    h = slice_harness
+    hosts = ["host-a", "host-b"]
+    bind_slice_member(h, hosts)
+    reformer = SliceReformer(h.registry, h.plugin)
+    assert h.registry.status()["job"]["hosts"] == hosts
+
+    class BlindSitter:
+        def get_pod(self, namespace, name):
+            return None
+
+    rec = make_reconciler(h, BlindSitter(), reformer)
+    report = {"slice_check_errors": 0, "divergences_observed": 0,
+              "replay_failures": 0}
+    rec._reconcile_slices(report, boot=False, active=True)
+    assert "job" in h.registry.status()  # survived the blip
+    # dry-run: even a genuinely gone slice is only observed, not pruned
+    dry = make_reconciler(h, h.sitter, reformer, dry_run=True)
+    h.registry.note_reform("ghost", ("host-z",))
+    dry._reconcile_slices(dict(report), boot=False, active=False)
+    assert "ghost" in h.registry.status()
+    # an active pass with the real sitter does prune the ghost
+    rec2 = make_reconciler(h, h.sitter, reformer)
+    rec2._reconcile_slices(dict(report), boot=False, active=True)
+    assert "ghost" not in h.registry.status()
+    assert "job" in h.registry.status()
