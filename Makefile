@@ -5,7 +5,7 @@ TAG ?= elastic-tpu-agent:latest
 # verify's tier-1 line uses pipefail, which /bin/sh (dash) lacks
 SHELL := /bin/bash
 
-.PHONY: all native sanitize test test-all verify doctor-smoke chaos-smoke bench-smoke crash-replay-smoke fleet-smoke slice-smoke protos image bench clean
+.PHONY: all native sanitize test test-all verify doctor-smoke chaos-smoke bench-smoke crash-replay-smoke fleet-smoke slice-smoke drain-smoke protos image bench clean
 
 all: native test
 
@@ -65,12 +65,15 @@ bench-smoke:
 	JAX_PLATFORMS=cpu python3 bench.py --churn-smoke
 
 # crash-replay smoke: the kill-at-every-failpoint suite — dies at each
-# mid-bind crash window (die-thread failpoints), restarts the manager
-# over the surviving store + fake kubelet, and asserts convergence to
-# the crash-free end state with an empty bind-intent journal.
-# Deterministic: in-process bind drive, no sleeps on the replay path.
+# mid-bind crash window (die-thread failpoints) and each mid-DRAIN
+# window (drain.pre_cordon/post_signal/pre_reclaim), restarts the
+# manager over the surviving store + fake kubelet, and asserts
+# convergence to the crash-free end state (empty bind-intent journal;
+# resumed drain lifecycle). Deterministic: in-process drive, no sleeps
+# on the replay path.
 crash-replay-smoke:
-	JAX_PLATFORMS=cpu python -m pytest tests/test_reconciler.py -q \
+	JAX_PLATFORMS=cpu python -m pytest tests/test_reconciler.py \
+	  tests/test_drain.py -q \
 	  -p no:cacheprovider && echo "crash replay smoke: OK"
 
 # fleet smoke: the cluster-in-a-box simulator (bench.py --fleet-smoke):
@@ -95,8 +98,21 @@ fleet-smoke:
 slice-smoke:
 	JAX_PLATFORMS=cpu python3 bench.py --slice-smoke
 
+# drain smoke: the graceful-drain chaos gate (bench.py --drain-smoke):
+# a 4-agent slice forms, then a GCE maintenance event is announced on
+# one member's host — that agent must cordon WITHOUT failing health,
+# stamp the deadline-bearing ELASTIC_TPU_DRAIN signal, and proactively
+# annotate its member draining so the survivors re-form to world 3
+# BEFORE the reclaim; the agent is then restarted mid-drain (journaled
+# lifecycle must resume), the deadline reclaim must leave zero orphan
+# links/specs per a converged reconciler pass, and the full event trail
+# (TPUMaintenanceImminent/TPUNodeDraining/TPUSliceReformed/
+# TPUNodeDrained) must reach the apiserver. Structural, deterministic.
+drain-smoke:
+	JAX_PLATFORMS=cpu python3 bench.py --drain-smoke
+
 T1_TIMEOUT ?= 870
-verify: doctor-smoke chaos-smoke bench-smoke crash-replay-smoke fleet-smoke slice-smoke
+verify: doctor-smoke chaos-smoke bench-smoke crash-replay-smoke fleet-smoke slice-smoke drain-smoke
 	python -c "from prometheus_client import CollectorRegistry; \
 	  from elastic_tpu_agent.metrics import AgentMetrics; \
 	  AgentMetrics(registry=CollectorRegistry()); \

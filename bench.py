@@ -533,6 +533,7 @@ def run_fleet(
     trace_samples=FLEET_TRACE_SAMPLES,
     convergence_timeout_s=60.0,
     slice_scenario=True,
+    drain_scenario=True,
 ):
     from elastic_tpu_agent.sim import FleetAggregator, FleetSim
 
@@ -566,6 +567,31 @@ def run_fleet(
                 for r in sample_refs
             ])
             stored = sim.stored_binds()
+            # Drain lifecycle leg (drain-to-reclaim latency + proactive
+            # reform convergence) on nodes the slice scenario won't
+            # touch: its victim's BINDINGS die but the node stays alive.
+            if drain_scenario and nodes >= 8:
+                try:
+                    drain_report = run_drain_scenario(
+                        sim, [nodes - 4, nodes - 3, nodes - 2, nodes - 1],
+                        slice_id="bench-drain",
+                        timeout_s=convergence_timeout_s,
+                        restart_mid_drain=False,
+                    )
+                except Exception as e:  # noqa: BLE001 - failure, not a skip
+                    drain_report = {
+                        "failed": True,
+                        "error": f"{type(e).__name__}: {e}",
+                    }
+            else:
+                drain_report = {
+                    "skipped": True,
+                    "reason": (
+                        "drain scenario disabled for this run"
+                        if not drain_scenario
+                        else "needs >= 8 nodes (4 drain-only)"
+                    ),
+                }
             # Slice formation + elastic recovery, LAST: it kills a node.
             if slice_scenario and nodes >= 2:
                 try:
@@ -604,6 +630,9 @@ def run_fleet(
             # slice formation latency + reform convergence (or an
             # explicit skip, like every other leg that can't run)
             "slice": slice_report,
+            # drain-to-reclaim latency + proactive reform convergence
+            # (or an explicit skip)
+            "drain": drain_report,
             "driver": driver,
             "stored_binds": stored,
             "per_node": rollup["per_node"],
@@ -648,9 +677,10 @@ def fleet_smoke_main():
             pods_per_node=FLEET_SMOKE_PODS_PER_NODE,
             reconcile_period_s=1.0,
             trace_samples=20,
-            # `make slice-smoke` owns the slice chaos gate; keep this
-            # one focused (and its runtime bounded).
+            # `make slice-smoke` / `make drain-smoke` own the chaos
+            # gates; keep this one focused (and its runtime bounded).
             slice_scenario=False,
+            drain_scenario=False,
         )
     except Exception as e:  # noqa: BLE001
         print(json.dumps({"fleet_smoke": {
@@ -839,6 +869,242 @@ def run_slice_scenario(
         "reform_events": len(reformed_events),
         "problems": problems,
     }
+
+
+# -- drains: maintenance/preemption lifecycle (ROADMAP item 5) ----------------
+#
+# A 4-agent multi-host slice, then a GCE maintenance event announced on
+# one member's host: that node's drain orchestrator must cordon (devices
+# unschedulable WITHOUT failing health), stamp the deadline-bearing
+# ELASTIC_TPU_DRAIN signal into the resident's alloc specs, and
+# proactively annotate the member pod draining at the shared apiserver —
+# so the SURVIVORS re-form to world 3 while the victim pod still exists
+# (ahead of the loss, not after a divergence pass). At the hard deadline
+# the victim reclaims the resident bindings through the reconciler
+# (zero orphan artifacts), and an agent restarted mid-drain must resume
+# the drain from its journaled state.
+
+DRAIN_NODES = 4
+DRAIN_ACCEL = "v4-32"  # 4 hosts x 4 chips/host
+DRAIN_DEADLINE_S = 8.0
+
+
+def run_drain_scenario(
+    sim, node_idxs, slice_id="drain-slice", timeout_s=90.0,
+    restart_mid_drain=True,
+):
+    """Drive the maintenance-drain chaos scenario on a RUNNING FleetSim.
+
+    DESTRUCTIVE to the victim's bindings (the node itself stays alive —
+    that is the point of a graceful drain). Returns the report dict
+    (``problems`` empty = every invariant held)."""
+    from elastic_tpu_agent.common import EnvDrain, EnvDrainDeadline
+    from elastic_tpu_agent.slice_env import ordered_worker_hostnames
+
+    problems = []
+    hosts = [sim.nodes[i].name for i in node_idxs]
+    refs = sim.admit_slice(slice_id, node_idxs, accelerator_type=DRAIN_ACCEL)
+    sim.wait_synced(refs)
+    for ref in refs:
+        sim.bind_pod(ref)
+    victim = refs[-1]
+    survivors = refs[:-1]
+    vidx = victim.node_idx
+    victim_mgr = lambda: sim.nodes[vidx].manager  # noqa: E731 - restarts swap it
+    surviving_order, _ = ordered_worker_hostnames(hosts[:-1])
+
+    t0 = time.perf_counter()
+    sim.trigger_maintenance(vidx)
+    sim.wait_drain_state(vidx, ("draining", "drained", "reclaimed"),
+                         timeout_s=timeout_s)
+    # Cordon contract: unschedulable WITHOUT unhealthy — no failed-health
+    # accounting, no ChipUnhealthy storm.
+    core = victim_mgr().plugin.core
+    if not core.cordoned:
+        problems.append("victim not cordoned while draining")
+    if core.unhealthy_chips():
+        problems.append(
+            f"cordon leaked into health: {core.unhealthy_chips()}"
+        )
+    # The resident's spec carries the deadline-bearing drain signal
+    # (stamped right after the state flips to draining — poll briefly).
+    deadline = time.monotonic() + 10.0
+    while time.monotonic() < deadline:
+        env = sim.slice_env_of(victim)
+        if env.get(EnvDrain):
+            break
+        time.sleep(0.05)
+    if not env.get(EnvDrain, "").startswith("maintenance:"):
+        problems.append(f"victim spec missing drain signal: "
+                        f"{env.get(EnvDrain)!r}")
+    if not env.get(EnvDrainDeadline, "").isdigit():
+        problems.append("victim spec missing drain deadline")
+
+    if restart_mid_drain:
+        # Agent killed mid-drain: the restarted agent must resume the
+        # journaled lifecycle — cordon back up, deadline preserved —
+        # BEFORE its boot reconcile could replay anything.
+        sim.restart_node(vidx)
+        st = victim_mgr().drain.state
+        if st not in ("cordoned", "draining", "drained", "reclaimed"):
+            problems.append(f"drain state lost across restart: {st!r}")
+        if not victim_mgr().plugin.core.cordoned:
+            problems.append("cordon not resumed after mid-drain restart")
+
+    # PROACTIVE reform: the survivors re-form to world 3 while the
+    # victim pod still exists at the apiserver (we delete it only after
+    # reclaim below) — the draining annotation, not pod deletion, is
+    # what signalled the loss.
+    try:
+        sim.wait_slice_reformed(
+            survivors, surviving_order, expected_epoch=1,
+            timeout_s=timeout_s,
+        )
+        reform_s = time.perf_counter() - t0
+    except RuntimeError as e:
+        problems.append(f"proactive reform: {e}")
+        reform_s = None
+    if not sim.apiserver.has_pod(victim.namespace, victim.name):
+        problems.append(
+            "victim pod vanished before reform was confirmed — the "
+            "scenario cannot prove the reform was proactive"
+        )
+
+    # Deadline reclaim: bindings torn down through the reconciler.
+    sim.wait_drain_state(vidx, ("reclaimed",),
+                         timeout_s=DRAIN_DEADLINE_S + timeout_s)
+    reclaim_s = time.perf_counter() - t0
+    if victim_mgr().storage.load(victim.namespace, victim.name) is not None:
+        problems.append("victim binding survived the drain reclaim")
+    status = victim_mgr().drain.status()
+    if victim.pod_key not in status.get("reclaimed_pods", []):
+        problems.append(
+            f"reclaimed_pods missing the resident: {status}"
+        )
+
+    # The eviction (node controller's half), then converged victim
+    # reconcile with ZERO orphan artifacts and no replayed binds.
+    sim.apiserver.delete_pod(victim.namespace, victim.name)
+    deadline = time.monotonic() + timeout_s
+    victim_report = None
+    while time.monotonic() < deadline:
+        st = victim_mgr().reconciler.status()
+        report = st.get("last_report") or {}
+        if (
+            st.get("last_converged_ts")
+            and report.get("orphan_links", 1) == 0
+            and report.get("orphan_specs", 1) == 0
+            and report.get("replayed_binds", 1) == 0
+        ):
+            victim_report = report
+            break
+        time.sleep(0.05)
+    if victim_report is None:
+        problems.append(
+            "victim reconciler never converged with zero orphans after "
+            f"reclaim: {victim_mgr().reconciler.status().get('last_report')}"
+        )
+    links = list(victim_mgr().operator.list_links())
+    if links:
+        problems.append(f"orphan virtual links after reclaim: {links}")
+    leftover = [
+        f for f in os.listdir(sim.nodes[vidx].opts.alloc_spec_dir)
+        if f.endswith(".json")
+    ] if os.path.isdir(sim.nodes[vidx].opts.alloc_spec_dir) else []
+    if leftover:
+        problems.append(f"orphan alloc specs after reclaim: {leftover}")
+
+    # Lifecycle completed within the deadline budget (not wedged): the
+    # reclaim fires at deadline expiry, so the whole trigger->reclaim
+    # path must land within deadline + generous poll slack.
+    if reclaim_s > sim.drain_deadline_s + 30.0:
+        problems.append(
+            f"drain-to-reclaim took {reclaim_s:.1f}s against a "
+            f"{sim.drain_deadline_s:.0f}s deadline"
+        )
+
+    # Event trail: maintenance detection + the drain lifecycle. Events
+    # ride the async sinks — give the tail a moment to land.
+    wanted = {"TPUMaintenanceImminent", "TPUNodeDraining",
+              "TPUSliceReformed", "TPUNodeDrained"}
+    deadline = time.monotonic() + 10.0
+    while time.monotonic() < deadline:
+        reasons = {e.get("reason") for e in sim.apiserver.core_events}
+        if wanted <= reasons:
+            break
+        time.sleep(0.05)
+    else:
+        reasons = {e.get("reason") for e in sim.apiserver.core_events}
+    for want in sorted(wanted - reasons):
+        problems.append(f"no {want} event reached the apiserver")
+
+    return {
+        "slice_id": slice_id,
+        "accelerator_type": DRAIN_ACCEL,
+        "world": len(node_idxs),
+        "trigger": "maintenance:TERMINATE_ON_HOST_MAINTENANCE",
+        "restart_mid_drain": restart_mid_drain,
+        "deadline_s": sim.drain_deadline_s,
+        "reform_convergence_s": (
+            round(reform_s, 3) if reform_s is not None else None
+        ),
+        "drain_to_reclaim_s": round(reclaim_s, 3),
+        "victim_drain_status": {
+            "state": status.get("state"),
+            "trigger": status.get("trigger"),
+            "drains_total": status.get("drains_total"),
+            # the full fleet leg reclaims a whole node's residents —
+            # report the count plus a sample, not 100+ names
+            "reclaimed_pod_count": len(status.get("reclaimed_pods", [])),
+            "reclaimed_pods_sample": sorted(
+                status.get("reclaimed_pods", [])
+            )[:5],
+        },
+        "problems": problems,
+    }
+
+
+DRAIN_SMOKE_TIMEOUT_S = 90.0
+
+
+def drain_smoke_main():
+    """`make drain-smoke`: the drain-lifecycle chaos gate — maintenance
+    on one of 4 agents hosting a slice must produce a proactive reform
+    to world 3 (survivors stamped BEFORE reclaim, victim pod still
+    live), a mid-drain agent restart that resumes the journaled drain,
+    deadline reclaim with zero orphan links/specs, and the full event
+    trail. Structural and deterministic (no timing thresholds beyond a
+    generous wedge guard)."""
+    from elastic_tpu_agent.sim import FleetSim
+
+    with tempfile.TemporaryDirectory(prefix="etpu-drn") as tmp:
+        sim = FleetSim(
+            tmp, nodes=DRAIN_NODES, reconcile_period_s=0.5,
+            slice_membership_ttl_s=0.25,
+            drain_deadline_s=DRAIN_DEADLINE_S, drain_period_s=0.25,
+        )
+        try:
+            sim.start()
+            r = run_drain_scenario(
+                sim, list(range(DRAIN_NODES)), slice_id="smoke-drain",
+                timeout_s=DRAIN_SMOKE_TIMEOUT_S,
+            )
+        except Exception as e:  # noqa: BLE001
+            print(json.dumps({"drain_smoke": {
+                "error": f"{type(e).__name__}: {e}"
+            }}))
+            print(f"drain smoke FAILED: {type(e).__name__}: {e}",
+                  file=sys.stderr)
+            return 1
+        finally:
+            sim.stop()
+    print(json.dumps({"drain_smoke": r}))
+    if r["problems"]:
+        for p in r["problems"]:
+            print(f"drain smoke FAILED: {p}", file=sys.stderr)
+        return 1
+    print("drain smoke: OK", file=sys.stderr)
+    return 0
 
 
 SLICE_SMOKE_TIMEOUT_S = 90.0
@@ -1652,6 +1918,8 @@ if __name__ == "__main__":
         sys.exit(fleet_smoke_main())
     elif "--slice-smoke" in sys.argv:
         sys.exit(slice_smoke_main())
+    elif "--drain-smoke" in sys.argv:
+        sys.exit(drain_smoke_main())
     elif "--fleet" in sys.argv:
         sys.exit(fleet_main())
     else:

@@ -111,6 +111,21 @@ def parse_args(argv=None) -> argparse.Namespace:
                         "(/debug/allocations 'reconcile' block, doctor "
                         "bundle) without repairing; the boot-time restore "
                         "pass still repairs")
+    p.add_argument("--drain-deadline", type=float, default=300.0,
+                   help="graceful-drain checkpoint deadline (seconds): "
+                        "on a maintenance event / preemption notice / "
+                        "operator drain, resident pods get this long "
+                        "after the ELASTIC_TPU_DRAIN signal before "
+                        "their bindings are reclaimed (drain.py)")
+    p.add_argument("--drain-period", type=float, default=2.0,
+                   help="seconds between drain-orchestrator trigger "
+                        "polls (jittered 0.75x-1.25x)")
+    p.add_argument("--maintenance-poll-ttl", type=float, default=None,
+                   help="seconds one GCE maintenance-event/preempted "
+                        "metadata fetch stays cached (default 30; env "
+                        "ELASTIC_TPU_MAINTENANCE_POLL_TTL also honored "
+                        "— lower it for faster drain reaction, at the "
+                        "cost of metadata-server traffic)")
     p.add_argument("--slice-membership-ttl", type=float, default=5.0,
                    help="seconds one apiserver slice-membership snapshot "
                         "stays fresh (slices/registry.py) — bounds the "
@@ -317,6 +332,9 @@ def main(argv=None) -> int:
             reconcile_period_s=args.reconcile_period,
             reconcile_dry_run=args.reconcile_dry_run,
             slice_membership_ttl_s=args.slice_membership_ttl,
+            drain_deadline_s=args.drain_deadline,
+            drain_period_s=args.drain_period,
+            maintenance_poll_ttl_s=args.maintenance_poll_ttl,
         )
     )
     run_thread = threading.Thread(

@@ -62,6 +62,24 @@ AnnotationSliceID = "elasticgpu.io/tpu-slice-id"
 EnvSliceName = "ELASTIC_TPU_SLICE_NAME"
 EnvSliceEpoch = "ELASTIC_TPU_SLICE_EPOCH"
 
+# -- Graceful drain lifecycle (drain.py) --------------------------------------
+# Operator-requested drain: the node annotation an admin (or an external
+# controller) sets to ask this node's agent to cordon + drain; removing
+# it cancels/re-admits.
+AnnotationDrain = "elasticgpu.io/drain"
+# Stamped by a DRAINING agent onto its resident slice-member pods so
+# cooperating agents' registries see the member as already lost and
+# re-form the survivor world BEFORE the host actually dies (the
+# proactive half of elastic recovery; slices/registry.py counts an
+# annotated pod as not-live).
+AnnotationDraining = "elasticgpu.io/draining"
+# Env restamped into resident pods' alloc specs when a drain starts: the
+# trigger (maintenance:<event> | preemption[:...] | operator:<source>)
+# and the hard wall-clock deadline (unix seconds) after which bindings
+# are reclaimed. The runner treats the signal as "checkpoint now".
+EnvDrain = "ELASTIC_TPU_DRAIN"
+EnvDrainDeadline = "ELASTIC_TPU_DRAIN_DEADLINE"
+
 # -- Container env contract ---------------------------------------------------
 # Env carrying the allocation hash into the container; the OCI hook resolves
 # it back to physical chips (reference used "GPU", main.go:200 — we accept

@@ -1,0 +1,828 @@
+"""Graceful drain lifecycle: maintenance, preemption, operator drains.
+
+Before this module the agent *detected* trouble — tpu/tpuvm.py polls the
+GCE maintenance-event metadata endpoint — but the only response was
+flipping every chip unhealthy, which stranded resident workloads with no
+checkpoint signal and left slice peers to discover the loss after the
+fact (ROADMAP item 5). Funky's cloud-native FPGA orchestration
+(PAPERS.md) models the missing piece: accelerator lifecycle states —
+cordon, checkpoint, migrate, reclaim — owned by the runtime layer; Arax
+argues the mapping layer, not the application, should own that
+placement-and-recovery contract.
+
+This orchestrator is that layer, a per-node lifecycle state machine::
+
+    Active -> Cordoned -> Draining -> Drained | Reclaimed
+       ^__________________________________________|   (trigger cleared)
+
+driven by three trigger sources, polled each tick:
+
+- **maintenance**: the GCE maintenance-event value
+  (``operator.maintenance_event()``; MIGRATE/TERMINATE announcements).
+- **preemption**: the metadata ``preempted`` endpoint
+  (``operator.preempted()``) plus a test-injectable notice
+  (``faults.check("drain.preempt-notice")`` — arm with
+  ``drain.preempt-notice=notice:1``).
+- **operator-requested**: the ``elasticgpu.io/drain`` node annotation,
+  or the local :meth:`request_drain` admin seam.
+
+On trigger, the node drains gracefully instead of failing:
+
+1. **Cordon** — devices go unschedulable in ListAndWatch (kubelet stops
+   NEW placements) *without* failing health: no ChipUnhealthy events,
+   no CRD Failed, no eviction hooks; resident bindings ride on.
+2. **Signal** — every resident pod's alloc specs are restamped (under
+   the owner's bind stripe, the SliceReformer mechanism) with
+   ``ELASTIC_TPU_DRAIN=<trigger>`` and a deadline-bearing
+   ``ELASTIC_TPU_DRAIN_DEADLINE``; ``TPUNodeDraining`` events fire on
+   the node and each resident pod.
+3. **Proactive reform** — resident slice-member pods are annotated
+   ``elasticgpu.io/draining`` at the shared apiserver, so cooperating
+   agents' registries count this host as lost and re-form the survivor
+   world BEFORE the host dies (slices/recovery.py does the restamping
+   on each survivor) instead of after a divergence pass.
+4. **Checkpoint-wait, then reclaim** — residents that exit take their
+   bindings with them (normal GC); at the hard deadline whatever
+   remains is reclaimed through the reconciler's existing repair
+   classes (``reclaimed_pod``), leaving zero orphan artifacts.
+5. **Cancel / re-admit** — a maintenance event clearing (or the drain
+   annotation being removed) mid-drain uncordons, strips the drain
+   signal from surviving specs, clears the draining pod annotations and
+   returns to Active. Preemption never un-rings.
+
+Every transition is journaled in Storage (``agent_state`` table) BEFORE
+its side effects — the same crash-consistency discipline as bind
+intents — so an agent killed at any drain failpoint
+(``drain.pre_cordon`` / ``drain.post_signal`` / ``drain.pre_reclaim``)
+resumes the drain, cordon and deadline included, on restart.
+
+Supervised DEGRADED like the reconciler: a broken drain loop must not
+take binding down with it; /healthz and the doctor bundle surface the
+loss of lifecycle handling.
+"""
+
+from __future__ import annotations
+
+import logging
+import random
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from . import faults
+from .common import (
+    AnnotationDrain,
+    AnnotationDraining,
+    AnnotationSliceID,
+    EnvDrain,
+    EnvDrainDeadline,
+)
+from .types import PodContainer
+
+logger = logging.getLogger(__name__)
+
+# Lifecycle states (the `elastic_tpu_drain_state` gauge exports the code).
+ACTIVE = "active"
+CORDONED = "cordoned"
+DRAINING = "draining"
+DRAINED = "drained"      # every resident exited before the deadline
+RECLAIMED = "reclaimed"  # deadline expired; bindings force-reclaimed
+
+STATE_CODES = {ACTIVE: 0, CORDONED: 1, DRAINING: 2, DRAINED: 3, RECLAIMED: 4}
+
+# Trigger kinds (the `trigger` label of elastic_tpu_drains_total; the
+# full trigger string carries detail, e.g. "maintenance:TERMINATE_...").
+TRIGGER_MAINTENANCE = "maintenance"
+TRIGGER_PREEMPTION = "preemption"
+TRIGGER_OPERATOR = "operator"
+
+DEFAULT_DEADLINE_S = 300.0
+DEFAULT_PERIOD_S = 2.0
+# How long one GET /api/v1/nodes/<name> answer (the drain-annotation
+# read) stays fresh: the tick period is 2s but a fleet of agents must
+# not turn annotation polling into steady apiserver load — the sibling
+# trigger sources are TTL-cached the same way (maintenance/preempted).
+DEFAULT_NODE_POLL_TTL_S = 10.0
+
+_STATE_KEY = "drain"
+
+
+class DrainOrchestrator:
+    """Per-node graceful-drain state machine (one instance per agent)."""
+
+    def __init__(
+        self,
+        operator,
+        plugin,
+        storage,
+        sitter,
+        reconciler,
+        kube_client=None,
+        events=None,
+        metrics=None,
+        node_name: str = "",
+        deadline_s: float = DEFAULT_DEADLINE_S,
+        period_s: float = DEFAULT_PERIOD_S,
+        node_poll_ttl_s: float = DEFAULT_NODE_POLL_TTL_S,
+        rng=None,
+    ) -> None:
+        self._operator = operator
+        self._plugin = plugin
+        self._storage = storage
+        self._sitter = sitter
+        self._reconciler = reconciler
+        self._client = kube_client
+        self._events = events
+        self._metrics = metrics
+        self._node = node_name
+        self.deadline_s = deadline_s
+        self.period_s = period_s
+        self.node_poll_ttl_s = node_poll_ttl_s
+        self._node_ann_asserted = False
+        self._node_ann_next_poll = 0.0
+        self._rng = rng if rng is not None else random.Random()
+        self._lock = threading.Lock()
+        self.state = ACTIVE
+        self.trigger = ""
+        self.deadline_ts: Optional[float] = None
+        self._drain_requested = False
+        self._maint_active = False  # first-trip edge for the event/gauge
+        self._last_maint_value: Optional[str] = None  # for status()
+        self._drains_total = 0
+        self._reclaimed_pods: List[str] = []
+        self._stamped_pods: List[str] = []
+        self._annotated_pods: List[Tuple[str, str]] = []  # (ns, name)
+        self._last_error: Optional[str] = None
+        self._resumed = False
+
+    # -- admin seam -----------------------------------------------------------
+
+    def request_drain(self, reason: str = "admin") -> None:
+        """Local operator-requested drain (the admin-endpoint seam; the
+        node-annotation path is polled from the apiserver)."""
+        with self._lock:
+            self._drain_requested = True
+            self._drain_reason = reason
+
+    def cancel_request(self) -> None:
+        with self._lock:
+            self._drain_requested = False
+
+    # -- trigger polling ------------------------------------------------------
+
+    def _maintenance_value(self) -> Optional[str]:
+        fn = getattr(self._operator, "maintenance_event", None)
+        if fn is None:
+            return None
+        try:
+            return fn()
+        except Exception:  # noqa: BLE001 - a broken poll must not wedge
+            logger.exception("maintenance poll failed")
+            return None
+
+    def _note_maintenance(self, value: Optional[str]) -> bool:
+        """Satellite contract: the FIRST sighting of an announced event
+        emits TPUMaintenanceImminent and raises the gauge, whether or not
+        a drain is already running; clearing drops the gauge. ``None``
+        (endpoint unreachable) is UNKNOWABLE: the gauge and the
+        fired-once edge keep their last known state — a metadata blip
+        must neither tell dashboards the event is over nor re-fire the
+        imminent event when the endpoint comes back still announcing."""
+        if value is None:
+            return False
+        announced = value not in ("", "NONE")
+        if announced and not self._maint_active:
+            logger.warning("host maintenance imminent: %s", value)
+            if self._events is not None:
+                from .kube.events import ReasonMaintenanceImminent
+
+                try:
+                    self._events.node_event(
+                        ReasonMaintenanceImminent,
+                        f"GCE announces host maintenance: {value}; "
+                        "cordoning and draining this node's TPU workloads",
+                        type_="Warning",
+                    )
+                except Exception:  # noqa: BLE001 - observability only
+                    logger.exception("maintenance event emit failed")
+        if self._metrics is not None and hasattr(
+            self._metrics, "maintenance_imminent"
+        ):
+            try:
+                self._metrics.maintenance_imminent.set(1 if announced else 0)
+            except Exception:  # noqa: BLE001
+                pass
+        self._maint_active = announced
+        return announced
+
+    def _poll_trigger(self) -> Optional[str]:
+        """The currently-asserted trigger (None = all quiet). Checked
+        both to start a drain and to notice mid-drain that the cause
+        went away (cancel/re-admit)."""
+        maint = self._maintenance_value()
+        self._last_maint_value = maint
+        maint_announced = self._note_maintenance(maint)
+        # Preemption OUTRANKS maintenance: when both assert, the drain
+        # must carry the non-cancelable trigger — otherwise a
+        # maintenance-labelled drain would cancel when its event clears
+        # even though the host is still being preempted.
+        # Test-injectable preemption notice (chaos matrix): consuming the
+        # notice LATCHES preemption — a real GCE notice never un-rings.
+        if faults.check("drain.preempt-notice"):
+            setter = getattr(self._operator, "set_preempted", None)
+            if setter is not None:
+                setter(True)
+            return f"{TRIGGER_PREEMPTION}:notice"
+        preempted = getattr(self._operator, "preempted", None)
+        if preempted is not None:
+            try:
+                if preempted():
+                    return TRIGGER_PREEMPTION
+            except Exception:  # noqa: BLE001
+                logger.exception("preemption poll failed")
+        if maint_announced:
+            return f"{TRIGGER_MAINTENANCE}:{maint}"
+        if maint is None and self.trigger.startswith(TRIGGER_MAINTENANCE):
+            # The endpoint is UNREACHABLE (not answering "NONE"): with a
+            # maintenance drain in flight, unknowable must not read as
+            # cleared — a transient metadata failure (cached under the
+            # error backoff) would otherwise cancel the drain and
+            # re-admit workloads onto a host GCE is about to take away.
+            # Same discipline as the apiserver-blip guard below.
+            return self.trigger
+        with self._lock:
+            if self._drain_requested:
+                return f"{TRIGGER_OPERATOR}:{getattr(self, '_drain_reason', 'admin')}"
+        if self._client is not None and self._node:
+            now = time.monotonic()
+            if now >= self._node_ann_next_poll or self.node_poll_ttl_s <= 0:
+                try:
+                    node = self._client.get_node(self._node)
+                except Exception:  # noqa: BLE001 - apiserver blip
+                    # Unanswerable must not CANCEL an annotation-driven
+                    # drain mid-flight; the cached verdict stands and
+                    # the next tick retries (no TTL advance on failure
+                    # would hammer a dead apiserver — advance it).
+                    self._node_ann_next_poll = now + self.node_poll_ttl_s
+                    if self.trigger.startswith(
+                        TRIGGER_OPERATOR + ":annotation"
+                    ):
+                        return self.trigger
+                else:
+                    ann = (
+                        ((node or {}).get("metadata") or {})
+                        .get("annotations") or {}
+                    )
+                    self._node_ann_asserted = str(
+                        ann.get(AnnotationDrain, "")
+                    ).lower() in ("true", "1", "yes", "drain")
+                    self._node_ann_next_poll = now + self.node_poll_ttl_s
+            if self._node_ann_asserted:
+                return f"{TRIGGER_OPERATOR}:annotation"
+        return None
+
+    @staticmethod
+    def _cancelable(trigger: str) -> bool:
+        """Maintenance and operator drains cancel when their cause
+        clears; a preemption notice never un-rings."""
+        return not trigger.startswith(TRIGGER_PREEMPTION)
+
+    # -- residents ------------------------------------------------------------
+
+    def _spec_plugin(self):
+        return getattr(self._plugin, "core", None)
+
+    def _residents(self) -> Optional[List[Tuple[PodContainer, Dict]]]:
+        """(owner, records-by-resource) for every container this node
+        still holds bindings for — or None when storage cannot answer
+        (callers must NOT treat unknowable as zero residents: that
+        would complete a drain as Drained while bindings still exist,
+        permanently skipping the deadline reclaim)."""
+        out: List[Tuple[PodContainer, Dict]] = []
+        try:
+            items = list(self._storage.items())
+        except Exception:  # noqa: BLE001 - storage blip: retry next tick
+            logger.exception("drain: resident enumeration failed")
+            return None
+        for _key, info in items:
+            for container, by_resource in info.allocations.items():
+                if by_resource:
+                    out.append((
+                        PodContainer(info.namespace, info.name, container),
+                        dict(by_resource),
+                    ))
+        return out
+
+    # -- journaled transitions ------------------------------------------------
+
+    def _journal(self) -> None:
+        """Persist the CURRENT state (called before the transition's
+        side effects run, so a crash replays into this state)."""
+        self._storage.save_state(_STATE_KEY, {
+            "state": self.state,
+            "trigger": self.trigger,
+            "deadline_ts": self.deadline_ts,
+            "stamped_pods": list(self._stamped_pods),
+            "annotated_pods": [list(p) for p in self._annotated_pods],
+            "reclaimed_pods": list(self._reclaimed_pods),
+            "drains_total": self._drains_total,
+        })
+
+    def _set_state(self, state: str) -> None:
+        self.state = state
+        if self._metrics is not None and hasattr(self._metrics, "drain_state"):
+            try:
+                self._metrics.drain_state.set(STATE_CODES[state])
+            except Exception:  # noqa: BLE001
+                pass
+
+    def resume(self) -> None:
+        """Re-enter the journaled lifecycle after a restart (or a
+        supervisor respawn of this loop): re-apply the cordon for any
+        non-Active state and let tick() continue from there — the
+        deadline is wall-clock, so an agent down past it reclaims on its
+        first tick back. Idempotent."""
+        try:
+            st = self._storage.load_state(_STATE_KEY)
+        except Exception:  # noqa: BLE001 - unreadable journal: start clean
+            logger.exception("drain: state journal unreadable; starting "
+                             "Active")
+            st = None
+        if not st:
+            self._resumed = True
+            return
+        with self._lock:
+            self._set_state(st.get("state", ACTIVE))
+            self.trigger = st.get("trigger", "")
+            self.deadline_ts = st.get("deadline_ts")
+            self._stamped_pods = list(st.get("stamped_pods", []))
+            self._annotated_pods = [
+                tuple(p) for p in st.get("annotated_pods", [])
+            ]
+            self._reclaimed_pods = list(st.get("reclaimed_pods", []))
+            self._drains_total = int(st.get("drains_total", 0))
+            resumed_state = self.state
+        if resumed_state != ACTIVE:
+            logger.warning(
+                "drain: resuming journaled state %r (trigger %r, "
+                "deadline %s)", resumed_state, self.trigger,
+                self.deadline_ts,
+            )
+            self._plugin.set_cordoned(True)
+            if resumed_state in (CORDONED, DRAINING):
+                # A crash between the DRAINING journal write and the
+                # stamping pass loses nothing: re-signal is idempotent.
+                self._signal_residents()
+        else:
+            self._plugin.set_cordoned(False)
+        self._resumed = True
+
+    # -- the lifecycle --------------------------------------------------------
+
+    def _start_drain(self, trigger: str) -> None:
+        now = time.time()
+        with self._lock:
+            self.trigger = trigger
+            self.deadline_ts = now + self.deadline_s
+            self._drains_total += 1
+            self._stamped_pods = []
+            self._annotated_pods = []
+            self._reclaimed_pods = []
+            self._set_state(CORDONED)
+            self._journal()  # BEFORE any side effect
+        if self._metrics is not None and hasattr(self._metrics, "drains_total"):
+            try:
+                self._metrics.drains_total.labels(
+                    trigger=trigger.split(":", 1)[0]
+                ).inc()
+            except Exception:  # noqa: BLE001
+                pass
+        faults.fire("drain.pre_cordon")
+        self._plugin.set_cordoned(True)
+        logger.warning(
+            "drain: node cordoned (trigger %s, deadline in %.0fs)",
+            trigger, self.deadline_s,
+        )
+        if self._events is not None:
+            from .kube.events import ReasonNodeDraining
+
+            try:
+                self._events.node_event(
+                    ReasonNodeDraining,
+                    f"draining TPU workloads ({trigger}): chips "
+                    "unschedulable, residents signalled to checkpoint; "
+                    f"bindings reclaimed in {self.deadline_s:.0f}s",
+                    type_="Warning",
+                )
+            except Exception:  # noqa: BLE001
+                logger.exception("drain event emit failed")
+        with self._lock:
+            self._set_state(DRAINING)
+            self._journal()
+        self._signal_residents()
+        faults.fire("drain.post_signal")
+
+    def _signal_residents(self, residents=None) -> None:
+        """Stamp the deadline-bearing drain signal into every resident
+        container's alloc specs (under the owner's bind stripe — the
+        SliceReformer restamp mechanism) and proactively mark resident
+        slice members draining at the apiserver. Idempotent and cheap
+        to re-run (the restamp skips files whose env already carries
+        the signal): resume() and every DRAINING tick repeat it,
+        catching pods that bound mid-cordon and specs a drift rebind
+        rebuilt without the signal."""
+        from .plugins import tpushare
+
+        plugin = self._spec_plugin()
+        if plugin is None:
+            return
+        if residents is None:
+            residents = self._residents()
+        if residents is None:
+            return  # storage unanswerable: retry next tick
+        env = {
+            EnvDrain: self.trigger,
+            EnvDrainDeadline: str(int(self.deadline_ts or 0)),
+        }
+        stamped = set(self._stamped_pods)
+        annotated = set(self._annotated_pods)
+        for owner, records in residents:
+            try:
+                with tpushare.bind_lock(owner.pod_key):
+                    n = plugin.restamp_spec_env_locked(owner, records, env)
+            except Exception:  # noqa: BLE001 - next tick retries
+                logger.exception(
+                    "drain: signal restamp for %s failed", owner.pod_key
+                )
+                continue
+            if n and owner.pod_key not in stamped:
+                stamped.add(owner.pod_key)
+                if self._events is not None:
+                    from .kube.events import ReasonNodeDraining
+
+                    try:
+                        self._events.pod_event(
+                            owner.namespace, owner.name, ReasonNodeDraining,
+                            f"node draining ({self.trigger}): checkpoint "
+                            "now — TPU bindings are reclaimed at "
+                            f"{EnvDrainDeadline}={env[EnvDrainDeadline]}",
+                            type_="Warning",
+                        )
+                    except Exception:  # noqa: BLE001
+                        pass
+            # Proactive slice notification: peers must see this member as
+            # lost BEFORE the host dies, so the survivor world forms
+            # ahead of the loss instead of after a divergence pass.
+            key = (owner.namespace, owner.name)
+            if key in annotated or self._client is None:
+                continue
+            pod = self._sitter.get_pod(owner.namespace, owner.name)
+            ann = ((pod or {}).get("metadata") or {}).get("annotations") or {}
+            if not ann.get(AnnotationSliceID):
+                continue
+            try:
+                self._client.patch_pod_annotations(
+                    owner.namespace, owner.name,
+                    {AnnotationDraining: "true"},
+                )
+                annotated.add(key)
+            except Exception:  # noqa: BLE001 - next tick retries
+                logger.warning(
+                    "drain: draining-annotation patch for %s failed "
+                    "(retried next tick)", owner.pod_key,
+                )
+        with self._lock:
+            self._stamped_pods = sorted(stamped)
+            self._annotated_pods = sorted(annotated)
+            self._journal()
+
+    def _cancel_drain(self) -> None:
+        """The trigger cleared mid-drain (maintenance event withdrawn,
+        drain annotation removed): re-admit the node. Journal FIRST —
+        resume() re-derives cordon state from the journaled state, so a
+        crash mid-cancel converges to Active + uncordoned. The stamped/
+        annotated lists stay in the journal as the PENDING-CLEANUP
+        record: signal removal and annotation clearing are retried from
+        Active ticks (and across restarts) until they succeed — a
+        storage blip or apiserver failure here must not leave residents
+        checkpointing toward a deadline that no longer exists, or a
+        live slice member counted lost forever."""
+        logger.warning("drain: trigger %r cleared; re-admitting node",
+                       self.trigger)
+        cancelled_trigger = self.trigger
+        stamped = list(self._stamped_pods)
+        with self._lock:
+            self._set_state(ACTIVE)
+            self.trigger = ""
+            self.deadline_ts = None
+            self._journal()  # stamped/annotated kept: cleanup is owed
+        self._plugin.set_cordoned(False)
+        self._finish_cancel_cleanup()
+        if self._events is not None:
+            from .kube.events import ReasonDrainCancelled
+
+            try:
+                self._events.node_event(
+                    ReasonDrainCancelled,
+                    f"drain cancelled ({cancelled_trigger} cleared): "
+                    f"chips re-schedulable, drain signal removed from "
+                    f"{len(stamped)} resident pod(s)",
+                )
+            except Exception:  # noqa: BLE001
+                pass
+
+    def _finish_cancel_cleanup(self) -> None:
+        """Retryable post-cancel cleanup: strip the drain env from every
+        resident spec and clear the draining annotations, dropping each
+        item from the journaled pending lists only once it provably
+        succeeded (a 404 on the patch = the pod is gone = done)."""
+        from .plugins import tpushare
+
+        if self._stamped_pods:
+            plugin = self._spec_plugin()
+            residents = self._residents() if plugin is not None else []
+            if residents is not None:
+                cleaned = True
+                for owner, records in residents:
+                    try:
+                        with tpushare.bind_lock(owner.pod_key):
+                            plugin.restamp_spec_env_locked(
+                                owner, records, {},
+                                remove_keys=(EnvDrain, EnvDrainDeadline),
+                            )
+                    except Exception:  # noqa: BLE001 - retried next tick
+                        cleaned = False
+                        logger.exception(
+                            "drain: signal removal for %s failed "
+                            "(retried)", owner.pod_key,
+                        )
+                if cleaned:
+                    with self._lock:
+                        self._stamped_pods = []
+                        self._journal()
+        # With no client the annotation debt stays journaled untouched —
+        # it is owed for whenever a client exists again (an agent can
+        # restart into a working kubeconfig).
+        if self._annotated_pods and self._client is not None:
+            remaining = []
+            for ns, name in self._annotated_pods:
+                try:
+                    self._client.patch_pod_annotations(
+                        ns, name, {AnnotationDraining: None}
+                    )
+                except Exception:  # noqa: BLE001 - retried next tick
+                    logger.warning(
+                        "drain: draining-annotation clear for %s/%s "
+                        "failed (retried)", ns, name,
+                    )
+                    remaining.append((ns, name))
+            with self._lock:
+                self._annotated_pods = sorted(remaining)
+                self._journal()
+
+    def _reclaim(self) -> None:
+        """Deadline expired: reclaim every remaining binding through the
+        reconciler's repair machinery (counted under reclaimed_pod),
+        leaving zero orphan artifacts. The pods themselves may still
+        exist at the apiserver — eviction is the node controller's job —
+        so the reconciler suppresses unbound-assignment replays for this
+        node while reclaimed (suppress_replays)."""
+        faults.fire("drain.pre_reclaim")
+        residents = self._residents()
+        if residents is None:
+            return  # storage unanswerable: reclaim retries next tick
+        keys = sorted({owner.pod_key for owner, _ in residents})
+        report = {}
+        if keys:
+            logger.warning(
+                "drain: deadline expired with %d resident pod(s); "
+                "reclaiming bindings: %s", len(keys), keys,
+            )
+            report = self._reconciler.drain_reclaim(keys)
+            if self._metrics is not None and hasattr(
+                self._metrics, "drain_reclaimed_pods"
+            ):
+                try:
+                    self._metrics.drain_reclaimed_pods.inc(
+                        report.get("reclaimed_pods", 0)
+                    )
+                except Exception:  # noqa: BLE001
+                    pass
+        # Only pods whose records are actually GONE count as reclaimed;
+        # a pod whose teardown failed stays a resident and the state
+        # stays DRAINING, so the past-deadline tick retries it — no
+        # RECLAIMED/DRAINING flap, no per-cycle NodeDrained event spam,
+        # and status() never claims a still-live binding was reclaimed.
+        after = self._residents()
+        remaining = (
+            {owner.pod_key for owner, _ in after}
+            if after is not None else set(keys)
+        )
+        done = [k for k in keys if k not in remaining]
+        with self._lock:
+            # union: a straggler bind reclaimed after re-entering
+            # draining must not erase the first wave from the record
+            self._reclaimed_pods = sorted(
+                set(self._reclaimed_pods) | set(done)
+            )
+            if remaining:
+                self._journal()  # progress recorded; retry next tick
+            else:
+                self._set_state(RECLAIMED)
+                self._journal()
+        if remaining:
+            logger.warning(
+                "drain: %d resident(s) survived the reclaim (%s); "
+                "retried next tick", len(remaining), sorted(remaining),
+            )
+            return
+        if self._events is not None:
+            from .kube.events import ReasonNodeDrained
+
+            try:
+                self._events.node_event(
+                    ReasonNodeDrained,
+                    "drain deadline expired: reclaimed TPU bindings of "
+                    f"{len(keys)} resident pod(s) "
+                    f"({report.get('reclaimed_pods', 0)} records, "
+                    f"{report.get('sweep_failures', 0)} sweep failures)",
+                    type_="Warning",
+                )
+            except Exception:  # noqa: BLE001
+                pass
+
+    def _finish_drained(self) -> None:
+        with self._lock:
+            self._set_state(DRAINED)
+            self._journal()
+        logger.info("drain: all residents exited before the deadline")
+        if self._events is not None:
+            from .kube.events import ReasonNodeDrained
+
+            try:
+                self._events.node_event(
+                    ReasonNodeDrained,
+                    f"drain complete ({self.trigger}): every resident "
+                    "workload exited before the deadline; node remains "
+                    "cordoned until the trigger clears",
+                )
+            except Exception:  # noqa: BLE001
+                pass
+
+    # -- reconciler integration -----------------------------------------------
+
+    def suppress_replays(self) -> bool:
+        """True while reclaimed bindings must STAY reclaimed: kubelet's
+        pod-resources view still lists the drained assignments (the pods
+        may not be evicted yet), and without this the reconciler's
+        unbound-assignment replay would faithfully re-bind everything
+        the drain just tore down."""
+        with self._lock:
+            if self.state == RECLAIMED:
+                return True
+            return (
+                self.state == DRAINING
+                and self.deadline_ts is not None
+                and time.time() >= self.deadline_ts
+            )
+
+    # -- the tick -------------------------------------------------------------
+
+    def tick(self) -> str:
+        """One state-machine step; returns the (possibly new) state."""
+        faults.fire("drain.tick")
+        trigger = self._poll_trigger()
+        state = self.state
+        if (
+            state != ACTIVE
+            and trigger is not None
+            and not self._cancelable(trigger)
+            and self._cancelable(self.trigger)
+        ):
+            # A preemption notice arriving MID-drain upgrades the
+            # lifecycle to non-cancelable: "preemption never un-rings"
+            # must hold even when maintenance rang first — otherwise the
+            # maintenance event clearing (or its endpoint blipping in
+            # just the wrong tick) would re-admit workloads onto a host
+            # GCE is about to preempt.
+            logger.warning(
+                "drain: trigger upgraded %r -> %r (non-cancelable)",
+                self.trigger, trigger,
+            )
+            with self._lock:
+                self.trigger = trigger
+                self._journal()
+        if state == ACTIVE:
+            if trigger is not None:
+                self._start_drain(trigger)
+            elif self._stamped_pods or self._annotated_pods:
+                # cleanup owed by a cancelled drain (journaled pending
+                # lists): retry until every spec and annotation is clean
+                self._finish_cancel_cleanup()
+        elif state in (CORDONED, DRAINING):
+            if trigger is None and self._cancelable(self.trigger):
+                self._cancel_drain()
+            else:
+                if state == CORDONED:
+                    # A crash landed between the CORDONED and DRAINING
+                    # journal writes: finish the entry sequence.
+                    with self._lock:
+                        self._set_state(DRAINING)
+                        self._journal()
+                # ONE storage snapshot per tick; None = unknowable, and
+                # unknowable must never complete the drain as Drained
+                # (that would skip the deadline reclaim forever).
+                residents = self._residents()
+                self._signal_residents(residents)
+                if residents is None:
+                    pass  # storage blip: retry next tick
+                elif not residents:
+                    self._finish_drained()
+                elif (
+                    self.deadline_ts is not None
+                    and time.time() >= self.deadline_ts
+                ):
+                    self._reclaim()
+        elif state in (DRAINED, RECLAIMED):
+            if trigger is None and self._cancelable(self.trigger):
+                # The cause cleared after the drain completed (host
+                # migrated back, annotation removed): re-admit.
+                self._cancel_drain()
+            else:
+                # A PreStart bind can race the final empty-residents
+                # snapshot (kubelet completed Allocate pre-cordon, the
+                # bind committed just after). A completed drain must
+                # keep checking: such a straggler is re-signalled and
+                # falls back under the deadline reclaim instead of
+                # surviving unstranded-but-unsignalled until the host
+                # dies.
+                residents = self._residents()
+                if residents:
+                    logger.warning(
+                        "drain: %d resident(s) appeared after the drain "
+                        "completed; re-entering draining", len(residents),
+                    )
+                    with self._lock:
+                        self._set_state(DRAINING)
+                        self._journal()
+                    self._signal_residents(residents)
+        return self.state
+
+    def run(self, stop: threading.Event) -> None:
+        """Supervised loop (DEGRADED): resume the journaled lifecycle,
+        then tick at a jittered period (0.75x-1.25x, so a fleet never
+        polls the metadata server in lockstep)."""
+        self.resume()
+        consecutive_failures = 0
+        while True:
+            delay = self.period_s * (0.75 + 0.5 * self._rng.random())
+            if stop.wait(delay):
+                return
+            try:
+                self.tick()
+                consecutive_failures = 0
+            except Exception as e:  # noqa: BLE001
+                # One-off failures (apiserver blip, sqlite lock) are
+                # absorbed; persistent ones escalate to the supervisor —
+                # same discipline as the reconciler loop.
+                consecutive_failures += 1
+                with self._lock:
+                    self._last_error = f"{type(e).__name__}: {e}"
+                if consecutive_failures >= 3:
+                    raise
+                logger.exception(
+                    "drain tick failed (%d consecutive; escalating to "
+                    "the supervisor at 3)", consecutive_failures,
+                )
+
+    # -- introspection --------------------------------------------------------
+
+    def status(self) -> dict:
+        """The ``drain`` block of /debug/allocations and the doctor
+        bundle: state, trigger, deadline, and which pods were signalled
+        / reclaimed — drain-stuck triage must work from a bundle alone."""
+        # The drain loop's last polled value, NOT a live metadata fetch:
+        # /debug/allocations and the doctor bundle must never pay the
+        # metadata timeout (or race the drain thread through the
+        # operator's unsynchronized poll cache) from a handler thread.
+        maint = self._last_maint_value
+        with self._lock:
+            deadline_in = (
+                round(self.deadline_ts - time.time(), 3)
+                if self.deadline_ts is not None else None
+            )
+            return {
+                "state": self.state,
+                "trigger": self.trigger,
+                "deadline_ts": self.deadline_ts,
+                "deadline_in_s": deadline_in,
+                "deadline_s": self.deadline_s,
+                "drains_total": self._drains_total,
+                "stamped_pods": list(self._stamped_pods),
+                "annotated_pods": [
+                    f"{ns}/{name}" for ns, name in self._annotated_pods
+                ],
+                "reclaimed_pods": list(self._reclaimed_pods),
+                "maintenance_event": maint,
+                "last_error": self._last_error,
+            }

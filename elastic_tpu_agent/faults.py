@@ -19,6 +19,12 @@ Specs (``<kind>[:<arg>]``):
   thread the way an uncaught bug would. This is what proves the
   supervisor actually restarts a loop: ``raise`` alone is absorbed by
   the loops' own catch-and-continue guards.
+- ``notice`` / ``notice:N`` — a consumable signal rather than a fault:
+  ``fire()`` ignores it; a poll site asks ``faults.check(point)``
+  which returns True (and consumes one charge) while armed. This is
+  how chaos tests inject external notifications the code merely polls
+  for — e.g. ``drain.preempt-notice=notice:1`` makes the drain
+  orchestrator see exactly one spot-preemption notice.
 
 Arming is test-only: production deployments never set the env knob, and
 an unarmed ``fire()`` is a dict-emptiness check. Points are plain
@@ -74,9 +80,12 @@ def _parse_spec(spec: str) -> _Fault:
     if kind == "die-thread":
         n = int(arg) if arg else None
         return _Fault("die-thread", None, n)
+    if kind == "notice":
+        n = int(arg) if arg else None
+        return _Fault("notice", None, n)
     raise ValueError(
         f"unknown fault spec {spec!r} "
-        "(want raise[-once|:N] | delay:S | die-thread[:N])"
+        "(want raise[-once|:N] | delay:S | die-thread[:N] | notice[:N])"
     )
 
 
@@ -128,10 +137,28 @@ class FaultRegistry:
             fault = self._armed.get(point)
             return fault.fired if fault is not None else 0
 
+    def check(self, point: str) -> bool:
+        """Consume one charge of a ``notice``-armed point: True while
+        armed, False otherwise (and always False for non-notice kinds —
+        ``fire()`` owns those). Poll sites use this to receive injected
+        external signals deterministically."""
+        with self._lock:
+            fault = self._armed.get(point)
+            if fault is None or fault.kind != "notice":
+                return False
+            fault.fired += 1
+            self.total_fired += 1
+            if fault.remaining is not None:
+                fault.remaining -= 1
+                if fault.remaining <= 0:
+                    del self._armed[point]
+        logger.warning("failpoint %s: notice consumed", point)
+        return True
+
     def fire(self, point: str) -> None:
         with self._lock:
             fault = self._armed.get(point)
-            if fault is None:
+            if fault is None or fault.kind == "notice":
                 return
             fault.fired += 1
             self.total_fired += 1
@@ -164,6 +191,14 @@ def fire(point: str) -> None:
     if not _registry._armed:  # unlocked emptiness check: hot-path cheap
         return
     _registry.fire(point)
+
+
+def check(point: str) -> bool:
+    """Module-level fast path for notice points (see
+    :meth:`FaultRegistry.check`): False unless armed with ``notice``."""
+    if not _registry._armed:
+        return False
+    return _registry.check(point)
 
 
 class armed:

@@ -141,6 +141,15 @@ class KubeClient:
             self._base + path, verify=self._verify, **kw
         )
 
+    def _patch(self, path: str, body: dict, **kw):
+        kw.setdefault("timeout", self.DEFAULT_TIMEOUT)
+        headers = dict(kw.pop("headers", {}))
+        headers.setdefault("Content-Type", "application/merge-patch+json")
+        return self._session.patch(
+            self._base + path, data=json.dumps(body), headers=headers,
+            verify=self._verify, **kw
+        )
+
     # -- API surface ----------------------------------------------------------
 
     def get_pod(self, namespace: str, name: str) -> Optional[dict]:
@@ -196,6 +205,28 @@ class KubeClient:
             cont = (body.get("metadata") or {}).get("continue", "")
             if not cont:
                 return items
+
+    def patch_pod_annotations(
+        self, namespace: str, name: str, annotations: Dict[str, Optional[str]]
+    ) -> Optional[dict]:
+        """Merge-patch a pod's metadata.annotations (a None value deletes
+        the key, merge-patch semantics); returns None on 404 — a gone pod
+        needs no annotation, and callers retrying cleanup must be able to
+        tell "done" from "failed". The drain orchestrator stamps
+        ``elasticgpu.io/draining`` on its resident slice-member pods this
+        way, so cooperating agents re-form the survivor world BEFORE the
+        host dies."""
+        r = self._patch(
+            f"/api/v1/namespaces/{namespace}/pods/{name}",
+            {"metadata": {"annotations": annotations}},
+        )
+        if r.status_code == 404:
+            return None
+        if r.status_code != 200:
+            raise KubeError(
+                f"patch pod {namespace}/{name}: {r.status_code}"
+            )
+        return r.json()
 
     def create_event(self, namespace: str, event: dict) -> dict:
         """POST a core/v1 Event (reference RBAC granted this and never

@@ -47,6 +47,11 @@ ReasonChipHealthy = "TPUChipHealthy"
 ReasonAllocatableDrift = "TPUAllocatableDrift"
 ReasonSliceReformed = "TPUSliceReformed"
 ReasonSliceInconsistent = "TPUSliceInconsistent"
+# Graceful drain lifecycle (drain.py)
+ReasonMaintenanceImminent = "TPUMaintenanceImminent"
+ReasonNodeDraining = "TPUNodeDraining"
+ReasonNodeDrained = "TPUNodeDrained"
+ReasonDrainCancelled = "TPUDrainCancelled"
 
 
 class EventRecorder:

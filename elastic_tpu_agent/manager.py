@@ -100,6 +100,15 @@ class ManagerOptions:
     # membership snapshot stays fresh — bounds slice-tracking apiserver
     # traffic from the bind path and the reconciler alike.
     slice_membership_ttl_s: float = 5.0
+    # Graceful drain lifecycle (drain.py): the hard checkpoint deadline
+    # between the drain signal and binding reclaim, and the trigger-poll
+    # period (jittered 0.75x-1.25x). --drain-deadline / --drain-period.
+    drain_deadline_s: float = 300.0
+    drain_period_s: float = 2.0
+    # tpuvm operator: maintenance/preempted metadata poll TTL override
+    # (--maintenance-poll-ttl; None = the operator's default, env
+    # ELASTIC_TPU_MAINTENANCE_POLL_TTL also honored for tests).
+    maintenance_poll_ttl_s: Optional[float] = None
     # test seams
     kube_client: Optional[KubeClient] = None
     operator: object = None
@@ -126,7 +135,10 @@ def build_operator(opts: ManagerOptions):
             build_operator(replace(opts, operator_kind=inner_kind))
         )
     if kind == "tpuvm":
-        return TPUVMOperator(opts.dev_root)
+        return TPUVMOperator(
+            opts.dev_root,
+            maintenance_poll_ttl_s=opts.maintenance_poll_ttl_s,
+        )
     if kind.startswith("stub"):
         acc = kind.partition(":")[2] or "v5litepod-4"
         # Worker identity for multi-host simulations (kind clusters / CI):
@@ -299,11 +311,34 @@ class TPUManager:
             dry_run=opts.reconcile_dry_run,
             slice_reformer=self.slice_reformer,
         )
+        from .drain import DrainOrchestrator
+
+        # Graceful drain lifecycle (drain.py): maintenance events,
+        # preemption notices and operator-requested drains cordon +
+        # checkpoint-signal + proactively re-form slices + reclaim on a
+        # deadline, with every transition journaled in storage.
+        self.drain = DrainOrchestrator(
+            operator=self.operator,
+            plugin=self.plugin,
+            storage=self.storage,
+            sitter=self.sitter,
+            reconciler=self.reconciler,
+            kube_client=self.client,
+            events=self.events,
+            metrics=self.metrics,
+            node_name=opts.node_name,
+            deadline_s=opts.drain_deadline_s,
+            period_s=opts.drain_period_s,
+        )
+        # While the drain has reclaimed bindings, kubelet's still-listed
+        # assignments must not be replayed back by the reconciler.
+        self.reconciler.drain = self.drain
         if self.sampler is not None:
             # /debug/allocations and the doctor bundle carry the live
             # reconcile/journal state (open intents, per-class repairs).
             self.sampler.reconcile_status_fn = self.reconciler.status
             self.sampler.slice_status_fn = self.slice_registry.status
+            self.sampler.drain_status_fn = self.drain.status
         self.nri_plugin = None
         if opts.nri_socket:
             from .nri import NRIPlugin
@@ -393,6 +428,11 @@ class TPUManager:
         from .common import ResourceTPUCore, ResourceTPUMemory
         from .plugins.tpushare import chip_of_device_id
 
+        if getattr(self.plugin, "cordoned", False):
+            # A drain cordon advertises every device Unhealthy by
+            # design; comparing kubelet's (correctly shrunken) view
+            # against discovery would cry drift on every drained node.
+            return None
         try:
             resp = self.pr_client.get_allocatable_resources()
         except Exception as e:  # noqa: BLE001 - diagnostic, never fatal
@@ -490,6 +530,13 @@ class TPUManager:
                 self.crd_recorder.publish_inventory(self.operator.devices())
             except Exception:  # noqa: BLE001 - observability, never fatal
                 logger.exception("inventory publication failed")
+        # Journaled drain state BEFORE the boot reconcile: a node that
+        # rebooted mid-drain must re-enter the lifecycle (cordon back
+        # up, replay suppression armed) before the boot pass runs, or
+        # restore() would faithfully replay the very bindings the drain
+        # reclaimed. The supervised loop's own resume() is then a no-op
+        # re-read.
+        self.drain.resume()
         self.restore()
         # Device-plugin serve loops: one per extended resource, CRITICAL —
         # a dead ListAndWatch leaves kubelet advertising stale devices.
@@ -510,6 +557,11 @@ class TPUManager:
         # node binding (with the boot-converged state) while /healthz and
         # the doctor bundle surface the loss of self-repair.
         self.supervisor.register("reconciler", self.reconciler.run, DEGRADED)
+        # Drain orchestrator: DEGRADED — losing lifecycle handling must
+        # not take binding down; resume() re-enters the journaled drain
+        # on every (re)start, so a crashed loop (or agent) picks the
+        # drain back up where it died.
+        self.supervisor.register("drain", self.drain.run, DEGRADED)
         if self.sampler is not None:
             self.supervisor.register("sampler", self.sampler.run, DEGRADED)
         if self.nri_plugin is not None:
@@ -547,6 +599,8 @@ class TPUManager:
         # The reconciler both writes storage and submits CRD releases:
         # join it before the recorder stops and the db closes.
         self.supervisor.join("reconciler", timeout=10.0)
+        # The drain loop journals into storage and emits events too.
+        self.supervisor.join("drain", timeout=10.0)
         if self.nri_plugin is not None:
             self.nri_plugin.stop()
         if hasattr(self.plugin, "core"):

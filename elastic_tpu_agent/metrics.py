@@ -269,6 +269,33 @@ class AgentMetrics:
             ["slice"],
             **kw,
         )
+        # -- graceful drain lifecycle (drain.py) ---------------------------
+        self.maintenance_imminent = Gauge(
+            "elastic_tpu_maintenance_imminent",
+            "1 while GCE announces an upcoming host maintenance event "
+            "for this node (MIGRATE/TERMINATE_ON_HOST_MAINTENANCE), "
+            "else 0 — set the moment detection first trips, before any "
+            "drain work starts",
+            **kw,
+        )
+        self.drain_state = Gauge(
+            "elastic_tpu_drain_state",
+            "Drain lifecycle state of this node: 0=active 1=cordoned "
+            "2=draining 3=drained 4=reclaimed",
+            **kw,
+        )
+        self.drains_total = Counter(
+            "elastic_tpu_drains_total",
+            "Drain lifecycles started on this node, by trigger source",
+            ["trigger"],
+            **kw,
+        )
+        self.drain_reclaimed_pods = Counter(
+            "elastic_tpu_drain_reclaimed_pods_total",
+            "Resident pods whose bindings were reclaimed because the "
+            "drain deadline expired before they exited",
+            **kw,
+        )
         self.observability_dropped = Counter(
             "elastic_tpu_observability_dropped_total",
             "CRD/event writes dropped by the bounded async queue",

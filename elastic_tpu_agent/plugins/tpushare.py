@@ -256,6 +256,12 @@ class _TPUSharePluginBase(_ListAndWatchMixin, rpc.DevicePluginServicer):
         # nodes; advertisement/env/qos all branch on this one flag.
         self._whole_chip = not getattr(self._operator, "virtual_nodes", True)
         self._unhealthy_chips: set = set()
+        # Drain cordon (drain.py): while set, every device is advertised
+        # Unhealthy so kubelet stops NEW placements — but the chips are
+        # NOT in _unhealthy_chips, so no ChipUnhealthy events fire, the
+        # CRD inventory stays Available, eviction policy hooks stay
+        # quiet, and resident bindings ride on untouched.
+        self._cordoned = False
         self._alloc_dir = config.extra.get(
             "alloc_spec_dir", DEFAULT_ALLOC_SPEC_DIR
         )
@@ -282,9 +288,31 @@ class _TPUSharePluginBase(_ListAndWatchMixin, rpc.DevicePluginServicer):
 
     def _chip_health(self, chip_index: int) -> str:
         return (
-            rpc.UNHEALTHY if chip_index in self._unhealthy_chips
+            rpc.UNHEALTHY
+            if self._cordoned or chip_index in self._unhealthy_chips
             else rpc.HEALTHY
         )
+
+    @property
+    def cordoned(self) -> bool:
+        """True while a drain has this resource's devices advertised
+        unschedulable (distinct from unhealthy — see set_cordoned)."""
+        return self._cordoned
+
+    def set_cordoned(self, flag: bool) -> None:
+        """Flip the drain cordon and wake ListAndWatch so kubelet sees
+        every device Unhealthy (no new placements) or Healthy again —
+        WITHOUT touching the health accounting (no events, no CRD
+        Failed, no eviction hooks). Idempotent."""
+        flag = bool(flag)
+        if flag == self._cordoned:
+            return
+        self._cordoned = flag
+        logger.warning(
+            "%s: devices %s by drain cordon", self.resource,
+            "unschedulable" if flag else "re-schedulable",
+        )
+        self.notify_devices_changed()
 
     def apply_health(self, healthy: set) -> tuple:
         """Apply an operator health view; on change, flip device health and
@@ -986,14 +1014,17 @@ class _TPUSharePluginBase(_ListAndWatchMixin, rpc.DevicePluginServicer):
             return None
 
     def restamp_spec_env_locked(
-        self, owner, records: Dict, env_updates: Dict[str, str]
+        self, owner, records: Dict, env_updates: Dict[str, str],
+        remove_keys=(),
     ) -> int:
         """(owner's bind stripe held) Update env keys in EVERY on-disk
         spec of this container — the merged env and the pre-merge ``own``
         snapshot both, atomic per file — without re-running the bind.
         The slice reformer re-emits topology env at a new world size
-        through this; devices/chips stay untouched, so the container's
-        cgroup reality is never contradicted. Returns files restamped."""
+        through this, and the drain orchestrator stamps (and, on cancel,
+        removes via ``remove_keys``) the ELASTIC_TPU_DRAIN signal;
+        devices/chips stay untouched, so the container's cgroup reality
+        is never contradicted. Returns files restamped."""
         restamped = 0
         for record in records.values():
             path = os.path.join(
@@ -1004,11 +1035,25 @@ class _TPUSharePluginBase(_ListAndWatchMixin, rpc.DevicePluginServicer):
                     spec = json.load(f)
             except (OSError, ValueError):
                 continue
-            spec.setdefault("env", {}).update(env_updates)
+            targets = [spec.setdefault("env", {})]
             own = spec.get("own")
             if isinstance(own, dict):
-                own.setdefault("env", {}).update(env_updates)
-            _write_json_atomic(path, spec)
+                targets.append(own.setdefault("env", {}))
+            changed = False
+            for env in targets:
+                for key, value in env_updates.items():
+                    if env.get(key) != value:
+                        env[key] = value
+                        changed = True
+                for key in remove_keys:
+                    if env.pop(key, None) is not None:
+                        changed = True
+            if changed:
+                _write_json_atomic(path, spec)
+            # An already-correct spec still counts: callers (slice
+            # reform, the drain's per-tick re-signal) treat the count
+            # as "specs carrying the env", and the skip is what makes
+            # repeating the stamp every tick cheap.
             restamped += 1
         return restamped
 
@@ -1204,6 +1249,16 @@ class TPUSharePlugin:
             ResourceTPUCore: self.core,
             ResourceTPUMemory: self.memory,
         }.get(resource)
+
+    def set_cordoned(self, flag: bool) -> None:
+        """Drain cordon across BOTH resources (they must never disagree
+        about schedulability, exactly like health)."""
+        self.core.set_cordoned(flag)
+        self.memory.set_cordoned(flag)
+
+    @property
+    def cordoned(self) -> bool:
+        return self.core.cordoned
 
     def bind_stats(self) -> Dict:
         """Bind-pipeline introspection: in-flight binds, totals, the gRPC

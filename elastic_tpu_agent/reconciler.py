@@ -151,6 +151,10 @@ class Reconciler:
         # SliceReformer (slices/recovery.py): slice membership is a
         # divergence class — member loss re-forms the survivors.
         self._slices = slice_reformer
+        # DrainOrchestrator (drain.py), assigned by the manager after
+        # both exist: while a drain has reclaimed this node's bindings,
+        # kubelet's still-listed assignments must NOT be replayed back.
+        self.drain = None
         self._rng = rng if rng is not None else random.Random()
         self._lock = threading.Lock()
         self._repairs: Dict[str, int] = {k: 0 for k in ALL_KINDS}
@@ -543,6 +547,17 @@ class Reconciler:
             report["kept_pods"] += 1
             for container, by_resource in list(info.allocations.items()):
                 for resource, record in list(by_resource.items()):
+                    if self.drain is not None and (
+                        self.drain.suppress_replays()
+                    ):
+                        # Drain reclaim is tearing bindings down while
+                        # this pass walks a pre-reclaim record snapshot:
+                        # re-creating "missing" links or rebinding
+                        # "missing" specs here would resurrect exactly
+                        # what the drain just removed. Checked per
+                        # record (not per pass) so a reclaim starting
+                        # mid-pass stops the rebuilds immediately.
+                        continue
                     owner = PodContainer(
                         info.namespace, info.name, container
                     )
@@ -659,7 +674,14 @@ class Reconciler:
             with self._lock:
                 self._replay_failures_total += 1
 
-    def _reclaim_pod(self, info, report: dict) -> None:
+    def _reclaim_pod(self, info, report: dict, locked: bool = False) -> None:
+        """``locked=True`` = the caller already holds the owner's bind
+        stripe (drain_reclaim tears down LIVE pods from the drain
+        thread and must serialize against binds and this reconciler's
+        own repairs); the stripes are not reentrant, so the spec
+        removal switches to its ``_locked`` variant. The historical
+        dead-pod path stays unlocked — it only ever ran on the single
+        reconciler thread for pods that no longer exist."""
         spec_plugin = self._spec_plugin()
         for container, by_resource in info.allocations.items():
             owner = PodContainer(info.namespace, info.name, container)
@@ -674,7 +696,14 @@ class Reconciler:
                         )
                         self._sweep_failure(report)
                 if spec_plugin is not None:
-                    spec_plugin.remove_alloc_spec(record.device.hash, owner)
+                    if locked:
+                        spec_plugin.remove_alloc_spec_locked(
+                            record.device.hash, owner
+                        )
+                    else:
+                        spec_plugin.remove_alloc_spec(
+                            record.device.hash, owner
+                        )
                 if self._crd is not None:
                     try:
                         self._crd.record_released(record.device.hash)
@@ -683,6 +712,40 @@ class Reconciler:
         self._storage.delete(info.namespace, info.name)
         self._count(report, KIND_RECLAIMED_POD)
         logger.info("reconcile: reclaimed dead pod %s", info.key)
+
+    def drain_reclaim(self, pod_keys) -> dict:
+        """Drain-deadline reclaim (drain.py): tear down the named pods'
+        bindings — links, specs, CRD releases, store records — through
+        the SAME repair executor the reconciler uses for dead pods, so
+        the work is counted under the ``reclaimed_pod`` divergence class
+        and leaves zero orphan artifacts. The pods may still be live at
+        the apiserver; the caller suppresses replays until eviction.
+        Each pod's teardown runs under the owner's bind stripe — this
+        is called from the DRAIN thread against LIVE pods, so it must
+        serialize against in-flight binds and the reconcile pass's own
+        repairs exactly like the drift repair does."""
+        from .plugins import tpushare
+
+        report = _new_report(boot=False, dry_run=False)
+        for pod_key in pod_keys:
+            namespace, name = parse_pod_key(pod_key)
+            try:
+                info = self._storage.load(namespace, name)
+            except StorageError:
+                logger.warning(
+                    "drain reclaim: %s has a corrupt record; left for "
+                    "the corrupt-row runbook", pod_key,
+                )
+                continue
+            if info is None:
+                continue
+            try:
+                with tpushare.bind_lock(pod_key):
+                    self._reclaim_pod(info, report, locked=True)
+            except Exception:  # noqa: BLE001 - keep reclaiming the rest
+                logger.exception("drain reclaim: %s failed", pod_key)
+                self._sweep_failure(report)
+        return report
 
     # -- orphan sweep ---------------------------------------------------------
 
@@ -784,6 +847,16 @@ class Reconciler:
         no record: a bind that crashed before its checkpoint (or whose
         intent was rolled back above). Replay it end to end."""
         if assignments is None:
+            return
+        if self.drain is not None and self.drain.suppress_replays():
+            # Drain reclaimed this node's bindings past the deadline;
+            # the pods (and their kubelet assignments) may outlive the
+            # reclaim until eviction. Replaying them would faithfully
+            # re-bind everything the drain just tore down.
+            logger.info(
+                "reconcile: unbound-assignment replay suppressed "
+                "(node drain reclaimed bindings)"
+            )
             return
         for resource in sorted(assignments):
             plugin = self._plugin_for(resource)

@@ -123,6 +123,12 @@ class UtilizationSampler:
         # from SliceRegistry.status(); the `slices` block of
         # /debug/allocations and the doctor bundle.
         self.slice_status_fn: Optional[Callable[[], dict]] = None
+        # Also manager-set: () -> drain-orchestrator status (lifecycle
+        # state, trigger, deadline, signalled/reclaimed pods) from
+        # DrainOrchestrator.status(); the `drain` block of
+        # /debug/allocations and the doctor bundle — drain-stuck triage
+        # must work from a bundle alone.
+        self.drain_status_fn: Optional[Callable[[], dict]] = None
         # Also manager-set: () -> set of unhealthy chip indexes, the
         # plugin's APPLIED health view. Snapshots must read this (a
         # plain set copy) instead of re-probing the operator:
@@ -606,6 +612,11 @@ class UtilizationSampler:
                 out["slices"] = self.slice_status_fn()
             except Exception:  # noqa: BLE001 - introspection only
                 pass
+        if self.drain_status_fn is not None:
+            try:
+                out["drain"] = self.drain_status_fn()
+            except Exception:  # noqa: BLE001 - introspection only
+                pass
         return out
 
 
@@ -820,6 +831,25 @@ def validate_bundle(bundle: dict) -> List[str]:
             for field in ("hosts", "world_size", "epoch", "reforms_total"):
                 expect(field in sl,
                        f"allocations.slices[{name!r}] missing {field!r}")
+    if isinstance(allocations, dict) and "drain" in allocations:
+        # absent in pre-drain-orchestrator bundles and when no drain
+        # status hook is attached (standalone node-doctor)
+        drain = allocations["drain"]
+        expect(isinstance(drain, dict), "allocations.drain must be an object")
+        if isinstance(drain, dict):
+            for field in ("state", "trigger", "drains_total"):
+                expect(field in drain,
+                       f"allocations.drain missing {field!r}")
+            expect(
+                drain.get("state") in (
+                    "active", "cordoned", "draining", "drained", "reclaimed",
+                ),
+                f"allocations.drain.state {drain.get('state')!r} is not a "
+                "lifecycle state",
+            )
+            for field in ("stamped_pods", "reclaimed_pods"):
+                expect(isinstance(drain.get(field, []), list),
+                       f"allocations.drain.{field} must be a list")
     windows = bundle.get("sampler_windows")
     expect(isinstance(windows, dict), "sampler_windows must be an object")
     if isinstance(windows, dict):

@@ -84,6 +84,8 @@ class SimNode:
         self.metrics = None
         self.metrics_url: str = ""
         self.dead = False  # killed by a chaos scenario (kill_node)
+        self.opts: Optional[ManagerOptions] = None  # kept for restart_node
+        self.operator_kind: str = ""
 
     @property
     def storage(self):
@@ -124,10 +126,18 @@ class FleetSim:
         enable_sampler: bool = False,
         core_units_per_pod: int = 10,
         slice_membership_ttl_s: float = 1.0,
+        operator_kinds: Optional[List[str]] = None,
+        drain_deadline_s: float = 5.0,
+        drain_period_s: float = 0.5,
     ) -> None:
         self.base_dir = base_dir
         self.n_nodes = nodes
         self.operator_kind = operator_kind
+        # Heterogeneous fleet (ROADMAP item 5): one operator kind PER
+        # NODE, cycling through the list — e.g. ["stub:v4-8",
+        # "stub:v5litepod-8", "stub:v6e-8"] mixes generations with
+        # per-generation core-count/HBM shapes from topology.CHIP_SPECS.
+        self.operator_kinds = list(operator_kinds or [])
         self.reconcile_period_s = reconcile_period_s
         self.dp_pool_size = dp_pool_size
         self.enable_sampler = enable_sampler
@@ -135,6 +145,10 @@ class FleetSim:
         # Short TTL: a chaos scenario expects reform within a few
         # reconcile periods, not after a production-sized cache window.
         self.slice_membership_ttl_s = slice_membership_ttl_s
+        # Drain lifecycle pacing: sim deadlines are seconds, not the
+        # production 300s — chaos scenarios assert reclaim-on-deadline.
+        self.drain_deadline_s = drain_deadline_s
+        self.drain_period_s = drain_period_s
         self.nodes: List[SimNode] = []
         self.apiserver = None
         self.api_url = ""
@@ -181,10 +195,14 @@ class FleetSim:
             node.metrics = AgentMetrics(registry=CollectorRegistry())
             httpd = node.metrics.serve(0)  # ephemeral loopback port
             node.metrics_url = f"http://127.0.0.1:{httpd.server_address[1]}"
-            opts = ManagerOptions(
+            node.operator_kind = (
+                self.operator_kinds[i % len(self.operator_kinds)]
+                if self.operator_kinds else self.operator_kind
+            )
+            node.opts = ManagerOptions(
                 node_name=node.name,
                 db_path=os.path.join(node.root, "meta.db"),
-                operator_kind=self.operator_kind,
+                operator_kind=node.operator_kind,
                 dev_root=os.path.join(node.root, "dev"),
                 device_plugin_dir=os.path.join(node.root, "dp"),
                 pod_resources_socket=os.path.join(
@@ -197,8 +215,10 @@ class FleetSim:
                 enable_sampler=self.enable_sampler,
                 reconcile_period_s=self.reconcile_period_s,
                 slice_membership_ttl_s=self.slice_membership_ttl_s,
+                drain_deadline_s=self.drain_deadline_s,
+                drain_period_s=self.drain_period_s,
             )
-            node.manager = TPUManager(opts)
+            node.manager = TPUManager(node.opts)
             node.manager.run(block=False)
             self.nodes.append(node)  # appended first: stop() reaps it
             if not node.kubelet.wait_registrations(2, timeout=20):
@@ -258,6 +278,77 @@ class FleetSim:
                 closer()
             except Exception:  # noqa: BLE001 - a kill is best-effort
                 pass
+        return node
+
+    # -- chaos: drain lifecycle (drain.py) ------------------------------------
+
+    def trigger_maintenance(
+        self, idx: int, event: str = "TERMINATE_ON_HOST_MAINTENANCE"
+    ) -> None:
+        """Announce a GCE maintenance event on one node's stub operator;
+        the node's drain orchestrator picks it up on its next poll."""
+        self.nodes[idx].manager.operator.set_maintenance_event(event)
+
+    def clear_maintenance(self, idx: int) -> None:
+        self.nodes[idx].manager.operator.set_maintenance_event("NONE")
+
+    def trigger_preemption(self, idx: int) -> None:
+        """Spot-preemption notice: never un-rings (like real GCE)."""
+        self.nodes[idx].manager.operator.set_preempted(True)
+
+    def drain_status(self, idx: int) -> Dict:
+        return self.nodes[idx].manager.drain.status()
+
+    def wait_drain_state(
+        self, idx: int, states, timeout_s: float = 30.0
+    ) -> str:
+        """Block until node ``idx``'s drain lifecycle reaches one of
+        ``states``; returns the state reached."""
+        states = {states} if isinstance(states, str) else set(states)
+        deadline = time.monotonic() + timeout_s
+        while True:
+            state = self.nodes[idx].manager.drain.state
+            if state in states:
+                return state
+            if time.monotonic() > deadline:
+                raise RuntimeError(
+                    f"{self.nodes[idx].name}: drain state {state!r} never "
+                    f"reached {sorted(states)} "
+                    f"(status: {self.drain_status(idx)})"
+                )
+            time.sleep(0.02)
+
+    def restart_node(self, idx: int) -> SimNode:
+        """Kill and re-boot one node's AGENT over its surviving
+        db/kubelet/disk — the mid-drain restart scenario: the new manager
+        must resume the journaled drain lifecycle (cordon, deadline,
+        replay suppression) before its boot reconcile runs."""
+        node = self.nodes[idx]
+        old_op = node.manager.operator
+        # The stub operator is process memory; the real metadata server
+        # would still be announcing the event to the restarted agent, so
+        # carry any injected maintenance/preemption state across.
+        maint = (
+            old_op.maintenance_event()
+            if hasattr(old_op, "maintenance_event") else None
+        )
+        preempted = old_op.preempted() if hasattr(old_op, "preempted") else False
+        try:
+            node.manager.stop()
+        except Exception:  # noqa: BLE001 - a crash is allowed to be messy
+            pass
+        prior = len(node.kubelet.registrations)  # count is cumulative
+        node.manager = TPUManager(node.opts)
+        new_op = node.manager.operator
+        if maint and hasattr(new_op, "set_maintenance_event"):
+            new_op.set_maintenance_event(maint)
+        if preempted and hasattr(new_op, "set_preempted"):
+            new_op.set_preempted(True)
+        node.manager.run(block=False)
+        if not node.kubelet.wait_registrations(prior + 2, timeout=20):
+            raise RuntimeError(
+                f"{node.name}: restarted agent failed to re-register"
+            )
         return node
 
     # -- admission (the scheduler's half) -------------------------------------

@@ -246,11 +246,20 @@ class SliceRegistry:
 
     @staticmethod
     def _pod_is_live(pod: dict) -> bool:
-        """A member is live only while its pod can still run: draining
+        """A member is live only while its pod can still run: deleting
         (deletionTimestamp) and terminal phases are OUT — a Failed pod
         that kube GC retains must not keep blocking reform while the
-        fabric is already missing its worker."""
-        if (pod.get("metadata", {}) or {}).get("deletionTimestamp"):
+        fabric is already missing its worker. A pod its own agent marked
+        ``elasticgpu.io/draining`` is out too: that is the PROACTIVE
+        loss signal (drain.py) — the host is going away on a deadline,
+        and counting it lost now lets the survivor world form BEFORE the
+        loss instead of after a divergence pass."""
+        from ..common import AnnotationDraining
+
+        meta = pod.get("metadata", {}) or {}
+        if meta.get("deletionTimestamp"):
+            return False
+        if (meta.get("annotations", {}) or {}).get(AnnotationDraining):
             return False
         phase = (pod.get("status", {}) or {}).get("phase", "")
         return phase not in ("Succeeded", "Failed")

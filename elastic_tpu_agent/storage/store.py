@@ -67,6 +67,18 @@ CREATE TABLE IF NOT EXISTS bind_intents (
 );
 """
 
+# Durable agent-lifecycle state (drain.py journals its state machine
+# here, same crash-consistency discipline as bind intents: the row is
+# written BEFORE the side effects of a transition, so an agent killed
+# mid-drain resumes the drain — cordon, deadline and all — on restart).
+_STATE_SCHEMA = """
+CREATE TABLE IF NOT EXISTS agent_state (
+    key        TEXT PRIMARY KEY,
+    value      TEXT NOT NULL,    -- JSON
+    updated_ts REAL NOT NULL
+);
+"""
+
 
 class Storage:
     """Thread-safe persistent map of pod key -> PodInfo.
@@ -114,6 +126,7 @@ class Storage:
             self._db.execute(f"PRAGMA busy_timeout={BUSY_TIMEOUT_MS}")
             self._db.execute(_SCHEMA)
             self._db.execute(_JOURNAL_SCHEMA)
+            self._db.execute(_STATE_SCHEMA)
             self._db.commit()
         except sqlite3.Error as e:
             raise StorageError(f"open {path}: {e}") from e
@@ -410,6 +423,51 @@ class Storage:
             }
             for i in self.open_intents()
         ]
+
+    # -- durable agent state (drain lifecycle journal) ------------------------
+
+    def save_state(self, key: str, value: dict) -> None:
+        """Persist one JSON state document under ``key`` (upsert).
+        Written BEFORE the side effects of the transition it describes —
+        the drain orchestrator's crash-consistency contract."""
+        faults.fire("storage.state")
+        with self._lock:
+            self._write(
+                f"save_state {key}",
+                "INSERT INTO agent_state(key, value, updated_ts) "
+                "VALUES(?, ?, ?) ON CONFLICT(key) DO UPDATE SET "
+                "value=excluded.value, updated_ts=excluded.updated_ts",
+                (key, json.dumps(value, sort_keys=True), time.time()),
+            )
+
+    def load_state(self, key: str) -> Optional[dict]:
+        """The stored state document, or None when absent/corrupt (a
+        corrupt row is logged and treated as absent — lifecycle state is
+        always safely re-derivable from a fresh start)."""
+        with self._lock:
+            try:
+                row = self._db.execute(
+                    "SELECT value FROM agent_state WHERE key=?", (key,)
+                ).fetchone()
+            except sqlite3.Error as e:
+                raise StorageError(f"load_state {key}: {e}") from e
+        if row is None:
+            return None
+        try:
+            value = json.loads(row[0])
+        except ValueError:
+            logger.warning("corrupt agent_state row %r; treating as absent",
+                           key)
+            return None
+        return value if isinstance(value, dict) else None
+
+    def delete_state(self, key: str) -> None:
+        with self._lock:
+            self._write(
+                f"delete_state {key}",
+                "DELETE FROM agent_state WHERE key=?",
+                (key,),
+            )
 
     def for_each(self, fn: Callable[[PodInfo], None]) -> None:
         """Invoke fn on a snapshot of every stored PodInfo.

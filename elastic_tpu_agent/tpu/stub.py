@@ -37,6 +37,8 @@ class StubOperator(LinkingOperator):
         self._worker_hostnames = list(worker_hostnames or [])
         self._unhealthy: set = set()
         self._utilization: dict = {}
+        self._maintenance_event = "NONE"
+        self._preempted = False
 
     @property
     def topology(self) -> TopologyInfo:
@@ -57,6 +59,26 @@ class StubOperator(LinkingOperator):
 
     def healthy_indexes(self) -> set:
         return {c.index for c in self.devices()} - self._unhealthy
+
+    # -- drain trigger injection (mirrors tpuvm maintenance_event/preempted) --
+
+    def set_maintenance_event(self, event: str) -> None:
+        """Inject a GCE-style maintenance announcement
+        ("MIGRATE_ON_HOST_MAINTENANCE"/"TERMINATE_ON_HOST_MAINTENANCE";
+        "NONE" clears it) — the drain orchestrator's trigger in chaos
+        scenarios and the fleet sim."""
+        self._maintenance_event = event
+
+    def maintenance_event(self) -> str:
+        return self._maintenance_event
+
+    def set_preempted(self, flag: bool) -> None:
+        """Inject a spot/preemption notice (never clears on real GCE;
+        tests may clear it to exercise state transitions)."""
+        self._preempted = bool(flag)
+
+    def preempted(self) -> bool:
+        return self._preempted
 
     # -- utilization telemetry injection (mirrors tpuvm.utilization) ----------
 

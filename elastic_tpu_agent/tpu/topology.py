@@ -43,6 +43,11 @@ _SPECS: Dict[str, ChipSpec] = {
     "v6e": ChipSpec("v6e", 1, 32 * GiB, 8, False),
 }
 
+# Public name for the generation table: heterogeneous-fleet consumers
+# (stub operators per generation, the fleet sim's mixed node shapes,
+# parametrized generation tests) iterate it by family key.
+CHIP_SPECS = _SPECS
+
 # Accepted accelerator-type spellings -> family key.
 _FAMILY_ALIASES = {
     "v2": "v2",

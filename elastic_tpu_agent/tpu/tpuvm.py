@@ -36,14 +36,22 @@ _MAINTENANCE_EVENT_URL = (
     "http://metadata.google.internal/computeMetadata/v1/instance/"
     "maintenance-event"
 )
+# spot/preemptible VMs: flips to TRUE when the instance is being
+# preempted (the ACPI G2 notice window) — the drain orchestrator's
+# second trigger source.
+_PREEMPTED_URL = (
+    "http://metadata.google.internal/computeMetadata/v1/instance/preempted"
+)
 _METADATA_HEADERS = {"Metadata-Flavor": "Google"}
 _METADATA_TIMEOUT_S = 2.0
 
-# Health-poll cost control: maintenance-event is re-fetched at most every
-# POLL_TTL (the 5s health loop must not hammer metadata), and after a
-# transport failure (non-GCE host, kind node) the endpoint is left alone
-# for ERROR_BACKOFF so health polling stays cheap where there is no
-# metadata server at all.
+# Poll cost control defaults: maintenance-event / preempted are
+# re-fetched at most every POLL_TTL (the drain poll loop must not hammer
+# metadata), and after a transport failure (non-GCE host, kind node) the
+# endpoint is left alone for ERROR_BACKOFF so polling stays cheap where
+# there is no metadata server at all. Overridable per instance
+# (constructor / --maintenance-poll-ttl) and via env for tests:
+# ELASTIC_TPU_MAINTENANCE_POLL_TTL / ELASTIC_TPU_MAINTENANCE_ERROR_BACKOFF.
 _MAINTENANCE_POLL_TTL_S = 30.0
 _MAINTENANCE_ERROR_BACKOFF_S = 300.0
 
@@ -93,6 +101,12 @@ def _default_maintenance_fetcher() -> Optional[str]:
     "MIGRATE_ON_HOST_MAINTENANCE"/"TERMINATE_ON_HOST_MAINTENANCE" when an
     event is imminent); None when the endpoint is unreachable."""
     return _fetch_metadata_url(_MAINTENANCE_EVENT_URL)
+
+
+def _default_preempted_fetcher() -> Optional[str]:
+    """Current GCE ``preempted`` value ("TRUE"/"FALSE"); None when the
+    endpoint is unreachable (non-GCE or non-preemptible host)."""
+    return _fetch_metadata_url(_PREEMPTED_URL)
 
 
 _COUNTER_WALK_DEPTH = 3
@@ -204,6 +218,9 @@ class TPUVMOperator(LinkingOperator):
         env: Optional[Dict[str, str]] = None,
         maintenance: Callable[[], Optional[str]] = _default_maintenance_fetcher,
         sys_accel_root: Optional[str] = None,
+        preemption: Callable[[], Optional[str]] = _default_preempted_fetcher,
+        maintenance_poll_ttl_s: Optional[float] = None,
+        maintenance_error_backoff_s: Optional[float] = None,
     ) -> None:
         # dev_root: where virtual links are created (host /dev mount).
         # host_dev_scan_root: where to look for accel* chardevs (defaults to
@@ -221,6 +238,27 @@ class TPUVMOperator(LinkingOperator):
         self._maintenance = maintenance
         self._maint_cached: Optional[str] = None
         self._maint_next_poll = 0.0
+        self._preemption = preemption
+        self._preempt_cached: Optional[str] = None
+        self._preempt_next_poll = 0.0
+
+        def _ttl(env_key: str, arg: Optional[float], default: float) -> float:
+            if arg is not None:
+                return arg
+            raw = self._env.get(env_key)
+            try:
+                return float(raw) if raw else default
+            except ValueError:
+                return default
+
+        self._maint_poll_ttl_s = _ttl(
+            "ELASTIC_TPU_MAINTENANCE_POLL_TTL",
+            maintenance_poll_ttl_s, _MAINTENANCE_POLL_TTL_S,
+        )
+        self._maint_error_backoff_s = _ttl(
+            "ELASTIC_TPU_MAINTENANCE_ERROR_BACKOFF",
+            maintenance_error_backoff_s, _MAINTENANCE_ERROR_BACKOFF_S,
+        )
         self._sys_root = sys_accel_root or self._env.get(
             "ELASTIC_TPU_SYS_ACCEL_ROOT", _SYS_ACCEL_ROOT
         )
@@ -352,20 +390,40 @@ class TPUVMOperator(LinkingOperator):
 
     # -- health ---------------------------------------------------------------
 
-    def _maintenance_imminent(self) -> bool:
-        """True while GCE reports an upcoming host maintenance event.
-        Cached: success for _MAINTENANCE_POLL_TTL_S, transport failure for
-        _MAINTENANCE_ERROR_BACKOFF_S (non-GCE hosts have no endpoint and
-        must not pay a 2s timeout on every 5s health tick)."""
+    def maintenance_event(self) -> Optional[str]:
+        """The current GCE maintenance-event value, TTL-cached: "NONE"
+        while quiet, the event name while one is announced, None while
+        the endpoint is unreachable. The drain orchestrator's trigger
+        source — an announced event cordons + drains the node instead of
+        flipping chips unhealthy (drain.py owns the response)."""
         now = time.monotonic()
         if now >= self._maint_next_poll:
             val = self._maintenance()
             self._maint_cached = val
             self._maint_next_poll = now + (
-                _MAINTENANCE_POLL_TTL_S if val is not None
-                else _MAINTENANCE_ERROR_BACKOFF_S
+                self._maint_poll_ttl_s if val is not None
+                else self._maint_error_backoff_s
             )
-        return self._maint_cached not in (None, "", "NONE")
+        return self._maint_cached
+
+    def _maintenance_imminent(self) -> bool:
+        """True while GCE reports an upcoming host maintenance event
+        (TTL-cached via :meth:`maintenance_event`)."""
+        return self.maintenance_event() not in (None, "", "NONE")
+
+    def preempted(self) -> bool:
+        """True once GCE announces this (spot/preemptible) instance is
+        being preempted. Same TTL/backoff discipline as the maintenance
+        poll; a host with no ``preempted`` endpoint reads False."""
+        now = time.monotonic()
+        if now >= self._preempt_next_poll:
+            val = self._preemption()
+            self._preempt_cached = val
+            self._preempt_next_poll = now + (
+                self._maint_poll_ttl_s if val is not None
+                else self._maint_error_backoff_s
+            )
+        return (self._preempt_cached or "").strip().upper() == "TRUE"
 
     def _matching_counter_values(self, chip_dir: str):
         """(name, path, value) for every readable error-counter file under
@@ -411,26 +469,23 @@ class TPUVMOperator(LinkingOperator):
 
     def healthy_indexes(self) -> set:
         """A chip is healthy while (a) its /dev/accelN chardev is present
-        (a wedged/detached chip drops its node), (b) no sysfs fatal-error
-        counter has risen since baseline, and (c) GCE is not announcing a
-        host maintenance event — an imminent migration/termination drains
-        NEW placements off every chip while existing bindings ride out the
-        event (checkpoint/resume is the recovery path)."""
+        (a wedged/detached chip drops its node) and (b) no sysfs
+        fatal-error counter has risen since baseline.
+
+        A GCE maintenance event deliberately does NOT fail health any
+        more: flipping every chip unhealthy stranded resident workloads
+        with no checkpoint signal and let slice peers discover the loss
+        after the fact. The drain orchestrator (drain.py) polls
+        :meth:`maintenance_event` / :meth:`preempted` and responds with
+        the graceful lifecycle instead — cordon (unschedulable without
+        unhealthy), checkpoint-signal residents, proactively re-form
+        slices, then reclaim on a deadline."""
         present = self._accel_indexes()
         self._ever_present.update(present)
         reasons = {
             i: "device node missing"
             for i in self._ever_present if i not in present
         }
-        if self._maintenance_imminent():
-            for i in present:
-                reasons[i] = (
-                    f"host maintenance event: {self._maint_cached}"
-                )
-            # error chips keep their specific cause even through an event
-            reasons.update(self._sticky_reasons)
-            self._health_reasons = reasons
-            return set()
         self._scan_error_counters(present)
         for i in self._error_chips:
             reasons[i] = self._sticky_reasons.get(

@@ -39,6 +39,15 @@ class FakeAPIServer:
             self._pods[key] = pod
             self._notify({"type": etype, "object": pod})
 
+    def has_pod(self, namespace: str, name: str) -> bool:
+        with self._lock:
+            return (namespace, name) in self._pods
+
+    def get_pod(self, namespace: str, name: str) -> Optional[dict]:
+        with self._lock:
+            pod = self._pods.get((namespace, name))
+            return json.loads(json.dumps(pod)) if pod is not None else None
+
     def delete_pod(self, namespace: str, name: str) -> None:
         with self._lock:
             pod = self._pods.pop((namespace, name), None)
@@ -46,9 +55,24 @@ class FakeAPIServer:
                 self._rv += 1
                 self._notify({"type": "DELETED", "object": pod})
 
-    def add_node(self, name: str) -> None:
+    def add_node(self, name: str, annotations: Optional[dict] = None) -> None:
         with self._lock:
-            self._nodes[name] = {"metadata": {"name": name}}
+            self._nodes[name] = {
+                "metadata": {"name": name, "annotations": annotations or {}}
+            }
+
+    def annotate_node(self, name: str, key: str, value: Optional[str]) -> None:
+        """Set (or, with ``value=None``, remove) one node annotation —
+        the driver side of the operator-requested drain trigger."""
+        with self._lock:
+            node = self._nodes.setdefault(
+                name, {"metadata": {"name": name, "annotations": {}}}
+            )
+            ann = node["metadata"].setdefault("annotations", {})
+            if value is None:
+                ann.pop(key, None)
+            else:
+                ann[key] = value
 
     def _notify(self, event: dict) -> None:
         self._events.append((self._rv, event))
@@ -290,6 +314,44 @@ class FakeAPIServer:
                     if err is not None:
                         return self._json(*err)
                     return self._json(200, obj)
+                return self._json(404, {"kind": "Status", "code": 404})
+
+            def do_PATCH(self):  # noqa: N802
+                parts = [p for p in urlparse(self.path).path.split("/") if p]
+                # merge-patch a pod: /api/v1/namespaces/<ns>/pods/<name>
+                # (only metadata.annotations merge semantics are
+                # implemented — the one shape the agent sends: the drain
+                # orchestrator's elasticgpu.io/draining stamp; a None
+                # value deletes the key, per RFC 7386)
+                if (
+                    len(parts) == 6
+                    and parts[:3] == ["api", "v1", "namespaces"]
+                    and parts[4] == "pods"
+                ):
+                    ns, name = parts[3], parts[5]
+                    patch = self._read_body()
+                    with outer._lock:
+                        pod = outer._pods.get((ns, name))
+                        if pod is None:
+                            return self._json(
+                                404, {"kind": "Status", "code": 404}
+                            )
+                        ann_patch = (
+                            patch.get("metadata", {}) or {}
+                        ).get("annotations")
+                        if ann_patch is not None:
+                            ann = pod.setdefault("metadata", {}).setdefault(
+                                "annotations", {}
+                            )
+                            for k, v in ann_patch.items():
+                                if v is None:
+                                    ann.pop(k, None)
+                                else:
+                                    ann[k] = v
+                        outer._rv += 1
+                        pod["metadata"]["resourceVersion"] = str(outer._rv)
+                        outer._notify({"type": "MODIFIED", "object": pod})
+                        return self._json(200, pod)
                 return self._json(404, {"kind": "Status", "code": 404})
 
             def do_DELETE(self):  # noqa: N802

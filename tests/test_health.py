@@ -200,31 +200,35 @@ def _tpuvm_op(tmp_path, **kw):
     )
 
 
-def test_maintenance_event_drains_all_chips(tmp_path):
-    """A GCE maintenance event (VM about to migrate/terminate) marks every
-    chip unhealthy so kubelet places nothing new; clearing the event
-    restores them. Fault-injected via the maintenance fetcher."""
-    import elastic_tpu_agent.tpu.tpuvm as tpuvm_mod
-
+def test_maintenance_event_no_longer_fails_health(tmp_path):
+    """A GCE maintenance event does NOT flip chips unhealthy any more —
+    that stranded resident workloads with no checkpoint signal. The
+    value is surfaced via maintenance_event() for the drain
+    orchestrator (drain.py), which responds with cordon + graceful
+    drain instead."""
     state = {"event": "NONE"}
     op = _tpuvm_op(tmp_path, maintenance=lambda: state["event"])
-    # defeat the poll TTL so every healthy_indexes() re-fetches
     op._maint_next_poll = 0.0
     assert op.healthy_indexes() == {0, 1, 2, 3}
 
     state["event"] = "MIGRATE_ON_HOST_MAINTENANCE"
     op._maint_next_poll = 0.0
-    assert op.healthy_indexes() == set()
-    assert "maintenance" in op.health_reasons()[0]
+    assert op.maintenance_event() == "MIGRATE_ON_HOST_MAINTENANCE"
+    assert op.healthy_indexes() == {0, 1, 2, 3}, (
+        "maintenance must not fail health — the drain owns the response"
+    )
+    assert 0 not in op.health_reasons()
 
     state["event"] = "NONE"
     op._maint_next_poll = 0.0
+    assert op.maintenance_event() == "NONE"
     assert op.healthy_indexes() == {0, 1, 2, 3}
 
 
 def test_maintenance_fetch_failure_backs_off(tmp_path):
     """Non-GCE hosts (kind, CI) have no metadata endpoint: one failed
-    fetch must back off instead of paying the timeout every 5s tick."""
+    fetch must back off instead of paying the timeout on every drain
+    poll tick."""
     calls = {"n": 0}
 
     def failing():
@@ -232,9 +236,51 @@ def test_maintenance_fetch_failure_backs_off(tmp_path):
         return None
 
     op = _tpuvm_op(tmp_path, maintenance=failing)
-    assert op.healthy_indexes() == {0, 1, 2, 3}
-    assert op.healthy_indexes() == {0, 1, 2, 3}
+    assert op.maintenance_event() is None
+    assert op.maintenance_event() is None
     assert calls["n"] == 1, "no backoff after transport failure"
+
+
+def test_preempted_endpoint_and_backoff(tmp_path):
+    """The spot-preemption poll: TRUE reads preempted; an unreachable
+    endpoint reads False and backs off like the maintenance poll."""
+    state = {"value": "FALSE"}
+    calls = {"n": 0}
+
+    def fetch():
+        calls["n"] += 1
+        return state["value"]
+
+    op = _tpuvm_op(tmp_path, preemption=fetch)
+    assert op.preempted() is False
+    state["value"] = "TRUE"
+    op._preempt_next_poll = 0.0
+    assert op.preempted() is True
+    # unreachable endpoint: cached False under the error backoff
+    op2 = _tpuvm_op(tmp_path, preemption=lambda: None)
+    assert op2.preempted() is False
+    assert op2.preempted() is False
+
+
+def test_maintenance_poll_ttl_env_override(tmp_path):
+    """Satellite: the hardcoded poll TTL is configurable — constructor
+    arg and ELASTIC_TPU_MAINTENANCE_POLL_TTL env override (tests/fast
+    drain reaction)."""
+    op = _tpuvm_op(
+        tmp_path, maintenance=lambda: "NONE",
+        env={
+            "TPU_ACCELERATOR_TYPE": "v5litepod-4",
+            "ELASTIC_TPU_MAINTENANCE_POLL_TTL": "0.01",
+            "ELASTIC_TPU_MAINTENANCE_ERROR_BACKOFF": "0.02",
+        },
+    )
+    assert op._maint_poll_ttl_s == 0.01
+    assert op._maint_error_backoff_s == 0.02
+    op2 = _tpuvm_op(
+        tmp_path, maintenance=lambda: "NONE",
+        maintenance_poll_ttl_s=1.5,
+    )
+    assert op2._maint_poll_ttl_s == 1.5
 
 
 def test_sysfs_fatal_counter_marks_chip_unhealthy_sticky(tmp_path):
@@ -266,17 +312,23 @@ def test_sysfs_fatal_counter_marks_chip_unhealthy_sticky(tmp_path):
 
 
 def test_health_flip_reason_lands_in_node_event(tmp_path):
-    """The maintenance/counter reason travels through health_once into the
+    """The health-flip reason travels through health_once into the
     TPUChipUnhealthy node event (the ListAndWatch machinery test already
-    covers device flips; this pins the reason string)."""
+    covers device flips; this pins the reason string). Driven by a
+    rising sysfs fatal counter — maintenance events no longer fail
+    health (the drain orchestrator owns that response)."""
     from elastic_tpu_agent.plugins.base import PluginConfig
     from elastic_tpu_agent.plugins.tpushare import TPUSharePlugin
     from elastic_tpu_agent.storage import Storage
 
     from fake_kubelet import FakeSitter
 
-    state = {"event": "NONE"}
-    op = _tpuvm_op(tmp_path, maintenance=lambda: state["event"])
+    sys_root = tmp_path / "sysaccel"
+    err_dir = sys_root / "accel1" / "device"
+    err_dir.mkdir(parents=True)
+    fatal = err_dir / "aer_dev_fatal"
+    fatal.write_text("0\n")
+    op = _tpuvm_op(tmp_path, sys_accel_root=str(sys_root))
 
     class RecEvents:
         def __init__(self):
@@ -303,13 +355,12 @@ def test_health_flip_reason_lands_in_node_event(tmp_path):
     plugin.health_once()
     assert events.node_events == []
 
-    state["event"] = "TERMINATE_ON_HOST_MAINTENANCE"
-    op._maint_next_poll = 0.0
+    fatal.write_text("3\n")  # chip 1's fatal counter rises past baseline
     assert plugin.health_once()
-    assert len(events.node_events) == 4
+    assert len(events.node_events) == 1
     reason, message = events.node_events[0]
     assert reason == "TPUChipUnhealthy"
-    assert "TERMINATE_ON_HOST_MAINTENANCE" in message
+    assert "aer_dev_fatal" in message
 
 
 def test_sysfs_counters_reachable_through_symlinks(tmp_path):
@@ -540,10 +591,11 @@ def test_health_reasons_degraded_counter_path(tmp_path):
     assert list(op.error_counters()[1].values()) == [0]
 
 
-def test_health_reasons_maintenance_covers_all_then_clears(tmp_path):
-    """The maintenance-event path: every present chip carries the event
-    reason while it is announced; clearing the event clears the reasons
-    but keeps any sticky counter-error chip's specific cause."""
+def test_health_reasons_unaffected_by_maintenance_event(tmp_path):
+    """New contract (drain.py owns maintenance): an announced event
+    neither fails chips nor pollutes health_reasons — only real causes
+    (here a sticky counter chip) appear, before, during and after the
+    event window."""
     sys_root = tmp_path / "sysaccel"
     err_dir = sys_root / "accel0" / "device"
     err_dir.mkdir(parents=True)
@@ -559,19 +611,15 @@ def test_health_reasons_maintenance_covers_all_then_clears(tmp_path):
     op.healthy_indexes()
     state["event"] = "MIGRATE_ON_HOST_MAINTENANCE"
     op._maint_next_poll = 0.0
-    assert op.healthy_indexes() == set()
-    reasons = op.health_reasons()
-    assert set(reasons) == {0, 1, 2, 3}
-    for i in (1, 2, 3):
-        assert "MIGRATE_ON_HOST_MAINTENANCE" in reasons[i]
-    # the error chip keeps its SPECIFIC cause through the event
-    assert "aer_dev_fatal" in reasons[0]
-    state["event"] = "NONE"
-    op._maint_next_poll = 0.0
+    assert op.maintenance_event() == "MIGRATE_ON_HOST_MAINTENANCE"
     assert op.healthy_indexes() == {1, 2, 3}
     reasons = op.health_reasons()
     assert set(reasons) == {0}
     assert "aer_dev_fatal" in reasons[0]
+    state["event"] = "NONE"
+    op._maint_next_poll = 0.0
+    assert op.healthy_indexes() == {1, 2, 3}
+    assert set(op.health_reasons()) == {0}
 
 
 def test_sysfs_counter_reset_rebaselines(tmp_path):

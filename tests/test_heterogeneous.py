@@ -1,0 +1,198 @@
+"""Heterogeneous-generation advertisement (ISSUE 8 satellite).
+
+The generation table (tpu/topology.CHIP_SPECS) has carried v4/v5e/v6e
+core-count/HBM shapes since the seed, but nothing exercised MIXED
+shapes: every plugin/operator test ran one v5litepod node. These tests
+parametrize the advertisement pipeline over generations — device-list
+capacity (core units, HBM MiB units), per-chip facts on the discovered
+inventory, canonical TPU_VISIBLE_CHIPS ordering through a real bind,
+and a FleetSim whose nodes run DIFFERENT generations side by side.
+"""
+
+import pytest
+
+from elastic_tpu_agent.common import (
+    AnnotationAssumed,
+    BytesPerMemoryUnit,
+    ResourceTPUCore,
+    ResourceTPUMemory,
+    TPUPercentEachChip,
+    container_annotation,
+)
+from elastic_tpu_agent.plugins.tpushare import (
+    CORE_ENDPOINT,
+    core_device_id,
+    mem_device_id,
+)
+from elastic_tpu_agent.slices.packing import canonical_chip_order
+from elastic_tpu_agent.tpu.stub import StubOperator
+from elastic_tpu_agent.tpu.topology import (
+    CHIP_SPECS,
+    chip_grid,
+    parse_accelerator_type,
+)
+from elastic_tpu_agent.types import Device
+
+from test_e2e import Cluster, wait_until
+
+from fake_apiserver import make_pod
+
+# One single-host accelerator type per generation under test: the
+# fleet-relevant mix (v4 pods, v5e lite pods, v6e) with per-generation
+# chips/host, cores/chip and HBM/chip all differing.
+GENERATIONS = [
+    ("v4", "v4-8"),            # 4 chips/host, 2 cores/chip, 32 GiB
+    ("v5e", "v5litepod-8"),    # 8 chips/host, 1 core/chip, 16 GiB
+    ("v6e", "v6e-8"),          # 8 chips/host, 1 core/chip, 32 GiB
+]
+
+
+@pytest.mark.parametrize("family,acc", GENERATIONS)
+def test_stub_inventory_matches_generation_spec(tmp_path, family, acc):
+    """The discovered chips carry the generation's core/HBM facts."""
+    spec = CHIP_SPECS[family]
+    topo = parse_accelerator_type(acc)
+    op = StubOperator(str(tmp_path / "dev"), acc)
+    devs = op.devices()
+    assert len(devs) == topo.chips_per_host
+    for chip in devs:
+        assert chip.hbm_bytes == spec.hbm_bytes
+        assert chip.cores == spec.cores_per_chip
+        assert family in chip.uuid
+
+
+@pytest.mark.parametrize("family,acc", GENERATIONS)
+def test_device_list_capacity_per_generation(tmp_path, family, acc):
+    """Advertised fake-device capacity is the generation's shape: 100
+    core units per chip; one memory unit per MiB of that generation's
+    HBM (v4 advertises HALF the per-chip units of... no — v4 has 32 GiB
+    like v6e but only 4 chips; v5e has 16 GiB on 8 chips — the three
+    node totals all differ)."""
+    from elastic_tpu_agent.plugins.base import PluginConfig
+    from elastic_tpu_agent.plugins.tpushare import TPUSharePlugin
+    from elastic_tpu_agent.storage import Storage
+
+    from fake_kubelet import FakeSitter
+
+    spec = CHIP_SPECS[family]
+    topo = parse_accelerator_type(acc)
+    op = StubOperator(str(tmp_path / "dev"), acc)
+    config = PluginConfig(
+        device_plugin_dir=str(tmp_path / "dp"),
+        pod_resources_socket=str(tmp_path / "pr.sock"),
+        operator=op,
+        sitter=FakeSitter(),
+        storage=Storage(str(tmp_path / "meta.db")),
+        locator_factory=lambda r: None,
+        extra={"alloc_spec_dir": str(tmp_path / "alloc")},
+    )
+    plugin = TPUSharePlugin(config)
+    n_chips = topo.chips_per_host
+    assert len(plugin.core._device_list()) == n_chips * TPUPercentEachChip
+    units_per_chip = spec.hbm_bytes // BytesPerMemoryUnit
+    assert plugin.memory._mib_per_chip == units_per_chip
+    assert len(plugin.memory._device_list()) == n_chips * units_per_chip
+    # memory request packing derives from the generation's HBM: a
+    # request for 1.5 chips' worth of MiB must span 2 chips
+    assert plugin.memory._chips_for_request(
+        units_per_chip + units_per_chip // 2
+    ) == 2
+
+
+@pytest.mark.parametrize("family,acc", GENERATIONS)
+def test_bind_env_and_packing_per_generation(tmp_path, family, acc):
+    """A real two-chip bind on each generation: TPU_VISIBLE_CHIPS is
+    the dense canonical (grid-walk) renumbering, the virtual links
+    resolve to the annotated physical chips, and the memory sibling's
+    HBM quota reflects the generation's chip size."""
+    spec = CHIP_SPECS[family]
+    topo = parse_accelerator_type(acc)
+    c = Cluster(tmp_path, operator_kind=f"stub:{acc}")
+    c.start()
+    try:
+        # annotate the two chips in NON-canonical order: the bind must
+        # re-order them via the grid walk, not trust annotation order
+        chips = [topo.chips_per_host - 1, 0]
+        want_order = canonical_chip_order(chips, topo.chips_per_host)
+        assert want_order == sorted(
+            chips,
+            key=lambda i: (chip_grid(topo.chips_per_host)[i][1],
+                           chip_grid(topo.chips_per_host)[i][0]),
+        )
+        c.apiserver.upsert_pod(make_pod(
+            "default", "het-0", c.node,
+            annotations={
+                AnnotationAssumed: "true",
+                container_annotation("jax"): ",".join(map(str, chips)),
+            },
+            containers=[{"name": "jax"}],
+        ))
+        assert wait_until(
+            lambda: c.manager.sitter.get_pod("default", "het-0") is not None
+        )
+        ids = [core_device_id(chips[0], u) for u in range(100)] + [
+            core_device_id(chips[1], u) for u in range(100)
+        ]
+        resp = c.kubelet.kubelet_allocate_flow(
+            CORE_ENDPOINT, "default", "het-0", "jax", ResourceTPUCore, ids
+        )
+        env = dict(resp.container_responses[0].envs)
+        assert env["TPU_VISIBLE_CHIPS"] == "0,1"
+        # the spec on disk records the canonical physical order
+        rec = c.manager.storage.load("default", "het-0").allocations[
+            "jax"
+        ][ResourceTPUCore]
+        assert rec.chip_indexes == want_order
+        spec_doc = c.manager.plugin.core.read_alloc_spec(
+            Device(ids, ResourceTPUCore).hash
+        )
+        assert spec_doc["chip_indexes"] == want_order
+        assert [
+            p.rsplit("/accel", 1)[1] for p in spec_doc["device_paths"]
+        ] == [str(i) for i in want_order]
+        # memory granularity sanity for this generation
+        assert (
+            c.manager.plugin.memory._mib_per_chip
+            == spec.hbm_bytes // BytesPerMemoryUnit
+        )
+    finally:
+        c.stop()
+
+
+def test_fleet_sim_mixes_generations(tmp_path):
+    """FleetSim runs DIFFERENT generations per node: each agent
+    advertises its own generation's chip count/HBM, and a bind lands on
+    every node of the mixed fleet."""
+    from elastic_tpu_agent.sim import FleetSim
+
+    kinds = [f"stub:{acc}" for _, acc in GENERATIONS]
+    sim = FleetSim(
+        str(tmp_path), nodes=3, operator_kinds=kinds,
+        reconcile_period_s=30.0,
+    )
+    try:
+        sim.start()
+        for i, (family, acc) in enumerate(GENERATIONS):
+            spec = CHIP_SPECS[family]
+            topo = parse_accelerator_type(acc)
+            node = sim.nodes[i]
+            assert node.operator_kind == kinds[i]
+            devs = node.manager.operator.devices()
+            assert len(devs) == topo.chips_per_host, acc
+            assert {d.hbm_bytes for d in devs} == {spec.hbm_bytes}
+            assert (
+                node.manager.plugin.memory._mib_per_chip
+                == spec.hbm_bytes // BytesPerMemoryUnit
+            )
+        refs = sim.admit_pods(pods_per_node=2)
+        sim.wait_synced(refs)
+        for ref in refs:
+            sim.bind_pod(ref)
+        assert sim.stored_binds() == {
+            node.name: 2 for node in sim.nodes
+        }
+        # pods were spread over each node's OWN chip count (v4 node has
+        # 4 chips, v5e/v6e nodes 8) — the admission used per-node shapes
+        assert {r.chip for r in refs if r.node_idx == 0} <= set(range(4))
+    finally:
+        sim.stop()
