@@ -5,7 +5,7 @@ TAG ?= elastic-tpu-agent:latest
 # verify's tier-1 line uses pipefail, which /bin/sh (dash) lacks
 SHELL := /bin/bash
 
-.PHONY: all native sanitize test test-all verify doctor-smoke chaos-smoke bench-smoke crash-replay-smoke fleet-smoke slice-smoke drain-smoke protos image bench clean
+.PHONY: all native sanitize test test-all verify doctor-smoke chaos-smoke bench-smoke crash-replay-smoke fleet-smoke slice-smoke drain-smoke timeline-smoke protos image bench clean
 
 all: native test
 
@@ -69,11 +69,14 @@ bench-smoke:
 # window (drain.pre_cordon/post_signal/pre_reclaim), restarts the
 # manager over the surviving store + fake kubelet, and asserts
 # convergence to the crash-free end state (empty bind-intent journal;
-# resumed drain lifecycle). Deterministic: in-process drive, no sleeps
+# resumed drain lifecycle) — AND that the surviving lifecycle timeline
+# still tells a consistent story (no phantom commits, every crashed
+# intent resolved by a visible rollback/commit event;
+# tests/test_timeline.py). Deterministic: in-process drive, no sleeps
 # on the replay path.
 crash-replay-smoke:
 	JAX_PLATFORMS=cpu python -m pytest tests/test_reconciler.py \
-	  tests/test_drain.py -q \
+	  tests/test_drain.py tests/test_timeline.py -q \
 	  -p no:cacheprovider && echo "crash replay smoke: OK"
 
 # fleet smoke: the cluster-in-a-box simulator (bench.py --fleet-smoke):
@@ -111,8 +114,21 @@ slice-smoke:
 drain-smoke:
 	JAX_PLATFORMS=cpu python3 bench.py --drain-smoke
 
+# timeline smoke: the lifecycle-journal gate (bench.py
+# --timeline-smoke): a 4-agent fleet takes a churn burst sized past
+# the timeline ring cap, forms a slice, then drains one member through
+# maintenance (with a mid-drain agent restart) — every node's journal
+# must stay seq-ordered and ring-capped with an accurate durable
+# eviction counter, the aggregator's merged fleet view must sequence
+# the story causally (draining before reform before reclaim, per-node
+# order never violated), and `node-doctor timeline` must reconstruct
+# the per-pod bind->reform->drain->reclaim history from the db alone,
+# across the restart. Structural, deterministic.
+timeline-smoke:
+	JAX_PLATFORMS=cpu python3 bench.py --timeline-smoke
+
 T1_TIMEOUT ?= 870
-verify: doctor-smoke chaos-smoke bench-smoke crash-replay-smoke fleet-smoke slice-smoke drain-smoke
+verify: doctor-smoke chaos-smoke bench-smoke crash-replay-smoke fleet-smoke slice-smoke drain-smoke timeline-smoke
 	python -c "from prometheus_client import CollectorRegistry; \
 	  from elastic_tpu_agent.metrics import AgentMetrics; \
 	  AgentMetrics(registry=CollectorRegistry()); \

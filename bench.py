@@ -1107,6 +1107,267 @@ def drain_smoke_main():
     return 0
 
 
+# -- lifecycle timeline: churn + reform + drain as ONE story ------------------
+#
+# The observability gate for timeline.py: a 4-node fleet where nodes
+# 0-2 host a slice and node 3 takes a churn burst sized past the ring
+# cap. A maintenance drain on one slice member then produces the full
+# causal story — cordon, drain signal, proactive reform on the
+# survivors, mid-drain agent restart, deadline reclaim — and the gate
+# asserts (a) every node's journal is seq-ordered and ring-capped with
+# an ACCURATE durable eviction counter, (b) the aggregator's merged
+# fleet view preserves per-node order and sequences the drain story
+# causally (draining before reform before reclaim), and (c)
+# `node-doctor timeline` reconstructs per-pod histories from the dbs
+# alone — across the victim's restart — which is the acceptance bar.
+
+TIMELINE_NODES = 4
+TIMELINE_CAP = 160
+TIMELINE_CHURN_PODS = 100  # > cap/2 binds on node 3 forces eviction
+TIMELINE_ACCEL = "v4-24"   # 3 hosts x 4 chips/host
+TIMELINE_DEADLINE_S = 6.0
+
+
+def _node_doctor_history(db_file, pod):
+    """Run the real `node-doctor timeline` subcommand in-process against
+    a db file; returns the parsed JSON it printed."""
+    import contextlib
+    import io
+
+    from elastic_tpu_agent import cli
+
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = cli.main([
+            "node-doctor", "timeline", "--db-file", db_file, "--pod", pod,
+        ])
+    if rc != 0:
+        raise RuntimeError(f"node-doctor timeline rc={rc} for {pod}")
+    return json.loads(buf.getvalue())
+
+
+def run_timeline_scenario(sim, timeout_s=90.0):
+    from elastic_tpu_agent import timeline as tl
+    from elastic_tpu_agent.sim import FleetAggregator
+    from elastic_tpu_agent.slice_env import ordered_worker_hostnames
+
+    problems = []
+    slice_nodes = [0, 1, 2]
+    churn_node = 3
+    hosts = [sim.nodes[i].name for i in slice_nodes]
+
+    # 1) slice forms, churn burst overflows node 3's ring
+    refs = sim.admit_slice(
+        "smoke-tl", slice_nodes, accelerator_type=TIMELINE_ACCEL
+    )
+    sim.wait_synced(refs)
+    for ref in refs:
+        sim.bind_pod(ref)
+    churn_refs = sim.admit_pods(
+        TIMELINE_CHURN_PODS, namespace="churn", node_idxs=[churn_node]
+    )
+    sim.wait_synced(churn_refs)
+    for ref in churn_refs:
+        sim.bind_pod(ref)
+
+    # 2) maintenance drain on the last slice member: proactive reform,
+    # mid-drain restart, deadline reclaim
+    victim = refs[-1]
+    survivors = refs[:-1]
+    vidx = victim.node_idx
+    surviving_order, _ = ordered_worker_hostnames(hosts[:-1])
+    sim.trigger_maintenance(vidx)
+    sim.wait_drain_state(vidx, ("draining", "drained", "reclaimed"),
+                         timeout_s=timeout_s)
+    sim.restart_node(vidx)  # the history must span this boot boundary
+    try:
+        sim.wait_slice_reformed(
+            survivors, surviving_order, expected_epoch=1,
+            timeout_s=timeout_s,
+        )
+    except RuntimeError as e:
+        problems.append(f"proactive reform: {e}")
+    sim.wait_drain_state(vidx, ("reclaimed",),
+                         timeout_s=TIMELINE_DEADLINE_S + timeout_s)
+
+    # 3) ring cap honored + eviction counter accurate, per node
+    evicted_somewhere = False
+    for node in sim.nodes:
+        rows = node.storage.timeline_rows()
+        count = node.storage.timeline_count()
+        evicted = node.storage.timeline_evicted_total()
+        if count > sim.timeline_cap:
+            problems.append(
+                f"{node.name}: {count} rows exceed cap {sim.timeline_cap}"
+            )
+        seqs = [r["seq"] for r in rows]
+        if seqs != sorted(seqs) or len(set(seqs)) != len(seqs):
+            problems.append(f"{node.name}: seqs not strictly increasing")
+        if rows and rows[-1]["seq"] - count != evicted:
+            problems.append(
+                f"{node.name}: eviction counter {evicted} != "
+                f"max_seq {rows[-1]['seq']} - rows {count}"
+            )
+        evicted_somewhere = evicted_somewhere or evicted > 0
+    if not evicted_somewhere:
+        problems.append(
+            f"churn burst never overflowed the ring (cap "
+            f"{sim.timeline_cap}) — the eviction path went untested"
+        )
+
+    # 4) merged fleet view: per-node order preserved, the drain story
+    # causally ordered, the bind stories consistent
+    agg = FleetAggregator(sim.targets())
+    merged = agg.merged_timeline()
+    per_node_seqs = {}
+    for e in merged["events"]:
+        per_node_seqs.setdefault(e["keys"].get("node"), []).append(e["seq"])
+    for node_name, seqs in per_node_seqs.items():
+        if seqs != sorted(seqs):
+            problems.append(
+                f"merged view reordered {node_name}'s events"
+            )
+    bind_problems = tl.verify_bind_story(merged["events"])
+    problems.extend(f"bind story: {p}" for p in bind_problems[:3])
+    victim_node = sim.nodes[vidx].name
+
+    def _index(pred, label):
+        for i, e in enumerate(merged["events"]):
+            if pred(e):
+                return i
+        problems.append(f"merged view missing {label}")
+        return None
+
+    i_draining = _index(
+        lambda e: e["kind"] == "drain_transition"
+        and e["attrs"].get("state") == "draining"
+        and e["keys"].get("node") == victim_node,
+        "victim draining transition",
+    )
+    i_reform = _index(
+        lambda e: e["kind"] == "slice_reformed"
+        and e["attrs"].get("epoch") == 1,
+        "survivor reform at epoch 1",
+    )
+    i_reclaim = _index(
+        lambda e: e["kind"] == "reconcile_repair"
+        and e["attrs"].get("class") == "reclaimed_pod"
+        and e["keys"].get("node") == victim_node
+        and e["keys"].get("pod") == victim.pod_key,
+        "victim reclaim repair",
+    )
+    if None not in (i_draining, i_reform, i_reclaim) and not (
+        i_draining < i_reform < i_reclaim
+    ):
+        problems.append(
+            f"drain story out of causal order: draining@{i_draining}, "
+            f"reform@{i_reform}, reclaim@{i_reclaim}"
+        )
+    # the per-pod merged history stitches the survivors' reforms in via
+    # the shared slice id
+    pod_view = agg.merged_timeline(pod=victim.pod_key)
+    if not any(
+        e["kind"] == "slice_reformed" and e.get("related")
+        for e in pod_view["events"]
+    ):
+        problems.append(
+            "merged per-pod history missing the related reform events"
+        )
+
+    # 5) the acceptance bar: node-doctor reconstructs histories from
+    # the dbs alone (victim: bind -> drain -> reclaim across a restart;
+    # survivor: bind -> formation -> reform at epoch 1)
+    victim_db = sim.nodes[vidx].opts.db_path
+    history = _node_doctor_history(victim_db, victim.pod_key)
+    kinds = [e["kind"] for e in history["events"]]
+    for want in ("bind_intent", "bind_commit", "slice_formed",
+                 "drain_transition", "reconcile_repair"):
+        if want not in kinds:
+            problems.append(
+                f"victim node-doctor history missing {want}: {kinds}"
+            )
+    if kinds.count("agent_started") < 2:
+        problems.append(
+            "victim history does not show the mid-drain restart "
+            f"boundary: {kinds}"
+        )
+    if not any(
+        e["kind"] == "reconcile_repair"
+        and e["attrs"].get("class") == "reclaimed_pod"
+        for e in history["events"]
+    ):
+        problems.append("victim history missing the reclaim repair")
+    surv = survivors[0]
+    surv_history = _node_doctor_history(
+        sim.nodes[surv.node_idx].opts.db_path, surv.pod_key
+    )
+    if not any(
+        e["kind"] == "slice_reformed" and e["attrs"].get("epoch") == 1
+        for e in surv_history["events"]
+    ):
+        problems.append(
+            "survivor node-doctor history missing the epoch-1 reform: "
+            f"{[e['kind'] for e in surv_history['events']]}"
+        )
+
+    return {
+        "nodes": TIMELINE_NODES,
+        "timeline_cap": sim.timeline_cap,
+        "churn_pods": TIMELINE_CHURN_PODS,
+        "per_node_journal": {
+            node.name: {
+                "events": node.storage.timeline_count(),
+                "evicted": node.storage.timeline_evicted_total(),
+            }
+            for node in sim.nodes
+        },
+        "merged_events": len(merged["events"]),
+        "victim_history_events": len(history["events"]),
+        "problems": problems,
+    }
+
+
+TIMELINE_SMOKE_TIMEOUT_S = 90.0
+
+
+def timeline_smoke_main():
+    """`make timeline-smoke`: churn past the ring cap + one reform +
+    one drain in the fleet sim, then assert causal ordering (per-node
+    and merged), the ring cap, an accurate eviction counter, and the
+    node-doctor per-pod reconstruction across a mid-drain agent
+    restart. Structural and deterministic."""
+    from elastic_tpu_agent.sim import FleetSim
+
+    with tempfile.TemporaryDirectory(prefix="etpu-tln") as tmp:
+        sim = FleetSim(
+            tmp, nodes=TIMELINE_NODES, reconcile_period_s=0.5,
+            slice_membership_ttl_s=0.25,
+            drain_deadline_s=TIMELINE_DEADLINE_S, drain_period_s=0.25,
+            timeline_cap=TIMELINE_CAP,
+        )
+        try:
+            sim.start()
+            r = run_timeline_scenario(
+                sim, timeout_s=TIMELINE_SMOKE_TIMEOUT_S
+            )
+        except Exception as e:  # noqa: BLE001
+            print(json.dumps({"timeline_smoke": {
+                "error": f"{type(e).__name__}: {e}"
+            }}))
+            print(f"timeline smoke FAILED: {type(e).__name__}: {e}",
+                  file=sys.stderr)
+            return 1
+        finally:
+            sim.stop()
+    print(json.dumps({"timeline_smoke": r}))
+    if r["problems"]:
+        for p in r["problems"]:
+            print(f"timeline smoke FAILED: {p}", file=sys.stderr)
+        return 1
+    print("timeline smoke: OK", file=sys.stderr)
+    return 0
+
+
 SLICE_SMOKE_TIMEOUT_S = 90.0
 
 
@@ -1920,6 +2181,8 @@ if __name__ == "__main__":
         sys.exit(slice_smoke_main())
     elif "--drain-smoke" in sys.argv:
         sys.exit(drain_smoke_main())
+    elif "--timeline-smoke" in sys.argv:
+        sys.exit(timeline_smoke_main())
     elif "--fleet" in sys.argv:
         sys.exit(fleet_main())
     else:

@@ -102,6 +102,11 @@ def parse_args(argv=None) -> argparse.Namespace:
                         "events:create)")
     p.add_argument("--no-crd", action="store_true",
                    help="disable ElasticTPU CRD publication")
+    p.add_argument("--timeline-cap", type=int, default=None,
+                   help="ring cap on the durable lifecycle-event "
+                        "journal (timeline.py; default 4096). Evictions "
+                        "are counted durably either way — see "
+                        "node-doctor timeline")
     p.add_argument("--reconcile-period", type=float, default=30.0,
                    help="seconds between continuous-reconciler passes "
                         "(store <-> kubelet <-> disk <-> live-pod drift "
@@ -150,6 +155,92 @@ def parse_args(argv=None) -> argparse.Namespace:
             "go through the NRI session)"
         )
     return args
+
+
+# -- node-doctor timeline -----------------------------------------------------
+
+
+def parse_timeline_args(argv=None) -> argparse.Namespace:
+    p = argparse.ArgumentParser(
+        prog="elastic-tpu-agent node-doctor timeline",
+        description="Reconstruct a causally-linked lifecycle history "
+                    "for one pod/slice/chip/node straight from the "
+                    "checkpoint db's durable event journal — works "
+                    "against a dead agent's db, exactly like the "
+                    "open-intent reader.",
+    )
+    p.add_argument(
+        "--db-file", default="/host/var/lib/elastic-tpu/meta.db",
+        help="checkpoint db holding the timeline table",
+    )
+    p.add_argument("--pod", default=None, metavar="NS/NAME",
+                   help="history of one pod (bare names accepted)")
+    p.add_argument("--slice", dest="slice_id", default=None,
+                   help="history of one slice id")
+    p.add_argument("--chip", type=int, default=None,
+                   help="history of one chip index")
+    p.add_argument("--node", default=None,
+                   help="filter to one node name (merged fleet dbs)")
+    p.add_argument("--trace", default=None,
+                   help="history of one trace/correlation id")
+    p.add_argument("--kind", action="append", default=None,
+                   help="keep only these event kinds (repeatable)")
+    p.add_argument("--since", type=float, default=None,
+                   help="unix-seconds lower bound")
+    p.add_argument("--limit", type=int, default=None,
+                   help="newest-N cap on the reconstructed history")
+    p.add_argument("--no-causal", action="store_true",
+                   help="direct key matches only — skip the causal "
+                        "expansion along shared trace/slice ids")
+    return p.parse_args(argv)
+
+
+def timeline_main(argv=None) -> int:
+    args = parse_timeline_args(argv)
+    logging.basicConfig(
+        level=logging.WARNING,
+        format="%(levelname).1s %(name)s %(message)s",
+        stream=sys.stderr,
+    )
+    if not os.path.exists(args.db_file):
+        print(f"no db at {args.db_file}", file=sys.stderr)
+        return 1
+    from .storage import Storage
+    from .timeline import Timeline
+
+    with Storage(args.db_file) as storage:
+        view = Timeline(storage)
+        events = view.events(
+            pod=args.pod, slice_id=args.slice_id, chip=args.chip,
+            node=args.node, trace=args.trace, kinds=args.kind,
+            since=args.since, limit=args.limit,
+            causal=not args.no_causal,
+        )
+        status = view.status()
+        # The cap the WRITING agent ran with (persisted alongside the
+        # events), not this reader process's default — an operator
+        # judging "could the ring have trimmed history?" needs the
+        # real bound.
+        status["cap"] = storage.timeline_cap_stored()
+    entity = {
+        k: v for k, v in (
+            ("pod", args.pod), ("slice", args.slice_id),
+            ("chip", args.chip), ("node", args.node),
+            ("trace", args.trace),
+        ) if v is not None
+    }
+    json.dump({
+        "db_file": args.db_file,
+        "entity": entity,
+        "events": events,
+        "journal": {
+            "cap": status["cap"],
+            "total_events": status["total_events"],
+            "evicted_total": status["evicted_total"],
+        },
+    }, sys.stdout, indent=2, sort_keys=True)
+    sys.stdout.write("\n")
+    return 0
 
 
 # -- node-doctor --------------------------------------------------------------
@@ -204,6 +295,8 @@ def parse_doctor_args(argv=None) -> argparse.Namespace:
 
 
 def doctor_main(argv=None) -> int:
+    if argv and argv[0] == "timeline":
+        return timeline_main(argv[1:])
     from .sampler import (
         UtilizationSampler,
         build_diagnostics_bundle,
@@ -335,6 +428,10 @@ def main(argv=None) -> int:
             drain_deadline_s=args.drain_deadline,
             drain_period_s=args.drain_period,
             maintenance_poll_ttl_s=args.maintenance_poll_ttl,
+            **(
+                {"timeline_cap": args.timeline_cap}
+                if args.timeline_cap is not None else {}
+            ),
         )
     )
     run_thread = threading.Thread(

@@ -127,6 +127,46 @@ def strip_relay_env(environ=None) -> None:
 NEVER_STOP: "threading.Event" = threading.Event()  # never set: wait forever
 
 
+class Clock:
+    """Injectable time source — the seam that keeps time-dependent
+    subsystems (the lifecycle timeline, drain phase accounting) testable
+    without sleep-based polling: production code takes a ``clock``
+    argument defaulting to :data:`SYSTEM_CLOCK`; tests hand in a
+    :class:`ManualClock` and *advance* it, so "an hour passed" is one
+    method call instead of a wall-clock wait."""
+
+    def time(self) -> float:
+        """Wall-clock seconds (``time.time()``)."""
+        return time.time()
+
+    def monotonic(self) -> float:
+        """Monotonic seconds (``time.monotonic()``)."""
+        return time.monotonic()
+
+
+SYSTEM_CLOCK = Clock()
+
+
+class ManualClock(Clock):
+    """A clock that only moves when told to (tests). Starts at a fixed,
+    recognizably-fake wall time so an un-injected SYSTEM_CLOCK sneaking
+    into a code path under test shows up as a wildly different ts."""
+
+    def __init__(self, start: float = 1_000_000_000.0) -> None:
+        self._time = start
+        self._monotonic = 0.0
+
+    def time(self) -> float:
+        return self._time
+
+    def monotonic(self) -> float:
+        return self._monotonic
+
+    def advance(self, seconds: float) -> None:
+        self._time += seconds
+        self._monotonic += seconds
+
+
 def container_annotation(container: str) -> str:
     """Annotation key holding the chip indexes for one container,
     e.g. elasticgpu.io/container-train -> "0,1"."""

@@ -71,6 +71,7 @@ from typing import Dict, List, Optional, Tuple
 
 from . import faults
 from .common import (
+    SYSTEM_CLOCK,
     AnnotationDrain,
     AnnotationDraining,
     AnnotationSliceID,
@@ -89,6 +90,15 @@ DRAINED = "drained"      # every resident exited before the deadline
 RECLAIMED = "reclaimed"  # deadline expired; bindings force-reclaimed
 
 STATE_CODES = {ACTIVE: 0, CORDONED: 1, DRAINING: 2, DRAINED: 3, RECLAIMED: 4}
+
+# Phase labels of the elastic_tpu_drain_phase_seconds histogram: how
+# long cordon->every-resident-signalled took, and how long from the
+# signal to the outcome (graceful exit vs deadline reclaim). PR 8 only
+# counted drain totals; per-phase latency is what answers "are residents
+# actually checkpointing, or are we always reclaiming at the deadline?".
+PHASE_SIGNAL = "cordon_to_signaled"
+PHASE_DRAINED = "signaled_to_drained"
+PHASE_RECLAIMED = "signaled_to_reclaimed"
 
 # Trigger kinds (the `trigger` label of elastic_tpu_drains_total; the
 # full trigger string carries detail, e.g. "maintenance:TERMINATE_...").
@@ -125,6 +135,8 @@ class DrainOrchestrator:
         period_s: float = DEFAULT_PERIOD_S,
         node_poll_ttl_s: float = DEFAULT_NODE_POLL_TTL_S,
         rng=None,
+        timeline=None,
+        clock=None,
     ) -> None:
         self._operator = operator
         self._plugin = plugin
@@ -141,6 +153,14 @@ class DrainOrchestrator:
         self._node_ann_asserted = False
         self._node_ann_next_poll = 0.0
         self._rng = rng if rng is not None else random.Random()
+        self._timeline = timeline
+        self._clock = clock if clock is not None else SYSTEM_CLOCK
+        # Wall-clock phase anchors ("cordon", "signaled"), journaled so
+        # a mid-drain restart keeps measuring from the real start; the
+        # observed set is journaled too — a restart after Drained must
+        # not observe the phase twice.
+        self._phase_ts: Dict[str, float] = {}
+        self._phases_observed: List[str] = []
         self._lock = threading.Lock()
         self.state = ACTIVE
         self.trigger = ""
@@ -326,13 +346,49 @@ class DrainOrchestrator:
             "annotated_pods": [list(p) for p in self._annotated_pods],
             "reclaimed_pods": list(self._reclaimed_pods),
             "drains_total": self._drains_total,
+            "phase_ts": dict(self._phase_ts),
+            "phases_observed": list(self._phases_observed),
         })
 
-    def _set_state(self, state: str) -> None:
+    def _set_state(self, state: str, **timeline_attrs) -> None:
+        prev = self.state
         self.state = state
         if self._metrics is not None and hasattr(self._metrics, "drain_state"):
             try:
                 self._metrics.drain_state.set(STATE_CODES[state])
+            except Exception:  # noqa: BLE001
+                pass
+        if prev != state and self._timeline is not None:
+            from .timeline import KIND_DRAIN_TRANSITION
+
+            self._timeline.emit(
+                KIND_DRAIN_TRANSITION,
+                **{"state": state, "from": prev,
+                   "trigger": self.trigger,
+                   "deadline_ts": self.deadline_ts,
+                   **timeline_attrs},
+            )
+
+    def _observe_phase(self, phase: str, since_anchor: str) -> None:
+        """Observe one drain-phase duration exactly once per drain
+        (restart-safe: the anchor timestamps and the observed set ride
+        the journal). Falls back to the cordon anchor when the signal
+        anchor never landed — a drain whose residents could never be
+        signalled is exactly the pathological reclaim the histogram
+        exists to expose, and must not be the one drain it omits."""
+        anchor = self._phase_ts.get(since_anchor)
+        if anchor is None:
+            anchor = self._phase_ts.get("cordon")
+        if anchor is None or phase in self._phases_observed:
+            return
+        self._phases_observed.append(phase)
+        if self._metrics is not None and hasattr(
+            self._metrics, "drain_phase_seconds"
+        ):
+            try:
+                self._metrics.drain_phase_seconds.labels(
+                    phase=phase
+                ).observe(max(0.0, self._clock.time() - anchor))
             except Exception:  # noqa: BLE001
                 pass
 
@@ -352,7 +408,8 @@ class DrainOrchestrator:
             self._resumed = True
             return
         with self._lock:
-            self._set_state(st.get("state", ACTIVE))
+            # Trigger/deadline restored BEFORE the state flip so the
+            # timeline's resumed transition carries the real context.
             self.trigger = st.get("trigger", "")
             self.deadline_ts = st.get("deadline_ts")
             self._stamped_pods = list(st.get("stamped_pods", []))
@@ -361,6 +418,9 @@ class DrainOrchestrator:
             ]
             self._reclaimed_pods = list(st.get("reclaimed_pods", []))
             self._drains_total = int(st.get("drains_total", 0))
+            self._phase_ts = dict(st.get("phase_ts", {}))
+            self._phases_observed = list(st.get("phases_observed", []))
+            self._set_state(st.get("state", ACTIVE), resumed=True)
             resumed_state = self.state
         if resumed_state != ACTIVE:
             logger.warning(
@@ -380,7 +440,7 @@ class DrainOrchestrator:
     # -- the lifecycle --------------------------------------------------------
 
     def _start_drain(self, trigger: str) -> None:
-        now = time.time()
+        now = self._clock.time()
         with self._lock:
             self.trigger = trigger
             self.deadline_ts = now + self.deadline_s
@@ -388,6 +448,8 @@ class DrainOrchestrator:
             self._stamped_pods = []
             self._annotated_pods = []
             self._reclaimed_pods = []
+            self._phase_ts = {"cordon": now}
+            self._phases_observed = []
             self._set_state(CORDONED)
             self._journal()  # BEFORE any side effect
         if self._metrics is not None and hasattr(self._metrics, "drains_total"):
@@ -494,6 +556,16 @@ class DrainOrchestrator:
         with self._lock:
             self._stamped_pods = sorted(stamped)
             self._annotated_pods = sorted(annotated)
+            if "signaled" not in self._phase_ts and stamped >= {
+                owner.pod_key for owner, _ in residents
+            }:
+                # Every CURRENT resident carries the signal: the
+                # signalled phase anchor (an empty node signals
+                # vacuously; later-appearing residents re-stamp without
+                # moving the anchor — the phase measures the first full
+                # coverage).
+                self._phase_ts["signaled"] = self._clock.time()
+                self._observe_phase(PHASE_SIGNAL, "cordon")
             self._journal()
 
     def _cancel_drain(self) -> None:
@@ -628,7 +700,8 @@ class DrainOrchestrator:
             if remaining:
                 self._journal()  # progress recorded; retry next tick
             else:
-                self._set_state(RECLAIMED)
+                self._set_state(RECLAIMED, reclaimed_pods=sorted(done))
+                self._observe_phase(PHASE_RECLAIMED, "signaled")
                 self._journal()
         if remaining:
             logger.warning(
@@ -654,6 +727,7 @@ class DrainOrchestrator:
     def _finish_drained(self) -> None:
         with self._lock:
             self._set_state(DRAINED)
+            self._observe_phase(PHASE_DRAINED, "signaled")
             self._journal()
         logger.info("drain: all residents exited before the deadline")
         if self._events is not None:
@@ -683,7 +757,7 @@ class DrainOrchestrator:
             return (
                 self.state == DRAINING
                 and self.deadline_ts is not None
-                and time.time() >= self.deadline_ts
+                and self._clock.time() >= self.deadline_ts
             )
 
     # -- the tick -------------------------------------------------------------
@@ -709,9 +783,18 @@ class DrainOrchestrator:
                 "drain: trigger upgraded %r -> %r (non-cancelable)",
                 self.trigger, trigger,
             )
+            upgraded_from = self.trigger
             with self._lock:
                 self.trigger = trigger
                 self._journal()
+            if self._timeline is not None:
+                from .timeline import KIND_DRAIN_TRANSITION
+
+                self._timeline.emit(
+                    KIND_DRAIN_TRANSITION, state=self.state,
+                    trigger=trigger, upgraded_from=upgraded_from,
+                    deadline_ts=self.deadline_ts,
+                )
         if state == ACTIVE:
             if trigger is not None:
                 self._start_drain(trigger)
@@ -740,7 +823,7 @@ class DrainOrchestrator:
                     self._finish_drained()
                 elif (
                     self.deadline_ts is not None
-                    and time.time() >= self.deadline_ts
+                    and self._clock.time() >= self.deadline_ts
                 ):
                     self._reclaim()
         elif state in (DRAINED, RECLAIMED):
@@ -808,7 +891,7 @@ class DrainOrchestrator:
         maint = self._last_maint_value
         with self._lock:
             deadline_in = (
-                round(self.deadline_ts - time.time(), 3)
+                round(self.deadline_ts - self._clock.time(), 3)
                 if self.deadline_ts is not None else None
             )
             return {

@@ -18,10 +18,11 @@ import logging
 import os
 import queue
 import threading
+import time
 from dataclasses import dataclass, field
 from typing import Optional
 
-from . import rpc, supervisor as supervision
+from . import rpc, supervisor as supervision, timeline as timeline_mod
 from .kube.client import KubeClient
 from .kube.locator import KubeletDeviceLocator, PodResourcesSnapshotSource
 from .kube.sitter import Sitter
@@ -109,6 +110,10 @@ class ManagerOptions:
     # (--maintenance-poll-ttl; None = the operator's default, env
     # ELASTIC_TPU_MAINTENANCE_POLL_TTL also honored for tests).
     maintenance_poll_ttl_s: Optional[float] = None
+    # Lifecycle timeline (timeline.py): ring cap on the durable event
+    # journal (--timeline-cap). Small caps are a test/smoke seam; the
+    # eviction counter keeps trims observable either way.
+    timeline_cap: int = timeline_mod.DEFAULT_CAP
     # test seams
     kube_client: Optional[KubeClient] = None
     operator: object = None
@@ -170,10 +175,21 @@ class TPUManager:
     def __init__(self, opts: ManagerOptions) -> None:
         self._opts = opts
         self.storage = Storage(opts.db_path)
+        # The lifecycle timeline rides the checkpoint db (one fsync
+        # domain, one hostPath) and is handed to every subsystem that
+        # makes state transitions — created first so even supervisor
+        # bring-up events are journaled.
+        self.timeline = timeline_mod.Timeline(
+            self.storage,
+            node_name=opts.node_name,
+            metrics=opts.metrics,
+            cap=opts.timeline_cap,
+        )
         self.supervisor = Supervisor(
             metrics=opts.metrics,
             crash_loop_threshold=opts.crash_loop_threshold,
             crash_loop_window_s=opts.crash_loop_window_s,
+            timeline=self.timeline,
         )
         self.client = opts.kube_client or KubeClient.auto(opts.kubeconfig)
         self.gc_queue: "queue.Queue" = queue.Queue()
@@ -190,6 +206,12 @@ class TPUManager:
             self.metrics.attach_supervisor(self.supervisor)
         if self.metrics is not None and hasattr(self.metrics, "attach_sitter"):
             self.metrics.attach_sitter(self.sitter)
+        if self.metrics is not None and hasattr(
+            self.metrics, "attach_timeline"
+        ):
+            # /debug/timeline serves the journal; /healthz gains the
+            # boot id so restarts are attributable from either side.
+            self.metrics.attach_timeline(self.timeline)
         if self.metrics is not None:
             try:
                 n = len(self.operator.devices())
@@ -276,6 +298,7 @@ class TPUManager:
             events=self.events,
             sampler=self.sampler,
             slice_registry=self.slice_registry,
+            timeline=self.timeline,
             extra={"alloc_spec_dir": opts.alloc_spec_dir, **opts.extra},
         )
         from .plugins.base import plugin_factory
@@ -296,6 +319,7 @@ class TPUManager:
         self.slice_reformer = SliceReformer(
             self.slice_registry, self.plugin,
             metrics=self.metrics, events=self.events,
+            timeline=self.timeline,
         )
         self.reconciler = Reconciler(
             storage=self.storage,
@@ -310,6 +334,7 @@ class TPUManager:
             period_s=opts.reconcile_period_s,
             dry_run=opts.reconcile_dry_run,
             slice_reformer=self.slice_reformer,
+            timeline=self.timeline,
         )
         from .drain import DrainOrchestrator
 
@@ -329,6 +354,7 @@ class TPUManager:
             node_name=opts.node_name,
             deadline_s=opts.drain_deadline_s,
             period_s=opts.drain_period_s,
+            timeline=self.timeline,
         )
         # While the drain has reclaimed bindings, kubelet's still-listed
         # assignments must not be replayed back by the reconciler.
@@ -515,6 +541,30 @@ class TPUManager:
         stop, or a critical subsystem circuit-breaking) — previously it
         joined the GC thread alone, so a crashed GC exited (or wedged)
         the whole agent arbitrarily."""
+        from . import __version__
+
+        # agent_started FIRST: histories read across restarts must show
+        # the boot boundary (version + boot id) before any event this
+        # process emits, and the build-info/start-time gauges make the
+        # same facts scrapeable.
+        self.timeline.emit(
+            timeline_mod.KIND_AGENT_STARTED,
+            version=__version__,
+            boot_id=self.timeline.boot_id,
+        )
+        if self.metrics is not None:
+            if hasattr(self.metrics, "build_info"):
+                try:
+                    self.metrics.build_info.labels(
+                        version=__version__
+                    ).set(1)
+                except Exception:  # noqa: BLE001 - observability only
+                    logger.exception("build-info gauge failed")
+            if hasattr(self.metrics, "agent_start_time"):
+                try:
+                    self.metrics.agent_start_time.set(time.time())
+                except Exception:  # noqa: BLE001
+                    pass
         self.supervisor.start(self._stop)
         # Sitter is CRITICAL: binds read annotations from its cache and GC
         # learns deletions through it; a circuit-broken sitter means the

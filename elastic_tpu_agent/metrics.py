@@ -5,7 +5,7 @@ never recorded). BASELINE.md's north-star metric is Allocate() p50 latency
 plus chip utilization, so both are first-class here.
 
 One HTTP server (replacing prometheus_client's bare start_http_server)
-serves four paths:
+serves five paths:
 
 - ``/metrics``  — Prometheus scrape, names unchanged;
 - ``/debug/traces`` — JSON dump of the allocation-trace ring buffer
@@ -15,6 +15,10 @@ serves four paths:
   per-pod granted vs used core percent, chip health, and last trace
   id, straight from the utilization sampler (sampler.py; 503 until a
   sampler is attached);
+- ``/debug/timeline`` — the durable lifecycle event journal
+  (timeline.py), filterable per entity
+  (``?pod=&slice=&chip=&node=&since=&kind=&limit=``; 503 until a
+  timeline is attached);
 - ``/healthz`` — liveness: 200 + a small JSON status.
 
 Per-pod labeled gauges go through a cardinality guard
@@ -188,6 +192,34 @@ class AgentMetrics:
             "Virtual device nodes re-created by restore()",
             **kw,
         )
+        # -- build identity & lifecycle timeline (timeline.py) -------------
+        self.build_info = Gauge(
+            "elastic_tpu_build_info",
+            "Always 1; the labels carry the agent build identity "
+            "(prometheus build-info convention) — join with "
+            "elastic_tpu_agent_start_time_seconds to see which version "
+            "restarted when",
+            ["version"],
+            **kw,
+        )
+        self.agent_start_time = Gauge(
+            "elastic_tpu_agent_start_time_seconds",
+            "Unix time this agent process started serving; a reset "
+            "marks a restart even when counters alone are ambiguous",
+            **kw,
+        )
+        self.timeline_events = Counter(
+            "elastic_tpu_timeline_events_total",
+            "Lifecycle events journaled into the durable timeline this "
+            "boot (the journal itself persists across restarts)",
+            **kw,
+        )
+        self.timeline_evicted = Gauge(
+            "elastic_tpu_timeline_evicted_rows",
+            "Durable count of timeline events the ring cap has dropped "
+            "(reads the journal's own eviction counter)",
+            **kw,
+        )
         # -- continuous reconciler (reconciler.py) -------------------------
         self.reconcile_repairs = Counter(
             "elastic_tpu_reconcile_repairs_total",
@@ -294,6 +326,20 @@ class AgentMetrics:
             "elastic_tpu_drain_reclaimed_pods_total",
             "Resident pods whose bindings were reclaimed because the "
             "drain deadline expired before they exited",
+            **kw,
+        )
+        self.drain_phase_seconds = Histogram(
+            "elastic_tpu_drain_phase_seconds",
+            "Wall time of one drain-lifecycle phase: "
+            "cordon_to_signaled (cordon until every resident carried "
+            "the checkpoint signal), signaled_to_drained (residents "
+            "all exited gracefully), signaled_to_reclaimed (the "
+            "deadline fired instead) — a fleet whose mass sits in "
+            "reclaimed instead of drained has a checkpoint problem, "
+            "not a drain problem",
+            ["phase"],
+            buckets=(0.1, 0.5, 1.0, 5.0, 15.0, 30.0, 60.0, 120.0,
+                     300.0, 600.0, 1800.0),
             **kw,
         )
         self.observability_dropped = Counter(
@@ -428,6 +474,7 @@ class AgentMetrics:
         self._sampler = None
         self._supervisor = None
         self._sitter = None
+        self._timeline = None
         self._httpd: Optional[ThreadingHTTPServer] = None
 
     def attach_sampler(self, sampler) -> None:
@@ -435,6 +482,21 @@ class AgentMetrics:
         attachment is deliberate: the endpoint starts before the manager
         (cli.py) and answers 503 until the sampler exists."""
         self._sampler = sampler
+
+    def attach_timeline(self, timeline) -> None:
+        """Point /debug/timeline at the agent's lifecycle journal
+        (timeline.py); the endpoint answers 503 until attached, like
+        /debug/allocations. Also exports the journal's durable eviction
+        counter and stamps the boot id into /healthz."""
+        self._timeline = timeline
+
+        def _evicted() -> float:
+            try:
+                return float(timeline.status().get("evicted_total") or 0)
+            except Exception:  # noqa: BLE001 - scrape must never break
+                return 0.0
+
+        self.timeline_evicted.set_function(_evicted)
 
     def attach_supervisor(self, supervisor) -> None:
         """Fold supervisor state into /healthz: any circuit-broken
@@ -565,6 +627,45 @@ class AgentMetrics:
                             "completed_total": tracer.completed,
                             "capacity": tracer.capacity,
                         })
+                    elif parsed.path == "/debug/timeline":
+                        if not self._require_loopback():
+                            return
+                        timeline = agent_metrics._timeline
+                        if timeline is None:
+                            self._reply_json(
+                                {"error": "lifecycle timeline not "
+                                          "attached (agent starting)"},
+                                code=503,
+                            )
+                            return
+                        q = parse_qs(parsed.query)
+                        params = {}
+                        for name, key in (
+                            ("pod", "pod"), ("slice", "slice_id"),
+                            ("node", "node"), ("trace", "trace"),
+                        ):
+                            if q.get(name):
+                                params[key] = q[name][0]
+                        for name, key, cast in (
+                            ("chip", "chip", int),
+                            ("since", "since", float),
+                            ("limit", "limit", int),
+                        ):
+                            if q.get(name):
+                                try:
+                                    params[key] = cast(q[name][0])
+                                except ValueError:
+                                    self._reply_json(
+                                        {"error": f"{name} must be "
+                                                  "numeric"},
+                                        code=400,
+                                    )
+                                    return
+                        if q.get("kind"):
+                            params["kinds"] = q["kind"]
+                        payload = timeline.status()
+                        payload["events"] = timeline.events(**params)
+                        self._reply_json(payload)
                     elif parsed.path == "/debug/allocations":
                         if not self._require_loopback():
                             return
@@ -591,6 +692,13 @@ class AgentMetrics:
                         sitter = agent_metrics._sitter
                         if sitter is not None:
                             status["sitter_sync_age_s"] = sitter.sync_age_s()
+                        if agent_metrics._timeline is not None:
+                            # Boot identity: restarts must be visible
+                            # from the probe side too, not only inside
+                            # journal histories.
+                            status["boot_id"] = (
+                                agent_metrics._timeline.boot_id
+                            )
                         sup = agent_metrics._supervisor
                         if sup is not None:
                             snap = sup.healthz()
@@ -611,7 +719,8 @@ class AgentMetrics:
                         self._reply_json(
                             {"error": f"no such path {parsed.path}",
                              "paths": ["/metrics", "/debug/traces",
-                                       "/debug/allocations", "/healthz"]},
+                                       "/debug/allocations",
+                                       "/debug/timeline", "/healthz"]},
                             code=404,
                         )
                 except BrokenPipeError:  # client went away mid-reply
@@ -639,8 +748,8 @@ class AgentMetrics:
         ).start()
         self._httpd = httpd
         logger.info(
-            "observability endpoint on %s:%d "
-            "(/metrics /debug/traces /debug/allocations /healthz)",
+            "observability endpoint on %s:%d (/metrics /debug/traces "
+            "/debug/allocations /debug/timeline /healthz)",
             addr, httpd.server_address[1],
         )
         return httpd

@@ -62,6 +62,10 @@ class PluginConfig:
     # ordering, reform-aware world, slice name + epoch) instead of the
     # bare annotation-order slice_env_for_pod derivation.
     slice_registry: object = None
+    # Optional lifecycle Timeline (timeline.py): bind transaction
+    # phases, health/cordon flips and GC reclaims are journaled through
+    # it. Fire-and-forget like every observability seam here.
+    timeline: object = None
     extra: dict = field(default_factory=dict)
 
 

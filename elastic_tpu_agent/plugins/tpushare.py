@@ -47,6 +47,7 @@ from ..common import (
     AnnotationSliceID,
     AnnotationTraceID,
     BytesPerMemoryUnit,
+    EnvSliceEpoch,
     EnvSliceName,
     EnvAllocationHash,
     EnvTPUVisibleChips,
@@ -69,6 +70,7 @@ from ..kube.locator import DeviceLocator, LocateError
 from ..qos import qos_env
 from ..slice_env import slice_env_for_pod
 from ..slices import packing
+from .. import timeline as tl
 from ..tracing import get_tracer
 from ..types import AllocationRecord, Device, PodContainer, PodInfo
 from .base import DevicePluginServer, PluginConfig
@@ -120,6 +122,13 @@ def bind_lock(pod_key: str):
     (``remove_alloc_spec``) while holding it — use the ``_locked``
     variants."""
     return _BIND_LOCKS.acquire(pod_key)
+
+
+def _safe_int(value, default: int = 0) -> int:
+    try:
+        return int(value)
+    except (TypeError, ValueError):
+        return default
 
 
 def _write_json_atomic(path: str, payload: Dict) -> None:
@@ -266,6 +275,7 @@ class _TPUSharePluginBase(_ListAndWatchMixin, rpc.DevicePluginServicer):
             "alloc_spec_dir", DEFAULT_ALLOC_SPEC_DIR
         )
         self._slices = getattr(config, "slice_registry", None)
+        self._timeline = getattr(config, "timeline", None)
         self._inflight_lock = threading.Lock()
         self._binds_inflight = 0
         self._binds_total = 0
@@ -584,7 +594,7 @@ class _TPUSharePluginBase(_ListAndWatchMixin, rpc.DevicePluginServicer):
         bind back (the link ids it will create, the spec hash) or replay
         it (the exact device ids), durably recorded BEFORE the first
         side effect."""
-        return self._storage.journal_intent(
+        intent_id = self._storage.journal_intent(
             owner.pod_key, owner.container, self.resource, device.hash,
             {
                 "device_ids": list(device.ids),
@@ -592,6 +602,40 @@ class _TPUSharePluginBase(_ListAndWatchMixin, rpc.DevicePluginServicer):
                 "planned_link_ids": list(planned),
             },
         )
+        if self._timeline is not None:
+            self._timeline.emit(
+                tl.KIND_BIND_INTENT,
+                keys=self._bind_keys(owner, device, chip_indexes),
+                resource=self.resource, intent_id=intent_id,
+                n_ids=len(device.ids),
+            )
+        return intent_id
+
+    def _bind_keys(
+        self, owner, device: Device, chip_indexes: List[int],
+        slice_id: str = "",
+    ) -> Dict:
+        keys = {
+            "pod": owner.pod_key,
+            "container": owner.container,
+            "hash": device.hash,
+            "chips": list(chip_indexes),
+        }
+        if slice_id:
+            keys["slice"] = slice_id
+        return keys
+
+    def _emit_rollback(
+        self, owner, device: Device, chip_indexes: List[int],
+        intent_id: int, reason: str,
+    ) -> None:
+        if self._timeline is not None:
+            self._timeline.emit(
+                tl.KIND_BIND_ROLLBACK,
+                keys=self._bind_keys(owner, device, chip_indexes),
+                resource=self.resource, intent_id=intent_id,
+                reason=reason,
+            )
 
     def _bind_located(self, device: Device, owner, pod: dict) -> None:
         annotations = pod.get("metadata", {}).get("annotations", {}) or {}
@@ -624,6 +668,10 @@ class _TPUSharePluginBase(_ListAndWatchMixin, rpc.DevicePluginServicer):
                     # Handled failure: the bind rolled itself back, so
                     # the intent must not linger for the reconciler.
                     self._storage.journal_remove(intent_id)
+                    self._emit_rollback(
+                        owner, device, chip_indexes, intent_id,
+                        "handled_failure",
+                    )
                     raise
             finally:
                 # On EVERY exit (BaseException included) this thread
@@ -689,6 +737,10 @@ class _TPUSharePluginBase(_ListAndWatchMixin, rpc.DevicePluginServicer):
             except Exception:
                 self._rollback_created(created)
                 self._storage.journal_remove(intent_id)
+                self._emit_rollback(
+                    owner, device, chip_indexes, intent_id,
+                    "materialize_failed",
+                )
                 raise
             try:
                 self._finish_bind(
@@ -700,6 +752,10 @@ class _TPUSharePluginBase(_ListAndWatchMixin, rpc.DevicePluginServicer):
                 # spec/links; clear the intent so only a real crash
                 # leaves one.
                 self._storage.journal_remove(intent_id)
+                self._emit_rollback(
+                    owner, device, chip_indexes, intent_id,
+                    "handled_failure",
+                )
                 raise
         finally:
             # On EVERY exit (BaseException included) this thread stops
@@ -796,6 +852,20 @@ class _TPUSharePluginBase(_ListAndWatchMixin, rpc.DevicePluginServicer):
                 self._storage.journal_commit(intent_id)
         finally:
             locks.release_key(owner.pod_key)
+        if self._timeline is not None:
+            # Commit phase of the bind story: journaled AFTER the record
+            # checkpoint + journal_commit (a crash in between is exactly
+            # what the reconciler's intent resolution — and its own
+            # reconcile_repair event — narrates instead).
+            self._timeline.emit(
+                tl.KIND_BIND_COMMIT,
+                keys=self._bind_keys(
+                    owner, device, chip_indexes,
+                    slice_id=annotations.get(AnnotationSliceID, ""),
+                ),
+                resource=self.resource, intent_id=intent_id,
+                links=len(created),
+            )
         if self._metrics is not None:
             # O(1) COUNT(*) — the per-bind gauge update must not
             # deserialize the whole store (it used to scan every row).
@@ -865,6 +935,25 @@ class _TPUSharePluginBase(_ListAndWatchMixin, rpc.DevicePluginServicer):
                     slice_env[EnvSliceName],
                     f"{owner.namespace}/{owner.name}", wid,
                 )
+                if self._timeline is not None:
+                    # Formation stamp: this bind just wrote the slice's
+                    # world + epoch into the pod's env — the event a
+                    # later reform (or a triage session asking "what
+                    # world did the runner boot into?") is diffed
+                    # against.
+                    self._timeline.emit(
+                        tl.KIND_SLICE_FORMED,
+                        keys={
+                            "pod": f"{owner.namespace}/{owner.name}",
+                            "container": owner.container,
+                            "slice": slice_env[EnvSliceName],
+                            "chips": list(chip_indexes),
+                        },
+                        resource=self.resource,
+                        epoch=_safe_int(slice_env.get(EnvSliceEpoch)),
+                        worker_id=wid,
+                        hosts=slice_env.get("TPU_WORKER_HOSTNAMES", ""),
+                    )
         else:
             slice_env = slice_env_for_pod(
                 annotations, topo, worker_id, hostnames
@@ -1077,6 +1166,15 @@ class _TPUSharePluginBase(_ListAndWatchMixin, rpc.DevicePluginServicer):
         get_tracer().annotate(
             pod=f"{owner.namespace}/{owner.name}", container=owner.container
         )
+        if self._timeline is not None:
+            # Replay phase: the transaction below re-journals its own
+            # intent/commit; this event marks that those happened as a
+            # recovery replay, not a fresh kubelet-driven bind.
+            self._timeline.emit(
+                tl.KIND_BIND_REPLAY,
+                keys=self._bind_keys(owner, device, []),
+                resource=self.resource,
+            )
         self._bind_located(device, owner, pod)
 
 
@@ -1253,8 +1351,16 @@ class TPUSharePlugin:
     def set_cordoned(self, flag: bool) -> None:
         """Drain cordon across BOTH resources (they must never disagree
         about schedulability, exactly like health)."""
+        changed = bool(flag) != self.core.cordoned
         self.core.set_cordoned(flag)
         self.memory.set_cordoned(flag)
+        timeline = getattr(self._config, "timeline", None)
+        if changed and timeline is not None:
+            timeline.emit(
+                tl.KIND_CORDON,
+                keys={"chips": sorted(self.core._chips)},
+                cordoned=bool(flag),
+            )
 
     @property
     def cordoned(self) -> bool:
@@ -1353,6 +1459,24 @@ class TPUSharePlugin:
                 )
             if went_bad:
                 self._warn_bound_pods(events, went_bad)
+        timeline = getattr(self._config, "timeline", None)
+        if timeline is not None:
+            if went_bad:
+                timeline.emit(
+                    tl.KIND_CHIP_HEALTH,
+                    keys={"chips": sorted(went_bad)},
+                    healthy=False,
+                    reasons={
+                        str(i): reasons[i] for i in sorted(went_bad)
+                        if i in reasons
+                    },
+                )
+            if recovered:
+                timeline.emit(
+                    tl.KIND_CHIP_HEALTH,
+                    keys={"chips": sorted(recovered)},
+                    healthy=True,
+                )
         metrics = self._config.metrics
         if metrics is not None and hasattr(metrics, "healthy_chips"):
             metrics.healthy_chips.set(
@@ -1462,6 +1586,13 @@ class TPUSharePlugin:
                             )
                 sp.set(hashes=hashes)
                 storage.delete(info.namespace, info.name)
+                timeline = getattr(self._config, "timeline", None)
+                if timeline is not None:
+                    timeline.emit(
+                        tl.KIND_POD_RECLAIMED,
+                        keys={"pod": key, "hash": hashes[0] if hashes else ""},
+                        source="gc", hashes=hashes,
+                    )
             reclaimed += 1
             events = self._config.events
             if events is not None:

@@ -136,6 +136,7 @@ class Reconciler:
         dry_run: bool = False,
         rng=None,
         slice_reformer=None,
+        timeline=None,
     ) -> None:
         self._storage = storage
         self._operator = operator
@@ -151,6 +152,10 @@ class Reconciler:
         # SliceReformer (slices/recovery.py): slice membership is a
         # divergence class — member loss re-forms the survivors.
         self._slices = slice_reformer
+        # Lifecycle timeline (timeline.py): every repair is journaled
+        # with its divergence class + the entity it acted on, so "what
+        # sequence of events converged this pod" is answerable later.
+        self._timeline = timeline
         # DrainOrchestrator (drain.py), assigned by the manager after
         # both exist: while a drain has reclaimed this node's bindings,
         # kubelet's still-listed assignments must NOT be replayed back.
@@ -180,7 +185,10 @@ class Reconciler:
 
     # -- plumbing -------------------------------------------------------------
 
-    def _count(self, report: dict, kind: str) -> None:
+    def _count(
+        self, report: dict, kind: str, keys: Optional[dict] = None,
+        emit: bool = True, **attrs,
+    ) -> None:
         report[KIND_REPORT_KEY[kind]] += 1
         with self._lock:
             self._repairs[kind] = self._repairs.get(kind, 0) + 1
@@ -190,6 +198,16 @@ class Reconciler:
                 m.reconcile_repairs.labels(kind=kind).inc()
             except Exception:  # noqa: BLE001 - metrics never break repair
                 pass
+        if emit and self._timeline is not None:
+            from .timeline import KIND_RECONCILE_REPAIR
+
+            # One journal event per repair, divergence class as an
+            # attribute: per-entity histories show WHAT the reconciler
+            # did to them, not just that repairs happened somewhere.
+            self._timeline.emit(
+                KIND_RECONCILE_REPAIR, keys=keys,
+                **{"class": kind, **attrs},
+            )
 
     def _sweep_failure(self, report: dict) -> None:
         report["sweep_failures"] += 1
@@ -465,7 +483,13 @@ class Reconciler:
                                 "reconcile: re-create %s failed", link_id
                             )
                 self._storage.journal_remove(intent["id"])
-                self._count(report, KIND_INTENT_COMMITTED)
+                self._count(
+                    report, KIND_INTENT_COMMITTED,
+                    keys={"pod": owner.pod_key,
+                          "container": owner.container,
+                          "hash": alloc_hash},
+                    intent_id=intent["id"], resource=resource,
+                )
                 logger.info(
                     "reconcile: intent %d (%s %s) was committed; journal "
                     "row dropped", intent["id"], owner.pod_key, alloc_hash,
@@ -486,7 +510,14 @@ class Reconciler:
                 retry_exists = True  # can't tell: stay non-destructive
             if retry_exists:
                 self._storage.journal_remove(intent["id"])
-                self._count(report, KIND_INTENT_ROLLED_BACK)
+                self._count(
+                    report, KIND_INTENT_ROLLED_BACK,
+                    keys={"pod": owner.pod_key,
+                          "container": owner.container,
+                          "hash": alloc_hash},
+                    intent_id=intent["id"], resource=resource,
+                    reason="superseded_by_retry",
+                )
                 logger.info(
                     "reconcile: dropped stale intent %d for %s — a "
                     "newer intent owns hash %s", intent["id"],
@@ -513,7 +544,15 @@ class Reconciler:
                 except OSError:
                     pass
             self._storage.journal_remove(intent["id"])
-            self._count(report, KIND_INTENT_ROLLED_BACK)
+            self._count(
+                report, KIND_INTENT_ROLLED_BACK,
+                keys={"pod": owner.pod_key,
+                      "container": owner.container,
+                      "hash": alloc_hash,
+                      "chips": list(payload.get("chip_indexes", []))},
+                intent_id=intent["id"], resource=resource,
+                reason="crashed_mid_bind",
+            )
             logger.warning(
                 "reconcile: rolled back crashed bind intent %d "
                 "(%s %s %s)", intent["id"], owner.pod_key, resource,
@@ -590,7 +629,14 @@ class Reconciler:
                 continue
             try:
                 self._operator.create(record.chip_indexes[pos], link_id)
-                self._count(report, KIND_RESTORED_LINK)
+                self._count(
+                    report, KIND_RESTORED_LINK,
+                    keys={"pod": owner.pod_key,
+                          "container": owner.container,
+                          "hash": record.device.hash,
+                          "chips": [record.chip_indexes[pos]]},
+                    link=link_id,
+                )
             except Exception:  # noqa: BLE001
                 logger.exception("reconcile: re-create %s failed", link_id)
         plugin = self._plugin_for(resource)
@@ -604,7 +650,13 @@ class Reconciler:
         # (idempotent — same device, same record, re-merged siblings).
         try:
             plugin.rebind(owner, record.device)
-            self._count(report, KIND_RESTORED_SPEC)
+            self._count(
+                report, KIND_RESTORED_SPEC,
+                keys={"pod": owner.pod_key, "container": owner.container,
+                      "hash": record.device.hash,
+                      "chips": list(record.chip_indexes)},
+                resource=resource,
+            )
         except Exception as e:  # noqa: BLE001
             logger.warning(
                 "reconcile: spec rebuild for %s %s failed: %s",
@@ -659,7 +711,12 @@ class Reconciler:
                     pass
         try:
             plugin.rebind(owner, Device(list(new_ids), resource))
-            self._count(report, KIND_REBOUND_DRIFT)
+            self._count(
+                report, KIND_REBOUND_DRIFT,
+                keys={"pod": owner.pod_key, "container": owner.container,
+                      "hash": new_hash},
+                resource=resource, old_hash=record.device.hash,
+            )
             logger.warning(
                 "reconcile: %s %s re-bound after kubelet device-id drift "
                 "(%s -> %s)", owner.pod_key, resource,
@@ -710,7 +767,13 @@ class Reconciler:
                     except Exception:  # noqa: BLE001
                         pass
         self._storage.delete(info.namespace, info.name)
-        self._count(report, KIND_RECLAIMED_POD)
+        self._count(
+            report, KIND_RECLAIMED_POD,
+            keys={"pod": info.key},
+            hashes=[
+                record.device.hash for record in info.records()
+            ],
+        )
         logger.info("reconcile: reclaimed dead pod %s", info.key)
 
     def drain_reclaim(self, pod_keys) -> dict:
@@ -805,7 +868,7 @@ class Reconciler:
                 continue
             try:
                 self._operator.delete(link_id)
-                self._count(report, KIND_ORPHAN_LINK)
+                self._count(report, KIND_ORPHAN_LINK, link=link_id)
             except Exception:  # noqa: BLE001
                 # NOT dropped forever any more: counted, and retried on
                 # the next pass (the link stays unrecorded).
@@ -828,7 +891,9 @@ class Reconciler:
                 continue
             try:
                 os.unlink(os.path.join(self._alloc_dir, fname))
-                self._count(report, KIND_ORPHAN_SPEC)
+                self._count(
+                    report, KIND_ORPHAN_SPEC, keys={"hash": stem}
+                )
             except FileNotFoundError:
                 pass
             except OSError:
@@ -894,7 +959,13 @@ class Reconciler:
                     continue  # stale kubelet state or unknowable: skip
                 try:
                     plugin.rebind(owner, Device(list(ids), resource))
-                    self._count(report, KIND_REPLAYED_BIND)
+                    self._count(
+                        report, KIND_REPLAYED_BIND,
+                        keys={"pod": owner.pod_key,
+                              "container": owner.container,
+                              "hash": alloc_hash},
+                        resource=resource,
+                    )
                     self._replay_backoff.pop(ukey, None)
                     logger.warning(
                         "reconcile: replayed unbound assignment %s %s -> "
@@ -1062,7 +1133,11 @@ class Reconciler:
                         continue
                 try:
                     self._slices.reform(owner, by_resource, div)
-                    self._count(report, KIND_SLICE_REFORMED)
+                    # emit=False: SliceReformer.reform journals the
+                    # richer slice_reformed event itself (epoch, lost/
+                    # joined hosts) — two events for one reform would
+                    # read as two reforms.
+                    self._count(report, KIND_SLICE_REFORMED, emit=False)
                 except Exception as e:  # noqa: BLE001 - retried next pass
                     logger.warning(
                         "reconcile: slice reform for %s (%s) failed: %s",

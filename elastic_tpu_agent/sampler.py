@@ -649,6 +649,7 @@ def build_diagnostics_bundle(
     trace_limit: int = 50,
     http_timeout_s: float = 3.0,
     storage=None,
+    timeline_limit: int = 200,
 ) -> dict:
     """One JSON document with everything a support escalation needs:
     devices, health + reasons, raw error counters, the live allocation
@@ -716,6 +717,37 @@ def build_diagnostics_bundle(
         "reconcile": {},
         "agent": {"url": agent_url, "reachable": None},
     }
+    # Lifecycle timeline: read straight from the checkpoint db (never
+    # from the live agent) — the history must be attachable to an
+    # escalation even when the agent is a corpse, and the db IS the
+    # journal either way. The newest agent_started event stamps the
+    # agent version + boot id into the bundle, so "did it restart mid-
+    # incident" is answerable from the bundle alone.
+    if storage is not None:
+        try:
+            rows = storage.timeline_rows()
+            # Boot identity from the never-evicted meta side channel
+            # (written by every agent_started emit); the event row
+            # itself is the fallback for journals written before the
+            # meta keys existed.
+            boots = [e for e in rows if e["kind"] == "agent_started"]
+            last_boot = boots[-1]["attrs"] if boots else {}
+            bundle["timeline"] = {
+                "events": rows[-timeline_limit:] if timeline_limit
+                else rows,
+                "total_events": storage.timeline_count(),
+                "evicted_total": storage.timeline_evicted_total(),
+                "agent_version": str(
+                    storage.timeline_meta_value("timeline_agent_version")
+                    or last_boot.get("version", "")
+                ),
+                "boot_id": str(
+                    storage.timeline_meta_value("timeline_boot_id")
+                    or last_boot.get("boot_id", "")
+                ),
+            }
+        except Exception as e:  # noqa: BLE001 - partial bundles beat none
+            logger.warning("doctor: timeline read failed: %s", e)
     # Journal/reconciler state: from the live sampler hook when attached,
     # else straight from the checkpoint db — open intents must be
     # readable from a bundle even when the agent is down (that IS the
@@ -876,6 +908,38 @@ def validate_bundle(bundle: dict) -> List[str]:
                 for field in ("pod", "resource", "hash", "age_s"):
                     expect(field in intent,
                            f"reconcile.open_intents[{i}] missing {field!r}")
+    if "timeline" in bundle:  # absent only without a checkpoint db
+        timeline = bundle["timeline"]
+        expect(isinstance(timeline, dict), "timeline must be an object")
+        if isinstance(timeline, dict):
+            for field in ("events", "total_events", "evicted_total",
+                          "agent_version", "boot_id"):
+                expect(field in timeline, f"timeline missing {field!r}")
+            events = timeline.get("events")
+            expect(isinstance(events, list), "timeline.events must be a "
+                                             "list")
+            prev_seq = None
+            for i, event in enumerate(
+                events if isinstance(events, list) else []
+            ):
+                if not isinstance(event, dict):
+                    problems.append(f"timeline.events[{i}] must be an "
+                                    "object")
+                    continue
+                for field in ("seq", "ts", "kind", "keys", "attrs"):
+                    expect(field in event,
+                           f"timeline.events[{i}] missing {field!r}")
+                seq = event.get("seq")
+                if isinstance(seq, int):
+                    expect(
+                        prev_seq is None or seq > prev_seq,
+                        f"timeline.events[{i}] seq {seq} not "
+                        "monotonically increasing",
+                    )
+                    prev_seq = seq
+            for field in ("total_events", "evicted_total"):
+                expect(isinstance(timeline.get(field), int),
+                       f"timeline.{field} must be an int")
     if "subsystems" in bundle:  # absent only in pre-supervision bundles
         subsystems = bundle["subsystems"]
         expect(isinstance(subsystems, dict), "subsystems must be an object")

@@ -274,6 +274,63 @@ class FleetAggregator:
             "max_s": round(max(done), 3) if done else None,
         }
 
+    # -- fleet-wide lifecycle timeline ----------------------------------------
+
+    def node_timeline(
+        self, node: str, since: Optional[float] = None
+    ) -> dict:
+        """One node's /debug/timeline payload (its durable journal,
+        seq-ordered, plus the ring counters)."""
+        url = f"{self.targets[node]}/debug/timeline"
+        if since is not None:
+            url += f"?since={since}"
+        return json.loads(self._get(url))
+
+    def merged_timeline(
+        self,
+        pod: Optional[str] = None,
+        slice_id: Optional[str] = None,
+        chip: Optional[int] = None,
+        since: Optional[float] = None,
+        kinds=None,
+        limit: Optional[int] = None,
+    ) -> dict:
+        """Interleave every node's lifecycle journal into ONE
+        fleet-ordered causal view, so a slice reform reads as one story
+        — maintenance notice on node A, proactive draining annotation,
+        survivors restamping at epoch N+1, reclaim — instead of N
+        disjoint logs.
+
+        Ordering: within a node, seq order (the node's own causal
+        order) is never violated; across nodes the merge goes by wall
+        time, and adopted trace ids (the admission id every bind
+        continues under) stitch the cross-node causality no clock
+        could. Entity filtering + causal expansion run over the MERGED
+        list with the same semantics as one node's query
+        (timeline.select_events), so a pod's fleet history includes the
+        reform events its slice peers journaled on other nodes."""
+        from ..timeline import merge_node_events, select_events
+
+        per_node = {}
+        unreachable = []
+        for node in sorted(self.targets):
+            try:
+                per_node[node] = self.node_timeline(
+                    node, since=since
+                ).get("events", [])
+            except Exception:  # noqa: BLE001 - a dead node: its journal
+                unreachable.append(node)  # is still on ITS db, not here
+        merged = merge_node_events(per_node)
+        events = select_events(
+            merged, pod=pod, slice_id=slice_id, chip=chip,
+            kinds=kinds, limit=limit,
+        )
+        return {
+            "nodes": sorted(per_node),
+            "unreachable": unreachable,
+            "events": events,
+        }
+
     # -- trace continuity -----------------------------------------------------
 
     def trace_lookup(self, trace_id: str) -> List[dict]:

@@ -129,6 +129,7 @@ class FleetSim:
         operator_kinds: Optional[List[str]] = None,
         drain_deadline_s: float = 5.0,
         drain_period_s: float = 0.5,
+        timeline_cap: Optional[int] = None,
     ) -> None:
         self.base_dir = base_dir
         self.n_nodes = nodes
@@ -149,6 +150,10 @@ class FleetSim:
         # production 300s — chaos scenarios assert reclaim-on-deadline.
         self.drain_deadline_s = drain_deadline_s
         self.drain_period_s = drain_period_s
+        # Lifecycle-timeline ring cap override (timeline.py): the
+        # timeline smoke shrinks it to prove the ring + eviction
+        # counter under churn; None = the production default.
+        self.timeline_cap = timeline_cap
         self.nodes: List[SimNode] = []
         self.apiserver = None
         self.api_url = ""
@@ -217,6 +222,10 @@ class FleetSim:
                 slice_membership_ttl_s=self.slice_membership_ttl_s,
                 drain_deadline_s=self.drain_deadline_s,
                 drain_period_s=self.drain_period_s,
+                **(
+                    {"timeline_cap": self.timeline_cap}
+                    if self.timeline_cap is not None else {}
+                ),
             )
             node.manager = TPUManager(node.opts)
             node.manager.run(block=False)
@@ -357,13 +366,21 @@ class FleetSim:
         return len(node.manager.operator.devices())
 
     def admit_pods(
-        self, pods_per_node: int, namespace: str = "fleet"
+        self,
+        pods_per_node: int,
+        namespace: str = "fleet",
+        node_idxs: Optional[List[int]] = None,
     ) -> List[PodRef]:
         """Schedule pods round-robin over each node's chips, stamping the
-        elastic-scheduler annotations plus an admission trace id."""
+        elastic-scheduler annotations plus an admission trace id.
+        ``node_idxs`` restricts admission to the named nodes (default:
+        all) — e.g. a churn burst aimed at one node's journal."""
         _, _, make_pod = _import_fakes()
         refs: List[PodRef] = []
-        for i, node in enumerate(self.nodes):
+        for i in (
+            range(self.n_nodes) if node_idxs is None else node_idxs
+        ):
+            node = self.nodes[i]
             n_chips = self._n_chips(node)
             for j in range(pods_per_node):
                 ref = PodRef(

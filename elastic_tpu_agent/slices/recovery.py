@@ -51,11 +51,13 @@ class SliceReformer:
         plugin,
         metrics=None,
         events=None,
+        timeline=None,
     ) -> None:
         self._registry = registry
         self._plugin = plugin
         self._metrics = metrics
         self._events = events
+        self._timeline = timeline
 
     @property
     def registry(self) -> SliceRegistry:
@@ -243,6 +245,18 @@ class SliceReformer:
         self._registry.record_local_pod(
             slice_id, owner.pod_key, div["new_worker_id"]
         )
+        if self._timeline is not None:
+            from ..timeline import KIND_SLICE_REFORMED
+
+            self._timeline.emit(
+                KIND_SLICE_REFORMED,
+                keys={"pod": owner.pod_key, "container": owner.container,
+                      "slice": slice_id},
+                epoch=epoch, world=len(new_hosts),
+                worker_id=div["new_worker_id"],
+                lost=div["lost"], joined=div["joined"],
+                hosts=",".join(new_hosts), torn=div.get("torn", False),
+            )
         if self._events is not None:
             from ..kube.events import ReasonSliceReformed
 

@@ -79,6 +79,30 @@ CREATE TABLE IF NOT EXISTS agent_state (
 );
 """
 
+# Append-only lifecycle event journal (timeline.py): every state
+# transition the agent makes — bind phases, reconciler repairs, drain
+# transitions, slice reforms, health/cordon flips, supervisor restarts
+# — lands here as one row, ring-capped so churn cannot grow the db
+# without bound. AUTOINCREMENT matters: seq numbers stay monotonic per
+# agent across the ring trim AND across restarts (sqlite never reuses a
+# rowid from sqlite_sequence), so per-node causal order survives both.
+# The eviction counter lives in timeline_meta: "how many events has the
+# ring dropped" must itself be durable, or a bounded table under churn
+# would be indistinguishable from a quiet one.
+_TIMELINE_SCHEMA = """
+CREATE TABLE IF NOT EXISTS timeline (
+    seq   INTEGER PRIMARY KEY AUTOINCREMENT,
+    ts    REAL NOT NULL,        -- wall clock at emit
+    kind  TEXT NOT NULL,        -- event kind (timeline.py constants)
+    keys  TEXT NOT NULL,        -- JSON join keys (pod/slice/chips/trace/node)
+    attrs TEXT NOT NULL         -- JSON event detail
+);
+CREATE TABLE IF NOT EXISTS timeline_meta (
+    key   TEXT PRIMARY KEY,
+    value TEXT NOT NULL
+);
+"""
+
 
 class Storage:
     """Thread-safe persistent map of pod key -> PodInfo.
@@ -127,6 +151,7 @@ class Storage:
             self._db.execute(_SCHEMA)
             self._db.execute(_JOURNAL_SCHEMA)
             self._db.execute(_STATE_SCHEMA)
+            self._db.executescript(_TIMELINE_SCHEMA)
             self._db.commit()
         except sqlite3.Error as e:
             raise StorageError(f"open {path}: {e}") from e
@@ -468,6 +493,193 @@ class Storage:
                 "DELETE FROM agent_state WHERE key=?",
                 (key,),
             )
+
+    # -- lifecycle timeline journal (timeline.py) ------------------------------
+
+    _TIMELINE_EVICTED_KEY = "timeline_evicted_total"
+    _TIMELINE_CAP_KEY = "timeline_cap"
+    # In-memory row count for the timeline ring (None = recompute from
+    # SQL on next use). Every bind emits events, so the append path
+    # must not pay a COUNT(*) b-tree scan per event; all timeline
+    # writes go through this connection, so delta-tracking under
+    # self._lock stays exact. Any sqlite error resets it to None.
+    _timeline_rows_cache: Optional[int] = None
+    # Last cap value persisted into timeline_meta (None = not yet
+    # written this process). The cap is a process argument, but the
+    # offline reader (node-doctor against a dead agent's db) must
+    # report the cap the agent actually RAN with, not a compiled-in
+    # default — so every append keeps the stored value current.
+    _timeline_cap_stored: Optional[int] = None
+
+    def timeline_append(
+        self, ts: float, kind: str, keys: dict, attrs: dict, cap: int
+    ) -> int:
+        """Append one lifecycle event and trim the ring to ``cap`` rows
+        (oldest first), bumping the durable eviction counter by however
+        many rows the trim dropped. Returns the event's monotonic seq.
+        One commit covers append + trim + counter, so a crash can never
+        leave the counter disagreeing with the rows."""
+        keys_json = json.dumps(keys, sort_keys=True, default=str)
+        attrs_json = json.dumps(attrs, sort_keys=True, default=str)
+        with self._lock:
+            for attempt in (1, 2):
+                try:
+                    cur = self._db.execute(
+                        "INSERT INTO timeline(ts, kind, keys, attrs) "
+                        "VALUES(?, ?, ?, ?)",
+                        (ts, kind, keys_json, attrs_json),
+                    )
+                    seq = cur.lastrowid
+                    if self._timeline_cap_stored != cap:
+                        self._db.execute(
+                            "INSERT INTO timeline_meta(key, value) "
+                            "VALUES(?, ?) ON CONFLICT(key) DO UPDATE "
+                            "SET value=excluded.value",
+                            (self._TIMELINE_CAP_KEY, str(cap)),
+                        )
+                        self._timeline_cap_stored = cap
+                    if self._timeline_rows_cache is None:
+                        self._timeline_rows_cache = self._db.execute(
+                            "SELECT COUNT(*) FROM timeline"
+                        ).fetchone()[0]
+                    else:
+                        self._timeline_rows_cache += 1
+                    excess = self._timeline_rows_cache - max(1, cap)
+                    if excess > 0:
+                        self._db.execute(
+                            "DELETE FROM timeline WHERE seq IN ("
+                            "SELECT seq FROM timeline ORDER BY seq "
+                            "LIMIT ?)",
+                            (excess,),
+                        )
+                        self._db.execute(
+                            "INSERT INTO timeline_meta(key, value) "
+                            "VALUES(?, ?) ON CONFLICT(key) DO UPDATE SET "
+                            "value = CAST(value AS INTEGER) + "
+                            "excluded.value",
+                            (self._TIMELINE_EVICTED_KEY, str(excess)),
+                        )
+                        self._timeline_rows_cache -= excess
+                    self._db.commit()
+                    return seq
+                except sqlite3.Error as e:
+                    self._timeline_rows_cache = None
+                    self._timeline_cap_stored = None  # write rolled back
+                    transient = self._is_transient_lock(e) and attempt == 1
+                    try:
+                        self._db.rollback()
+                    except sqlite3.Error:
+                        pass
+                    if not transient:
+                        raise StorageError(f"timeline append: {e}") from e
+                    time.sleep(_LOCKED_RETRY_DELAY_S)
+        raise StorageError(
+            "timeline append: retries exhausted"
+        )  # pragma: no cover - loop returns
+
+    def timeline_rows(
+        self,
+        since_seq: Optional[int] = None,
+        since_ts: Optional[float] = None,
+        limit: Optional[int] = None,
+    ) -> list:
+        """Journaled events oldest-first (seq order = per-agent causal
+        order), each ``{seq, ts, kind, keys, attrs}`` with the JSON
+        columns parsed (a corrupt column parses to {} rather than
+        killing the read — the journal is triage material and must
+        degrade, not disappear). ``limit`` keeps the NEWEST rows."""
+        sql = "SELECT seq, ts, kind, keys, attrs FROM timeline"
+        where, params = [], []
+        if since_seq is not None:
+            where.append("seq > ?")
+            params.append(since_seq)
+        if since_ts is not None:
+            where.append("ts >= ?")
+            params.append(since_ts)
+        if where:
+            sql += " WHERE " + " AND ".join(where)
+        sql += " ORDER BY seq"
+        with self._lock:
+            try:
+                rows = self._db.execute(sql, tuple(params)).fetchall()
+            except sqlite3.Error as e:
+                raise StorageError(f"timeline read: {e}") from e
+        if limit is not None and limit >= 0:
+            rows = rows[-limit:] if limit else []
+        out = []
+        for seq, ts, kind, keys_json, attrs_json in rows:
+            try:
+                keys = json.loads(keys_json)
+            except ValueError:
+                keys = {}
+            try:
+                attrs = json.loads(attrs_json)
+            except ValueError:
+                attrs = {}
+            out.append({
+                "seq": seq, "ts": ts, "kind": kind,
+                "keys": keys if isinstance(keys, dict) else {},
+                "attrs": attrs if isinstance(attrs, dict) else {},
+            })
+        return out
+
+    def timeline_count(self) -> int:
+        with self._lock:
+            if self._timeline_rows_cache is not None:
+                return self._timeline_rows_cache
+            try:
+                count = self._db.execute(
+                    "SELECT COUNT(*) FROM timeline"
+                ).fetchone()[0]
+            except sqlite3.Error as e:
+                raise StorageError(f"timeline count: {e}") from e
+            self._timeline_rows_cache = count
+            return count
+
+    def timeline_meta_value(self, key: str) -> Optional[str]:
+        """One timeline_meta value, or None when absent."""
+        with self._lock:
+            try:
+                row = self._db.execute(
+                    "SELECT value FROM timeline_meta WHERE key=?",
+                    (key,),
+                ).fetchone()
+            except sqlite3.Error as e:
+                raise StorageError(f"timeline meta read: {e}") from e
+        return None if row is None else row[0]
+
+    def timeline_set_meta(self, key: str, value: str) -> None:
+        """Upsert one timeline_meta value — the never-evicted side
+        channel for journal facts that must outlive the ring trim (the
+        boot identity the doctor bundle stamps)."""
+        with self._lock:
+            self._write(
+                f"timeline meta {key}",
+                "INSERT INTO timeline_meta(key, value) VALUES(?, ?) "
+                "ON CONFLICT(key) DO UPDATE SET value=excluded.value",
+                (key, value),
+            )
+
+    def _timeline_meta_int(self, key: str) -> Optional[int]:
+        value = self.timeline_meta_value(key)
+        if value is None:
+            return None
+        try:
+            return int(value)
+        except ValueError:
+            return None
+
+    def timeline_evicted_total(self) -> int:
+        """Durable count of events the ring cap has dropped (0 when the
+        ring never overflowed)."""
+        value = self._timeline_meta_int(self._TIMELINE_EVICTED_KEY)
+        return 0 if value is None else value
+
+    def timeline_cap_stored(self) -> Optional[int]:
+        """The ring cap the WRITING agent last appended under — what an
+        offline reader must report instead of its own default (None
+        when no event was ever journaled)."""
+        return self._timeline_meta_int(self._TIMELINE_CAP_KEY)
 
     def for_each(self, fn: Callable[[PodInfo], None]) -> None:
         """Invoke fn on a snapshot of every stored PodInfo.

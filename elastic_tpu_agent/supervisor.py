@@ -101,8 +101,13 @@ class Supervisor:
         crash_loop_window_s: float = DEFAULT_CRASH_LOOP_WINDOW_S,
         backoff_min_s: float = DEFAULT_BACKOFF_MIN_S,
         backoff_max_s: float = DEFAULT_BACKOFF_MAX_S,
+        timeline=None,
     ) -> None:
         self._metrics = metrics
+        # Lifecycle timeline (timeline.py): restarts and breaker trips
+        # journaled so a history can say "the reconciler died twice
+        # right before this pod's repairs stopped".
+        self._timeline = timeline
         self._crash_loop_threshold = max(1, crash_loop_threshold)
         self._crash_loop_window_s = crash_loop_window_s
         self._backoff_min_s = backoff_min_s
@@ -258,11 +263,29 @@ class Supervisor:
                     "probe restarts this pod"
                     if sub.criticality == CRITICAL else "",
                 )
+                if self._timeline is not None:
+                    from .timeline import KIND_SUBSYSTEM_CRASH_LOOP
+
+                    self._timeline.emit(
+                        KIND_SUBSYSTEM_CRASH_LOOP,
+                        subsystem=sub.name,
+                        criticality=sub.criticality,
+                        crashes_in_window=len(sub.crash_times),
+                        error=sub.last_error,
+                    )
                 if sub.criticality == CRITICAL:
                     self.terminal.set()
                 return
             sub.restarts += 1
             self._count(sub, "subsystem_restarts")
+            if self._timeline is not None:
+                from .timeline import KIND_SUBSYSTEM_RESTART
+
+                self._timeline.emit(
+                    KIND_SUBSYSTEM_RESTART,
+                    subsystem=sub.name, restart=sub.restarts,
+                    error=sub.last_error,
+                )
             if uptime > 2 * self._backoff_max_s:
                 backoff.reset()  # it ran long enough: healthy again
             delay = backoff.next_delay()
