@@ -5,7 +5,7 @@ TAG ?= elastic-tpu-agent:latest
 # verify's tier-1 line uses pipefail, which /bin/sh (dash) lacks
 SHELL := /bin/bash
 
-.PHONY: all native sanitize test test-all verify doctor-smoke chaos-smoke bench-smoke crash-replay-smoke fleet-smoke slice-smoke drain-smoke timeline-smoke protos image bench clean
+.PHONY: all native sanitize test test-all verify doctor-smoke chaos-smoke bench-smoke crash-replay-smoke fleet-smoke slice-smoke drain-smoke timeline-smoke serving-smoke protos image bench clean
 
 all: native test
 
@@ -127,8 +127,21 @@ drain-smoke:
 timeline-smoke:
 	JAX_PLATFORMS=cpu python3 bench.py --timeline-smoke
 
+# serving smoke: the serving data plane's CPU-only gate (bench.py
+# --serving-smoke): the serving_proxy leg must run and its modeled
+# gather-vs-paged HBM ratio must clear the documented paged_kernel
+# threshold (with the XLA cost-analysis corroboration present), the
+# repeated-shared-prefix scenario must show >= 3x prefilled-token
+# reduction with the automatic prefix cache on and logit-equivalent
+# (identical greedy) streams, and a 2-device tensor-parallel decode
+# (--xla_force_host_platform_device_count) must match the
+# single-device engine's streams and pool occupancy. Structural,
+# deterministic.
+serving-smoke:
+	JAX_PLATFORMS=cpu python3 bench.py --serving-smoke
+
 T1_TIMEOUT ?= 870
-verify: doctor-smoke chaos-smoke bench-smoke crash-replay-smoke fleet-smoke slice-smoke drain-smoke timeline-smoke
+verify: doctor-smoke chaos-smoke bench-smoke crash-replay-smoke fleet-smoke slice-smoke drain-smoke timeline-smoke serving-smoke
 	python -c "from prometheus_client import CollectorRegistry; \
 	  from elastic_tpu_agent.metrics import AgentMetrics; \
 	  AgentMetrics(registry=CollectorRegistry()); \

@@ -35,6 +35,21 @@ sys.path.insert(0, os.path.join(REPO, "tests"))
 
 N_PODS = 150
 WARMUP = 10
+
+
+def _last_json_line(stdout: str):
+    """The child-process output contract, in one place: the LAST
+    stdout line starting with '{' is the result. Returns the parsed
+    object, or None when absent or garbled (callers fall back to
+    their stderr-tail error paths)."""
+    for line in reversed(stdout.splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                return json.loads(line)
+            except ValueError:
+                return None
+    return None
 # set at tpu_measure_once entry; time budget anchor for the child's
 # optional measurements (serving probe)
 _CHILD_T0 = 0.0
@@ -1407,6 +1422,281 @@ def slice_smoke_main():
     return 0
 
 
+# -- serving data plane: HBM-traffic proxy + prefix cache + TP engine ---------
+#
+# The serving_proxy leg is DETERMINISTIC and CPU-only: a closed-form
+# bytes/FLOPs model of one decode step through the gather path vs the
+# Pallas paged path (corroborated by XLA cost analysis of both compiled
+# attention programs), plus the int8 KV-pool reduction — the evidence
+# that flips the paged_kernel default without waiting for a reachable
+# chip (two rounds of TPU-init timeouts blocked exactly that decision).
+
+
+_SERVING_PROXY_TIMEOUT_S = 300
+
+
+def serving_proxy_child_main():
+    """Child entry (--serving-proxy-child): one JSON line on a
+    CPU-pinned backend."""
+    from elastic_tpu_agent.common import strip_relay_env
+
+    # same guard as the qos child: CPU-pinned init must not hang on a
+    # wedged TPU relay
+    strip_relay_env()
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    try:
+        from elastic_tpu_agent.workloads.serving_proxy import (
+            serving_proxy_report,
+        )
+
+        print(json.dumps(serving_proxy_report()))
+    except Exception as e:  # noqa: BLE001 - explicit failure, not a skip
+        print(json.dumps(
+            {"failed": True, "error": f"{type(e).__name__}: {e}"}
+        ))
+
+
+def run_serving_proxy():
+    """One deterministic proxy report; never raises (skip/fail
+    contract like every other leg).
+
+    Runs in a JAX_PLATFORMS=cpu SUBPROCESS: the XLA cost-analysis
+    corroboration compiles through jax, and initializing any backend
+    in the bench parent would either hang before the preflight (the
+    exact failure the preflight kills) or grab the exclusive libtpu
+    client and poison every later chip leg."""
+    import subprocess
+
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__),
+             "--serving-proxy-child"],
+            capture_output=True, timeout=_SERVING_PROXY_TIMEOUT_S,
+            env=env,
+        )
+    except subprocess.TimeoutExpired:
+        return {
+            "failed": True,
+            "error": f"proxy child exceeded {_SERVING_PROXY_TIMEOUT_S}s",
+        }
+    except Exception as e:  # noqa: BLE001
+        return {"failed": True, "error": f"{type(e).__name__}: {e}"}
+    result = _last_json_line(proc.stdout.decode())
+    if result is not None:
+        return result
+    return {
+        "failed": True,
+        "error": f"proxy child rc={proc.returncode}: "
+                 f"{proc.stderr.decode(errors='replace')[-300:]}",
+    }
+
+
+SERVING_SMOKE_PREFIX_REDUCTION_MIN = 3.0
+
+
+def _serving_smoke_prefix_scenario():
+    """Repeated-shared-prefix serving: N requests carrying the same
+    56-token system prompt + distinct 4-token user tails, run through
+    the SAME engine twice (prefix cache on / off). Returns the report;
+    the caller asserts >= 3x prefilled-token reduction and
+    logit-equivalent (identical greedy) streams."""
+    import jax
+    import jax.numpy as jnp
+
+    from elastic_tpu_agent.workloads.serving import ServingEngine
+    from elastic_tpu_agent.workloads.transformer import (
+        ModelConfig,
+        init_params,
+    )
+
+    cfg = ModelConfig(
+        vocab=97, d_model=32, n_heads=4, n_layers=2, d_ff=64,
+        max_seq=192, dtype=jnp.float32, attn="reference", pos="rope",
+    )
+    params = init_params(cfg, jax.random.key(0))
+    system = [((7 * i) % 89) + 2 for i in range(56)]
+    tails = [[60 + i, 3 + i, 41 - i, 9 + i] for i in range(8)]
+
+    def run(prefix_cache):
+        eng = ServingEngine(
+            params, cfg, slots=1, max_len=128,
+            prompt_buckets=(8, 64), block_size=8,
+            prefix_cache=prefix_cache,
+        )
+        streams = []
+        for tail in tails:
+            rid = eng.admit(system + tail)
+            eng.step()
+            streams.append(eng.release(rid))
+        return eng, streams
+
+    eng_on, on = run(True)
+    eng_off, off = run(False)
+    stats = eng_on.stats()
+    return {
+        "requests": len(tails),
+        "system_prompt_tokens": len(system),
+        "prefilled_tokens_cache_on": eng_on.prefilled_tokens_total,
+        "prefilled_tokens_cache_off": eng_off.prefilled_tokens_total,
+        "prefill_reduction": round(
+            eng_off.prefilled_tokens_total
+            / max(1, eng_on.prefilled_tokens_total), 3
+        ),
+        "streams_equal": on == off,
+        "prefix_cache": stats["prefix_cache"],
+    }
+
+
+def _serving_smoke_tp_scenario():
+    """A 2-device tensor-parallel decode step on the CPU host
+    platform: streams and pool occupancy must match the single-device
+    engine exactly."""
+    import jax
+    import jax.numpy as jnp
+
+    from elastic_tpu_agent.workloads.partitioner import (
+        make_serving_mesh,
+    )
+    from elastic_tpu_agent.workloads.serving import ServingEngine
+    from elastic_tpu_agent.workloads.transformer import (
+        ModelConfig,
+        init_params,
+    )
+
+    if jax.device_count() < 2:
+        return {
+            "skipped": True,
+            "reason": f"{jax.device_count()} host devices "
+                      "(need >= 2; XLA_FLAGS came preset?)",
+        }
+    cfg = ModelConfig(
+        vocab=96, d_model=32, n_heads=4, n_layers=2, d_ff=64,
+        max_seq=96, dtype=jnp.float32, attn="reference", pos="rope",
+    )
+    params = init_params(cfg, jax.random.key(0))
+
+    def run(mesh):
+        eng = ServingEngine(
+            params, cfg, slots=2, max_len=64, prompt_buckets=(8,),
+            block_size=4, mesh=mesh,
+        )
+        ra = eng.admit([5, 17, 42])
+        occ = [eng.used_blocks]
+        for _ in range(2):
+            eng.step()
+            occ.append(eng.used_blocks)
+        rb = eng.admit([61, 3, 9])
+        for _ in range(2):
+            eng.step()
+            occ.append(eng.used_blocks)
+        return eng.release(ra), eng.release(rb), occ
+
+    want = run(None)
+    mesh = make_serving_mesh(mp=2, n_devices=2)
+    got = run(mesh)
+    return {
+        "devices": 2,
+        "mp": 2,
+        "streams_equal": got[0] == want[0] and got[1] == want[1],
+        "occupancy_equal": got[2] == want[2],
+        "occupancy": got[2],
+    }
+
+
+def serving_smoke_main():
+    """`make serving-smoke` (CPU-only): (1) the serving_proxy leg runs
+    and its model clears the documented threshold, (2) the
+    repeated-shared-prefix scenario shows >= 3x prefilled-token
+    reduction with logit-equivalent streams, (3) a 2-device
+    tensor-parallel decode matches the single-device engine. Exits
+    nonzero with reasons on violation."""
+    # >= 2 simulated host devices for the TP leg; must precede the
+    # first jax import in this process
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=2"
+        )
+    problems = []
+    out = {}
+
+    proxy = run_serving_proxy()
+    out["serving_proxy"] = proxy
+    if proxy.get("failed") or proxy.get("skipped"):
+        problems.append(f"serving_proxy leg did not run: {proxy}")
+    else:
+        ratio = proxy["hbm_kv_bytes_ratio_gather_over_paged"]
+        if ratio < proxy["threshold"]:
+            problems.append(
+                f"modeled KV-byte ratio {ratio} below threshold "
+                f"{proxy['threshold']} — the paged default's evidence "
+                "is gone"
+            )
+        if not proxy["paged_kernel_default"]["tpu_native"]:
+            problems.append(
+                "paged_kernel auto default no longer flips ON for "
+                "native TPU backends"
+            )
+        if proxy["paged_kernel_default"]["cpu_interpret"]:
+            problems.append(
+                "paged_kernel auto default flipped ON under interpret "
+                "mode (emulation has no HBM to save)"
+            )
+        xla = proxy.get("xla_cost_analysis", {})
+        if not (xla.get("gather_reference") or {}).get("bytes_accessed"):
+            problems.append(
+                f"XLA cost-analysis corroboration missing: {xla}"
+            )
+
+    try:
+        prefix = _serving_smoke_prefix_scenario()
+        out["prefix_cache"] = prefix
+        if prefix["prefill_reduction"] < SERVING_SMOKE_PREFIX_REDUCTION_MIN:
+            problems.append(
+                f"prefix-cache prefill reduction "
+                f"{prefix['prefill_reduction']}x below the "
+                f"{SERVING_SMOKE_PREFIX_REDUCTION_MIN}x bar"
+            )
+        if not prefix["streams_equal"]:
+            problems.append(
+                "prefix-cached streams diverged from uncached streams"
+            )
+    except Exception as e:  # noqa: BLE001
+        out["prefix_cache"] = {
+            "failed": True, "error": f"{type(e).__name__}: {e}"
+        }
+        problems.append(f"prefix-cache scenario failed: {e}")
+
+    try:
+        tp = _serving_smoke_tp_scenario()
+        out["tensor_parallel"] = tp
+        if tp.get("skipped"):
+            problems.append(f"TP scenario skipped: {tp['reason']}")
+        else:
+            if not tp["streams_equal"]:
+                problems.append("TP streams diverged from single-device")
+            if not tp["occupancy_equal"]:
+                problems.append(
+                    "TP pool occupancy diverged from single-device"
+                )
+    except Exception as e:  # noqa: BLE001
+        out["tensor_parallel"] = {
+            "failed": True, "error": f"{type(e).__name__}: {e}"
+        }
+        problems.append(f"TP scenario failed: {e}")
+
+    print(json.dumps({"serving_smoke": out, "problems": problems}))
+    if problems:
+        for p in problems:
+            print(f"serving smoke FAILED: {p}", file=sys.stderr)
+        return 1
+    print("serving smoke: OK", file=sys.stderr)
+    return 0
+
+
 # Peak bf16 TFLOP/s per chip (public spec sheet numbers).
 PEAK_TFLOPS = {"v2": 23, "v3": 61, "v4": 137.5, "v5e": 197, "v5p": 229.5,
                "v6e": 459}
@@ -1683,6 +1973,10 @@ def tpu_serving_measure(
     ][:slots]
 
     def run_engine(**kwargs):
+        # the A/B below owns the paged choice: the baseline must stay
+        # the gather path even now that the engine's auto default
+        # resolves ON for native TPU backends
+        kwargs.setdefault("paged_kernel", False)
         eng = ServingEngine(
             params, cfg, slots=slots, max_len=64,
             prompt_buckets=(32,), block_size=32, **kwargs,
@@ -1817,6 +2111,57 @@ _TPU_SUBPROC_TIMEOUT_S = int(
 _TPU_MAX_TIMEOUTS = 2
 
 
+# Fast preflight: the phased watchdog above still burns
+# _TPU_INIT_TIMEOUT_S per attempt (x retries, ~15 min total) when the
+# backend HANGS in init — the exact failure that cost rounds 4 and 5
+# their chip data. The preflight child does nothing but init the
+# backend and print the platform, under a bounded timeout, so a hung
+# or absent chip turns into an explicit skip in SECONDS and the bench
+# budget goes to the legs that can run.
+_TPU_PREFLIGHT_TIMEOUT_S = int(
+    os.environ.get("ELASTIC_TPU_BENCH_PREFLIGHT_TIMEOUT_S", "60")
+)
+
+
+def tpu_preflight(timeout_s=None):
+    """Bounded-timeout backend probe. Returns (ok, detail): ok=False
+    means every chip-dependent leg should skip with ``detail`` as the
+    reason (hung init, probe crash, or a cpu-only host)."""
+    import subprocess
+
+    timeout_s = timeout_s or _TPU_PREFLIGHT_TIMEOUT_S
+    code = (
+        "import json, jax; d = jax.devices();"
+        "print(json.dumps({'platform': d[0].platform,"
+        " 'count': len(d),"
+        " 'kind': getattr(d[0], 'device_kind', '')}))"
+    )
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", code],
+            capture_output=True, timeout=timeout_s,
+        )
+    except subprocess.TimeoutExpired:
+        return False, (
+            f"backend init still hung after {timeout_s}s preflight "
+            "timeout"
+        )
+    except Exception as e:  # noqa: BLE001 - a broken probe is a skip
+        return False, f"preflight probe failed: {type(e).__name__}: {e}"
+    if proc.returncode != 0:
+        tail = proc.stderr.decode(errors="replace")[-300:]
+        return False, f"preflight probe rc={proc.returncode}: {tail}"
+    info = _last_json_line(proc.stdout.decode())
+    if info is None:
+        return False, "preflight probe printed no result"
+    if info.get("platform") == "cpu":
+        return False, "cpu-only host (no accelerator attached)"
+    return True, (
+        f"{info.get('platform')} x{info.get('count')} "
+        f"({info.get('kind')})"
+    )
+
+
 def _run_tpu_child():
     """One watchdogged child run.
 
@@ -1873,13 +2218,9 @@ def _run_tpu_child():
     t_out.join(timeout=5)
     stdout = b"".join(stdout_chunks).decode()
     t.join(timeout=5)
-    for line in reversed(stdout.splitlines()):
-        line = line.strip()
-        if line.startswith("{"):
-            try:
-                return json.loads(line), None, False
-            except ValueError:
-                break
+    result = _last_json_line(stdout)
+    if result is not None:
+        return result, None, False
     tail = b"".join(stderr_chunks).decode()[-500:]
     return None, f"no result (rc={rc}): {tail}", False
 
@@ -1891,7 +2232,20 @@ def run_tpu_throughput():
     as an explicit ``{"skipped": true, "reason": ...}`` block, so a
     round whose chip was unreachable reads as 'skipped, here is why' in
     the BENCH json instead of silently losing the key (the round-3/4
-    failure mode the trajectory called out)."""
+    failure mode the trajectory called out).
+
+    A fast bounded preflight runs FIRST: a hung backend init (the
+    cause of two rounds of missing chip data) skips all chip legs in
+    seconds instead of burning the full phased-watchdog budget times
+    the retry schedule."""
+    ok, detail = tpu_preflight()
+    if not ok:
+        return {
+            "skipped": True,
+            "reason": f"tpu preflight: {detail}",
+            "preflight": {"ok": False, "detail": detail,
+                          "timeout_s": _TPU_PREFLIGHT_TIMEOUT_S},
+        }
     last_err = None
     timeouts = 0
     for delay in _TPU_RETRY_DELAYS_S:
@@ -2019,16 +2373,12 @@ def _communicate_child(frac, proc, results):
         proc.wait()
         results[key] = {"error": f"timed out after {_QOS_TIMEOUT_S}s"}
         return
-    line = next(
-        (ln for ln in reversed(stdout.decode().splitlines())
-         if ln.strip().startswith("{")), None,
-    )
-    if proc.returncode == 0 and line:
-        try:
-            results[key] = json.loads(line)
+    if proc.returncode == 0:
+        result = _last_json_line(stdout.decode())
+        if result is not None:
+            results[key] = result
             return
-        except ValueError:
-            pass  # partial/garbled line: fall through to the tail
+        # garbled/absent result line: fall through to the tail
     results[key] = {
         "error": f"rc={proc.returncode}",
         "stderr_tail": stderr.decode()[-400:],
@@ -2110,6 +2460,7 @@ def main():
             "skipped": True,
             "reason": f"fleet sim failed: {type(e).__name__}: {e}",
         }
+    serving_proxy = run_serving_proxy()
     tpu = run_tpu_throughput()
     # QoS co-location only makes sense when the chip is reachable at
     # all (its children would just burn the same init timeout)
@@ -2161,6 +2512,10 @@ def main():
             # amplification, trace continuity).
             "fleet": fleet,
             "pods": N_PODS,
+            # Deterministic CPU proxy: paged-vs-gather HBM bytes + ops
+            # per decode step, the paged_kernel default's evidence —
+            # present every round even when the chip legs skip.
+            "serving_proxy": serving_proxy,
             "tpu": tpu,
             "qos_colocation": qos,
         },
@@ -2183,6 +2538,10 @@ if __name__ == "__main__":
         sys.exit(drain_smoke_main())
     elif "--timeline-smoke" in sys.argv:
         sys.exit(timeline_smoke_main())
+    elif "--serving-smoke" in sys.argv:
+        sys.exit(serving_smoke_main())
+    elif "--serving-proxy-child" in sys.argv:
+        serving_proxy_child_main()
     elif "--fleet" in sys.argv:
         sys.exit(fleet_main())
     else:

@@ -342,6 +342,62 @@ class AgentMetrics:
                      300.0, 600.0, 1800.0),
             **kw,
         )
+        # -- serving data plane (workloads/serving.py) ---------------------
+        # All read through attach_serving's set_function hooks: the
+        # engine's hot path never touches prometheus, and the values
+        # are the engine's own monotone counters (gauges rather than
+        # Counters because the source of truth lives in the engine).
+        self.serving_pool_blocks = Gauge(
+            "elastic_tpu_serving_pool_blocks",
+            "Total KV block-pool capacity of the attached serving "
+            "engine (junk block included)",
+            **kw,
+        )
+        self.serving_pool_used = Gauge(
+            "elastic_tpu_serving_pool_used_blocks",
+            "KV pool blocks currently held (live request tables + "
+            "registered prefixes + prefix-cache holdings)",
+            **kw,
+        )
+        self.serving_prefix_cache_hits = Gauge(
+            "elastic_tpu_serving_prefix_cache_hits",
+            "Admissions that reused at least one cached prefix block "
+            "(engine-lifetime count)",
+            **kw,
+        )
+        self.serving_prefix_cache_misses = Gauge(
+            "elastic_tpu_serving_prefix_cache_misses",
+            "Admissions that reused nothing from the prefix cache "
+            "(engine-lifetime count)",
+            **kw,
+        )
+        self.serving_prefix_cache_evictions = Gauge(
+            "elastic_tpu_serving_prefix_cache_evictions",
+            "Cached blocks dropped under pool pressure or the cache "
+            "cap (engine-lifetime count)",
+            **kw,
+        )
+        self.serving_prefix_cache_hit_rate = Gauge(
+            "elastic_tpu_serving_prefix_cache_hit_rate",
+            "hits / (hits + misses) of the automatic prefix cache; "
+            "a falling rate under steady traffic means the shared "
+            "prefixes stopped fitting the pool",
+            **kw,
+        )
+        self.serving_prefilled_tokens = Gauge(
+            "elastic_tpu_serving_prefilled_tokens",
+            "Prompt tokens actually run through a prefill forward "
+            "(engine-lifetime; compare with "
+            "elastic_tpu_serving_admitted_tokens for the cache's "
+            "savings)",
+            **kw,
+        )
+        self.serving_admitted_tokens = Gauge(
+            "elastic_tpu_serving_admitted_tokens",
+            "Prompt tokens admitted including cache-reused ones "
+            "(engine-lifetime)",
+            **kw,
+        )
         self.observability_dropped = Counter(
             "elastic_tpu_observability_dropped_total",
             "CRD/event writes dropped by the bounded async queue",
@@ -497,6 +553,45 @@ class AgentMetrics:
                 return 0.0
 
         self.timeline_evicted.set_function(_evicted)
+
+    def attach_serving(self, status_fn) -> None:
+        """Export a live serving engine's stats()
+        (workloads/serving.py) as the elastic_tpu_serving_* gauges.
+        ``status_fn`` is read at scrape time via set_function — a
+        broken engine reads as 0s, never a failed scrape."""
+
+        def read(*path):
+            def _read() -> float:
+                try:
+                    node = status_fn() or {}
+                    for key in path[:-1]:
+                        node = node.get(key) or {}
+                    value = node.get(path[-1])
+                    return float(value) if value is not None else 0.0
+                except Exception:  # noqa: BLE001 - scrape never breaks
+                    return 0.0
+            return _read
+
+        self.serving_pool_blocks.set_function(read("pool_blocks"))
+        self.serving_pool_used.set_function(read("used_blocks"))
+        self.serving_prefilled_tokens.set_function(
+            read("prefilled_tokens_total")
+        )
+        self.serving_admitted_tokens.set_function(
+            read("admitted_tokens_total")
+        )
+        self.serving_prefix_cache_hits.set_function(
+            read("prefix_cache", "hits")
+        )
+        self.serving_prefix_cache_misses.set_function(
+            read("prefix_cache", "misses")
+        )
+        self.serving_prefix_cache_evictions.set_function(
+            read("prefix_cache", "evictions")
+        )
+        self.serving_prefix_cache_hit_rate.set_function(
+            read("prefix_cache", "hit_rate")
+        )
 
     def attach_supervisor(self, supervisor) -> None:
         """Fold supervisor state into /healthz: any circuit-broken

@@ -129,6 +129,14 @@ class UtilizationSampler:
         # /debug/allocations and the doctor bundle — drain-stuck triage
         # must work from a bundle alone.
         self.drain_status_fn: Optional[Callable[[], dict]] = None
+        # Optional: () -> serving-engine stats (ServingEngine.stats():
+        # block-pool occupancy, prefix-cache hit/miss/eviction
+        # counters) — the `serving` block of /debug/allocations and
+        # the doctor bundle. NO agent subsystem wires this today (the
+        # agent hosts no engine): a process that embeds an engine next
+        # to a sampler assigns it directly, same as
+        # AgentMetrics.attach_serving. Absent -> no serving block.
+        self.serving_status_fn: Optional[Callable[[], dict]] = None
         # Also manager-set: () -> set of unhealthy chip indexes, the
         # plugin's APPLIED health view. Snapshots must read this (a
         # plain set copy) instead of re-probing the operator:
@@ -617,6 +625,11 @@ class UtilizationSampler:
                 out["drain"] = self.drain_status_fn()
             except Exception:  # noqa: BLE001 - introspection only
                 pass
+        if self.serving_status_fn is not None:
+            try:
+                out["serving"] = self.serving_status_fn()
+            except Exception:  # noqa: BLE001 - introspection only
+                pass
         return out
 
 
@@ -882,6 +895,28 @@ def validate_bundle(bundle: dict) -> List[str]:
             for field in ("stamped_pods", "reclaimed_pods"):
                 expect(isinstance(drain.get(field, []), list),
                        f"allocations.drain.{field} must be a list")
+    if isinstance(allocations, dict) and "serving" in allocations:
+        # absent unless a serving engine's stats hook is attached
+        # (runner serve mode / tests); agent-only nodes have none
+        serving = allocations["serving"]
+        expect(isinstance(serving, dict),
+               "allocations.serving must be an object")
+        if isinstance(serving, dict):
+            for field in ("pool_blocks", "used_blocks",
+                          "pool_occupancy", "prefilled_tokens_total"):
+                expect(field in serving,
+                       f"allocations.serving missing {field!r}")
+            if "prefix_cache" in serving:
+                pc = serving["prefix_cache"]
+                expect(isinstance(pc, dict),
+                       "allocations.serving.prefix_cache must be an "
+                       "object")
+                if isinstance(pc, dict):
+                    for field in ("hits", "misses", "evictions",
+                                  "cached_blocks"):
+                        expect(field in pc,
+                               "allocations.serving.prefix_cache "
+                               f"missing {field!r}")
     windows = bundle.get("sampler_windows")
     expect(isinstance(windows, dict), "sampler_windows must be an object")
     if isinstance(windows, dict):

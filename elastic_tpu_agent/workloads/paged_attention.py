@@ -164,6 +164,28 @@ def paged_decode_attention(
     return out.reshape(slots, n, h)
 
 
+def kernel_traffic(
+    slots: int, table_blocks: int, block_size: int, kv_heads: int,
+    head_dim: int, itemsize: int,
+) -> dict:
+    """Exact per-invocation HBM stream accounting of the kernel above,
+    derived from its grid: (slots, g, nb) programs, each DMA-ing one
+    [1, bs, 1, h] K block and V block HBM->VMEM exactly once (the
+    BlockSpec index maps dereference the prefetched table), one
+    [1, 1, r, h] query read and one output write per (slot, kv head).
+    serving_proxy.py consumes this so the bench's paged-path byte
+    model IS the kernel's shape, not a re-derivation that could
+    drift."""
+    g, h, bs, nb = kv_heads, head_dim, block_size, table_blocks
+    kv_read = slots * g * nb * bs * h * itemsize * 2   # k + v
+    return {
+        "grid": (slots, g, nb),
+        "kv_bytes_read": kv_read,
+        "blocks_streamed": slots * g * nb,
+        "reads_per_block": 1,
+    }
+
+
 def paged_decode_attention_reference(
     q, pool_k, pool_v, table, lengths, kv_heads: int, window: int = 0
 ):

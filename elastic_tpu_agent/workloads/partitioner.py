@@ -1,0 +1,199 @@
+"""Serving partitioner: mesh construction + sharding rules for the
+tensor-parallel ServingEngine.
+
+Millions-of-users traffic is served by engines WIDER than one chip:
+a model that fits one chip's HBM still wants its per-step matmuls and
+KV reads spread over a slice so decode latency scales down with chips.
+This module is the serving counterpart of generate.decode_shardings,
+shaped after the two reference patterns in SNIPPETS.md: [2]'s
+logical-axis -> mesh-axis rule table over an (dp, mp) mesh, and [3]'s
+Partitioner object that owns the mesh and the placement decisions so
+engine code never touches PartitionSpecs directly.
+
+The engine's tensor-parallel layout:
+- attention heads (wq/wqkv, wo) and GQA kv heads (wkv) split over
+  "mp";
+- the MLP hidden axis (w1/w2) and the lm_head vocab axis split over
+  "mp" (one all-reduce per step rides the mesh after wo/w2, the
+  standard Megatron shape);
+- the paged KV POOL [L, n_blocks, bs, kv_heads, h] splits its kv-head
+  axis over "mp" — each chip holds its heads' slice of every block, so
+  pool BOOKKEEPING (allocator, tables, refcounts) is identical to the
+  single-device engine and occupancy matches it block for block;
+- embeddings/norms replicate ("mp" collectives stay in the layer
+  body), and "dp" is a fleet-of-engines axis: one ServingEngine owns
+  one continuous batch, so in-engine batch stays unsharded.
+
+Exercised on CPU via --xla_force_host_platform_device_count (the same
+harness the sharded-decode and multihost tests use).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+# Logical-axis -> mesh-axis rules (SNIPPETS [2] DEFAULT_RULES shape).
+RULES = {
+    "batch": None,
+    "heads": "mp",
+    "embed": None,
+    "mlp": "mp",
+    "kv_heads": "mp",
+    "seq": None,
+    "vocab": "mp",
+}
+
+# Per-leaf PartitionSpecs derived from RULES against init_params'
+# shapes (transformer.py): wqkv [d, 3, n, h], wq [d, n, h],
+# wkv [d, 2, g, h], wo [n, h, d], w1 [d, f], w2 [f, d],
+# lm_head [d, v]. Everything absent here replicates.
+_LEAF_SPECS = {
+    "wqkv": (None, None, RULES["heads"], None),
+    "wq": (None, RULES["heads"], None),
+    "wkv": (None, None, RULES["kv_heads"], None),
+    "wo": (RULES["heads"], None, None),
+    "w1": (None, RULES["mlp"]),
+    "w2": (RULES["mlp"], None),
+    "lm_head": (None, RULES["vocab"]),
+}
+
+# The paged pool [L, n_blocks, block, kv_heads, head_dim]: kv heads
+# over "mp", everything else replicated (the block axis is addressed by
+# host-side tables, splitting it would shard the allocator too).
+POOL_SPEC = (None, None, None, RULES["kv_heads"], None)
+
+
+def make_serving_mesh(
+    mp: Optional[int] = None, n_devices: Optional[int] = None
+) -> Mesh:
+    """(dp, mp) mesh over the visible devices; default mp = all of
+    them (one tensor-parallel engine spanning the slice)."""
+    devices = jax.devices()
+    n = n_devices or len(devices)
+    if n > len(devices):
+        # fail loudly here, not as an opaque reshape error below (a
+        # preset XLA_FLAGS with a smaller device count is the usual
+        # culprit)
+        raise ValueError(
+            f"requested n_devices={n} but only {len(devices)} "
+            "visible (check --xla_force_host_platform_device_count)"
+        )
+    devices = devices[:n]
+    if mp is None:
+        mp = n
+    if n % mp:
+        raise ValueError(f"mp={mp} does not divide {n} devices")
+    arr = np.array(devices).reshape(n // mp, mp)
+    return Mesh(arr, axis_names=("dp", "mp"))
+
+
+class ServingPartitioner:
+    """Owns the serving engine's mesh and placement (SNIPPETS [3]'s
+    Partitioner shape). ``mesh=None`` is the single-device
+    partitioner: every method is a no-op passthrough, so the engine
+    has ONE code path."""
+
+    def __init__(self, mesh: Optional[Mesh], cfg) -> None:
+        self.mesh = mesh
+        self.cfg = cfg
+        if mesh is None:
+            return
+        if "mp" not in mesh.shape:
+            raise ValueError(
+                "serving mesh needs an 'mp' axis; build it with "
+                "partitioner.make_serving_mesh"
+            )
+        if cfg.moe_experts:
+            raise ValueError(
+                "tensor-parallel serving supports dense models (MoE "
+                "expert parallelism is a different mesh axis)"
+            )
+        mp = mesh.shape["mp"]
+        for name, dim in (
+            ("n_heads", cfg.n_heads),
+            ("kv_heads", cfg.kv_heads),
+            ("d_ff", cfg.d_ff),
+            ("vocab", cfg.vocab),
+        ):
+            if dim % mp:
+                raise ValueError(
+                    f"cfg.{name} {dim} must divide over mp={mp} "
+                    "(heads/mlp/vocab all split on that axis)"
+                )
+
+    @property
+    def mp(self) -> int:
+        return 1 if self.mesh is None else self.mesh.shape["mp"]
+
+    def _ns(self, *spec) -> NamedSharding:
+        return NamedSharding(self.mesh, P(*spec))
+
+    # -- params -------------------------------------------------------
+
+    def _leaf_sharding(self, name: str, leaf):
+        from .quantize import is_quantized
+
+        spec = _LEAF_SPECS.get(name)
+        ns = self._ns(*spec) if spec else self._ns()
+        if is_quantized(leaf):
+            # int8 weight-only tree: the scale keeps keepdims axes
+            # unpartitioned (generate.decode_shardings' rule)
+            padded = tuple(spec or ()) + (None,) * (
+                leaf["s"].ndim - len(spec or ())
+            )
+            s_spec = tuple(
+                None if dim == 1 else ax
+                for dim, ax in zip(leaf["s"].shape, padded)
+            )
+            return {"q": ns, "s": self._ns(*s_spec)}
+        return ns
+
+    def param_shardings(self, params: Dict) -> Dict:
+        """NamedSharding tree matching ``params`` exactly (device_put
+        rejects any structural mismatch, so a new param leaf that
+        needs a rule fails loudly here rather than silently
+        replicating)."""
+
+        def shard_container(container: Dict) -> Dict:
+            return {
+                name: self._leaf_sharding(name, leaf)
+                for name, leaf in container.items()
+            }
+
+        out = {
+            name: self._leaf_sharding(name, leaf)
+            for name, leaf in params.items()
+            if name != "layers"
+        }
+        out["layers"] = [
+            shard_container(layer) for layer in params["layers"]
+        ]
+        return out
+
+    def shard_params(self, params: Dict) -> Dict:
+        if self.mesh is None:
+            return params
+        return jax.device_put(params, self.param_shardings(params))
+
+    # -- the paged KV pool --------------------------------------------
+
+    def pool_sharding(self):
+        return None if self.mesh is None else self._ns(*POOL_SPEC)
+
+    def place_pool(self, pool):
+        """Place one pool side (array, or the int8 {"q","s"} pytree —
+        the scale's trailing keepdims axis is size 1 and replicates
+        under the same spec)."""
+        if self.mesh is None:
+            return pool
+        ns = self._ns(*POOL_SPEC)
+        if isinstance(pool, dict):
+            return {
+                "q": jax.device_put(pool["q"], ns),
+                "s": jax.device_put(pool["s"], ns),
+            }
+        return jax.device_put(pool, ns)

@@ -111,6 +111,25 @@ def embed_lookup(params: Dict, tokens: jax.Array, dtype=jnp.bfloat16):
     return leaf.astype(dtype)[tokens]
 
 
+def quantize_kv(x: jax.Array) -> Dict[str, jax.Array]:
+    """Symmetric int8 quantization of K/V cache entries, one f32 scale
+    per POSITION (amax over the trailing head_dim axis). The serving
+    engine's paged pool stores ``{"q": int8 [..., h], "s": f32
+    [..., 1]}`` per pool entry: reads shrink ~4x (f32 models) and the
+    per-position scale keeps the dequant a fused gather+multiply, the
+    same shape as embed_lookup's row dequant."""
+    x = x.astype(jnp.float32)
+    absmax = jnp.max(jnp.abs(x), axis=-1, keepdims=True)
+    scale = jnp.maximum(absmax, 1e-8) / 127.0
+    q = jnp.clip(jnp.round(x / scale), -127, 127).astype(jnp.int8)
+    return {"q": q, "s": scale}
+
+
+def dequantize_kv(qkv: Dict[str, jax.Array]) -> jax.Array:
+    """Inverse of quantize_kv (f32 out; exact per-position dequant)."""
+    return qkv["q"].astype(jnp.float32) * qkv["s"]
+
+
 def quantize_params(params: Dict) -> Dict:
     """Quantize every eligible leaf of a transformer params tree
     (init_params shape, transformer.py). Returns a new tree; the input

@@ -61,16 +61,83 @@ from .transformer import ModelConfig, _rmsnorm, rope
 _JUNK = 0
 
 
+def gather_bucket(needed_blocks: int, max_blocks: int) -> int:
+    """Power-of-two gather-width bucketing — ONE function shared by
+    the engine's compiled-program keys and the HBM-traffic proxy
+    (serving_proxy.py models exactly the widths the engine compiles,
+    so a bucketing change can't silently stale the paged-default
+    evidence)."""
+    b = 1
+    while b < needed_blocks:
+        b *= 2
+    return min(b, max_blocks)
+
+
+# -- pool representation helpers ------------------------------------
+#
+# The KV pool is either a plain array [L, n_blocks, bs, g, h] or (engine
+# flag kv_int8) the quantized pytree {"q": int8 same shape, "s": f32
+# [..., 1] per-position scales} — decode is HBM-bound and the pool is
+# the engine's dominant HBM resident, so int8 storage cuts per-step
+# cache reads ~4x (f32 models) / ~2x (bf16). Every pool read/write goes
+# through these two helpers, so the compiled programs handle both forms
+# with one code path (quantize on scatter, dequantize on gather).
+
+def _pool_empty(shape, dtype, kv_int8: bool):
+    if not kv_int8:
+        return jnp.zeros(shape, dtype)
+    return {
+        "q": jnp.zeros(shape, jnp.int8),
+        "s": jnp.zeros(shape[:-1] + (1,), jnp.float32),
+    }
+
+
+def _pool_shape(pool):
+    return pool["q"].shape if isinstance(pool, dict) else pool.shape
+
+
+def _pool_set(pool, idx, val):
+    """pool.at[idx].set(val) for either pool form (float values in;
+    int8 pools quantize per position on the way down)."""
+    if isinstance(pool, dict):
+        from .quantize import quantize_kv
+
+        qv = quantize_kv(val)
+        return {
+            "q": pool["q"].at[idx].set(qv["q"]),
+            "s": pool["s"].at[idx].set(qv["s"]),
+        }
+    return pool.at[idx].set(val.astype(pool.dtype))
+
+
+def _pool_get(pool, idx):
+    """pool[idx] for either form (int8 pools gather the int8 entries +
+    scales and dequantize AFTER the gather — the HBM read stays
+    int8-sized, exactly embed_lookup's pattern)."""
+    if isinstance(pool, dict):
+        return pool["q"][idx].astype(jnp.float32) * pool["s"][idx]
+    return pool[idx]
+
+
 class BlockAllocator:
     """Host-side pool bookkeeping: a free list plus per-block refcounts
-    (shared prefix blocks are held by several tables at once)."""
+    (shared prefix blocks are held by several tables at once).
+
+    ``reclaim`` (optional, set by the engine when automatic prefix
+    caching is on) is the pool-pressure hook: called with the number of
+    blocks needed when the free list runs dry, it may evict cache-held
+    refcount-1 blocks back onto the free list before alloc() declares
+    exhaustion."""
 
     def __init__(self, n_blocks: int):
         self.n_blocks = n_blocks
         self._free: List[int] = list(range(n_blocks - 1, 0, -1))
         self._ref = np.zeros((n_blocks,), np.int32)
+        self.reclaim: Optional[object] = None  # (n_blocks) -> freed
 
     def alloc(self) -> int:
+        if not self._free and self.reclaim is not None:
+            self.reclaim(1)
         if not self._free:
             raise RuntimeError(
                 "KV block pool exhausted; release() a request or size "
@@ -133,7 +200,32 @@ class ServingEngine:
     writes land directly in pool blocks and attention streams each
     block from HBM once — no gathered transient. Streams are pinned
     identical to the gather path; prefill/spec steps keep the gather
-    (they are multi-token).
+    (they are multi-token). ``paged_kernel=None`` (auto) resolves from
+    the HBM-traffic proxy's documented threshold (serving_proxy.py):
+    ON for native TPU backends, OFF where the kernel would only be
+    emulated (CPU interpret mode) or can't run the layout (int8 pool,
+    tensor-parallel mesh).
+
+    ``prefix_cache=True`` turns on AUTOMATIC cross-request prefix
+    caching (prefix_cache.py): every full prompt block a prefill
+    writes is published under a token hash chain, admissions share
+    the longest cached chain and prefill only the tail, and
+    refcount-1 cached blocks evict LRU under pool pressure.
+    ``prefix_cache_blocks`` caps the cache; hit/miss/eviction counters
+    ride ``stats()``, the flight recorder, and the agent's serving
+    gauges. Cached streams are bit-identical to uncached ones — the
+    reuse is the original K/V bytes, never a recompute.
+
+    ``kv_int8=True`` stores the pool as int8 with per-position f32
+    scales (quantize.quantize_kv): KV reads shrink ~4x (f32) / ~2x
+    (bf16); decode attends dequantized values, so streams are
+    approximate (quantizer noise), not bit-pinned. Gather path only.
+
+    ``mesh`` (partitioner.make_serving_mesh) makes the engine
+    TENSOR-PARALLEL: heads/MLP/vocab and the pool's kv-head axis
+    shard over the mesh's "mp" axis; host-side pool bookkeeping —
+    and so occupancy, prefix caching, eviction — is identical to the
+    single-device engine. Gather path only; spec mode unsupported.
 
     SPECULATIVE MODE: pass ``draft_params``/``draft_cfg`` (and
     optionally ``gamma``) and every step() becomes a speculative
@@ -169,8 +261,15 @@ class ServingEngine:
         draft_params: Optional[Dict] = None,
         draft_cfg: Optional[ModelConfig] = None,
         gamma: int = 4,
-        paged_kernel: bool = False,
+        # None = AUTO: resolved from the HBM-traffic proxy's documented
+        # threshold (serving_proxy.py) — the kernel is the default
+        # wherever it runs natively; see the class docstring
+        paged_kernel: Optional[bool] = None,
         recorder=None,
+        prefix_cache: bool = False,
+        prefix_cache_blocks: Optional[int] = None,
+        kv_int8: bool = False,
+        mesh=None,
     ):
         # optional flight recorder (workloads/telemetry.py): every
         # admit/step emits a JSONL record tagged with the agent's
@@ -209,13 +308,54 @@ class ServingEngine:
             pool_blocks = 1 + (slots + 1) * self.max_blocks
         self.pool_blocks = pool_blocks
         self._alloc = BlockAllocator(pool_blocks)
+        # automatic cross-request prefix caching (prefix_cache.py):
+        # every full prompt block a prefill writes is published under a
+        # token hash chain; admissions share the longest cached chain
+        # and prefill only the tail. Off by default — cached blocks
+        # outlive their request (refcount 1, LRU-evicted under pool
+        # pressure), which changes used_blocks bookkeeping callers may
+        # watch.
+        self._prefix_cache = None
+        if prefix_cache:
+            from .prefix_cache import PrefixCache
 
+            self._prefix_cache = PrefixCache(
+                self._alloc, block_size, max_blocks=prefix_cache_blocks
+            )
+            self._alloc.reclaim = self._prefix_cache.reclaim
+        # REAL prompt tokens run through a prefill forward (tails only
+        # when the cache hits); the serving bench's >=3x prefill
+        # reduction claim is measured against this counter.
+        self.prefilled_tokens_total = 0
+        self.admitted_tokens_total = 0
+
+        self.kv_int8 = kv_int8
+        if kv_int8 and draft_params is not None:
+            raise ValueError(
+                "speculative serving does not support kv_int8 (the "
+                "accept/resample algebra is pinned to the float pool)"
+            )
+        # tensor-parallel serving (partitioner.py): attention heads,
+        # MLP and vocab shard over the mesh's "mp" axis, and the paged
+        # KV pool shards its kv-head axis the same way — the host-side
+        # allocator/table bookkeeping never changes, so pool occupancy
+        # matches the single-device engine block for block.
+        from .partitioner import ServingPartitioner
+
+        self.mesh = mesh
+        self._part = ServingPartitioner(mesh, cfg)
+        if mesh is not None:
+            self.params = params = self._part.shard_params(params)
         pool_shape = (
             cfg.n_layers, pool_blocks, block_size,
             cfg.kv_heads, cfg.head_dim,
         )
-        self._pool_k = jnp.zeros(pool_shape, cfg.dtype)
-        self._pool_v = jnp.zeros(pool_shape, cfg.dtype)
+        self._pool_k = self._part.place_pool(
+            _pool_empty(pool_shape, cfg.dtype, kv_int8)
+        )
+        self._pool_v = self._part.place_pool(
+            _pool_empty(pool_shape, cfg.dtype, kv_int8)
+        )
         # logical->physical block map per slot; 0 = unmapped (junk)
         self._table = np.zeros((slots, self.max_blocks), np.int32)
         self._lengths = jnp.zeros((slots,), jnp.int32)
@@ -247,8 +387,31 @@ class ServingEngine:
         # paged_kernel=True: plain decode steps run the Pallas
         # paged-attention path (no gather transient; pool blocks read
         # once). Interpret mode on CPU so tests stay hermetic.
-        self.paged_kernel = paged_kernel
+        # paged_kernel=None resolves from the HBM-traffic proxy's
+        # documented threshold (serving_proxy.py): ON for a real TPU
+        # backend, OFF where the kernel would only be emulated.
         self._interpret = jax.default_backend() == "cpu"
+        if paged_kernel is None:
+            from .serving_proxy import recommend_paged_kernel
+
+            paged_kernel = recommend_paged_kernel(
+                cfg, interpret=self._interpret, kv_int8=kv_int8,
+                mesh=mesh, slots=slots, seq_len=max_len,
+                block_size=self.block_size,
+            )
+        if paged_kernel and kv_int8:
+            raise ValueError(
+                "kv_int8 and paged_kernel are mutually exclusive: the "
+                "Pallas kernel streams raw pool blocks; int8 pools "
+                "dequantize on the gather path"
+            )
+        if paged_kernel and mesh is not None:
+            raise ValueError(
+                "paged_kernel does not compose with a tensor-parallel "
+                "mesh yet; the TP engine runs the partitioned gather "
+                "path"
+            )
+        self.paged_kernel = paged_kernel
         self._step_fns: Dict[Tuple[int, bool], object] = {}
         self._prefill_fns = {
             b: self._build_prefill(b) for b in self.buckets
@@ -267,7 +430,8 @@ class ServingEngine:
         # prefill/step programs; an eager .at[].set would copy the pool)
         self._pool_write = jax.jit(
             lambda pk, pv, mk, mv, phys: (
-                pk.at[:, phys].set(mk), pv.at[:, phys].set(mv)
+                _pool_set(pk, (slice(None), phys), mk),
+                _pool_set(pv, (slice(None), phys), mv),
             ),
             donate_argnums=(0, 1),
         )
@@ -278,6 +442,12 @@ class ServingEngine:
         self.gamma = gamma
         if draft_params is not None:
             assert draft_cfg is not None
+            if mesh is not None:
+                raise ValueError(
+                    "speculative serving does not support a "
+                    "tensor-parallel mesh yet (the draft's dense cache "
+                    "is unsharded)"
+                )
             if cfg.vocab != draft_cfg.vocab:
                 raise ValueError("draft/target vocabularies must match")
             if cfg.moe_experts or draft_cfg.moe_experts:
@@ -311,10 +481,19 @@ class ServingEngine:
 
     def _ensure_blocks(self, slot: int, n_positions: int) -> None:
         """Allocate table entries so positions [0, n_positions) of
-        ``slot`` are backed by pool blocks."""
-        for j in range(self._blocks_for(n_positions)):
-            if self._table[slot, j] == _JUNK:
-                self._table[slot, j] = self._alloc.alloc()
+        ``slot`` are backed by pool blocks. The whole deficit is
+        reclaimed in ONE cache sweep up front — per-alloc reclaim(1)
+        backstops remain, but k blocks against a dry pool must not
+        cost k full cache scans."""
+        need = [
+            j for j in range(self._blocks_for(n_positions))
+            if self._table[slot, j] == _JUNK
+        ]
+        deficit = len(need) - len(self._alloc._free)
+        if deficit > 0 and self._alloc.reclaim is not None:
+            self._alloc.reclaim(deficit)
+        for j in need:
+            self._table[slot, j] = self._alloc.alloc()
 
     def _drop_row(self, slot: int) -> None:
         for j in range(self.max_blocks):
@@ -327,25 +506,49 @@ class ServingEngine:
         """Round a live-row block count up to a power-of-two bucket so
         the gathered step program compiles a handful of times, not
         once per length."""
-        b = 1
-        while b < needed_blocks:
-            b *= 2
-        return min(b, self.max_blocks)
+        return gather_bucket(needed_blocks, self.max_blocks)
 
     @property
     def used_blocks(self) -> int:
         return self._alloc.used
 
+    def stats(self) -> Dict:
+        """Structured serving status: block-pool occupancy, prefill
+        accounting and (when enabled) prefix-cache counters — the
+        payload behind the sampler's ``serving`` block on
+        /debug/allocations and the doctor bundle, and the
+        ``elastic_tpu_serving_*`` gauges."""
+        out = {
+            "slots": self.slots,
+            "live_requests": len(self._slot_of),
+            "pending_prefills": len(self._pending),
+            "block_size": self.block_size,
+            "pool_blocks": self.pool_blocks,
+            "used_blocks": self.used_blocks,
+            "pool_occupancy": round(
+                self.used_blocks / max(1, self.pool_blocks - 1), 4
+            ),
+            "prefilled_tokens_total": self.prefilled_tokens_total,
+            "admitted_tokens_total": self.admitted_tokens_total,
+            "paged_kernel": self.paged_kernel,
+            "kv_int8": self.kv_int8,
+        }
+        if self._prefix_cache is not None:
+            out["prefix_cache"] = self._prefix_cache.stats()
+        return out
+
     # -- compiled programs -------------------------------------------
 
     def _gathered_view(self, pk, pv, table_b):
         """[L, n_blocks, bs, g, h] pool + [slots, Bb] table -> dense
-        [L, slots, Bb*bs, g, h] view (transient; bucket-bounded)."""
-        L, _, bs, g, h = pk.shape
+        [L, slots, Bb*bs, g, h] view (transient; bucket-bounded).
+        int8 pools dequantize after the gather (reads stay
+        int8-sized)."""
+        L, _, bs, g, h = _pool_shape(pk)
         slots, Bb = table_b.shape
-        flat = table_b.reshape(-1)
-        kg = pk[:, flat].reshape(L, slots, Bb * bs, g, h)
-        vg = pv[:, flat].reshape(L, slots, Bb * bs, g, h)
+        flat = (slice(None), table_b.reshape(-1))
+        kg = _pool_get(pk, flat).reshape(L, slots, Bb * bs, g, h)
+        vg = _pool_get(pv, flat).reshape(L, slots, Bb * bs, g, h)
         return kg, vg
 
     def _decode_forward_paged(
@@ -472,8 +675,8 @@ class ServingEngine:
             wv = jnp.take_along_axis(
                 cache.v, idx, axis=2, mode="clip"
             )[:, :, 0]
-            pk = pk.at[:, wblk, woff].set(wk)
-            pv = pv.at[:, wblk, woff].set(wv)
+            pk = _pool_set(pk, (slice(None), wblk, woff), wk)
+            pv = _pool_set(pv, (slice(None), wblk, woff), wv)
             # frozen slots keep their token and length
             nxt = jnp.where(active, nxt, toks)
             lengths = jnp.where(active, lengths + 1, lengths)
@@ -505,11 +708,11 @@ class ServingEngine:
             logits, mini = _forward_chunk(
                 params, padded[None], mini, cfg
             )
-            L, _, _, g, h = pk.shape
+            L, _, _, g, h = _pool_shape(pk)
             mk = mini.k.reshape(L, nb, bs, g, h)
             mv = mini.v.reshape(L, nb, bs, g, h)
-            pk = pk.at[:, phys].set(mk)
-            pv = pv.at[:, phys].set(mv)
+            pk = _pool_set(pk, (slice(None), phys), mk)
+            pv = _pool_set(pv, (slice(None), phys), mv)
             first = _sample_rowwise(
                 logits[:, true_len - 1], key,
                 tkp[0:1], tkp[1:2].astype(jnp.int32), tkp[2:3],
@@ -537,10 +740,15 @@ class ServingEngine:
             params, pk, pv, pref_phys, plen, padded, true_len, key,
             tkp, phys,
         ):
-            L, _, _, g, h = pk.shape
+            L, _, _, g, h = _pool_shape(pk)
             mini = KVCache.empty(cfg, 1, pref_padded + bucket)
-            pref_k = pk[:, pref_phys].reshape(L, 1, pref_padded, g, h)
-            pref_v = pv[:, pref_phys].reshape(L, 1, pref_padded, g, h)
+            pidx = (slice(None), pref_phys)
+            pref_k = _pool_get(pk, pidx).reshape(
+                L, 1, pref_padded, g, h
+            ).astype(mini.k.dtype)
+            pref_v = _pool_get(pv, pidx).reshape(
+                L, 1, pref_padded, g, h
+            ).astype(mini.v.dtype)
             mini = KVCache(
                 k=jax.lax.dynamic_update_slice(
                     mini.k, pref_k, (0, 0, 0, 0, 0)
@@ -553,8 +761,8 @@ class ServingEngine:
             logits, mini = _forward_chunk(params, padded[None], mini, cfg)
             mk = mini.k.reshape(L, nb, bs, g, h)
             mv = mini.v.reshape(L, nb, bs, g, h)
-            pk = pk.at[:, phys].set(mk)
-            pv = pv.at[:, phys].set(mv)
+            pk = _pool_set(pk, (slice(None), phys), mk)
+            pv = _pool_set(pv, (slice(None), phys), mv)
             first = _sample_rowwise(
                 logits[:, true_len - 1], key,
                 tkp[0:1], tkp[1:2].astype(jnp.int32), tkp[2:3],
@@ -576,10 +784,14 @@ class ServingEngine:
 
         @functools.partial(jax.jit, donate_argnums=(1, 2))
         def chunk_prefill(params, pk, pv, row_blocks, toks, start, wphys):
-            L, _, _, g, h = pk.shape
-            kg = pk[:, row_blocks].reshape(L, 1, n_b * bs, g, h)
-            vg = pv[:, row_blocks].reshape(L, 1, n_b * bs, g, h)
-            cache = KVCache(k=kg, v=vg, length=start)
+            L, _, _, g, h = _pool_shape(pk)
+            ridx = (slice(None), row_blocks)
+            kg = _pool_get(pk, ridx).reshape(L, 1, n_b * bs, g, h)
+            vg = _pool_get(pv, ridx).reshape(L, 1, n_b * bs, g, h)
+            cache = KVCache(
+                k=kg.astype(cfg.dtype), v=vg.astype(cfg.dtype),
+                length=start,
+            )
             logits, cache = _forward_chunk(
                 params, toks[None], cache, cfg
             )
@@ -589,11 +801,47 @@ class ServingEngine:
             wv = jax.lax.dynamic_slice(
                 cache.v, (0, 0, start, 0, 0), (L, 1, bs, g, h)
             )[:, 0]
-            pk = pk.at[:, wphys].set(wk)
-            pv = pv.at[:, wphys].set(wv)
+            pk = _pool_set(pk, (slice(None), wphys), wk)
+            pv = _pool_set(pv, (slice(None), wphys), wv)
             return pk, pv, logits[0]
 
         return chunk_prefill
+
+    def _prefill_tail_chunks(
+        self, slot, seq, total: int, start: int, key, tkp
+    ) -> int:
+        """Synchronous block-chunked prefill of positions
+        [start, total) of ``seq`` for an automatic prefix-cache hit:
+        the same per-chunk program _pump_prefill drives (keyed by the
+        power-of-two gather bucket, so compiles stay bounded no matter
+        what widths cached chains take). Samples and returns the first
+        generated token from the last REAL prompt position."""
+        bs = self.block_size
+        pos = start
+        logits = None
+        while pos < total:
+            chunk = np.zeros((bs,), np.int32)
+            avail = min(bs, total - pos)
+            chunk[:avail] = seq[pos:pos + avail]
+            n_b = self._gather_bucket(self._blocks_for(pos + bs))
+            if n_b not in self._chunk_prefill_fns:
+                self._chunk_prefill_fns[n_b] = (
+                    self._build_chunk_prefill(n_b)
+                )
+            row_blocks = self._table[slot, :n_b].astype(np.int32)
+            self._pool_k, self._pool_v, logits = (
+                self._chunk_prefill_fns[n_b](
+                    self.params, self._pool_k, self._pool_v,
+                    jnp.asarray(row_blocks), jnp.asarray(chunk),
+                    jnp.int32(pos),
+                    jnp.int32(self._table[slot, pos // bs]),
+                )
+            )
+            pos += bs
+        return int(_sample_rowwise(
+            logits[(total - 1) - (pos - bs)][None], key,
+            tkp[0:1], tkp[1:2].astype(jnp.int32), tkp[2:3],
+        )[0])
 
     def _pump_prefill(self) -> Dict[int, int]:
         """Advance the OLDEST pending admission by one chunk; on its
@@ -633,6 +881,10 @@ class ServingEngine:
             jnp.asarray([tkp[1]], jnp.int32),
             jnp.asarray([tkp[2]], jnp.float32),
         )[0])
+        self.prefilled_tokens_total += total - st["start0"]
+        self.admitted_tokens_total += total
+        if self._prefix_cache is not None:
+            self._prefix_cache.insert(seq[:total], self._table[slot])
         if self.draft_params is not None:
             self._draft_prefill_row(slot, seq, total)
         self._lengths = self._lengths.at[slot].set(total)
@@ -797,8 +1049,8 @@ class ServingEngine:
             ).reshape(1, slots, gamma + 1, 1, 1)
             wk = jnp.take_along_axis(tcache.k, idx, axis=2, mode="clip")
             wv = jnp.take_along_axis(tcache.v, idx, axis=2, mode="clip")
-            pk = pk.at[:, wblk, woff].set(wk)
-            pv = pv.at[:, wblk, woff].set(wv)
+            pk = _pool_set(pk, (slice(None), wblk, woff), wk)
+            pv = _pool_set(pv, (slice(None), wblk, woff), wv)
 
             # -- per-row Leviathan accept / resample -----------------
             p_i = jnp.take_along_axis(
@@ -881,6 +1133,9 @@ class ServingEngine:
         # blocks past the prefix go to junk
         bs = self.block_size
         need = self._blocks_for(plen)
+        deficit = need - len(self._alloc._free)
+        if deficit > 0 and self._alloc.reclaim is not None:
+            self._alloc.reclaim(deficit)  # one sweep, not one per block
         block_ids: List[int] = []
         try:
             for _ in range(need):
@@ -932,6 +1187,33 @@ class ServingEngine:
         p = len(prompt)
         if p == 0:
             raise ValueError("empty prompt")
+        pref_blocks, plen, pref_padded = [], 0, 0
+        pref_tokens = np.zeros((0,), np.int32)
+        auto_hit = False
+        if prefix is not None:
+            if prefix not in self._prefixes:
+                raise ValueError(
+                    f"unknown or released prefix {prefix}"
+                )
+            pref_blocks, plen, pref_tokens = self._prefixes[prefix]
+            pref_padded = self._blocks_for(plen) * self.block_size
+        elif self._prefix_cache is not None:
+            # automatic prefix cache: reuse the longest cached block
+            # chain as an internal (block-aligned) prefix. Always leave
+            # >= 1 prompt token to prefill — the tail forward is where
+            # the first generated token's logits come from. (Hit/miss
+            # accounting happens at claim SUCCESS, not here: a lookup
+            # whose admission then fails reused nothing.)
+            bs = self.block_size
+            blocks, covered = self._prefix_cache.lookup(
+                prompt[: ((p - 1) // bs) * bs]
+            )
+            if covered:
+                auto_hit = True
+                pref_blocks, plen, pref_padded = blocks, covered, covered
+                pref_tokens = prompt[:covered]
+                prompt = prompt[covered:]
+                p = len(prompt)
         bucket = None
         if need_bucket:
             bucket = next(
@@ -942,23 +1224,19 @@ class ServingEngine:
                     f"prompt length {p} exceeds largest bucket "
                     f"{self.buckets[-1]}"
                 )
-        if prefix is not None:
-            if prefix not in self._prefixes:
-                raise ValueError(
-                    f"unknown or released prefix {prefix}"
-                )
-            pref_blocks, plen, pref_tokens = self._prefixes[prefix]
-            pref_padded = self._blocks_for(plen) * self.block_size
-        else:
-            pref_blocks, plen, pref_padded = [], 0, 0
-            pref_tokens = np.zeros((0,), np.int32)
         total = plen + p
         if total >= self.max_len:
             raise ValueError(
                 f"prefix+prompt length {total} leaves no room to "
                 f"decode (max_len {self.max_len})"
             )
-        if need_bucket and pref_padded + bucket > self.max_len:
+        if (
+            need_bucket and not auto_hit
+            and pref_padded + bucket > self.max_len
+        ):
+            # the EXPLICIT-prefix mini program is (pref_padded +
+            # bucket) wide; auto-cache tails prefill chunked instead,
+            # so only that path carries this constraint
             raise ValueError(
                 "prefix bucket + prompt bucket exceed the slot row"
             )
@@ -993,12 +1271,16 @@ class ServingEngine:
             self._free.append(slot)
             self._free.sort()
             raise ValueError(str(e)) from e
+        if self._prefix_cache is not None and prefix is None:
+            # the claim HELD (slot + blocks are this request's now):
+            # this admission counts against the cache
+            self._prefix_cache.record_admission(plen if auto_hit else 0)
         return dict(
             prompt=prompt, p=p, bucket=bucket,
             pref_blocks=pref_blocks, plen=plen,
             pref_tokens=pref_tokens, pref_padded=pref_padded,
             total=total, slot=slot, n_shared=n_shared,
-            temp=temp, tk=tk, tp=tp,
+            temp=temp, tk=tk, tp=tp, auto_hit=auto_hit,
         )
 
     def admit(
@@ -1040,13 +1322,27 @@ class ServingEngine:
         bs = self.block_size
         nb_req = self._blocks_for(total + 1)
 
-        padded = jnp.zeros((bucket,), jnp.int32)
-        padded = padded.at[:p].set(jnp.asarray(prompt))
         self._key, sub = jax.random.split(self._key)
         # sampling params ride in ONE traced f32 triple (top_k cast
         # back inside) so per-request values never retrace the prefill
         tkp = jnp.asarray([temp, float(tk), tp], jnp.float32)
-        if prefix is not None:
+        if claim["auto_hit"]:
+            # automatic cache hit: the tail prefills CHUNKED through
+            # the power-of-two-bounded chunk-prefill family. A cached
+            # chain's width is whatever traffic produced, and a
+            # per-(covered, bucket) prefix program would mint a fresh
+            # multi-second XLA compile per distinct depth.
+            first = self._prefill_tail_chunks(
+                slot,
+                np.concatenate([pref_tokens, prompt]).astype(np.int32),
+                total, n_shared * bs, sub, tkp,
+            )
+            pk, pv = self._pool_k, self._pool_v
+        elif plen:
+            padded = jnp.zeros((bucket,), jnp.int32)
+            padded = padded.at[:p].set(jnp.asarray(prompt))
+            # explicit registered prefix: continue the pool-resident
+            # K/V prefix in one (pref_padded + bucket)-wide program
             fn_key = (pref_padded, bucket)
             if fn_key not in self._prefix_prefill_fns:
                 self._prefix_prefill_fns[fn_key] = (
@@ -1071,6 +1367,8 @@ class ServingEngine:
                 jnp.int32(p), sub, tkp, jnp.asarray(phys),
             )
         else:
+            padded = jnp.zeros((bucket,), jnp.int32)
+            padded = padded.at[:p].set(jnp.asarray(prompt))
             nb_mini = bucket // bs
             phys = np.full((nb_mini,), _JUNK, np.int32)
             for j in range(min(nb_req, nb_mini)):
@@ -1080,15 +1378,31 @@ class ServingEngine:
                 jnp.int32(p), sub, tkp, jnp.asarray(phys),
             )
         self._pool_k, self._pool_v = pk, pv
+        self.prefilled_tokens_total += p
+        self.admitted_tokens_total += total
+        if self._prefix_cache is not None:
+            # publish the admission's full token blocks (cache-shared
+            # ones dedupe by digest); the hash history is the REAL
+            # sequence, so explicit-prefix admissions publish too
+            self._prefix_cache.insert(
+                np.concatenate([pref_tokens, prompt]).astype(np.int32),
+                self._table[slot],
+            )
         if self.draft_params is not None:
             # prefill the draft's dense row on the FULL sequence (the
-            # prefix's tokens were kept at registration); width is the
-            # same static (pref_padded + bucket) family as the target
+            # prefix's tokens were kept at registration). Explicit
+            # prefixes share the target's static (pref_padded + bucket)
+            # width family; auto-cache hits take arbitrary widths, so
+            # they use the default power-of-two rounding instead.
             seq = np.concatenate(
                 [pref_tokens, prompt]
             ).astype(np.int32)
             self._draft_prefill_row(
-                slot, seq, total, width=pref_padded + bucket
+                slot, seq, total,
+                width=(
+                    None if claim["auto_hit"]
+                    else pref_padded + bucket
+                ),
             )
         self._lengths = self._lengths.at[slot].set(total)
         self._host_len[slot] = total
@@ -1102,12 +1416,16 @@ class ServingEngine:
         if int(first) in self._stop[rid]:
             self._finish(rid, "stop_token")
         if self._recorder is not None:
-            self._recorder.record(
-                "serving_admit", rid=rid, prompt_len=p,
-                prefix_len=plen, bucket=bucket,
+            rec = dict(
+                rid=rid, prompt_len=p, prefix_len=plen, bucket=bucket,
                 duration_ms=round((time.perf_counter() - t0) * 1000, 3),
                 used_blocks=self.used_blocks,
             )
+            if claim["auto_hit"]:
+                rec["cached_tokens"] = plen
+            if self._prefix_cache is not None:
+                rec["prefix_cache_hit"] = bool(claim["auto_hit"])
+            self._recorder.record("serving_admit", **rec)
         return rid
 
     def enqueue(
@@ -1147,6 +1465,7 @@ class ServingEngine:
             ).astype(np.int32),
             total=claim["total"],
             next_pos=claim["n_shared"] * self.block_size,
+            start0=claim["n_shared"] * self.block_size,
             tkp=(claim["temp"], float(claim["tk"]), claim["tp"]),
         )
         return rid

@@ -245,6 +245,28 @@ class FlightRecorder:
                 out["mean_tokens_per_s"] = round(
                     sum(rates) / len(rates), 3
                 )
+        # serving-engine admissions (ServingEngine(recorder=)): surface
+        # the prefix-cache economics per run — what fraction of
+        # admissions reused cached blocks, and how many prompt tokens
+        # never re-prefilled because of it
+        with self._lock:
+            admits = [
+                r for r in self.records
+                if r.get("kind") == "serving_admit"
+            ]
+        if admits:
+            out["serving_admits"] = len(admits)
+            flagged = [r for r in admits if "prefix_cache_hit" in r]
+            if flagged:
+                hits = sum(
+                    1 for r in flagged if r["prefix_cache_hit"]
+                )
+                out["prefix_cache_hit_rate"] = round(
+                    hits / len(flagged), 3
+                )
+                out["prefix_cache_tokens_saved"] = sum(
+                    r.get("cached_tokens", 0) for r in flagged
+                )
         return out
 
     def close(self) -> None:

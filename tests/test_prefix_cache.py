@@ -1,0 +1,450 @@
+"""Automatic cross-request prefix cache (workloads/prefix_cache.py +
+ServingEngine(prefix_cache=True)): cached-path streams must be exactly
+the uncached streams (the reuse is the original K/V bytes, never a
+recompute), eviction must never touch a block any table still maps,
+and reuse must measurably skip prefill work."""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from elastic_tpu_agent.workloads.generate import generate
+from elastic_tpu_agent.workloads.prefix_cache import (
+    PrefixCache,
+    chain_hashes,
+)
+from elastic_tpu_agent.workloads.serving import (
+    BlockAllocator,
+    ServingEngine,
+)
+from elastic_tpu_agent.workloads.transformer import (
+    ModelConfig,
+    init_params,
+)
+
+BASE = dict(
+    vocab=97, d_model=32, n_heads=4, n_layers=2, d_ff=64, max_seq=96,
+    dtype=jnp.float32, attn="reference",
+)
+
+SYSTEM = [7, 7, 30, 2, 51, 11, 29, 4, 9, 13, 21, 3]  # 12 = 3 blocks of 4
+
+
+def _oracle(params, cfg, prompt, n):
+    out = generate(
+        params, jnp.asarray(prompt, jnp.int32)[None], cfg,
+        max_new_tokens=n,
+    )
+    return np.asarray(out[0, len(prompt):]).tolist()
+
+
+def _engine(params, cfg, **kw):
+    kw.setdefault("slots", 2)
+    kw.setdefault("max_len", 64)
+    kw.setdefault("prompt_buckets", (4, 16))
+    kw.setdefault("block_size", 4)
+    return ServingEngine(params, cfg, **kw)
+
+
+# -- cache unit behavior (bare allocator, no model) -------------------
+
+
+def test_chain_hash_depends_on_history():
+    """Block 1's key must change when block 0's tokens change, even
+    though block 1's own tokens are identical — attention is causal,
+    so 'same block' means 'same full history'."""
+    a = chain_hashes([1, 2, 3, 4], 2)
+    b = chain_hashes([9, 9, 3, 4], 2)
+    assert a[1] != b[1]
+    # and only FULL blocks get keys
+    assert len(chain_hashes([1, 2, 3], 2)) == 1
+
+
+def test_lookup_full_partial_miss():
+    alloc = BlockAllocator(16)
+    cache = PrefixCache(alloc, block_size=4)
+    tokens = list(range(10, 22))            # 3 full blocks
+    blocks = [alloc.alloc() for _ in range(3)]
+    cache.insert(tokens, blocks)
+    # full hit: the whole chain
+    got, covered = cache.lookup(tokens)
+    assert got == blocks and covered == 12
+    # partial hit: shared first block, divergent second
+    got, covered = cache.lookup(tokens[:4] + [99, 98, 97, 96])
+    assert got == blocks[:1] and covered == 4
+    # miss
+    got, covered = cache.lookup([77] * 8)
+    assert got == [] and covered == 0
+    # lookup alone counts nothing (a failed admission reuses nothing);
+    # record_admission reports each claim's fate
+    assert cache.stats()["hits"] == 0
+    for c in (12, 4, 0):
+        cache.record_admission(c)
+    st = cache.stats()
+    assert st["hits"] == 2 and st["misses"] == 1
+    assert st["hit_tokens"] == 16
+    assert st["cached_blocks"] == 3
+
+
+def test_insert_dedups_by_chain():
+    alloc = BlockAllocator(16)
+    cache = PrefixCache(alloc, block_size=4)
+    tokens = list(range(8))
+    blocks = [alloc.alloc(), alloc.alloc()]
+    assert cache.insert(tokens, blocks) == 2
+    before = [int(alloc._ref[b]) for b in blocks]
+    # same tokens again (another slot's copy of the same prompt): the
+    # existing entries keep serving, no double-ref
+    other = [alloc.alloc(), alloc.alloc()]
+    assert cache.insert(tokens, other) == 0
+    assert [int(alloc._ref[b]) for b in blocks] == before
+
+
+def test_eviction_never_touches_shared_blocks():
+    """A cached block a request's table still maps (refcount > 1)
+    survives any amount of pool pressure; only cache-exclusive blocks
+    (refcount exactly 1) free."""
+    alloc = BlockAllocator(8)
+    cache = PrefixCache(alloc, block_size=4)
+    tokens = list(range(12))
+    blocks = [alloc.alloc() for _ in range(3)]
+    cache.insert(tokens, blocks)
+    for b in blocks:
+        alloc.drop(b)  # the "request" released: cache is sole holder
+    shared = blocks[1]
+    alloc.share(shared)  # a live table still maps block 1
+    freed = cache.reclaim(10)
+    assert freed == 2
+    assert cache.evictions == 2
+    assert int(alloc._ref[shared]) == 2, "shared block was touched"
+    # the shared entry is still cached and still serves lookups for
+    # its own chain... but its PARENT was evicted, so the chain walk
+    # misses at block 0 — pin that the walk degrades safely
+    got, covered = cache.lookup(tokens)
+    assert got == [] and covered == 0
+
+
+def test_cap_bounds_cached_blocks():
+    alloc = BlockAllocator(32)
+    cache = PrefixCache(alloc, block_size=4, max_blocks=2)
+    blocks = [alloc.alloc() for _ in range(4)]
+    cache.insert(list(range(16)), blocks)
+    for b in blocks:
+        alloc.drop(b)       # the request released; cache sole holder
+    # entries still mapped by a table (refcount > 1) can't be trimmed,
+    # so the cap enforces against what IS evictable at the next insert
+    extra = alloc.alloc()
+    cache.insert(list(range(100, 104)), [extra])
+    assert cache.cached_blocks == 2
+    assert cache.evictions == 3  # 4 + 1 entries trimmed down to 2
+
+
+# -- engine integration: correctness ---------------------------------
+
+
+def test_cached_admission_streams_exact_and_skip_prefill():
+    """The acceptance pin: repeated shared-prefix admissions prefill
+    only the tail, and every stream equals both the solo oracle and
+    the cache-OFF engine's stream (logit-equivalent outputs)."""
+    cfg = ModelConfig(**BASE, pos="rope")
+    params = init_params(cfg, jax.random.key(0))
+    tails = ([5, 17], [61, 3], [5, 17], [88, 24])
+
+    def run(prefix_cache):
+        eng = _engine(params, cfg, prefix_cache=prefix_cache)
+        streams = []
+        for tail in tails:
+            rid = eng.admit(SYSTEM + tail)
+            for _ in range(3):
+                eng.step()
+            streams.append(eng.release(rid))
+        return eng, streams
+
+    eng_on, on = run(True)
+    eng_off, off = run(False)
+    assert on == off, "prefix cache changed a stream"
+    for tail, got in zip(tails, on):
+        assert got == _oracle(params, cfg, SYSTEM + tail, 4)
+    # prefill work: cold 14, then 3 warm tails of 2 each
+    assert eng_off.prefilled_tokens_total == 4 * 14
+    assert eng_on.prefilled_tokens_total == 14 + 3 * 2
+    st = eng_on.stats()["prefix_cache"]
+    assert st["hits"] == 3 and st["misses"] == 1
+    assert st["hit_tokens"] == 3 * 12
+
+
+def test_partial_hit_divergent_tail():
+    """Prompts sharing only the first block reuse exactly that block;
+    the divergent remainder prefills and the stream stays exact."""
+    cfg = ModelConfig(**BASE, pos="rope", n_kv_heads=2)
+    params = init_params(cfg, jax.random.key(0))
+    eng = _engine(params, cfg, prefix_cache=True)
+    a = [7, 7, 30, 2] + [5, 17, 42]     # block 0 + tail A
+    b = [7, 7, 30, 2] + [61, 3]         # block 0 + tail B
+    ra = eng.admit(a)
+    for _ in range(3):
+        eng.step()
+    sa = eng.release(ra)
+    before = eng.prefilled_tokens_total
+    rb = eng.admit(b)
+    assert eng.prefilled_tokens_total - before == 2  # tail only
+    for _ in range(3):
+        eng.step()
+    sb = eng.release(rb)
+    assert sa == _oracle(params, cfg, a, 4)
+    assert sb == _oracle(params, cfg, b, 4)
+    st = eng.stats()["prefix_cache"]
+    assert st["hits"] == 1 and st["hit_tokens"] == 4
+
+
+def test_enqueue_chunked_admission_uses_cache():
+    cfg = ModelConfig(**BASE, pos="rope")
+    params = init_params(cfg, jax.random.key(0))
+    eng = _engine(params, cfg, prefix_cache=True)
+    long_p = SYSTEM + [5, 17, 42, 9]
+    r1 = eng.enqueue(long_p)
+    for _ in range(8):
+        eng.step()
+    s1 = eng.release(r1)
+    assert s1 == _oracle(params, cfg, long_p, len(s1))
+    before = eng.prefilled_tokens_total
+    # warm: the chunked admission starts at the first uncached block
+    r2 = eng.enqueue(SYSTEM + [61, 3])
+    for _ in range(6):
+        eng.step()
+    s2 = eng.release(r2)
+    assert s2 == _oracle(params, cfg, SYSTEM + [61, 3], len(s2))
+    assert eng.prefilled_tokens_total - before == 2
+    assert eng.stats()["prefix_cache"]["hits"] == 1
+
+
+def test_eviction_under_pool_pressure_frees_cache_first():
+    """Pool pressure evicts cache-exclusive blocks LRU instead of
+    failing the admission; blocks mapped by a LIVE request are never
+    reclaimed and its stream stays exact."""
+    cfg = ModelConfig(**BASE, pos="rope")
+    params = init_params(cfg, jax.random.key(0))
+    # junk + 7 usable blocks
+    eng = _engine(
+        params, cfg, prefix_cache=True, pool_blocks=8,
+        prompt_buckets=(4, 16),
+    )
+    r1 = eng.admit(SYSTEM)                 # 3 full blocks + write block
+    for _ in range(2):
+        eng.step()
+    s1 = eng.release(r1)
+    assert s1 == _oracle(params, cfg, SYSTEM, 3)
+    assert eng.used_blocks == 3            # the cache's holdings
+    # a live request that pins its own blocks
+    r2 = eng.admit([5, 17, 42])
+    # now a big uncached admission that needs more than the free list
+    # has: the cache must give back its 3 blocks under pressure
+    big = [80, 81, 82, 83, 84, 85, 86, 87, 88, 89, 90, 91, 92]
+    r3 = eng.admit(big)
+    assert eng.stats()["prefix_cache"]["evictions"] >= 1
+    for _ in range(3):
+        eng.step()
+    assert eng.release(r2) == _oracle(params, cfg, [5, 17, 42], 4)
+    assert eng.release(r3) == _oracle(params, cfg, big, 4)
+
+
+def test_pressure_with_everything_live_still_fails_clean():
+    """When every cached block is also live (refcount > 1), pressure
+    has nothing to reclaim: admission fails with the usual ValueError
+    and nothing leaks."""
+    cfg = ModelConfig(**BASE, pos="rope")
+    params = init_params(cfg, jax.random.key(0))
+    eng = _engine(
+        params, cfg, prefix_cache=True, pool_blocks=6,
+        prompt_buckets=(4, 16), slots=2,
+    )
+    r1 = eng.admit(SYSTEM)                 # 4 blocks; 3 cached+live
+    used = eng.used_blocks
+    with pytest.raises(ValueError, match="pool exhausted"):
+        eng.admit([80, 81, 82, 83, 84, 85, 86, 87])
+    assert eng.used_blocks == used, "failed admission leaked blocks"
+    eng.step()
+    got = eng.release(r1)
+    assert got == _oracle(params, cfg, SYSTEM, 2)
+
+
+def test_explicit_prefix_still_works_and_publishes():
+    """register_prefix composes with the automatic cache: the
+    explicit-prefix admission publishes its full blocks, so a LATER
+    plain admission of (prefix + prompt) hits."""
+    cfg = ModelConfig(**BASE, pos="rope")
+    params = init_params(cfg, jax.random.key(0))
+    eng = _engine(params, cfg, prefix_cache=True)
+    pid = eng.register_prefix(SYSTEM[:8])  # 2 full blocks
+    ra = eng.admit([5, 17, 42], prefix=pid)
+    for _ in range(3):
+        eng.step()
+    sa = eng.release(ra)
+    assert sa == _oracle(params, cfg, SYSTEM[:8] + [5, 17, 42], 4)
+    before = eng.prefilled_tokens_total
+    rb = eng.admit(SYSTEM[:8] + [5, 17, 61])   # plain, shares 2 blocks
+    assert eng.prefilled_tokens_total - before == 3
+    for _ in range(3):
+        eng.step()
+    assert eng.release(rb) == _oracle(
+        params, cfg, SYSTEM[:8] + [5, 17, 61], 4
+    )
+
+
+def test_flight_recorder_carries_cache_fields():
+    from elastic_tpu_agent.workloads.telemetry import FlightRecorder
+
+    cfg = ModelConfig(**BASE, pos="rope")
+    params = init_params(cfg, jax.random.key(0))
+    rec = FlightRecorder(path=None)
+    eng = _engine(params, cfg, prefix_cache=True, recorder=rec)
+    eng.release(eng.admit(SYSTEM + [5, 17]))
+    eng.release(eng.admit(SYSTEM + [61, 3]))
+    admits = [r for r in rec.records if r["kind"] == "serving_admit"]
+    assert [r["prefix_cache_hit"] for r in admits] == [False, True]
+    assert admits[1]["cached_tokens"] == 12
+    summary = rec.summary()
+    assert summary["serving_admits"] == 2
+    assert summary["prefix_cache_hit_rate"] == 0.5
+    assert summary["prefix_cache_tokens_saved"] == 12
+
+
+def test_stats_shape():
+    cfg = ModelConfig(**BASE, pos="rope")
+    params = init_params(cfg, jax.random.key(0))
+    eng = _engine(params, cfg, prefix_cache=True)
+    st = eng.stats()
+    for field in (
+        "slots", "live_requests", "pool_blocks", "used_blocks",
+        "pool_occupancy", "prefilled_tokens_total", "paged_kernel",
+        "kv_int8", "prefix_cache",
+    ):
+        assert field in st, field
+    assert st["prefix_cache"]["hits"] == 0
+
+
+def test_failed_admission_never_counts_as_hit():
+    """An admission that looks up the cache but then fails (no free
+    slot) must not move the hit/miss counters — the gauges would
+    otherwise overstate cache effectiveness under retry load."""
+    cfg = ModelConfig(**BASE, pos="rope")
+    params = init_params(cfg, jax.random.key(0))
+    eng = _engine(params, cfg, prefix_cache=True, slots=1)
+    eng.admit(SYSTEM + [5, 17])          # occupies the only slot
+    st0 = eng.stats()["prefix_cache"]
+    with pytest.raises(ValueError, match="free slot"):
+        eng.admit(SYSTEM + [61, 3])
+    assert eng.stats()["prefix_cache"] == st0
+
+
+def test_auto_hits_mint_no_prefix_programs():
+    """Cached-chain admissions run through the power-of-two-bounded
+    chunk-prefill family: arbitrary cached depths must never mint
+    per-(covered, bucket) prefix-prefill programs (each would be a
+    fresh XLA compile on the admission path)."""
+    cfg = ModelConfig(**BASE, pos="rope")
+    params = init_params(cfg, jax.random.key(0))
+    eng = _engine(
+        params, cfg, prefix_cache=True, prompt_buckets=(4, 32),
+        block_size=4,
+    )
+    base = list(range(2, 26))            # 24 tokens = 6 blocks
+    # admissions that hit at several distinct cached depths
+    for tail in ([50, 51], [52], [53, 54, 55]):
+        for cut in (8, 16, 24):
+            rid = eng.admit(base[:cut] + tail)
+            eng.step()
+            eng.release(rid)
+    assert eng.stats()["prefix_cache"]["hits"] > 0
+    assert eng._prefix_prefill_fns == {}
+    # chunk programs come from the power-of-two gather-bucket family
+    assert all(
+        n_b & (n_b - 1) == 0 for n_b in eng._chunk_prefill_fns
+    ), eng._chunk_prefill_fns.keys()
+
+
+# -- observability surfaces ------------------------------------------
+
+
+def _served_engine():
+    cfg = ModelConfig(**BASE, pos="rope")
+    params = init_params(cfg, jax.random.key(0))
+    eng = _engine(params, cfg, prefix_cache=True)
+    eng.release(eng.admit(SYSTEM + [5, 17]))
+    rid = eng.admit(SYSTEM + [61, 3])   # warm: a hit, kept live
+    return eng, rid
+
+
+def test_serving_block_on_allocations_snapshot_and_bundle(tmp_path):
+    """The serving block rides /debug/allocations and the doctor
+    bundle through the sampler's serving_status_fn seam, and the
+    bundle stays schema-valid with and without it."""
+    from elastic_tpu_agent.sampler import (
+        UtilizationSampler,
+        build_diagnostics_bundle,
+        validate_bundle,
+    )
+    from elastic_tpu_agent.storage import Storage
+    from elastic_tpu_agent.tpu import StubOperator
+
+    eng, _rid = _served_engine()
+    op = StubOperator(str(tmp_path / "dev"), "v5litepod-4")
+    storage = Storage(str(tmp_path / "meta.db"))
+    try:
+        sampler = UtilizationSampler(op, storage=storage)
+        sampler.serving_status_fn = eng.stats
+        sampler.sample_once(now=1000.0)
+        snap = sampler.allocations_snapshot()
+        assert snap["serving"]["prefix_cache"]["hits"] == 1
+        assert snap["serving"]["used_blocks"] == eng.used_blocks
+        bundle = build_diagnostics_bundle(
+            op, sampler=sampler, node_name="serve-x",
+        )
+        assert validate_bundle(bundle) == []
+        assert (
+            bundle["allocations"]["serving"]["prefix_cache"]["hits"]
+            == 1
+        )
+        # round-trips through JSON (the on-disk escalation format)
+        assert validate_bundle(json.loads(json.dumps(bundle))) == []
+        # a malformed serving block is CAUGHT
+        broken = json.loads(json.dumps(bundle))
+        del broken["allocations"]["serving"]["pool_blocks"]
+        assert any(
+            "serving" in p for p in validate_bundle(broken)
+        )
+    finally:
+        storage.close()
+
+
+def test_serving_gauges_on_metrics_registry():
+    """attach_serving exports the engine's stats as
+    elastic_tpu_serving_* gauges, read live at scrape time."""
+    from prometheus_client import CollectorRegistry, generate_latest
+
+    from elastic_tpu_agent.metrics import AgentMetrics
+
+    eng, rid = _served_engine()
+    metrics = AgentMetrics(registry=CollectorRegistry())
+    metrics.attach_serving(eng.stats)
+    text = generate_latest(metrics._registry).decode()
+    assert "elastic_tpu_serving_prefix_cache_hits 1.0" in text
+    assert "elastic_tpu_serving_prefix_cache_hit_rate 0.5" in text
+    assert (
+        f"elastic_tpu_serving_pool_used_blocks "
+        f"{float(eng.used_blocks)}" in text
+    )
+    # live: releasing the request changes the next scrape
+    eng.release(rid)
+    text = generate_latest(metrics._registry).decode()
+    assert (
+        f"elastic_tpu_serving_pool_used_blocks "
+        f"{float(eng.used_blocks)}" in text
+    )
+    # a dead status fn reads as zeros, never a scrape failure
+    metrics.attach_serving(lambda: (_ for _ in ()).throw(RuntimeError))
+    text = generate_latest(metrics._registry).decode()
+    assert "elastic_tpu_serving_prefix_cache_hits 0.0" in text

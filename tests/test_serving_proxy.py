@@ -1,0 +1,197 @@
+"""HBM-traffic/ops proxy (workloads/serving_proxy.py): the analytic
+model must put gather/paged KV traffic at its structural ~3x, the
+paged_kernel auto default must follow the documented threshold, and
+the int8 KV flag must show its modeled byte reduction AND decode
+correctly through the engine."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from elastic_tpu_agent.workloads.generate import generate
+from elastic_tpu_agent.workloads.serving import ServingEngine
+from elastic_tpu_agent.workloads.serving_proxy import (
+    PAGED_DEFAULT_MIN_RATIO,
+    decode_step_traffic,
+    recommend_paged_kernel,
+    serving_proxy_report,
+    xla_measured_costs,
+)
+from elastic_tpu_agent.workloads.transformer import (
+    ModelConfig,
+    init_params,
+)
+
+BASE = dict(
+    vocab=97, d_model=32, n_heads=4, n_layers=2, d_ff=64, max_seq=96,
+    dtype=jnp.float32, attn="reference",
+)
+
+
+def test_traffic_model_ratio_is_structural_3x():
+    """gather = read pool + write view + read view (3x) vs paged = one
+    stream (1x), both plus the same one-position write-back — so the
+    KV ratio sits just under 3 at any realistic shape."""
+    cfg = ModelConfig(**BASE)
+    for slots, seq in ((4, 64), (8, 512), (16, 48)):
+        est = decode_step_traffic(cfg, slots=slots, seq_len=seq)
+        assert 2.5 < est["kv_bytes_ratio"] <= 3.0, est
+        assert est["ops_ratio"] == 1.0
+        assert est["gather"]["flops"] == est["paged"]["flops"]
+        assert est["gather"]["kv_bytes"] > est["paged"]["kv_bytes"]
+        # total ratio folds in the (path-independent) parameter reads
+        assert 1.0 < est["total_bytes_ratio"] <= est["kv_bytes_ratio"]
+
+
+def test_traffic_model_int8_reduction():
+    cfg = ModelConfig(**BASE)  # f32 storage, head_dim 8
+    f = decode_step_traffic(cfg)
+    q = decode_step_traffic(cfg, kv_int8=True)
+    # f32 -> int8+scale: 4h bytes -> h + 4 bytes per head vector
+    h = cfg.head_dim
+    want = (4 * h) / (h + 4)
+    got = f["paged"]["kv_bytes"] / q["paged"]["kv_bytes"]
+    assert abs(got - want) < 0.05, (got, want)
+
+
+def test_recommendation_follows_documented_threshold():
+    cfg = ModelConfig(**BASE)
+    # native TPU backend: the modeled ratio clears the threshold
+    assert recommend_paged_kernel(cfg, interpret=False) is True
+    # interpret mode (CPU CI): the kernel is an emulation, no HBM win
+    assert recommend_paged_kernel(cfg, interpret=True) is False
+    # incompatible layouts keep the gather path regardless of backend
+    assert recommend_paged_kernel(cfg, kv_int8=True) is False
+    assert recommend_paged_kernel(cfg, mesh=object()) is False
+    assert (
+        decode_step_traffic(cfg)["kv_bytes_ratio"]
+        >= PAGED_DEFAULT_MIN_RATIO
+    )
+
+
+def test_engine_auto_default_resolves_off_on_cpu():
+    """paged_kernel=None (auto) on the CPU backend keeps the gather
+    path — interpret mode would only emulate the kernel — and the
+    engine still serves exactly."""
+    cfg = ModelConfig(**BASE, pos="rope")
+    params = init_params(cfg, jax.random.key(0))
+    eng = ServingEngine(
+        params, cfg, slots=1, max_len=64, prompt_buckets=(8,),
+        block_size=4, paged_kernel=None,
+    )
+    assert eng.paged_kernel is False
+    rid = eng.admit([5, 17, 42])
+    for _ in range(3):
+        eng.step()
+    got = eng.release(rid)
+    want = generate(
+        params, jnp.asarray([5, 17, 42], jnp.int32)[None], cfg,
+        max_new_tokens=4,
+    )
+    assert got == np.asarray(want[0, 3:]).tolist()
+
+
+def test_xla_cost_analysis_instrumentation():
+    """The corroboration path: XLA's compiled cost analysis of both
+    attention programs yields bytes/flops on CPU."""
+    measured = xla_measured_costs()
+    for leg in ("gather_reference", "paged_interpret"):
+        assert measured[leg]["bytes_accessed"], measured
+        assert measured[leg]["flops"], measured
+
+
+def test_serving_proxy_report_shape():
+    report = serving_proxy_report()
+    assert report["hbm_kv_bytes_ratio_gather_over_paged"] >= (
+        report["threshold"]
+    )
+    assert report["paged_kernel_default"]["tpu_native"] is True
+    assert report["paged_kernel_default"]["cpu_interpret"] is False
+    # the flagship stores bf16: int8+scale gets ~1.94x of its 2x ideal
+    assert report["int8_kv"]["kv_bytes_reduction_vs_float"] > 1.8
+    assert report["per_decode_step"]["gather"]["kv_bytes"] > (
+        report["per_decode_step"]["paged"]["kv_bytes"]
+    )
+
+
+def test_int8_engine_decodes_and_pool_is_int8():
+    """kv_int8 end to end: the pool stores int8 + per-position scales,
+    and the greedy stream matches the float oracle on this config
+    (quantization noise stays below the argmax margin here — pinned so
+    a dequant bug can't hide)."""
+    cfg = ModelConfig(**BASE, pos="rope")
+    params = init_params(cfg, jax.random.key(0))
+    eng = ServingEngine(
+        params, cfg, slots=2, max_len=64, prompt_buckets=(8,),
+        block_size=4, kv_int8=True,
+    )
+    assert isinstance(eng._pool_k, dict)
+    assert eng._pool_k["q"].dtype == jnp.int8
+    assert eng._pool_k["s"].dtype == jnp.float32
+    ra = eng.admit([5, 17, 42])
+    rb = eng.admit([61, 3])
+    for _ in range(5):
+        eng.step()
+    got_a, got_b = eng.release(ra), eng.release(rb)
+
+    def oracle(p, n):
+        out = generate(
+            params, jnp.asarray(p, jnp.int32)[None], cfg,
+            max_new_tokens=n,
+        )
+        return np.asarray(out[0, len(p):]).tolist()
+
+    assert got_a == oracle([5, 17, 42], 6)
+    assert got_b == oracle([61, 3], 6)
+    assert eng.stats()["kv_int8"] is True
+
+
+def test_int8_rejects_incompatible_modes():
+    cfg = ModelConfig(**BASE, pos="rope")
+    params = init_params(cfg, jax.random.key(0))
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        ServingEngine(
+            params, cfg, slots=1, max_len=64, prompt_buckets=(8,),
+            block_size=4, kv_int8=True, paged_kernel=True,
+        )
+    dcfg = ModelConfig(
+        vocab=97, d_model=16, n_heads=2, n_layers=1, d_ff=32,
+        max_seq=96, dtype=jnp.float32, attn="reference", pos="rope",
+    )
+    dparams = init_params(dcfg, jax.random.key(7))
+    with pytest.raises(ValueError, match="kv_int8"):
+        ServingEngine(
+            params, cfg, slots=1, max_len=64, prompt_buckets=(8,),
+            block_size=4, kv_int8=True,
+            draft_params=dparams, draft_cfg=dcfg,
+        )
+
+
+def test_int8_with_prefix_cache_streams_consistent():
+    """int8 + automatic prefix cache: a warm admission reuses the SAME
+    quantized blocks a cold prefill would write, so warm and cold
+    streams agree with each other (the int8-vs-float drift is the
+    quantizer's, not the cache's)."""
+    cfg = ModelConfig(**BASE, pos="rope")
+    params = init_params(cfg, jax.random.key(0))
+    system = [7, 7, 30, 2, 51, 11, 29, 4]
+
+    def run(prefix_cache):
+        eng = ServingEngine(
+            params, cfg, slots=1, max_len=64, prompt_buckets=(4, 16),
+            block_size=4, kv_int8=True, prefix_cache=prefix_cache,
+        )
+        out = []
+        for tail in ([5, 17], [61, 3]):
+            rid = eng.admit(system + tail)
+            for _ in range(3):
+                eng.step()
+            out.append(eng.release(rid))
+        return out, eng
+
+    warm, eng_on = run(True)
+    cold, _ = run(False)
+    assert warm[0] == cold[0]  # first admission: no cache involved
+    assert len(warm[1]) == len(cold[1]) == 4
+    assert eng_on.stats()["prefix_cache"]["hits"] == 1

@@ -1,0 +1,160 @@
+"""Tensor-parallel ServingEngine (workloads/partitioner.py +
+ServingEngine(mesh=)): the mp-sharded engine on simulated host devices
+must produce the single-device engine's token streams with IDENTICAL
+block-pool occupancy at every step — sharding splits each block's
+kv-head slice across chips, never the pool bookkeeping."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from elastic_tpu_agent.workloads.partitioner import (
+    POOL_SPEC,
+    ServingPartitioner,
+    make_serving_mesh,
+)
+from elastic_tpu_agent.workloads.serving import ServingEngine
+from elastic_tpu_agent.workloads.transformer import (
+    ModelConfig,
+    init_params,
+)
+
+# vocab/d_ff/heads divisible by every mp under test
+BASE = dict(
+    vocab=96, d_model=32, n_heads=4, n_layers=2, d_ff=64, max_seq=96,
+    dtype=jnp.float32, attn="reference",
+)
+
+
+def _run(params, cfg, mesh, admissions=((5, 17, 42), (61, 3, 9))):
+    eng = ServingEngine(
+        params, cfg, slots=2, max_len=64, prompt_buckets=(8,),
+        block_size=4, mesh=mesh,
+    )
+    occupancy = []
+    ra = eng.admit(list(admissions[0]))
+    occupancy.append(eng.used_blocks)
+    for _ in range(3):
+        eng.step()
+        occupancy.append(eng.used_blocks)
+    rb = eng.admit(list(admissions[1]))
+    for _ in range(4):
+        eng.step()
+        occupancy.append(eng.used_blocks)
+    return eng.release(ra), eng.release(rb), occupancy
+
+
+@pytest.mark.parametrize("mp,n_devices", [(2, 2), (4, 8)])
+def test_tp_streams_and_occupancy_match_single_device(mp, n_devices):
+    """The acceptance pin: a tensor-parallel decode on >= 2 simulated
+    host devices, streams equal to the single-device engine and
+    sharded KV-pool occupancy matching it step for step."""
+    cfg = ModelConfig(**BASE, pos="rope")
+    params = init_params(cfg, jax.random.key(0))
+    want_a, want_b, want_occ = _run(params, cfg, None)
+    mesh = make_serving_mesh(mp=mp, n_devices=n_devices)
+    got_a, got_b, got_occ = _run(params, cfg, mesh)
+    assert got_a == want_a and got_b == want_b
+    assert got_occ == want_occ
+
+
+def test_tp_gqa_and_learned_positions():
+    cfg = ModelConfig(**BASE, pos="learned", n_kv_heads=2)
+    params = init_params(cfg, jax.random.key(0))
+    mesh = make_serving_mesh(mp=2, n_devices=2)  # tp=2 divides kv 2
+    want = _run(params, cfg, None)
+    got = _run(params, cfg, mesh)
+    assert got == want
+
+
+def test_tp_pool_is_actually_sharded():
+    """The pool's kv-head axis must land on the mp axis — a silently
+    replicated pool would pass the stream tests while burning mp times
+    the HBM."""
+    cfg = ModelConfig(**BASE, pos="rope")
+    params = init_params(cfg, jax.random.key(0))
+    mesh = make_serving_mesh(mp=2, n_devices=2)
+    eng = ServingEngine(
+        params, cfg, slots=2, max_len=64, prompt_buckets=(8,),
+        block_size=4, mesh=mesh,
+    )
+    spec = eng._pool_k.sharding.spec
+    assert tuple(spec) == tuple(POOL_SPEC)
+    # and a sharded param: wo splits its head axis
+    wo = eng.params["layers"][0]["wo"]
+    assert tuple(wo.sharding.spec)[0] == "mp"
+
+
+def test_tp_engine_still_decodes_after_slot_churn():
+    cfg = ModelConfig(**BASE, pos="rope")
+    params = init_params(cfg, jax.random.key(0))
+    mesh = make_serving_mesh(mp=2, n_devices=2)
+    eng = ServingEngine(
+        params, cfg, slots=1, max_len=64, prompt_buckets=(8,),
+        block_size=4, mesh=mesh,
+    )
+    ref = ServingEngine(
+        params, cfg, slots=1, max_len=64, prompt_buckets=(8,),
+        block_size=4,
+    )
+    for prompt in ([5, 17, 42], [61, 3, 9, 24, 7]):
+        r1, r2 = eng.admit(prompt), ref.admit(prompt)
+        for _ in range(4):
+            eng.step(), ref.step()
+        assert eng.release(r1) == ref.release(r2)
+        assert eng.used_blocks == ref.used_blocks == 0
+
+
+def test_tp_int8_pool_runs_sharded():
+    """kv_int8 composes with the mesh: the quantized pool's q and s
+    leaves shard their kv-head axis; streams stay structural-valid
+    (quantization noise is not bit-pinned across reduction orders)."""
+    cfg = ModelConfig(**BASE, pos="rope")
+    params = init_params(cfg, jax.random.key(0))
+    mesh = make_serving_mesh(mp=2, n_devices=2)
+    eng = ServingEngine(
+        params, cfg, slots=1, max_len=64, prompt_buckets=(8,),
+        block_size=4, mesh=mesh, kv_int8=True,
+    )
+    assert tuple(eng._pool_k["q"].sharding.spec) == tuple(POOL_SPEC)
+    rid = eng.admit([5, 17, 42])
+    for _ in range(4):
+        eng.step()
+    got = eng.release(rid)
+    assert len(got) == 5
+    assert all(0 <= t < cfg.vocab for t in got)
+
+
+def test_mesh_validation():
+    cfg = ModelConfig(**BASE, pos="rope", n_kv_heads=2)
+    params = init_params(cfg, jax.random.key(0))
+    mesh4 = make_serving_mesh(mp=4, n_devices=4)
+    with pytest.raises(ValueError, match="kv_heads"):
+        ServingEngine(
+            params, cfg, slots=1, max_len=64, prompt_buckets=(8,),
+            block_size=4, mesh=mesh4,
+        )
+    mesh2 = make_serving_mesh(mp=2, n_devices=2)
+    with pytest.raises(ValueError, match="paged_kernel"):
+        ServingEngine(
+            params, cfg, slots=1, max_len=64, prompt_buckets=(8,),
+            block_size=4, mesh=mesh2, paged_kernel=True,
+        )
+    # a mesh without the serving axis is rejected up front
+    from elastic_tpu_agent.workloads.transformer import make_mesh
+
+    with pytest.raises(ValueError, match="mp"):
+        ServingPartitioner(make_mesh(2, dp=2, sp=1, tp=1, ep=1), cfg)
+
+
+def test_make_serving_mesh_shapes():
+    mesh = make_serving_mesh(mp=2, n_devices=8)
+    assert mesh.shape == {"dp": 4, "mp": 2}
+    mesh = make_serving_mesh(n_devices=4)   # default: all mp
+    assert mesh.shape == {"dp": 1, "mp": 4}
+    with pytest.raises(ValueError, match="does not divide"):
+        make_serving_mesh(mp=3, n_devices=8)
+    # over-requesting devices fails loudly, not as a reshape error
+    with pytest.raises(ValueError, match="only 8 visible"):
+        make_serving_mesh(mp=4, n_devices=16)
