@@ -5,7 +5,7 @@ TAG ?= elastic-tpu-agent:latest
 # verify's tier-1 line uses pipefail, which /bin/sh (dash) lacks
 SHELL := /bin/bash
 
-.PHONY: all native sanitize test test-all verify doctor-smoke chaos-smoke bench-smoke crash-replay-smoke fleet-smoke slice-smoke drain-smoke timeline-smoke serving-smoke protos image bench clean
+.PHONY: all native sanitize test test-all verify doctor-smoke chaos-smoke bench-smoke crash-replay-smoke fleet-smoke slice-smoke drain-smoke timeline-smoke serving-smoke qos-smoke protos image bench clean
 
 all: native test
 
@@ -65,18 +65,21 @@ bench-smoke:
 	JAX_PLATFORMS=cpu python3 bench.py --churn-smoke
 
 # crash-replay smoke: the kill-at-every-failpoint suite — dies at each
-# mid-bind crash window (die-thread failpoints) and each mid-DRAIN
-# window (drain.pre_cordon/post_signal/pre_reclaim), restarts the
+# mid-bind crash window (die-thread failpoints), each mid-DRAIN window
+# (drain.pre_cordon/post_signal/pre_reclaim) and each mid-REPARTITION
+# window (repartition.pre_journal/post_journal/mid_restamp plus the
+# between-sibling-spec-files restamp.spec_file tear), restarts the
 # manager over the surviving store + fake kubelet, and asserts
 # convergence to the crash-free end state (empty bind-intent journal;
-# resumed drain lifecycle) — AND that the surviving lifecycle timeline
-# still tells a consistent story (no phantom commits, every crashed
-# intent resolved by a visible rollback/commit event;
-# tests/test_timeline.py). Deterministic: in-process drive, no sleeps
-# on the replay path.
+# resumed drain lifecycle; no pod left at a torn quota) — AND that the
+# surviving lifecycle timeline still tells a consistent story (no
+# phantom commits, every crashed intent resolved by a visible
+# rollback/commit event; tests/test_timeline.py). Deterministic:
+# in-process drive, no sleeps on the replay path.
 crash-replay-smoke:
 	JAX_PLATFORMS=cpu python -m pytest tests/test_reconciler.py \
-	  tests/test_drain.py tests/test_timeline.py -q \
+	  tests/test_drain.py tests/test_timeline.py \
+	  tests/test_repartition.py -q \
 	  -p no:cacheprovider && echo "crash replay smoke: OK"
 
 # fleet smoke: the cluster-in-a-box simulator (bench.py --fleet-smoke):
@@ -140,8 +143,20 @@ timeline-smoke:
 serving-smoke:
 	JAX_PLATFORMS=cpu python3 bench.py --serving-smoke
 
+# qos smoke: the utilization-loop gate (bench.py --qos-smoke,
+# CPU-deterministic): two engines co-located on one stub chip under
+# phase-imbalanced load must decode measurably more aggregate tokens
+# with LIVE re-partitioning (the real annotation -> usage-report ->
+# sampler -> controller -> restamped-quota loop) than the same run's
+# static 50/50 baseline, with the quota trace proving units moved both
+# ways and no spurious throttle; and the prefill/decode split must
+# decode a token every tick through a long-prompt burst that
+# head-of-line blocks the unified engine, with bit-identical streams.
+qos-smoke:
+	JAX_PLATFORMS=cpu python3 bench.py --qos-smoke
+
 T1_TIMEOUT ?= 870
-verify: doctor-smoke chaos-smoke bench-smoke crash-replay-smoke fleet-smoke slice-smoke drain-smoke timeline-smoke serving-smoke
+verify: doctor-smoke chaos-smoke bench-smoke crash-replay-smoke fleet-smoke slice-smoke drain-smoke timeline-smoke serving-smoke qos-smoke
 	python -c "from prometheus_client import CollectorRegistry; \
 	  from elastic_tpu_agent.metrics import AgentMetrics; \
 	  AgentMetrics(registry=CollectorRegistry()); \

@@ -125,6 +125,19 @@ def parse_args(argv=None) -> argparse.Namespace:
     p.add_argument("--drain-period", type=float, default=2.0,
                    help="seconds between drain-orchestrator trigger "
                         "polls (jittered 0.75x-1.25x)")
+    p.add_argument("--repartition-period", type=float, default=10.0,
+                   help="seconds between repartition-controller policy "
+                        "passes (live quota renegotiation for pods that "
+                        "opt in via elasticgpu.io/repartition; jittered "
+                        "0.75x-1.25x)")
+    p.add_argument("--no-repartition", action="store_true",
+                   help="disable live re-partitioning and QoS "
+                        "throttle/evict enforcement (static grants + "
+                        "overcommit alarms only)")
+    p.add_argument("--qos-evict-after", type=float, default=300.0,
+                   help="seconds between the overcommit throttle clamp "
+                        "and binding reclaim for a pod that stays over "
+                        "quota (repartition.py)")
     p.add_argument("--maintenance-poll-ttl", type=float, default=None,
                    help="seconds one GCE maintenance-event/preempted "
                         "metadata fetch stays cached (default 30; env "
@@ -427,6 +440,9 @@ def main(argv=None) -> int:
             slice_membership_ttl_s=args.slice_membership_ttl,
             drain_deadline_s=args.drain_deadline,
             drain_period_s=args.drain_period,
+            enable_repartition=not args.no_repartition,
+            repartition_period_s=args.repartition_period,
+            qos_evict_after_s=args.qos_evict_after,
             maintenance_poll_ttl_s=args.maintenance_poll_ttl,
             **(
                 {"timeline_cap": args.timeline_cap}

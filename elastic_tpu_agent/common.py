@@ -80,6 +80,25 @@ AnnotationDraining = "elasticgpu.io/draining"
 EnvDrain = "ELASTIC_TPU_DRAIN"
 EnvDrainDeadline = "ELASTIC_TPU_DRAIN_DEADLINE"
 
+# -- Dynamic fractional re-partitioning (repartition.py) ----------------------
+# Opt-in contract: pods carrying this annotation (truthy) let the agent
+# renegotiate their ELASTIC_TPU_CORE_UNITS / HBM quota live — grow from a
+# co-located idle pod's slack, shrink back under pressure — and accept
+# the throttle -> evict escalation when they sustain overcommit.
+AnnotationRepartition = "elasticgpu.io/repartition"
+# Env restamped into a sustained-overcommitter's alloc specs when the
+# alarm escalates to a throttle: the reason, and the wall-clock deadline
+# (unix seconds) past which the binding is reclaimed if the pod is still
+# over its (clamped) quota. Removed when the pod returns within grant.
+EnvThrottle = "ELASTIC_TPU_THROTTLE"
+EnvThrottleDeadline = "ELASTIC_TPU_THROTTLE_DEADLINE"
+# Subdirectory of the alloc-spec dir where opted-in workloads publish
+# self-measured utilization ({"ts", "duty_cycle_percent"} keyed by the
+# allocation hash). ONE spelling shared by the writer
+# (workloads/telemetry.write_usage_report), the reader (sampler) and
+# the reclaim path (tpushare.remove_alloc_spec).
+UsageReportSubdir = "usage"
+
 # -- Container env contract ---------------------------------------------------
 # Env carrying the allocation hash into the container; the OCI hook resolves
 # it back to physical chips (reference used "GPU", main.go:200 — we accept

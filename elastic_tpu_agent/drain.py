@@ -493,7 +493,7 @@ class DrainOrchestrator:
         the signal): resume() and every DRAINING tick repeat it,
         catching pods that bound mid-cordon and specs a drift rebind
         rebuilt without the signal."""
-        from .plugins import tpushare
+        from .plugins import restamp_owner_env
 
         plugin = self._spec_plugin()
         if plugin is None:
@@ -510,8 +510,7 @@ class DrainOrchestrator:
         annotated = set(self._annotated_pods)
         for owner, records in residents:
             try:
-                with tpushare.bind_lock(owner.pod_key):
-                    n = plugin.restamp_spec_env_locked(owner, records, env)
+                n = restamp_owner_env(plugin, owner, records, env)
             except Exception:  # noqa: BLE001 - next tick retries
                 logger.exception(
                     "drain: signal restamp for %s failed", owner.pod_key
@@ -608,7 +607,7 @@ class DrainOrchestrator:
         resident spec and clear the draining annotations, dropping each
         item from the journaled pending lists only once it provably
         succeeded (a 404 on the patch = the pod is gone = done)."""
-        from .plugins import tpushare
+        from .plugins import restamp_owner_env
 
         if self._stamped_pods:
             plugin = self._spec_plugin()
@@ -617,11 +616,10 @@ class DrainOrchestrator:
                 cleaned = True
                 for owner, records in residents:
                     try:
-                        with tpushare.bind_lock(owner.pod_key):
-                            plugin.restamp_spec_env_locked(
-                                owner, records, {},
-                                remove_keys=(EnvDrain, EnvDrainDeadline),
-                            )
+                        restamp_owner_env(
+                            plugin, owner, records, {},
+                            remove_keys=(EnvDrain, EnvDrainDeadline),
+                        )
                     except Exception:  # noqa: BLE001 - retried next tick
                         cleaned = False
                         logger.exception(

@@ -53,6 +53,10 @@ ReasonNodeDraining = "TPUNodeDraining"
 ReasonNodeDrained = "TPUNodeDrained"
 ReasonDrainCancelled = "TPUDrainCancelled"
 
+ReasonRepartitioned = "TPURepartitioned"
+ReasonThrottled = "TPUThrottled"
+ReasonQoSEvicted = "TPUQoSEvicted"
+
 
 class EventRecorder:
     """Posts core/v1 Events; all methods non-blocking and never raise."""

@@ -106,6 +106,14 @@ class ManagerOptions:
     # period (jittered 0.75x-1.25x). --drain-deadline / --drain-period.
     drain_deadline_s: float = 300.0
     drain_period_s: float = 2.0
+    # Dynamic fractional re-partitioning (repartition.py): live quota
+    # renegotiation for pods that opt in via elasticgpu.io/repartition,
+    # with throttle -> evict escalation for sustained overcommit.
+    # Requires the sampler (it is the usage signal); --no-repartition /
+    # --repartition-period / --qos-evict-after.
+    enable_repartition: bool = True
+    repartition_period_s: float = 10.0
+    qos_evict_after_s: float = 300.0
     # tpuvm operator: maintenance/preempted metadata poll TTL override
     # (--maintenance-poll-ttl; None = the operator's default, env
     # ELASTIC_TPU_MAINTENANCE_POLL_TTL also honored for tests).
@@ -359,7 +367,52 @@ class TPUManager:
         # While the drain has reclaimed bindings, kubelet's still-listed
         # assignments must not be replayed back by the reconciler.
         self.reconciler.drain = self.drain
+        # Dynamic fractional re-partitioning (repartition.py): sampler
+        # windows -> live quota restamps. The sampler IS the usage
+        # signal, so no sampler means no repartitioning.
+        self.repartition = None
+        if opts.enable_repartition and self.sampler is not None:
+            from .repartition import RepartitionController
+
+            self.repartition = RepartitionController(
+                sampler=self.sampler,
+                storage=self.storage,
+                sitter=self.sitter,
+                plugin=self.plugin,
+                reconciler=self.reconciler,
+                metrics=self.metrics,
+                events=self.events,
+                timeline=self.timeline,
+                node_name=opts.node_name,
+                period_s=opts.repartition_period_s,
+                evict_after_s=opts.qos_evict_after_s,
+            )
+            # Evicted pods' kubelet assignments must not be replayed
+            # back, and the overcommit alarm must judge usage against
+            # the EFFECTIVE (adjusted) grant.
+            self.reconciler.repartition = self.repartition
+            self.sampler.grant_adjust_fn = (
+                self.repartition.core_delta_percent
+            )
+            self.sampler.repartition_status_fn = self.repartition.status
         if self.sampler is not None:
+            # Self-reports steer attribution (and, with the controller
+            # on, ENFORCEMENT), so only opted-in pods' usage files are
+            # ever trusted — wired unconditionally: even in alarm-only
+            # mode (--no-repartition) a non-participant must not
+            # under-report and shift phantom duty onto a co-tenant the
+            # overcommit alarm then blames.
+            def _report_allowed(pod_key: str) -> bool:
+                from .qos import repartition_opt_in
+
+                ns, _, name = pod_key.partition("/")
+                pod = self.sitter.get_pod(ns, name)
+                if pod is None:
+                    return False
+                ann = (pod.get("metadata") or {}).get("annotations") or {}
+                return repartition_opt_in(ann)
+
+            self.sampler.usage_report_allowed_fn = _report_allowed
             # /debug/allocations and the doctor bundle carry the live
             # reconcile/journal state (open intents, per-class repairs).
             self.sampler.reconcile_status_fn = self.reconciler.status
@@ -587,6 +640,12 @@ class TPUManager:
         # reclaimed. The supervised loop's own resume() is then a no-op
         # re-read.
         self.drain.resume()
+        if self.repartition is not None:
+            # Journaled quota ledger BEFORE the boot reconcile, like the
+            # drain: replay suppression for QoS-evicted pods must be
+            # armed before restore() walks kubelet's assignments, and a
+            # crash mid-restamp must converge before binds resume.
+            self.repartition.resume()
         self.restore()
         # Device-plugin serve loops: one per extended resource, CRITICAL —
         # a dead ListAndWatch leaves kubelet advertising stale devices.
@@ -612,6 +671,12 @@ class TPUManager:
         # on every (re)start, so a crashed loop (or agent) picks the
         # drain back up where it died.
         self.supervisor.register("drain", self.drain.run, DEGRADED)
+        if self.repartition is not None:
+            # Repartition controller: DEGRADED — losing live quota
+            # renegotiation leaves static grants in force, never binding.
+            self.supervisor.register(
+                "repartition", self.repartition.run, DEGRADED
+            )
         if self.sampler is not None:
             self.supervisor.register("sampler", self.sampler.run, DEGRADED)
         if self.nri_plugin is not None:
@@ -651,6 +716,9 @@ class TPUManager:
         self.supervisor.join("reconciler", timeout=10.0)
         # The drain loop journals into storage and emits events too.
         self.supervisor.join("drain", timeout=10.0)
+        # The repartition loop journals and restamps specs; join it
+        # before the recorder stops and the db closes.
+        self.supervisor.join("repartition", timeout=10.0)
         if self.nri_plugin is not None:
             self.nri_plugin.stop()
         if hasattr(self.plugin, "core"):

@@ -342,6 +342,30 @@ class AgentMetrics:
                      300.0, 600.0, 1800.0),
             **kw,
         )
+        # -- dynamic re-partitioning & QoS enforcement (repartition.py) ----
+        self.repartitions = Counter(
+            "elastic_tpu_repartitions_total",
+            "Live quota moves executed by the repartition controller: "
+            "grow = a busy pod absorbed a co-located idle pod's slack, "
+            "shrink = slack returned to a donor under pressure (or a "
+            "peer leaving unwound the donation)",
+            ["direction"],
+            **kw,
+        )
+        self.throttles = Counter(
+            "elastic_tpu_throttles_total",
+            "Sustained-overcommit escalations from alarm to throttle: "
+            "the pod's quota was clamped back to its base grant and the "
+            "evict deadline armed",
+            **kw,
+        )
+        self.qos_evictions = Counter(
+            "elastic_tpu_qos_evictions_total",
+            "Throttled pods still overcommitting at the evict deadline "
+            "whose bindings were reclaimed through the reconciler's "
+            "reclaimed_pod repair class",
+            **kw,
+        )
         # -- serving data plane (workloads/serving.py) ---------------------
         # All read through attach_serving's set_function hooks: the
         # engine's hot path never touches prometheus, and the values
@@ -396,6 +420,30 @@ class AgentMetrics:
             "elastic_tpu_serving_admitted_tokens",
             "Prompt tokens admitted including cache-reused ones "
             "(engine-lifetime)",
+            **kw,
+        )
+        # Disaggregated prefill/decode serving (SharedKVPool roles):
+        # per-role backlog plus the cross-role block-adoption counter —
+        # the phase-imbalance signal the repartition controller exploits.
+        self.serving_role_queue_depth = Gauge(
+            "elastic_tpu_serving_role_queue_depth",
+            "Backlog of a serving role sharing the paged KV pool: "
+            "pending chunked prefills for the prefill role, live decode "
+            "requests (plus pending tails) for the decode role",
+            ["role"],
+            **kw,
+        )
+        self.serving_pool_adoptions = Gauge(
+            "elastic_tpu_serving_pool_adoptions",
+            "Admissions that adopted shared-pool KV blocks another role "
+            "prefilled (refcounted via the prefix cache; "
+            "engine-lifetime count)",
+            **kw,
+        )
+        self.serving_pool_adopted_tokens = Gauge(
+            "elastic_tpu_serving_pool_adopted_tokens",
+            "Prompt tokens adopted from shared-pool blocks another role "
+            "prefilled (engine-lifetime count)",
             **kw,
         )
         self.observability_dropped = Counter(
@@ -591,6 +639,19 @@ class AgentMetrics:
         )
         self.serving_prefix_cache_hit_rate.set_function(
             read("prefix_cache", "hit_rate")
+        )
+        # Disaggregated roles (serving.disaggregated_status): absent
+        # blocks read as 0, so a unified engine's status needs no shape
+        # change and the role series stay flat until roles exist.
+        for role in ("prefill", "decode"):
+            self.serving_role_queue_depth.labels(role=role).set_function(
+                read("roles", role, "queue_depth")
+            )
+        self.serving_pool_adoptions.set_function(
+            read("shared_pool", "adoptions")
+        )
+        self.serving_pool_adopted_tokens.set_function(
+            read("shared_pool", "adopted_tokens")
         )
 
     def attach_supervisor(self, supervisor) -> None:

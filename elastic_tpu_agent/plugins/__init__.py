@@ -1,9 +1,37 @@
 from .base import DevicePluginServer, PluginConfig, plugin_factory
 from .tpushare import TPUSharePlugin
 
+
+def restamp_owner_env(
+    spec_plugin, owner, records, env_updates, remove_keys=(),
+):
+    """Restamp env keys into every on-disk alloc spec of ONE container,
+    under the owner's bind stripe.
+
+    The single post-bind env-mutation path: the drain orchestrator's
+    checkpoint signal, its cancel cleanup, and the repartition
+    controller's quota updates all go through here, so the three writers
+    can never drift in locking (the same stripe the bind path takes) or
+    merge semantics (restamp_spec_env_locked updates the merged env AND
+    the pre-merge ``own`` snapshot of every sibling spec). Returns the
+    number of spec files carrying the requested env afterwards.
+
+    Callers must NOT already hold the owner's stripe (it is not
+    reentrant); use ``spec_plugin.restamp_spec_env_locked`` directly
+    from code that does.
+    """
+    from . import tpushare
+
+    with tpushare.bind_lock(owner.pod_key):
+        return spec_plugin.restamp_spec_env_locked(
+            owner, records, env_updates, remove_keys=remove_keys
+        )
+
+
 __all__ = [
     "DevicePluginServer",
     "PluginConfig",
     "plugin_factory",
+    "restamp_owner_env",
     "TPUSharePlugin",
 ]

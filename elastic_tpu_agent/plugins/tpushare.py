@@ -1065,10 +1065,26 @@ class _TPUSharePluginBase(_ListAndWatchMixin, rpc.DevicePluginServicer):
             restored["resources"] = [restored.get("resource", "")]
             _write_json_atomic(path, restored)
 
+    def _remove_usage_report(self, alloc_hash: str) -> None:
+        """Reclaim the allocation's self-reported usage file
+        (common.UsageReportSubdir) along with its spec — without this,
+        pod churn grows the usage dir without bound (nothing else ever
+        unlinks a dead allocation's report)."""
+        from ..common import UsageReportSubdir
+
+        for suffix in (".json", ".json.tmp"):
+            try:
+                os.unlink(
+                    os.path.join(self._alloc_dir, UsageReportSubdir,
+                                 f"{alloc_hash}{suffix}")
+                )
+            except OSError:
+                pass
+
     def remove_alloc_spec(self, alloc_hash: str, owner=None) -> None:
-        """Unlink an allocation's spec; when ``owner`` is given, also
-        restore the container's surviving sibling specs to their own
-        (unmerged) content."""
+        """Unlink an allocation's spec (and its usage self-report);
+        when ``owner`` is given, also restore the container's surviving
+        sibling specs to their own (unmerged) content."""
         if owner is None:
             try:
                 os.unlink(
@@ -1076,6 +1092,7 @@ class _TPUSharePluginBase(_ListAndWatchMixin, rpc.DevicePluginServicer):
                 )
             except FileNotFoundError:
                 pass
+            self._remove_usage_report(alloc_hash)
             return
         with _BIND_LOCKS.acquire(owner.pod_key):
             self.remove_alloc_spec_locked(alloc_hash, owner)
@@ -1088,6 +1105,7 @@ class _TPUSharePluginBase(_ListAndWatchMixin, rpc.DevicePluginServicer):
             os.unlink(os.path.join(self._alloc_dir, f"{alloc_hash}.json"))
         except FileNotFoundError:
             pass
+        self._remove_usage_report(alloc_hash)
         self._restore_sibling_specs(owner, alloc_hash)
 
     def read_alloc_spec(self, alloc_hash: str) -> Optional[Dict]:
@@ -1139,6 +1157,12 @@ class _TPUSharePluginBase(_ListAndWatchMixin, rpc.DevicePluginServicer):
                         changed = True
             if changed:
                 _write_json_atomic(path, spec)
+            # Crash-window failpoint (test-only): fires after each spec
+            # file lands, so an armed die-thread kills the restamp
+            # BETWEEN the sibling files of one container — the
+            # torn-quota window the repartition crash-replay suite
+            # proves recoverable.
+            faults.fire("restamp.spec_file")
             # An already-correct spec still counts: callers (slice
             # reform, the drain's per-tick re-signal) treat the count
             # as "specs carrying the env", and the skip is what makes

@@ -14,13 +14,108 @@ libtpu/XLA/JAX inside the container. The honest boundary:
 - ``ELASTIC_TPU_PRIORITY`` — high|low, from the scheduler's annotation or
   the pod priorityClassName; low-priority workloads should enable
   preemptible/donation behavior.
+
+Every annotation-sourced value is VALIDATED here, not trusted: quota env
+feeds straight into runtime memory limits inside the container, so a
+malformed annotation (non-numeric core units, an HBM quota larger than
+the chip, a request above the pod's actual grant) must degrade to the
+derived grant — never pass through and never fail the bind. Annotation
+overrides can only shrink a quota below the grant (a self-imposed cap,
+e.g. for a bursty sidecar), never raise it: raising is the repartition
+controller's job (repartition.py), which moves real slack between
+co-located pods instead of minting units from an annotation.
 """
 
 from __future__ import annotations
 
+import logging
 from typing import Dict, Optional
 
+from .common import AnnotationRepartition
+
+logger = logging.getLogger(__name__)
+
 AnnotationQoSPriority = "elasticgpu.io/qos-priority"
+# Self-imposed quota caps (validated, clamp-only-downward): a pod may ask
+# to be held below its grant, never above it.
+AnnotationQoSCoreUnits = "elasticgpu.io/qos-core-units"
+AnnotationQoSHBMLimit = "elasticgpu.io/qos-hbm-limit-bytes"
+
+# The env keys this module owns (shared with repartition.py's restamps so
+# the two writers can never disagree on spelling).
+EnvQoSCoreUnits = "ELASTIC_TPU_CORE_UNITS"
+EnvQoSHBMLimit = "ELASTIC_TPU_HBM_LIMIT_BYTES"
+EnvQoSHBMFraction = "ELASTIC_TPU_HBM_FRACTION"
+EnvQoSPriority = "ELASTIC_TPU_PRIORITY"
+
+_TRUTHY = ("true", "1", "yes", "enabled")
+
+
+def _annotation_int(
+    annotations: Dict[str, str], key: str
+) -> Optional[int]:
+    """A positive int annotation value, or None when absent/malformed
+    (malformed values are logged and IGNORED — a typo in a quota
+    annotation must not fail the bind or pass through unvalidated)."""
+    raw = annotations.get(key)
+    if raw is None:
+        return None
+    try:
+        value = int(str(raw).strip())
+    except (TypeError, ValueError):
+        logger.warning(
+            "qos: ignoring malformed annotation %s=%r (not an integer)",
+            key, raw,
+        )
+        return None
+    if value <= 0:
+        logger.warning(
+            "qos: ignoring annotation %s=%r (must be a positive integer)",
+            key, raw,
+        )
+        return None
+    return value
+
+
+def _derive_priority(
+    annotations: Dict[str, str], pod_spec: Optional[dict] = None
+) -> Optional[str]:
+    """high|low from the annotation (validated), else from
+    priorityClassName, else None (indeterminate). The ONE place the
+    mapping lives: qos_env's stamped env and the repartition
+    controller's donation precedence read the same derivation."""
+    priority = str(annotations.get(AnnotationQoSPriority, "")).strip().lower()
+    if priority in ("high", "low"):
+        return priority
+    if priority:
+        logger.warning(
+            "qos: ignoring malformed annotation %s=%r (want high|low)",
+            AnnotationQoSPriority, annotations.get(AnnotationQoSPriority),
+        )
+    if pod_spec:
+        pc = (pod_spec.get("spec") or {}).get("priorityClassName", "")
+        if pc:
+            return "high" if "high" in pc.lower() else "low"
+    return None
+
+
+def pod_priority(
+    annotations: Dict[str, str], pod_spec: Optional[dict] = None
+) -> str:
+    """The pod's QoS priority, defaulting indeterminate to "low" (the
+    safe default for donation precedence — an unclassified pod never
+    outranks anyone)."""
+    return _derive_priority(annotations, pod_spec) or "low"
+
+
+def repartition_opt_in(annotations: Dict[str, str]) -> bool:
+    """Whether the pod opted into live re-partitioning
+    (``elasticgpu.io/repartition``); unknown values read as opted-OUT
+    (quota renegotiation must never surprise a pod that didn't ask)."""
+    return (
+        str(annotations.get(AnnotationRepartition, "")).strip().lower()
+        in _TRUTHY
+    )
 
 
 def qos_env(
@@ -31,18 +126,64 @@ def qos_env(
     core_units: Optional[int] = None,
 ) -> Dict[str, str]:
     env: Dict[str, str] = {}
+    # -- derived-quota validation (the grant itself) ----------------------
+    try:
+        hbm_limit_bytes = (
+            int(hbm_limit_bytes) if hbm_limit_bytes is not None else None
+        )
+    except (TypeError, ValueError):
+        logger.warning(
+            "qos: dropping non-numeric hbm_limit_bytes %r", hbm_limit_bytes
+        )
+        hbm_limit_bytes = None
+    if hbm_limit_bytes is not None and hbm_limit_bytes <= 0:
+        hbm_limit_bytes = None
+    if (
+        hbm_limit_bytes
+        and chip_hbm_bytes
+        and hbm_limit_bytes > chip_hbm_bytes
+    ):
+        # A grant above the chip's HBM is a scheduler accounting bug; the
+        # runtime limit must still be physically satisfiable.
+        logger.warning(
+            "qos: HBM quota %d exceeds chip HBM %d; clamping",
+            hbm_limit_bytes, chip_hbm_bytes,
+        )
+        hbm_limit_bytes = chip_hbm_bytes
+    try:
+        core_units = int(core_units) if core_units is not None else None
+    except (TypeError, ValueError):
+        logger.warning("qos: dropping non-numeric core_units %r", core_units)
+        core_units = None
+    if core_units is not None and core_units < 0:
+        logger.warning("qos: dropping negative core_units %d", core_units)
+        core_units = None
+    # -- annotation overrides: clamp-only-downward ------------------------
+    ann_hbm = _annotation_int(annotations, AnnotationQoSHBMLimit)
+    if ann_hbm is not None:
+        if hbm_limit_bytes:
+            hbm_limit_bytes = min(hbm_limit_bytes, ann_hbm)
+        # No derived grant (core-only pod): the annotation alone never
+        # mints an HBM quota — there is nothing to cap.
+    ann_units = _annotation_int(annotations, AnnotationQoSCoreUnits)
+    if ann_units is not None and core_units is not None:
+        if ann_units > core_units:
+            logger.warning(
+                "qos: annotation %s=%d exceeds the granted %d core "
+                "units; using the grant",
+                AnnotationQoSCoreUnits, ann_units, core_units,
+            )
+        else:
+            core_units = ann_units
+    # -- emit -------------------------------------------------------------
     if hbm_limit_bytes:
-        env["ELASTIC_TPU_HBM_LIMIT_BYTES"] = str(hbm_limit_bytes)
+        env[EnvQoSHBMLimit] = str(hbm_limit_bytes)
         if chip_hbm_bytes:
             frac = min(1.0, hbm_limit_bytes / chip_hbm_bytes)
-            env["ELASTIC_TPU_HBM_FRACTION"] = f"{frac:.4f}"
+            env[EnvQoSHBMFraction] = f"{frac:.4f}"
     if core_units is not None:
-        env["ELASTIC_TPU_CORE_UNITS"] = str(core_units)
-    priority = annotations.get(AnnotationQoSPriority, "")
-    if not priority and pod_spec:
-        pc = (pod_spec.get("spec") or {}).get("priorityClassName", "")
-        if pc:
-            priority = "high" if "high" in pc.lower() else "low"
-    if priority in ("high", "low"):
-        env["ELASTIC_TPU_PRIORITY"] = priority
+        env[EnvQoSCoreUnits] = str(core_units)
+    priority = _derive_priority(annotations, pod_spec)
+    if priority:
+        env[EnvQoSPriority] = priority
     return env

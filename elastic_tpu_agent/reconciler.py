@@ -160,6 +160,10 @@ class Reconciler:
         # both exist: while a drain has reclaimed this node's bindings,
         # kubelet's still-listed assignments must NOT be replayed back.
         self.drain = None
+        # RepartitionController (repartition.py), same late assignment:
+        # a pod whose bindings QoS enforcement reclaimed must not have
+        # its still-listed assignment replayed back either.
+        self.repartition = None
         self._rng = rng if rng is not None else random.Random()
         self._lock = threading.Lock()
         self._repairs: Dict[str, int] = {k: 0 for k in ALL_KINDS}
@@ -776,17 +780,20 @@ class Reconciler:
         )
         logger.info("reconcile: reclaimed dead pod %s", info.key)
 
-    def drain_reclaim(self, pod_keys) -> dict:
-        """Drain-deadline reclaim (drain.py): tear down the named pods'
-        bindings — links, specs, CRD releases, store records — through
-        the SAME repair executor the reconciler uses for dead pods, so
-        the work is counted under the ``reclaimed_pod`` divergence class
-        and leaves zero orphan artifacts. The pods may still be live at
-        the apiserver; the caller suppresses replays until eviction.
-        Each pod's teardown runs under the owner's bind stripe — this
-        is called from the DRAIN thread against LIVE pods, so it must
-        serialize against in-flight binds and the reconcile pass's own
-        repairs exactly like the drift repair does."""
+    def reclaim_pods(self, pod_keys) -> dict:
+        """Policy-driven reclaim: tear down the named pods' bindings —
+        links, specs, CRD releases, store records — through the SAME
+        repair executor the reconciler uses for dead pods, so the work
+        is counted under the ``reclaimed_pod`` divergence class and
+        leaves zero orphan artifacts. Two callers: the drain
+        orchestrator's deadline reclaim (drain.py) and the repartition
+        controller's QoS eviction (repartition.py). The pods may still
+        be live at the apiserver; each caller suppresses replays until
+        its pods are actually gone. Each pod's teardown runs under the
+        owner's bind stripe — these run from OTHER threads against LIVE
+        pods, so they must serialize against in-flight binds and the
+        reconcile pass's own repairs exactly like the drift repair
+        does."""
         from .plugins import tpushare
 
         report = _new_report(boot=False, dry_run=False)
@@ -806,9 +813,13 @@ class Reconciler:
                 with tpushare.bind_lock(pod_key):
                     self._reclaim_pod(info, report, locked=True)
             except Exception:  # noqa: BLE001 - keep reclaiming the rest
-                logger.exception("drain reclaim: %s failed", pod_key)
+                logger.exception("policy reclaim: %s failed", pod_key)
                 self._sweep_failure(report)
         return report
+
+    # Historical name (PR 8): the drain orchestrator and its tests call
+    # the reclaim by this alias.
+    drain_reclaim = reclaim_pods
 
     # -- orphan sweep ---------------------------------------------------------
 
@@ -894,6 +905,19 @@ class Reconciler:
                 self._count(
                     report, KIND_ORPHAN_SPEC, keys={"hash": stem}
                 )
+                # the allocation's usage self-report dies with its
+                # spec (same contract as remove_alloc_spec — a sweep
+                # that bypassed it must not leak the report)
+                from .common import UsageReportSubdir
+
+                for suffix in (".json", ".json.tmp"):
+                    try:
+                        os.unlink(os.path.join(
+                            self._alloc_dir, UsageReportSubdir,
+                            stem + suffix,
+                        ))
+                    except OSError:
+                        pass
             except FileNotFoundError:
                 pass
             except OSError:
@@ -929,6 +953,14 @@ class Reconciler:
                 continue  # not our extended resource
             for alloc_hash in sorted(assignments[resource]):
                 owner, ids = assignments[resource][alloc_hash]
+                if self.repartition is not None and (
+                    self.repartition.replay_suppressed(owner.pod_key)
+                ):
+                    # QoS enforcement reclaimed this pod's bindings; its
+                    # kubelet assignment outlives the reclaim until the
+                    # pod is deleted. Replaying would re-bind exactly
+                    # what the throttle->evict escalation tore down.
+                    continue
                 try:
                     info = self._storage.load(owner.namespace, owner.name)
                 except StorageError:

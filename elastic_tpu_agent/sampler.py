@@ -47,7 +47,12 @@ from collections import deque
 from typing import Callable, Dict, List, Optional
 
 from . import faults
-from .common import BytesPerMemoryUnit, ResourceTPUCore, TPUPercentEachChip
+from .common import (
+    BytesPerMemoryUnit,
+    ResourceTPUCore,
+    TPUPercentEachChip,
+    UsageReportSubdir,
+)
 
 logger = logging.getLogger(__name__)
 
@@ -67,6 +72,21 @@ DEFAULT_UNHEALTHY_AFTER_FAILURES = 3
 # Window deques are pruned by horizon on write; the maxlen is only a
 # backstop against a clock that never advances.
 _MAX_WINDOW_SAMPLES = 720
+
+# How long a pod's self-reported usage file stays fresh. TPUs expose no
+# per-process duty counters, so chip duty split by grant share is the
+# best EXTERNAL attribution — but a pod that opted into live
+# re-partitioning (repartition.py) can do better: its runtime writes
+# {"ts", "duty_cycle_percent"} to <alloc_spec_dir>/usage/<TPU hash>.json
+# (the same agent<->pod surface the env file rides), and the sampler
+# takes that as the pod's measured usage, attributing only the REMAINING
+# chip duty to the non-reporting co-tenants. Stale reports (a wedged or
+# exited workload) fall back to proportional attribution.
+USAGE_REPORT_TTL_S = 30.0
+USAGE_REPORT_SUBDIR = UsageReportSubdir
+# A report stamped FROM THE FUTURE (skewed workload clock, bad ts
+# argument) must not stay "fresh" forever and defeat the TTL fallback.
+USAGE_REPORT_FUTURE_SLACK_S = 5.0
 
 
 def _window_stats(samples, horizon_s: float, now: float) -> dict:
@@ -137,6 +157,27 @@ class UtilizationSampler:
         # to a sampler assigns it directly, same as
         # AgentMetrics.attach_serving. Absent -> no serving block.
         self.serving_status_fn: Optional[Callable[[], dict]] = None
+        # Also manager-set: () -> repartition-controller status (edges,
+        # throttles, evict deadlines) from RepartitionController.status();
+        # the `repartition` block of /debug/allocations and the bundle.
+        self.repartition_status_fn: Optional[Callable[[], dict]] = None
+        # Also manager-set: (pod_key) -> signed core-percent delta the
+        # repartition controller currently applies on top of the pod's
+        # base grant. The overcommit detector judges usage against the
+        # EFFECTIVE grant — without this, growing a borrower's quota
+        # would immediately trip the very alarm the growth authorized.
+        self.grant_adjust_fn: Optional[Callable[[str], float]] = None
+        # Staleness bound on self-reported usage files (test seam).
+        self.usage_report_ttl_s = USAGE_REPORT_TTL_S
+        # Manager-set: (pod_key) -> whether this pod's self-reports are
+        # trusted (the repartition opt-in check). Self-reports feed the
+        # throttle->evict ENFORCEMENT path: without the gate, any pod
+        # could under-report and shift phantom duty onto a co-tenant the
+        # controller then punishes. None (standalone samplers, tests)
+        # accepts all reports — nothing enforces there.
+        self.usage_report_allowed_fn: Optional[
+            Callable[[str], bool]
+        ] = None
         # Also manager-set: () -> set of unhealthy chip indexes, the
         # plugin's APPLIED health view. Snapshots must read this (a
         # plain set copy) instead of re-probing the operator:
@@ -198,9 +239,10 @@ class UtilizationSampler:
         except Exception:  # noqa: BLE001
             chips = {}
         grants = self._join_allocations()
+        reports = self._read_usage_reports(grants, now)
         with self._lock:
             self._record_chip_samples(util, chips, now)
-            self._attribute_pods(util, grants, now)
+            self._attribute_pods(util, grants, now, reports)
             self._last_pods = grants
             self._last_sample_ts = now
             self.samples_total += 1
@@ -336,34 +378,118 @@ class UtilizationSampler:
         self._trace_ids[alloc_hash] = trace_id
         return trace_id
 
+    def _read_usage_reports(
+        self, grants: Dict[str, dict], now: float
+    ) -> Dict[str, float]:
+        """pod key -> self-reported duty percent, for pods with a FRESH
+        usage file under <alloc_spec_dir>/usage/<hash>.json (the
+        cooperative half of the repartition contract — see
+        USAGE_REPORT_TTL_S above). Reads happen outside the sampler
+        lock; a malformed or stale file simply falls back to
+        proportional attribution."""
+        out: Dict[str, float] = {}
+        if not self._alloc_spec_dir:
+            return out
+        usage_dir = os.path.join(self._alloc_spec_dir, USAGE_REPORT_SUBDIR)
+        if not os.path.isdir(usage_dir):
+            return out
+        for key, pod in grants.items():
+            if self.usage_report_allowed_fn is not None:
+                try:
+                    if not self.usage_report_allowed_fn(key):
+                        continue  # not opted in: report untrusted
+                except Exception:  # noqa: BLE001 - fail closed
+                    continue
+            best_ts = None
+            best_duty = None
+            for alloc_hash in pod["hashes"]:
+                path = os.path.join(usage_dir, f"{alloc_hash}.json")
+                try:
+                    with open(path) as f:
+                        report = json.load(f)
+                    ts = float(report["ts"])
+                    duty = float(report["duty_cycle_percent"])
+                except (OSError, ValueError, KeyError, TypeError):
+                    continue
+                if (
+                    now - ts > self.usage_report_ttl_s
+                    or ts - now > USAGE_REPORT_FUTURE_SLACK_S
+                    or duty < 0
+                ):
+                    continue
+                if best_ts is None or ts > best_ts:
+                    best_ts, best_duty = ts, duty
+            if best_duty is not None:
+                out[key] = best_duty
+        return out
+
     # -- attribution + overcommit ---------------------------------------------
 
-    def _attribute_pods(self, util: dict, grants: dict, now: float) -> None:
-        """(lock held) Split each chip's duty cycle across the pods bound
-        to it, proportionally to their grant share, and run the sustained
-        overcommit detector."""
+    def _attribute_pods(
+        self, util: dict, grants: dict, now: float,
+        reports: Optional[Dict[str, float]] = None,
+    ) -> None:
+        """(lock held) Attribute each chip's duty cycle to its pods —
+        self-reported usage verbatim where a fresh report exists, the
+        REMAINING duty split across non-reporting pods proportionally to
+        their grant share — then run the sustained overcommit
+        detector."""
+        reports = reports or {}
         chip_total_grant: Dict[int, float] = {}
-        for pod in grants.values():
+        pod_total_share: Dict[str, float] = {}
+        for key, pod in grants.items():
+            pod_total_share[key] = sum(pod["chips"].values())
+            if key in reports:
+                continue  # reporters don't compete for the remainder
             for chip, share in pod["chips"].items():
                 chip_total_grant[chip] = (
                     chip_total_grant.get(chip, 0.0) + share
                 )
+        # Reported duty pinned to chips (a multi-chip reporter's duty is
+        # split by its own grant-share proportions) so the remainder the
+        # non-reporters divide is what the reporters did NOT claim.
+        reported_chip_duty: Dict[int, float] = {}
+        for key, duty in reports.items():
+            pod = grants.get(key)
+            if pod is None or not pod["chips"]:
+                continue
+            own_total = pod_total_share.get(key, 0.0)
+            for chip, share in pod["chips"].items():
+                frac = (
+                    share / own_total if own_total > 0
+                    else 1.0 / len(pod["chips"])
+                )
+                reported_chip_duty[chip] = (
+                    reported_chip_duty.get(chip, 0.0) + duty * frac
+                )
         for key, pod in grants.items():
             used = 0.0
             covered = False
-            for chip, share in pod["chips"].items():
-                sample = self._last_chips.get(chip)
-                if not sample or "duty_cycle_percent" not in sample:
-                    continue
+            if key in reports:
+                # Measured, not assumed: the pod's own runtime telemetry
+                # is current evidence even when chip counters lag.
+                used = reports[key]
                 covered = True
-                total = chip_total_grant.get(chip, 0.0)
-                if total > 0:
-                    used += sample["duty_cycle_percent"] * (share / total)
-                elif len(
-                    [p for p in grants.values() if chip in p["chips"]]
-                ) == 1:
-                    # Memory-only sole tenant: the whole duty is its.
-                    used += sample["duty_cycle_percent"]
+                pod["self_reported"] = True
+            else:
+                for chip, share in pod["chips"].items():
+                    sample = self._last_chips.get(chip)
+                    if not sample or "duty_cycle_percent" not in sample:
+                        continue
+                    covered = True
+                    duty = max(
+                        0.0,
+                        sample["duty_cycle_percent"]
+                        - reported_chip_duty.get(chip, 0.0),
+                    )
+                    total = chip_total_grant.get(chip, 0.0)
+                    if total > 0:
+                        used += duty * (share / total)
+                    elif len(
+                        [p for p in grants.values() if chip in p["chips"]]
+                    ) == 1:
+                        # Memory-only sole tenant: the whole duty is its.
+                        used += duty
             pod["used_percent"] = round(used, 3) if covered else None
             pod["granted_percent"] = round(pod["granted_percent"], 3)
             if covered:
@@ -400,6 +526,17 @@ class UtilizationSampler:
         self, key: str, pod: dict, used: float, now: float
     ) -> None:
         granted = pod["granted_percent"]
+        if self.grant_adjust_fn is not None:
+            # The repartition controller may have grown (or shrunk) this
+            # pod's quota on top of the store-derived base grant; the
+            # alarm must judge usage against the EFFECTIVE grant.
+            try:
+                adjust = float(self.grant_adjust_fn(key))
+            except Exception:  # noqa: BLE001 - never load-bearing
+                adjust = 0.0
+            if adjust:
+                granted = max(0.0, granted + adjust)
+                pod["effective_granted_percent"] = round(granted, 3)
         if granted <= 0 or used <= granted + self.overcommit_margin:
             self._overcommit_streak[key] = 0
             if key in self._overcommit_active:
@@ -501,6 +638,21 @@ class UtilizationSampler:
                         for name, horizon in WINDOWS.items()
                     }
             return out
+
+    def utilization_view(self) -> dict:
+        """Copies of the last join — the repartition controller's input:
+        ``pods`` (pod -> chips/granted/used/self_reported/overcommit),
+        ``chips`` (chip -> last raw sample) and the sample's timestamp.
+        Safe from any thread; never blocks on sampling."""
+        with self._lock:
+            return {
+                "pods": {
+                    k: {**v, "chips": dict(v["chips"])}
+                    for k, v in self._last_pods.items()
+                },
+                "chips": {k: dict(v) for k, v in self._last_chips.items()},
+                "ts": self._last_sample_ts,
+            }
 
     def pod_windows(self, now: Optional[float] = None) -> Dict[str, dict]:
         now = time.time() if now is None else now
@@ -623,6 +775,11 @@ class UtilizationSampler:
         if self.drain_status_fn is not None:
             try:
                 out["drain"] = self.drain_status_fn()
+            except Exception:  # noqa: BLE001 - introspection only
+                pass
+        if self.repartition_status_fn is not None:
+            try:
+                out["repartition"] = self.repartition_status_fn()
             except Exception:  # noqa: BLE001 - introspection only
                 pass
         if self.serving_status_fn is not None:
@@ -917,6 +1074,66 @@ def validate_bundle(bundle: dict) -> List[str]:
                         expect(field in pc,
                                "allocations.serving.prefix_cache "
                                f"missing {field!r}")
+            if "roles" in serving:
+                # disaggregated prefill/decode engines over a shared
+                # pool (serving.disaggregated_status); absent for a
+                # unified engine
+                roles = serving["roles"]
+                expect(isinstance(roles, dict),
+                       "allocations.serving.roles must be an object")
+                for rname, rstat in (
+                    roles.items() if isinstance(roles, dict) else []
+                ):
+                    if not isinstance(rstat, dict):
+                        problems.append(
+                            f"allocations.serving.roles[{rname!r}] must "
+                            "be an object"
+                        )
+                        continue
+                    for field in ("role", "queue_depth"):
+                        expect(field in rstat,
+                               f"allocations.serving.roles[{rname!r}] "
+                               f"missing {field!r}")
+            if "shared_pool" in serving:
+                sp = serving["shared_pool"]
+                expect(isinstance(sp, dict),
+                       "allocations.serving.shared_pool must be an "
+                       "object")
+                if isinstance(sp, dict):
+                    for field in ("adoptions", "adopted_tokens"):
+                        expect(field in sp,
+                               "allocations.serving.shared_pool "
+                               f"missing {field!r}")
+    if isinstance(allocations, dict) and "repartition" in allocations:
+        # absent in pre-repartition bundles and when no controller is
+        # attached (sampler disabled / standalone node-doctor)
+        rep = allocations["repartition"]
+        expect(isinstance(rep, dict),
+               "allocations.repartition must be an object")
+        if isinstance(rep, dict):
+            for field in ("enabled", "edges", "throttled_pods",
+                          "repartitions_total"):
+                expect(field in rep,
+                       f"allocations.repartition missing {field!r}")
+            expect(isinstance(rep.get("edges", []), list),
+                   "allocations.repartition.edges must be a list")
+            for i, edge in enumerate(
+                rep.get("edges")
+                if isinstance(rep.get("edges"), list) else []
+            ):
+                if not isinstance(edge, dict):
+                    problems.append(
+                        f"allocations.repartition.edges[{i}] must be an "
+                        "object"
+                    )
+                    continue
+                for field in ("donor", "borrower", "chip", "core_units"):
+                    expect(field in edge,
+                           f"allocations.repartition.edges[{i}] missing "
+                           f"{field!r}")
+            expect(isinstance(rep.get("throttled_pods", {}), dict),
+                   "allocations.repartition.throttled_pods must be an "
+                   "object")
     windows = bundle.get("sampler_windows")
     expect(isinstance(windows, dict), "sampler_windows must be an object")
     if isinstance(windows, dict):

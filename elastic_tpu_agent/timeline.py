@@ -80,6 +80,14 @@ KIND_CORDON = "cordon"
 # GC reclaim of a deleted pod's bindings (the reconciler's reclaims are
 # reconcile_repair events with class=reclaimed_pod)
 KIND_POD_RECLAIMED = "pod_reclaimed"
+# dynamic fractional re-partitioning (repartition.py): one event per
+# executed quota move (attrs: direction grow|shrink, donor, borrower,
+# core_units, hbm_bytes) keyed by pod + chip, so a grant's growth/shrink
+# history reconstructs causally next to its binds and drains
+KIND_REPARTITION = "repartition"
+# sustained-overcommit escalation (repartition.py): attrs.action is
+# throttle | unthrottle | evict, with the evict deadline where relevant
+KIND_THROTTLE = "throttle"
 # supervision (supervisor.py)
 KIND_SUBSYSTEM_RESTART = "subsystem_restart"
 KIND_SUBSYSTEM_CRASH_LOOP = "subsystem_crash_loop"

@@ -162,6 +162,134 @@ class BlockAllocator:
         return self.n_blocks - 1 - len(self._free)
 
 
+class SharedKVPool:
+    """One paged KV block pool shared by SEVERAL engines — the substrate
+    of prefill/decode disaggregation (FlexNPU's co-location shape): a
+    PREFILL-role engine writes prompt K/V into pool blocks and publishes
+    them through the automatic prefix cache; a DECODE-role engine admits
+    the same prompt, adopts the published blocks via the refcounted
+    ``BlockAllocator``/``PrefixCache`` plumbing (the exact explicit-
+    prefix machinery — no bytes copied, no recompute), prefills only the
+    ≥1-token tail and decodes. One chip serves both phases without the
+    decode stream ever waiting behind a whole prompt, and the phase
+    imbalance between the two roles is exactly the signal the agent's
+    repartition controller moves core quota along.
+
+    Owns the allocator, the prefix cache (always on — it IS the
+    handoff channel) and the pool arrays; attached engines read and
+    write the arrays through their ``_pool_k``/``_pool_v`` properties,
+    so donated jit programs keep working unchanged. Host-side driving
+    is expected from one thread (or externally serialized) — the same
+    contract a single engine already has.
+    """
+
+    def __init__(
+        self,
+        cfg: ModelConfig,
+        block_size: int,
+        pool_blocks: int,
+        kv_int8: bool = False,
+        prefix_cache_blocks: Optional[int] = None,
+    ):
+        from .prefix_cache import PrefixCache
+
+        self.cfg = cfg
+        self.block_size = block_size
+        self.pool_blocks = pool_blocks
+        self.kv_int8 = kv_int8
+        self.allocator = BlockAllocator(pool_blocks)
+        self.prefix_cache = PrefixCache(
+            self.allocator, block_size, max_blocks=prefix_cache_blocks
+        )
+        self.allocator.reclaim = self.prefix_cache.reclaim
+        shape = (
+            cfg.n_layers, pool_blocks, block_size,
+            cfg.kv_heads, cfg.head_dim,
+        )
+        self.pool_k = _pool_empty(shape, cfg.dtype, kv_int8)
+        self.pool_v = _pool_empty(shape, cfg.dtype, kv_int8)
+        # Cross-role adoption accounting: admissions that mapped cached
+        # blocks some attached engine published earlier. In the
+        # disaggregated flow the decode role publishes only digests the
+        # prefill role already owns (dedup), so decode-side hits ARE
+        # prefill->decode handoffs.
+        self.adoptions = 0
+        self.adopted_tokens = 0
+
+    def compatible_with(self, cfg: ModelConfig) -> bool:
+        return (
+            cfg.n_layers == self.cfg.n_layers
+            and cfg.kv_heads == self.cfg.kv_heads
+            and cfg.head_dim == self.cfg.head_dim
+        )
+
+    @property
+    def used_blocks(self) -> int:
+        return self.allocator.used
+
+    def stats(self) -> Dict:
+        return {
+            "pool_blocks": self.pool_blocks,
+            "used_blocks": self.used_blocks,
+            "block_size": self.block_size,
+            "adoptions": self.adoptions,
+            "adopted_tokens": self.adopted_tokens,
+            "prefix_cache": self.prefix_cache.stats(),
+        }
+
+
+def disaggregated_status(prefill: "ServingEngine",
+                         decode: "ServingEngine") -> Dict:
+    """Combined serving status for a prefill/decode pair over one
+    SharedKVPool — the ``serving`` block shape the sampler/doctor
+    bundle schema validates (pool totals at the top level like a
+    unified engine, plus per-role queue depths and the shared-pool
+    adoption counters the per-role gauges read)."""
+    ps, ds = prefill.stats(), decode.stats()
+    pool = prefill.shared_pool
+    out = {
+        "slots": ps["slots"] + ds["slots"],
+        "live_requests": ps["live_requests"] + ds["live_requests"],
+        "pending_prefills": (
+            ps["pending_prefills"] + ds["pending_prefills"]
+        ),
+        "block_size": pool.block_size,
+        "pool_blocks": pool.pool_blocks,
+        "used_blocks": pool.used_blocks,
+        "pool_occupancy": round(
+            pool.used_blocks / max(1, pool.pool_blocks - 1), 4
+        ),
+        "prefilled_tokens_total": (
+            ps["prefilled_tokens_total"] + ds["prefilled_tokens_total"]
+        ),
+        "admitted_tokens_total": (
+            ps["admitted_tokens_total"] + ds["admitted_tokens_total"]
+        ),
+        "prefix_cache": pool.prefix_cache.stats(),
+        "shared_pool": {
+            "adoptions": pool.adoptions,
+            "adopted_tokens": pool.adopted_tokens,
+        },
+        "roles": {
+            "prefill": {
+                "role": "prefill",
+                "queue_depth": ps["pending_prefills"],
+                "prefilled_tokens_total": ps["prefilled_tokens_total"],
+            },
+            "decode": {
+                "role": "decode",
+                "queue_depth": (
+                    ds["live_requests"] + ds["pending_prefills"]
+                ),
+                "adopted_tokens_total": ds.get(
+                    "adopted_tokens_total", 0
+                ),
+            },
+        },
+    }
+    return out
+
+
 class ServingEngine:
     """Host-driven continuous-batching decoder over fixed slots and a
     paged KV block pool.
@@ -270,6 +398,8 @@ class ServingEngine:
         prefix_cache_blocks: Optional[int] = None,
         kv_int8: bool = False,
         mesh=None,
+        role: str = "both",
+        pool: Optional[SharedKVPool] = None,
     ):
         # optional flight recorder (workloads/telemetry.py): every
         # admit/step emits a JSONL record tagged with the agent's
@@ -287,6 +417,60 @@ class ServingEngine:
         self._sampling = (temperature, top_k, top_p)
         self._key = jax.random.key(seed)
 
+        # Disaggregated roles over a SharedKVPool (see SharedKVPool):
+        # "prefill" admits-and-publishes (no decode slots retained),
+        # "decode" adopts published blocks and decodes, "both" is the
+        # unified engine. The pool must be shared for roles to talk.
+        if role not in ("both", "prefill", "decode"):
+            raise ValueError(
+                f"role must be both|prefill|decode, got {role!r}"
+            )
+        if role != "both" and draft_params is not None:
+            raise ValueError(
+                "speculative serving does not support disaggregated "
+                "prefill/decode roles"
+            )
+        self.role = role
+        self.shared_pool = pool
+        if pool is not None:
+            if mesh is not None:
+                raise ValueError(
+                    "a SharedKVPool does not compose with a "
+                    "tensor-parallel mesh yet (per-engine placement "
+                    "would shard one pool two ways)"
+                )
+            if draft_params is not None:
+                raise ValueError(
+                    "speculative serving does not support a shared pool"
+                )
+            if paged_kernel:
+                raise ValueError(
+                    "paged_kernel=True does not compose with a shared "
+                    "pool yet; shared-pool engines run the gather path"
+                )
+            paged_kernel = False
+            if kv_int8 != pool.kv_int8:
+                raise ValueError(
+                    f"engine kv_int8={kv_int8} disagrees with the "
+                    f"shared pool's kv_int8={pool.kv_int8}"
+                )
+            if not pool.compatible_with(cfg):
+                raise ValueError(
+                    "model config (n_layers/kv_heads/head_dim) does not "
+                    "match the shared pool's"
+                )
+            if block_size is not None and block_size != pool.block_size:
+                raise ValueError(
+                    f"block_size {block_size} != shared pool's "
+                    f"{pool.block_size}"
+                )
+            block_size = pool.block_size
+            if pool_blocks is not None and pool_blocks != pool.pool_blocks:
+                raise ValueError(
+                    f"pool_blocks {pool_blocks} != shared pool's "
+                    f"{pool.pool_blocks}"
+                )
+
         if block_size is None:
             # paging granularity: largest power of two dividing every
             # prompt bucket and max_len (so prefill chunks and rows
@@ -302,32 +486,52 @@ class ServingEngine:
                 f"{max_len} and every prompt bucket {self.buckets}"
             )
         self.max_blocks = max_len // block_size
-        if pool_blocks is None:
-            # all slots at max_len plus one slot's worth of headroom
-            # for registered prefixes, plus the junk block
-            pool_blocks = 1 + (slots + 1) * self.max_blocks
-        self.pool_blocks = pool_blocks
-        self._alloc = BlockAllocator(pool_blocks)
-        # automatic cross-request prefix caching (prefix_cache.py):
-        # every full prompt block a prefill writes is published under a
-        # token hash chain; admissions share the longest cached chain
-        # and prefill only the tail. Off by default — cached blocks
-        # outlive their request (refcount 1, LRU-evicted under pool
-        # pressure), which changes used_blocks bookkeeping callers may
-        # watch.
-        self._prefix_cache = None
-        if prefix_cache:
-            from .prefix_cache import PrefixCache
+        if pool is not None:
+            # Shared substrate: the pool owns allocator + prefix cache
+            # (the cache IS the cross-role handoff channel, so it is
+            # always on) and the arrays; this engine is a view.
+            self.pool_blocks = pool.pool_blocks
+            self._alloc = pool.allocator
+            self._prefix_cache = pool.prefix_cache
+        else:
+            if pool_blocks is None:
+                # all slots at max_len plus one slot's worth of headroom
+                # for registered prefixes, plus the junk block
+                pool_blocks = 1 + (slots + 1) * self.max_blocks
+            self.pool_blocks = pool_blocks
+            self._alloc = BlockAllocator(pool_blocks)
+            # automatic cross-request prefix caching (prefix_cache.py):
+            # every full prompt block a prefill writes is published
+            # under a token hash chain; admissions share the longest
+            # cached chain and prefill only the tail. Off by default —
+            # cached blocks outlive their request (refcount 1,
+            # LRU-evicted under pool pressure), which changes
+            # used_blocks bookkeeping callers may watch.
+            self._prefix_cache = None
+            if prefix_cache:
+                from .prefix_cache import PrefixCache
 
-            self._prefix_cache = PrefixCache(
-                self._alloc, block_size, max_blocks=prefix_cache_blocks
+                self._prefix_cache = PrefixCache(
+                    self._alloc, block_size,
+                    max_blocks=prefix_cache_blocks,
+                )
+                self._alloc.reclaim = self._prefix_cache.reclaim
+        if self.role == "prefill" and self._prefix_cache is None:
+            raise ValueError(
+                "role='prefill' publishes through the prefix cache; "
+                "construct with prefix_cache=True or a SharedKVPool"
             )
-            self._alloc.reclaim = self._prefix_cache.reclaim
         # REAL prompt tokens run through a prefill forward (tails only
         # when the cache hits); the serving bench's >=3x prefill
         # reduction claim is measured against this counter.
         self.prefilled_tokens_total = 0
         self.admitted_tokens_total = 0
+        # Cache-adoption accounting (nonzero only with the prefix cache
+        # on): admissions that mapped already-cached blocks, and the
+        # prompt tokens those blocks covered. On a decode-role engine
+        # over a shared pool these are prefill->decode handoffs.
+        self.adoptions_total = 0
+        self.adopted_tokens_total = 0
 
         self.kv_int8 = kv_int8
         if kv_int8 and draft_params is not None:
@@ -346,16 +550,19 @@ class ServingEngine:
         self._part = ServingPartitioner(mesh, cfg)
         if mesh is not None:
             self.params = params = self._part.shard_params(params)
-        pool_shape = (
-            cfg.n_layers, pool_blocks, block_size,
-            cfg.kv_heads, cfg.head_dim,
-        )
-        self._pool_k = self._part.place_pool(
-            _pool_empty(pool_shape, cfg.dtype, kv_int8)
-        )
-        self._pool_v = self._part.place_pool(
-            _pool_empty(pool_shape, cfg.dtype, kv_int8)
-        )
+        if pool is None:
+            pool_shape = (
+                cfg.n_layers, self.pool_blocks, block_size,
+                cfg.kv_heads, cfg.head_dim,
+            )
+            self._pool_k = self._part.place_pool(
+                _pool_empty(pool_shape, cfg.dtype, kv_int8)
+            )
+            self._pool_v = self._part.place_pool(
+                _pool_empty(pool_shape, cfg.dtype, kv_int8)
+            )
+        # (shared pool: the arrays already live on the pool; the
+        # _pool_k/_pool_v properties read and write through it)
         # logical->physical block map per slot; 0 = unmapped (junk)
         self._table = np.zeros((slots, self.max_blocks), np.int32)
         self._lengths = jnp.zeros((slots,), jnp.int32)
@@ -473,6 +680,40 @@ class ServingEngine:
             self._spec_step_fn = self._build_spec_step()
             self._draft_catchup_fn = self._build_draft_catchup()
 
+    # -- pool array indirection --------------------------------------
+    #
+    # Every compiled program reads the pool through these and writes the
+    # (donated) result back through them, so attaching a SharedKVPool
+    # needed no change to any program or call site: a solo engine keeps
+    # its own arrays, a shared-pool engine reads/writes the pool's — the
+    # other role sees every update immediately.
+
+    @property
+    def _pool_k(self):
+        if self.shared_pool is not None:
+            return self.shared_pool.pool_k
+        return self._pool_k_own
+
+    @_pool_k.setter
+    def _pool_k(self, value):
+        if self.shared_pool is not None:
+            self.shared_pool.pool_k = value
+        else:
+            self._pool_k_own = value
+
+    @property
+    def _pool_v(self):
+        if self.shared_pool is not None:
+            return self.shared_pool.pool_v
+        return self._pool_v_own
+
+    @_pool_v.setter
+    def _pool_v(self, value):
+        if self.shared_pool is not None:
+            self.shared_pool.pool_v = value
+        else:
+            self._pool_v_own = value
+
     # -- paging helpers ----------------------------------------------
 
     def _blocks_for(self, n_positions: int) -> int:
@@ -532,9 +773,17 @@ class ServingEngine:
             "admitted_tokens_total": self.admitted_tokens_total,
             "paged_kernel": self.paged_kernel,
             "kv_int8": self.kv_int8,
+            "role": self.role,
+            "adoptions_total": self.adoptions_total,
+            "adopted_tokens_total": self.adopted_tokens_total,
         }
         if self._prefix_cache is not None:
             out["prefix_cache"] = self._prefix_cache.stats()
+        if self.shared_pool is not None:
+            out["shared_pool"] = {
+                "adoptions": self.shared_pool.adoptions,
+                "adopted_tokens": self.shared_pool.adopted_tokens,
+            }
         return out
 
     # -- compiled programs -------------------------------------------
@@ -894,6 +1143,10 @@ class ServingEngine:
         self._streams[rid] = [first]
         if first in self._stop[rid]:
             self._finish(rid, "stop_token")
+        elif self.role == "prefill":
+            # Prefill role (see admit): publish-and-release — the slot
+            # frees for the next queued prompt instead of decoding.
+            self._finish(rid, "prefilled")
         return {rid: first}
 
     # -- speculative-mode programs -----------------------------------
@@ -1275,6 +1528,13 @@ class ServingEngine:
             # the claim HELD (slot + blocks are this request's now):
             # this admission counts against the cache
             self._prefix_cache.record_admission(plen if auto_hit else 0)
+            if auto_hit:
+                self.adoptions_total += 1
+                self.adopted_tokens_total += plen
+                if self.shared_pool is not None:
+                    # cross-role handoff accounting (SharedKVPool)
+                    self.shared_pool.adoptions += 1
+                    self.shared_pool.adopted_tokens += plen
         return dict(
             prompt=prompt, p=p, bucket=bucket,
             pref_blocks=pref_blocks, plen=plen,
@@ -1415,6 +1675,12 @@ class ServingEngine:
         # the admission token itself may be a stop token
         if int(first) in self._stop[rid]:
             self._finish(rid, "stop_token")
+        elif self.role == "prefill":
+            # Prefill role: the published cache blocks ARE the output —
+            # free the slot immediately (the decode-role engine adopts
+            # the blocks and owns the stream from here; the sampled
+            # first token stays retrievable for the caller to compare).
+            self._finish(rid, "prefilled")
         if self._recorder is not None:
             rec = dict(
                 rid=rid, prompt_len=p, prefix_len=plen, bucket=bucket,

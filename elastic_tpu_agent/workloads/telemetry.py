@@ -295,3 +295,51 @@ def load_jsonl(path: str) -> List[dict]:
         except OSError:
             continue
     return out
+
+
+def write_usage_report(
+    alloc_spec_dir: str,
+    alloc_hash: str,
+    duty_cycle_percent: float,
+    hbm_used_bytes: int = 0,
+    ts: float = None,
+) -> bool:
+    """Publish this workload's measured utilization to the node agent.
+
+    The cooperative half of the repartition contract (repartition.py):
+    TPUs expose no per-process duty counters, so the agent's sampler can
+    only split chip duty across co-tenants by grant share — useless for
+    telling a busy pod from its idle neighbor. A pod that opted into
+    live re-partitioning writes {"ts", "duty_cycle_percent",
+    "hbm_used_bytes"} to ``<alloc dir>/usage/<alloc hash>.json`` (the
+    hash is the pod's ``TPU`` env; the alloc dir is the same
+    hostPath-shared surface its env file arrived on), and the sampler
+    attributes that pod's usage from the report instead of assuming it.
+
+    Atomic (tmp + rename), never raises — a full disk must not take the
+    training loop down. Returns True when the report landed.
+    """
+    from ..common import UsageReportSubdir
+
+    usage_dir = os.path.join(alloc_spec_dir, UsageReportSubdir)
+    path = os.path.join(usage_dir, f"{alloc_hash}.json")
+    # FIXED temp name (one writer per hash — the pod that owns the
+    # allocation): a crash between write and rename leaves one file the
+    # NEXT write reclaims, never an unbounded pid-suffixed pile.
+    tmp = f"{path}.tmp"
+    try:
+        os.makedirs(usage_dir, exist_ok=True)
+        with open(tmp, "w") as f:
+            json.dump({
+                "ts": time.time() if ts is None else ts,
+                "duty_cycle_percent": float(duty_cycle_percent),
+                "hbm_used_bytes": int(hbm_used_bytes),
+            }, f)
+        os.replace(tmp, path)
+        return True
+    except OSError:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        return False

@@ -1,0 +1,277 @@
+"""Disaggregated prefill/decode serving over a SharedKVPool.
+
+The acceptance bar (ISSUE 12, serving half): ServingEngine splits into
+prefill and decode roles that share the paged KV block pool — blocks
+prefilled by one role are adopted by the other via the existing
+refcounted BlockAllocator/PrefixCache plumbing — so one chip serves
+both phases without head-of-line blocking, with streams bit-identical
+to the unified engine.
+"""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from elastic_tpu_agent.workloads.serving import (
+    ServingEngine,
+    SharedKVPool,
+    disaggregated_status,
+)
+from elastic_tpu_agent.workloads.transformer import ModelConfig, init_params
+
+
+def _cfg(**over):
+    base = dict(
+        vocab=97, d_model=32, n_heads=4, n_layers=2, d_ff=64,
+        max_seq=192, dtype=jnp.float32, attn="reference", pos="rope",
+    )
+    base.update(over)
+    return ModelConfig(**base)
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = _cfg()
+    params = init_params(cfg, jax.random.key(0))
+    return cfg, params
+
+
+def _pair(cfg, params, pool_blocks=64, pre_slots=1, dec_slots=2):
+    pool = SharedKVPool(cfg, block_size=8, pool_blocks=pool_blocks)
+    pre = ServingEngine(
+        params, cfg, slots=pre_slots, max_len=128,
+        prompt_buckets=(8, 64), role="prefill", pool=pool,
+    )
+    dec = ServingEngine(
+        params, cfg, slots=dec_slots, max_len=128,
+        prompt_buckets=(8, 64), role="decode", pool=pool,
+    )
+    return pool, pre, dec
+
+
+PROMPT = [((7 * i) % 89) + 2 for i in range(40)]
+
+
+# -- handoff correctness ------------------------------------------------------
+
+
+def test_disaggregated_stream_is_bit_identical_to_unified(setup):
+    """prefill-role publish -> decode-role adopt produces exactly the
+    unified engine's greedy stream: the adoption re-maps the original
+    K/V bytes, never a recompute."""
+    cfg, params = setup
+    uni = ServingEngine(
+        params, cfg, slots=2, max_len=128, prompt_buckets=(8, 64),
+        prefix_cache=True,
+    )
+    ru = uni.admit(PROMPT)
+    for _ in range(6):
+        uni.step()
+    want = uni.release(ru)
+
+    pool, pre, dec = _pair(cfg, params)
+    rp = pre.admit(PROMPT)
+    assert pre.finish_reason[rp] == "prefilled"
+    first = pre.release(rp)
+    assert first == want[:1]  # same prefill logits, same first token
+    rd = dec.admit(PROMPT)
+    for _ in range(6):
+        dec.step()
+    assert dec.release(rd) == want
+
+
+def test_decode_adopts_published_blocks_not_recompute(setup):
+    """The decode admission prefills ONLY the tail: every full prompt
+    block comes from the shared pool under a refcount."""
+    cfg, params = setup
+    pool, pre, dec = _pair(cfg, params)
+    pre.admit(PROMPT)
+    prefilled_by_pre = pre.prefilled_tokens_total
+    assert prefilled_by_pre == len(PROMPT)
+    dec.admit(PROMPT)
+    # 40 tokens, block 8: blocks 0..3 cached (32 tokens), 8-token tail
+    assert dec.prefilled_tokens_total == 8
+    assert pool.adoptions == 1
+    assert pool.adopted_tokens == 32
+    assert dec.adopted_tokens_total == 32
+    st = pool.prefix_cache.stats()
+    assert st["hits"] == 1
+
+
+def test_prefill_role_frees_slots_blocks_survive_in_cache(setup):
+    """publish-and-release: the prefill engine's slot frees immediately
+    while the published blocks stay cache-held (refcount 1) for
+    adoption; releasing decode requests returns the pool to exactly
+    the cache-held footprint."""
+    cfg, params = setup
+    pool, pre, dec = _pair(cfg, params)
+    r0 = pre.admit(PROMPT)
+    assert pre.finish_reason[r0] == "prefilled"
+    assert not pre._slot_of  # slot free for the next prompt
+    cache_held = pool.prefix_cache.cached_blocks
+    assert cache_held >= 4
+    assert pool.used_blocks == cache_held
+    rd = dec.admit(PROMPT)
+    for _ in range(3):
+        dec.step()
+    dec.release(rd)
+    assert pool.used_blocks == pool.prefix_cache.cached_blocks
+
+
+def test_chunked_prefill_role_via_enqueue(setup):
+    """The prefill role drives enqueue()'s chunked path too: one chunk
+    per step(), publish-and-release at the final chunk."""
+    cfg, params = setup
+    pool, pre, dec = _pair(cfg, params)
+    prompt = [((3 * i) % 89) + 2 for i in range(40)]
+    rid = pre.enqueue(prompt)
+    ticks = 0
+    while pre._pending:
+        pre.step()
+        ticks += 1
+    assert ticks == 5  # 40 tokens / 8-token blocks
+    assert pre.finish_reason[rid] == "prefilled"
+    rd = dec.admit(prompt)
+    assert dec.prefilled_tokens_total == 8  # tail only
+
+
+# -- the head-of-line story ---------------------------------------------------
+
+
+def test_split_decode_advances_during_prefill_burst(setup):
+    """Structural no-HOL: while a long prompt prefills chunk-by-chunk
+    on the prefill engine, the decode engine emits a token EVERY tick.
+    The unified engine's synchronous admit() emits zero decode tokens
+    until the whole prefill returns — the head-of-line block the split
+    removes."""
+    cfg, params = setup
+    burst = [((5 * i) % 89) + 2 for i in range(56)]
+
+    # unified: the admit is one blocking call; the live decode stream
+    # cannot advance inside it, by construction
+    uni = ServingEngine(
+        params, cfg, slots=2, max_len=128, prompt_buckets=(8, 64),
+        prefix_cache=True,
+    )
+    r_live = uni.admit([9, 8, 7])
+    uni.step()
+    before = len(uni.stream(r_live))
+    uni.admit(burst)  # <- the whole burst prefills here, decode stalled
+    tokens_during_burst_unified = len(uni.stream(r_live)) - before
+    assert tokens_during_burst_unified == 0
+
+    # disaggregated: interleave one prefill chunk + one decode step per
+    # tick; the decode stream grows every tick of the burst
+    pool, pre, dec = _pair(cfg, params)
+    r_live = dec.admit([9, 8, 7])
+    dec.step()
+    before = len(dec.stream(r_live))
+    pre.enqueue(burst)
+    ticks = 0
+    while pre._pending:
+        pre.step()
+        dec.step()
+        ticks += 1
+    tokens_during_burst_split = len(dec.stream(r_live)) - before
+    assert ticks == 7  # 56 tokens / 8-token chunks
+    assert tokens_during_burst_split == ticks  # one token EVERY tick
+    # and the burst's own decode can start from the adopted blocks
+    rb = dec.admit(burst)
+    assert dec.prefilled_tokens_total < len(burst)
+    dec.step()
+    assert len(dec.stream(rb)) == 2
+
+
+# -- status / metrics surfaces ------------------------------------------------
+
+
+def test_disaggregated_status_shape_and_bundle_schema(setup):
+    cfg, params = setup
+    pool, pre, dec = _pair(cfg, params)
+    pre.admit(PROMPT)
+    dec.admit(PROMPT)
+    st = disaggregated_status(pre, dec)
+    assert st["roles"]["prefill"]["queue_depth"] == 0
+    assert st["roles"]["decode"]["queue_depth"] == 1
+    assert st["shared_pool"]["adoptions"] == 1
+    assert st["pool_blocks"] == pool.pool_blocks
+    assert st["prefilled_tokens_total"] == len(PROMPT) + 8
+    # the sampler/doctor schema accepts (and checks) the role shape
+    from elastic_tpu_agent.sampler import validate_bundle
+
+    bundle = {
+        "kind": "elastic-tpu-node-doctor", "version": 1,
+        "generated_ts": 0.0, "node": "n", "devices": [],
+        "healthy_indexes": [], "health_reasons": {},
+        "error_counters": {},
+        "allocations": {"chips": [], "pods": [], "sampler": {},
+                        "serving": st},
+        "sampler_windows": {"chips": {}, "pods": {}},
+        "traces": [], "agent": {},
+    }
+    assert validate_bundle(bundle) == []
+    del st["roles"]["decode"]["queue_depth"]
+    problems = validate_bundle(bundle)
+    assert any("queue_depth" in p for p in problems)
+
+
+def test_role_gauges_read_disaggregated_status(setup):
+    cfg, params = setup
+    pool, pre, dec = _pair(cfg, params)
+    pre.admit(PROMPT)
+    dec.admit(PROMPT)
+    from prometheus_client import CollectorRegistry, generate_latest
+
+    from elastic_tpu_agent.metrics import AgentMetrics
+
+    registry = CollectorRegistry()
+    m = AgentMetrics(registry=registry)
+    m.attach_serving(lambda: disaggregated_status(pre, dec))
+    text = generate_latest(registry).decode()
+    assert (
+        'elastic_tpu_serving_role_queue_depth{role="decode"} 1.0' in text
+    )
+    assert "elastic_tpu_serving_pool_adoptions 1.0" in text
+    assert "elastic_tpu_serving_pool_adopted_tokens 32.0" in text
+
+
+def test_engine_stats_carry_role_and_adoption(setup):
+    cfg, params = setup
+    pool, pre, dec = _pair(cfg, params)
+    pre.admit(PROMPT)
+    dec.admit(PROMPT)
+    assert pre.stats()["role"] == "prefill"
+    assert dec.stats()["role"] == "decode"
+    assert dec.stats()["adoptions_total"] == 1
+    assert dec.stats()["shared_pool"]["adopted_tokens"] == 32
+
+
+# -- validation ---------------------------------------------------------------
+
+
+def test_shared_pool_and_role_rejections(setup):
+    cfg, params = setup
+    pool = SharedKVPool(cfg, block_size=8, pool_blocks=64)
+    with pytest.raises(ValueError, match="role"):
+        ServingEngine(params, cfg, role="verifier")
+    with pytest.raises(ValueError, match="prefix cache"):
+        ServingEngine(params, cfg, role="prefill")  # no cache, no pool
+    with pytest.raises(ValueError, match="block_size"):
+        ServingEngine(
+            params, cfg, prompt_buckets=(16,), block_size=16, pool=pool
+        )
+    with pytest.raises(ValueError, match="kv_int8"):
+        ServingEngine(params, cfg, kv_int8=True, pool=pool)
+    with pytest.raises(ValueError, match="paged_kernel"):
+        ServingEngine(params, cfg, paged_kernel=True, pool=pool)
+    other = _cfg(n_layers=3)
+    with pytest.raises(ValueError, match="shared pool"):
+        ServingEngine(
+            init_params(other, jax.random.key(1)), other, pool=pool
+        )
+    from elastic_tpu_agent.workloads.partitioner import make_serving_mesh
+
+    if jax.device_count() >= 2:
+        mesh = make_serving_mesh(mp=2, n_devices=2)
+        with pytest.raises(ValueError, match="mesh"):
+            ServingEngine(params, cfg, mesh=mesh, pool=pool)

@@ -46,6 +46,90 @@ def test_qos_env_fraction_capped_at_1():
     assert env["ELASTIC_TPU_HBM_FRACTION"] == "1.0000"
 
 
+# -- unit: annotation validation / clamping (ISSUE 12 satellite) --------------
+
+
+def test_qos_env_hbm_quota_above_chip_is_clamped():
+    """A grant above the chip's HBM is a scheduler accounting bug; the
+    LIMIT itself (not just the fraction) must stay physically
+    satisfiable."""
+    env = qos_env(
+        {}, hbm_limit_bytes=32 * 1024**3, chip_hbm_bytes=16 * 1024**3
+    )
+    assert env["ELASTIC_TPU_HBM_LIMIT_BYTES"] == str(16 * 1024**3)
+
+
+def test_qos_env_non_numeric_derived_values_dropped():
+    assert "ELASTIC_TPU_CORE_UNITS" not in qos_env({}, core_units="lots")
+    assert "ELASTIC_TPU_CORE_UNITS" not in qos_env({}, core_units=-5)
+    assert "ELASTIC_TPU_HBM_LIMIT_BYTES" not in qos_env(
+        {}, hbm_limit_bytes="many"
+    )
+
+
+def test_qos_env_core_units_annotation_caps_downward_only():
+    from elastic_tpu_agent.qos import AnnotationQoSCoreUnits
+
+    # a self-imposed cap below the grant is honored...
+    env = qos_env({AnnotationQoSCoreUnits: "30"}, core_units=50)
+    assert env["ELASTIC_TPU_CORE_UNITS"] == "30"
+    # ...but an annotation can never RAISE the quota above the grant
+    env = qos_env({AnnotationQoSCoreUnits: "80"}, core_units=50)
+    assert env["ELASTIC_TPU_CORE_UNITS"] == "50"
+    # malformed values are ignored, never passed through
+    for bad in ("0x20", "", "NaN", "-3", "0"):
+        env = qos_env({AnnotationQoSCoreUnits: bad}, core_units=50)
+        assert env["ELASTIC_TPU_CORE_UNITS"] == "50", bad
+
+
+def test_qos_env_hbm_annotation_clamped_to_grant_and_chip():
+    from elastic_tpu_agent.qos import AnnotationQoSHBMLimit
+
+    gib = 1024**3
+    env = qos_env(
+        {AnnotationQoSHBMLimit: str(4 * gib)},
+        hbm_limit_bytes=8 * gib, chip_hbm_bytes=16 * gib,
+    )
+    assert env["ELASTIC_TPU_HBM_LIMIT_BYTES"] == str(4 * gib)
+    # above the grant: the grant wins
+    env = qos_env(
+        {AnnotationQoSHBMLimit: str(12 * gib)},
+        hbm_limit_bytes=8 * gib, chip_hbm_bytes=16 * gib,
+    )
+    assert env["ELASTIC_TPU_HBM_LIMIT_BYTES"] == str(8 * gib)
+    # malformed: ignored; without a derived grant nothing is minted
+    env = qos_env({AnnotationQoSHBMLimit: "a-lot"},
+                  hbm_limit_bytes=8 * gib)
+    assert env["ELASTIC_TPU_HBM_LIMIT_BYTES"] == str(8 * gib)
+    assert "ELASTIC_TPU_HBM_LIMIT_BYTES" not in qos_env(
+        {AnnotationQoSHBMLimit: str(4 * gib)}
+    )
+
+
+def test_pod_priority_sources_and_default():
+    from elastic_tpu_agent.qos import pod_priority
+
+    assert pod_priority({AnnotationQoSPriority: "high"}) == "high"
+    assert pod_priority({AnnotationQoSPriority: " HIGH "}) == "high"
+    assert pod_priority({}) == "low"
+    assert pod_priority({AnnotationQoSPriority: "urgent"}) == "low"
+    pod = {"spec": {"priorityClassName": "high-priority-serving"}}
+    assert pod_priority({}, pod) == "high"
+    # a malformed annotation falls back to the priority class
+    assert pod_priority({AnnotationQoSPriority: "x"}, pod) == "high"
+
+
+def test_repartition_opt_in_parses_strictly():
+    from elastic_tpu_agent.common import AnnotationRepartition
+    from elastic_tpu_agent.qos import repartition_opt_in
+
+    for yes in ("true", "1", "yes", "enabled", " True "):
+        assert repartition_opt_in({AnnotationRepartition: yes}), yes
+    for no in ("false", "0", "", "maybe", "on-tuesdays"):
+        assert not repartition_opt_in({AnnotationRepartition: no}), no
+    assert not repartition_opt_in({})
+
+
 # -- unit: slice_env ----------------------------------------------------------
 
 

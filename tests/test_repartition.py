@@ -1,0 +1,1090 @@
+"""Dynamic fractional re-partitioning (repartition.py): policy, QoS
+precedence, throttle->evict escalation, crash replay.
+
+The acceptance bar (ISSUE 12): pods that opt in via
+``elasticgpu.io/repartition`` get live ELASTIC_TPU_CORE_UNITS/HBM quota
+renegotiation — grow from a co-located idle pod's slack, shrink back
+under pressure — restamped under the owner's bind stripe with
+QoS-class-aware precedence (high never donates to low); sustained
+overcommit escalates from alarm to throttle (quota clamp) and past a
+deadline to eviction through the reconciler's reclaimed_pod repair
+class; and every quota move is journaled BEFORE its restamps so a kill
+at any repartition failpoint converges with no pod left at a torn
+quota.
+
+`make crash-replay-smoke` runs this file alongside the bind/drain
+replay suites.
+"""
+
+import os
+import time
+
+import pytest
+
+from elastic_tpu_agent import faults
+from elastic_tpu_agent.common import (
+    AnnotationAssumed,
+    AnnotationRepartition,
+    BytesPerMemoryUnit,
+    EnvThrottle,
+    EnvThrottleDeadline,
+    ResourceTPUCore,
+    ResourceTPUMemory,
+    container_annotation,
+)
+from elastic_tpu_agent.manager import TPUManager
+from elastic_tpu_agent.plugins.tpushare import (
+    CORE_ENDPOINT,
+    MEM_ENDPOINT,
+    core_device_id,
+    mem_device_id,
+)
+from elastic_tpu_agent.qos import AnnotationQoSPriority
+from elastic_tpu_agent.sampler import build_diagnostics_bundle, validate_bundle
+from elastic_tpu_agent.workloads.telemetry import write_usage_report
+
+from test_e2e import Cluster, wait_until
+
+REPARTITION_FAILPOINTS = [
+    "repartition.pre_journal",
+    "repartition.post_journal",
+    "repartition.mid_restamp",
+]
+
+
+# -- harness ------------------------------------------------------------------
+
+
+def _make_cluster(tmp_path, name="rep"):
+    d = tmp_path / name
+    d.mkdir()
+    c = Cluster(d)
+    # Park every supervised loop whose work the tests drive manually.
+    c.manager.drain.period_s = 3600.0
+    c.manager.sampler.period_s = 3600.0
+    c.manager.repartition.period_s = 3600.0
+    c.start()
+    return c
+
+
+@pytest.fixture()
+def cluster(tmp_path):
+    c = _make_cluster(tmp_path)
+    yield c
+    c.stop()
+
+
+def _bind_pod(
+    c, pod_name, chip="0", n_units=50, opted=True, priority=None,
+    mem_units=0, annotations=None, uid=None,
+):
+    ann = {
+        AnnotationAssumed: "true",
+        container_annotation("jax"): chip,
+    }
+    if opted:
+        ann[AnnotationRepartition] = "true"
+    if priority is not None:
+        ann[AnnotationQoSPriority] = priority
+    ann.update(annotations or {})
+    from fake_apiserver import make_pod
+
+    pod = make_pod(
+        "default", pod_name, c.node, annotations=ann,
+        containers=[{"name": "jax"}],
+    )
+    if uid is not None:
+        pod["metadata"]["uid"] = uid
+    c.apiserver.upsert_pod(pod)
+    assert wait_until(
+        lambda: c.manager.sitter.get_pod("default", pod_name) is not None
+    )
+    chip_idx = int(chip.split(",")[0])
+    ids = [core_device_id(chip_idx, f"{pod_name}u{j}")
+           for j in range(n_units)]
+    c.kubelet.kubelet_allocate_flow(
+        CORE_ENDPOINT, "default", pod_name, "jax", ResourceTPUCore, ids
+    )
+    if mem_units:
+        mids = [mem_device_id(chip_idx, f"{pod_name}m{j}")
+                for j in range(mem_units)]
+        c.kubelet.kubelet_allocate_flow(
+            MEM_ENDPOINT, "default", pod_name, "jax",
+            ResourceTPUMemory, mids,
+        )
+    return ids
+
+
+def _core_hash(c, pod_name):
+    info = c.manager.storage.load("default", pod_name)
+    for by_resource in info.allocations.values():
+        rec = by_resource.get(ResourceTPUCore)
+        if rec is not None:
+            return rec.device.hash
+    raise AssertionError(f"no core record for {pod_name}")
+
+
+def _spec_envs(c, pod_name):
+    """hash -> env for EVERY spec file of the pod (torn-quota checks
+    need the per-file view, not just one)."""
+    info = c.manager.storage.load("default", pod_name)
+    if info is None:
+        return {}
+    core = c.manager.plugin.core
+    out = {}
+    for by_resource in info.allocations.values():
+        for rec in by_resource.values():
+            spec = core.read_alloc_spec(rec.device.hash)
+            if spec and spec.get("env"):
+                out[rec.device.hash] = dict(spec["env"])
+    return out
+
+
+def _units(c, pod_name):
+    """The pod's stamped ELASTIC_TPU_CORE_UNITS (asserting every spec
+    file agrees — a disagreement IS a torn quota)."""
+    envs = _spec_envs(c, pod_name)
+    assert envs, f"no specs for {pod_name}"
+    values = {env.get("ELASTIC_TPU_CORE_UNITS") for env in envs.values()}
+    assert len(values) == 1, f"torn quota for {pod_name}: {envs}"
+    return int(values.pop())
+
+
+def _report(c, pod_name, duty, now):
+    assert write_usage_report(
+        c.opts.alloc_spec_dir, _core_hash(c, pod_name), duty, ts=now
+    )
+
+
+def _step(c, now):
+    c.manager.sampler.sample_once(now=now)
+    return c.manager.repartition.tick(now=now)
+
+
+# -- grow / shrink ------------------------------------------------------------
+
+
+def test_grow_moves_slack_to_busy_borrower(cluster):
+    """A busy opted-in pod absorbs a co-located idle pod's slack: one
+    step of core units moves donor -> borrower, restamped in both
+    pods' alloc specs, counted and journaled."""
+    _bind_pod(cluster, "pod-a")
+    _bind_pod(cluster, "pod-b")
+    now = time.time()
+    _report(cluster, "pod-a", 5.0, now)
+    _report(cluster, "pod-b", 48.0, now)
+    result = _step(cluster, now)
+    assert result["grown"] == 1
+    assert _units(cluster, "pod-a") == 40
+    assert _units(cluster, "pod-b") == 60
+    status = cluster.manager.repartition.status()
+    assert status["edges"] == [{
+        "donor": "default/pod-a", "borrower": "default/pod-b",
+        "chip": 0, "core_units": 10, "hbm_bytes": 0,
+    }]
+    assert status["repartitions_total"]["grow"] == 1
+    # the journal is durable state, not memory
+    st = cluster.manager.storage.load_state("repartition")
+    assert st["edges"] == status["edges"]
+    # and the move is in the lifecycle timeline under BOTH pods — the
+    # donor's quota changed too, and its triage query must see why
+    for pod in ("default/pod-a", "default/pod-b"):
+        kinds = [
+            e["kind"]
+            for e in cluster.manager.timeline.events(pod=pod)
+        ]
+        assert "repartition" in kinds, pod
+
+
+def test_growth_is_stepwise_and_respects_donor_floor(cluster):
+    """Repeated hunger keeps growing one step per tick, but the donor
+    never drops below its keep floor. The borrower stays just inside
+    its (moving) quota — an honest hungry pod, not an overcommitter."""
+    _bind_pod(cluster, "pod-a")
+    _bind_pod(cluster, "pod-b")
+    now = time.time()
+    eff = 50
+    for i in range(8):
+        _report(cluster, "pod-a", 2.0, now + i)
+        _report(cluster, "pod-b", eff - 2.0, now + i)
+        result = _step(cluster, now + i)
+        if result["grown"]:
+            eff += 10
+    # donor keeps min_keep_units (10): 50 - 4 steps of 10 = 10
+    assert _units(cluster, "pod-a") == 10
+    assert _units(cluster, "pod-b") == 90
+
+
+def test_non_opted_pods_never_participate(cluster):
+    """Without the opt-in annotation neither side of the imbalance
+    moves — quota renegotiation must never surprise anyone."""
+    _bind_pod(cluster, "pod-a", opted=False)
+    _bind_pod(cluster, "pod-b", opted=False)
+    now = time.time()
+    _report(cluster, "pod-a", 5.0, now)
+    _report(cluster, "pod-b", 48.0, now)
+    result = _step(cluster, now)
+    assert result == {
+        "grown": 0, "shrunk": 0, "throttled": 0, "evicted": 0,
+    }
+    assert _units(cluster, "pod-a") == 50
+    assert _units(cluster, "pod-b") == 50
+
+
+def test_high_priority_never_donates_to_low(cluster):
+    """Donation precedence: an idle HIGH pod's slack never flows to a
+    busy LOW pod; the reverse direction is allowed."""
+    _bind_pod(cluster, "pod-hi", priority="high")
+    _bind_pod(cluster, "pod-lo", priority="low")
+    now = time.time()
+    _report(cluster, "pod-hi", 5.0, now)   # high idle
+    _report(cluster, "pod-lo", 48.0, now)  # low busy
+    assert _step(cluster, now)["grown"] == 0
+    assert _units(cluster, "pod-hi") == 50
+    # reversed: low idle donates UP to high busy
+    _report(cluster, "pod-hi", 48.0, now + 1)
+    _report(cluster, "pod-lo", 5.0, now + 1)
+    assert _step(cluster, now + 1)["grown"] == 1
+    assert _units(cluster, "pod-hi") == 60
+    assert _units(cluster, "pod-lo") == 40
+
+
+def test_shrink_back_under_donor_pressure(cluster):
+    """A donor whose usage climbs back reclaims its units: the edge
+    unwinds and both pods restamp to the base grant."""
+    _bind_pod(cluster, "pod-a")
+    _bind_pod(cluster, "pod-b")
+    now = time.time()
+    _report(cluster, "pod-a", 5.0, now)
+    _report(cluster, "pod-b", 48.0, now)
+    _step(cluster, now)
+    assert _units(cluster, "pod-a") == 40
+    # donor wakes up: 35 > 0.75 * 40
+    _report(cluster, "pod-a", 35.0, now + 1)
+    _report(cluster, "pod-b", 48.0, now + 1)
+    result = _step(cluster, now + 1)
+    assert result["shrunk"] == 1
+    assert _units(cluster, "pod-a") == 50
+    assert _units(cluster, "pod-b") == 50
+    assert cluster.manager.repartition.status()["edges"] == []
+
+
+def test_peer_leaving_unwinds_the_edge(cluster):
+    """A borrower whose record is reclaimed returns the donor's units
+    even though the borrower can no longer be restamped."""
+    _bind_pod(cluster, "pod-a")
+    _bind_pod(cluster, "pod-b")
+    now = time.time()
+    _report(cluster, "pod-a", 5.0, now)
+    _report(cluster, "pod-b", 48.0, now)
+    _step(cluster, now)
+    assert _units(cluster, "pod-a") == 40
+    # the borrower goes away (GC-style teardown via the reconciler)
+    cluster.apiserver.delete_pod("default", "pod-b")
+    assert wait_until(
+        lambda: cluster.manager.sitter.get_pod("default", "pod-b") is None
+    )
+    cluster.manager.plugin.gc_once()
+    assert cluster.manager.storage.load("default", "pod-b") is None
+    result = cluster.manager.repartition.tick(now=now + 1)
+    assert result["shrunk"] == 1
+    assert _units(cluster, "pod-a") == 50
+    assert cluster.manager.repartition.status()["edges"] == []
+
+
+def test_hbm_quota_rides_core_donation(cluster):
+    """When donor and borrower both hold HBM grants, the HBM quota
+    moves donor-ratio-proportionally with the core units and the
+    fraction env stays consistent."""
+    _bind_pod(cluster, "pod-a", mem_units=100)
+    _bind_pod(cluster, "pod-b", mem_units=100)
+    now = time.time()
+    _report(cluster, "pod-a", 5.0, now)
+    _report(cluster, "pod-b", 48.0, now)
+    _step(cluster, now)
+    envs_a = _spec_envs(cluster, "pod-a")
+    envs_b = _spec_envs(cluster, "pod-b")
+    # donor ratio: 100 MiB HBM / 50 units -> 10 units carry 20 MiB
+    moved = 20 * BytesPerMemoryUnit
+    for env in envs_a.values():
+        assert env["ELASTIC_TPU_HBM_LIMIT_BYTES"] == str(
+            100 * BytesPerMemoryUnit - moved
+        )
+    for env in envs_b.values():
+        assert env["ELASTIC_TPU_HBM_LIMIT_BYTES"] == str(
+            100 * BytesPerMemoryUnit + moved
+        )
+
+
+# -- sampler integration ------------------------------------------------------
+
+
+def test_self_reported_usage_beats_proportional_attribution(cluster):
+    """A fresh usage report IS the pod's attributed usage; the
+    remaining chip duty goes to the non-reporting co-tenant."""
+    _bind_pod(cluster, "pod-a")
+    _bind_pod(cluster, "pod-b")
+    now = time.time()
+    cluster.manager.operator.set_utilization({0: 80.0})
+    _report(cluster, "pod-a", 70.0, now)
+    cluster.manager.sampler.sample_once(now=now)
+    view = cluster.manager.sampler.utilization_view()
+    a = view["pods"]["default/pod-a"]
+    b = view["pods"]["default/pod-b"]
+    assert a["used_percent"] == 70.0
+    assert a["self_reported"] is True
+    # b gets the REMAINDER (80 - 70), not half of 80
+    assert b["used_percent"] == pytest.approx(10.0)
+
+
+def test_stale_usage_report_falls_back_to_proportional(cluster):
+    _bind_pod(cluster, "pod-a")
+    _bind_pod(cluster, "pod-b")
+    now = time.time()
+    cluster.manager.operator.set_utilization({0: 80.0})
+    _report(cluster, "pod-a", 70.0, now - 3600)  # stale
+    cluster.manager.sampler.sample_once(now=now)
+    view = cluster.manager.sampler.utilization_view()
+    # equal grants on one chip: proportional split, 40/40
+    assert view["pods"]["default/pod-a"]["used_percent"] == pytest.approx(40.0)
+    assert view["pods"]["default/pod-b"]["used_percent"] == pytest.approx(40.0)
+
+
+def test_non_opted_pods_usage_reports_are_untrusted(cluster):
+    """Self-reports feed enforcement, so only opted-in pods' files are
+    trusted: a non-participant under-reporting must NOT shift phantom
+    duty onto its co-tenant."""
+    _bind_pod(cluster, "pod-a")            # opted, honest, no report
+    _bind_pod(cluster, "pod-liar", opted=False)
+    now = time.time()
+    cluster.manager.operator.set_utilization({0: 90.0})
+    # the non-participant claims 5% while the chip burns 90%
+    _report(cluster, "pod-liar", 5.0, now)
+    cluster.manager.sampler.sample_once(now=now)
+    view = cluster.manager.sampler.utilization_view()
+    # untrusted report ignored: plain proportional split, 45/45 — the
+    # honest pod is NOT blamed for the remaining 85
+    assert view["pods"]["default/pod-a"]["used_percent"] == pytest.approx(45.0)
+    assert view["pods"]["default/pod-liar"]["used_percent"] == pytest.approx(45.0)
+    assert not view["pods"]["default/pod-liar"].get("self_reported")
+
+
+def test_reclaim_removes_usage_report_file(cluster):
+    """The self-report file dies with its allocation — pod churn must
+    not grow the usage dir without bound."""
+    _bind_pod(cluster, "pod-a")
+    now = time.time()
+    _report(cluster, "pod-a", 10.0, now)
+    h = _core_hash(cluster, "pod-a")
+    path = os.path.join(cluster.opts.alloc_spec_dir, "usage", f"{h}.json")
+    assert os.path.exists(path)
+    # a crash-leaked rename temp is reclaimed too
+    with open(path + ".tmp", "w") as f:
+        f.write("{}")
+    cluster.apiserver.delete_pod("default", "pod-a")
+    assert wait_until(
+        lambda: cluster.manager.sitter.get_pod("default", "pod-a") is None
+    )
+    cluster.manager.plugin.gc_once()
+    assert not os.path.exists(path)
+    assert not os.path.exists(path + ".tmp")
+
+
+def test_opting_out_lifts_a_standing_throttle(cluster):
+    """A throttled pod that removes the repartition annotation returns
+    to its static base grant with the clamp env removed — never stuck
+    throttled, never silently dodging into a later eviction."""
+    from fake_apiserver import make_pod
+
+    _bind_pod(cluster, "pod-a")
+    _bind_pod(cluster, "pod-b")
+    rep = cluster.manager.repartition
+    now = time.time()
+    for i in range(3):
+        _report(cluster, "pod-a", 5.0, now + i)
+        _report(cluster, "pod-b", 90.0, now + i)
+        _step(cluster, now + i)
+    assert "default/pod-b" in rep.status()["throttled_pods"]
+    # the pod opts out (annotation removed)
+    cluster.apiserver.upsert_pod(make_pod(
+        "default", "pod-b", cluster.node,
+        annotations={
+            AnnotationAssumed: "true",
+            container_annotation("jax"): "0",
+        },
+        containers=[{"name": "jax"}],
+    ))
+    assert wait_until(lambda: AnnotationRepartition not in (
+        cluster.manager.sitter.get_pod("default", "pod-b")
+        .get("metadata", {}).get("annotations", {})
+    ))
+    rep.tick(now=now + 4)
+    assert rep.status()["throttled_pods"] == {}
+    envs = _spec_envs(cluster, "pod-b")
+    for env in envs.values():
+        assert EnvThrottle not in env
+        assert env["ELASTIC_TPU_CORE_UNITS"] == "50"
+    # and it can never be evicted: later ticks skip non-participants
+    t = now + 1000
+    _report(cluster, "pod-a", 5.0, t)
+    _step(cluster, t)
+    assert cluster.manager.storage.load("default", "pod-b") is not None
+
+
+def test_attributed_only_usage_never_throttles(cluster):
+    """Enforcement needs measured evidence: a pod whose apparent
+    overcommit comes ONLY from remainder attribution (it never
+    self-reported) raises the alarm but is never clamped — an
+    under-reporting co-tenant cannot get an honest pod evicted."""
+    _bind_pod(cluster, "pod-a")
+    _bind_pod(cluster, "pod-b")
+    rep = cluster.manager.repartition
+    now = time.time()
+    cluster.manager.operator.set_utilization({0: 95.0})
+    for i in range(5):
+        # pod-a under-reports; pod-b gets the phantom remainder (~90)
+        _report(cluster, "pod-a", 5.0, now + i)
+        result = _step(cluster, now + i)
+    view = cluster.manager.sampler.utilization_view()
+    assert view["pods"]["default/pod-b"]["used_percent"] > 60
+    assert result["throttled"] == 0
+    assert rep.status()["throttled_pods"] == {}
+
+
+def test_future_timestamped_report_is_ignored(cluster):
+    """A report stamped from the future must not stay 'fresh' forever
+    and defeat the TTL fallback."""
+    _bind_pod(cluster, "pod-a")
+    _bind_pod(cluster, "pod-b")
+    now = time.time()
+    cluster.manager.operator.set_utilization({0: 80.0})
+    _report(cluster, "pod-a", 5.0, now + 3600)  # skewed clock
+    cluster.manager.sampler.sample_once(now=now)
+    view = cluster.manager.sampler.utilization_view()
+    assert not view["pods"]["default/pod-a"].get("self_reported")
+    assert view["pods"]["default/pod-a"]["used_percent"] == pytest.approx(40.0)
+
+
+def test_evicted_suppression_is_uid_pinned(cluster):
+    """A pod deleted and re-created under the same name BETWEEN ticks
+    (the sitter never shows it gone) must not inherit the predecessor's
+    replay suppression."""
+    _bind_pod(cluster, "pod-a")
+    _bind_pod(cluster, "pod-b", uid="uid-old")
+    rep = cluster.manager.repartition
+    rep.evict_after_s = 2.0
+    now = time.time()
+    for i in range(3):
+        _report(cluster, "pod-a", 5.0, now + i)
+        _report(cluster, "pod-b", 90.0, now + i)
+        _step(cluster, now + i)
+    t = now + 10
+    _report(cluster, "pod-a", 5.0, t)
+    _report(cluster, "pod-b", 90.0, t)
+    assert _step(cluster, t)["evicted"] == 1
+    assert rep.replay_suppressed("default/pod-b")
+    # re-created atomically under the same name with a NEW uid; the
+    # sitter only ever sees the replacement
+    _bind_pod(cluster, "pod-b", uid="uid-new")
+    assert wait_until(lambda: (
+        cluster.manager.sitter.get_pod("default", "pod-b")
+        .get("metadata", {}).get("uid") == "uid-new"
+    ))
+    rep.tick(now=t + 1)
+    assert not rep.replay_suppressed("default/pod-b")
+
+
+def test_ceasing_reports_is_not_a_throttle_escape(cluster):
+    """A throttled pod that goes silent keeps its clamp (no positive
+    evidence of compliance) and is still evicted at the deadline —
+    deleting the usage file is not an escape hatch."""
+    _bind_pod(cluster, "pod-a")
+    _bind_pod(cluster, "pod-b")
+    rep = cluster.manager.repartition
+    rep.evict_after_s = 5.0
+    now = time.time()
+    for i in range(3):
+        _report(cluster, "pod-a", 5.0, now + i)
+        _report(cluster, "pod-b", 90.0, now + i)
+        _step(cluster, now + i)
+    assert "default/pod-b" in rep.status()["throttled_pods"]
+    # pod-b stops reporting; its file goes stale past the TTL
+    t = now + 3
+    _report(cluster, "pod-a", 5.0, t)
+    cluster.manager.sampler.usage_report_ttl_s = 0.5
+    result = _step(cluster, t)
+    assert result["throttled"] == 0 and result["evicted"] == 0
+    assert "default/pod-b" in rep.status()["throttled_pods"]  # armed
+    # ...and silence at the deadline still evicts
+    t2 = now + 10
+    _report(cluster, "pod-a", 5.0, t2)
+    result = _step(cluster, t2)
+    assert result["evicted"] == 1
+    assert cluster.manager.storage.load("default", "pod-b") is None
+
+
+def test_storage_blip_never_unwinds_the_ledger(cluster):
+    """A transient StorageError must read as UNKNOWABLE, not as 'every
+    peer departed': edges, throttles and the stamped quotas all
+    survive the blip untouched."""
+    from elastic_tpu_agent.storage.store import StorageError
+
+    _bind_pod(cluster, "pod-a")
+    _bind_pod(cluster, "pod-b")
+    rep = cluster.manager.repartition
+    now = time.time()
+    _report(cluster, "pod-a", 5.0, now)
+    _report(cluster, "pod-b", 48.0, now)
+    _step(cluster, now)
+    assert len(rep.status()["edges"]) == 1
+    storage = cluster.manager.storage
+    real_load = storage.load
+
+    def broken_load(*a, **k):
+        raise StorageError("injected blip")
+
+    storage.load = broken_load
+    try:
+        result = rep.tick(now=now + 1)
+    finally:
+        storage.load = real_load
+    assert result["shrunk"] == 0
+    assert len(rep.status()["edges"]) == 1  # ledger intact
+    # and the quotas on disk still match the ledger after recovery
+    # (pod-b at 40/60: neither hungry nor idle, so nothing moves)
+    _report(cluster, "pod-a", 5.0, now + 2)
+    _report(cluster, "pod-b", 40.0, now + 2)
+    _step(cluster, now + 2)
+    assert _units(cluster, "pod-a") == 40
+    assert _units(cluster, "pod-b") == 60
+
+
+def test_report_trust_gate_armed_without_repartition(tmp_path):
+    """Alarm-only mode (--no-repartition) still refuses usage files
+    from non-participants — the attribution skew needs no controller
+    to do damage."""
+    d = tmp_path / "noctl"
+    d.mkdir()
+    c = Cluster(d)
+    # rebuild the manager with the controller OFF (the flag must be set
+    # before construction; the discarded first manager never started)
+    c.manager.storage.close()
+    c.opts.enable_repartition = False
+    c.manager = TPUManager(c.opts)
+    try:
+        assert c.manager.repartition is None
+        c.manager.sampler.period_s = 3600.0
+        c.manager.drain.period_s = 3600.0
+        c.start()
+        assert c.manager.sampler.usage_report_allowed_fn is not None
+        _bind_pod(c, "pod-a")
+        _bind_pod(c, "pod-liar", opted=False)
+        now = time.time()
+        c.manager.operator.set_utilization({0: 90.0})
+        _report(c, "pod-liar", 5.0, now)
+        c.manager.sampler.sample_once(now=now)
+        view = c.manager.sampler.utilization_view()
+        assert not view["pods"]["default/pod-liar"].get("self_reported")
+        assert view["pods"]["default/pod-a"]["used_percent"] == (
+            pytest.approx(45.0)
+        )
+    finally:
+        c.stop()
+
+
+def test_opting_out_unwinds_borrowed_and_lent_quota(cluster):
+    """Opting out ends participation on BOTH sides: a pod that leaves
+    the pool returns what it borrowed (no enforcement-exempt pod keeps
+    grown quota) and gets back what it lent."""
+    from fake_apiserver import make_pod
+
+    _bind_pod(cluster, "pod-a")
+    _bind_pod(cluster, "pod-b")
+    now = time.time()
+    _report(cluster, "pod-a", 5.0, now)
+    _report(cluster, "pod-b", 48.0, now)
+    _step(cluster, now)
+    assert _units(cluster, "pod-b") == 60  # b borrowed 10 from a
+    # the BORROWER opts out while still busy
+    cluster.apiserver.upsert_pod(make_pod(
+        "default", "pod-b", cluster.node,
+        annotations={
+            AnnotationAssumed: "true",
+            container_annotation("jax"): "0",
+        },
+        containers=[{"name": "jax"}],
+    ))
+    assert wait_until(lambda: AnnotationRepartition not in (
+        cluster.manager.sitter.get_pod("default", "pod-b")
+        .get("metadata", {}).get("annotations", {})
+    ))
+    _report(cluster, "pod-a", 5.0, now + 1)
+    _report(cluster, "pod-b", 58.0, now + 1)
+    result = _step(cluster, now + 1)
+    assert result["shrunk"] == 1
+    assert cluster.manager.repartition.status()["edges"] == []
+    assert _units(cluster, "pod-a") == 50
+    assert _units(cluster, "pod-b") == 50
+
+
+def test_growth_stops_at_the_borrower_self_cap(cluster):
+    """A borrower's clamp-only-downward qos-core-units cap bounds the
+    LEDGER too: donated units its stamped env can never expose must
+    not be stranded on it."""
+    from elastic_tpu_agent.qos import AnnotationQoSCoreUnits
+
+    _bind_pod(cluster, "pod-a")
+    _bind_pod(
+        cluster, "pod-b",
+        annotations={AnnotationQoSCoreUnits: "50"},
+    )
+    now = time.time()
+    for i in range(4):
+        _report(cluster, "pod-a", 5.0, now + i)
+        _report(cluster, "pod-b", 48.0, now + i)
+        _step(cluster, now + i)
+    # the cap equals the base grant: no growth is ever usable, so no
+    # units move at all and the donor keeps its full grant
+    assert cluster.manager.repartition.status()["edges"] == []
+    assert _units(cluster, "pod-a") == 50
+    assert _units(cluster, "pod-b") == 50
+
+
+def test_frozen_sampler_view_never_escalates(cluster):
+    """Enforcement needs a view that ADVANCED: re-judging one frozen
+    sample across ticks must not accrue the throttle streak (a crashed
+    or slow sampler would otherwise let one measurement evict)."""
+    _bind_pod(cluster, "pod-a")
+    _bind_pod(cluster, "pod-b")
+    rep = cluster.manager.repartition
+    now = time.time()
+    _report(cluster, "pod-a", 5.0, now)
+    _report(cluster, "pod-b", 90.0, now)
+    cluster.manager.sampler.sample_once(now=now)
+    # the sampler stalls: the same view is re-read on every tick
+    for i in range(5):
+        rep.tick(now=now + 1 + i)
+    assert rep.status()["throttled_pods"] == {}
+    # once sampling resumes, the streak counts fresh evidence again
+    for i in range(3):
+        _report(cluster, "pod-a", 5.0, now + 10 + i)
+        _report(cluster, "pod-b", 90.0, now + 10 + i)
+        _step(cluster, now + 10 + i)
+    assert "default/pod-b" in rep.status()["throttled_pods"]
+
+
+def test_overcommit_alarm_judges_the_effective_grant(cluster):
+    """A grown borrower using its grown quota is NOT an overcommit: the
+    sampler's detector reads the controller's delta through
+    grant_adjust_fn."""
+    _bind_pod(cluster, "pod-a")
+    _bind_pod(cluster, "pod-b")
+    now = time.time()
+    _report(cluster, "pod-a", 5.0, now)
+    _report(cluster, "pod-b", 48.0, now)
+    _step(cluster, now)
+    assert _units(cluster, "pod-b") == 60
+    sampler = cluster.manager.sampler
+    # b uses 58% of a 50% base grant — over base, within effective
+    for i in range(1, 6):
+        _report(cluster, "pod-b", 58.0, now + i)
+        _report(cluster, "pod-a", 5.0, now + i)
+        sampler.sample_once(now=now + i)
+    view = sampler.utilization_view()
+    assert view["pods"]["default/pod-b"]["overcommit"] is False
+
+
+# -- throttle -> evict escalation ---------------------------------------------
+
+
+def test_sustained_overcommit_throttles_then_lifts(cluster):
+    """Three consecutive over-quota ticks clamp the quota back to the
+    base grant and stamp the throttle env; returning within quota
+    lifts it."""
+    _bind_pod(cluster, "pod-a")
+    _bind_pod(cluster, "pod-b")
+    rep = cluster.manager.repartition
+    now = time.time()
+    # first let b grow once, so the throttle visibly revokes the growth
+    _report(cluster, "pod-a", 5.0, now)
+    _report(cluster, "pod-b", 48.0, now)
+    _step(cluster, now)
+    assert _units(cluster, "pod-b") == 60
+    for i in range(1, 4):
+        _report(cluster, "pod-a", 5.0, now + i)
+        _report(cluster, "pod-b", 90.0, now + i)  # way over 60 + margin
+        result = _step(cluster, now + i)
+    assert result["throttled"] == 1
+    envs = _spec_envs(cluster, "pod-b")
+    for env in envs.values():
+        assert env[EnvThrottle] == "overcommit"
+        assert int(env[EnvThrottleDeadline]) > now
+    assert _units(cluster, "pod-b") == 50  # clamped to base, growth gone
+    assert rep.status()["throttles_total"] == 1
+    assert "default/pod-b" in rep.status()["throttled_pods"]
+    # compliance lifts the clamp
+    _report(cluster, "pod-a", 5.0, now + 10)
+    _report(cluster, "pod-b", 30.0, now + 10)
+    _step(cluster, now + 10)
+    envs = _spec_envs(cluster, "pod-b")
+    for env in envs.values():
+        assert EnvThrottle not in env
+        assert EnvThrottleDeadline not in env
+    assert rep.status()["throttled_pods"] == {}
+    # the escalation is a causal story in the timeline
+    actions = [
+        e["attrs"].get("action")
+        for e in cluster.manager.timeline.events(pod="default/pod-b")
+        if e["kind"] == "throttle"
+    ]
+    assert actions == ["throttle", "unthrottle"]
+
+
+def test_throttle_deadline_evicts_and_suppresses_replay(cluster):
+    """Still over quota at the deadline: bindings reclaimed through the
+    reconciler's reclaimed_pod class, and kubelet's still-listed
+    assignment is NOT replayed back while the pod exists."""
+    _bind_pod(cluster, "pod-a")
+    _bind_pod(cluster, "pod-b")
+    rep = cluster.manager.repartition
+    rep.evict_after_s = 5.0
+    now = time.time()
+    for i in range(3):
+        _report(cluster, "pod-a", 5.0, now + i)
+        _report(cluster, "pod-b", 90.0, now + i)
+        _step(cluster, now + i)
+    assert "default/pod-b" in rep.status()["throttled_pods"]
+    # past the deadline, still hot
+    t = now + 10
+    _report(cluster, "pod-a", 5.0, t)
+    _report(cluster, "pod-b", 90.0, t)
+    result = _step(cluster, t)
+    assert result["evicted"] == 1
+    assert cluster.manager.storage.load("default", "pod-b") is None
+    assert rep.replay_suppressed("default/pod-b")
+    assert rep.status()["evictions_total"] == 1
+    # two reconcile passes (confirmation window) must not re-bind it
+    cluster.manager.reconciler.reconcile_once()
+    report = cluster.manager.reconciler.reconcile_once()
+    assert report["replayed_binds"] == 0
+    assert cluster.manager.storage.load("default", "pod-b") is None
+    # once the pod is actually gone, the suppression sweeps away
+    cluster.apiserver.delete_pod("default", "pod-b")
+    assert wait_until(
+        lambda: cluster.manager.sitter.get_pod("default", "pod-b") is None
+    )
+    rep.tick(now=t + 1)
+    assert not rep.replay_suppressed("default/pod-b")
+
+
+def test_recreated_pod_does_not_inherit_stale_throttle(cluster):
+    """A pod deleted while throttled takes its throttle (and expired
+    deadline) with it — a new pod under the same name starts clean and
+    gets the full streak + grace, never an instant eviction."""
+    _bind_pod(cluster, "pod-a")
+    _bind_pod(cluster, "pod-b")
+    rep = cluster.manager.repartition
+    rep.evict_after_s = 5.0
+    now = time.time()
+    for i in range(3):
+        _report(cluster, "pod-a", 5.0, now + i)
+        _report(cluster, "pod-b", 90.0, now + i)
+        _step(cluster, now + i)
+    assert "default/pod-b" in rep.status()["throttled_pods"]
+    # the offender is deleted well before its deadline
+    cluster.apiserver.delete_pod("default", "pod-b")
+    assert wait_until(
+        lambda: cluster.manager.sitter.get_pod("default", "pod-b") is None
+    )
+    cluster.manager.plugin.gc_once()
+    rep.tick(now=now + 4)
+    assert rep.status()["throttled_pods"] == {}
+    # a NEW pod under the same name binds, way past the old deadline;
+    # its first over-quota tick must NOT evict (fresh streak + grace)
+    t = now + 100
+    _bind_pod(cluster, "pod-b")
+    _report(cluster, "pod-a", 5.0, t)
+    _report(cluster, "pod-b", 90.0, t)
+    result = _step(cluster, t)
+    assert result["evicted"] == 0
+    assert cluster.manager.storage.load("default", "pod-b") is not None
+    envs = _spec_envs(cluster, "pod-b")
+    for env in envs.values():
+        assert EnvThrottle not in env
+
+
+def test_kill_between_evict_journal_and_reclaim_keeps_suppression(
+    tmp_path,
+):
+    """A crash between journaling the evicted set and the binding
+    teardown must leave replay suppression ARMED on restart — the boot
+    reconcile must not re-bind what enforcement was mid-removing."""
+    c = _make_cluster(tmp_path, name="evcrash")
+    try:
+        _bind_pod(c, "pod-a")
+        _bind_pod(c, "pod-b")
+        rep = c.manager.repartition
+        rep.evict_after_s = 2.0
+        now = time.time()
+        for i in range(3):
+            _report(c, "pod-a", 5.0, now + i)
+            _report(c, "pod-b", 90.0, now + i)
+            _step(c, now + i)
+        assert "default/pod-b" in rep.status()["throttled_pods"]
+        t = now + 10
+        _report(c, "pod-a", 5.0, t)
+        _report(c, "pod-b", 90.0, t)
+        c.manager.sampler.sample_once(now=t)
+        with faults.armed("repartition.pre_evict_reclaim",
+                          "die-thread:1"):
+            with pytest.raises(faults.DieThread):
+                rep.tick(now=t)
+        # died before the reclaim: record still present, journal armed
+        assert c.manager.storage.load("default", "pod-b") is not None
+
+        c.manager.stop()
+        mgr2 = TPUManager(c.opts)
+        mgr2.drain.period_s = 3600.0
+        mgr2.sampler.period_s = 3600.0
+        mgr2.repartition.period_s = 3600.0
+        mgr2.run(block=False)
+        c.manager = mgr2
+        assert mgr2.repartition.replay_suppressed("default/pod-b")
+        # re-runs of the reconciler never resurrect; the escalation
+        # path converges the half-done eviction on later ticks
+        mgr2.reconciler.reconcile_once()
+        report = mgr2.reconciler.reconcile_once()
+        assert report["replayed_binds"] == 0
+    finally:
+        c.stop()
+
+
+def test_restamp_respects_annotation_self_cap(cluster):
+    """A pod's clamp-only-downward qos-core-units cap binds restamps
+    too: donating slack must never stamp the donor's quota above the
+    ceiling it declared at bind time."""
+    from elastic_tpu_agent.qos import AnnotationQoSCoreUnits
+
+    _bind_pod(
+        cluster, "pod-a",
+        annotations={AnnotationQoSCoreUnits: "30"},
+    )
+    _bind_pod(cluster, "pod-b")
+    assert _units(cluster, "pod-a") == 30  # bind-time cap applied
+    now = time.time()
+    _report(cluster, "pod-a", 5.0, now)
+    _report(cluster, "pod-b", 48.0, now)
+    _step(cluster, now)
+    # the ledger moved 10 grant units; the stamped env stays capped
+    assert _units(cluster, "pod-a") == 30
+    assert _units(cluster, "pod-b") == 60
+
+
+# -- restart durability / crash replay ----------------------------------------
+
+
+def test_quota_state_survives_agent_restart(cluster, tmp_path):
+    """A restarted agent resumes the journaled ledger: deltas restamped
+    (healing any manual/torn drift), throttle deadlines preserved."""
+    _bind_pod(cluster, "pod-a")
+    _bind_pod(cluster, "pod-b")
+    now = time.time()
+    _report(cluster, "pod-a", 5.0, now)
+    _report(cluster, "pod-b", 48.0, now)
+    _step(cluster, now)
+    assert _units(cluster, "pod-b") == 60
+    # simulate torn state: hand-wreck the borrower's stamped quota
+    core = cluster.manager.plugin.core
+    h = _core_hash(cluster, "pod-b")
+    spec = core.read_alloc_spec(h)
+    spec["env"]["ELASTIC_TPU_CORE_UNITS"] = "55"
+    import json
+
+    path = os.path.join(cluster.opts.alloc_spec_dir, f"{h}.json")
+    with open(path, "w") as f:
+        json.dump(spec, f)
+
+    cluster.manager.stop()
+    mgr2 = TPUManager(cluster.opts)
+    mgr2.drain.period_s = 3600.0
+    mgr2.sampler.period_s = 3600.0
+    mgr2.repartition.period_s = 3600.0
+    mgr2.run(block=False)
+    cluster.manager = mgr2
+    assert mgr2.repartition.status()["edges"] == [{
+        "donor": "default/pod-a", "borrower": "default/pod-b",
+        "chip": 0, "core_units": 10, "hbm_bytes": 0,
+    }]
+    assert _units(cluster, "pod-a") == 40
+    assert _units(cluster, "pod-b") == 60  # resume healed the 55
+
+
+def test_throttle_deadline_survives_agent_restart(cluster, tmp_path):
+    """A restarted agent resumes the journaled throttle — env re-stamped,
+    deadline INTACT (not re-armed) — and still evicts at the original
+    deadline if the pod stays over quota."""
+    _bind_pod(cluster, "pod-a")
+    _bind_pod(cluster, "pod-b")
+    cluster.manager.repartition.evict_after_s = 60.0
+    now = time.time()
+    for i in range(3):
+        _report(cluster, "pod-a", 5.0, now + i)
+        _report(cluster, "pod-b", 90.0, now + i)
+        _step(cluster, now + i)
+    st = cluster.manager.repartition.status()
+    deadline = st["throttled_pods"]["default/pod-b"]["deadline_ts"]
+
+    cluster.manager.stop()
+    mgr2 = TPUManager(cluster.opts)
+    mgr2.drain.period_s = 3600.0
+    mgr2.sampler.period_s = 3600.0
+    mgr2.repartition.period_s = 3600.0
+    mgr2.repartition.evict_after_s = 60.0
+    mgr2.run(block=False)
+    cluster.manager = mgr2
+    resumed = mgr2.repartition.status()["throttled_pods"]
+    assert resumed["default/pod-b"]["deadline_ts"] == deadline
+    envs = _spec_envs(cluster, "pod-b")
+    for env in envs.values():
+        assert env[EnvThrottleDeadline] == str(int(deadline))
+    # still hot past the ORIGINAL deadline: the resumed agent evicts
+    t = deadline + 1
+    _report(cluster, "pod-a", 5.0, t)
+    _report(cluster, "pod-b", 90.0, t)
+    result = _step(cluster, t)
+    assert result["evicted"] == 1
+    assert mgr2.storage.load("default", "pod-b") is None
+    assert mgr2.repartition.replay_suppressed("default/pod-b")
+
+
+@pytest.mark.parametrize("failpoint", REPARTITION_FAILPOINTS)
+def test_kill_at_every_repartition_failpoint_converges(
+    tmp_path, failpoint
+):
+    """Crash replay: die at each repartition failpoint mid-move,
+    restart the manager over the surviving db, and every pod's specs
+    must agree with the journaled ledger — no torn quotas."""
+    c = _make_cluster(
+        tmp_path, name=f"fp{REPARTITION_FAILPOINTS.index(failpoint)}"
+    )
+    try:
+        _bind_pod(c, "pod-a")
+        _bind_pod(c, "pod-b")
+        now = time.time()
+        _report(c, "pod-a", 5.0, now)
+        _report(c, "pod-b", 48.0, now)
+        c.manager.sampler.sample_once(now=now)
+        with faults.armed(failpoint, "die-thread:1"):
+            with pytest.raises(faults.DieThread):
+                c.manager.repartition.tick(now=now)
+
+        c.manager.stop()
+        mgr2 = TPUManager(c.opts)
+        mgr2.drain.period_s = 3600.0
+        mgr2.sampler.period_s = 3600.0
+        mgr2.repartition.period_s = 3600.0
+        mgr2.run(block=False)
+        c.manager = mgr2
+        # the journal is the truth; the specs must match it exactly
+        edges = mgr2.repartition.status()["edges"]
+        if failpoint == "repartition.pre_journal":
+            assert edges == []
+            expect_a, expect_b = 50, 50
+        else:
+            assert edges and edges[0]["core_units"] == 10
+            expect_a, expect_b = 40, 60
+        # _units asserts every spec file of a pod agrees (not torn)
+        assert _units(c, "pod-a") == expect_a
+        assert _units(c, "pod-b") == expect_b
+    finally:
+        c.stop()
+
+
+def test_kill_between_sibling_spec_files_heals_torn_quota(tmp_path):
+    """The nastiest window: death BETWEEN one container's two spec
+    files (core + memory) leaves the quota visibly torn on disk;
+    resume() converges both files onto the journaled value.
+
+    Setup: after a grow, the donor leaves; the unwind tick restamps
+    only the borrower (the dead donor has no specs), and the armed
+    failpoint kills the restamp after the borrower's FIRST file."""
+    c = _make_cluster(tmp_path, name="torn")
+    try:
+        _bind_pod(c, "pod-a")
+        _bind_pod(c, "pod-b", mem_units=100)
+        now = time.time()
+        _report(c, "pod-a", 5.0, now)
+        _report(c, "pod-b", 48.0, now)
+        _step(c, now)
+        assert _units(c, "pod-b") == 60
+        # the donor leaves the node; its edge must unwind
+        c.apiserver.delete_pod("default", "pod-a")
+        assert wait_until(
+            lambda: c.manager.sitter.get_pod("default", "pod-a") is None
+        )
+        c.manager.plugin.gc_once()
+        assert c.manager.storage.load("default", "pod-a") is None
+        # the unwind tick's only restamp target is pod-b (two files);
+        # die after the first file lands -> units visibly torn on disk
+        with faults.armed("restamp.spec_file", "die-thread:1"):
+            with pytest.raises(faults.DieThread):
+                c.manager.repartition.tick(now=now + 1)
+        envs = _spec_envs(c, "pod-b")
+        torn = {
+            env.get("ELASTIC_TPU_CORE_UNITS") for env in envs.values()
+        }
+        assert torn == {"50", "60"}, f"expected a torn quota, got {envs}"
+
+        c.manager.stop()
+        mgr2 = TPUManager(c.opts)
+        mgr2.drain.period_s = 3600.0
+        mgr2.sampler.period_s = 3600.0
+        mgr2.repartition.period_s = 3600.0
+        mgr2.run(block=False)
+        c.manager = mgr2
+        assert mgr2.repartition.status()["edges"] == []
+        assert _units(c, "pod-b") == 50  # healed, both files agree
+    finally:
+        c.stop()
+
+
+# -- observability surfaces ---------------------------------------------------
+
+
+def test_status_rides_debug_allocations_and_doctor_bundle(cluster):
+    _bind_pod(cluster, "pod-a")
+    _bind_pod(cluster, "pod-b")
+    now = time.time()
+    _report(cluster, "pod-a", 5.0, now)
+    _report(cluster, "pod-b", 48.0, now)
+    _step(cluster, now)
+    snap = cluster.manager.sampler.allocations_snapshot()
+    assert snap["repartition"]["edges"][0]["core_units"] == 10
+    assert snap["repartition"]["enabled"] is True
+    bundle = build_diagnostics_bundle(
+        cluster.manager.operator, sampler=cluster.manager.sampler,
+        node_name=cluster.node, storage=cluster.manager.storage,
+    )
+    assert validate_bundle(bundle) == []
+    assert (
+        bundle["allocations"]["repartition"]["repartitions_total"]["grow"]
+        == 1
+    )
+
+
+def test_malformed_repartition_block_fails_bundle_validation(cluster):
+    bundle = build_diagnostics_bundle(
+        cluster.manager.operator, sampler=cluster.manager.sampler,
+        node_name=cluster.node, storage=cluster.manager.storage,
+    )
+    bundle["allocations"]["repartition"] = {"edges": "nope"}
+    problems = validate_bundle(bundle)
+    assert any("repartition" in p for p in problems)
+
+
+def test_supervised_loop_registered_degraded(cluster):
+    healthz = cluster.manager.supervisor.healthz()
+    assert "repartition" in healthz["subsystems"]
+    assert (
+        healthz["subsystems"]["repartition"]["criticality"] == "degraded"
+    )
