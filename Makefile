@@ -5,7 +5,7 @@ TAG ?= elastic-tpu-agent:latest
 # verify's tier-1 line uses pipefail, which /bin/sh (dash) lacks
 SHELL := /bin/bash
 
-.PHONY: all native sanitize test test-all verify doctor-smoke chaos-smoke bench-smoke crash-replay-smoke fleet-smoke slice-smoke drain-smoke timeline-smoke serving-smoke qos-smoke protos image bench clean
+.PHONY: all native sanitize test test-all verify doctor-smoke chaos-smoke bench-smoke crash-replay-smoke fleet-smoke scale-smoke slice-smoke drain-smoke timeline-smoke serving-smoke qos-smoke protos image bench clean
 
 all: native test
 
@@ -93,6 +93,20 @@ crash-replay-smoke:
 fleet-smoke:
 	JAX_PLATFORMS=cpu python3 bench.py --fleet-smoke
 
+# scale smoke: the thousand-pod scale-harness gate (bench.py
+# --scale-smoke): 8 in-process agents x 64 pods driven through the full
+# scenario (admission waves, delete churn, drain wave, slice reform,
+# repartition ticks, 10k-series cardinality storm) in BOTH storage
+# shapes — group-commit batching + coalesced sinks, and the historical
+# per-write baseline. Structural assertions only: every bind lands,
+# every node reconcile-converges, kubelet/apiserver/sink amplification
+# within bound, RSS growth per driven series under the documented
+# ceiling, batching measurably reduces storage commits per bind, and
+# the kill-at-a-mid-bind-failpoint crash drill replays clean with
+# batching ON and OFF.
+scale-smoke:
+	JAX_PLATFORMS=cpu python3 bench.py --scale-smoke
+
 # slice smoke: the slice-orchestrator chaos gate (bench.py
 # --slice-smoke): a 4-agent multi-host slice forms against the shared
 # fake apiserver (consistent TPU_WORKER_ID/HOSTNAMES env on every
@@ -156,7 +170,7 @@ qos-smoke:
 	JAX_PLATFORMS=cpu python3 bench.py --qos-smoke
 
 T1_TIMEOUT ?= 870
-verify: doctor-smoke chaos-smoke bench-smoke crash-replay-smoke fleet-smoke slice-smoke drain-smoke timeline-smoke serving-smoke qos-smoke
+verify: doctor-smoke chaos-smoke bench-smoke crash-replay-smoke fleet-smoke scale-smoke slice-smoke drain-smoke timeline-smoke serving-smoke qos-smoke
 	python -c "from prometheus_client import CollectorRegistry; \
 	  from elastic_tpu_agent.metrics import AgentMetrics; \
 	  AgentMetrics(registry=CollectorRegistry()); \

@@ -763,6 +763,261 @@ def fleet_smoke_main():
     return 0
 
 
+# -- scale: thousand-pod fleet load generation (ISSUE 13 / ROADMAP item 1) ----
+#
+# The scale harness (elastic_tpu_agent/sim/scale.py) composes 16-32
+# complete agents against one shared fake apiserver and churns thousands
+# of pods through deterministic scenario phases (admission waves, delete
+# churn, a drain wave, a slice reform, repartition ticks, a 10k-series
+# cardinality storm), reporting fleet bind p50/p99, reconcile
+# convergence, kubelet/apiserver/sink/storage request amplification per
+# bind, and peak process RSS. Two same-run passes — group-commit storage
+# batching + coalesced sinks ON, then the historical per-write shape —
+# make the write-amplification reduction a measurement, not a claim.
+
+SCALE_NODES = 16
+SCALE_PODS_PER_NODE = 125          # 16 x 125 = 2000 pods
+SCALE_STORAGE_WINDOW_S = 0.005     # --storage-batch-window for the leg
+SCALE_SINK_WINDOW_S = 0.02         # sink flush window for the leg
+SCALE_CARDINALITY_SERIES = 10_500  # the documented 10k+ ceiling claim
+
+
+def run_scale_once(
+    nodes,
+    pods_per_node,
+    batched,
+    cardinality_series_total=SCALE_CARDINALITY_SERIES,
+    convergence_timeout_s=120.0,
+    phase_timeout_s=120.0,
+):
+    from elastic_tpu_agent.sim import ScaleHarness
+
+    with tempfile.TemporaryDirectory(prefix="etpu-scale") as tmp:
+        harness = ScaleHarness(
+            tmp,
+            nodes=nodes,
+            pods_per_node=pods_per_node,
+            storage_batch_window_s=(
+                SCALE_STORAGE_WINDOW_S if batched else 0.0
+            ),
+            sink_flush_window_s=SCALE_SINK_WINDOW_S if batched else 0.0,
+            cardinality_series_total=cardinality_series_total,
+            reconcile_period_s=2.0,
+            convergence_timeout_s=convergence_timeout_s,
+            phase_timeout_s=phase_timeout_s,
+        )
+        return harness.run()
+
+
+def _scale_reduction(batched, unbatched):
+    """Measured write-amplification comparison between the same-run
+    batched and unbatched passes (per-bind ratios, so the two passes
+    normalize even if their absolute bind counts differ)."""
+    out = {}
+    for label, path in (
+        ("storage_commits_per_bind",
+         ("amplification", "storage_commits_per_bind")),
+        ("sink_writes_per_bind_events",
+         ("amplification", "sink_writes_per_bind", "events")),
+        ("sink_writes_per_bind_crd",
+         ("amplification", "sink_writes_per_bind", "crd")),
+        ("apiserver_requests_per_bind",
+         ("amplification", "apiserver_requests_per_bind")),
+    ):
+        b = batched
+        u = unbatched
+        for key in path:
+            b = (b or {}).get(key)
+            u = (u or {}).get(key)
+        out[label] = {
+            "batched": b,
+            "unbatched": u,
+            "reduction_x": (
+                round(u / b, 3) if b and u else None
+            ),
+        }
+    return out
+
+
+def run_scale(
+    nodes=SCALE_NODES,
+    pods_per_node=SCALE_PODS_PER_NODE,
+    cardinality_series_total=SCALE_CARDINALITY_SERIES,
+    convergence_timeout_s=120.0,
+    phase_timeout_s=120.0,
+):
+    t0 = time.perf_counter()
+    batched = run_scale_once(
+        nodes, pods_per_node, batched=True,
+        cardinality_series_total=cardinality_series_total,
+        convergence_timeout_s=convergence_timeout_s,
+        phase_timeout_s=phase_timeout_s,
+    )
+    baseline = run_scale_once(
+        nodes, pods_per_node, batched=False,
+        cardinality_series_total=cardinality_series_total,
+        convergence_timeout_s=convergence_timeout_s,
+        phase_timeout_s=phase_timeout_s,
+    )
+    return {
+        "nodes": nodes,
+        "pods": nodes * pods_per_node,
+        "wall_s": round(time.perf_counter() - t0, 1),
+        "batched": batched,
+        "unbatched_baseline": baseline,
+        "write_amplification_reduction": _scale_reduction(
+            batched, baseline
+        ),
+    }
+
+
+# Crash windows the drill kills a bind at, in both storage shapes: the
+# WAL-journaled transaction's mid-bind failpoints (PR 5). post_journal =
+# intent durable, nothing else; post_create = virtual nodes exist;
+# post_checkpoint = record committed, intent still open (exactly the
+# window group-commit batching widens by deferring the intent-commit
+# row drop).
+SCALE_DRILL_FAILPOINTS = (
+    "bind.post_journal", "bind.post_create", "bind.post_checkpoint",
+)
+
+
+def scale_crash_drill(storage_batch_window_s, timeout_s=30.0):
+    """Kill a bind thread at each mid-bind crash window on a 1-node sim
+    with the given storage shape; the reconciler must converge every
+    crash to a bound pod with an empty intent journal and a timeline
+    that still tells a consistent bind story. Returns problems."""
+    from elastic_tpu_agent import faults
+    from elastic_tpu_agent.common import ResourceTPUCore
+    from elastic_tpu_agent.sim import FleetSim
+    from elastic_tpu_agent.timeline import verify_bind_story
+
+    problems = []
+    with tempfile.TemporaryDirectory(prefix="etpu-drill") as tmp:
+        sim = FleetSim(
+            tmp, nodes=1, reconcile_period_s=0.5,
+            storage_batch_window_s=storage_batch_window_s,
+        )
+        sim.start()
+        try:
+            storage = sim.nodes[0].storage
+            for point in SCALE_DRILL_FAILPOINTS:
+                ns = point.replace(".", "-").replace("_", "-")
+                refs = sim.admit_pods(1, namespace=ns, node_idxs=[0])
+                sim.wait_synced(refs)
+                ref = refs[0]
+                faults.get_registry().arm(point, "die-thread:1")
+                try:
+                    crashed = threading.Event()
+
+                    def bind_and_die():
+                        try:
+                            sim.bind_pod(ref)
+                        except BaseException:  # noqa: BLE001 - the crash
+                            pass
+                        finally:
+                            crashed.set()
+
+                    t = threading.Thread(target=bind_and_die, daemon=True)
+                    t.start()
+                    if not crashed.wait(timeout_s):
+                        problems.append(f"{point}: bind never returned")
+                        continue
+                finally:
+                    faults.get_registry().disarm(point)
+                # Converged end state: the reconciler replays/commits the
+                # crashed bind (the kubelet assignment is live and the
+                # pod exists), leaving a record and no open intent.
+                deadline = time.monotonic() + timeout_s
+                while time.monotonic() < deadline:
+                    info = storage.load(ref.namespace, ref.name)
+                    rec = None
+                    if info is not None:
+                        rec = info.allocations.get("jax", {}).get(
+                            ResourceTPUCore
+                        )
+                    if rec is not None and not storage.open_intents():
+                        break
+                    time.sleep(0.05)
+                else:
+                    problems.append(
+                        f"{point}: never converged (record "
+                        f"{storage.load(ref.namespace, ref.name)!r}, "
+                        f"open intents {storage.open_intents()!r})"
+                    )
+            story = verify_bind_story(storage.timeline_rows())
+            for p in story:
+                problems.append(f"timeline story: {p}")
+        finally:
+            sim.stop()
+    return problems
+
+
+def scale_main():
+    """`bench.py --scale`: the full-scale leg (16 nodes x 125 pods,
+    batched + same-run unbatched baseline), one JSON line."""
+    try:
+        result = run_scale()
+    except Exception as e:  # noqa: BLE001 - explicit skip, never silence
+        result = {
+            "skipped": True,
+            "reason": f"scale harness failed: {type(e).__name__}: {e}",
+        }
+    print(json.dumps({"scale": result}))
+    return 0 if not result.get("skipped") else 1
+
+
+SCALE_SMOKE_NODES = 8
+SCALE_SMOKE_PODS_PER_NODE = 64     # 512 pods: small, deterministic
+
+
+def scale_smoke_main():
+    """`make scale-smoke`: the scale harness at a small deterministic
+    config with STRUCTURAL assertions only — every bind lands, every
+    node converges, request amplification within bound, RSS under the
+    documented ceiling, batched beats unbatched on storage commits, and
+    the mid-bind crash drill replays clean in BOTH storage shapes."""
+    from elastic_tpu_agent.sim import scale_problems
+
+    problems = []
+    try:
+        r = run_scale(
+            nodes=SCALE_SMOKE_NODES,
+            pods_per_node=SCALE_SMOKE_PODS_PER_NODE,
+            convergence_timeout_s=60.0,
+            phase_timeout_s=60.0,
+        )
+    except Exception as e:  # noqa: BLE001
+        print(json.dumps({"scale_smoke": {
+            "error": f"{type(e).__name__}: {e}"
+        }}))
+        print(f"scale smoke FAILED: {type(e).__name__}: {e}",
+              file=sys.stderr)
+        return 1
+    for tag in ("batched", "unbatched_baseline"):
+        for p in scale_problems(r[tag]):
+            problems.append(f"{tag}: {p}")
+    reduction = r["write_amplification_reduction"]
+    commits = reduction["storage_commits_per_bind"]
+    if not commits["reduction_x"] or commits["reduction_x"] <= 1.0:
+        problems.append(
+            "group-commit batching did not reduce storage commits per "
+            f"bind: {commits}"
+        )
+    for mode, window in (
+        ("batched", SCALE_STORAGE_WINDOW_S), ("unbatched", 0.0),
+    ):
+        for p in scale_crash_drill(window):
+            problems.append(f"crash drill ({mode}): {p}")
+    print(json.dumps({"scale_smoke": r, "problems": problems}))
+    if problems:
+        for p in problems:
+            print(f"scale smoke FAILED: {p}", file=sys.stderr)
+        return 1
+    print("scale smoke: OK", file=sys.stderr)
+    return 0
+
+
 # -- slices: formation + elastic recovery (ROADMAP item 4) --------------------
 #
 # A multi-host slice formed across cooperating agents (annotation-driven,
@@ -2868,6 +3123,14 @@ def main():
             # (fleet bind p50/p99, reconcile convergence, request
             # amplification, trace continuity).
             "fleet": fleet,
+            # Thousand-pod scale harness (16 x 125 + unbatched
+            # baseline): too heavy to ride every main-bench round —
+            # run `bench.py --scale` explicitly; `make scale-smoke`
+            # gates the structural invariants each verify.
+            "scale": {
+                "skipped": True,
+                "reason": "heavy leg: run bench.py --scale explicitly",
+            },
             "pods": N_PODS,
             # Deterministic CPU proxy: paged-vs-gather HBM bytes + ops
             # per decode step, the paged_kernel default's evidence —
@@ -2906,6 +3169,10 @@ if __name__ == "__main__":
         sys.exit(qos_smoke_main())
     elif "--serving-proxy-child" in sys.argv:
         serving_proxy_child_main()
+    elif "--scale-smoke" in sys.argv:
+        sys.exit(scale_smoke_main())
+    elif "--scale" in sys.argv:
+        sys.exit(scale_main())
     elif "--fleet" in sys.argv:
         sys.exit(fleet_main())
     else:

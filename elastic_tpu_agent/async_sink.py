@@ -10,9 +10,22 @@ that contract once.
 Flow control:
 - the worker drains in BATCHES (everything queued when it wakes) and
   writes for the same coalescing ``key`` collapse to the newest one, so
-  a storm of updates for one object costs one apiserver write;
+  a storm of updates for one object costs one apiserver write; each
+  superseded op is counted in ``merged`` (the coalescing win is itself
+  observable);
+- an optional ``flush_window_s`` makes the worker LINGER after waking
+  so ops submitted close together coalesce before the drain — at fleet
+  churn a bind's event + CRD create + CRD status land in one window and
+  same-key ops dedup instead of each paying an apiserver round-trip;
 - the queue is BOUNDED: past ``max_queue`` the oldest entry is dropped
   (newer state wins for observability) and counted in ``dropped``;
+- failures back off on ONE shared clock: a failed flush attempt bumps
+  the streak ONCE, re-queues the unwritten ops, and sleeps a jittered
+  exponential backoff before retrying — under a dead apiserver the sink
+  no longer machine-guns each queued op independently (which both
+  hammered the apiserver and burned the whole failure budget on one
+  batch); the sink disables after ``max_failures`` consecutive failed
+  flush attempts;
 - ``stop()`` DRAINS: everything submitted before the call is written
   (or dropped by the bound) before the worker exits — queued
   Bound/Released records no longer die with the daemon thread.
@@ -22,14 +35,21 @@ from __future__ import annotations
 
 import logging
 import threading
+import time
 from typing import Callable, List, Optional, Tuple
 
 from . import faults
+from .common import JitteredBackoff
 
 logger = logging.getLogger(__name__)
 
 MAX_CONSECUTIVE_FAILURES = 5
 DEFAULT_MAX_QUEUE = 4096
+# Shared backoff clock for failed flush attempts (jittered exponential;
+# common.JitteredBackoff): min keeps tests and transient blips quick,
+# max keeps a dead apiserver from being polled hot.
+DEFAULT_BACKOFF_MIN_S = 0.2
+DEFAULT_BACKOFF_MAX_S = 15.0
 
 
 def drop_hook(metrics) -> Optional[Callable[[], None]]:
@@ -64,11 +84,22 @@ class AsyncSink:
         max_failures: int = MAX_CONSECUTIVE_FAILURES,
         max_queue: int = DEFAULT_MAX_QUEUE,
         on_drop: Optional[Callable[[], None]] = None,
+        flush_window_s: float = 0.0,
+        backoff_min_s: float = DEFAULT_BACKOFF_MIN_S,
+        backoff_max_s: float = DEFAULT_BACKOFF_MAX_S,
     ) -> None:
         self._name = name
         self._max_failures = max_failures
         self._max_queue = max_queue
         self._on_drop = on_drop
+        # Coalescing window: after waking with work, linger this long so
+        # ops submitted close together batch/dedup into one drain
+        # (0 = drain immediately, the historical shape).
+        self._flush_window_s = max(0.0, flush_window_s)
+        # ONE backoff clock for the whole flush: a dead apiserver costs
+        # one failed attempt + one (growing) sleep per cycle, not one
+        # hot failure per queued op.
+        self._backoff = JitteredBackoff(backoff_min_s, backoff_max_s)
         # Invoked once per successfully drained op (request-amplification
         # accounting; metrics.AgentMetrics.register_sink points it at the
         # per-sink elastic_tpu_sink_writes_total counter). Note ops are
@@ -85,6 +116,15 @@ class AsyncSink:
         self._stopping = False
         self._busy = False
         self._dropped = 0
+        self._merged = 0
+        # Per-op failure counts for the ops currently cycling through
+        # failed flushes: an op that keeps failing while LATER ops would
+        # succeed (a deterministic 4xx, not a dead apiserver) is dropped
+        # after max_failures of its OWN failures instead of head-of-line
+        # blocking the queue until the whole sink disables. Pruned on
+        # success/drop and at requeue, so it only ever holds the keys of
+        # currently-failing ops.
+        self._op_failures: "dict[object, int]" = {}
         self._cond = threading.Condition()
         self._worker_error: Optional[BaseException] = None
         self._thread = self._spawn_worker()
@@ -106,6 +146,13 @@ class AsyncSink:
     def dropped(self) -> int:
         """Ops discarded by the queue bound since start."""
         return self._dropped
+
+    @property
+    def merged(self) -> int:
+        """Queued ops superseded by a newer same-key submission before
+        they were drained (each one is an apiserver write the coalescing
+        saved)."""
+        return self._merged
 
     @property
     def writes_total(self) -> int:
@@ -135,9 +182,9 @@ class AsyncSink:
             if key is None:
                 self._seq += 1
                 key = ("_seq", self._seq)
-            else:
+            elif self._items.pop(key, None) is not None:
                 # superseding moves the write to the newest position
-                self._items.pop(key, None)
+                self._merged += 1
             if len(self._items) >= self._max_queue:
                 oldest = next(iter(self._items))
                 del self._items[oldest]  # drop-oldest: newer state wins
@@ -157,8 +204,6 @@ class AsyncSink:
 
     def flush(self, timeout: float = 10.0) -> bool:
         """Block until queued work has drained (tests / shutdown)."""
-        import time
-
         deadline = time.monotonic() + timeout
         with self._cond:
             while self._items or self._busy:
@@ -217,6 +262,26 @@ class AsyncSink:
                 self._busy = False
                 self._cond.notify_all()  # un-wedge flush()ers
 
+    def _wait_until(self, end: float) -> None:
+        """Sleep on the condition until ``end`` (monotonic) or stop; a
+        plain sleep would ignore stop(), a single cond.wait would be cut
+        short by every submit."""
+        with self._cond:
+            while not self._stopping:
+                remaining = end - time.monotonic()
+                if remaining <= 0:
+                    return
+                self._cond.wait(timeout=remaining)
+
+    def _count_drop(self, n: int = 1) -> None:
+        self._dropped += n
+        if self._on_drop is not None:
+            for _ in range(n):
+                try:
+                    self._on_drop()
+                except Exception:  # noqa: BLE001
+                    pass
+
     def _worker_body(self) -> None:
         while True:
             with self._cond:
@@ -225,40 +290,124 @@ class AsyncSink:
                 if not self._items:  # stopping and drained
                     self._cond.notify_all()
                     return
+            # Coalescing window: linger so a burst submitted together is
+            # drained together — same-key ops dedup in the queue instead
+            # of each paying an apiserver write. Skipped when stopping
+            # (drain fast) or disabled (nothing will be written anyway).
+            if (
+                self._flush_window_s > 0
+                and not self._stopping and not self._disabled
+            ):
+                self._wait_until(time.monotonic() + self._flush_window_s)
             # Failpoint BEFORE the batch is claimed: a raise/die-thread
             # here leaves every queued op intact for the respawned worker
             # (the chaos suite asserts nothing is dropped across a worker
             # crash). Only this worker pops, so the re-lock is race-free.
             faults.fire(f"sink.{self._name}")
             with self._cond:
-                batch, self._items = list(self._items.values()), {}
+                batch = list(self._items.items())
+                self._items = {}
                 self._busy = True
-            for op in batch:
+            failed_at: Optional[int] = None
+            error: Optional[Exception] = None
+            i = 0
+            while i < len(batch):
+                key, op = batch[i]
+                if self._disabled:
+                    # claimed-after-disable: dropped like submit refuses,
+                    # but COUNTED — this is where losses are largest
+                    self._count_drop(len(batch) - i)
+                    i = len(batch)
+                    break
                 try:
-                    if not self._disabled:
-                        op()
-                        self._failures = 0
-                        self._writes += 1
-                        cb = self.on_write
-                        if cb is not None:
-                            try:
-                                cb()
-                            except Exception:  # noqa: BLE001
-                                pass
+                    op()
                 except Exception as e:  # noqa: BLE001 - must not wedge
-                    self._failures += 1
-                    if self._failures >= self._max_failures:
-                        self._disabled = True
+                    fails = self._op_failures.get(key, 0) + 1
+                    if fails >= self._max_failures:
+                        # This op ITSELF keeps failing while the flush
+                        # around it may be fine (deterministic apiserver
+                        # rejection): drop it and keep draining, rather
+                        # than head-of-line blocking the queue until the
+                        # whole sink disables.
+                        self._op_failures.pop(key, None)
+                        self._count_drop()
                         logger.warning(
-                            "%s disabled after %d consecutive failures "
-                            "(last: %s)", self._name, self._failures, e,
+                            "%s op dropped after %d failed attempts "
+                            "(last: %s)", self._name, fails, e,
                         )
-                    else:
-                        logger.warning(
-                            "%s write failed (%s); continuing",
-                            self._name, e,
-                        )
+                        i += 1
+                        continue
+                    self._op_failures[key] = fails
+                    failed_at, error = i, e
+                    break
+                self._op_failures.pop(key, None)
+                self._failures = 0
+                self._backoff.reset()
+                self._writes += 1
+                cb = self.on_write
+                if cb is not None:
+                    try:
+                        cb()
+                    except Exception:  # noqa: BLE001
+                        pass
+                i += 1
+            if failed_at is None:
+                with self._cond:
+                    self._busy = False
+                    if not self._items:
+                        self._cond.notify_all()
+                continue
+            # Failed flush: ONE streak bump for the whole attempt (not
+            # one per queued op), the unwritten tail re-queued for the
+            # retry (ops superseded by a newer same-key submission while
+            # we held the batch stay superseded), and one shared backoff
+            # clock before the next attempt.
+            self._failures += 1
+            disable = self._failures >= self._max_failures
             with self._cond:
+                if disable:
+                    self._disabled = True
+                    # the unwritten tail dies with the sink: counted
+                    self._count_drop(len(batch) - failed_at)
+                    self._op_failures.clear()
+                else:
+                    requeue = {}
+                    for key, op in batch[failed_at:]:
+                        if key in self._items:
+                            self._merged += 1
+                        else:
+                            requeue[key] = op
+                    self._items = {**requeue, **self._items}
+                    # failure counters only for ops still in play
+                    self._op_failures = {
+                        k: v for k, v in self._op_failures.items()
+                        if k in self._items
+                    }
+                    # Re-apply the queue bound: the requeue merged with
+                    # ops submitted during the flush/backoff, and the
+                    # documented memory bound must hold through failure
+                    # cycles too (drop-oldest, counted as ever).
+                    excess = len(self._items) - self._max_queue
+                    if excess > 0:
+                        for old in list(self._items)[:excess]:
+                            del self._items[old]
+                        self._count_drop(excess)
                 self._busy = False
                 if not self._items:
                     self._cond.notify_all()
+            if disable:
+                logger.warning(
+                    "%s disabled after %d consecutive failed flushes "
+                    "(last: %s; %d op(s) dropped)",
+                    self._name, self._failures, error,
+                    len(batch) - failed_at,
+                )
+                continue
+            delay = self._backoff.next_delay()
+            logger.warning(
+                "%s flush failed (%s); retrying %d queued op(s) in "
+                "%.1fs (streak %d/%d)",
+                self._name, error, len(batch) - failed_at, delay,
+                self._failures, self._max_failures,
+            )
+            self._wait_until(time.monotonic() + delay)

@@ -348,3 +348,17 @@ class FileWatcher:
             self._sig = sig
             return True
         return False
+
+
+def read_rss_bytes() -> int:
+    """This process's resident set size, from ``/proc/self/statm``
+    (field 2 is resident pages). Stub-safe: any failure — non-Linux,
+    locked-down /proc — reads as 0, never an exception, so the
+    ``elastic_tpu_agent_rss_bytes`` gauge and the doctor bundle can
+    carry it unconditionally."""
+    try:
+        with open("/proc/self/statm") as f:
+            resident_pages = int(f.read().split()[1])
+        return resident_pages * os.sysconf("SC_PAGE_SIZE")
+    except Exception:  # noqa: BLE001 - a memory gauge must never raise
+        return 0

@@ -50,11 +50,15 @@ class CRDRecorder:
         node_name: str,
         accelerator_type: str = "",
         metrics=None,
+        flush_window_s: float = 0.0,
     ) -> None:
         self._client = client
         self._node = node_name
         self._accelerator_type = accelerator_type
-        self._sink = AsyncSink("crd-recorder", on_drop=drop_hook(metrics))
+        self._sink = AsyncSink(
+            "crd-recorder", on_drop=drop_hook(metrics),
+            flush_window_s=flush_window_s,
+        )
         register_sink_metrics(self._sink, metrics)
 
     # -- public API (called from plugin bind / GC / manager restore) ----------
@@ -213,7 +217,8 @@ class CRDRecorder:
 
 
 def build_recorder(
-    kube_client, node_name: str, operator, metrics=None
+    kube_client, node_name: str, operator, metrics=None,
+    flush_window_s: float = 0.0,
 ) -> Optional[CRDRecorder]:
     """Manager-side constructor: a recorder bound to this node's client and
     accelerator type; None when there is no kube client (hermetic runs)."""
@@ -225,5 +230,5 @@ def build_recorder(
         acc = getattr(topo, "accelerator_type", "") or ""
     return CRDRecorder(
         ElasticTPUClient(kube_client), node_name, accelerator_type=acc,
-        metrics=metrics,
+        metrics=metrics, flush_window_s=flush_window_s,
     )

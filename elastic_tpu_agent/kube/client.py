@@ -170,18 +170,33 @@ class KubeClient:
             raise KubeError(f"get node {name}: {r.status_code}")
         return r.json()
 
-    def list_pods(self, node_name: str) -> Tuple[list, str]:
+    def list_pods(
+        self, node_name: str, page_limit: int = 500
+    ) -> Tuple[list, str]:
         """All pods bound to ``node_name`` + the list resourceVersion
-        (fieldSelector parity: sitter.go:73-77)."""
-        r = self._get(
-            "/api/v1/pods",
-            params={"fieldSelector": f"spec.nodeName={node_name}"},
-        )
-        if r.status_code != 200:
-            raise KubeError(f"list pods: {r.status_code}")
-        body = r.json()
-        rv = body.get("metadata", {}).get("resourceVersion", "")
-        return body.get("items", []), rv
+        (fieldSelector parity: sitter.go:73-77). Paginated: apiservers
+        enforce page caps server-side, and a node-scoped list that
+        ignored ``continue`` would silently truncate the sitter's cache
+        on a busy node."""
+        items: list = []
+        cont = ""
+        while True:
+            params = {
+                "fieldSelector": f"spec.nodeName={node_name}",
+                "limit": str(page_limit),
+            }
+            if cont:
+                params["continue"] = cont
+            r = self._get("/api/v1/pods", params=params)
+            if r.status_code != 200:
+                raise KubeError(f"list pods: {r.status_code}")
+            body = r.json()
+            items.extend(body.get("items", []))
+            meta = body.get("metadata", {}) or {}
+            rv = meta.get("resourceVersion", "")
+            cont = meta.get("continue", "")
+            if not cont:
+                return items, rv
 
     def list_all_pods(self, page_limit: int = 500) -> list:
         """Every pod in the cluster (no node fieldSelector) — the slice

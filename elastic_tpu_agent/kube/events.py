@@ -61,10 +61,16 @@ ReasonQoSEvicted = "TPUQoSEvicted"
 class EventRecorder:
     """Posts core/v1 Events; all methods non-blocking and never raise."""
 
-    def __init__(self, kube_client, node_name: str, metrics=None) -> None:
+    def __init__(
+        self, kube_client, node_name: str, metrics=None,
+        flush_window_s: float = 0.0,
+    ) -> None:
         self._client = kube_client
         self._node = node_name
-        self._sink = AsyncSink("event-recorder", on_drop=drop_hook(metrics))
+        self._sink = AsyncSink(
+            "event-recorder", on_drop=drop_hook(metrics),
+            flush_window_s=flush_window_s,
+        )
         register_sink_metrics(self._sink, metrics)
         # key -> (last_emit_monotonic, suppressed_since_then, emit_ctx)
         # where emit_ctx = (namespace, base, involved, reason, message, type_)
@@ -249,8 +255,11 @@ class EventRecorder:
 
 
 def build_event_recorder(
-    kube_client, node_name: str, metrics=None
+    kube_client, node_name: str, metrics=None, flush_window_s: float = 0.0
 ) -> Optional[EventRecorder]:
     if kube_client is None or not node_name:
         return None
-    return EventRecorder(kube_client, node_name, metrics=metrics)
+    return EventRecorder(
+        kube_client, node_name, metrics=metrics,
+        flush_window_s=flush_window_s,
+    )

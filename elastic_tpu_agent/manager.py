@@ -122,6 +122,17 @@ class ManagerOptions:
     # journal (--timeline-cap). Small caps are a test/smoke seam; the
     # eviction counter keeps trims observable either way.
     timeline_cap: int = timeline_mod.DEFAULT_CAP
+    # Group-commit write batching (storage/batcher.py): >0 coalesces
+    # storage commits into one flush per window — load-bearing writes
+    # (bind checkpoints, intent journals, agent_state) still block until
+    # their covering commit lands; timeline events and intent-commit
+    # row drops ride async. 0 = every write commits itself.
+    # CLI --storage-batch-window.
+    storage_batch_window_s: float = 0.0
+    # AsyncSink coalescing window (async_sink.py): >0 makes the CRD and
+    # event sinks linger after waking so a bind's burst of apiserver
+    # writes batches/dedups into one drain. CLI --sink-flush-window.
+    sink_flush_window_s: float = 0.0
     # test seams
     kube_client: Optional[KubeClient] = None
     operator: object = None
@@ -182,7 +193,9 @@ def build_operator(opts: ManagerOptions):
 class TPUManager:
     def __init__(self, opts: ManagerOptions) -> None:
         self._opts = opts
-        self.storage = Storage(opts.db_path)
+        self.storage = Storage(
+            opts.db_path, batch_window_s=opts.storage_batch_window_s
+        )
         # The lifecycle timeline rides the checkpoint db (one fsync
         # domain, one hostPath) and is handed to every subsystem that
         # makes state transitions — created first so even supervisor
@@ -215,6 +228,12 @@ class TPUManager:
         if self.metrics is not None and hasattr(self.metrics, "attach_sitter"):
             self.metrics.attach_sitter(self.sitter)
         if self.metrics is not None and hasattr(
+            self.metrics, "attach_storage"
+        ):
+            # Write/commit amplification accounting (group-commit
+            # batching) rides the scrape like every other counter.
+            self.metrics.attach_storage(self.storage)
+        if self.metrics is not None and hasattr(
             self.metrics, "attach_timeline"
         ):
             # /debug/timeline serves the journal; /healthz gains the
@@ -234,13 +253,15 @@ class TPUManager:
             self.crd_recorder = build_recorder(
                 self.client, opts.node_name, self.operator,
                 metrics=self.metrics,
+                flush_window_s=opts.sink_flush_window_s,
             )
         self.events = None
         if opts.enable_events:
             from .kube.events import build_event_recorder
 
             self.events = build_event_recorder(
-                self.client, opts.node_name, metrics=self.metrics
+                self.client, opts.node_name, metrics=self.metrics,
+                flush_window_s=opts.sink_flush_window_s,
             )
         self.sampler = None
         if opts.enable_sampler:

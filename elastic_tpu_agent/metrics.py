@@ -446,6 +446,55 @@ class AgentMetrics:
             "prefilled (engine-lifetime count)",
             **kw,
         )
+        # -- self-memory accounting (ROADMAP item 1: bounded memory at
+        # 10k+ pod-series must be observable OUTSIDE the scale harness)
+        self.agent_rss = Gauge(
+            "elastic_tpu_agent_rss_bytes",
+            "Resident set size of the agent process (/proc/self/statm; "
+            "0 where /proc is unavailable). Divide by the live series/"
+            "pod count for the per-series memory the scale leg asserts "
+            "a ceiling on.",
+            **kw,
+        )
+        self.trace_ring_bytes = Gauge(
+            "elastic_tpu_trace_ring_bytes",
+            "Approximate bytes held by the in-process allocation-trace "
+            "ring (sampled-extrapolated estimate; tracing.py). The ring "
+            "is capacity-bounded — this gauge is how that bound stays "
+            "falsifiable under churn.",
+            **kw,
+        )
+        from .common import read_rss_bytes
+
+        self.agent_rss.set_function(read_rss_bytes)
+
+        def _ring_bytes() -> float:
+            try:
+                from .tracing import get_tracer
+
+                return float(get_tracer().ring_bytes())
+            except Exception:  # noqa: BLE001 - scrape must never break
+                return 0.0
+
+        self.trace_ring_bytes.set_function(_ring_bytes)
+        # -- storage write amplification (storage/batcher.py) --------------
+        # Gauges over the store's own monotone counters (set_function via
+        # attach_storage): commits/writes per bind is the fleet
+        # aggregator's storage-amplification numerator.
+        self.storage_writes = Gauge(
+            "elastic_tpu_storage_writes_total",
+            "Logical write transactions requested of the checkpoint "
+            "store (each was one sqlite COMMIT before group-commit "
+            "batching)",
+            **kw,
+        )
+        self.storage_commits = Gauge(
+            "elastic_tpu_storage_commits_total",
+            "sqlite COMMITs the checkpoint store actually paid; with "
+            "--storage-batch-window > 0 one commit covers many writes "
+            "(compare with elastic_tpu_storage_writes_total)",
+            **kw,
+        )
         self.observability_dropped = Counter(
             "elastic_tpu_observability_dropped_total",
             "CRD/event writes dropped by the bounded async queue",
@@ -486,6 +535,13 @@ class AgentMetrics:
         self.sink_queue_depth = Gauge(
             "elastic_tpu_sink_queue_depth",
             "Ops queued in an async observability sink",
+            ["sink"],
+            **kw,
+        )
+        self.sink_merged = Gauge(
+            "elastic_tpu_sink_merged_ops",
+            "Queued sink ops superseded by a newer same-key write before "
+            "draining — apiserver writes the coalescing window saved",
             ["sink"],
             **kw,
         )
@@ -654,6 +710,22 @@ class AgentMetrics:
             read("shared_pool", "adopted_tokens")
         )
 
+    def attach_storage(self, storage) -> None:
+        """Export the checkpoint store's write/commit counters (group-
+        commit amplification accounting) via set_function reads — the
+        store's hot path never touches prometheus."""
+
+        def read(key):
+            def _read() -> float:
+                try:
+                    return float(storage.write_stats().get(key) or 0)
+                except Exception:  # noqa: BLE001 - scrape never breaks
+                    return 0.0
+            return _read
+
+        self.storage_writes.set_function(read("writes_total"))
+        self.storage_commits.set_function(read("commits_total"))
+
     def attach_supervisor(self, supervisor) -> None:
         """Fold supervisor state into /healthz: any circuit-broken
         CRITICAL subsystem flips the endpoint to 503 so the DaemonSet
@@ -679,6 +751,10 @@ class AgentMetrics:
         self.sink_queue_depth.labels(sink=name).set_function(
             lambda: sink.queue_depth
         )
+        if hasattr(sink, "merged"):
+            self.sink_merged.labels(sink=name).set_function(
+                lambda: float(sink.merged)
+            )
         self.sink_consecutive_failures.labels(sink=name).set_function(
             lambda: sink.consecutive_failures
         )

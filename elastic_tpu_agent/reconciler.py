@@ -972,7 +972,13 @@ class Reconciler:
                     ).get(resource)
                 if rec is not None:
                     continue  # bound (drift is the record walk's job)
-                ukey = ("unbound", resource, alloc_hash)
+                # Keyed by OWNER too: under churn a reclaimed pod's
+                # device set can return under a NEW pod (same chip/unit
+                # pattern, fresh assignment) within one pass window —
+                # without the owner in the key, the dead generation's
+                # candidate would insta-confirm the new one and replay
+                # a bind that is seconds from binding itself.
+                ukey = ("unbound", resource, alloc_hash, owner.pod_key)
                 if not active:
                     self._candidate(ukey)
                     report["divergences_observed"] += 1
@@ -1027,7 +1033,7 @@ class Reconciler:
         # Assignments that disappeared take their backoff state with
         # them (pod deleted, or finally bound via a real PreStart).
         live_keys = {
-            ("unbound", res, h)
+            ("unbound", res, h, by_hash[h][0].pod_key)
             for res, by_hash in assignments.items()
             for h in by_hash
         }

@@ -887,6 +887,21 @@ def build_diagnostics_bundle(
         "reconcile": {},
         "agent": {"url": agent_url, "reachable": None},
     }
+    # Self-memory: the doctor's process RSS (statm-backed, 0 where /proc
+    # is unavailable) and the trace ring's approximate footprint — the
+    # memory-ceiling numbers the scale harness asserts, observable from
+    # a bundle too.
+    try:
+        from .common import read_rss_bytes
+        from .tracing import get_tracer
+
+        bundle["memory"] = {
+            "rss_bytes": read_rss_bytes(),
+            "trace_ring_bytes": get_tracer().ring_bytes(),
+        }
+    except Exception as e:  # noqa: BLE001 - partial bundles beat none
+        logger.warning("doctor: memory accounting failed: %s", e)
+        bundle["memory"] = {"rss_bytes": 0, "trace_ring_bytes": 0}
     # Lifecycle timeline: read straight from the checkpoint db (never
     # from the live agent) — the history must be attachable to an
     # escalation even when the agent is a corpse, and the db IS the
@@ -1142,6 +1157,15 @@ def validate_bundle(bundle: dict) -> List[str]:
                    f"sampler_windows.{field} must be an object")
     expect(isinstance(bundle.get("traces"), list), "traces must be a list")
     expect(isinstance(bundle.get("agent"), dict), "agent must be an object")
+    if "memory" in bundle:  # absent only in pre-scale-harness bundles
+        memory = bundle["memory"]
+        expect(isinstance(memory, dict), "memory must be an object")
+        if isinstance(memory, dict):
+            for field in ("rss_bytes", "trace_ring_bytes"):
+                expect(
+                    isinstance(memory.get(field), (int, float)),
+                    f"memory.{field} must be a number",
+                )
     if "reconcile" in bundle:  # absent only in pre-reconciler bundles
         reconcile = bundle["reconcile"]
         expect(isinstance(reconcile, dict), "reconcile must be an object")

@@ -19,3 +19,4 @@ scraping every agent's /metrics endpoint.
 
 from .aggregator import FleetAggregator, histogram_quantile  # noqa: F401
 from .fleet import FleetSim  # noqa: F401
+from .scale import ScaleHarness, scale_problems  # noqa: F401

@@ -130,6 +130,8 @@ class FleetSim:
         drain_deadline_s: float = 5.0,
         drain_period_s: float = 0.5,
         timeline_cap: Optional[int] = None,
+        storage_batch_window_s: float = 0.0,
+        sink_flush_window_s: float = 0.0,
     ) -> None:
         self.base_dir = base_dir
         self.n_nodes = nodes
@@ -154,6 +156,12 @@ class FleetSim:
         # timeline smoke shrinks it to prove the ring + eviction
         # counter under churn; None = the production default.
         self.timeline_cap = timeline_cap
+        # Scale-harness batching knobs (ISSUE 13): group-commit storage
+        # writes (storage/batcher.py) and coalesced sink traffic
+        # (async_sink flush window). 0/0 = the historical per-write
+        # shape — the scale leg's unbatched baseline.
+        self.storage_batch_window_s = storage_batch_window_s
+        self.sink_flush_window_s = sink_flush_window_s
         self.nodes: List[SimNode] = []
         self.apiserver = None
         self.api_url = ""
@@ -222,6 +230,8 @@ class FleetSim:
                 slice_membership_ttl_s=self.slice_membership_ttl_s,
                 drain_deadline_s=self.drain_deadline_s,
                 drain_period_s=self.drain_period_s,
+                storage_batch_window_s=self.storage_batch_window_s,
+                sink_flush_window_s=self.sink_flush_window_s,
                 **(
                     {"timeline_cap": self.timeline_cap}
                     if self.timeline_cap is not None else {}
@@ -419,12 +429,17 @@ class FleetSim:
 
     def _core_ids(self, ref: PodRef) -> List[str]:
         # The unit field of a fake id is never parsed (only the chip
-        # is), so embedding the pod name makes every pod's id set
+        # is), so embedding the pod KEY makes every pod's id set
         # pairwise distinct on its node without unit-space bookkeeping.
+        # The namespace must be in there too: a real kubelet never
+        # assigns one device id to two live pods, and scenario phases
+        # reuse pod names across namespaces (admission waves, churn
+        # replacements) — name-only ids would alias their device-set
+        # hashes and make the locator's hash->owner mapping ambiguous.
         from ..plugins.tpushare import core_device_id
 
         return [
-            core_device_id(ref.chip, f"{ref.name}u{j}")
+            core_device_id(ref.chip, f"{ref.namespace}.{ref.name}u{j}")
             for j in range(self.core_units_per_pod)
         ]
 
@@ -519,6 +534,39 @@ class FleetSim:
             "wall_s": wall_s,
             "churn_end_ts": time.time(),
         }
+
+    # -- pod deletion (steady-state churn: the scheduler's other half) --------
+
+    def delete_pods(self, refs: List[PodRef]) -> None:
+        """Delete admitted pods the way the control plane would: gone
+        from the apiserver (the sitter's DELETED event feeds each
+        node's GC) and unassigned at the node's kubelet (so the
+        reconciler doesn't replay the bind back)."""
+        for ref in refs:
+            self.nodes[ref.node_idx].kubelet.unassign_pod(
+                ref.namespace, ref.name
+            )
+            self.apiserver.delete_pod(ref.namespace, ref.name)
+
+    def wait_reclaimed(
+        self, refs: List[PodRef], timeout_s: float = 60.0
+    ) -> float:
+        """Block until every deleted pod's checkpoint record is gone
+        (GC/reconciler reclaimed the binding); returns the wait."""
+        t0 = time.monotonic()
+        deadline = t0 + timeout_s
+        for ref in refs:
+            node = self.nodes[ref.node_idx]
+            if node.dead:
+                continue
+            while node.storage.load(ref.namespace, ref.name) is not None:
+                if time.monotonic() > deadline:
+                    raise RuntimeError(
+                        f"{node.name}: {ref.pod_key} never reclaimed "
+                        "after delete"
+                    )
+                time.sleep(0.02)
+        return time.monotonic() - t0
 
     # -- fleet-side ground truth (assertions, not metrics) --------------------
 

@@ -21,6 +21,7 @@ from typing import Callable, Iterator, Optional, Tuple
 from .. import faults
 from ..common import StripedLockSet
 from ..types import PodInfo
+from .batcher import GroupCommitBatcher, GroupCommitError
 
 logger = logging.getLogger(__name__)
 
@@ -111,7 +112,7 @@ class Storage:
     save / load / load_or_create / delete / for_each / close.
     """
 
-    def __init__(self, path: str) -> None:
+    def __init__(self, path: str, batch_window_s: float = 0.0) -> None:
         directory = os.path.dirname(os.path.abspath(path))
         os.makedirs(directory, exist_ok=True)
         self._path = path
@@ -155,6 +156,100 @@ class Storage:
             self._db.commit()
         except sqlite3.Error as e:
             raise StorageError(f"open {path}: {e}") from e
+        # -- group-commit write batching (storage/batcher.py) -------------
+        # 0 = off (every write commits itself, the historical shape).
+        # >0 = statements still execute inline under the lock (reads on
+        # this connection stay read-your-writes), but the COMMIT is
+        # deferred to one flusher commit per window: load-bearing writes
+        # wait for their covering commit (durability unchanged, fsyncs
+        # amortized across concurrent writers), non-load-bearing ones
+        # (timeline events, intent-commit row drops) ride along free.
+        self.commits_total = 0   # commits this connection actually paid
+        self.writes_total = 0    # logical write transactions requested
+        self._batcher: Optional[GroupCommitBatcher] = None
+        if batch_window_s and batch_window_s > 0:
+            self._batcher = GroupCommitBatcher(
+                self._batch_commit, self._batch_rollback,
+                window_s=batch_window_s,
+                name=f"storage:{os.path.basename(path)}",
+                # The statement lock: the batcher's failure path must
+                # exclude writers while it decides which generations a
+                # rollback took with it (RLock, so the rollback callback
+                # may re-take it).
+                lock=self._lock,
+            )
+
+    # -- group-commit plumbing (flusher-thread side) --------------------------
+
+    def _batch_commit(self) -> None:
+        """One group commit covering every statement executed since the
+        last flush; retried once on a transient cross-connection lock."""
+        with self._lock:
+            for attempt in (1, 2):
+                try:
+                    self._db.commit()
+                    self.commits_total += 1
+                    return
+                except sqlite3.Error as e:
+                    if not (self._is_transient_lock(e) and attempt == 1):
+                        raise
+                time.sleep(_LOCKED_RETRY_DELAY_S)
+
+    def _batch_rollback(self) -> None:
+        """A failed group commit rolls the whole open transaction back;
+        in-memory views that may now hold rolled-back state are dropped
+        (sync waiters get their error from the batcher)."""
+        with self._lock:
+            try:
+                self._db.rollback()
+            except sqlite3.Error:
+                pass
+            self._cache = {}
+            self._cache_complete = False
+            self._timeline_rows_cache = None
+            self._timeline_cap_stored = None
+
+    def _commit_locked(self, sync: bool = True) -> Optional[int]:
+        """(lock held) Commit this write, or hand it to the group-commit
+        batcher; returns the batch generation to wait on (None when the
+        commit already happened). ``sync`` marks a write whose caller
+        will block on the commit — the batcher flushes those
+        immediately instead of riding out the coalescing window."""
+        self.writes_total += 1
+        if self._batcher is None:
+            self._db.commit()
+            self.commits_total += 1
+            return None
+        return self._batcher.mark_dirty(sync=sync)
+
+    def _sync_wait(self, what: str, token: Optional[int]) -> None:
+        """(lock NOT held) Block until a load-bearing write's covering
+        group commit has landed; no-op when the write committed inline."""
+        if token is None or self._batcher is None:
+            return
+        try:
+            self._batcher.wait(token)
+        except GroupCommitError as e:
+            raise StorageError(f"{what}: {e}") from e
+
+    def write_stats(self) -> dict:
+        """Write-amplification accounting for the scale harness and
+        /metrics: logical write transactions vs sqlite commits paid."""
+        with self._lock:
+            stats = {
+                "batching": self._batcher is not None,
+                "writes_total": self.writes_total,
+                "commits_total": self.commits_total,
+            }
+        if self._batcher is not None:
+            b = self._batcher.stats()
+            stats["commits_total"] = b["commits_total"]
+            stats["batch"] = b
+        writes, commits = stats["writes_total"], stats["commits_total"]
+        stats["writes_per_commit"] = (
+            round(writes / commits, 3) if commits else None
+        )
+        return stats
 
     @staticmethod
     def _is_transient_lock(e: sqlite3.Error) -> bool:
@@ -162,21 +257,29 @@ class Storage:
             "database is locked" in str(e) or "database is busy" in str(e)
         )
 
-    def _write(self, what: str, sql: str, params: tuple) -> None:
-        """Execute+commit under the lock, retrying ONCE on a transient
-        lock error (a concurrent writer on another connection — e.g. a
-        node-doctor run against the live db — outlasting busy_timeout)."""
+    def _write(
+        self, what: str, sql: str, params: tuple, sync: bool = True
+    ) -> Optional[int]:
+        """Execute (+commit, or join the group-commit batch) under the
+        lock, retrying ONCE on a transient lock error (a concurrent
+        writer on another connection — e.g. a node-doctor run against
+        the live db — outlasting busy_timeout). Returns the batch token
+        for :meth:`_sync_wait` (None when the commit already ran)."""
         for attempt in (1, 2):
             try:
                 self._db.execute(sql, params)
-                self._db.commit()
-                return
+                return self._commit_locked(sync=sync)
             except sqlite3.Error as e:
                 transient = self._is_transient_lock(e) and attempt == 1
-                try:
-                    self._db.rollback()  # clear the failed statement
-                except sqlite3.Error:
-                    pass
+                if self._batcher is None:
+                    # Under batching the open transaction carries OTHER
+                    # writers' uncommitted statements: one failed
+                    # statement must not roll them back (sqlite keeps
+                    # the transaction usable past a statement error).
+                    try:
+                        self._db.rollback()  # clear the failed statement
+                    except sqlite3.Error:
+                        pass
                 if not transient:
                     raise StorageError(f"{what}: {e}") from e
                 logger.warning(
@@ -184,6 +287,7 @@ class Storage:
                     what, e, _LOCKED_RETRY_DELAY_S * 1000,
                 )
                 time.sleep(_LOCKED_RETRY_DELAY_S)
+        return None  # pragma: no cover - loop returns or raises
 
     # Exceptions meaning "this stored value does not parse as a PodInfo".
     _CORRUPT = (json.JSONDecodeError, KeyError, TypeError, AttributeError)
@@ -218,24 +322,35 @@ class Storage:
 
     # -- CRUD ----------------------------------------------------------------
 
+    def _save_locked(self, pod: PodInfo) -> Optional[int]:
+        """(lock held) Execute the save; returns the batch token. The
+        caller MUST release the lock before waiting on the token — a
+        sync wait under the lock deadlocks the group-commit flusher."""
+        value = pod.to_json()
+        self._check_foreign_writes()
+        token = self._write(
+            f"save {pod.key}",
+            "INSERT INTO pods(key, value) VALUES(?, ?) "
+            "ON CONFLICT(key) DO UPDATE SET value=excluded.value",
+            (pod.key, value),
+        )
+        # Cache a snapshot parsed back from the persisted JSON — never
+        # the caller's object, which the caller may keep mutating.
+        try:
+            self._cache[pod.key] = PodInfo.from_json(value)
+        except self._CORRUPT:  # pragma: no cover - to_json round-trips
+            self._cache.pop(pod.key, None)
+            self._cache_complete = False
+        return token
+
     def save(self, pod: PodInfo) -> None:
         faults.fire("storage.save")
-        value = pod.to_json()
         with self._lock:
-            self._check_foreign_writes()
-            self._write(
-                f"save {pod.key}",
-                "INSERT INTO pods(key, value) VALUES(?, ?) "
-                "ON CONFLICT(key) DO UPDATE SET value=excluded.value",
-                (pod.key, value),
-            )
-            # Cache a snapshot parsed back from the persisted JSON — never
-            # the caller's object, which the caller may keep mutating.
-            try:
-                self._cache[pod.key] = PodInfo.from_json(value)
-            except self._CORRUPT:  # pragma: no cover - to_json round-trips
-                self._cache.pop(pod.key, None)
-                self._cache_complete = False
+            token = self._save_locked(pod)
+        # The checkpoint is the bind's durable commit marker: block until
+        # the covering group commit lands (outside the lock, so the
+        # flusher can take it).
+        self._sync_wait(f"save {pod.key}", token)
 
     def load(self, namespace: str, name: str) -> Optional[PodInfo]:
         """Return the stored PodInfo, or None when absent (reference returns
@@ -258,13 +373,18 @@ class Storage:
             ) from e
 
     def load_or_create(self, namespace: str, name: str) -> PodInfo:
+        token = None
         with self._lock:
             existing = self.load(namespace, name)
             if existing is not None:
                 return existing
             pod = PodInfo(namespace=namespace, name=name)
-            self.save(pod)
-            return pod
+            faults.fire("storage.save")
+            token = self._save_locked(pod)
+        # Wait OUTSIDE the lock (see _save_locked): holding it here
+        # would block the flusher this wait depends on.
+        self._sync_wait(f"save {pod.key}", token)
+        return pod
 
     def mutate(self, namespace: str, name: str, fn) -> PodInfo:
         """Atomic per-key read-modify-write: load-or-create the record,
@@ -282,12 +402,13 @@ class Storage:
         faults.fire("storage.delete")
         with self._lock:
             self._check_foreign_writes()
-            self._write(
+            token = self._write(
                 f"delete {namespace}/{name}",
                 "DELETE FROM pods WHERE key=?",
                 (f"{namespace}/{name}",),
             )
             self._cache.pop(f"{namespace}/{name}", None)
+        self._sync_wait(f"delete {namespace}/{name}", token)
 
     def count(self) -> int:
         """O(1)-per-bind record count — the gauge-update path must not
@@ -337,22 +458,31 @@ class Storage:
                         (pod_key, container, resource, alloc_hash, value,
                          time.time()),
                     )
-                    self._db.commit()
-                    self._inflight_intents.add(cur.lastrowid)
-                    return cur.lastrowid
+                    token = self._commit_locked()
+                    intent_id = cur.lastrowid
+                    self._inflight_intents.add(intent_id)
+                    break
                 except sqlite3.Error as e:
                     transient = self._is_transient_lock(e) and attempt == 1
-                    try:
-                        self._db.rollback()
-                    except sqlite3.Error:
-                        pass
+                    if self._batcher is None:
+                        try:
+                            self._db.rollback()
+                        except sqlite3.Error:
+                            pass
                     if not transient:
                         raise StorageError(
                             f"journal intent {pod_key}/{container}: {e}"
                         ) from e
                     time.sleep(_LOCKED_RETRY_DELAY_S)
-        raise StorageError(f"journal intent {pod_key}/{container}: retries "
-                           "exhausted")  # pragma: no cover - loop returns
+            else:  # pragma: no cover - loop breaks or raises
+                raise StorageError(
+                    f"journal intent {pod_key}/{container}: retries "
+                    "exhausted"
+                )
+        # The intent must be DURABLE before the bind's first side effect
+        # (that is its whole point): wait out the covering group commit.
+        self._sync_wait(f"journal intent {pod_key}/{container}", token)
+        return intent_id
 
     def journal_commit(self, intent_id: int) -> None:
         """Mark a bind intent committed. The checkpointed allocation
@@ -360,11 +490,18 @@ class Storage:
         an intent simply removes its row — an intent that survives a
         crash is, by construction, one whose bind never provably
         finished."""
+        # Deliberately NOT sync under batching: the checkpointed pods-
+        # table record is the durable commit marker, so a crash that
+        # loses this row drop merely leaves an open intent whose record
+        # exists — the reconciler's intent_committed repair class
+        # resolves it (the bind.post_checkpoint crash window that has
+        # always existed, now a few ms wider).
         with self._lock:
             self._write(
                 f"journal commit {intent_id}",
                 "DELETE FROM bind_intents WHERE id=?",
                 (intent_id,),
+                sync=False,
             )
             self._inflight_intents.discard(intent_id)
 
@@ -457,13 +594,16 @@ class Storage:
         the drain orchestrator's crash-consistency contract."""
         faults.fire("storage.state")
         with self._lock:
-            self._write(
+            token = self._write(
                 f"save_state {key}",
                 "INSERT INTO agent_state(key, value, updated_ts) "
                 "VALUES(?, ?, ?) ON CONFLICT(key) DO UPDATE SET "
                 "value=excluded.value, updated_ts=excluded.updated_ts",
                 (key, json.dumps(value, sort_keys=True), time.time()),
             )
+        # Lifecycle journals are written BEFORE their side effects run —
+        # that ordering only means something if the row is durable first.
+        self._sync_wait(f"save_state {key}", token)
 
     def load_state(self, key: str) -> Optional[dict]:
         """The stored state document, or None when absent/corrupt (a
@@ -488,11 +628,12 @@ class Storage:
 
     def delete_state(self, key: str) -> None:
         with self._lock:
-            self._write(
+            token = self._write(
                 f"delete_state {key}",
                 "DELETE FROM agent_state WHERE key=?",
                 (key,),
             )
+        self._sync_wait(f"delete_state {key}", token)
 
     # -- lifecycle timeline journal (timeline.py) ------------------------------
 
@@ -519,11 +660,24 @@ class Storage:
         many rows the trim dropped. Returns the event's monotonic seq.
         One commit covers append + trim + counter, so a crash can never
         leave the counter disagreeing with the rows."""
+        # Timeline events are non-load-bearing by contract (emit swallows
+        # failures): under batching they never wait for their commit —
+        # the whole churn burst's events amortize into the flusher's
+        # window commits.
         keys_json = json.dumps(keys, sort_keys=True, default=str)
         attrs_json = json.dumps(attrs, sort_keys=True, default=str)
         with self._lock:
             for attempt in (1, 2):
                 try:
+                    if self._batcher is not None:
+                        # Multi-statement append inside a SHARED open
+                        # transaction: a savepoint scopes the rollback
+                        # of a mid-append failure to THIS append, so a
+                        # partial trim/counter update can never ride a
+                        # later group commit and break the
+                        # max(seq)-rows == evicted invariant — without
+                        # touching other writers' pending statements.
+                        self._db.execute("SAVEPOINT timeline_append")
                     cur = self._db.execute(
                         "INSERT INTO timeline(ts, kind, keys, attrs) "
                         "VALUES(?, ?, ?, ?)",
@@ -560,16 +714,28 @@ class Storage:
                             (self._TIMELINE_EVICTED_KEY, str(excess)),
                         )
                         self._timeline_rows_cache -= excess
-                    self._db.commit()
+                    if self._batcher is not None:
+                        self._db.execute("RELEASE timeline_append")
+                    self._commit_locked(sync=False)
                     return seq
                 except sqlite3.Error as e:
                     self._timeline_rows_cache = None
                     self._timeline_cap_stored = None  # write rolled back
                     transient = self._is_transient_lock(e) and attempt == 1
-                    try:
-                        self._db.rollback()
-                    except sqlite3.Error:
-                        pass
+                    if self._batcher is None:
+                        try:
+                            self._db.rollback()
+                        except sqlite3.Error:
+                            pass
+                    else:
+                        # Scoped undo: only this append's statements.
+                        try:
+                            self._db.execute(
+                                "ROLLBACK TO timeline_append"
+                            )
+                            self._db.execute("RELEASE timeline_append")
+                        except sqlite3.Error:
+                            pass
                     if not transient:
                         raise StorageError(f"timeline append: {e}") from e
                     time.sleep(_LOCKED_RETRY_DELAY_S)
@@ -658,6 +824,7 @@ class Storage:
                 "INSERT INTO timeline_meta(key, value) VALUES(?, ?) "
                 "ON CONFLICT(key) DO UPDATE SET value=excluded.value",
                 (key, value),
+                sync=False,  # journal meta: observability, like the ring
             )
 
     def _timeline_meta_int(self, key: str) -> Optional[int]:
@@ -739,6 +906,10 @@ class Storage:
         return [key for key, pod in self._rows() if pod is None]
 
     def close(self) -> None:
+        if self._batcher is not None:
+            # Flush-then-stop: pending batched writes (timeline tails,
+            # intent-commit drops) land before the connection closes.
+            self._batcher.stop()
         with self._lock:
             self._db.close()
 

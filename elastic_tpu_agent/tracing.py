@@ -147,6 +147,32 @@ class Tracer:
         self._lock = threading.Lock()
         self.completed = 0  # lifetime count (ring only keeps the newest)
 
+    def ring_bytes(self, sample: int = 16) -> int:
+        """Approximate bytes held by the trace ring: the JSON-encoded
+        size of the newest ``sample`` traces extrapolated over the ring
+        length. An estimate by design — exact accounting would
+        serialize every trace on every scrape; this is the bounded-
+        memory gauge (elastic_tpu_trace_ring_bytes) the scale harness
+        asserts a ceiling against, not a byte-exact ledger."""
+        import json
+
+        with self._lock:
+            n = len(self._ring)
+            if n == 0:
+                return 0
+            newest = [self._ring[-1 - i] for i in range(min(n, sample))]
+        sampled = 0
+        counted = 0
+        for tr in newest:
+            try:
+                sampled += len(json.dumps(tr.to_dict(), default=str))
+                counted += 1
+            except Exception:  # noqa: BLE001 - estimate must not raise
+                continue
+        if not counted:
+            return 0
+        return int(sampled / counted * n)
+
     # -- recording ------------------------------------------------------------
 
     @contextlib.contextmanager

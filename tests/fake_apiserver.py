@@ -3,6 +3,19 @@
 Serves list/watch/get pods (node fieldSelector honored) and get node over
 plain HTTP, enough to drive the Sitter's informer loop and the GC's
 apiserver-NotFound checks hermetically.
+
+Hardened for thousand-pod fleets (scale harness, sim/scale.py):
+
+- pod LISTs are PAGINATED server-side: ``limit``/``continue`` are
+  honored and a ``max_page_size`` cap is ENFORCED even when the client
+  asks for more (or for nothing) — so a client that forgets to follow
+  ``continue`` sees truncated lists in tests instead of silently
+  working against an unrealistically chatty fake;
+- every request is counted in ``request_counts`` by operation kind
+  (``pod_list``, ``pod_list_pages``, ``pod_watch``, ``pod_get``,
+  ``event_post``, ``crd_*``, ...), so request amplification is
+  assertable AT THE SOURCE rather than inferred from client-side
+  counters.
 """
 
 from __future__ import annotations
@@ -16,7 +29,13 @@ from urllib.parse import parse_qs, urlparse
 
 
 class FakeAPIServer:
-    def __init__(self) -> None:
+    # Server-side pagination cap on pod LISTs: pages never exceed this
+    # many items regardless of the client's ``limit`` (kube-apiservers
+    # cap page sizes the same way). Small enough that the scale
+    # harness's fleets actually exercise multi-page listing.
+    DEFAULT_MAX_PAGE_SIZE = 500
+
+    def __init__(self, max_page_size: int = DEFAULT_MAX_PAGE_SIZE) -> None:
         self._lock = threading.Lock()
         self._pods: Dict[Tuple[str, str], dict] = {}
         self._nodes: Dict[str, dict] = {}
@@ -27,6 +46,71 @@ class FakeAPIServer:
         self._watchers: List[queue.Queue] = []
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
+        self.max_page_size = max(1, max_page_size)
+        # operation kind -> requests served; the scale harness divides
+        # these by binds for apiserver-side request amplification.
+        self.request_counts: Dict[str, int] = {}
+        # Continuation snapshots: a real apiserver's continue token is
+        # pinned to the resourceVersion of the FIRST page — later pages
+        # never skip or duplicate objects because of concurrent
+        # writes. Token = "<snap_id>:<offset>" over a frozen key list;
+        # keys resolve to current objects (deleted ones drop out, which
+        # is within real list semantics). Bounded: abandoned snapshots
+        # age out.
+        self._list_snapshots: Dict[int, Tuple[list, str]] = {}
+        self._snap_seq = 0
+
+    def _snapshot_page(self, node: str, cont: str, limit: int):
+        """(keys_page, rv, next_continue) for one paginated pod LIST."""
+        with self._lock:
+            if cont:
+                try:
+                    snap_id, _, off = cont.partition(":")
+                    snap_id, offset = int(snap_id), int(off)
+                except ValueError:
+                    snap_id, offset = -1, 0
+                keys, rv = self._list_snapshots.get(snap_id, (None, ""))
+                if keys is None:
+                    return [], str(self._rv), None  # expired: end the list
+            else:
+                keys = sorted(
+                    key for key, p in self._pods.items()
+                    if not node
+                    or p.get("spec", {}).get("nodeName") == node
+                )
+                rv = str(self._rv)
+                offset = 0
+                snap_id = None
+                if len(keys) > limit:
+                    self._snap_seq += 1
+                    snap_id = self._snap_seq
+                    self._list_snapshots[snap_id] = (keys, rv)
+                    for old in [
+                        s for s in self._list_snapshots
+                        if s <= self._snap_seq - 32
+                    ]:
+                        del self._list_snapshots[old]
+            page = keys[offset:offset + limit]
+            items = [
+                self._pods[k] for k in page if k in self._pods
+            ]
+            next_cont = None
+            if snap_id is not None and offset + limit < len(keys):
+                next_cont = f"{snap_id}:{offset + limit}"
+            return items, rv, next_cont
+
+    def _count(self, kind: str, n: int = 1) -> None:
+        with self._lock:
+            self.request_counts[kind] = self.request_counts.get(kind, 0) + n
+
+    def requests_total(self) -> int:
+        """All requests served, watches excluded (a watch is one
+        long-lived connection, not per-object traffic)."""
+        with self._lock:
+            return sum(
+                v for k, v in self.request_counts.items()
+                if k not in ("pod_watch",)
+            )
 
     # -- state manipulation (test driver side) --------------------------------
 
@@ -112,21 +196,35 @@ class FakeAPIServer:
                 if parts[:3] == ["api", "v1", "pods"]:
                     node = params.get("fieldSelector", "").partition("=")[2]
                     if params.get("watch") == "true":
+                        outer._count("pod_watch")
                         return self._watch(node, params)
-                    with outer._lock:
-                        items = [
-                            p
-                            for p in outer._pods.values()
-                            if not node
-                            or p.get("spec", {}).get("nodeName") == node
-                        ]
-                        rv = str(outer._rv)
+                    outer._count("pod_list_pages")
+                    cont = params.get("continue", "")
+                    if not cont:
+                        # pages of one logical LIST count once
+                        outer._count("pod_list")
+                    try:
+                        want = int(params.get("limit", "") or 0)
+                    except ValueError:
+                        want = 0
+                    # ENFORCED server-side: the cap applies even to
+                    # clients that ask for more, or for nothing.
+                    limit = min(
+                        want if want > 0 else outer.max_page_size,
+                        outer.max_page_size,
+                    )
+                    page, rv, next_cont = outer._snapshot_page(
+                        node, cont, limit
+                    )
+                    meta = {"resourceVersion": rv}
+                    if next_cont is not None:
+                        meta["continue"] = next_cont
                     return self._json(
                         200,
                         {
                             "kind": "PodList",
-                            "items": items,
-                            "metadata": {"resourceVersion": rv},
+                            "items": page,
+                            "metadata": meta,
                         },
                     )
                 # /api/v1/namespaces/{ns}/pods/{name}
@@ -136,6 +234,7 @@ class FakeAPIServer:
                     and parts[4] == "pods"
                 ):
                     ns, name = parts[3], parts[5]
+                    outer._count("pod_get")
                     with outer._lock:
                         pod = outer._pods.get((ns, name))
                     if pod is None:
@@ -143,6 +242,7 @@ class FakeAPIServer:
                     return self._json(200, pod)
                 # /api/v1/nodes/{name}
                 if len(parts) == 4 and parts[:3] == ["api", "v1", "nodes"]:
+                    outer._count("node_get")
                     with outer._lock:
                         node_obj = outer._nodes.get(parts[3])
                     if node_obj is None:
@@ -151,6 +251,7 @@ class FakeAPIServer:
                 # /apis/elasticgpu.io/v1alpha1/elastictpus[/name]
                 if self._crd_parts(parts) is not None:
                     name = self._crd_parts(parts)
+                    outer._count("crd_list" if name == "" else "crd_get")
                     with outer._lock:
                         if name == "":
                             items = list(outer._crds.values())
@@ -211,6 +312,7 @@ class FakeAPIServer:
                     and parts[4] == "events"
                 ):
                     obj = self._read_body()
+                    outer._count("event_post")
                     with outer._lock:
                         outer._rv += 1
                         obj.setdefault("metadata", {})[
@@ -222,6 +324,7 @@ class FakeAPIServer:
                 # rejects POST-to-named-resource and duplicate creates.
                 if self._crd_parts(parts) == "":
                     obj = self._read_body()
+                    outer._count("crd_create")
                     # Status subresource semantics (the CRD declares
                     # `subresources: status: {}`): a real apiserver DROPS
                     # status on main-endpoint creates.
@@ -273,6 +376,7 @@ class FakeAPIServer:
                 if status_name:
                     # PUT /status: only the status field is applied.
                     obj = self._read_body()
+                    outer._count("crd_status_update")
                     err = updated = None
                     with outer._lock:
                         existing = outer._crds.get(status_name)
@@ -293,6 +397,7 @@ class FakeAPIServer:
                 name = self._crd_parts(parts)
                 if name:
                     obj = self._read_body()
+                    outer._count("crd_update")
                     err = None
                     with outer._lock:
                         prior = outer._crds.get(name)
@@ -330,6 +435,7 @@ class FakeAPIServer:
                 ):
                     ns, name = parts[3], parts[5]
                     patch = self._read_body()
+                    outer._count("pod_patch")
                     with outer._lock:
                         pod = outer._pods.get((ns, name))
                         if pod is None:
@@ -358,6 +464,7 @@ class FakeAPIServer:
                 parts = [p for p in urlparse(self.path).path.split("/") if p]
                 name = self._crd_parts(parts)
                 if name:
+                    outer._count("crd_delete")
                     with outer._lock:
                         outer._crds.pop(name, None)
                     return self._json(200, {"kind": "Status", "code": 200})

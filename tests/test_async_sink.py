@@ -97,3 +97,141 @@ def test_self_disable_after_consecutive_failures():
     time.sleep(0.05)
     assert ran == []
     sink.stop()
+
+
+# -- coalescing window + shared backoff clock (ISSUE 13) ----------------------
+
+
+def test_flush_window_batches_and_counts_merges():
+    """With a flush window, same-key ops submitted close together dedup
+    into ONE write (newest wins) and each superseded op is counted in
+    ``merged`` — the apiserver writes the window saved."""
+    ran = []
+    sink = AsyncSink("t", flush_window_s=0.15)
+    for i in range(4):
+        sink.submit(lambda i=i: ran.append(i), key="same-pod")
+    sink.submit(lambda: ran.append("other"))
+    assert sink.flush(timeout=5.0)
+    assert ran == [3, "other"], ran
+    assert sink.merged == 3
+    sink.stop()
+
+
+def test_failed_flush_bumps_streak_once_not_per_op():
+    """A dead apiserver with N queued ops costs ONE failure-streak bump
+    per flush attempt — the original shape burned the whole failure
+    budget (and N apiserver hits) on a single drain."""
+    attempts = []
+
+    def boom():
+        attempts.append(time.monotonic())
+        raise RuntimeError("apiserver down")
+
+    sink = AsyncSink(
+        "t", max_failures=3, backoff_min_s=0.05, backoff_max_s=0.2,
+    )
+    # 5 distinct ops queued at once: under per-op accounting this would
+    # disable the sink after ONE drain; under per-flush accounting each
+    # attempt bumps the streak ONCE. The head op is retried per attempt
+    # and dropped at its own max_failures cap (attempt 3), at which
+    # point the NEXT op gets one try in the same attempt — 4 op calls
+    # total across 3 flush attempts, nowhere near one call per queued
+    # op.
+    gate = threading.Event()
+    sink.submit(gate.wait)  # hold the worker so all 5 queue together
+    for _ in range(5):
+        sink.submit(boom)
+    gate.set()
+    assert sink.flush(timeout=10.0)
+    assert sink.disabled
+    assert len(attempts) == 4, attempts
+    assert sink.consecutive_failures == 3
+    # every queued op is accounted for: 1 dropped at its own cap, the
+    # rest dropped when the sink disabled
+    assert sink.dropped == 5
+    sink.stop()
+
+
+def test_failed_flush_backs_off_on_one_shared_clock():
+    """Consecutive failed flushes are spaced by the (growing) shared
+    backoff delay — not machine-gunned back to back — and a single
+    always-failing op is dropped at its own retry cap WITHOUT killing
+    the sink (poison-op tolerance: the old per-op accounting would
+    have disabled it and silently eaten all future writes)."""
+    attempts = []
+
+    def boom():
+        attempts.append(time.monotonic())
+        raise RuntimeError("down")
+
+    sink = AsyncSink(
+        "t", max_failures=3, backoff_min_s=0.2, backoff_max_s=1.0,
+    )
+    sink.submit(boom)
+    assert sink.flush(timeout=15.0)
+    assert len(attempts) == 3
+    # jitter is 0.5x-1.5x of the base: even the smallest first gap must
+    # clear half the minimum backoff
+    assert attempts[1] - attempts[0] >= 0.1, attempts
+    assert attempts[2] - attempts[1] >= 0.1, attempts
+    # the op died at ITS cap; the sink survives and still writes
+    assert sink.dropped == 1
+    assert not sink.disabled
+    ran = []
+    sink.submit(lambda: ran.append(1))
+    assert sink.flush(timeout=5.0)
+    assert ran == [1]
+    sink.stop()
+
+
+def test_flush_failure_requeues_and_recovers():
+    """Ops that a failed flush could not write are retried after the
+    backoff and ALL land once the target recovers; the streak resets."""
+    healthy = threading.Event()
+    ran = []
+
+    def flaky(i):
+        def op():
+            if not healthy.is_set():
+                raise RuntimeError("down")
+            ran.append(i)
+        return op
+
+    sink = AsyncSink(
+        "t", max_failures=5, backoff_min_s=0.05, backoff_max_s=0.2,
+    )
+    for i in range(4):
+        sink.submit(flaky(i))
+    time.sleep(0.15)  # let at least one flush attempt fail
+    healthy.set()
+    assert sink.flush(timeout=10.0)
+    assert ran == [0, 1, 2, 3], ran
+    assert not sink.disabled
+    assert sink.consecutive_failures == 0
+    sink.stop()
+
+
+def test_requeued_op_stays_superseded_by_newer_same_key():
+    """An op claimed into a failing flush whose key was re-submitted
+    while the flush was out must NOT clobber the newer op on re-queue."""
+    healthy = threading.Event()
+    ran = []
+
+    def op(tag, fail_gate=True):
+        def run():
+            if fail_gate and not healthy.is_set():
+                raise RuntimeError("down")
+            ran.append(tag)
+        return run
+
+    sink = AsyncSink(
+        "t", max_failures=10, backoff_min_s=0.05, backoff_max_s=0.2,
+    )
+    sink.submit(op("old"), key="k")
+    time.sleep(0.1)  # the failing flush claims "old"
+    sink.submit(op("new"), key="k")
+    healthy.set()
+    assert sink.flush(timeout=10.0)
+    assert ran == ["new"], ran
+    assert sink.merged >= 1
+    sink.stop()

@@ -202,3 +202,52 @@ def test_agent_metrics_pod_gauges_bounded_during_churn():
                     - metrics.pod_core_granted.series_count
                     - metrics.pod_core_used.series_count
                 )
+
+
+def test_bounded_while_scrape_runs_concurrently():
+    """The scale leg scrapes /metrics WHILE the fleet churns series
+    through the guards: collection (registry iteration) racing 10k+
+    concurrent set() calls must never observe more than cap series,
+    and the final accounting must still be exact."""
+    cap = 128
+    writers, keys_each = 4, 2_600  # 10400 distinct series
+    reg, evicted, guard = _make_guard(cap)
+    stop = threading.Event()
+    over_cap = []
+
+    # A scrape racing an in-flight set() may catch the new child gauge
+    # between its creation and the eviction that pays for it — one
+    # transient extra series per concurrent writer is the guard's
+    # documented jitter; UNBOUNDED growth is what must never appear.
+    scrape_bound = cap + writers
+
+    def scraper():
+        while not stop.is_set():
+            n = len(_series_values(reg))
+            if n > scrape_bound:
+                over_cap.append(n)
+
+    def writer(w):
+        for i in range(keys_each):
+            guard.set(float(i), pod=f"w{w}-{i}")
+
+    scrape_thread = threading.Thread(target=scraper, daemon=True)
+    scrape_thread.start()
+    threads = [
+        threading.Thread(target=writer, args=(w,), daemon=True)
+        for w in range(writers)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    stop.set()
+    scrape_thread.join(timeout=10)
+
+    assert not over_cap, (
+        f"scrape saw {max(over_cap)} series (bound {scrape_bound})"
+    )
+    inserted = writers * keys_each
+    assert guard.series_count == cap
+    assert len(_series_values(reg)) == cap
+    assert evicted._value.get() == inserted - cap

@@ -144,8 +144,11 @@ def test_recorder_self_disables_and_never_raises():
     assert rec.flush(timeout=5.0)
     rec.stop()
     assert rec.disabled
-    # ops after disablement were dropped, not attempted
-    assert client.calls == _MAX_CONSECUTIVE_FAILURES
+    # Shared-backoff flush accounting: the head op is retried to its
+    # own max_failures cap (N calls) then dropped; the next op's single
+    # failure lands the Nth consecutive failed flush and disables the
+    # sink. Ops after disablement were dropped, never attempted.
+    assert client.calls == _MAX_CONSECUTIVE_FAILURES + 1
 
 
 def test_bind_survives_broken_recorder(cluster):

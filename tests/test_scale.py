@@ -1,0 +1,196 @@
+"""Scale harness (elastic_tpu_agent/sim/scale.py) + the FakeAPIServer
+hardening that backs it (ISSUE 13 / ROADMAP item 1).
+
+Three layers:
+
+- the fake apiserver's server-side pagination + request counting (the
+  at-the-source amplification accounting the scale leg asserts on);
+- the client pagination that must survive it (list_all_pods and the
+  sitter's node-scoped list_pods);
+- a small end-to-end harness run (2 nodes) through every scenario phase
+  with the structural checker, in both storage shapes.
+
+`make scale-smoke` runs the real thing at 8x64; these tests keep the
+machinery honest inside tier-1.
+"""
+
+import json
+import tempfile
+import urllib.request
+
+import pytest
+
+from elastic_tpu_agent.kube.client import KubeClient
+
+from fake_apiserver import FakeAPIServer, make_pod
+
+
+@pytest.fixture()
+def api():
+    server = FakeAPIServer(max_page_size=100)
+    url = server.start()
+    yield server, url
+    server.stop()
+
+
+def _fill(server, n, node="n0", namespace="ns"):
+    for i in range(n):
+        server.upsert_pod(make_pod(namespace, f"p{i:04d}", node))
+
+
+# -- server-side pagination enforcement ---------------------------------------
+
+
+def test_list_page_capped_even_without_limit_param(api):
+    """A client that sends no limit gets AT MOST max_page_size items
+    and a continue token — forgetting to paginate shows up as a
+    truncated view in tests, not as a silently-unrealistic fake."""
+    server, url = api
+    _fill(server, 250)
+    with urllib.request.urlopen(f"{url}/api/v1/pods") as resp:
+        body = json.loads(resp.read())
+    assert len(body["items"]) == 100
+    assert body["metadata"]["continue"]
+
+
+def test_list_limit_above_cap_is_clamped(api):
+    server, url = api
+    _fill(server, 250)
+    with urllib.request.urlopen(f"{url}/api/v1/pods?limit=10000") as resp:
+        body = json.loads(resp.read())
+    assert len(body["items"]) == 100
+
+
+def test_list_all_pods_follows_continue_and_is_counted(api):
+    server, url = api
+    _fill(server, 250)
+    client = KubeClient(url)
+    pods = client.list_all_pods(page_limit=100)
+    assert len(pods) == 250
+    assert {p["metadata"]["name"] for p in pods} == {
+        f"p{i:04d}" for i in range(250)
+    }
+    # one logical LIST, three pages — both visible at the source
+    assert server.request_counts["pod_list"] == 1
+    assert server.request_counts["pod_list_pages"] == 3
+
+
+def test_node_scoped_list_pods_paginates(api):
+    """The sitter's fieldSelector list must survive server-enforced
+    paging: a busy node can hold more pods than one page."""
+    server, url = api
+    _fill(server, 150, node="busy")
+    _fill(server, 30, node="other", namespace="elsewhere")
+    client = KubeClient(url)
+    items, rv = client.list_pods("busy", page_limit=60)
+    assert len(items) == 150
+    assert rv  # the list resourceVersion still rides along
+    assert all(
+        p["spec"]["nodeName"] == "busy" for p in items
+    )
+
+
+def test_request_counts_by_operation_kind(api):
+    server, url = api
+    _fill(server, 3)
+    client = KubeClient(url)
+    client.get_pod("ns", "p0000")
+    client.get_pod("ns", "nope")
+    client.create_event("ns", {"metadata": {"name": "e"}})
+    assert server.request_counts["pod_get"] == 2
+    assert server.request_counts["event_post"] == 1
+    # driver-side upserts are not HTTP requests; only real traffic counts
+    assert server.requests_total() == 3
+
+
+# -- the structural checker ----------------------------------------------------
+
+
+def _ok_report():
+    return {
+        "pods": 10,
+        "stored_binds": 10,
+        "fleet_bind_p99_ms": 5.0,
+        "phases": {
+            "admission_waves": {"admitted": 10, "bound": 10, "errors": 0},
+            "steady_churn": {"deleted": 2, "replaced": 2, "rebound": 2,
+                             "errors": 0},
+            "drain_wave": {"nodes": 1},
+            "slice_reform": {"world": 2},
+            "repartition_ticks": {"ticks": 2},
+            "cardinality_storm": {"series_inserted": 100, "problems": []},
+        },
+        "reconcile_convergence_s": {"unconverged_nodes": []},
+        "amplification": {
+            "kubelet_lists_per_bind": 0.9,
+            "apiserver_requests_per_bind": 4.0,
+            "sink_writes_per_bind": {"events": 1.1, "crd": 1.2},
+        },
+        "memory": {
+            "rss_delta_per_series_bytes": 5000.0,
+            "trace_ring_bytes": 1_000_000,
+        },
+    }
+
+
+def test_scale_problems_empty_for_healthy_report():
+    from elastic_tpu_agent.sim import scale_problems
+
+    assert scale_problems(_ok_report()) == []
+
+
+def test_scale_problems_flags_each_violation():
+    from elastic_tpu_agent.sim import scale_problems
+
+    report = _ok_report()
+    report["stored_binds"] = 9
+    report["phases"]["admission_waves"]["bound"] = 9
+    report["reconcile_convergence_s"]["unconverged_nodes"] = ["sim-1"]
+    report["amplification"]["kubelet_lists_per_bind"] = 5.0
+    report["memory"]["rss_delta_per_series_bytes"] = 10 * 1024 * 1024
+    problems = scale_problems(report)
+    assert len(problems) >= 5
+    joined = "\n".join(problems)
+    for needle in ("stored binds", "admission waves", "unconverged",
+                   "kubelet_lists_per_bind", "ceiling"):
+        assert needle in joined, f"{needle!r} not flagged:\n{joined}"
+
+
+# -- small end-to-end run -------------------------------------------------------
+
+
+@pytest.mark.parametrize("batched", [True, False], ids=["batched", "raw"])
+def test_scale_harness_small_e2e(batched):
+    """2 complete agents through every scenario phase; the structural
+    checker must come back clean in both storage shapes. The full-size
+    run is `make scale-smoke` / `bench.py --scale`."""
+    from elastic_tpu_agent.sim import ScaleHarness, scale_problems
+
+    with tempfile.TemporaryDirectory(prefix="etpu-scale-t") as tmp:
+        harness = ScaleHarness(
+            tmp,
+            nodes=2,
+            pods_per_node=16,
+            admission_waves=2,
+            drain_nodes=1,
+            slice_world=2,
+            cardinality_series_total=1200,
+            storage_batch_window_s=0.005 if batched else 0.0,
+            sink_flush_window_s=0.02 if batched else 0.0,
+            reconcile_period_s=1.0,
+            convergence_timeout_s=60.0,
+            phase_timeout_s=60.0,
+        )
+        report = harness.run()
+    assert scale_problems(report) == []
+    assert report["pods"] == report["stored_binds"]
+    waves = report["phases"]["admission_waves"]
+    assert waves["bound"] == waves["admitted"] == 32
+    stats = report["amplification"]
+    if batched:
+        assert stats["storage_writes_per_commit"] > 1.0
+    else:
+        assert stats["storage_writes_per_commit"] == 1.0
+    storm = report["phases"]["cardinality_storm"]
+    assert storm["series_inserted"] >= 1200
+    assert storm["problems"] == []

@@ -364,3 +364,195 @@ def test_journal_is_ordered_and_independent_of_pods_table(store):
     store.journal_remove(a)
     assert [r["hash"] for r in store.open_intents()] == ["h2"]
     store.journal_remove(b)
+
+
+# -- group-commit write batching (storage/batcher.py, ISSUE 13) ---------------
+#
+# Batched storage must keep the crash-consistency contract exactly:
+# load-bearing writes (saves, intent journals, agent_state) are DURABLE
+# before the call returns — provable from a second connection, no
+# close() required — while non-load-bearing writes (timeline events,
+# intent-commit row drops) flush within the window and always land by
+# close(). And it must actually coalesce: many writes, few commits.
+
+
+@pytest.fixture()
+def batched_store(tmp_path):
+    s = Storage(str(tmp_path / "meta.db"), batch_window_s=0.01)
+    yield s
+    s.close()
+
+
+def _second_connection(tmp_path):
+    return Storage(str(tmp_path / "meta.db"))
+
+
+def test_batched_sync_write_durable_before_return(tmp_path, batched_store):
+    """A save is the bind's durable commit marker: the moment save()
+    returns, a DIFFERENT connection (a crashed process's successor)
+    must see it — no close, no flush call."""
+    batched_store.save(make_pod(name="durable-now"))
+    intent = batched_store.journal_intent(
+        "default/durable-now", "main", "elasticgpu.io/tpu-core", "abcd",
+        {"device_ids": ["d1"]},
+    )
+    reader = _second_connection(tmp_path)
+    try:
+        assert reader.load("default", "durable-now") is not None
+        assert [i["id"] for i in reader.open_intents()] == [intent]
+    finally:
+        reader.close()
+
+
+def test_batched_async_writes_flush_within_window(tmp_path, batched_store):
+    """Timeline events don't wait for their commit, but the flusher
+    lands them within ~a window — they must not sit open forever."""
+    import time as _time
+
+    batched_store.timeline_append(1.0, "k", {"pod": "a/b"}, {}, 64)
+    reader = _second_connection(tmp_path)
+    try:
+        deadline = _time.monotonic() + 5.0
+        while _time.monotonic() < deadline:
+            if reader.timeline_count() == 1:
+                break
+            # foreign-read caches pin the view; a fresh connection per
+            # poll sidesteps them
+            reader.close()
+            reader = _second_connection(tmp_path)
+            _time.sleep(0.02)
+        assert reader.timeline_count() == 1
+    finally:
+        reader.close()
+
+
+def test_batched_close_flushes_pending(tmp_path):
+    s = Storage(str(tmp_path / "meta.db"), batch_window_s=5.0)
+    s.timeline_append(1.0, "k", {}, {}, 64)  # async; window far away
+    s.close()  # must flush, not abandon
+    reader = _second_connection(tmp_path)
+    try:
+        assert reader.timeline_count() == 1
+    finally:
+        reader.close()
+
+
+def test_batched_coalesces_commits(tmp_path):
+    """The point of the whole exercise: N logical writes, far fewer
+    sqlite commits."""
+    s = Storage(str(tmp_path / "meta.db"), batch_window_s=0.005)
+    try:
+        def writer(w):
+            for i in range(25):
+                intent = s.journal_intent(
+                    f"ns/p{w}-{i}", "c", "r", "h", {}
+                )
+                s.save(make_pod(ns="ns", name=f"p{w}-{i}"))
+                s.journal_commit(intent)
+                s.timeline_append(1.0, "bind", {"pod": f"p{w}-{i}"}, {}, 4096)
+        threads = [
+            threading.Thread(target=writer, args=(w,)) for w in range(4)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        stats = s.write_stats()
+        assert stats["batching"] is True
+        assert stats["writes_total"] == 4 * 25 * 4
+        assert stats["commits_total"] < stats["writes_total"] / 2, stats
+        assert s.count() == 100
+        assert s.open_intents() == []
+    finally:
+        s.close()
+    reader = _second_connection(tmp_path)
+    try:
+        assert reader.count() == 100
+        assert reader.timeline_count() == 100
+        assert reader.open_intents() == []
+    finally:
+        reader.close()
+
+
+def test_batched_mutate_matches_unbatched_semantics(tmp_path):
+    """The same concurrent same-key mutate() storm in both storage
+    shapes lands the same final record (group commit changes WHEN
+    commits happen, never what is committed)."""
+    results = {}
+    for tag, window in (("batched", 0.005), ("unbatched", 0.0)):
+        s = Storage(str(tmp_path / f"{tag}.db"), batch_window_s=window)
+        try:
+            def bump2(w):
+                for i in range(20):
+                    s.mutate(
+                        "ns", "hot",
+                        lambda info: info.set_allocation(
+                            f"c{w}-{i}",
+                            AllocationRecord(
+                                device=Device(["d"], "elasticgpu.io/tpu-core"),
+                                chip_indexes=[0],
+                                created_node_ids=[],
+                            ),
+                        ),
+                    )
+            threads = [
+                threading.Thread(target=bump2, args=(w,)) for w in range(3)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=60)
+            info = s.load("ns", "hot")
+            results[tag] = sorted(info.allocations)
+        finally:
+            s.close()
+    assert results["batched"] == results["unbatched"]
+    assert len(results["batched"]) == 60
+
+
+def test_batcher_failed_flush_fails_straddling_generations():
+    """A failed commit rolls back the WHOLE open transaction — a writer
+    whose statement executed after the flusher claimed generation N but
+    before N's commit failed was assigned N+1, and its statement died
+    in the same rollback: its wait() must raise too, never be satisfied
+    by a later (now-empty) successful commit."""
+    import threading as _threading
+
+    from elastic_tpu_agent.storage.batcher import (
+        GroupCommitBatcher,
+        GroupCommitError,
+    )
+
+    lock = _threading.RLock()
+    commit_started = _threading.Event()
+    release_commit = _threading.Event()
+    fail = {"armed": True}
+
+    def commit_fn():
+        commit_started.set()
+        release_commit.wait(10.0)
+        if fail["armed"]:
+            fail["armed"] = False
+            raise RuntimeError("disk full")
+
+    batcher = GroupCommitBatcher(
+        commit_fn, lambda: None, window_s=0.005, lock=lock
+    )
+    try:
+        gen_n = batcher.mark_dirty(sync=True)
+        assert commit_started.wait(5.0)  # flusher is inside N's commit
+        # the straddling writer: statement "executes" (lock held) while
+        # the commit is in flight, lands in generation N+1
+        with lock:
+            gen_next = batcher.mark_dirty(sync=True)
+        assert gen_next == gen_n + 1
+        release_commit.set()  # N's commit now fails and rolls back
+        with pytest.raises(GroupCommitError):
+            batcher.wait(gen_n, timeout_s=10.0)
+        with pytest.raises(GroupCommitError):
+            batcher.wait(gen_next, timeout_s=10.0)
+        # the batcher recovers: a fresh write commits cleanly
+        gen_fresh = batcher.mark_dirty(sync=True)
+        batcher.wait(gen_fresh, timeout_s=10.0)
+    finally:
+        batcher.stop()
