@@ -5,7 +5,7 @@ TAG ?= elastic-tpu-agent:latest
 # verify's tier-1 line uses pipefail, which /bin/sh (dash) lacks
 SHELL := /bin/bash
 
-.PHONY: all native sanitize test test-all verify doctor-smoke chaos-smoke bench-smoke crash-replay-smoke fleet-smoke scale-smoke slice-smoke drain-smoke timeline-smoke serving-smoke qos-smoke protos image bench clean
+.PHONY: all native sanitize test test-all verify doctor-smoke chaos-smoke bench-smoke crash-replay-smoke fleet-smoke scale-smoke slice-smoke drain-smoke migrate-smoke timeline-smoke serving-smoke qos-smoke protos image bench clean
 
 all: native test
 
@@ -68,7 +68,8 @@ bench-smoke:
 # mid-bind crash window (die-thread failpoints), each mid-DRAIN window
 # (drain.pre_cordon/post_signal/pre_reclaim) and each mid-REPARTITION
 # window (repartition.pre_journal/post_journal/mid_restamp plus the
-# between-sibling-spec-files restamp.spec_file tear), restarts the
+# between-sibling-spec-files restamp.spec_file tear), each mid-MIGRATION
+# window (migration.pre_ack/post_record), restarts the
 # manager over the surviving store + fake kubelet, and asserts
 # convergence to the crash-free end state (empty bind-intent journal;
 # resumed drain lifecycle; no pod left at a torn quota) — AND that the
@@ -79,7 +80,7 @@ bench-smoke:
 crash-replay-smoke:
 	JAX_PLATFORMS=cpu python -m pytest tests/test_reconciler.py \
 	  tests/test_drain.py tests/test_timeline.py \
-	  tests/test_repartition.py -q \
+	  tests/test_repartition.py tests/test_migration.py -q \
 	  -p no:cacheprovider && echo "crash replay smoke: OK"
 
 # fleet smoke: the cluster-in-a-box simulator (bench.py --fleet-smoke):
@@ -131,6 +132,18 @@ slice-smoke:
 drain-smoke:
 	JAX_PLATFORMS=cpu python3 bench.py --drain-smoke
 
+# migrate smoke: the verified-migration gate (bench.py --migrate-smoke):
+# a 4-node fleet runs stub workloads with the REAL lifecycle watcher; a
+# maintenance drain on one node must produce an acked early reclaim
+# with measured margin > 0 before the deadline, a published
+# MigrationRecord the replacement pod (re-admitted on another node)
+# restores from with the destination verifying the resume at the acked
+# step, survivor slice members checkpoint-acking the reform at the
+# post-reform world size, and an un-acked resident still honoring the
+# FULL deadline. Structural, deterministic.
+migrate-smoke:
+	JAX_PLATFORMS=cpu python3 bench.py --migrate-smoke
+
 # timeline smoke: the lifecycle-journal gate (bench.py
 # --timeline-smoke): a 4-agent fleet takes a churn burst sized past
 # the timeline ring cap, forms a slice, then drains one member through
@@ -170,7 +183,7 @@ qos-smoke:
 	JAX_PLATFORMS=cpu python3 bench.py --qos-smoke
 
 T1_TIMEOUT ?= 870
-verify: doctor-smoke chaos-smoke bench-smoke crash-replay-smoke fleet-smoke scale-smoke slice-smoke drain-smoke timeline-smoke serving-smoke qos-smoke
+verify: doctor-smoke chaos-smoke bench-smoke crash-replay-smoke fleet-smoke scale-smoke slice-smoke drain-smoke migrate-smoke timeline-smoke serving-smoke qos-smoke
 	python -c "from prometheus_client import CollectorRegistry; \
 	  from elastic_tpu_agent.metrics import AgentMetrics; \
 	  AgentMetrics(registry=CollectorRegistry()); \

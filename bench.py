@@ -555,6 +555,7 @@ def run_fleet(
     convergence_timeout_s=60.0,
     slice_scenario=True,
     drain_scenario=True,
+    migrate_scenario=True,
 ):
     from elastic_tpu_agent.sim import FleetAggregator, FleetSim
 
@@ -636,6 +637,26 @@ def run_fleet(
                 }
         finally:
             sim.stop()
+        # Verified-migration leg (ISSUE 14): its own small sim + scratch
+        # checkpoint PVC — the scenario drains a node, early-reclaims on
+        # ack and re-admits the workload across nodes, so it must not
+        # share the fleet churn's nodes. Same skip/fail contract as the
+        # other legs.
+        if migrate_scenario:
+            try:
+                migration_report = run_migrate_leg(
+                    timeout_s=convergence_timeout_s
+                )
+            except Exception as e:  # noqa: BLE001 - failure, not a skip
+                migration_report = {
+                    "failed": True,
+                    "error": f"{type(e).__name__}: {e}",
+                }
+        else:
+            migration_report = {
+                "skipped": True,
+                "reason": "migration scenario disabled for this run",
+            }
         fleet = rollup["fleet"]
         return {
             "nodes": nodes,
@@ -654,6 +675,10 @@ def run_fleet(
             # drain-to-reclaim latency + proactive reform convergence
             # (or an explicit skip)
             "drain": drain_report,
+            # verified migration: acked early-reclaim margin +
+            # drain-to-resume downtime vs the deadline baseline (or an
+            # explicit skip/fail)
+            "migration": migration_report,
             "driver": driver,
             "stored_binds": stored,
             "per_node": rollup["per_node"],
@@ -698,10 +723,12 @@ def fleet_smoke_main():
             pods_per_node=FLEET_SMOKE_PODS_PER_NODE,
             reconcile_period_s=1.0,
             trace_samples=20,
-            # `make slice-smoke` / `make drain-smoke` own the chaos
-            # gates; keep this one focused (and its runtime bounded).
+            # `make slice-smoke` / `make drain-smoke` / `make
+            # migrate-smoke` own the chaos gates; keep this one focused
+            # (and its runtime bounded).
             slice_scenario=False,
             drain_scenario=False,
+            migrate_scenario=False,
         )
     except Exception as e:  # noqa: BLE001
         print(json.dumps({"fleet_smoke": {
@@ -1381,6 +1408,316 @@ def drain_smoke_main():
         return 1
     print("drain smoke: OK", file=sys.stderr)
     return 0
+
+
+# -- migration handshake: drain -> ack -> early reclaim -> verified resume ----
+
+MIGRATE_NODES = 4
+MIGRATE_DEADLINE_S = 10.0
+MIGRATE_SMOKE_TIMEOUT_S = 90.0
+
+
+def run_migrate_scenario(sim, ckpt_root, timeout_s=60.0):
+    """Drive the verified-migration chaos scenario on a RUNNING FleetSim
+    (ISSUE 14 acceptance): a maintenance drain on node 3 — hosting a
+    training pod (stub workload with the REAL LifecycleWatcher), an
+    un-acked pod, and a slice member — must produce (a) an acked early
+    reclaim with measured margin > 0 before the deadline, (b) a
+    published MigrationRecord the replacement pod (re-admitted on node
+    0) restores from, with the destination verifying the resume at the
+    acked step, (c) survivor slice members checkpoint-acking the reform
+    at the post-reform world size, and (d) the un-acked pod still
+    honoring the FULL deadline. Returns a report dict (``problems``
+    empty = every invariant held)."""
+    from elastic_tpu_agent.crd import ElasticTPUClient
+    from elastic_tpu_agent.kube.client import KubeClient
+    from elastic_tpu_agent.migration import migration_object_name
+    from elastic_tpu_agent.slice_env import ordered_worker_hostnames
+    from elastic_tpu_agent.workloads.lifecycle import read_checkpoint_ack
+
+    problems = []
+    victim_idx, dest_idx = 3, 0
+    # Slice of 3 on nodes 1..3 (member m2 rides the drained host), a
+    # migrating training pod and a never-acking pod both on node 3.
+    slice_refs = sim.admit_slice(
+        "mig-slice", [1, 2, victim_idx], accelerator_type=DRAIN_ACCEL
+    )
+    train = sim.admit_pod("train", "job", victim_idx, chip=1)
+    noack = sim.admit_pod("train", "noack", victim_idx, chip=2)
+    sim.wait_synced(slice_refs + [train, noack])
+    for ref in slice_refs + [train, noack]:
+        sim.bind_pod(ref)
+    workloads = {}
+    w_train = sim.start_workload(
+        train, os.path.join(ckpt_root, "job"), tick_s=0.01
+    )
+    workloads["train"] = w_train
+    member_w = []
+    for i, ref in enumerate(slice_refs):
+        w = sim.start_workload(
+            ref, os.path.join(ckpt_root, f"m{i}"), tick_s=0.01
+        )
+        member_w.append(w)
+        workloads[f"m{i}"] = w
+    time.sleep(0.2)  # a few training steps before the trigger
+
+    trigger_wall_ts = time.time()
+    t0 = time.perf_counter()
+    sim.trigger_maintenance(victim_idx)
+    sim.wait_drain_state(
+        victim_idx, ("draining", "drained", "reclaimed"),
+        timeout_s=timeout_s,
+    )
+    victim_mgr = lambda: sim.nodes[victim_idx].manager  # noqa: E731
+    deadline_ts = victim_mgr().drain.deadline_ts
+
+    # (a) acked early reclaim: the training pod checkpoints, acks and
+    # exits; its bindings must be gone with margin BEFORE the deadline.
+    if not w_train.exited.wait(timeout_s):
+        problems.append("training workload never saw the drain signal")
+    early_margin = None
+    wait_until = time.monotonic() + timeout_s
+    while time.monotonic() < wait_until:
+        if victim_mgr().storage.load("train", "job") is None:
+            early_margin = deadline_ts - time.time()
+            break
+        time.sleep(0.02)
+    if early_margin is None:
+        problems.append("acked resident was never reclaimed")
+    elif early_margin <= 0:
+        problems.append(
+            f"acked drain reclaimed AFTER the deadline "
+            f"(margin {early_margin:.2f}s)"
+        )
+    early_reclaim_s = time.perf_counter() - t0
+
+    # (b) MigrationRecord published at the apiserver.
+    crd = ElasticTPUClient(KubeClient(sim.api_url))
+    record_name = migration_object_name("train", "job")
+    record = None
+    wait_until = time.monotonic() + timeout_s
+    while time.monotonic() < wait_until:
+        obj = crd.get(record_name)
+        if obj is not None and obj.migration:
+            record = obj.migration
+            break
+        time.sleep(0.05)
+    if record is None:
+        problems.append("MigrationRecord never reached the apiserver")
+    elif record.get("step") != w_train.saved_step:
+        problems.append(
+            f"record step {record.get('step')} != workload's saved "
+            f"step {w_train.saved_step}"
+        )
+
+    # (c) proactive reform to world 2 + survivor members acking the
+    # reform at the POST-REFORM world size.
+    surviving_hosts = [sim.nodes[1].name, sim.nodes[2].name]
+    surviving_order, _ = ordered_worker_hostnames(surviving_hosts)
+    try:
+        sim.wait_slice_reformed(
+            slice_refs[:2], surviving_order, expected_epoch=1,
+            timeout_s=timeout_s,
+        )
+    except RuntimeError as e:
+        problems.append(f"proactive reform: {e}")
+    reform_world_acks = 0
+    wait_until = time.monotonic() + timeout_s
+    while time.monotonic() < wait_until and reform_world_acks < 2:
+        reform_world_acks = 0
+        for ref in slice_refs[:2]:
+            ack = read_checkpoint_ack(
+                sim.nodes[ref.node_idx].opts.alloc_spec_dir,
+                sim.alloc_hash_of(ref),
+            )
+            if (
+                ack is not None and ack.get("epoch") == 1
+                and ack.get("world_size") == 2
+            ):
+                reform_world_acks += 1
+        time.sleep(0.05)
+    if reform_world_acks < 2:
+        problems.append(
+            "survivor members never acked the reform at the "
+            "post-reform world size (want 2 acks with world_size=2)"
+        )
+
+    # (d) replacement admission on node 0: the destination agent finds
+    # the record, stamps the restore env, and VERIFIES the resume.
+    sim.delete_pods([train])  # the node controller's eviction
+    rep = sim.admit_pod("train", "job", dest_idx, chip=1)
+    sim.wait_synced([rep])
+    sim.bind_pod(rep)
+    w_rep = sim.start_workload(
+        rep, os.path.join(ckpt_root, "job"), tick_s=0.01,
+        resume_wait_s=20.0,
+    )
+    workloads["replacement"] = w_rep
+    downtime_s = None
+    completion = None
+    try:
+        completion = sim.wait_migration_completed(
+            dest_idx, "train/job", timeout_s=timeout_s
+        )
+        downtime_s = time.time() - trigger_wall_ts
+    except RuntimeError as e:
+        problems.append(f"resume verification: {e}")
+    if completion is not None:
+        if w_rep.resumed_step != w_train.saved_step:
+            problems.append(
+                f"replacement resumed at step {w_rep.resumed_step}, "
+                f"source acked step {w_train.saved_step}"
+            )
+        if completion.get("step") != w_train.saved_step:
+            problems.append(
+                f"verified completion step {completion.get('step')} != "
+                f"acked step {w_train.saved_step}"
+            )
+        if completion.get("trace") != train.trace_id:
+            problems.append(
+                "completion lost the source bind's trace id "
+                f"({completion.get('trace')!r} != {train.trace_id!r})"
+            )
+    # the completed record must be deleted (a stale record would make
+    # the NEXT generation under this identity restore old state)
+    wait_until = time.monotonic() + 10.0
+    while time.monotonic() < wait_until and crd.get(record_name) is not None:
+        time.sleep(0.05)
+    if crd.get(record_name) is not None:
+        problems.append("completed MigrationRecord not deleted")
+
+    # (e) the un-acked pod honors the FULL deadline: its record must
+    # still exist until the deadline, and reclaim only at/after it.
+    if victim_mgr().storage.load("train", "noack") is None and (
+        time.time() < deadline_ts - 0.25
+    ):
+        problems.append("un-acked resident reclaimed before the deadline")
+    sim.wait_drain_state(
+        victim_idx, ("reclaimed",),
+        timeout_s=MIGRATE_DEADLINE_S + timeout_s,
+    )
+    noack_gone_ts = None
+    wait_until = time.monotonic() + timeout_s
+    while time.monotonic() < wait_until:
+        if victim_mgr().storage.load("train", "noack") is None:
+            noack_gone_ts = time.time()
+            break
+        time.sleep(0.02)
+    if noack_gone_ts is None:
+        problems.append("un-acked resident never reclaimed at deadline")
+    elif noack_gone_ts < deadline_ts - 0.25:
+        problems.append(
+            f"un-acked resident reclaimed {deadline_ts - noack_gone_ts:.2f}s "
+            "before the deadline"
+        )
+    status = victim_mgr().drain.status()
+    if status.get("outcome") != "reclaimed":
+        problems.append(
+            f"drain outcome {status.get('outcome')!r} != 'reclaimed' "
+            "(the un-acked resident rode to the deadline)"
+        )
+    if "train/job" not in status.get("acked_pods", []):
+        problems.append(
+            f"drain status lost the acked resident: {status}"
+        )
+
+    # Event trail: the handshake's two new events reached the apiserver.
+    wanted = {"TPUMigrationRecorded", "TPUMigrationCompleted"}
+    wait_until = time.monotonic() + 10.0
+    while time.monotonic() < wait_until:
+        reasons = {e.get("reason") for e in sim.apiserver.core_events}
+        if wanted <= reasons:
+            break
+        time.sleep(0.05)
+    else:
+        reasons = {e.get("reason") for e in sim.apiserver.core_events}
+    for want in sorted(wanted - reasons):
+        problems.append(f"no {want} event reached the apiserver")
+
+    for w in workloads.values():
+        w.stop()
+    mig_status = victim_mgr().migration.status()
+    return {
+        "deadline_s": sim.drain_deadline_s,
+        "early_reclaim_s": round(early_reclaim_s, 3),
+        "early_reclaim_margin_s": (
+            round(early_margin, 3) if early_margin is not None else None
+        ),
+        "drain_to_resume_downtime_s": (
+            round(downtime_s, 3) if downtime_s is not None else None
+        ),
+        "deadline_baseline_s": sim.drain_deadline_s,
+        "acked_step": w_train.saved_step,
+        "resumed_step": w_rep.resumed_step,
+        "reform_world_acks": reform_world_acks,
+        "early_reclaims_total": mig_status.get("early_reclaims_total"),
+        "records_published_total": mig_status.get(
+            "records_published_total"
+        ),
+        "completion": completion,
+        "victim_drain_outcome": status.get("outcome"),
+        "problems": problems,
+    }
+
+
+def run_migrate_leg(timeout_s=MIGRATE_SMOKE_TIMEOUT_S):
+    """A self-contained migrate leg (own small FleetSim + scratch
+    checkpoint 'PVC'): used by `bench.py --migrate`, `make
+    migrate-smoke` and the fleet leg's ``migration`` block."""
+    from elastic_tpu_agent.sim import FleetSim
+
+    with tempfile.TemporaryDirectory(prefix="etpu-mig") as tmp:
+        sim = FleetSim(
+            os.path.join(tmp, "f"), nodes=MIGRATE_NODES,
+            reconcile_period_s=0.5, slice_membership_ttl_s=0.25,
+            drain_deadline_s=MIGRATE_DEADLINE_S, drain_period_s=0.25,
+            migration_period_s=0.1,
+        )
+        os.makedirs(os.path.join(tmp, "f"), exist_ok=True)
+        ckpt_root = os.path.join(tmp, "pvc")
+        try:
+            sim.start()
+            return run_migrate_scenario(
+                sim, ckpt_root, timeout_s=timeout_s
+            )
+        finally:
+            sim.stop()
+
+
+def migrate_smoke_main():
+    """`make migrate-smoke`: the verified-migration gate — acked drain
+    reclaims before the deadline (measured margin > 0), the destination
+    verifies the resume at the acked step, survivor members ack the
+    reform at the post-reform world size, and the un-acked resident
+    still honors the full deadline. Structural, deterministic."""
+    try:
+        r = run_migrate_leg()
+    except Exception as e:  # noqa: BLE001
+        print(json.dumps({"migrate_smoke": {
+            "error": f"{type(e).__name__}: {e}"
+        }}))
+        print(f"migrate smoke FAILED: {type(e).__name__}: {e}",
+              file=sys.stderr)
+        return 1
+    print(json.dumps({"migrate_smoke": r}))
+    if r["problems"]:
+        for p in r["problems"]:
+            print(f"migrate smoke FAILED: {p}", file=sys.stderr)
+        return 1
+    print("migrate smoke: OK", file=sys.stderr)
+    return 0
+
+
+def migrate_main():
+    """`bench.py --migrate`: the migration leg alone, one JSON line
+    (same shape the fleet leg embeds under ``migration``) — headline:
+    drain-to-resume downtime vs the deadline-reclaim baseline."""
+    try:
+        r = run_migrate_leg()
+    except Exception as e:  # noqa: BLE001 - explicit failure, not silence
+        r = {"failed": True, "error": f"{type(e).__name__}: {e}"}
+    print(json.dumps({"migration": r}))
+    return 0 if not r.get("failed") and not r.get("problems") else 1
 
 
 # -- lifecycle timeline: churn + reform + drain as ONE story ------------------
@@ -3161,6 +3498,10 @@ if __name__ == "__main__":
         sys.exit(slice_smoke_main())
     elif "--drain-smoke" in sys.argv:
         sys.exit(drain_smoke_main())
+    elif "--migrate-smoke" in sys.argv:
+        sys.exit(migrate_smoke_main())
+    elif "--migrate" in sys.argv:
+        sys.exit(migrate_main())
     elif "--timeline-smoke" in sys.argv:
         sys.exit(timeline_smoke_main())
     elif "--serving-smoke" in sys.argv:

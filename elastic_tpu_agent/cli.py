@@ -138,6 +138,15 @@ def parse_args(argv=None) -> argparse.Namespace:
                    help="seconds between the overcommit throttle clamp "
                         "and binding reclaim for a pod that stays over "
                         "quota (repartition.py)")
+    p.add_argument("--migration-period", type=float, default=2.0,
+                   help="seconds between migration-coordinator ticks "
+                        "(ack consumption, early drain reclaim, "
+                        "MigrationRecord publication, inbound resume "
+                        "verification; jittered 0.75x-1.25x)")
+    p.add_argument("--no-migration", action="store_true",
+                   help="disable the checkpoint-handshake migration "
+                        "coordinator (drains run to their deadline and "
+                        "nothing verifies workload checkpoints/resumes)")
     p.add_argument("--maintenance-poll-ttl", type=float, default=None,
                    help="seconds one GCE maintenance-event/preempted "
                         "metadata fetch stays cached (default 30; env "
@@ -457,6 +466,8 @@ def main(argv=None) -> int:
             enable_repartition=not args.no_repartition,
             repartition_period_s=args.repartition_period,
             qos_evict_after_s=args.qos_evict_after,
+            enable_migration=not args.no_migration,
+            migration_period_s=args.migration_period,
             maintenance_poll_ttl_s=args.maintenance_poll_ttl,
             storage_batch_window_s=args.storage_batch_window,
             sink_flush_window_s=args.sink_flush_window,

@@ -99,6 +99,28 @@ EnvThrottleDeadline = "ELASTIC_TPU_THROTTLE_DEADLINE"
 # the reclaim path (tpushare.remove_alloc_spec).
 UsageReportSubdir = "usage"
 
+# -- Migration handshake (migration.py + workloads/lifecycle.py) --------------
+# Subdirectory of the alloc-spec dir where workloads acknowledge a
+# checkpoint-restore signal: an atomic ``ack/<alloc hash>.json``
+# ({"ts", "step", "checkpoint_dir", "digest", ...}) written by the pod's
+# lifecycle watcher the moment its checkpoint is durable. The agent's
+# MigrationCoordinator consumes acks to complete drains early, gate QoS
+# eviction and verify resumes.
+AckSubdir = "ack"
+# Every per-allocation sidecar file family living under the alloc-spec
+# dir: ONE list shared by the spec reclaim path
+# (tpushare.remove_alloc_spec) and the reconciler's orphan-spec sweep,
+# so a new sidecar kind can never be added to one reclaimer and leak
+# through the other.
+AllocSidecarSubdirs = (UsageReportSubdir, AckSubdir)
+# Env restamped into a REPLACEMENT pod's alloc specs by the destination
+# agent when a published MigrationRecord names a checkpoint the workload
+# should resume from: the checkpoint directory, the acked step, and the
+# source bind's trace id (so the resume ack joins the same story).
+EnvRestoreDir = "ELASTIC_TPU_RESTORE_DIR"
+EnvRestoreStep = "ELASTIC_TPU_RESTORE_STEP"
+EnvRestoreTrace = "ELASTIC_TPU_RESTORE_TRACE"
+
 # -- Container env contract ---------------------------------------------------
 # Env carrying the allocation hash into the container; the OCI hook resolves
 # it back to physical chips (reference used "GPU", main.go:200 — we accept

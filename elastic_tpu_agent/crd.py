@@ -21,6 +21,11 @@ VERSION = "v1alpha1"
 PLURAL = "elastictpus"
 KIND = "ElasticTPU"
 NodeLabel = "elasticgpu.io/node"
+# Stamped on MigrationRecord objects (migration.py) so destination
+# agents can LIST just the records with a labelSelector instead of
+# downloading the cluster-wide per-allocation collection — the same
+# reason NodeLabel exists for node-scoped lists.
+MigrationLabel = "elasticgpu.io/migration"
 
 # Canonical phases (reference types.go:49-57).
 PhasePending = "Pending"
@@ -28,6 +33,12 @@ PhaseAvailable = "Available"
 PhaseBound = "Bound"
 PhaseReleased = "Released"
 PhaseFailed = "Failed"
+# TPU-native addition (migration.py): a MigrationRecord — the source
+# agent verified a resident's checkpoint durable before reclaiming its
+# chips, and the record tells whichever node binds the replacement pod
+# where to restore from. Deleted by the destination once the resume is
+# verified.
+PhaseMigrated = "Migrated"
 
 
 @dataclass
@@ -42,6 +53,11 @@ class ElasticTPU:
     claim_container: str = ""
     phase: str = PhasePending
     message: str = ""
+    # MigrationRecord payload (phase Migrated, migration.py): checkpoint
+    # location/step/digest, source node, last topology env and the bind
+    # trace id — everything the destination agent needs to stamp the
+    # restore env and verify the resume. None on ordinary objects.
+    migration: Optional[Dict] = None
     # Server-assigned; must round-trip into updates (a real apiserver
     # rejects RV-less PUTs on custom resources).
     resource_version: str = ""
@@ -50,29 +66,37 @@ class ElasticTPU:
         metadata: dict = {"name": self.name}
         if self.resource_version:
             metadata["resourceVersion"] = self.resource_version
+        labels: dict = {}
         if self.node_name:
             # Node-scoped label so agents can list with a labelSelector
             # instead of downloading the cluster-wide collection.
-            metadata["labels"] = {NodeLabel: self.node_name}
+            labels[NodeLabel] = self.node_name
+        if self.migration is not None:
+            labels[MigrationLabel] = "true"
+        if labels:
+            metadata["labels"] = labels
+        spec = {
+            "nodeName": self.node_name,
+            "capacity": dict(self.capacity),
+            "source": {
+                "physicalTPU": {"chipIndexes": list(self.chip_indexes)},
+                "tpuShare": {
+                    "acceleratorType": self.accelerator_type,
+                },
+            },
+            "claimRef": {
+                "namespace": self.claim_namespace,
+                "name": self.claim_name,
+                "container": self.claim_container,
+            },
+        }
+        if self.migration is not None:
+            spec["migration"] = dict(self.migration)
         return {
             "apiVersion": f"{GROUP}/{VERSION}",
             "kind": KIND,
             "metadata": metadata,
-            "spec": {
-                "nodeName": self.node_name,
-                "capacity": dict(self.capacity),
-                "source": {
-                    "physicalTPU": {"chipIndexes": list(self.chip_indexes)},
-                    "tpuShare": {
-                        "acceleratorType": self.accelerator_type,
-                    },
-                },
-                "claimRef": {
-                    "namespace": self.claim_namespace,
-                    "name": self.claim_name,
-                    "container": self.claim_container,
-                },
-            },
+            "spec": spec,
             "status": {"phase": self.phase, "message": self.message},
         }
 
@@ -97,6 +121,10 @@ class ElasticTPU:
             claim_container=claim.get("container", ""),
             phase=status.get("phase", PhasePending),
             message=status.get("message", ""),
+            migration=(
+                dict(spec["migration"])
+                if isinstance(spec.get("migration"), dict) else None
+            ),
             resource_version=m.get("metadata", {}).get("resourceVersion", ""),
         )
 
@@ -167,6 +195,19 @@ class ElasticTPUClient:
             # Belt-and-braces for objects created before the label existed.
             items = [i for i in items if i.node_name == node_name]
         return items
+
+    def list_migrations(self) -> List[ElasticTPU]:
+        """Only the MigrationRecord objects (labelSelector-scoped):
+        the destination-role discovery LIST must not scale with the
+        fleet's per-allocation object count."""
+        r = self._kube._get(
+            self._base, params={"labelSelector": f"{MigrationLabel}=true"}
+        )
+        if r.status_code != 200:
+            raise KubeError(f"list migration records: {r.status_code}")
+        return [
+            ElasticTPU.from_manifest(m) for m in r.json().get("items", [])
+        ]
 
     def delete(self, name: str) -> None:
         r = self._kube._delete(f"{self._base}/{name}")

@@ -159,6 +159,18 @@ class CRDRecorder:
             key=("chip", chip_index),
         )
 
+    def record_migration(self, obj: ElasticTPU) -> None:
+        """Publish (or refresh) a MigrationRecord object built by the
+        migration coordinator (phase Migrated, ``migration`` payload).
+        Keyed per object name so a re-publish supersedes a queued
+        duplicate; the coordinator confirms by read-back and re-submits
+        until the record is really at the apiserver — the journal, not
+        this queue, is the durable copy."""
+        self._submit(
+            lambda: self._client.create(obj, update_existing=True),
+            key=("obj", obj.name),
+        )
+
     def record_released(self, alloc_hash: str) -> None:
         name = self.object_name(alloc_hash)
 

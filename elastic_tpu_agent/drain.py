@@ -169,6 +169,17 @@ class DrainOrchestrator:
         self._maint_active = False  # first-trip edge for the event/gauge
         self._last_maint_value: Optional[str] = None  # for status()
         self._drains_total = 0
+        # Outcome of the last COMPLETED drain (satellite of ISSUE 14):
+        # "resident exited" used to read as Drained even when the pod
+        # crashed pre-checkpoint. With the migration coordinator wired
+        # (manager sets .migration), completion classifies into
+        # drained_acked (every stamped resident acknowledged a durable
+        # checkpoint) vs drained_exited (exit proves nothing) vs
+        # reclaimed/cancelled — in status() and
+        # elastic_tpu_drains_total{trigger,outcome}.
+        self.outcome = ""
+        self._acked_pods: List[str] = []
+        self.migration = None  # MigrationCoordinator (manager-wired)
         self._reclaimed_pods: List[str] = []
         self._stamped_pods: List[str] = []
         self._annotated_pods: List[Tuple[str, str]] = []  # (ns, name)
@@ -346,6 +357,8 @@ class DrainOrchestrator:
             "annotated_pods": [list(p) for p in self._annotated_pods],
             "reclaimed_pods": list(self._reclaimed_pods),
             "drains_total": self._drains_total,
+            "outcome": self.outcome,
+            "acked_pods": list(self._acked_pods),
             "phase_ts": dict(self._phase_ts),
             "phases_observed": list(self._phases_observed),
         })
@@ -418,6 +431,8 @@ class DrainOrchestrator:
             ]
             self._reclaimed_pods = list(st.get("reclaimed_pods", []))
             self._drains_total = int(st.get("drains_total", 0))
+            self.outcome = st.get("outcome", "")
+            self._acked_pods = list(st.get("acked_pods", []))
             self._phase_ts = dict(st.get("phase_ts", {}))
             self._phases_observed = list(st.get("phases_observed", []))
             self._set_state(st.get("state", ACTIVE), resumed=True)
@@ -448,17 +463,12 @@ class DrainOrchestrator:
             self._stamped_pods = []
             self._annotated_pods = []
             self._reclaimed_pods = []
+            self.outcome = ""
+            self._acked_pods = []
             self._phase_ts = {"cordon": now}
             self._phases_observed = []
             self._set_state(CORDONED)
             self._journal()  # BEFORE any side effect
-        if self._metrics is not None and hasattr(self._metrics, "drains_total"):
-            try:
-                self._metrics.drains_total.labels(
-                    trigger=trigger.split(":", 1)[0]
-                ).inc()
-            except Exception:  # noqa: BLE001
-                pass
         faults.fire("drain.pre_cordon")
         self._plugin.set_cordoned(True)
         logger.warning(
@@ -567,6 +577,47 @@ class DrainOrchestrator:
                 self._observe_phase(PHASE_SIGNAL, "cordon")
             self._journal()
 
+    def started_ts(self) -> Optional[float]:
+        """Wall-clock anchor of the current drain (the cordon phase
+        stamp; journaled, so restart-stable). The migration coordinator
+        accepts only acks at/after this as 'answered the signal'."""
+        with self._lock:
+            return self._phase_ts.get("cordon")
+
+    def _classify_outcome(self) -> Tuple[str, List[str]]:
+        """(outcome, acked_pods) for a drain completing as Drained:
+        drained_acked only when EVERY stamped resident acknowledged a
+        durable checkpoint after the cordon (via the migration
+        coordinator — an exit alone proves nothing; the pod may have
+        crashed pre-checkpoint, which is exactly what the old 'exited ⇒
+        Drained' reading hid from operators)."""
+        acked: List[str] = []
+        if self.migration is not None:
+            started = self._phase_ts.get("cordon")
+            acked = [
+                k for k in self._stamped_pods
+                if self.migration.acked_since(k, started)
+            ]
+        if not self._stamped_pods:
+            # a drain of an empty node neither saved nor lost work —
+            # it must not pollute either real outcome
+            return "drained_empty", acked
+        if set(acked) >= set(self._stamped_pods):
+            return "drained_acked", acked
+        return "drained_exited", acked
+
+    def _count_outcome(self, outcome: str, trigger: str = "") -> None:
+        if self._metrics is not None and hasattr(
+            self._metrics, "drains_total"
+        ):
+            try:
+                self._metrics.drains_total.labels(
+                    trigger=(trigger or self.trigger).split(":", 1)[0],
+                    outcome=outcome,
+                ).inc()
+            except Exception:  # noqa: BLE001
+                pass
+
     def _cancel_drain(self) -> None:
         """The trigger cleared mid-drain (maintenance event withdrawn,
         drain annotation removed): re-admit the node. Journal FIRST —
@@ -583,10 +634,17 @@ class DrainOrchestrator:
         cancelled_trigger = self.trigger
         stamped = list(self._stamped_pods)
         with self._lock:
+            was_completed = self.state in (DRAINED, RECLAIMED)
             self._set_state(ACTIVE)
             self.trigger = ""
             self.deadline_ts = None
+            if not was_completed:
+                # a drain that already completed keeps its real outcome;
+                # only an in-flight drain cancels
+                self.outcome = "cancelled"
             self._journal()  # stamped/annotated kept: cleanup is owed
+        if not was_completed:
+            self._count_outcome("cancelled", trigger=cancelled_trigger)
         self._plugin.set_cordoned(False)
         self._finish_cancel_cleanup()
         if self._events is not None:
@@ -698,9 +756,15 @@ class DrainOrchestrator:
             if remaining:
                 self._journal()  # progress recorded; retry next tick
             else:
+                _, acked = self._classify_outcome()
+                self.outcome = "reclaimed"
+                self._acked_pods = sorted(acked)
+                prev = self.state
                 self._set_state(RECLAIMED, reclaimed_pods=sorted(done))
                 self._observe_phase(PHASE_RECLAIMED, "signaled")
                 self._journal()
+                if prev != RECLAIMED:
+                    self._count_outcome("reclaimed")
         if remaining:
             logger.warning(
                 "drain: %d resident(s) survived the reclaim (%s); "
@@ -724,19 +788,42 @@ class DrainOrchestrator:
 
     def _finish_drained(self) -> None:
         with self._lock:
-            self._set_state(DRAINED)
+            outcome, acked = self._classify_outcome()
+            self.outcome = outcome
+            self._acked_pods = sorted(acked)
+            prev = self.state
+            self._set_state(
+                DRAINED, outcome=outcome, acked_pods=sorted(acked)
+            )
             self._observe_phase(PHASE_DRAINED, "signaled")
             self._journal()
-        logger.info("drain: all residents exited before the deadline")
+        if prev != DRAINED:
+            self._count_outcome(outcome)
+        logger.info(
+            "drain: all residents gone before the deadline (%s: %d/%d "
+            "acknowledged a durable checkpoint)", outcome, len(acked),
+            len(self._stamped_pods),
+        )
         if self._events is not None:
             from .kube.events import ReasonNodeDrained
 
+            if outcome == "drained_acked":
+                detail = ("every resident's checkpoint was verified "
+                          "durable before its bindings went")
+            elif outcome == "drained_empty":
+                detail = "no resident workloads were bound"
+            else:
+                detail = (
+                    f"residents exited but only {len(acked)}/"
+                    f"{len(self._stamped_pods)} acknowledged a "
+                    "checkpoint — unverified exits may have lost work"
+                )
             try:
                 self._events.node_event(
                     ReasonNodeDrained,
-                    f"drain complete ({self.trigger}): every resident "
-                    "workload exited before the deadline; node remains "
-                    "cordoned until the trigger clears",
+                    f"drain complete ({self.trigger}, {outcome}): "
+                    f"{detail}; node remains cordoned until the "
+                    "trigger clears",
                 )
             except Exception:  # noqa: BLE001
                 pass
@@ -899,6 +986,8 @@ class DrainOrchestrator:
                 "deadline_in_s": deadline_in,
                 "deadline_s": self.deadline_s,
                 "drains_total": self._drains_total,
+                "outcome": self.outcome,
+                "acked_pods": list(self._acked_pods),
                 "stamped_pods": list(self._stamped_pods),
                 "annotated_pods": [
                     f"{ns}/{name}" for ns, name in self._annotated_pods

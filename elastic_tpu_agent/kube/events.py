@@ -56,6 +56,11 @@ ReasonDrainCancelled = "TPUDrainCancelled"
 ReasonRepartitioned = "TPURepartitioned"
 ReasonThrottled = "TPUThrottled"
 ReasonQoSEvicted = "TPUQoSEvicted"
+# Migration handshake (migration.py): a resident's checkpoint verified
+# durable (ack consumed, record published), and the destination-side
+# resume verified at the acked step / current world size.
+ReasonMigrationRecorded = "TPUMigrationRecorded"
+ReasonMigrationCompleted = "TPUMigrationCompleted"
 
 
 class EventRecorder:

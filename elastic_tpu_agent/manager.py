@@ -114,6 +114,12 @@ class ManagerOptions:
     enable_repartition: bool = True
     repartition_period_s: float = 10.0
     qos_evict_after_s: float = 300.0
+    # Migration coordinator (migration.py): the verified checkpoint
+    # handshake — consume workload acks, complete drains early, gate
+    # QoS eviction, publish MigrationRecords, verify resumes on the
+    # destination. --migration-period / --no-migration.
+    enable_migration: bool = True
+    migration_period_s: float = 2.0
     # tpuvm operator: maintenance/preempted metadata poll TTL override
     # (--maintenance-poll-ttl; None = the operator's default, env
     # ELASTIC_TPU_MAINTENANCE_POLL_TTL also honored for tests).
@@ -388,6 +394,33 @@ class TPUManager:
         # While the drain has reclaimed bindings, kubelet's still-listed
         # assignments must not be replayed back by the reconciler.
         self.reconciler.drain = self.drain
+        # Migration coordinator (migration.py): the verified checkpoint
+        # handshake on top of the drain's signal — consume acks,
+        # reclaim acked residents early, publish MigrationRecords,
+        # verify inbound resumes.
+        self.migration = None
+        if opts.enable_migration:
+            from .migration import MigrationCoordinator
+
+            self.migration = MigrationCoordinator(
+                storage=self.storage,
+                plugin=self.plugin,
+                sitter=self.sitter,
+                reconciler=self.reconciler,
+                drain=self.drain,
+                kube_client=self.client,
+                crd_recorder=self.crd_recorder,
+                events=self.events,
+                metrics=self.metrics,
+                node_name=opts.node_name,
+                alloc_spec_dir=opts.alloc_spec_dir,
+                period_s=opts.migration_period_s,
+                timeline=self.timeline,
+            )
+            # Early-reclaimed residents' kubelet assignments must not be
+            # replayed back; the drain classifies completions by ack.
+            self.reconciler.migration = self.migration
+            self.drain.migration = self.migration
         # Dynamic fractional re-partitioning (repartition.py): sampler
         # windows -> live quota restamps. The sampler IS the usage
         # signal, so no sampler means no repartitioning.
@@ -416,6 +449,10 @@ class TPUManager:
                 self.repartition.core_delta_percent
             )
             self.sampler.repartition_status_fn = self.repartition.status
+            # QoS eviction gated by the checkpoint handshake: a
+            # throttled pod's durable ack publishes a MigrationRecord
+            # before (and can advance) the reclaim.
+            self.repartition.migration = self.migration
         if self.sampler is not None:
             # Self-reports steer attribution (and, with the controller
             # on, ENFORCEMENT), so only opted-in pods' usage files are
@@ -439,6 +476,8 @@ class TPUManager:
             self.sampler.reconcile_status_fn = self.reconciler.status
             self.sampler.slice_status_fn = self.slice_registry.status
             self.sampler.drain_status_fn = self.drain.status
+            if self.migration is not None:
+                self.sampler.migration_status_fn = self.migration.status
         self.nri_plugin = None
         if opts.nri_socket:
             from .nri import NRIPlugin
@@ -661,6 +700,13 @@ class TPUManager:
         # reclaimed. The supervised loop's own resume() is then a no-op
         # re-read.
         self.drain.resume()
+        if self.migration is not None:
+            # Journaled handshake state BEFORE the boot reconcile, like
+            # the drain: replay suppression for early-reclaimed pods
+            # must be armed before restore() walks kubelet's
+            # still-listed assignments, and half-published records must
+            # finish publishing.
+            self.migration.resume()
         if self.repartition is not None:
             # Journaled quota ledger BEFORE the boot reconcile, like the
             # drain: replay suppression for QoS-evicted pods must be
@@ -692,6 +738,13 @@ class TPUManager:
         # on every (re)start, so a crashed loop (or agent) picks the
         # drain back up where it died.
         self.supervisor.register("drain", self.drain.run, DEGRADED)
+        if self.migration is not None:
+            # Migration coordinator: DEGRADED — losing the handshake
+            # must not take binding down; drains then simply run to
+            # their deadline, exactly the pre-handshake behavior.
+            self.supervisor.register(
+                "migration", self.migration.run, DEGRADED
+            )
         if self.repartition is not None:
             # Repartition controller: DEGRADED — losing live quota
             # renegotiation leaves static grants in force, never binding.
@@ -737,6 +790,10 @@ class TPUManager:
         self.supervisor.join("reconciler", timeout=10.0)
         # The drain loop journals into storage and emits events too.
         self.supervisor.join("drain", timeout=10.0)
+        # The migration coordinator journals, reclaims and publishes
+        # through the CRD sink; join it before the recorder stops and
+        # the db closes.
+        self.supervisor.join("migration", timeout=10.0)
         # The repartition loop journals and restamps specs; join it
         # before the recorder stops and the db closes.
         self.supervisor.join("repartition", timeout=10.0)

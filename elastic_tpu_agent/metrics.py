@@ -318,8 +318,14 @@ class AgentMetrics:
         )
         self.drains_total = Counter(
             "elastic_tpu_drains_total",
-            "Drain lifecycles started on this node, by trigger source",
-            ["trigger"],
+            "Drain lifecycles COMPLETED on this node, by trigger source "
+            "and outcome: drained_acked = every resident acknowledged a "
+            "durable checkpoint before its bindings went (the drain "
+            "saved the work), drained_exited = residents merely exited "
+            "(a pre-checkpoint crash looks identical from outside — "
+            "nothing proves work was saved), reclaimed = the deadline "
+            "fired, cancelled = the trigger cleared mid-drain",
+            ["trigger", "outcome"],
             **kw,
         )
         self.drain_reclaimed_pods = Counter(
@@ -340,6 +346,43 @@ class AgentMetrics:
             ["phase"],
             buckets=(0.1, 0.5, 1.0, 5.0, 15.0, 30.0, 60.0, 120.0,
                      300.0, 600.0, 1800.0),
+            **kw,
+        )
+        # -- migration handshake (migration.py) ----------------------------
+        self.workload_checkpoint_age = BoundedLabeledGauge(
+            Gauge(
+                "elastic_tpu_workload_checkpoint_age_seconds",
+                "Seconds since each resident pod last acknowledged a "
+                "durable checkpoint (ack/<hash>.json on the alloc "
+                "surface) — 'are we actually checkpointing?' from one "
+                "scrape. Series exist only for pods that have EVER "
+                "acked; a bound pod with no series has never "
+                "checkpointed under the handshake",
+                ["pod"],
+                **kw,
+            ),
+            max_series=max_pod_series,
+            evicted=self.series_evicted,
+        )
+        self.drain_early_reclaims = Counter(
+            "elastic_tpu_drain_early_reclaims_total",
+            "Draining residents reclaimed BEFORE the deadline because "
+            "their checkpoint ack was durable — the chips the "
+            "handshake freed early",
+            **kw,
+        )
+        self.migration_records = Counter(
+            "elastic_tpu_migration_records_total",
+            "MigrationRecords published (and confirmed) at the "
+            "apiserver for residents whose checkpoints were verified "
+            "durable before reclaim",
+            **kw,
+        )
+        self.migrations_completed = Counter(
+            "elastic_tpu_migrations_completed_total",
+            "Inbound migrations VERIFIED on this node: the replacement "
+            "pod restored and acked a resume at step >= the record's "
+            "acked step and the current slice world size",
             **kw,
         )
         # -- dynamic re-partitioning & QoS enforcement (repartition.py) ----

@@ -1,0 +1,1044 @@
+"""Migration coordinator: close the checkpoint handshake.
+
+Until now the agent *signalled* checkpoint-restore everywhere —
+``ELASTIC_TPU_DRAIN``/``_DEADLINE`` restamped into alloc specs on a
+drain, ``TPUSliceReformed`` + an epoch bump on reform, throttle/evict
+deadlines on QoS escalation — and the workload side had an orbax
+``TrainCheckpointer``, but the two halves never shook hands: drain.py
+waited for residents to *exit* and reclaimed blind at the deadline, and
+no component ever verified that a workload checkpointed before losing
+its chips or resumed at the new world size after. Funky's cloud-native
+FPGA orchestration (PAPERS.md) makes the cordon→checkpoint→migrate→
+reclaim sequence a runtime-owned lifecycle; Arax argues the mapping
+layer — this agent — should own placement *and recovery* end to end.
+
+This module is the agent's half of that handshake (the pod's half is
+``workloads/lifecycle.py``). The coordinator consumes the atomic ack
+files workloads write (``<alloc dir>/ack/<TPU hash>.json``: checkpoint
+step, directory digest, wall time) to:
+
+- **complete drains early** — a DRAINING resident whose ack is durable
+  is reclaimed the moment its checkpoint lands instead of at the
+  deadline, freeing chips minutes sooner; un-acked residents still get
+  the full deadline (nothing about their safety changed);
+- **gate QoS eviction** — a throttled pod that answers the throttle
+  signal with a durable checkpoint is evicted with its work preserved
+  (the repartition controller consults :meth:`acked_since` and calls
+  :meth:`publish_record` before its reclaim);
+- **publish a MigrationRecord** (pod, checkpoint location, step, digest,
+  last topology env, trace id) through the CRD sink so the replacement
+  pod — wherever the external scheduler lands it — restores from the
+  record at admission;
+- **verify the resume** on the destination: the agent that binds the
+  replacement restamps ``ELASTIC_TPU_RESTORE_DIR``/``_RESTORE_STEP``
+  into its specs, waits for the workload's ``kind="resume"`` ack, checks
+  step ≥ acked step AND world size == the pod's CURRENT stamped slice
+  world, then emits ``TPUMigrationCompleted`` and a timeline
+  ``migration`` event keyed to the same trace id as the source bind.
+
+Crash consistency follows the drain orchestrator's discipline: records,
+the replay-suppression set and inbound verification state are journaled
+in the Storage ``agent_state`` table BEFORE side effects (failpoints
+``migration.pre_ack`` / ``migration.post_record`` name the crash
+windows), :meth:`resume` re-arms everything before the boot reconcile,
+and every step is idempotent — a record is re-published until confirmed
+at the apiserver, a restamp is re-asserted until the spec carries it.
+
+Supervised DEGRADED: losing the coordinator must not take binding down;
+drains then simply run to their deadline as before this module existed.
+"""
+
+from __future__ import annotations
+
+import logging
+import random
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from . import faults
+from .common import (
+    SYSTEM_CLOCK,
+    AckSubdir,
+    EnvRestoreDir,
+    EnvRestoreStep,
+    EnvRestoreTrace,
+    EnvSliceEpoch,
+    EnvSliceName,
+)
+from .types import PodContainer
+
+logger = logging.getLogger(__name__)
+
+DEFAULT_PERIOD_S = 2.0
+# How long a locally-bound pod's "is there a record for me?" apiserver
+# miss stays cached: a record published AFTER the replacement bound is
+# still found, without per-tick GETs for every ordinary pod.
+DEFAULT_RECORD_RECHECK_S = 15.0
+# Consumed acks kept for outcome classification / the age gauge after
+# their files are reclaimed with the spec; pruned oldest-first past this.
+MAX_RETAINED_ACKS = 1024
+
+_STATE_KEY = "migration"
+
+# Topology env keys a MigrationRecord snapshots from the source spec —
+# what the destination (and an operator reading the record) needs to
+# judge "did it come back at a sane world".
+_TOPOLOGY_KEYS = (
+    "TPU_WORKER_ID",
+    "TPU_WORKER_HOSTNAMES",
+    EnvSliceName,
+    EnvSliceEpoch,
+)
+
+
+def migration_object_name(namespace: str, name: str) -> str:
+    """Deterministic CRD object name for one workload identity — the
+    SAME function on source and destination is the rendezvous. The crc
+    of the UNAMBIGUOUS "ns/name" key is always appended: ns and name
+    may themselves contain '-', so the readable prefix alone would
+    collide ("team-a"/"x" vs "team"/"a-x"); the prefix is also
+    truncated under the apiserver's 253-char name cap."""
+    import zlib
+
+    key = f"{namespace}/{name}"
+    crc = zlib.crc32(key.encode("utf-8")) & 0xFFFFFFFF
+    return f"{f'mig-{namespace}-{name}'[:240]}-{crc:08x}"
+
+
+class MigrationCoordinator:
+    """Per-node migration handshake driver (one instance per agent);
+    both the SOURCE role (consume acks, publish records, reclaim early)
+    and the DESTINATION role (restamp restore env, verify resume) run
+    on the same supervised tick."""
+
+    def __init__(
+        self,
+        storage,
+        plugin,
+        sitter,
+        reconciler,
+        drain=None,
+        kube_client=None,
+        crd_recorder=None,
+        events=None,
+        metrics=None,
+        node_name: str = "",
+        alloc_spec_dir: str = "",
+        period_s: float = DEFAULT_PERIOD_S,
+        record_recheck_s: float = DEFAULT_RECORD_RECHECK_S,
+        rng=None,
+        timeline=None,
+        clock=None,
+    ) -> None:
+        self._storage = storage
+        self._plugin = plugin
+        self._sitter = sitter
+        self._reconciler = reconciler
+        self._drain = drain
+        self._client = kube_client
+        self._crd_recorder = crd_recorder
+        self._crd = None
+        if kube_client is not None:
+            from .crd import ElasticTPUClient
+
+            self._crd = ElasticTPUClient(kube_client)
+        self._events = events
+        self._metrics = metrics
+        self._node = node_name
+        self._alloc_dir = alloc_spec_dir
+        self.period_s = period_s
+        self.record_recheck_s = record_recheck_s
+        self._rng = rng if rng is not None else random.Random()
+        self._timeline = timeline
+        self._clock = clock if clock is not None else SYSTEM_CLOCK
+        self._lock = threading.Lock()
+        # pod_key -> MigrationRecord dict (source role), journaled.
+        self._records: Dict[str, dict] = {}
+        # pod_key -> uid: early-reclaimed pods whose kubelet assignments
+        # must NOT be replayed back until the pod is really gone.
+        self._migrated: Dict[str, str] = {}
+        # pod_key -> newest consumed ack ts (retained past file reclaim
+        # so drain outcome classification survives the early reclaim).
+        self._acked: Dict[str, float] = {}
+        # pod_key -> latest consumed ack payload (for the status block).
+        self._last_acks: Dict[str, dict] = {}
+        # pod_key -> inbound verification state (destination role),
+        # journaled: {"record", "stage": restamped|verified,
+        # "restamp_ts"}.
+        self._inbound: Dict[str, dict] = {}
+        # Destination-role record discovery is ONE apiserver LIST (all
+        # Migrated-phase objects), refreshed at most once per tick and
+        # only while an unresolved resident needs a snapshot FRESHER
+        # than its own first sighting — per-pod GETs would multiply
+        # apiserver traffic by the fleet's pod count. A record always
+        # exists BEFORE its replacement pod can be scheduled (publish
+        # precedes reclaim precedes eviction precedes re-admission), so
+        # one fresh snapshot per pod resolves it; a bounded second look
+        # after record_recheck_s covers sink stragglers.
+        self._records_snapshot: Dict[tuple, tuple] = {}
+        self._records_snapshot_ts: Optional[float] = None
+        self._first_seen: Dict[str, float] = {}
+        self._resolve_attempts: Dict[str, tuple] = {}  # (attempts, next_ts)
+        self._early_reclaims_total = 0
+        self._records_published_total = 0
+        self._completed_total = 0
+        self._verify_failures_total = 0
+        self._completed: List[dict] = []  # bounded recent completions
+        self._last_error: Optional[str] = None
+        self._resumed = False
+
+    # -- journaled state ------------------------------------------------------
+
+    def _journal_locked(self) -> None:
+        self._storage.save_state(_STATE_KEY, {
+            "records": {k: dict(v) for k, v in self._records.items()},
+            "migrated": dict(self._migrated),
+            "acked": dict(self._acked),
+            "inbound": {k: dict(v) for k, v in self._inbound.items()},
+            "early_reclaims_total": self._early_reclaims_total,
+            "records_published_total": self._records_published_total,
+            "completed_total": self._completed_total,
+        })
+
+    def resume(self) -> None:
+        """Re-arm the journaled handshake state after a restart, BEFORE
+        the boot reconcile: replay suppression for early-reclaimed pods
+        must be up before restore() walks kubelet's still-listed
+        assignments, and half-published records must finish publishing.
+        Idempotent."""
+        try:
+            st = self._storage.load_state(_STATE_KEY)
+        except Exception:  # noqa: BLE001 - unreadable journal: start clean
+            logger.exception("migration: state journal unreadable; "
+                             "starting clean")
+            st = None
+        if st:
+            with self._lock:
+                self._records = {
+                    k: dict(v) for k, v in (st.get("records") or {}).items()
+                }
+                self._migrated = dict(st.get("migrated") or {})
+                self._acked = {
+                    k: float(v) for k, v in (st.get("acked") or {}).items()
+                }
+                self._inbound = {
+                    k: dict(v) for k, v in (st.get("inbound") or {}).items()
+                }
+                self._early_reclaims_total = int(
+                    st.get("early_reclaims_total", 0)
+                )
+                self._records_published_total = int(
+                    st.get("records_published_total", 0)
+                )
+                self._completed_total = int(st.get("completed_total", 0))
+            if self._records or self._migrated or self._inbound:
+                logger.warning(
+                    "migration: resumed %d record(s), %d suppressed "
+                    "pod(s), %d inbound verification(s)",
+                    len(self._records), len(self._migrated),
+                    len(self._inbound),
+                )
+        self._resumed = True
+
+    # -- hooks consulted by the reconciler / drain / repartition --------------
+
+    def replay_suppressed(self, pod_key: str) -> bool:
+        """True while ``pod_key``'s early-reclaimed bindings must STAY
+        reclaimed (kubelet still lists the assignment until eviction)."""
+        with self._lock:
+            return pod_key in self._migrated
+
+    def acked_since(self, pod_key: str, since_ts: Optional[float]) -> bool:
+        """Whether this pod acknowledged a durable checkpoint at/after
+        ``since_ts`` (None = any ack ever) — the drain's outcome
+        classifier and the repartition controller's eviction gate."""
+        with self._lock:
+            ts = self._acked.get(pod_key)
+        if ts is None:
+            return False
+        return since_ts is None or ts >= since_ts
+
+    def acked_pods(self) -> Dict[str, float]:
+        with self._lock:
+            return dict(self._acked)
+
+    # -- ack consumption (source role) ----------------------------------------
+
+    def _residents(self) -> Optional[List[Tuple[str, dict]]]:
+        """[(pod_key, {"containers": {container: records}, "hashes":
+        [...]})] for every pod this node holds bindings for; None when
+        storage cannot answer."""
+        out: List[Tuple[str, dict]] = []
+        try:
+            items = list(self._storage.items())
+        except Exception:  # noqa: BLE001 - storage blip: retry next tick
+            logger.exception("migration: resident enumeration failed")
+            return None
+        for _key, info in items:
+            hashes = [rec.device.hash for rec in info.records()]
+            if not hashes:
+                continue
+            out.append((info.key, {
+                "namespace": info.namespace,
+                "name": info.name,
+                "containers": {
+                    c: dict(r) for c, r in info.allocations.items() if r
+                },
+                "hashes": hashes,
+            }))
+        return out
+
+    def _spec_plugin(self):
+        return getattr(self._plugin, "core", None)
+
+    def _spec_env(self, hashes: List[str]) -> Dict[str, str]:
+        plugin = self._spec_plugin()
+        if plugin is None:
+            return {}
+        for h in hashes:
+            spec = plugin.read_alloc_spec(h)
+            if spec and isinstance(spec.get("env"), dict):
+                return dict(spec["env"])
+        return {}
+
+    def _consume_acks(self, residents) -> Dict[str, dict]:
+        """Read every resident's ack file; update the retained ack map
+        and the per-pod checkpoint-age gauge. Returns pod_key -> ack."""
+        from .workloads.lifecycle import read_checkpoint_ack
+
+        now = self._clock.time()
+        acks: Dict[str, dict] = {}
+        for pod_key, res in residents:
+            ack = None
+            for h in res["hashes"]:
+                ack = read_checkpoint_ack(self._alloc_dir, h)
+                if ack is not None:
+                    break
+            if ack is None:
+                continue
+            try:
+                ts = float(ack.get("ts", 0.0))
+            except (TypeError, ValueError):
+                continue
+            if ts > now + 60.0:
+                # future-stamped acks rejected, like usage reports: a
+                # skewed clock must not pin "just checkpointed" forever.
+                continue
+            acks[pod_key] = ack
+            with self._lock:
+                self._acked[pod_key] = max(
+                    ts, self._acked.get(pod_key, 0.0)
+                )
+                self._last_acks[pod_key] = ack
+                while len(self._acked) > MAX_RETAINED_ACKS:
+                    oldest = min(self._acked, key=self._acked.get)
+                    self._acked.pop(oldest, None)
+                    self._last_acks.pop(oldest, None)
+            m = self._metrics
+            if m is not None and hasattr(m, "workload_checkpoint_age"):
+                try:
+                    m.workload_checkpoint_age.set(
+                        max(0.0, now - ts), pod=pod_key
+                    )
+                except Exception:  # noqa: BLE001 - observability only
+                    pass
+        return acks
+
+    # -- MigrationRecord construction / publication ---------------------------
+
+    def _build_record(
+        self, pod_key: str, res: dict, ack: dict, reason: str
+    ) -> dict:
+        env = self._spec_env(res["hashes"])
+        pod = self._sitter.get_pod(res["namespace"], res["name"])
+        uid = str(((pod or {}).get("metadata") or {}).get("uid", ""))
+        return {
+            "name": migration_object_name(res["namespace"], res["name"]),
+            "pod": pod_key,
+            "uid": uid,
+            "source_node": self._node,
+            "reason": reason,
+            "step": ack.get("step"),
+            "checkpoint_dir": ack.get("checkpoint_dir", ""),
+            "digest": ack.get("digest", ""),
+            "ack_kind": ack.get("kind", "checkpoint"),
+            "ack_ts": ack.get("ts"),
+            "trace": env.get("ELASTIC_TPU_TRACE_ID", ""),
+            "topology_env": {
+                k: env[k] for k in _TOPOLOGY_KEYS if k in env
+            },
+            "recorded_ts": self._clock.time(),
+            "published": False,
+            "reclaimed": False,
+        }
+
+    def _record_manifest(self, record: dict):
+        from .crd import ElasticTPU, PhaseMigrated
+
+        ns, _, name = record["pod"].partition("/")
+        return ElasticTPU(
+            name=record["name"],
+            # node_name stays EMPTY on purpose: the CRD recorder's
+            # restore-time reconcile sweeps objects labeled with this
+            # node that aren't live allocations — a migration record
+            # must survive exactly that sweep (its whole point is to
+            # outlive the source's bindings). The source node rides in
+            # the migration payload instead.
+            node_name="",
+            claim_namespace=ns,
+            claim_name=name,
+            phase=PhaseMigrated,
+            message=(
+                f"checkpoint step {record['step']} at "
+                f"{record['checkpoint_dir'] or '<unset>'} "
+                f"(from {record['source_node']}, {record['reason']})"
+            ),
+            migration={
+                k: record[k] for k in (
+                    "pod", "uid", "source_node", "reason", "step",
+                    "checkpoint_dir", "digest", "ack_kind", "ack_ts",
+                    "trace", "topology_env", "recorded_ts",
+                )
+            },
+        )
+
+    def _publish_pending(self) -> None:
+        """Publish every journaled record not yet CONFIRMED at the
+        apiserver — re-submitted each tick until a read-back sees it, so
+        a sink drop or a crash between journal and publish can never
+        lose the record (the journal is the durable copy)."""
+        if self._crd is None:
+            return
+        with self._lock:
+            pending = [
+                dict(r) for r in self._records.values()
+                if not r.get("published")
+            ]
+        for record in pending:
+            try:
+                existing = self._crd.get(record["name"])
+            except Exception:  # noqa: BLE001 - apiserver blip: next tick
+                continue
+            if existing is not None and (
+                (existing.migration or {}).get("ack_ts") == record["ack_ts"]
+            ):
+                confirmed = True
+            else:
+                obj = self._record_manifest(record)
+                if self._crd_recorder is not None and hasattr(
+                    self._crd_recorder, "record_migration"
+                ):
+                    # the async CRD sink (coalesced, keyed per object);
+                    # confirmation happens by read-back next tick
+                    self._crd_recorder.record_migration(obj)
+                    confirmed = False
+                else:
+                    try:
+                        self._crd.create(obj, update_existing=True)
+                        confirmed = True
+                    except Exception:  # noqa: BLE001 - retried next tick
+                        logger.warning(
+                            "migration: record publish for %s failed "
+                            "(retried)", record["pod"],
+                        )
+                        continue
+            if confirmed:
+                with self._lock:
+                    rec = self._records.get(record["pod"])
+                    if rec is not None and not rec.get("published"):
+                        rec["published"] = True
+                        self._records_published_total += 1
+                        self._journal_locked()
+                m = self._metrics
+                if m is not None and hasattr(m, "migration_records"):
+                    try:
+                        m.migration_records.inc()
+                    except Exception:  # noqa: BLE001
+                        pass
+                if self._timeline is not None:
+                    from .timeline import KIND_MIGRATION
+
+                    self._timeline.emit(
+                        KIND_MIGRATION,
+                        keys={"pod": record["pod"],
+                              "trace": record["trace"] or None},
+                        action="record_published",
+                        step=record["step"],
+                        checkpoint_dir=record["checkpoint_dir"],
+                        reason=record["reason"],
+                    )
+
+    def publish_record(
+        self, pod_key: str, uid: str = "", reason: str = "qos_evict"
+    ) -> bool:
+        """Journal + queue a MigrationRecord for ``pod_key`` from its
+        newest consumed ack, WITHOUT reclaiming (the caller owns the
+        teardown — the repartition controller's eviction gate). Returns
+        True when a record exists afterwards. Never raises."""
+        try:
+            with self._lock:
+                if pod_key in self._records:
+                    return True
+                ack = self._last_acks.get(pod_key)
+            if ack is None:
+                return False
+            residents = self._residents() or []
+            res = dict(residents).get(pod_key)
+            if res is None:
+                return False
+            record = self._build_record(pod_key, res, ack, reason)
+            if uid and not record["uid"]:
+                record["uid"] = uid
+            with self._lock:
+                self._records[pod_key] = record
+                self._journal_locked()
+            self._emit_recorded(record)
+            self._publish_pending()
+            return True
+        except Exception:  # noqa: BLE001 - a gate must never break eviction
+            logger.exception("migration: publish_record(%s) failed", pod_key)
+            return False
+
+    def _emit_recorded(self, record: dict) -> None:
+        if self._timeline is not None:
+            from .timeline import KIND_MIGRATION
+
+            self._timeline.emit(
+                KIND_MIGRATION,
+                keys={"pod": record["pod"],
+                      "trace": record["trace"] or None},
+                action="recorded",
+                step=record["step"],
+                checkpoint_dir=record["checkpoint_dir"],
+                digest=record["digest"],
+                reason=record["reason"],
+            )
+        if self._events is not None:
+            from .kube.events import ReasonMigrationRecorded
+
+            ns, _, name = record["pod"].partition("/")
+            try:
+                self._events.pod_event(
+                    ns, name, ReasonMigrationRecorded,
+                    f"checkpoint verified durable at step "
+                    f"{record['step']} ({record['reason']}); migration "
+                    "record published for the replacement pod",
+                    trace_id=record["trace"],
+                )
+            except Exception:  # noqa: BLE001 - observability only
+                pass
+
+    # -- early drain completion (source role) ---------------------------------
+
+    def _drain_early_pass(self, residents, acks: Dict[str, dict]) -> None:
+        """While the node is DRAINING, reclaim every resident whose ack
+        is durable AND fresh (at/after the drain's cordon anchor) — the
+        handshake's headline: chips free the moment the checkpoint
+        lands, not at the deadline. Un-acked residents are untouched."""
+        from .drain import DRAINING
+
+        drain = self._drain
+        if drain is None or drain.state != DRAINING:
+            return
+        started = drain.started_ts()
+        trigger = drain.trigger
+        by_key = dict(residents)
+        for pod_key, ack in acks.items():
+            res = by_key.get(pod_key)
+            if res is None:
+                continue
+            try:
+                ts = float(ack.get("ts", 0.0))
+            except (TypeError, ValueError):
+                continue
+            if started is not None and ts < started:
+                continue  # a stale pre-drain ack saves nothing here
+            with self._lock:
+                prior = self._records.get(pod_key)
+                if (
+                    pod_key in self._migrated
+                    and prior is not None and prior.get("reclaimed")
+                ):
+                    continue  # fully handled
+            if prior is not None and pod_key in self._migrated:
+                # a crash landed between the record journal and the
+                # reclaim: the journaled record stands, finish the
+                # teardown (reclaim_pods is idempotent)
+                record = prior
+            else:
+                record = self._build_record(
+                    pod_key, res, ack, f"drain:{trigger.split(':', 1)[0]}"
+                )
+                with self._lock:
+                    self._records[pod_key] = record
+                    self._migrated[pod_key] = record["uid"]
+                    self._early_reclaims_total += 1
+                    self._journal_locked()  # BEFORE the reclaim side effect
+                faults.fire("migration.post_record")
+                self._emit_recorded(record)
+            report = self._reconciler.reclaim_pods([pod_key])
+            with self._lock:
+                rec = self._records.get(pod_key)
+                if rec is not None:
+                    rec["reclaimed"] = True
+                    self._journal_locked()
+            m = self._metrics
+            if m is not None and hasattr(m, "drain_early_reclaims"):
+                try:
+                    m.drain_early_reclaims.inc()
+                except Exception:  # noqa: BLE001
+                    pass
+            if self._timeline is not None:
+                from .timeline import KIND_MIGRATION
+
+                self._timeline.emit(
+                    KIND_MIGRATION,
+                    keys={"pod": pod_key,
+                          "trace": record["trace"] or None},
+                    action="early_reclaim",
+                    step=record["step"],
+                    deadline_ts=drain.deadline_ts,
+                )
+            logger.warning(
+                "migration: %s acked step %s; bindings reclaimed %s "
+                "early of the drain deadline (%s)",
+                pod_key, record["step"],
+                (f"{drain.deadline_ts - self._clock.time():.0f}s"
+                 if drain.deadline_ts else "ahead"),
+                report.get("reclaimed_pods"),
+            )
+        self._publish_pending()
+
+    # -- destination role: restamp + verify -----------------------------------
+
+    def _refresh_records_snapshot(self) -> bool:
+        """One LIST of every Migrated-phase object -> {(ns, name):
+        (object name, payload)}. Returns False when the apiserver could
+        not answer (the stale snapshot stands)."""
+        from .crd import PhaseMigrated
+
+        if self._crd is None:
+            return False
+        try:
+            # labelSelector-scoped: records only, never the fleet's
+            # whole per-allocation collection
+            objs = self._crd.list_migrations()
+        except Exception:  # noqa: BLE001 - apiserver blip: stale stands
+            return False
+        snap: Dict[tuple, tuple] = {}
+        for obj in objs:
+            if obj.phase == PhaseMigrated and obj.migration:
+                snap[(obj.claim_namespace, obj.claim_name)] = (
+                    obj.name, dict(obj.migration)
+                )
+        self._records_snapshot = snap
+        self._records_snapshot_ts = self._clock.monotonic()
+        return True
+
+    def _inbound_pass(self, residents) -> None:
+        """For every locally-bound pod: adopt a published record
+        (restamp restore env), then verify the workload's resume ack."""
+        now_mono = self._clock.monotonic()
+        # Which pods still need a record lookup, and how FRESH a
+        # snapshot each attempt needs: the first look needs one newer
+        # than the pod's first sighting (a record always predates its
+        # replacement's bind); the delayed second look — the
+        # sink-straggler net — needs one STRICTLY newer than the
+        # snapshot its first look consumed, or it would just re-read
+        # the stale snapshot that missed.
+        pending: List[Tuple[str, dict, float]] = []
+        for pod_key, res in residents:
+            with self._lock:
+                if pod_key in self._inbound or pod_key in self._records:
+                    continue
+            first = self._first_seen.setdefault(pod_key, now_mono)
+            attempts, next_ts, used_snap = self._resolve_attempts.get(
+                pod_key, (0, first, None)
+            )
+            if attempts >= 2 or now_mono < next_ts:
+                continue
+            pending.append((
+                pod_key, res, first if used_snap is None else used_snap,
+            ))
+        if pending and (
+            self._records_snapshot_ts is None
+            or self._records_snapshot_ts
+            <= max(need for _, _, need in pending)
+        ):
+            self._refresh_records_snapshot()
+        for pod_key, res, need_after in pending:
+            if (
+                self._records_snapshot_ts is None
+                or self._records_snapshot_ts <= need_after
+            ):
+                continue  # no fresh-enough snapshot yet; retry next tick
+            attempts, _, _ = self._resolve_attempts.get(
+                pod_key, (0, 0.0, None)
+            )
+            self._resolve_attempts[pod_key] = (
+                attempts + 1, now_mono + self.record_recheck_s,
+                self._records_snapshot_ts,
+            )
+            entry = self._records_snapshot.get(
+                (res["namespace"], res["name"])
+            )
+            if entry is None:
+                continue  # no record; one delayed recheck then final
+            _, record = entry
+            inbound = {
+                "record": record,
+                "stage": "restamped",
+                "restamp_ts": self._clock.time(),
+            }
+            if not self._restamp_restore(pod_key, res, record):
+                # retried next tick (nothing journaled yet)
+                self._resolve_attempts.pop(pod_key, None)
+                continue
+            with self._lock:
+                self._inbound[pod_key] = inbound
+                self._journal_locked()
+            if self._timeline is not None:
+                from .timeline import KIND_MIGRATION
+
+                self._timeline.emit(
+                    KIND_MIGRATION,
+                    keys={"pod": pod_key,
+                          "trace": record.get("trace") or None},
+                    action="restore_stamped",
+                    step=record.get("step"),
+                    source_node=record.get("source_node"),
+                )
+            logger.warning(
+                "migration: %s has a published record (step %s from "
+                "%s); restore env stamped", pod_key,
+                record.get("step"), record.get("source_node"),
+            )
+        for pod_key, res in residents:
+            with self._lock:
+                inbound = self._inbound.get(pod_key)
+            if inbound is not None and inbound.get("stage") == "restamped":
+                # re-assert the stamp (a drift rebind may have rebuilt
+                # the spec without it), then look for the resume ack
+                self._restamp_restore(pod_key, res, inbound["record"])
+                self._verify_resume(pod_key, res, inbound)
+
+    def _restamp_restore(self, pod_key, res, record) -> bool:
+        from .plugins import restamp_owner_env
+
+        plugin = self._spec_plugin()
+        if plugin is None:
+            return False
+        env = {
+            EnvRestoreDir: str(record.get("checkpoint_dir", "")),
+            EnvRestoreStep: str(record.get("step", "")),
+        }
+        if record.get("trace"):
+            env[EnvRestoreTrace] = str(record["trace"])
+        ok = False
+        for container, records in res["containers"].items():
+            owner = PodContainer(res["namespace"], res["name"], container)
+            try:
+                if restamp_owner_env(plugin, owner, records, env):
+                    ok = True
+            except Exception:  # noqa: BLE001 - retried next tick
+                logger.exception(
+                    "migration: restore restamp for %s failed", pod_key
+                )
+        return ok
+
+    def _verify_resume(self, pod_key: str, res: dict, inbound: dict) -> None:
+        from .workloads.lifecycle import read_checkpoint_ack, world_size_of
+
+        record = inbound["record"]
+        ack = None
+        for h in res["hashes"]:
+            ack = read_checkpoint_ack(self._alloc_dir, h)
+            if ack is not None:
+                break
+        if ack is None or ack.get("kind") != "resume":
+            return
+        problems = []
+        acked_step = record.get("step")
+        try:
+            resumed_step = int(ack.get("step"))
+        except (TypeError, ValueError):
+            resumed_step = None
+        if acked_step is not None and (
+            resumed_step is None or resumed_step < int(acked_step)
+        ):
+            problems.append(
+                f"resumed at step {resumed_step} < acked step {acked_step}"
+            )
+        expected_world = world_size_of(self._spec_env(res["hashes"]))
+        got_world = ack.get("world_size")
+        if got_world is not None and int(got_world) != expected_world:
+            problems.append(
+                f"resumed at world size {got_world}, current slice "
+                f"world is {expected_world}"
+            )
+        if problems:
+            # One failing ack is ONE incident: the same unchanged ack is
+            # re-read every tick, and without this dedup the failure
+            # counter/timeline/log would grow by one per tick for the
+            # whole life of the stuck migration.
+            failed_id = (ack.get("ts"), resumed_step, got_world)
+            with self._lock:
+                if inbound.get("last_failed") == list(failed_id):
+                    return
+                inbound["last_failed"] = list(failed_id)
+                self._verify_failures_total += 1
+                self._journal_locked()
+            if self._timeline is not None:
+                from .timeline import KIND_MIGRATION
+
+                self._timeline.emit(
+                    KIND_MIGRATION,
+                    keys={"pod": pod_key,
+                          "trace": record.get("trace") or None},
+                    action="verify_failed", problems=problems,
+                )
+            logger.warning(
+                "migration: %s resume verification FAILED: %s",
+                pod_key, "; ".join(problems),
+            )
+            return
+        completion = {
+            "pod": pod_key,
+            "step": resumed_step,
+            "world_size": expected_world,
+            "source_node": record.get("source_node"),
+            "trace": record.get("trace", ""),
+            "verified_ts": self._clock.time(),
+            "downtime_s": (
+                round(self._clock.time() - float(record["ack_ts"]), 3)
+                if record.get("ack_ts") else None
+            ),
+        }
+        with self._lock:
+            self._inbound.pop(pod_key, None)
+            self._completed_total += 1
+            self._completed = (self._completed + [completion])[-32:]
+            self._journal_locked()
+        m = self._metrics
+        if m is not None and hasattr(m, "migrations_completed"):
+            try:
+                m.migrations_completed.inc()
+            except Exception:  # noqa: BLE001
+                pass
+        if self._timeline is not None:
+            from .timeline import KIND_MIGRATION
+
+            self._timeline.emit(
+                KIND_MIGRATION,
+                keys={"pod": pod_key,
+                      "trace": record.get("trace") or None},
+                action="completed",
+                step=resumed_step,
+                world_size=expected_world,
+                source_node=record.get("source_node"),
+                downtime_s=completion["downtime_s"],
+            )
+        if self._events is not None:
+            from .kube.events import ReasonMigrationCompleted
+
+            try:
+                self._events.pod_event(
+                    res["namespace"], res["name"],
+                    ReasonMigrationCompleted,
+                    f"resume verified at step {resumed_step}, world size "
+                    f"{expected_world} (migrated from "
+                    f"{record.get('source_node', '?')})",
+                    trace_id=record.get("trace", ""),
+                )
+            except Exception:  # noqa: BLE001
+                pass
+        if self._crd is not None:
+            # the record's job is done; a stale record left behind would
+            # make the NEXT pod under this identity "restore" old state
+            try:
+                self._crd.delete(record.get("name") or
+                                 migration_object_name(
+                                     res["namespace"], res["name"]))
+            except Exception:  # noqa: BLE001 - retried never: reclaimed
+                logger.warning(
+                    "migration: completed record delete for %s failed",
+                    pod_key,
+                )
+        logger.warning(
+            "migration: %s resume VERIFIED (step %s, world %s, "
+            "downtime %ss)", pod_key, resumed_step, expected_world,
+            completion["downtime_s"],
+        )
+
+    # -- sweeping -------------------------------------------------------------
+
+    def _pod_gone(self, pod_key: str, armed_uid: str) -> bool:
+        ns, _, name = pod_key.partition("/")
+        pod = self._sitter.get_pod(ns, name)
+        if pod is None:
+            if self._client is not None:
+                try:
+                    pod = self._client.get_pod(ns, name)
+                except Exception:  # noqa: BLE001 - unknowable: keep armed
+                    return False
+            if pod is None:
+                return True
+        uid = str(((pod or {}).get("metadata") or {}).get("uid", ""))
+        return bool(armed_uid) and uid != armed_uid
+
+    def _sweep(self, residents) -> None:
+        """Suppression and records drop once their pod generation is
+        really gone; retained acks for pods with no bindings and no
+        record age out with them (the gauge series is removed so a
+        reclaimed pod doesn't report a frozen age forever)."""
+        with self._lock:
+            migrated = dict(self._migrated)
+            # records WITHOUT a suppression entry (the QoS-evict path's
+            # publish_record never arms one) must sweep by their own
+            # recorded uid, or they leak in the journal forever and —
+            # worse — block a same-node re-admission from ADOPTING the
+            # record (_inbound_pass skips pods in _records).
+            record_only = {
+                k: r.get("uid", "") for k, r in self._records.items()
+                if k not in migrated
+            }
+        dropped = False
+        for pod_key, uid in migrated.items():
+            if self._pod_gone(pod_key, uid):
+                with self._lock:
+                    self._migrated.pop(pod_key, None)
+                    # the record is dropped with the suppression ONLY
+                    # once it provably reached the apiserver — an
+                    # unpublished record for a gone pod is exactly the
+                    # record that still matters (the replacement is
+                    # about to go looking for it)
+                    rec = self._records.get(pod_key)
+                    if rec is not None and rec.get("published"):
+                        self._records.pop(pod_key, None)
+                    dropped = True
+        for pod_key, uid in record_only.items():
+            if self._pod_gone(pod_key, uid):
+                with self._lock:
+                    rec = self._records.get(pod_key)
+                    if rec is not None and rec.get("published"):
+                        self._records.pop(pod_key, None)
+                        dropped = True
+        resident_keys = {k for k, _ in residents}
+        for k in [
+            k for k in self._first_seen if k not in resident_keys
+        ]:
+            self._first_seen.pop(k, None)
+            self._resolve_attempts.pop(k, None)
+        with self._lock:
+            stale = [
+                k for k in self._acked
+                if k not in resident_keys and k not in self._migrated
+                and k not in self._records
+            ]
+            for k in stale:
+                # keep the ack VALUE (drain outcome classification may
+                # still need it this lifecycle) but stop aging it in the
+                # gauge once the pod has no bindings here
+                self._last_acks.pop(k, None)
+            inbound_stale = [
+                k for k in self._inbound if k not in resident_keys
+            ]
+            for k in inbound_stale:
+                self._inbound.pop(k, None)
+                dropped = True
+            if dropped:
+                self._journal_locked()
+        m = self._metrics
+        if m is not None and hasattr(m, "workload_checkpoint_age"):
+            for k in stale:
+                try:
+                    m.workload_checkpoint_age.remove(pod=k)
+                except Exception:  # noqa: BLE001
+                    pass
+
+    # -- the tick -------------------------------------------------------------
+
+    def tick(self) -> None:
+        faults.fire("migration.pre_ack")
+        residents = self._residents()
+        if residents is None:
+            return  # storage unanswerable: retry next tick
+        acks = self._consume_acks(residents)
+        self._drain_early_pass(residents, acks)
+        self._publish_pending()
+        self._inbound_pass(residents)
+        self._sweep(residents)
+
+    def run(self, stop: threading.Event) -> None:
+        """Supervised loop (DEGRADED): resume journaled state, then tick
+        at a jittered period — same discipline as the drain loop."""
+        if not self._resumed:
+            self.resume()
+        consecutive_failures = 0
+        while True:
+            delay = self.period_s * (0.75 + 0.5 * self._rng.random())
+            if stop.wait(delay):
+                return
+            try:
+                self.tick()
+                consecutive_failures = 0
+            except Exception as e:  # noqa: BLE001
+                consecutive_failures += 1
+                with self._lock:
+                    self._last_error = f"{type(e).__name__}: {e}"
+                if consecutive_failures >= 3:
+                    raise
+                logger.exception(
+                    "migration tick failed (%d consecutive; escalating "
+                    "to the supervisor at 3)", consecutive_failures,
+                )
+
+    # -- introspection --------------------------------------------------------
+
+    def status(self) -> dict:
+        """The ``migration`` block of /debug/allocations and the doctor
+        bundle: per-pod ack freshness, outbound records, inbound
+        verifications — "are we actually checkpointing?" (drain.py's
+        open question) answerable from one scrape."""
+        now = self._clock.time()
+        with self._lock:
+            return {
+                "acked_pods": {
+                    k: {
+                        "ack_ts": ts,
+                        "age_s": round(max(0.0, now - ts), 3),
+                        "step": (self._last_acks.get(k) or {}).get("step"),
+                        "kind": (self._last_acks.get(k) or {}).get(
+                            "kind", "checkpoint"
+                        ),
+                    }
+                    for k, ts in sorted(self._acked.items())
+                },
+                "records": {
+                    k: {
+                        f: r.get(f) for f in (
+                            "step", "checkpoint_dir", "digest", "reason",
+                            "published", "reclaimed", "trace",
+                        )
+                    }
+                    for k, r in sorted(self._records.items())
+                },
+                "inbound": {
+                    k: {
+                        "stage": v.get("stage"),
+                        "step": (v.get("record") or {}).get("step"),
+                        "source_node": (v.get("record") or {}).get(
+                            "source_node"
+                        ),
+                        "restamp_ts": v.get("restamp_ts"),
+                    }
+                    for k, v in sorted(self._inbound.items())
+                },
+                "suppressed_pods": sorted(self._migrated),
+                "recent_completions": list(self._completed),
+                "early_reclaims_total": self._early_reclaims_total,
+                "records_published_total": self._records_published_total,
+                "completed_total": self._completed_total,
+                "verify_failures_total": self._verify_failures_total,
+                "last_error": self._last_error,
+            }

@@ -1066,20 +1066,23 @@ class _TPUSharePluginBase(_ListAndWatchMixin, rpc.DevicePluginServicer):
             _write_json_atomic(path, restored)
 
     def _remove_usage_report(self, alloc_hash: str) -> None:
-        """Reclaim the allocation's self-reported usage file
-        (common.UsageReportSubdir) along with its spec — without this,
-        pod churn grows the usage dir without bound (nothing else ever
-        unlinks a dead allocation's report)."""
-        from ..common import UsageReportSubdir
+        """Reclaim the allocation's sidecar files — the usage
+        self-report AND the checkpoint ack — along with its spec. ONE
+        subdir list (common.AllocSidecarSubdirs) shared with the
+        reconciler's orphan-spec sweep: without this, pod churn grows
+        the sidecar dirs without bound, and a stale ack under a reused
+        hash would read as a fresh checkpoint acknowledgement."""
+        from ..common import AllocSidecarSubdirs
 
-        for suffix in (".json", ".json.tmp"):
-            try:
-                os.unlink(
-                    os.path.join(self._alloc_dir, UsageReportSubdir,
-                                 f"{alloc_hash}{suffix}")
-                )
-            except OSError:
-                pass
+        for subdir in AllocSidecarSubdirs:
+            for suffix in (".json", ".json.tmp"):
+                try:
+                    os.unlink(
+                        os.path.join(self._alloc_dir, subdir,
+                                     f"{alloc_hash}{suffix}")
+                    )
+                except OSError:
+                    pass
 
     def remove_alloc_spec(self, alloc_hash: str, owner=None) -> None:
         """Unlink an allocation's spec (and its usage self-report);

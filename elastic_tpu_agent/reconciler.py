@@ -164,6 +164,12 @@ class Reconciler:
         # a pod whose bindings QoS enforcement reclaimed must not have
         # its still-listed assignment replayed back either.
         self.repartition = None
+        # MigrationCoordinator (migration.py), same late assignment: an
+        # acked resident reclaimed EARLY (checkpoint durable, drain
+        # deadline not yet reached) keeps its kubelet assignment until
+        # eviction — replaying it would re-bind the chips the handshake
+        # just freed.
+        self.migration = None
         self._rng = rng if rng is not None else random.Random()
         self._lock = threading.Lock()
         self._repairs: Dict[str, int] = {k: 0 for k in ALL_KINDS}
@@ -905,19 +911,21 @@ class Reconciler:
                 self._count(
                     report, KIND_ORPHAN_SPEC, keys={"hash": stem}
                 )
-                # the allocation's usage self-report dies with its
-                # spec (same contract as remove_alloc_spec — a sweep
-                # that bypassed it must not leak the report)
-                from .common import UsageReportSubdir
+                # the allocation's sidecar files — usage self-report
+                # AND checkpoint ack — die with its spec (the same
+                # common.AllocSidecarSubdirs list remove_alloc_spec
+                # uses: a sweep that bypassed it must not leak either)
+                from .common import AllocSidecarSubdirs
 
-                for suffix in (".json", ".json.tmp"):
-                    try:
-                        os.unlink(os.path.join(
-                            self._alloc_dir, UsageReportSubdir,
-                            stem + suffix,
-                        ))
-                    except OSError:
-                        pass
+                for subdir in AllocSidecarSubdirs:
+                    for suffix in (".json", ".json.tmp"):
+                        try:
+                            os.unlink(os.path.join(
+                                self._alloc_dir, subdir,
+                                stem + suffix,
+                            ))
+                        except OSError:
+                            pass
             except FileNotFoundError:
                 pass
             except OSError:
@@ -960,6 +968,13 @@ class Reconciler:
                     # kubelet assignment outlives the reclaim until the
                     # pod is deleted. Replaying would re-bind exactly
                     # what the throttle->evict escalation tore down.
+                    continue
+                if self.migration is not None and (
+                    self.migration.replay_suppressed(owner.pod_key)
+                ):
+                    # The migration coordinator reclaimed this acked
+                    # resident ahead of the drain deadline; until the
+                    # pod is evicted, its assignment must stay reclaimed.
                     continue
                 try:
                     info = self._storage.load(owner.namespace, owner.name)

@@ -188,6 +188,14 @@ class RepartitionController:
         # fresh evidence, never one frozen measurement re-counted.
         self._last_view_ts: Optional[float] = None
         self._last_error: Optional[str] = None
+        # MigrationCoordinator (migration.py), assigned by the manager:
+        # gates the QoS eviction the same way acks gate drain reclaim —
+        # a throttled pod that answers the clamp with a durable
+        # checkpoint is evicted with its work PRESERVED (record
+        # published before the teardown), and may be evicted the moment
+        # the ack lands instead of at the deadline (checkpointing under
+        # a throttle is the pod accepting the move).
+        self.migration = None
         self._resumed = False
 
     # -- derived quota state ---------------------------------------------------
@@ -736,6 +744,10 @@ class RepartitionController:
                     self._throttles[key]["deadline_ts"] if throttled
                     else None
                 )
+                throttle_since = (
+                    self._throttles[key]["since_ts"] if throttled
+                    else None
+                )
             if throttled:
                 # A standing throttle lifts ONLY on positive evidence
                 # of compliance: a fresh self-report within quota.
@@ -758,8 +770,20 @@ class RepartitionController:
                         "repartition: %s back within quota; throttle "
                         "lifted", key,
                     )
-                elif now >= deadline:
-                    self._evict(key, m.get("uid", ""), dirty, result)
+                    continue
+                # Migration gate: a still-over-quota pod that answered
+                # the throttle signal with a durable checkpoint ack has
+                # accepted the move — evict NOW with the work preserved
+                # instead of burning the rest of the grace deadline.
+                acked_early = (
+                    self.migration is not None
+                    and self.migration.acked_since(key, throttle_since)
+                )
+                if now >= deadline or acked_early:
+                    self._evict(
+                        key, m.get("uid", ""), dirty, result,
+                        acked=acked_early,
+                    )
                 continue
             if m["used"] is None:
                 # Coverage lost (no telemetry, no fresh report): no
@@ -824,14 +848,25 @@ class RepartitionController:
                 )
 
     def _evict(
-        self, key: str, uid: str, dirty: set, result: dict
+        self, key: str, uid: str, dirty: set, result: dict,
+        acked: bool = False,
     ) -> None:
-        """Deadline expired with the pod still over quota: reclaim its
-        bindings through the reconciler's reclaimed_pod repair class.
-        The evicted set is journaled BEFORE the teardown — a crash in
-        between must leave replay suppression armed, or the boot
-        reconcile would re-bind exactly what enforcement removed (the
-        safe wrong way round merely re-runs the escalation)."""
+        """Deadline expired (or the pod acked a post-throttle
+        checkpoint) with the pod still over quota: reclaim its bindings
+        through the reconciler's reclaimed_pod repair class. When the
+        migration coordinator holds a durable ack, a MigrationRecord is
+        published FIRST so the eviction preserves the work (the gated
+        eviction of ISSUE 14). The evicted set is journaled BEFORE the
+        teardown — a crash in between must leave replay suppression
+        armed, or the boot reconcile would re-bind exactly what
+        enforcement removed (the safe wrong way round merely re-runs
+        the escalation)."""
+        if self.migration is not None and (
+            acked or self.migration.acked_since(key, None)
+        ):
+            # best-effort, never blocks the eviction: the record (and
+            # its journal entry) outlives the reclaim either way
+            self.migration.publish_record(key, uid, reason="qos_evict")
         with self._lock:
             self._throttles.pop(key, None)
             self._evicted[key] = uid

@@ -161,6 +161,12 @@ class UtilizationSampler:
         # throttles, evict deadlines) from RepartitionController.status();
         # the `repartition` block of /debug/allocations and the bundle.
         self.repartition_status_fn: Optional[Callable[[], dict]] = None
+        # Also manager-set: () -> migration-coordinator status (per-pod
+        # ack freshness, outbound MigrationRecords, inbound resume
+        # verifications) from MigrationCoordinator.status(); the
+        # `migration` block of /debug/allocations and the doctor bundle
+        # — "are we actually checkpointing?" from one scrape.
+        self.migration_status_fn: Optional[Callable[[], dict]] = None
         # Also manager-set: (pod_key) -> signed core-percent delta the
         # repartition controller currently applies on top of the pod's
         # base grant. The overcommit detector judges usage against the
@@ -782,6 +788,11 @@ class UtilizationSampler:
                 out["repartition"] = self.repartition_status_fn()
             except Exception:  # noqa: BLE001 - introspection only
                 pass
+        if self.migration_status_fn is not None:
+            try:
+                out["migration"] = self.migration_status_fn()
+            except Exception:  # noqa: BLE001 - introspection only
+                pass
         if self.serving_status_fn is not None:
             try:
                 out["serving"] = self.serving_status_fn()
@@ -1067,6 +1078,26 @@ def validate_bundle(bundle: dict) -> List[str]:
             for field in ("stamped_pods", "reclaimed_pods"):
                 expect(isinstance(drain.get(field, []), list),
                        f"allocations.drain.{field} must be a list")
+    if isinstance(allocations, dict) and "migration" in allocations:
+        # absent in pre-migration-coordinator bundles and when no
+        # migration status hook is attached (standalone node-doctor)
+        migration = allocations["migration"]
+        expect(isinstance(migration, dict),
+               "allocations.migration must be an object")
+        if isinstance(migration, dict):
+            for field in ("early_reclaims_total",
+                          "records_published_total", "completed_total"):
+                expect(
+                    isinstance(migration.get(field), int),
+                    f"allocations.migration.{field} must be an int",
+                )
+            for field in ("acked_pods", "records", "inbound"):
+                expect(isinstance(migration.get(field, {}), dict),
+                       f"allocations.migration.{field} must be an object")
+            expect(
+                isinstance(migration.get("suppressed_pods", []), list),
+                "allocations.migration.suppressed_pods must be a list",
+            )
     if isinstance(allocations, dict) and "serving" in allocations:
         # absent unless a serving engine's stats hook is attached
         # (runner serve mode / tests); agent-only nodes have none

@@ -109,6 +109,108 @@ class PodRef:
         return f"{self.namespace}/{self.name}"
 
 
+class SimWorkload:
+    """Stub in-pod workload for migration chaos scenarios: a thread
+    ticking a step counter with the REAL LifecycleWatcher woven in —
+    the same spec-polling / atomic-ack code path a production runner
+    uses — writing stub checkpoints (a state file whose digest the ack
+    carries) to a shared 'PVC' directory. On a drain or reform signal
+    it saves, acks and (for drains) exits, exactly the contract
+    workloads/lifecycle.py documents; a replacement pod finds the
+    destination agent's restore stamp, adopts the checkpointed step and
+    acks the resume for verification."""
+
+    def __init__(
+        self,
+        alloc_spec_dir: str,
+        alloc_hash: str,
+        ckpt_dir: str,
+        tick_s: float = 0.02,
+        resume_wait_s: float = 0.0,
+        exit_on_drain: bool = True,
+    ) -> None:
+        from ..workloads.lifecycle import LifecycleWatcher
+
+        self.ckpt_dir = ckpt_dir
+        self.tick_s = tick_s
+        self.resume_wait_s = resume_wait_s
+        self.exit_on_drain = exit_on_drain
+        self.step = 0
+        self.saved_step: Optional[int] = None
+        self.resumed_step: Optional[int] = None
+        self.last_signal = None
+        self.exited = threading.Event()
+        self.watcher = LifecycleWatcher(
+            alloc_spec_dir, alloc_hash, poll_interval_s=0.0
+        )
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, daemon=True,
+            name=f"sim-workload-{alloc_hash[:8]}",
+        )
+
+    def start(self) -> "SimWorkload":
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=10.0)
+
+    def _save(self) -> None:
+        import json as _json
+
+        os.makedirs(self.ckpt_dir, exist_ok=True)
+        with open(os.path.join(self.ckpt_dir, "state.json"), "w") as f:
+            _json.dump({"step": self.step}, f)
+        self.saved_step = self.step
+
+    def _maybe_resume(self) -> None:
+        import json as _json
+
+        deadline = time.monotonic() + self.resume_wait_s
+        while not self._stop.is_set():
+            req = self.watcher.restore_request()
+            if req:
+                try:
+                    with open(os.path.join(
+                        req["checkpoint_dir"], "state.json"
+                    )) as f:
+                        self.step = int(_json.load(f)["step"])
+                except (OSError, ValueError, KeyError, TypeError):
+                    self.step = int(req.get("step") or 0)
+                self.resumed_step = self.step
+                self.watcher.ack_resume(
+                    self.step, checkpoint_dir=req["checkpoint_dir"]
+                )
+                return
+            if time.monotonic() >= deadline:
+                return
+            time.sleep(0.02)
+
+    def _run(self) -> None:
+        from ..workloads.lifecycle import SIGNAL_DRAIN
+
+        # The destination agent stamps the restore env moments AFTER
+        # the replacement's bind; a migrating workload polls briefly
+        # before concluding it starts from scratch.
+        self._maybe_resume()
+        while not self._stop.is_set():
+            self.step += 1
+            sig = self.watcher.poll(force=True)
+            if sig is not None:
+                self.last_signal = sig
+                self._save()
+                self.watcher.ack(
+                    self.step, checkpoint_dir=self.ckpt_dir,
+                    signal=sig.value, epoch=sig.epoch,
+                )
+                if sig.kind == SIGNAL_DRAIN and self.exit_on_drain:
+                    break
+            self._stop.wait(self.tick_s)
+        self.exited.set()
+
+
 class FleetSim:
     """Build, drive and tear down an N-node simulated fleet.
 
@@ -129,6 +231,7 @@ class FleetSim:
         operator_kinds: Optional[List[str]] = None,
         drain_deadline_s: float = 5.0,
         drain_period_s: float = 0.5,
+        migration_period_s: float = 0.25,
         timeline_cap: Optional[int] = None,
         storage_batch_window_s: float = 0.0,
         sink_flush_window_s: float = 0.0,
@@ -152,6 +255,10 @@ class FleetSim:
         # production 300s — chaos scenarios assert reclaim-on-deadline.
         self.drain_deadline_s = drain_deadline_s
         self.drain_period_s = drain_period_s
+        # Migration-coordinator tick (migration.py): sim scenarios
+        # assert ack-to-early-reclaim latency in fractions of the
+        # already-short sim drain deadline.
+        self.migration_period_s = migration_period_s
         # Lifecycle-timeline ring cap override (timeline.py): the
         # timeline smoke shrinks it to prove the ring + eviction
         # counter under churn; None = the production default.
@@ -230,6 +337,7 @@ class FleetSim:
                 slice_membership_ttl_s=self.slice_membership_ttl_s,
                 drain_deadline_s=self.drain_deadline_s,
                 drain_period_s=self.drain_period_s,
+                migration_period_s=self.migration_period_s,
                 storage_batch_window_s=self.storage_batch_window_s,
                 sink_flush_window_s=self.sink_flush_window_s,
                 **(
@@ -407,6 +515,89 @@ class FleetSim:
                 ))
                 refs.append(ref)
         return refs
+
+    def admit_pod(
+        self,
+        namespace: str,
+        name: str,
+        node_idx: int,
+        chip: int = 0,
+        annotations: Optional[Dict[str, str]] = None,
+    ) -> PodRef:
+        """Admit ONE pod with an explicit identity — the migration
+        scenarios' replacement admission: the external scheduler lands
+        the workload's next generation (same ns/name) on whatever node
+        has room, and that node's agent finds the MigrationRecord."""
+        _, _, make_pod = _import_fakes()
+        node = self.nodes[node_idx]
+        ref = PodRef(node_idx, namespace, name, chip, new_trace_id())
+        ann = {
+            AnnotationAssumed: "true",
+            container_annotation("jax"): str(chip),
+            AnnotationTraceID: ref.trace_id,
+        }
+        ann.update(annotations or {})
+        self.apiserver.upsert_pod(make_pod(
+            ref.namespace, ref.name, node.name,
+            annotations=ann, containers=[{"name": "jax"}],
+        ))
+        return ref
+
+    # -- migration handshake (migration.py) -----------------------------------
+
+    def alloc_hash_of(self, ref: PodRef) -> str:
+        """The pod's allocation hash — the key its ack file is written
+        under ('' when unbound). In a real container this is the
+        agent-injected ``TPU`` env; the sim reads the bound record."""
+        info = self.nodes[ref.node_idx].storage.load(
+            ref.namespace, ref.name
+        )
+        if info is None:
+            return ""
+        for rec in info.records():
+            return rec.device.hash
+        return ""
+
+    def start_workload(
+        self,
+        ref: PodRef,
+        ckpt_dir: str,
+        tick_s: float = 0.02,
+        resume_wait_s: float = 0.0,
+        exit_on_drain: bool = True,
+    ) -> SimWorkload:
+        """Run a stub workload (REAL LifecycleWatcher) inside ``ref``'s
+        binding; the pod must be bound first (the hash comes from its
+        stamped spec)."""
+        alloc_hash = self.alloc_hash_of(ref)
+        if not alloc_hash:
+            raise RuntimeError(f"{ref.pod_key} is not bound (no TPU env)")
+        return SimWorkload(
+            self.nodes[ref.node_idx].opts.alloc_spec_dir, alloc_hash,
+            ckpt_dir, tick_s=tick_s, resume_wait_s=resume_wait_s,
+            exit_on_drain=exit_on_drain,
+        ).start()
+
+    def migration_status(self, idx: int) -> Dict:
+        return self.nodes[idx].manager.migration.status()
+
+    def wait_migration_completed(
+        self, idx: int, pod_key: str, timeout_s: float = 30.0
+    ) -> Dict:
+        """Block until node ``idx``'s coordinator VERIFIES the inbound
+        resume of ``pod_key``; returns the completion entry."""
+        deadline = time.monotonic() + timeout_s
+        while True:
+            status = self.migration_status(idx)
+            for c in status.get("recent_completions", []):
+                if c.get("pod") == pod_key:
+                    return c
+            if time.monotonic() > deadline:
+                raise RuntimeError(
+                    f"{self.nodes[idx].name}: migration of {pod_key} "
+                    f"never verified (status: {status})"
+                )
+            time.sleep(0.02)
 
     def wait_synced(self, refs: List[PodRef], timeout_s: float = 60.0) -> None:
         """Wait until every node's sitter has seen its LAST admitted pod

@@ -88,6 +88,12 @@ KIND_REPARTITION = "repartition"
 # sustained-overcommit escalation (repartition.py): attrs.action is
 # throttle | unthrottle | evict, with the evict deadline where relevant
 KIND_THROTTLE = "throttle"
+# migration handshake (migration.py): attrs.action walks the record's
+# life — recorded | record_published | early_reclaim (source side),
+# restore_stamped | completed | verify_failed (destination side) — all
+# keyed pod + the SOURCE bind's trace id, so one id links the drain,
+# the checkpoint ack and the verified resume across nodes
+KIND_MIGRATION = "migration"
 # supervision (supervisor.py)
 KIND_SUBSYSTEM_RESTART = "subsystem_restart"
 KIND_SUBSYSTEM_CRASH_LOOP = "subsystem_crash_loop"

@@ -426,21 +426,65 @@ def main(argv=None) -> int:
     # Preemption-tolerant resume (TPU pods are preemptible; the elastic
     # scheduler may also move us): restore the latest checkpoint onto the
     # live mesh shardings, and save on SIGTERM before dying.
+    #
+    # Migration handshake (workloads/lifecycle.py): the watcher polls
+    # the alloc spec for the agent's drain signal / slice-epoch bump —
+    # either checkpoints NOW and acknowledges with an atomic ack file,
+    # so the agent can reclaim the chips the moment the work is safe
+    # instead of at the deadline. A replacement pod finds the
+    # destination agent's ELASTIC_TPU_RESTORE_DIR stamp, restores from
+    # the migrated checkpoint and acks the resume for verification.
+    from .lifecycle import SIGNAL_DRAIN, SIGNAL_REFORM, LifecycleWatcher
+
+    watcher = LifecycleWatcher()
+    restore_req = watcher.restore_request() if watcher.enabled else None
+    if watcher.enabled and restore_req is None:
+        # The destination agent stamps the restore env up to one
+        # migration tick AFTER the bind; a fast-starting replacement
+        # must not race past the stamp and silently train from
+        # scratch. Wait briefly — but not at all when a populated
+        # local checkpoint dir already answers where to resume from.
+        has_local = False
+        if args.checkpoint_dir and os.path.isdir(args.checkpoint_dir):
+            try:
+                has_local = bool(os.listdir(args.checkpoint_dir))
+            except OSError:
+                has_local = False
+        wait_s = 0.0 if has_local else float(
+            os.environ.get("ELASTIC_TPU_RESTORE_WAIT_S", "5")
+        )
+        deadline = time.monotonic() + wait_s
+        while restore_req is None and time.monotonic() < deadline:
+            time.sleep(0.2)
+            restore_req = watcher.restore_request()
+    ckpt_dir = args.checkpoint_dir
+    if not ckpt_dir and restore_req:
+        ckpt_dir = restore_req["checkpoint_dir"]
     ckpt = None
     start_step = 0
+    resumed = False
     preempted = {"flag": False}
-    if args.checkpoint_dir:
+    lifecycle_sig = {"sig": None}
+    if ckpt_dir:
         from .checkpointing import TrainCheckpointer
 
-        ckpt = TrainCheckpointer(args.checkpoint_dir)
+        ckpt = TrainCheckpointer(ckpt_dir)
         if ckpt.latest_step is not None:
             params, opt_state, start_step = ckpt.restore(params, opt_state)
             start_step += 1
+            resumed = True
 
         def on_sigterm(signum, frame):  # noqa: ARG001
             preempted["flag"] = True
 
         signal.signal(signal.SIGTERM, on_sigterm)
+    if restore_req is not None and watcher.enabled:
+        # The resume ack completes the handshake: the destination agent
+        # verifies step >= the record's acked step and that the world
+        # size matches the pod's CURRENT stamped slice env.
+        watcher.ack_resume(
+            start_step - 1 if resumed else None, checkpoint_dir=ckpt_dir
+        )
 
     # AOT-compile instead of a warmup execution: a real warmup step would
     # apply an optimizer update the step accounting never sees, so a
@@ -471,6 +515,7 @@ def main(argv=None) -> int:
     t0 = time.perf_counter()
     ran = 0
     loss = None
+    last_saved_step = None
     eval_hist = []
     eval_s = 0.0  # eval wall time, subtracted from step accounting
     try:
@@ -496,6 +541,15 @@ def main(argv=None) -> int:
                     "eval", step=step, loss=eval_hist[-1]["loss"],
                     duration_ms=round(ev_dt * 1000, 3),
                 )
+            sig = watcher.poll()
+            if sig is not None and sig.kind in (SIGNAL_DRAIN, SIGNAL_REFORM):
+                # checkpoint-and-exit: a drain means the chips go away;
+                # a reform means the world size changed and the process
+                # must restart to re-form the mesh. Either way the save
+                # below runs this iteration and the ack lands once the
+                # checkpoint is durable (after ckpt.wait()).
+                lifecycle_sig["sig"] = sig
+                preempted["flag"] = True
             if ckpt is not None and (
                 preempted["flag"] or (every > 0 and (step + 1) % every == 0)
             ):
@@ -508,6 +562,7 @@ def main(argv=None) -> int:
                     )
                 else:
                     ckpt.save(step, params, opt_state)
+                last_saved_step = step
             if preempted["flag"]:
                 break
         if loss is not None:
@@ -520,6 +575,14 @@ def main(argv=None) -> int:
     dt = time.perf_counter() - t0 - eval_s
     if ckpt is not None:
         ckpt.wait()
+        sig = lifecycle_sig["sig"]
+        if sig is not None and last_saved_step is not None:
+            # the checkpoint is durable (wait() returned) — only now is
+            # the ack honest: the agent reclaims the chips on it
+            watcher.ack(
+                last_saved_step, checkpoint_dir=ckpt_dir,
+                signal=sig.value, epoch=sig.epoch,
+            )
         ckpt.close()
 
     report = {
@@ -533,6 +596,10 @@ def main(argv=None) -> int:
         "tokens_per_s": tokens_per_step * ran / dt,
         "alloc_env": applied,
         "preempted": preempted["flag"],
+        "lifecycle_signal": (
+            lifecycle_sig["sig"].kind if lifecycle_sig["sig"] else None
+        ),
+        "resumed_from_migration": restore_req is not None,
     }
     if eval_hist:
         report["eval"] = eval_hist

@@ -400,12 +400,18 @@ class ServingEngine:
         mesh=None,
         role: str = "both",
         pool: Optional[SharedKVPool] = None,
+        lifecycle=None,
     ):
         # optional flight recorder (workloads/telemetry.py): every
         # admit/step emits a JSONL record tagged with the agent's
         # propagated trace id, so broker-side sharing decisions can be
         # validated against measured serving throughput
         self._recorder = recorder
+        # optional LifecycleWatcher (workloads/lifecycle.py): once the
+        # agent's drain signal lands in the alloc spec, NEW admissions
+        # are refused so the serving loop can finish in-flight streams
+        # and ack (lifecycle.drain_serving) before the chips go away
+        self._lifecycle = lifecycle
         self.params = params
         self.cfg = cfg
         self.slots = slots
@@ -1436,6 +1442,18 @@ class ServingEngine:
         rolling everything back on failure. Returns the claim as a
         dict; ``need_bucket`` additionally resolves the synchronous
         path's prompt bucket."""
+        if self._lifecycle is not None:
+            self._lifecycle.poll()
+            if getattr(self._lifecycle, "draining", False):
+                # ValueError: the engine's admission-control type (slot
+                # exhaustion, oversize prompts raise it too) — a serving
+                # loop that rejects/queues on ValueError must treat a
+                # drain refusal the same way, not die on it
+                raise ValueError(
+                    "engine draining: the node signalled "
+                    "ELASTIC_TPU_DRAIN — no new admissions; finish "
+                    "in-flight streams (lifecycle.drain_serving) and ack"
+                )
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         p = len(prompt)
         if p == 0:

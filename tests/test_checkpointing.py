@@ -96,6 +96,48 @@ def test_retention_keeps_newest(tmp_path):
     ckpt.close()
 
 
+def test_restore_at_changed_world_size_continues_loss_curve(tmp_path):
+    """The elastic-reform resume (ISSUE 14 satellite): a checkpoint
+    saved on a dp=4 mesh (8 devices) restores onto a SMALLER dp=3 mesh
+    (6 devices) — shardings re-laid-out by orbax onto the new mesh —
+    and the next step's loss matches the uninterrupted full-mesh run on
+    the same global batch. Today only same-shape resume was pinned;
+    this is exactly what a workload does after TPUSliceReformed shrinks
+    its world."""
+    mesh8 = make_mesh(8, dp=4, sp=1, tp=2)
+    step8, init8, _ = make_train_step(TINY, mesh8)
+    params, opt = init8(jax.random.key(0))
+    # global batch 12: divisible by BOTH dp=4 and dp=3
+    toks = jax.random.randint(jax.random.key(1), (12, 17), 0, TINY.vocab)
+    for _ in range(2):
+        params, opt, _ = step8(params, opt, toks)
+    ckpt = TrainCheckpointer(str(tmp_path / "ckpt"))
+    ckpt.save(1, params, opt)
+    ckpt.wait()
+    ckpt.close()
+
+    # the reformed world: 3 dp ranks over 6 of the 8 devices
+    mesh6 = make_mesh(6, dp=3, sp=1, tp=2)
+    step6, init6, _ = make_train_step(TINY, mesh6)
+    p_like, o_like = init6(jax.random.key(0))
+    ckpt2 = TrainCheckpointer(str(tmp_path / "ckpt"))
+    r_params, r_opt, step = ckpt2.restore(p_like, o_like)
+    ckpt2.close()
+    assert step == 1
+    # restored VALUES are the full-mesh values...
+    _trees_equal(params, r_params)
+    # ...but laid out on the smaller mesh
+    assert r_params["layers"][0]["w1"].sharding.mesh.shape["dp"] == 3
+
+    # loss-curve continuity: one more step on each world, same batch
+    _, _, loss_direct = step8(params, opt, toks)
+    _, _, loss_resumed = step6(r_params, r_opt, toks)
+    np.testing.assert_allclose(
+        np.asarray(loss_resumed), np.asarray(loss_direct),
+        rtol=2e-4, atol=1e-5,
+    )
+
+
 @pytest.mark.slow
 def test_runner_resumes_from_checkpoint(tmp_path):
     """Two real runner processes sharing a checkpoint dir: the second

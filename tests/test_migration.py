@@ -1,0 +1,824 @@
+"""Migration coordinator (migration.py) + pod-side lifecycle watcher
+(workloads/lifecycle.py): the verified checkpoint handshake.
+
+The acceptance bar (ISSUE 14): a drain signal answered by a durable
+ack file completes the drain EARLY (bindings reclaimed before the
+deadline, replay-suppressed until eviction) and publishes a
+MigrationRecord; an un-acked resident still gets the full deadline;
+the destination agent restamps the restore env for a replacement pod,
+verifies the resume (step >= acked step, world size == current slice)
+and emits TPUMigrationCompleted; ack files are reclaimed with their
+spec exactly like usage reports; drains classify into drained_acked vs
+drained_exited; and a crash at any migration failpoint
+(``migration.pre_ack`` / ``migration.post_record``) replays to the
+same converged state.
+
+`make crash-replay-smoke` runs this file alongside the drain replay
+suite.
+"""
+
+import json
+import os
+import time
+
+import pytest
+
+from elastic_tpu_agent import faults
+from elastic_tpu_agent.common import (
+    AckSubdir,
+    AnnotationAssumed,
+    EnvRestoreDir,
+    EnvRestoreStep,
+    ResourceTPUCore,
+    container_annotation,
+)
+from elastic_tpu_agent.crd import ElasticTPU, ElasticTPUClient, PhaseMigrated
+from elastic_tpu_agent.drain import DRAINED, DRAINING, RECLAIMED
+from elastic_tpu_agent.manager import TPUManager
+from elastic_tpu_agent.migration import migration_object_name
+from elastic_tpu_agent.plugins.tpushare import CORE_ENDPOINT, core_device_id
+from elastic_tpu_agent.workloads.lifecycle import (
+    SIGNAL_DRAIN,
+    SIGNAL_REFORM,
+    SIGNAL_THROTTLE,
+    LifecycleWatcher,
+    checkpoint_digest,
+    read_checkpoint_ack,
+    write_checkpoint_ack,
+)
+
+from test_e2e import Cluster, wait_until
+
+from fake_apiserver import make_pod
+
+MIGRATION_FAILPOINTS = ["migration.pre_ack", "migration.post_record"]
+
+
+# -- harness ------------------------------------------------------------------
+
+
+def _make_cluster(tmp_path, name="mig", metrics=None):
+    d = tmp_path / name
+    d.mkdir()
+    c = Cluster(d, metrics=metrics)
+    # Park the supervised loops: these tests drive tick() manually.
+    c.manager.drain.period_s = 3600.0
+    c.manager.migration.period_s = 3600.0
+    if c.manager.repartition is not None:
+        c.manager.repartition.period_s = 3600.0
+    c.start()
+    return c
+
+
+def _bind_pod(c, pod_name, chip="1", n_units=10, annotations=None):
+    ann = {
+        AnnotationAssumed: "true",
+        container_annotation("jax"): chip,
+    }
+    ann.update(annotations or {})
+    c.apiserver.upsert_pod(make_pod(
+        "default", pod_name, c.node, annotations=ann,
+        containers=[{"name": "jax"}],
+    ))
+    assert wait_until(
+        lambda: c.manager.sitter.get_pod("default", pod_name) is not None
+    )
+    ids = [core_device_id(int(chip.split(",")[0]), f"{pod_name}u{j}")
+           for j in range(n_units)]
+    c.kubelet.kubelet_allocate_flow(
+        CORE_ENDPOINT, "default", pod_name, "jax", ResourceTPUCore, ids
+    )
+    return ids
+
+
+def _hash_of(c, pod_name):
+    info = c.manager.storage.load("default", pod_name)
+    assert info is not None, f"{pod_name} not bound"
+    return next(iter(info.records())).device.hash
+
+
+def _ack(c, pod_name, step=7, **kw):
+    """Write the pod's ack the way the in-pod watcher would."""
+    ok = write_checkpoint_ack(
+        c.opts.alloc_spec_dir, _hash_of(c, pod_name), step, **kw
+    )
+    assert ok
+    return step
+
+
+@pytest.fixture()
+def cluster(tmp_path):
+    c = _make_cluster(tmp_path)
+    yield c
+    c.stop()
+
+
+# -- pod-side watcher ---------------------------------------------------------
+
+
+def _write_spec(d, h, env):
+    with open(os.path.join(d, f"{h}.json"), "w") as f:
+        json.dump({"env": env}, f)
+
+
+def test_watcher_signal_edges_fire_once_and_rearm(tmp_path):
+    d = str(tmp_path)
+    env = {"ELASTIC_TPU_SLICE_EPOCH": "0",
+           "TPU_WORKER_HOSTNAMES": "a,b,c"}
+    _write_spec(d, "h1", env)
+    w = LifecycleWatcher(d, "h1", poll_interval_s=0.0)
+    assert w.enabled
+    # the baseline epoch the pod started at is NOT a reform
+    assert w.poll(force=True) is None
+    # drain edge fires exactly once per distinct value
+    env["ELASTIC_TPU_DRAIN"] = "maintenance:X"
+    env["ELASTIC_TPU_DRAIN_DEADLINE"] = "99"
+    _write_spec(d, "h1", env)
+    sig = w.poll(force=True)
+    assert sig.kind == SIGNAL_DRAIN and sig.deadline_ts == 99.0
+    assert w.draining
+    assert w.poll(force=True) is None
+    # a cancelled drain re-arms the edge
+    del env["ELASTIC_TPU_DRAIN"]
+    _write_spec(d, "h1", env)
+    assert w.poll(force=True) is None
+    env["ELASTIC_TPU_DRAIN"] = "preemption"
+    _write_spec(d, "h1", env)
+    assert w.poll(force=True).kind == SIGNAL_DRAIN
+    # epoch bump is a reform signal
+    env["ELASTIC_TPU_SLICE_EPOCH"] = "1"
+    env["TPU_WORKER_HOSTNAMES"] = "a,b"
+    _write_spec(d, "h1", env)
+    sig = w.poll(force=True)
+    assert sig.kind == SIGNAL_REFORM and sig.epoch == 1
+    # throttle deadline is a signal too
+    env["ELASTIC_TPU_THROTTLE"] = "overcommit"
+    env["ELASTIC_TPU_THROTTLE_DEADLINE"] = "123"
+    _write_spec(d, "h1", env)
+    sig = w.poll(force=True)
+    assert sig.kind == SIGNAL_THROTTLE and sig.deadline_ts == 123.0
+
+
+def test_watcher_checkpoint_fn_acks_inline(tmp_path):
+    d = str(tmp_path)
+    ck = str(tmp_path / "ck")
+    os.makedirs(ck)
+    with open(os.path.join(ck, "w.bin"), "w") as f:
+        f.write("weights")
+    _write_spec(d, "h2", {"TPU_WORKER_HOSTNAMES": "a,b"})
+    calls = []
+
+    def checkpoint(sig):
+        calls.append(sig.kind)
+        return 41, ck
+
+    w = LifecycleWatcher(d, "h2", checkpoint_fn=checkpoint,
+                         poll_interval_s=0.0)
+    _write_spec(d, "h2", {"TPU_WORKER_HOSTNAMES": "a,b",
+                          "ELASTIC_TPU_DRAIN": "preemption"})
+    assert w.poll(force=True).kind == SIGNAL_DRAIN
+    assert calls == [SIGNAL_DRAIN]
+    ack = read_checkpoint_ack(d, "h2")
+    assert ack["step"] == 41
+    assert ack["world_size"] == 2  # from the CURRENT stamped env
+    assert ack["signal"] == "preemption"
+    assert ack["digest"] == checkpoint_digest(ck)
+
+
+def test_watcher_disabled_outside_contract(tmp_path, monkeypatch):
+    monkeypatch.delenv("TPU", raising=False)
+    monkeypatch.delenv("GPU", raising=False)
+    monkeypatch.delenv("ELASTIC_TPU_ALLOC_DIR", raising=False)
+    w = LifecycleWatcher()
+    assert not w.enabled
+    assert w.poll(force=True) is None
+    assert w.ack(1) is False
+
+
+def test_ack_write_is_atomic_and_digest_stable(tmp_path):
+    d = str(tmp_path)
+    (tmp_path / "ck").mkdir()
+    (tmp_path / "ck" / "data.bin").write_bytes(b"x" * 100)
+    dg1 = checkpoint_digest(str(tmp_path / "ck"))
+    assert dg1 and dg1 == checkpoint_digest(str(tmp_path / "ck"))
+    (tmp_path / "ck" / "data.bin").write_bytes(b"x" * 101)
+    assert checkpoint_digest(str(tmp_path / "ck")) != dg1
+    assert write_checkpoint_ack(d, "h3", 5, checkpoint_dir=str(tmp_path))
+    assert not os.path.exists(
+        os.path.join(d, AckSubdir, "h3.json.tmp")
+    )
+    assert read_checkpoint_ack(d, "h3")["step"] == 5
+
+
+# -- source role: ack consumption + early drain completion --------------------
+
+
+def test_ack_consumption_feeds_status_and_age(cluster):
+    _bind_pod(cluster, "train-0")
+    _ack(cluster, "train-0", step=12, checkpoint_dir="/ckpt")
+    mig = cluster.manager.migration
+    mig.tick()
+    st = mig.status()
+    assert "default/train-0" in st["acked_pods"]
+    entry = st["acked_pods"]["default/train-0"]
+    assert entry["step"] == 12 and entry["age_s"] >= 0
+    # future-stamped acks are rejected (skewed clock)
+    _bind_pod(cluster, "train-1", chip="2")
+    write_checkpoint_ack(
+        cluster.opts.alloc_spec_dir, _hash_of(cluster, "train-1"),
+        3, ts=time.time() + 3600,
+    )
+    mig.tick()
+    assert "default/train-1" not in mig.status()["acked_pods"]
+
+
+def test_acked_drain_reclaims_early_unacked_waits(cluster):
+    """The headline: during a drain, the acked resident's bindings go
+    the moment the ack is durable — far before the deadline — while the
+    un-acked resident is untouched until the deadline; the reconciler
+    must not replay the early-reclaimed bind back."""
+    _bind_pod(cluster, "acked-0", chip="1")
+    _bind_pod(cluster, "silent-0", chip="2")
+    drain = cluster.manager.drain
+    drain.deadline_s = 3600.0  # the deadline is NOT what frees acked-0
+    cluster.manager.operator.set_maintenance_event(
+        "TERMINATE_ON_HOST_MAINTENANCE"
+    )
+    assert drain.tick() == DRAINING
+    mig = cluster.manager.migration
+    mig.tick()  # no acks yet: nothing reclaimed
+    assert cluster.manager.storage.load("default", "acked-0") is not None
+
+    _ack(cluster, "acked-0", step=33, checkpoint_dir="/ckpt/a")
+    mig.tick()
+    # early reclaim: acked gone, silent untouched, deadline far away
+    assert cluster.manager.storage.load("default", "acked-0") is None
+    assert cluster.manager.storage.load("default", "silent-0") is not None
+    assert drain.deadline_ts - time.time() > 3000
+    assert mig.replay_suppressed("default/acked-0")
+    st = mig.status()
+    assert st["early_reclaims_total"] == 1
+    assert st["records"]["default/acked-0"]["step"] == 33
+    assert st["records"]["default/acked-0"]["reclaimed"] is True
+    # kubelet still lists the assignment; two passes must not replay it
+    cluster.manager.reconciler.reconcile_once()
+    report = cluster.manager.reconciler.reconcile_once()
+    assert report["replayed_binds"] == 0
+    assert cluster.manager.storage.load("default", "acked-0") is None
+    # a stale PRE-drain ack must not early-reclaim: silent-0 stays
+    write_checkpoint_ack(
+        cluster.opts.alloc_spec_dir, _hash_of(cluster, "silent-0"),
+        1, ts=drain.started_ts() - 10.0,
+    )
+    mig.tick()
+    assert cluster.manager.storage.load("default", "silent-0") is not None
+
+
+def test_record_published_and_confirmed_at_apiserver(cluster):
+    _bind_pod(cluster, "train-0")
+    drain = cluster.manager.drain
+    drain.deadline_s = 3600.0
+    cluster.manager.operator.set_maintenance_event(
+        "TERMINATE_ON_HOST_MAINTENANCE"
+    )
+    assert drain.tick() == DRAINING
+    _ack(cluster, "train-0", step=9, checkpoint_dir="/pvc/t0")
+    mig = cluster.manager.migration
+    mig.tick()
+    # publication rides the async CRD sink; confirm by read-back
+    assert cluster.manager.crd_recorder.flush()
+    mig.tick()
+    st = mig.status()
+    assert st["records"]["default/train-0"]["published"] is True
+    crd = ElasticTPUClient(cluster.opts.kube_client)
+    obj = crd.get(migration_object_name("default", "train-0"))
+    assert obj is not None and obj.phase == PhaseMigrated
+    assert obj.migration["step"] == 9
+    assert obj.migration["checkpoint_dir"] == "/pvc/t0"
+    assert obj.migration["source_node"] == cluster.node
+    # trace id from the bind rides the record
+    assert obj.migration["trace"], obj.migration
+
+
+def test_drained_acked_vs_drained_exited_outcome(tmp_path):
+    """Satellite: 'resident exited' no longer reads as a successful
+    drain — outcomes split by ack coverage, in status and the
+    elastic_tpu_drains_total{trigger,outcome} counter."""
+    from prometheus_client import CollectorRegistry
+
+    from elastic_tpu_agent.metrics import AgentMetrics
+
+    reg = CollectorRegistry()
+    c = _make_cluster(tmp_path, metrics=AgentMetrics(registry=reg))
+    try:
+        _bind_pod(c, "worker-0")
+        drain = c.manager.drain
+        drain.deadline_s = 3600.0
+        c.manager.operator.set_maintenance_event(
+            "TERMINATE_ON_HOST_MAINTENANCE"
+        )
+        assert drain.tick() == DRAINING
+        _ack(c, "worker-0", step=5)
+        c.manager.migration.tick()  # early reclaim: residents now empty
+        assert drain.tick() == DRAINED
+        assert drain.status()["outcome"] == "drained_acked"
+        assert drain.status()["acked_pods"] == ["default/worker-0"]
+        assert reg.get_sample_value(
+            "elastic_tpu_drains_total",
+            {"trigger": "maintenance", "outcome": "drained_acked"},
+        ) == 1.0
+
+        # second drain: the resident exits WITHOUT acking
+        c.manager.operator.set_maintenance_event("NONE")
+        assert drain.tick() == "active"
+        _bind_pod(c, "worker-1", chip="2")
+        c.manager.operator.set_maintenance_event(
+            "TERMINATE_ON_HOST_MAINTENANCE"
+        )
+        assert drain.tick() == DRAINING
+        # the pod exits: apiserver delete -> GC reclaims the binding
+        c.kubelet.unassign_pod("default", "worker-1")
+        c.apiserver.delete_pod("default", "worker-1")
+        assert wait_until(
+            lambda: c.manager.storage.load("default", "worker-1") is None
+        )
+        assert drain.tick() == DRAINED
+        assert drain.status()["outcome"] == "drained_exited"
+        assert reg.get_sample_value(
+            "elastic_tpu_drains_total",
+            {"trigger": "maintenance", "outcome": "drained_exited"},
+        ) == 1.0
+    finally:
+        c.stop()
+
+
+def test_empty_node_drain_is_drained_empty_not_exited(cluster):
+    """A drain with zero residents must not pollute either real
+    outcome: nothing was saved AND nothing was lost."""
+    drain = cluster.manager.drain
+    cluster.manager.operator.set_maintenance_event(
+        "MIGRATE_ON_HOST_MAINTENANCE"
+    )
+    assert drain.tick() == DRAINING
+    assert drain.tick() == DRAINED
+    assert drain.status()["outcome"] == "drained_empty"
+
+
+def test_qos_record_swept_after_pod_gone_without_suppression(cluster):
+    """publish_record (the QoS-evict path) never arms replay
+    suppression; its record must still sweep by its own uid once the
+    pod generation is gone — a leaked record would block a same-node
+    re-admission from ever adopting it."""
+    _bind_pod(cluster, "tenant-2")
+    _ack(cluster, "tenant-2", step=3)
+    mig = cluster.manager.migration
+    mig.tick()
+    assert mig.publish_record("default/tenant-2") is True
+    assert cluster.manager.crd_recorder.flush()
+    mig.tick()  # confirm the publish
+    assert mig.status()["records"]["default/tenant-2"]["published"]
+    # the evicted pod is deleted; its record must sweep
+    cluster.kubelet.unassign_pod("default", "tenant-2")
+    cluster.apiserver.delete_pod("default", "tenant-2")
+    assert wait_until(
+        lambda: cluster.manager.sitter.get_pod(
+            "default", "tenant-2") is None
+    )
+    mig.tick()
+    assert "default/tenant-2" not in mig.status()["records"]
+
+
+def test_verify_failure_counted_once_per_distinct_ack(cluster):
+    """The same unchanged failing resume ack re-read every tick is ONE
+    incident, not one failure per tick."""
+    _publish_record(cluster, "default", "job-2", step=50)
+    _bind_pod(cluster, "job-2")
+    mig = cluster.manager.migration
+    mig.tick()
+    write_checkpoint_ack(
+        cluster.opts.alloc_spec_dir, _hash_of(cluster, "job-2"),
+        10, kind="resume", world_size=1, ts=1234.5,
+    )
+    for _ in range(4):
+        mig.tick()
+    assert mig.status()["verify_failures_total"] == 1
+    # a DIFFERENT failing ack is a new incident
+    write_checkpoint_ack(
+        cluster.opts.alloc_spec_dir, _hash_of(cluster, "job-2"),
+        11, kind="resume", world_size=1, ts=1236.5,
+    )
+    mig.tick()
+    assert mig.status()["verify_failures_total"] == 2
+
+
+def test_unacked_drain_still_honors_full_deadline(cluster):
+    _bind_pod(cluster, "silent-0")
+    drain = cluster.manager.drain
+    drain.deadline_s = 0.4
+    cluster.manager.operator.set_maintenance_event(
+        "TERMINATE_ON_HOST_MAINTENANCE"
+    )
+    assert drain.tick() == DRAINING
+    mig = cluster.manager.migration
+    mig.tick()
+    # before the deadline: untouched
+    assert cluster.manager.storage.load("default", "silent-0") is not None
+    time.sleep(0.5)
+    mig.tick()  # still no ack: the coordinator never touches it
+    assert cluster.manager.storage.load("default", "silent-0") is not None
+    assert drain.tick() == RECLAIMED
+    assert cluster.manager.storage.load("default", "silent-0") is None
+    assert drain.status()["outcome"] == "reclaimed"
+
+
+# -- QoS eviction gate --------------------------------------------------------
+
+
+def test_qos_evict_publishes_record_for_acked_pod(cluster):
+    _bind_pod(cluster, "tenant-0")
+    _ack(cluster, "tenant-0", step=21, checkpoint_dir="/pvc/q")
+    mig = cluster.manager.migration
+    mig.tick()
+    rep = cluster.manager.repartition
+    assert rep is not None and rep.migration is mig
+    result = {"grown": 0, "shrunk": 0, "throttled": 0, "evicted": 0}
+    rep._evict("default/tenant-0", "", set(), result, acked=True)
+    assert result["evicted"] == 1
+    assert cluster.manager.storage.load("default", "tenant-0") is None
+    st = mig.status()
+    assert st["records"]["default/tenant-0"]["reason"] == "qos_evict"
+    assert st["records"]["default/tenant-0"]["step"] == 21
+
+
+def test_publish_record_without_ack_returns_false(cluster):
+    _bind_pod(cluster, "tenant-1")
+    mig = cluster.manager.migration
+    mig.tick()
+    assert mig.publish_record("default/tenant-1") is False
+
+
+# -- destination role: restamp + verified resume ------------------------------
+
+
+def _publish_record(cluster, ns, name, step=50, world=None,
+                    checkpoint_dir="/pvc/job", trace="trace-xyz"):
+    crd = ElasticTPUClient(cluster.opts.kube_client)
+    payload = {
+        "pod": f"{ns}/{name}", "uid": "old-uid",
+        "source_node": "other-node", "reason": "drain:maintenance",
+        "step": step, "checkpoint_dir": checkpoint_dir,
+        "digest": "d" * 32, "ack_kind": "checkpoint",
+        "ack_ts": time.time(), "trace": trace,
+        "topology_env": {}, "recorded_ts": time.time(),
+    }
+    crd.create(ElasticTPU(
+        name=migration_object_name(ns, name),
+        claim_namespace=ns, claim_name=name,
+        phase=PhaseMigrated, migration=payload,
+    ))
+    return payload
+
+
+def _spec_env(c, pod_name):
+    core = c.manager.plugin.core
+    spec = core.read_alloc_spec(_hash_of(c, pod_name))
+    return dict(spec.get("env") or {})
+
+
+def test_destination_restamps_and_verifies_resume(cluster):
+    _publish_record(cluster, "default", "job-0", step=50)
+    _bind_pod(cluster, "job-0")
+    mig = cluster.manager.migration
+    mig.tick()
+    env = _spec_env(cluster, "job-0")
+    assert env[EnvRestoreDir] == "/pvc/job"
+    assert env[EnvRestoreStep] == "50"
+    st = mig.status()
+    assert st["inbound"]["default/job-0"]["stage"] == "restamped"
+    # the workload restores and acks the resume
+    write_checkpoint_ack(
+        cluster.opts.alloc_spec_dir, _hash_of(cluster, "job-0"),
+        50, kind="resume", world_size=1, checkpoint_dir="/pvc/job",
+    )
+    mig.tick()
+    st = mig.status()
+    assert st["completed_total"] == 1
+    done = st["recent_completions"][0]
+    assert done["pod"] == "default/job-0" and done["step"] == 50
+    assert done["trace"] == "trace-xyz"
+    # the record's job is done: deleted at the apiserver
+    crd = ElasticTPUClient(cluster.opts.kube_client)
+    assert crd.get(migration_object_name("default", "job-0")) is None
+    # TPUMigrationCompleted reached the apiserver
+    assert cluster.manager.events.flush()
+    reasons = {e.get("reason") for e in cluster.apiserver.core_events}
+    assert "TPUMigrationCompleted" in reasons
+    # timeline: the completion keyed to the SOURCE trace id
+    events = cluster.manager.timeline.events(trace="trace-xyz")
+    kinds = [(e["kind"], e["attrs"].get("action")) for e in events]
+    assert ("migration", "restore_stamped") in kinds
+    assert ("migration", "completed") in kinds
+
+
+def test_resume_verification_rejects_lower_step_and_wrong_world(cluster):
+    _publish_record(cluster, "default", "job-1", step=50)
+    _bind_pod(cluster, "job-1")
+    mig = cluster.manager.migration
+    mig.tick()
+    # resumed BELOW the acked step: rejected
+    write_checkpoint_ack(
+        cluster.opts.alloc_spec_dir, _hash_of(cluster, "job-1"),
+        49, kind="resume", world_size=1,
+    )
+    mig.tick()
+    st = mig.status()
+    assert st["completed_total"] == 0
+    assert st["verify_failures_total"] >= 1
+    assert "default/job-1" in st["inbound"]
+    # wrong world size: rejected (pod has no slice env -> world 1)
+    write_checkpoint_ack(
+        cluster.opts.alloc_spec_dir, _hash_of(cluster, "job-1"),
+        50, kind="resume", world_size=4,
+    )
+    mig.tick()
+    assert mig.status()["completed_total"] == 0
+    # correct resume: verified
+    write_checkpoint_ack(
+        cluster.opts.alloc_spec_dir, _hash_of(cluster, "job-1"),
+        51, kind="resume", world_size=1,
+    )
+    mig.tick()
+    assert mig.status()["completed_total"] == 1
+
+
+def test_object_name_is_collision_free_across_separator_ambiguity():
+    """ns and name may both contain '-': the readable prefix alone
+    would make team-a/x and team/a-x share one record object."""
+    assert migration_object_name("team-a", "x") != (
+        migration_object_name("team", "a-x")
+    )
+    # deterministic rendezvous: same identity, same name, both sides
+    assert migration_object_name("default", "job") == (
+        migration_object_name("default", "job")
+    )
+    assert len(migration_object_name("n" * 300, "p" * 300)) <= 253
+
+
+def test_record_published_after_replacement_bind_is_still_found(cluster):
+    """The sink-straggler net: a record landing AFTER the replacement
+    bound is found by the delayed second look, which must refresh the
+    snapshot instead of re-reading the one that missed."""
+    _bind_pod(cluster, "late-0")
+    mig = cluster.manager.migration
+    mig.record_recheck_s = 0.0  # the second look is due immediately
+    mig.tick()  # attempt 1: no record yet
+    assert mig.status()["inbound"] == {}
+    _publish_record(cluster, "default", "late-0", step=5)
+    mig.tick()  # attempt 2: MUST see a fresh snapshot
+    assert mig.status()["inbound"]["default/late-0"]["stage"] == (
+        "restamped"
+    )
+
+
+def test_migration_records_listed_by_label_selector(cluster):
+    """Destination discovery LISTs only labeled record objects — never
+    the fleet's per-allocation collection."""
+    _publish_record(cluster, "default", "sel-0", step=1)
+    crd = ElasticTPUClient(cluster.opts.kube_client)
+    # an ordinary (non-migration) object must not ride the selector
+    crd.create(ElasticTPU(name="plain-obj", node_name=cluster.node))
+    names = {o.name for o in crd.list_migrations()}
+    assert migration_object_name("default", "sel-0") in names
+    assert "plain-obj" not in names
+
+
+def test_watcher_draining_is_sticky_across_later_edges(tmp_path):
+    """A throttle (or reform) edge arriving DURING a drain must not
+    flip `draining` back off — admissions stay closed until the drain
+    stamp itself clears."""
+    d = str(tmp_path)
+    env = {"ELASTIC_TPU_DRAIN": "maintenance:X"}
+    _write_spec(d, "h9", env)
+    w = LifecycleWatcher(d, "h9", poll_interval_s=0.0)
+    assert w.poll(force=True).kind == SIGNAL_DRAIN
+    assert w.draining
+    env["ELASTIC_TPU_THROTTLE"] = "overcommit"
+    _write_spec(d, "h9", env)
+    assert w.poll(force=True).kind == SIGNAL_THROTTLE
+    assert w.draining  # the drain stamp is still there
+    del env["ELASTIC_TPU_DRAIN"]
+    _write_spec(d, "h9", env)
+    w.poll(force=True)
+    assert not w.draining  # cancelled drain reopens admissions
+
+
+def test_plain_pods_cause_no_inbound_state(cluster):
+    """A pod with no published record resolves once (plus one delayed
+    recheck) and never creates inbound state."""
+    _bind_pod(cluster, "plain-0")
+    mig = cluster.manager.migration
+    mig.tick()
+    mig.tick()
+    st = mig.status()
+    assert st["inbound"] == {}
+    env = _spec_env(cluster, "plain-0")
+    assert EnvRestoreDir not in env
+
+
+# -- sidecar reclaim (satellite: ack/usage unification) -----------------------
+
+
+def test_ack_reclaimed_with_spec_like_usage_report(cluster):
+    from elastic_tpu_agent.types import PodContainer
+
+    _bind_pod(cluster, "gone-0")
+    h = _hash_of(cluster, "gone-0")
+    d = cluster.opts.alloc_spec_dir
+    write_checkpoint_ack(d, h, 3)
+    # a crash-debris temp must be reclaimed too
+    open(os.path.join(d, AckSubdir, f"{h}.json.tmp"), "w").close()
+    assert os.path.exists(os.path.join(d, AckSubdir, f"{h}.json"))
+    cluster.manager.plugin.core.remove_alloc_spec(
+        h, PodContainer("default", "gone-0", "jax")
+    )
+    assert not os.path.exists(os.path.join(d, AckSubdir, f"{h}.json"))
+    assert not os.path.exists(
+        os.path.join(d, AckSubdir, f"{h}.json.tmp")
+    )
+
+
+def test_orphan_spec_sweep_reclaims_ack(cluster):
+    d = cluster.opts.alloc_spec_dir
+    os.makedirs(d, exist_ok=True)
+    # a spec no record/intent knows about, with a matching ack
+    with open(os.path.join(d, "feedbeef.json"), "w") as f:
+        json.dump({"env": {}}, f)
+    write_checkpoint_ack(d, "feedbeef", 1)
+    report = cluster.manager.reconciler.reconcile_once()
+    assert report["orphan_specs"] >= 1
+    assert not os.path.exists(os.path.join(d, "feedbeef.json"))
+    assert not os.path.exists(
+        os.path.join(d, AckSubdir, "feedbeef.json")
+    )
+
+
+# -- observability ------------------------------------------------------------
+
+
+def test_migration_block_in_debug_and_doctor(cluster):
+    from elastic_tpu_agent.sampler import (
+        build_diagnostics_bundle,
+        validate_bundle,
+    )
+
+    _bind_pod(cluster, "train-0")
+    _ack(cluster, "train-0", step=4)
+    cluster.manager.migration.tick()
+    snap = cluster.manager.sampler.allocations_snapshot()
+    assert "default/train-0" in snap["migration"]["acked_pods"]
+    bundle = build_diagnostics_bundle(
+        cluster.manager.operator, sampler=cluster.manager.sampler,
+        node_name=cluster.node,
+    )
+    assert validate_bundle(bundle) == []
+    bundle["allocations"]["migration"]["early_reclaims_total"] = "lots"
+    assert any("early_reclaims_total" in p
+               for p in validate_bundle(bundle))
+
+
+def test_checkpoint_age_gauge_bounded_per_pod(tmp_path):
+    from prometheus_client import CollectorRegistry
+
+    from elastic_tpu_agent.metrics import AgentMetrics
+
+    reg = CollectorRegistry()
+    c = _make_cluster(tmp_path, metrics=AgentMetrics(registry=reg))
+    try:
+        _bind_pod(c, "train-0")
+        _ack(c, "train-0", step=2, ts=time.time() - 30)
+        c.manager.migration.tick()
+        age = reg.get_sample_value(
+            "elastic_tpu_workload_checkpoint_age_seconds",
+            {"pod": "default/train-0"},
+        )
+        assert age is not None and 29 <= age <= 120
+        # un-acked pods have NO series (absence = never checkpointed)
+        _bind_pod(c, "train-1", chip="2")
+        c.manager.migration.tick()
+        assert reg.get_sample_value(
+            "elastic_tpu_workload_checkpoint_age_seconds",
+            {"pod": "default/train-1"},
+        ) is None
+    finally:
+        c.stop()
+
+
+# -- crash replay over the new failpoints -------------------------------------
+
+
+@pytest.mark.parametrize("failpoint", MIGRATION_FAILPOINTS)
+def test_kill_at_migration_failpoints_converges(tmp_path, failpoint):
+    """Die mid-handshake at each failpoint, restart the manager over
+    the surviving db, and the handshake must converge: the record
+    published exactly once, the acked binding reclaimed, replay
+    suppression armed across the boot reconcile, no torn state."""
+    c = _make_cluster(
+        tmp_path, name=f"fp{MIGRATION_FAILPOINTS.index(failpoint)}"
+    )
+    try:
+        _bind_pod(c, "acked-0")
+        drain = c.manager.drain
+        drain.deadline_s = 3600.0
+        c.manager.operator.set_maintenance_event(
+            "TERMINATE_ON_HOST_MAINTENANCE"
+        )
+        assert drain.tick() == DRAINING
+        _ack(c, "acked-0", step=17, checkpoint_dir="/pvc/a")
+        with faults.armed(failpoint, "die-thread:1"):
+            with pytest.raises(faults.DieThread):
+                c.manager.migration.tick()
+
+        c.manager.stop()
+        mgr2 = TPUManager(c.opts)
+        mgr2.drain.period_s = 3600.0
+        mgr2.migration.period_s = 3600.0
+        mgr2.operator.set_maintenance_event(
+            "TERMINATE_ON_HOST_MAINTENANCE"
+        )
+        mgr2.run(block=False)
+        c.manager = mgr2
+        if failpoint == "migration.post_record":
+            # journaled BEFORE the crash: suppression armed through the
+            # boot reconcile, before any tick runs
+            assert mgr2.migration.replay_suppressed("default/acked-0")
+        assert mgr2.drain.state in (DRAINING, "cordoned")
+        mgr2.drain.tick()
+        mgr2.migration.tick()
+        # converged: early reclaim done, record journaled + published
+        assert mgr2.storage.load("default", "acked-0") is None
+        assert mgr2.crd_recorder.flush()
+        mgr2.migration.tick()
+        st = mgr2.migration.status()
+        assert st["records"]["default/acked-0"]["reclaimed"] is True
+        assert st["records"]["default/acked-0"]["published"] is True
+        assert st["early_reclaims_total"] == 1
+        crd = ElasticTPUClient(c.opts.kube_client)
+        assert crd.get(
+            migration_object_name("default", "acked-0")
+        ) is not None
+        # the reconciler must not replay the reclaimed bind back
+        mgr2.reconciler.reconcile_once()
+        report = mgr2.reconciler.reconcile_once()
+        assert report["replayed_binds"] == 0
+        assert mgr2.storage.load("default", "acked-0") is None
+        # drain completes as acked (the journaled ack survived)
+        assert mgr2.drain.tick() == DRAINED
+        assert mgr2.drain.status()["outcome"] == "drained_acked"
+    finally:
+        c.stop()
+
+
+def test_migration_state_survives_restart_before_publish(tmp_path):
+    """A record journaled but not yet at the apiserver (sink dead) is
+    re-published by the restarted agent — the journal is the durable
+    copy."""
+    c = _make_cluster(tmp_path, name="pub")
+    try:
+        _bind_pod(c, "acked-0")
+        drain = c.manager.drain
+        drain.deadline_s = 3600.0
+        c.manager.operator.set_maintenance_event(
+            "TERMINATE_ON_HOST_MAINTENANCE"
+        )
+        assert drain.tick() == DRAINING
+        _ack(c, "acked-0", step=8)
+        # cripple the CRD sink so the publish cannot land pre-restart
+        c.manager.migration._crd_recorder = None
+        c.manager.migration._crd = None
+        c.manager.migration.tick()
+        assert (
+            c.manager.migration.status()["records"]
+            ["default/acked-0"]["published"] is False
+        )
+        c.manager.stop()
+        mgr2 = TPUManager(c.opts)
+        mgr2.drain.period_s = 3600.0
+        mgr2.migration.period_s = 3600.0
+        mgr2.operator.set_maintenance_event(
+            "TERMINATE_ON_HOST_MAINTENANCE"
+        )
+        mgr2.run(block=False)
+        c.manager = mgr2
+        mgr2.migration.tick()
+        assert mgr2.crd_recorder.flush()
+        mgr2.migration.tick()
+        assert (
+            mgr2.migration.status()["records"]
+            ["default/acked-0"]["published"] is True
+        )
+        crd = ElasticTPUClient(c.opts.kube_client)
+        assert crd.get(
+            migration_object_name("default", "acked-0")
+        ) is not None
+    finally:
+        c.stop()
