@@ -5,7 +5,7 @@ TAG ?= elastic-tpu-agent:latest
 # verify's tier-1 line uses pipefail, which /bin/sh (dash) lacks
 SHELL := /bin/bash
 
-.PHONY: all native sanitize test test-all verify doctor-smoke chaos-smoke bench-smoke crash-replay-smoke fleet-smoke scale-smoke slice-smoke drain-smoke migrate-smoke timeline-smoke serving-smoke qos-smoke protos image bench clean
+.PHONY: all native sanitize test test-all verify doctor-smoke chaos-smoke bench-smoke crash-replay-smoke fleet-smoke scale-smoke slice-smoke drain-smoke migrate-smoke timeline-smoke serving-smoke qos-smoke goodput-smoke protos image bench clean
 
 all: native test
 
@@ -182,8 +182,22 @@ serving-smoke:
 qos-smoke:
 	JAX_PLATFORMS=cpu python3 bench.py --qos-smoke
 
+# goodput smoke: the goodput-ledger gate (bench.py --goodput-smoke): a
+# 4-node fleet runs the drain-with-migration story plus a QoS
+# throttle->unthrottle story, then every node's ledger replays its
+# journal — conservation must hold on every node AND over the wire
+# (state intervals partition each pod's lifetime, gaps priced as
+# unattributed), the drain's non-productive time must be attributed to
+# the maintenance trigger, the clamp window to qos_throttle, the
+# aggregator's fleet rollup must equal the per-node ledgers exactly,
+# and the ledger's migration-attributed downtime must agree with the
+# bench's own drain-to-resume stopwatch within one reconcile period.
+# Structural, deterministic.
+goodput-smoke:
+	JAX_PLATFORMS=cpu python3 bench.py --goodput-smoke
+
 T1_TIMEOUT ?= 870
-verify: doctor-smoke chaos-smoke bench-smoke crash-replay-smoke fleet-smoke scale-smoke slice-smoke drain-smoke migrate-smoke timeline-smoke serving-smoke qos-smoke
+verify: doctor-smoke chaos-smoke bench-smoke crash-replay-smoke fleet-smoke scale-smoke slice-smoke drain-smoke migrate-smoke timeline-smoke serving-smoke qos-smoke goodput-smoke
 	python -c "from prometheus_client import CollectorRegistry; \
 	  from elastic_tpu_agent.metrics import AgentMetrics; \
 	  AgentMetrics(registry=CollectorRegistry()); \

@@ -1392,6 +1392,14 @@ def drain_smoke_main():
                 sim, list(range(DRAIN_NODES)), slice_id="smoke-drain",
                 timeout_s=DRAIN_SMOKE_TIMEOUT_S,
             )
+            # the SLI next to the drain latency numbers: what the
+            # maintenance story cost in fleet goodput, by cause
+            try:
+                r["fleet_goodput"] = _fleet_goodput_summary(sim)
+            except Exception as e:  # noqa: BLE001 - rollup is additive
+                r["fleet_goodput"] = {
+                    "failed": True, "error": f"{type(e).__name__}: {e}",
+                }
         except Exception as e:  # noqa: BLE001
             print(json.dumps({"drain_smoke": {
                 "error": f"{type(e).__name__}: {e}"
@@ -1677,9 +1685,18 @@ def run_migrate_leg(timeout_s=MIGRATE_SMOKE_TIMEOUT_S):
         ckpt_root = os.path.join(tmp, "pvc")
         try:
             sim.start()
-            return run_migrate_scenario(
+            r = run_migrate_scenario(
                 sim, ckpt_root, timeout_s=timeout_s
             )
+            # The SLI next to the latency numbers: what the whole
+            # story COST in fleet goodput, by cause.
+            try:
+                r["fleet_goodput"] = _fleet_goodput_summary(sim)
+            except Exception as e:  # noqa: BLE001 - rollup is additive
+                r["fleet_goodput"] = {
+                    "failed": True, "error": f"{type(e).__name__}: {e}",
+                }
+            return r
         finally:
             sim.stop()
 
@@ -1718,6 +1735,293 @@ def migrate_main():
         r = {"failed": True, "error": f"{type(e).__name__}: {e}"}
     print(json.dumps({"migration": r}))
     return 0 if not r.get("failed") and not r.get("problems") else 1
+
+
+# -- goodput ledger: fleet downtime attribution as the bench SLI --------------
+#
+# The observability gate for goodput.py (ISSUE 15): the SAME 4-node
+# drain-with-migration story the migrate smoke runs, plus a QoS
+# throttle->unthrottle story, replayed through every node's goodput
+# ledger and rolled up by the aggregator. The gate asserts the ledger
+# agrees with the bench's own stopwatch: conservation holds on every
+# node, the drain's non-productive time is attributed to the
+# maintenance trigger, the completed migration's stitched downtime
+# lands within one reconcile period of the measured drain-to-resume
+# window, and the fleet rollup equals the per-node ledgers exactly.
+
+GOODPUT_SMOKE_TIMEOUT_S = 90.0
+
+
+def _fleet_goodput_summary(sim):
+    """Fleet goodput %% + downtime-by-cause for a RUNNING FleetSim —
+    the rollup the chaos legs report next to their latency numbers."""
+    from elastic_tpu_agent.sim import FleetAggregator
+
+    sim.tick_goodput()
+    fg = FleetAggregator(sim.targets()).fleet_goodput()
+    return {
+        **fg["fleet"],
+        "migrations": fg["migrations"],
+        "conservation_problems": fg["conservation_problems"],
+        "unreachable_nodes": fg["unreachable"],
+    }
+
+
+def run_goodput_throttle_scenario(sim, node_idx, chip=2, timeout_s=20.0):
+    """A QoS throttle story on one node of a RUNNING FleetSim, driven
+    through the REAL usage-report -> sampler -> repartition loop: the
+    hog pod overcommits until the controller clamps it (journal
+    ``throttle``), holds the clamp long enough for the ledger to price
+    a visible window, then behaves and gets it lifted (``unthrottle``).
+    """
+    from elastic_tpu_agent.common import AnnotationRepartition
+    from elastic_tpu_agent.workloads.telemetry import write_usage_report
+
+    problems = []
+    ann = {AnnotationRepartition: "true"}
+    calm = sim.admit_pod("qos", "calm", node_idx, chip=chip,
+                         annotations=ann)
+    hog = sim.admit_pod("qos", "hog", node_idx, chip=chip,
+                        annotations=ann)
+    sim.wait_synced([calm, hog])
+    sim.bind_pod(calm)
+    sim.bind_pod(hog)
+    node = sim.nodes[node_idx]
+    mgr = node.manager
+    spec_dir = node.opts.alloc_spec_dir
+    calm_hash = sim.alloc_hash_of(calm)
+    hog_hash = sim.alloc_hash_of(hog)
+
+    def throttled():
+        return "qos/hog" in mgr.repartition.status()["throttled_pods"]
+
+    def drive(hog_duty):
+        now = time.time()
+        write_usage_report(spec_dir, calm_hash, 2.0, ts=now)
+        write_usage_report(spec_dir, hog_hash, hog_duty, ts=now)
+        mgr.sampler.sample_once(now=now)
+        mgr.repartition.tick(now=now)
+
+    deadline = time.monotonic() + timeout_s
+    while not throttled():
+        if time.monotonic() > deadline:
+            problems.append("hog was never throttled")
+            break
+        drive(90.0)
+        time.sleep(0.05)
+    throttled_at = time.time()
+    time.sleep(0.4)  # the clamp window the ledger must price
+    deadline = time.monotonic() + timeout_s
+    while throttled():
+        if time.monotonic() > deadline:
+            problems.append("hog was never unthrottled")
+            break
+        drive(5.0)
+        time.sleep(0.05)
+    return {
+        "node": node.name,
+        "pod": "qos/hog",
+        "throttled_window_s": round(time.time() - throttled_at, 3),
+        "problems": problems,
+    }
+
+
+def run_goodput_leg(timeout_s=GOODPUT_SMOKE_TIMEOUT_S):
+    """A self-contained goodput leg (used by `bench.py
+    --goodput-smoke`, `make goodput-smoke` and the main bench's
+    ``extra.goodput`` block). Returns a report dict (``problems``
+    empty = the ledger told the truth)."""
+    from elastic_tpu_agent.sim import FleetAggregator, FleetSim
+
+    with tempfile.TemporaryDirectory(prefix="etpu-gp") as tmp:
+        sim = FleetSim(
+            os.path.join(tmp, "f"), nodes=MIGRATE_NODES,
+            reconcile_period_s=0.5, slice_membership_ttl_s=0.25,
+            drain_deadline_s=MIGRATE_DEADLINE_S, drain_period_s=0.25,
+            migration_period_s=0.1,
+            # the leg drives ledger replays explicitly (tick_goodput)
+            # so the per-node reads and the aggregator rollup see the
+            # SAME frozen replay — the equality assertion is exact
+            goodput_period_s=3600.0,
+            # the throttle scenario drives the usage -> quota loop by
+            # hand (sample_once/tick); the supervised loops stay parked
+            enable_sampler=True,
+            sampler_period_s=3600.0,
+            repartition_period_s=3600.0,
+        )
+        os.makedirs(os.path.join(tmp, "f"), exist_ok=True)
+        problems = []
+        try:
+            sim.start()
+            migrate = run_migrate_scenario(
+                sim, os.path.join(tmp, "pvc"), timeout_s=timeout_s
+            )
+            problems += [
+                f"migrate scenario: {p}" for p in migrate["problems"]
+            ]
+            throttle = run_goodput_throttle_scenario(sim, 0)
+            problems += [
+                f"throttle scenario: {p}" for p in throttle["problems"]
+            ]
+            sim.tick_goodput()
+            per_node = [
+                sim.goodput_status(i) for i in range(len(sim.nodes))
+            ]
+            fg = FleetAggregator(sim.targets()).fleet_goodput()
+            fleet = fg["fleet"]
+            down = fleet["downtime_by_cause"]
+
+            # (1) conservation holds on every node AND over the wire
+            for payload in per_node:
+                for p in payload["conservation_problems"]:
+                    problems.append(
+                        f"conservation on {payload['node']}: {p}"
+                    )
+            problems += [
+                f"aggregator conservation: {p}"
+                for p in fg["conservation_problems"]
+            ]
+            if fg["unreachable"]:
+                problems.append(f"unreachable nodes: {fg['unreachable']}")
+
+            # (2) the drain's cost is attributed to the MAINTENANCE
+            # trigger: the un-acked resident's deadline ride is
+            # draining, the acked resident's save window checkpointing,
+            # both rolled up under maintenance_drain.
+            if not down.get("maintenance_drain"):
+                problems.append(
+                    f"no maintenance_drain downtime in {down}"
+                )
+            victim = per_node[3]
+            noack = victim["pods"].get("train/noack")
+            if noack is None or noack["states"]["draining"] <= 0:
+                problems.append(
+                    "un-acked resident's deadline ride not priced as "
+                    f"draining: {noack and noack['states']}"
+                )
+            else:
+                cats = {
+                    itv["cause"]["category"]
+                    for itv in noack["intervals"] if itv["cause"]
+                }
+                if "maintenance_drain" not in cats:
+                    problems.append(
+                        f"noack downtime attributed to {sorted(cats)}, "
+                        "not the maintenance trigger"
+                    )
+            src = victim["pods"].get("train/job")
+            if src is None or src["states"]["checkpointing"] <= 0:
+                problems.append(
+                    "acked resident's save window not priced as "
+                    f"checkpointing: {src and src['states']}"
+                )
+
+            # (3) the QoS clamp window is priced and attributed
+            if not down.get("qos_throttle"):
+                problems.append(f"no qos_throttle downtime in {down}")
+            hog = per_node[0]["pods"].get("qos/hog")
+            if hog is None or hog["states"]["throttled"] <= 0:
+                problems.append(
+                    "hog's clamp window not priced as throttled: "
+                    f"{hog and hog['states']}"
+                )
+
+            # (4) the aggregator's fleet rollup == the per-node ledgers
+            lifetime = productive = 0.0
+            by_cause = {}
+            for payload in per_node:
+                for entry in payload["pods"].values():
+                    lifetime += entry["lifetime_s"]
+                    productive += entry["states"]["productive"]
+                for cause, s in payload["downtime_by_cause"].items():
+                    by_cause[cause] = by_cause.get(cause, 0.0) + s
+            if abs(fleet["lifetime_s"] - lifetime) > 1e-3:
+                problems.append(
+                    f"fleet lifetime {fleet['lifetime_s']}s != per-node "
+                    f"sum {lifetime:.6f}s"
+                )
+            if abs(fleet["productive_s"] - productive) > 1e-3:
+                problems.append(
+                    f"fleet productive {fleet['productive_s']}s != "
+                    f"per-node sum {productive:.6f}s"
+                )
+            for cause in sorted(set(by_cause) | set(down)):
+                if abs(
+                    down.get(cause, 0.0) - by_cause.get(cause, 0.0)
+                ) > 1e-3:
+                    problems.append(
+                        f"fleet downtime[{cause}] {down.get(cause)} != "
+                        f"per-node sum {by_cause.get(cause)}"
+                    )
+
+            # (5) the ledger's migration-attributed downtime agrees
+            # with the bench's own stopwatch (PR 14's drain-to-resume
+            # window) within one reconcile period
+            stories = [
+                m for m in fg["migrations"] if m["pod"] == "train/job"
+            ]
+            bench_s = migrate.get("drain_to_resume_downtime_s")
+            ledger_s = stories[0].get("downtime_s") if stories else None
+            delta = None
+            if bench_s is None or ledger_s is None:
+                problems.append(
+                    f"migration downtime missing (bench {bench_s}, "
+                    f"ledger {ledger_s})"
+                )
+            else:
+                delta = abs(ledger_s - bench_s)
+                if delta > sim.reconcile_period_s:
+                    problems.append(
+                        f"ledger migration downtime {ledger_s}s vs "
+                        f"bench stopwatch {bench_s}s: delta {delta:.3f}s "
+                        f"> one reconcile period "
+                        f"({sim.reconcile_period_s}s)"
+                    )
+            return {
+                "nodes": len(sim.nodes),
+                "fleet_goodput_percent": fleet["goodput_percent"],
+                "fleet_lifetime_s": fleet["lifetime_s"],
+                "downtime_by_cause": down,
+                "migration_downtime_agreement": {
+                    "bench_stopwatch_s": bench_s,
+                    "ledger_attributed_s": ledger_s,
+                    "delta_s": (
+                        round(delta, 3) if delta is not None else None
+                    ),
+                    "tolerance_s": sim.reconcile_period_s,
+                },
+                "throttle": throttle,
+                "early_reclaim_margin_s": migrate.get(
+                    "early_reclaim_margin_s"
+                ),
+                "problems": problems,
+            }
+        finally:
+            sim.stop()
+
+
+def goodput_smoke_main():
+    """`make goodput-smoke`: the goodput-ledger gate — conservation
+    holds fleet-wide, drain downtime is attributed to the maintenance
+    trigger, the throttle clamp is priced, fleet goodput from the
+    aggregator matches the per-node ledgers, and migration-attributed
+    downtime agrees with the measured drain-to-resume window."""
+    try:
+        r = run_goodput_leg()
+    except Exception as e:  # noqa: BLE001
+        print(json.dumps({"goodput_smoke": {
+            "error": f"{type(e).__name__}: {e}"
+        }}))
+        print(f"goodput smoke FAILED: {type(e).__name__}: {e}",
+              file=sys.stderr)
+        return 1
+    print(json.dumps({"goodput_smoke": r}))
+    if r["problems"]:
+        for p in r["problems"]:
+            print(f"goodput smoke FAILED: {p}", file=sys.stderr)
+        return 1
+    print("goodput smoke: OK", file=sys.stderr)
+    return 0
 
 
 # -- lifecycle timeline: churn + reform + drain as ONE story ------------------
@@ -3403,6 +3707,15 @@ def main():
         }
     serving_proxy = run_serving_proxy()
     try:
+        goodput_leg = run_goodput_leg()
+        if goodput_leg.get("problems"):
+            goodput_leg["failed"] = True
+    except Exception as e:  # noqa: BLE001 - surfaced, not silence
+        goodput_leg = {
+            "skipped": True,
+            "reason": f"goodput leg failed: {type(e).__name__}: {e}",
+        }
+    try:
         qos_repartition = run_qos_repartition_leg()
     except Exception as e:  # noqa: BLE001 - bonus measurement
         qos_repartition = {
@@ -3473,6 +3786,11 @@ def main():
             # per decode step, the paged_kernel default's evidence —
             # present every round even when the chip legs skip.
             "serving_proxy": serving_proxy,
+            # Goodput ledger round trip: the drain-with-migration +
+            # throttle stories priced by every node's journal replay,
+            # rolled up by the aggregator, and checked against the
+            # bench's own stopwatch (goodput.py; ISSUE 15).
+            "goodput": goodput_leg,
             # Deterministic CPU co-location leg: live re-partitioning
             # vs static halves under phase-imbalanced load, the REAL
             # controller loop end to end — present every round even
@@ -3502,6 +3820,8 @@ if __name__ == "__main__":
         sys.exit(migrate_smoke_main())
     elif "--migrate" in sys.argv:
         sys.exit(migrate_main())
+    elif "--goodput-smoke" in sys.argv:
+        sys.exit(goodput_smoke_main())
     elif "--timeline-smoke" in sys.argv:
         sys.exit(timeline_smoke_main())
     elif "--serving-smoke" in sys.argv:

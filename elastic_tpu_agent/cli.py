@@ -20,7 +20,9 @@ from __future__ import annotations
 import argparse
 import json
 import logging
+import math
 import os
+import re
 import sys
 import threading
 import time
@@ -125,6 +127,10 @@ def parse_args(argv=None) -> argparse.Namespace:
     p.add_argument("--drain-period", type=float, default=2.0,
                    help="seconds between drain-orchestrator trigger "
                         "polls (jittered 0.75x-1.25x)")
+    p.add_argument("--goodput-period", type=float, default=10.0,
+                   help="seconds between goodput-ledger journal replays "
+                        "(per-pod productive/downtime partition + "
+                        "downtime-by-cause metrics; goodput.py)")
     p.add_argument("--repartition-period", type=float, default=10.0,
                    help="seconds between repartition-controller policy "
                         "passes (live quota renegotiation for pods that "
@@ -193,6 +199,41 @@ def parse_args(argv=None) -> argparse.Namespace:
     return args
 
 
+# -- node-doctor shared plumbing ----------------------------------------------
+
+
+_SINCE_UNITS = {"s": 1.0, "m": 60.0, "h": 3600.0, "d": 86400.0}
+
+
+def since_arg(value: str, _now=None) -> float:
+    """argparse type for ``--since``: unix epoch seconds, OR a relative
+    duration like ``15m`` / ``2h`` / ``90s`` / ``1d`` (resolved against
+    now). Junk raises ArgumentTypeError, so argparse exits non-zero
+    with a usage message — pinned in tests."""
+    raw = value.strip()
+    try:
+        ts = float(raw)
+    except ValueError:
+        pass
+    else:
+        # 'nan'/'inf' parse as floats but make the ts >= ? filter
+        # silently match nothing — an operator typo must be an error,
+        # not an empty-but-successful read
+        if math.isfinite(ts):
+            return ts
+        raise argparse.ArgumentTypeError(
+            f"{value!r} is not a finite timestamp"
+        )
+    m = re.fullmatch(r"(\d+(?:\.\d+)?)([smhd])", raw)
+    if not m:
+        raise argparse.ArgumentTypeError(
+            f"{value!r} is neither unix seconds nor a relative duration "
+            "(15m, 2h, 90s, 1d)"
+        )
+    now = time.time() if _now is None else _now
+    return now - float(m.group(1)) * _SINCE_UNITS[m.group(2)]
+
+
 # -- node-doctor timeline -----------------------------------------------------
 
 
@@ -221,8 +262,9 @@ def parse_timeline_args(argv=None) -> argparse.Namespace:
                    help="history of one trace/correlation id")
     p.add_argument("--kind", action="append", default=None,
                    help="keep only these event kinds (repeatable)")
-    p.add_argument("--since", type=float, default=None,
-                   help="unix-seconds lower bound")
+    p.add_argument("--since", type=since_arg, default=None,
+                   help="unix-seconds lower bound, or a relative "
+                        "duration (15m, 2h, 90s, 1d)")
     p.add_argument("--limit", type=int, default=None,
                    help="newest-N cap on the reconstructed history")
     p.add_argument("--no-causal", action="store_true",
@@ -279,6 +321,71 @@ def timeline_main(argv=None) -> int:
     return 0
 
 
+# -- node-doctor goodput ------------------------------------------------------
+
+
+def parse_goodput_args(argv=None) -> argparse.Namespace:
+    p = argparse.ArgumentParser(
+        prog="elastic-tpu-agent node-doctor goodput",
+        description="Replay the checkpoint db's durable event journal "
+                    "into the goodput ledger: per-pod partitions of "
+                    "wall time into productive/checkpointing/migrating/"
+                    "draining/throttled/queued/unattributed, each "
+                    "non-productive interval causally attributed — "
+                    "works against a dead agent's db, exactly like "
+                    "node-doctor timeline.",
+    )
+    p.add_argument(
+        "--db-file", default="/host/var/lib/elastic-tpu/meta.db",
+        help="checkpoint db holding the timeline journal + goodput "
+             "anchors",
+    )
+    p.add_argument("--pod", default=None, metavar="NS/NAME",
+                   help="one pod's ledger (bare names accepted)")
+    p.add_argument("--slice", dest="slice_id", default=None,
+                   help="ledgers of one slice's member pods")
+    p.add_argument("--since", type=since_arg, default=None,
+                   help="keep pods whose lifetime reaches past this "
+                        "bound: unix seconds or a relative duration "
+                        "(15m, 2h, 90s, 1d)")
+    return p.parse_args(argv)
+
+
+def goodput_main(argv=None) -> int:
+    args = parse_goodput_args(argv)
+    logging.basicConfig(
+        level=logging.WARNING,
+        format="%(levelname).1s %(name)s %(message)s",
+        stream=sys.stderr,
+    )
+    if not os.path.exists(args.db_file):
+        print(f"no db at {args.db_file}", file=sys.stderr)
+        return 1
+    from .goodput import build_goodput_block
+    from .storage import Storage
+
+    with Storage(args.db_file) as storage:
+        block = build_goodput_block(
+            storage, pod=args.pod, slice_id=args.slice_id,
+            since=args.since,
+        )
+    entity = {
+        k: v for k, v in (
+            ("pod", args.pod), ("slice", args.slice_id),
+            ("since", args.since),
+        ) if v is not None
+    }
+    json.dump({
+        "db_file": args.db_file,
+        "entity": entity,
+        "goodput": block,
+    }, sys.stdout, indent=2, sort_keys=True)
+    sys.stdout.write("\n")
+    # Conservation is the contract: a ledger that cannot account for a
+    # pod's lifetime is a finding, and the exit code says so.
+    return 1 if block.get("conservation_problems") else 0
+
+
 # -- node-doctor --------------------------------------------------------------
 
 
@@ -333,6 +440,8 @@ def parse_doctor_args(argv=None) -> argparse.Namespace:
 def doctor_main(argv=None) -> int:
     if argv and argv[0] == "timeline":
         return timeline_main(argv[1:])
+    if argv and argv[0] == "goodput":
+        return goodput_main(argv[1:])
     from .sampler import (
         UtilizationSampler,
         build_diagnostics_bundle,
@@ -469,6 +578,7 @@ def main(argv=None) -> int:
             enable_migration=not args.no_migration,
             migration_period_s=args.migration_period,
             maintenance_poll_ttl_s=args.maintenance_poll_ttl,
+            goodput_period_s=args.goodput_period,
             storage_batch_window_s=args.storage_batch_window,
             sink_flush_window_s=args.sink_flush_window,
             **(

@@ -107,12 +107,18 @@ UsageReportSubdir = "usage"
 # MigrationCoordinator consumes acks to complete drains early, gate QoS
 # eviction and verify resumes.
 AckSubdir = "ack"
+# Subdirectory where a workload's flight recorder publishes its rolling
+# summary ({"ts", "tokens_per_s", ...} keyed by allocation hash;
+# workloads/telemetry.write_flight_summary). The sampler reads fresh
+# summaries so elastic_tpu_workload_tokens_per_second{pod} reaches
+# /metrics — achieved throughput next to granted/used percent.
+FlightSummarySubdir = "flight"
 # Every per-allocation sidecar file family living under the alloc-spec
 # dir: ONE list shared by the spec reclaim path
 # (tpushare.remove_alloc_spec) and the reconciler's orphan-spec sweep,
 # so a new sidecar kind can never be added to one reclaimer and leak
 # through the other.
-AllocSidecarSubdirs = (UsageReportSubdir, AckSubdir)
+AllocSidecarSubdirs = (UsageReportSubdir, AckSubdir, FlightSummarySubdir)
 # Env restamped into a REPLACEMENT pod's alloc specs by the destination
 # agent when a published MigrationRecord names a checkpoint the workload
 # should resume from: the checkpoint directory, the acked step, and the

@@ -128,6 +128,9 @@ class ManagerOptions:
     # journal (--timeline-cap). Small caps are a test/smoke seam; the
     # eviction counter keeps trims observable either way.
     timeline_cap: int = timeline_mod.DEFAULT_CAP
+    # Goodput ledger (goodput.py): journal-replay period for the per-pod
+    # state partition + downtime-by-cause rollup (--goodput-period).
+    goodput_period_s: float = 10.0
     # Group-commit write batching (storage/batcher.py): >0 coalesces
     # storage commits into one flush per window — load-bearing writes
     # (bind checkpoints, intent journals, agent_state) still block until
@@ -478,6 +481,24 @@ class TPUManager:
             self.sampler.drain_status_fn = self.drain.status
             if self.migration is not None:
                 self.sampler.migration_status_fn = self.migration.status
+        # Goodput ledger (goodput.py): replays the timeline journal into
+        # per-pod productive/downtime partitions with causal attribution
+        # — the SLI the drain/migration/repartition machinery above is
+        # judged by. Reads the same db the journal writes, so it needs
+        # no hooks into the subsystems themselves.
+        from .goodput import GoodputLedger
+
+        self.goodput = GoodputLedger(
+            storage=self.storage,
+            node_name=opts.node_name,
+            metrics=self.metrics,
+            migration=self.migration,
+            period_s=opts.goodput_period_s,
+        )
+        if self.metrics is not None and hasattr(
+            self.metrics, "attach_goodput"
+        ):
+            self.metrics.attach_goodput(self.goodput)
         self.nri_plugin = None
         if opts.nri_socket:
             from .nri import NRIPlugin
@@ -713,6 +734,10 @@ class TPUManager:
             # armed before restore() walks kubelet's assignments, and a
             # crash mid-restamp must converge before binds resume.
             self.repartition.resume()
+        # Goodput anchors BEFORE the first replay: pods whose bind
+        # events the ring already trimmed keep their journaled lifetime
+        # starts across the restart, like drain/migration state.
+        self.goodput.resume()
         self.restore()
         # Device-plugin serve loops: one per extended resource, CRITICAL —
         # a dead ListAndWatch leaves kubelet advertising stale devices.
@@ -753,6 +778,10 @@ class TPUManager:
             )
         if self.sampler is not None:
             self.supervisor.register("sampler", self.sampler.run, DEGRADED)
+        # Goodput ledger: DEGRADED — losing the SLI rollup must never
+        # take binding down; the journal keeps accruing either way and
+        # the next tick replays it all.
+        self.supervisor.register("goodput", self.goodput.run, DEGRADED)
         if self.nri_plugin is not None:
             self.supervisor.register("nri", self.nri_plugin.run, DEGRADED)
         if self.crd_recorder is not None and hasattr(
@@ -797,6 +826,9 @@ class TPUManager:
         # The repartition loop journals and restamps specs; join it
         # before the recorder stops and the db closes.
         self.supervisor.join("repartition", timeout=10.0)
+        # The goodput ledger reads the journal and writes its anchors;
+        # join it before the db closes under it.
+        self.supervisor.join("goodput", timeout=10.0)
         if self.nri_plugin is not None:
             self.nri_plugin.stop()
         if hasattr(self.plugin, "core"):

@@ -35,6 +35,7 @@ from __future__ import annotations
 
 import json
 import logging
+import re
 import threading
 import time
 from collections import OrderedDict
@@ -70,6 +71,20 @@ _RECONCILE_BUCKETS = (
 SINK_LABELS = {"event-recorder": "events", "crd-recorder": "crd"}
 
 DEFAULT_BIND_ADDR = "127.0.0.1"
+
+# Registered debug routes, served as the /debug index so an operator on
+# a node shell can discover the surfaces without reading source; the
+# 404 body for unknown /debug/* paths carries the same list. One dict —
+# a new endpoint added to the handler but not here fails the pinned
+# index test, not a 3am triage session.
+DEBUG_ROUTES = {
+    "/debug/traces": "allocation-trace ring (?pod=&trace=&limit=)",
+    "/debug/allocations": "live chip->pod table + subsystem blocks",
+    "/debug/timeline": "durable lifecycle journal "
+                       "(?pod=&slice=&chip=&node=&since=&kind=&limit=)",
+    "/debug/goodput": "goodput ledger: per-pod state partition + "
+                      "downtime by cause (?pod=&since=)",
+}
 
 
 class MetricsServerError(RuntimeError):
@@ -358,6 +373,48 @@ class AgentMetrics:
                 "scrape. Series exist only for pods that have EVER "
                 "acked; a bound pod with no series has never "
                 "checkpointed under the handshake",
+                ["pod"],
+                **kw,
+            ),
+            max_series=max_pod_series,
+            evicted=self.series_evicted,
+        )
+        # -- goodput ledger (goodput.py) -----------------------------------
+        # Ratio per pod is bounded like every per-pod series; downtime by
+        # cause is a small closed vocabulary (goodput.CAUSES), exported
+        # as a gauge over the ledger's replayed totals — the journal is
+        # the durable source of truth, the scrape only mirrors it.
+        self.goodput_ratio = BoundedLabeledGauge(
+            Gauge(
+                "elastic_tpu_goodput_ratio",
+                "Fraction of a live pod's known lifetime the goodput "
+                "ledger attributes to productive time (1.0 = nothing "
+                "the agent did got in the way; see /debug/goodput for "
+                "the per-interval attribution)",
+                ["pod"],
+                **kw,
+            ),
+            max_series=max_pod_series,
+            evicted=self.series_evicted,
+        )
+        self.downtime_seconds = Gauge(
+            "elastic_tpu_downtime_seconds_total",
+            "Non-productive pod-seconds attributed to each cause by the "
+            "goodput ledger's journal replay (maintenance_drain, "
+            "preemption, operator_drain, qos_throttle, qos_evict, "
+            "migration, slice_reform, agent_restart, bind_queue, "
+            "unattributed) — the fleet aggregator sums this per cause",
+            ["cause"],
+            **kw,
+        )
+        self.workload_tokens_per_s = BoundedLabeledGauge(
+            Gauge(
+                "elastic_tpu_workload_tokens_per_second",
+                "Latest tokens/s a pod's flight recorder published to "
+                "its alloc-surface sidecar (flight/<hash>.json) — what "
+                "the workload ACHIEVED on its grant, next to the "
+                "granted/used percents. Series exist only for pods "
+                "that publish, and go away with the pod's bindings.",
                 ["pod"],
                 **kw,
             ),
@@ -678,6 +735,7 @@ class AgentMetrics:
         self._supervisor = None
         self._sitter = None
         self._timeline = None
+        self._goodput = None
         self._httpd: Optional[ThreadingHTTPServer] = None
 
     def attach_sampler(self, sampler) -> None:
@@ -700,6 +758,12 @@ class AgentMetrics:
                 return 0.0
 
         self.timeline_evicted.set_function(_evicted)
+
+    def attach_goodput(self, ledger) -> None:
+        """Point /debug/goodput at the agent's GoodputLedger
+        (goodput.py); the endpoint answers 503 until attached, like
+        /debug/allocations and /debug/timeline."""
+        self._goodput = ledger
 
     def attach_serving(self, status_fn) -> None:
         """Export a live serving engine's stats()
@@ -941,6 +1005,36 @@ class AgentMetrics:
                         payload = timeline.status()
                         payload["events"] = timeline.events(**params)
                         self._reply_json(payload)
+                    elif parsed.path == "/debug/goodput":
+                        if not self._require_loopback():
+                            return
+                        ledger = agent_metrics._goodput
+                        if ledger is None:
+                            self._reply_json(
+                                {"error": "goodput ledger not attached "
+                                          "(agent starting)"},
+                                code=503,
+                            )
+                            return
+                        q = parse_qs(parsed.query)
+                        pod = q.get("pod", [None])[0]
+                        since = None
+                        if q.get("since"):
+                            try:
+                                since = float(q["since"][0])
+                            except ValueError:
+                                self._reply_json(
+                                    {"error": "since must be numeric"},
+                                    code=400,
+                                )
+                                return
+                        self._reply_json(
+                            ledger.status(pod=pod, since=since)
+                        )
+                    elif parsed.path in ("/debug", "/debug/"):
+                        if not self._require_loopback():
+                            return
+                        self._reply_json({"routes": DEBUG_ROUTES})
                     elif parsed.path == "/debug/allocations":
                         if not self._require_loopback():
                             return
@@ -990,12 +1084,22 @@ class AgentMetrics:
                             elif snap["degraded"]:
                                 status["status"] = "degraded"
                         self._reply_json(status, code=code)
+                    elif parsed.path.startswith("/debug/"):
+                        # Unknown debug paths answer an explicit JSON
+                        # 404 naming the real routes instead of the
+                        # generic catch-all — a typo'd surface should
+                        # self-correct from its own error body.
+                        self._reply_json(
+                            {"error": f"no such debug path {parsed.path}",
+                             "debug_routes": sorted(DEBUG_ROUTES)},
+                            code=404,
+                        )
                     else:
                         self._reply_json(
                             {"error": f"no such path {parsed.path}",
-                             "paths": ["/metrics", "/debug/traces",
-                                       "/debug/allocations",
-                                       "/debug/timeline", "/healthz"]},
+                             "paths": ["/metrics", "/debug",
+                                       *sorted(DEBUG_ROUTES),
+                                       "/healthz"]},
                             code=404,
                         )
                 except BrokenPipeError:  # client went away mid-reply
@@ -1081,3 +1185,189 @@ class AgentMetrics:
             self._httpd.shutdown()
             self._httpd.server_close()
             self._httpd = None
+
+
+# -- exposition-format lint (promtool-style, in-repo, no new dependency) ------
+#
+# `promtool check metrics` is the tool operators actually run against a
+# scrape; CI cannot assume it exists in the image, so this is the same
+# rule set as plain functions: every family with samples has HELP and
+# TYPE (TYPE before the first sample), no duplicate series, sample
+# lines grammatical, label values escaped per the exposition format
+# (only \\ , \" and \n escapes are legal inside a quoted value).
+
+_EXPO_NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+_EXPO_LABEL_NAME_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
+_EXPO_VALUE_RE = re.compile(
+    r"^(?:[+-]?(?:\d+\.?\d*(?:[eE][+-]?\d+)?|\.\d+(?:[eE][+-]?\d+)?)"
+    r"|NaN|[+-]?Inf)$"
+)
+_EXPO_TYPES = frozenset(
+    {"counter", "gauge", "histogram", "summary", "untyped", "info"}
+)
+# A sample's family: its name minus the well-known generated suffixes
+# (prometheus_client emits `x_total`/`x_created` under family `x`, and
+# histogram `x_bucket`/`x_sum`/`x_count` under `x`).
+_EXPO_SUFFIXES = ("_bucket", "_sum", "_count", "_total", "_created",
+                  "_gsum", "_gcount", "_info")
+
+
+def _expo_parse_labels(raw: str):
+    """Parse the `{...}` body of a sample line; returns (labels dict,
+    error string or None). Hand-rolled so ESCAPING mistakes surface as
+    lint problems instead of silently mis-parsing."""
+    labels = {}
+    i, n = 0, len(raw)
+    while i < n:
+        j = i
+        while j < n and raw[j] not in "=":
+            j += 1
+        name = raw[i:j].strip()
+        if not _EXPO_LABEL_NAME_RE.match(name):
+            return labels, f"bad label name {name!r}"
+        if j >= n or raw[j] != "=":
+            return labels, f"label {name!r} missing '='"
+        j += 1
+        if j >= n or raw[j] != '"':
+            return labels, f"label {name!r} value not quoted"
+        j += 1
+        value = []
+        while j < n:
+            ch = raw[j]
+            if ch == "\\":
+                if j + 1 >= n or raw[j + 1] not in ('\\', '"', 'n'):
+                    return labels, (
+                        f"label {name!r}: illegal escape "
+                        f"\\{raw[j + 1] if j + 1 < n else ''!s}"
+                    )
+                value.append(raw[j:j + 2])
+                j += 2
+                continue
+            if ch == '"':
+                break
+            value.append(ch)
+            j += 1
+        else:
+            return labels, f"label {name!r} value unterminated"
+        if name in labels:
+            return labels, f"label {name!r} repeated"
+        labels[name] = "".join(value)
+        j += 1  # past closing quote
+        if j < n:
+            if raw[j] != ",":
+                return labels, f"junk after label {name!r}: {raw[j:]!r}"
+            j += 1
+        i = j
+    return labels, None
+
+
+def _expo_family_of(sample_name: str, families) -> "Optional[str]":
+    if sample_name in families:
+        return sample_name
+    for suffix in _EXPO_SUFFIXES:
+        if sample_name.endswith(suffix):
+            base = sample_name[: -len(suffix)]
+            if base in families:
+                return base
+    return None
+
+
+def lint_exposition(text: str) -> list:
+    """Lint a /metrics payload; returns problems (empty = conformant).
+    Consumed by the exposition-conformance test and usable against any
+    scrape (`lint_exposition(urlopen(...).read().decode())`)."""
+    problems = []
+    helped, typed = set(), set()
+    families_with_samples = {}
+    seen_series = set()
+    for lineno, line in enumerate(text.splitlines(), 1):
+        if not line.strip():
+            continue
+        if line.startswith("#"):
+            parts = line.split(None, 3)
+            if len(parts) < 3 or parts[1] not in ("HELP", "TYPE"):
+                continue  # plain comment: legal
+            name = parts[2]
+            if not _EXPO_NAME_RE.match(name):
+                problems.append(
+                    f"line {lineno}: bad metric name {name!r} in "
+                    f"{parts[1]}"
+                )
+                continue
+            if parts[1] == "HELP":
+                if name in helped:
+                    problems.append(
+                        f"line {lineno}: duplicate HELP for {name}"
+                    )
+                helped.add(name)
+            else:
+                if name in typed:
+                    problems.append(
+                        f"line {lineno}: duplicate TYPE for {name}"
+                    )
+                if len(parts) < 4 or parts[3] not in _EXPO_TYPES:
+                    problems.append(
+                        f"line {lineno}: TYPE {name} "
+                        f"{parts[3] if len(parts) > 3 else ''!r} is not "
+                        "a known type"
+                    )
+                if name in families_with_samples:
+                    problems.append(
+                        f"line {lineno}: TYPE for {name} after its "
+                        "samples"
+                    )
+                typed.add(name)
+            continue
+        # sample line: name[{labels}] value [timestamp]
+        brace = line.find("{")
+        if brace >= 0:
+            close = line.rfind("}")
+            if close < brace:
+                problems.append(f"line {lineno}: unbalanced braces")
+                continue
+            name = line[:brace]
+            labels, err = _expo_parse_labels(line[brace + 1:close])
+            if err:
+                problems.append(f"line {lineno}: {err}")
+            rest = line[close + 1:].strip()
+        else:
+            fields = line.split(None, 1)
+            name = fields[0]
+            labels = {}
+            rest = fields[1].strip() if len(fields) > 1 else ""
+        if not _EXPO_NAME_RE.match(name):
+            problems.append(f"line {lineno}: bad sample name {name!r}")
+            continue
+        value_fields = rest.split()
+        if not value_fields or not _EXPO_VALUE_RE.match(value_fields[0]):
+            problems.append(
+                f"line {lineno}: {name} sample value "
+                f"{value_fields[0] if value_fields else ''!r} is not a "
+                "number"
+            )
+        if len(value_fields) > 2:
+            problems.append(
+                f"line {lineno}: {name} trailing junk after value"
+            )
+        series = (name, tuple(sorted(labels.items())))
+        if series in seen_series:
+            problems.append(
+                f"line {lineno}: duplicate series {name}"
+                f"{dict(labels) if labels else ''}"
+            )
+        seen_series.add(series)
+        family = _expo_family_of(name, typed | helped)
+        if family is None:
+            # a sample with neither HELP nor TYPE anywhere: flag once
+            families_with_samples.setdefault(name, lineno)
+            problems.append(
+                f"line {lineno}: sample {name} has no HELP/TYPE family"
+            )
+        else:
+            families_with_samples.setdefault(family, lineno)
+    for family, lineno in sorted(families_with_samples.items()):
+        if family in typed and family not in helped:
+            problems.append(f"family {family} (line {lineno}) has no HELP")
+        if family in helped and family not in typed:
+            problems.append(f"family {family} (line {lineno}) has no TYPE")
+    return problems

@@ -49,6 +49,7 @@ from typing import Callable, Dict, List, Optional
 from . import faults
 from .common import (
     BytesPerMemoryUnit,
+    FlightSummarySubdir,
     ResourceTPUCore,
     TPUPercentEachChip,
     UsageReportSubdir,
@@ -246,6 +247,7 @@ class UtilizationSampler:
             chips = {}
         grants = self._join_allocations()
         reports = self._read_usage_reports(grants, now)
+        self._read_flight_summaries(grants, now)
         with self._lock:
             self._record_chip_samples(util, chips, now)
             self._attribute_pods(util, grants, now, reports)
@@ -429,6 +431,44 @@ class UtilizationSampler:
                 out[key] = best_duty
         return out
 
+    def _read_flight_summaries(
+        self, grants: Dict[str, dict], now: float
+    ) -> None:
+        """Fold fresh flight-recorder sidecar summaries
+        (<alloc_spec_dir>/flight/<hash>.json, written by
+        telemetry.write_flight_summary) into the join: the pod's
+        ACHIEVED tokens/s rides /debug/allocations and the
+        elastic_tpu_workload_tokens_per_second{pod} gauge. Display
+        only — never an enforcement signal — so no trust gate; the
+        same TTL/future-slack staleness rules as usage reports."""
+        if not self._alloc_spec_dir:
+            return
+        flight_dir = os.path.join(self._alloc_spec_dir, FlightSummarySubdir)
+        if not os.path.isdir(flight_dir):
+            return
+        for key, pod in grants.items():
+            best_ts = None
+            best = None
+            for alloc_hash in pod["hashes"]:
+                path = os.path.join(flight_dir, f"{alloc_hash}.json")
+                try:
+                    with open(path) as f:
+                        summary = json.load(f)
+                    ts = float(summary["ts"])
+                    rate = float(summary["tokens_per_s"])
+                except (OSError, ValueError, KeyError, TypeError):
+                    continue
+                if (
+                    now - ts > self.usage_report_ttl_s
+                    or ts - now > USAGE_REPORT_FUTURE_SLACK_S
+                    or rate < 0
+                ):
+                    continue
+                if best_ts is None or ts > best_ts:
+                    best_ts, best = ts, rate
+            if best is not None:
+                pod["tokens_per_s"] = best
+
     # -- attribution + overcommit ---------------------------------------------
 
     def _attribute_pods(
@@ -594,6 +634,16 @@ class UtilizationSampler:
                 m.pod_core_granted.set(pod["granted_percent"], pod=key)
                 if pod.get("used_percent") is not None:
                     m.pod_core_used.set(pod["used_percent"], pod=key)
+                if hasattr(m, "workload_tokens_per_s"):
+                    if pod.get("tokens_per_s") is not None:
+                        m.workload_tokens_per_s.set(
+                            pod["tokens_per_s"], pod=key
+                        )
+                    elif hasattr(m.workload_tokens_per_s, "remove"):
+                        # no FRESH summary this sample: the series goes
+                        # away rather than freezing a dead workload's
+                        # last rate on the scrape
+                        m.workload_tokens_per_s.remove(pod=key)
         except Exception:  # noqa: BLE001 - metrics must never break sampling
             logger.exception("sampler metrics export failed")
 
@@ -601,7 +651,9 @@ class UtilizationSampler:
         m = self._metrics
         if m is None:
             return
-        for gauge_name in ("pod_core_granted", "pod_core_used"):
+        for gauge_name in (
+            "pod_core_granted", "pod_core_used", "workload_tokens_per_s",
+        ):
             gauge = getattr(m, gauge_name, None)
             if gauge is not None and hasattr(gauge, "remove"):
                 try:
@@ -741,6 +793,7 @@ class UtilizationSampler:
                 "resources": sorted(pod["resources"]),
                 "granted_core_percent": pod["granted_percent"],
                 "used_core_percent": pod.get("used_percent"),
+                "tokens_per_s": pod.get("tokens_per_s"),
                 "hbm_granted_bytes": pod["hbm_granted_bytes"],
                 "overcommit": pod.get("overcommit", False),
                 "last_trace_id": pod.get("last_trace_id", ""),
@@ -944,6 +997,16 @@ def build_diagnostics_bundle(
             }
         except Exception as e:  # noqa: BLE001 - partial bundles beat none
             logger.warning("doctor: timeline read failed: %s", e)
+        # Goodput ledger: replayed straight from the db's journal +
+        # journaled anchors (goodput.build_goodput_block) — downtime
+        # attribution must be readable from a DEAD agent's db, and the
+        # db IS the ledger's entire input either way.
+        try:
+            from .goodput import build_goodput_block
+
+            bundle["goodput"] = build_goodput_block(storage)
+        except Exception as e:  # noqa: BLE001 - partial bundles beat none
+            logger.warning("doctor: goodput replay failed: %s", e)
     # Journal/reconciler state: from the live sampler hook when attached,
     # else straight from the checkpoint db — open intents must be
     # readable from a bundle even when the agent is down (that IS the
@@ -1247,6 +1310,10 @@ def validate_bundle(bundle: dict) -> List[str]:
             for field in ("total_events", "evicted_total"):
                 expect(isinstance(timeline.get(field), int),
                        f"timeline.{field} must be an int")
+    if "goodput" in bundle:  # absent only without a checkpoint db
+        from .goodput import validate_goodput_block
+
+        problems.extend(validate_goodput_block(bundle["goodput"]))
     if "subsystems" in bundle:  # absent only in pre-supervision bundles
         subsystems = bundle["subsystems"]
         expect(isinstance(subsystems, dict), "subsystems must be an object")

@@ -331,6 +331,100 @@ class FleetAggregator:
             "events": events,
         }
 
+    # -- fleet goodput (goodput.py) -------------------------------------------
+
+    def node_goodput(self, node: str) -> dict:
+        """One node's /debug/goodput payload: the ledger's per-pod
+        state partitions + downtime-by-cause rollup."""
+        return json.loads(self._get(f"{self.targets[node]}/debug/goodput"))
+
+    def fleet_goodput(self) -> dict:
+        """Fleet goodput % and downtime-by-cause, summed over every
+        node's ledger — the SLI the migrate/drain/scale bench legs
+        report next to their latency numbers.
+
+        Migration stories get one extra join the per-node ledgers
+        cannot do alone: a completed migration's TRUE downtime spans
+        two pods on two nodes (source checkpoint signal -> verified
+        resume on the destination), so each completion is stitched to
+        the source pod's terminal non-productive run on its source
+        node's ledger. Falls back to the coordinator's own measured
+        window (ack -> verify) when the source ledger is unreachable."""
+        per_node = {}
+        unreachable = []
+        for node in sorted(self.targets):
+            try:
+                per_node[node] = self.node_goodput(node)
+            except Exception:  # noqa: BLE001 - dead node: its db still
+                unreachable.append(node)  # has the ledger, not this view
+        lifetime = productive = 0.0
+        downtime: dict = {}
+        conservation: list = []
+        for node, payload in per_node.items():
+            for pod, entry in payload.get("pods", {}).items():
+                lifetime += entry.get("lifetime_s") or 0.0
+                productive += (entry.get("states") or {}).get(
+                    "productive", 0.0
+                )
+            for cause, seconds in payload.get(
+                "downtime_by_cause", {}
+            ).items():
+                downtime[cause] = downtime.get(cause, 0.0) + seconds
+            for problem in payload.get("conservation_problems", []):
+                conservation.append(f"{node}: {problem}")
+        stories = []
+        for node, payload in per_node.items():
+            for story in payload.get("migrations", []):
+                downtime_s = story.get("coordinator_downtime_s")
+                source = story.get("source_node")
+                src_entry = (
+                    per_node.get(source, {}).get("pods", {})
+                    .get(story.get("pod"))
+                    if source else None
+                )
+                if src_entry:
+                    # the source pod's terminal non-productive run:
+                    # walk back from its last interval while the state
+                    # stays non-productive — its start is the signal
+                    run_start = None
+                    for itv in reversed(src_entry.get("intervals", [])):
+                        if itv["state"] == "productive":
+                            break
+                        run_start = itv["start"]
+                    if run_start is not None and story.get(
+                        "completed_ts"
+                    ) is not None:
+                        downtime_s = round(
+                            story["completed_ts"] - run_start, 6
+                        )
+                stories.append({**story, "downtime_s": downtime_s})
+        return {
+            "nodes": sorted(per_node),
+            "unreachable": unreachable,
+            "fleet": {
+                "lifetime_s": round(lifetime, 6),
+                "productive_s": round(productive, 6),
+                "goodput_percent": (
+                    round(100.0 * productive / lifetime, 3)
+                    if lifetime > 0 else None
+                ),
+                "downtime_by_cause": {
+                    k: round(v, 6) for k, v in sorted(downtime.items())
+                },
+            },
+            "migrations": stories,
+            "conservation_problems": conservation,
+            "per_node": {
+                node: {
+                    "pods": len(payload.get("pods", {})),
+                    "downtime_by_cause": payload.get(
+                        "downtime_by_cause", {}
+                    ),
+                }
+                for node, payload in per_node.items()
+            },
+        }
+
     # -- trace continuity -----------------------------------------------------
 
     def trace_lookup(self, trace_id: str) -> List[dict]:

@@ -235,6 +235,9 @@ class FleetSim:
         timeline_cap: Optional[int] = None,
         storage_batch_window_s: float = 0.0,
         sink_flush_window_s: float = 0.0,
+        goodput_period_s: float = 1.0,
+        sampler_period_s: float = 10.0,
+        repartition_period_s: float = 10.0,
     ) -> None:
         self.base_dir = base_dir
         self.n_nodes = nodes
@@ -269,6 +272,15 @@ class FleetSim:
         # shape — the scale leg's unbatched baseline.
         self.storage_batch_window_s = storage_batch_window_s
         self.sink_flush_window_s = sink_flush_window_s
+        # Goodput-ledger replay period (goodput.py): sim scenarios read
+        # downtime attribution within seconds of the transitions, not
+        # after a production-paced 10s tick.
+        self.goodput_period_s = goodput_period_s
+        # Sampler/repartition pacing: scenarios that drive the usage ->
+        # quota loop by hand park both supervised loops (3600.0) so a
+        # background tick can't race their round-paced assertions.
+        self.sampler_period_s = sampler_period_s
+        self.repartition_period_s = repartition_period_s
         self.nodes: List[SimNode] = []
         self.apiserver = None
         self.api_url = ""
@@ -340,6 +352,9 @@ class FleetSim:
                 migration_period_s=self.migration_period_s,
                 storage_batch_window_s=self.storage_batch_window_s,
                 sink_flush_window_s=self.sink_flush_window_s,
+                goodput_period_s=self.goodput_period_s,
+                sampler_period_s=self.sampler_period_s,
+                repartition_period_s=self.repartition_period_s,
                 **(
                     {"timeline_cap": self.timeline_cap}
                     if self.timeline_cap is not None else {}
@@ -598,6 +613,20 @@ class FleetSim:
                     f"never verified (status: {status})"
                 )
             time.sleep(0.02)
+
+    # -- goodput ledger (goodput.py) ------------------------------------------
+
+    def tick_goodput(self) -> None:
+        """Force one ledger replay on every live node so the NEXT
+        /debug/goodput (and the aggregator's fleet_goodput) reads the
+        journal as of now — deterministic scenarios must not wait out
+        the supervised loop's period."""
+        for node in self.nodes:
+            if not node.dead:
+                node.manager.goodput.tick()
+
+    def goodput_status(self, idx: int, **kwargs) -> Dict:
+        return self.nodes[idx].manager.goodput.status(**kwargs)
 
     def wait_synced(self, refs: List[PodRef], timeout_s: float = 60.0) -> None:
         """Wait until every node's sitter has seen its LAST admitted pod

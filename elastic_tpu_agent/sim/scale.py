@@ -389,6 +389,12 @@ class ScaleHarness:
                 node.storage.timeline_evicted_total()
                 for node in sim.nodes
             )
+            # Goodput rollup: force a fresh ledger replay everywhere,
+            # then read the fleet SLI through the aggregator — the
+            # scale story must price its drain wave / reform /
+            # repartition churn in downtime-by-cause, not just latency.
+            sim.tick_goodput()
+            fleet_goodput = agg.fleet_goodput()
             # Snapshot source-side counters BEFORE stop(): stop drops
             # the apiserver and swaps the sim's big trace ring back out.
             api_counts = dict(sim.apiserver.request_counts)
@@ -421,6 +427,13 @@ class ScaleHarness:
             "phases": phases,
             "fleet_bind_p50_ms": fleet["fleet_bind_p50_ms"],
             "fleet_bind_p99_ms": fleet["fleet_bind_p99_ms"],
+            "goodput": {
+                **fleet_goodput["fleet"],
+                "conservation_problems": (
+                    fleet_goodput["conservation_problems"]
+                ),
+                "unreachable_nodes": fleet_goodput["unreachable"],
+            },
             "binds_total": binds,
             "stored_binds": sum(stored.values()),
             "reconcile_convergence_s": convergence,
@@ -558,4 +571,9 @@ def scale_problems(report: dict, bounds: Optional[dict] = None) -> List[str]:
         )
     if not report.get("fleet_bind_p99_ms"):
         problems.append("fleet bind p99 missing from scraped histograms")
+    gp = report.get("goodput", {})
+    if gp.get("goodput_percent") is None:
+        problems.append("goodput: fleet rollup missing")
+    for p in gp.get("conservation_problems", []):
+        problems.append(f"goodput conservation: {p}")
     return problems

@@ -360,6 +360,20 @@ def select_events(
     return selected
 
 
+def event_by_ref(
+    rows: List[dict], node: str, seq,
+) -> Optional[dict]:
+    """Resolve a ``(node, seq)`` cause reference (the id every
+    non-productive goodput interval carries — goodput.py) back to its
+    journal event, or None when the ring has since evicted it."""
+    for e in rows:
+        if e.get("seq") == seq and (
+            e.get("keys", {}).get("node", "") == node
+        ):
+            return e
+    return None
+
+
 def merge_node_events(per_node: Dict[str, List[dict]]) -> List[dict]:
     """Interleave per-node journals into one fleet-ordered causal view.
 

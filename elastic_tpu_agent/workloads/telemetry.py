@@ -297,6 +297,50 @@ def load_jsonl(path: str) -> List[dict]:
     return out
 
 
+def write_flight_summary(
+    alloc_spec_dir: str,
+    alloc_hash: str,
+    tokens_per_s: float,
+    steps: int = 0,
+    mean_step_ms: Optional[float] = None,
+    ts: float = None,
+) -> bool:
+    """Publish a flight-recorder summary to the node agent.
+
+    The flight recorder's JSONL lives inside the pod; this sidecar is
+    the agent-visible digest — ``<alloc dir>/flight/<alloc hash>.json``
+    with the latest achieved tokens/s — which the sampler exports as
+    ``elastic_tpu_workload_tokens_per_second{pod}`` (bounded, removed
+    with the pod's bindings) and the goodput runbook reads next to the
+    ledger's productive intervals. Same atomic fixed-temp-name contract
+    as :func:`write_usage_report`; never raises.
+    """
+    from ..common import FlightSummarySubdir
+
+    flight_dir = os.path.join(alloc_spec_dir, FlightSummarySubdir)
+    path = os.path.join(flight_dir, f"{alloc_hash}.json")
+    tmp = f"{path}.tmp"
+    try:
+        os.makedirs(flight_dir, exist_ok=True)
+        payload = {
+            "ts": time.time() if ts is None else ts,
+            "tokens_per_s": float(tokens_per_s),
+            "steps": int(steps),
+        }
+        if mean_step_ms is not None:
+            payload["mean_step_ms"] = float(mean_step_ms)
+        with open(tmp, "w") as f:
+            json.dump(payload, f)
+        os.replace(tmp, path)
+        return True
+    except OSError:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        return False
+
+
 def write_usage_report(
     alloc_spec_dir: str,
     alloc_hash: str,

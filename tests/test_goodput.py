@@ -1,0 +1,691 @@
+"""The goodput ledger (goodput.py): fleet-wide downtime attribution.
+
+Everything here is ManualClock-driven with zero sleeps — the replay is
+a pure function of the journal, which is exactly what makes the
+conservation invariant property-testable: for ANY replayed event
+sequence (including a mid-lifetime agent restart and an evicted
+timeline ring) per-pod state intervals must sum to lifetime with zero
+overlap, and every non-productive interval must carry a cause id
+resolvable in the surviving journal.
+"""
+
+import contextlib
+import io
+import json
+import random
+
+import pytest
+
+from elastic_tpu_agent import cli, goodput
+from elastic_tpu_agent import timeline as tl
+from elastic_tpu_agent.common import ManualClock
+from elastic_tpu_agent.storage import Storage
+
+
+@pytest.fixture()
+def store(tmp_path):
+    s = Storage(str(tmp_path / "meta.db"))
+    yield s
+    s.close()
+
+
+def _journal(store, cap=500, clock=None):
+    return tl.Timeline(store, node_name="n0", cap=cap,
+                       clock=clock or ManualClock())
+
+
+def _assert_conserved(result, rows=None):
+    problems = goodput.verify_conservation(result, rows)
+    assert problems == [], problems
+
+
+def _states_of(entry):
+    return [itv["state"] for itv in entry["intervals"]]
+
+
+# -- replay semantics ---------------------------------------------------------
+
+
+def test_queued_bind_then_productive_partition(store):
+    clk = ManualClock()
+    t = _journal(store, clock=clk)
+    t.emit(tl.KIND_BIND_INTENT, keys={"pod": "d/p"})
+    clk.advance(3.0)
+    t.emit(tl.KIND_BIND_COMMIT, keys={"pod": "d/p"})
+    clk.advance(10.0)
+    t.emit(tl.KIND_POD_RECLAIMED, keys={"pod": "d/p"})
+    rows = store.timeline_rows()
+    result = goodput.replay_goodput(rows, asof=clk.time())
+    entry = result["pods"]["d/p"]
+    assert _states_of(entry) == ["queued", "productive"]
+    assert entry["states"]["queued"] == pytest.approx(3.0)
+    assert entry["states"]["productive"] == pytest.approx(10.0)
+    assert entry["lifetime_s"] == pytest.approx(13.0)
+    assert entry["goodput_ratio"] == pytest.approx(10.0 / 13.0)
+    assert not entry["live"]
+    assert result["downtime_by_cause"] == {"bind_queue": 3.0}
+    _assert_conserved(result, rows)
+
+
+def test_rolled_back_bind_is_all_queued(store):
+    clk = ManualClock()
+    t = _journal(store, clock=clk)
+    t.emit(tl.KIND_BIND_INTENT, keys={"pod": "d/p"})
+    clk.advance(2.0)
+    t.emit(tl.KIND_BIND_ROLLBACK, keys={"pod": "d/p"})
+    result = goodput.replay_goodput(store.timeline_rows(), asof=clk.time())
+    entry = result["pods"]["d/p"]
+    assert _states_of(entry) == ["queued"]
+    assert entry["states"]["productive"] == 0.0
+    _assert_conserved(result)
+
+
+def test_drain_checkpoint_migrate_story_attributes_to_the_trigger(store):
+    """The PR-14 handshake as the ledger tells it: maintenance drain
+    signal -> checkpoint ack (CHECKPOINTING, attributed to the DRAIN
+    trigger, not the handshake) -> early reclaim (MIGRATING) — and the
+    destination's admission-to-verified-resume window is MIGRATING."""
+    clk = ManualClock()
+    t = _journal(store, clock=clk)
+    t.emit(tl.KIND_BIND_COMMIT, keys={"pod": "d/train"})
+    clk.advance(10.0)
+    drain_seq = t.emit(
+        tl.KIND_DRAIN_TRANSITION, state="draining", **{"from": "cordoned"},
+        trigger="maintenance:TERMINATE_ON_HOST_MAINTENANCE",
+    )
+    clk.advance(2.0)  # the workload saves for 2s, then acks
+    t.emit(tl.KIND_MIGRATION, keys={"pod": "d/train"}, action="recorded",
+           step=7)
+    clk.advance(1.0)
+    t.emit(tl.KIND_MIGRATION, keys={"pod": "d/train"},
+           action="early_reclaim")
+    rows = store.timeline_rows()
+    result = goodput.replay_goodput(rows, asof=clk.time())
+    entry = result["pods"]["d/train"]
+    assert _states_of(entry) == ["productive", "checkpointing", "migrating"]
+    assert entry["states"]["checkpointing"] == pytest.approx(2.0)
+    assert entry["states"]["migrating"] == pytest.approx(1.0)
+    # the checkpointing interval's cause is the DRAIN event...
+    ckpt = entry["intervals"][1]
+    assert ckpt["cause"]["seq"] == drain_seq
+    assert ckpt["cause"]["category"] == "maintenance_drain"
+    # ...so the rollup charges the maintenance trigger, plus the
+    # handshake's own migrating second.
+    assert result["downtime_by_cause"]["maintenance_drain"] == (
+        pytest.approx(2.0)
+    )
+    assert result["downtime_by_cause"]["migration"] == pytest.approx(1.0)
+    _assert_conserved(result, rows)
+
+
+def test_destination_restore_window_is_migrating(store):
+    clk = ManualClock()
+    t = _journal(store, clock=clk)
+    t.emit(tl.KIND_BIND_COMMIT, keys={"pod": "d/train"})
+    clk.advance(4.0)  # restoring the whole time since admission
+    t.emit(tl.KIND_MIGRATION, keys={"pod": "d/train"},
+           action="restore_stamped")
+    clk.advance(1.0)
+    t.emit(tl.KIND_MIGRATION, keys={"pod": "d/train"}, action="completed",
+           step=7, downtime_s=5.0, source_node="n9")
+    clk.advance(5.0)
+    rows = store.timeline_rows()
+    result = goodput.replay_goodput(rows, asof=clk.time())
+    entry = result["pods"]["d/train"]
+    assert _states_of(entry) == ["migrating", "productive"]
+    assert entry["states"]["migrating"] == pytest.approx(5.0)
+    assert entry["states"]["productive"] == pytest.approx(5.0)
+    assert result["migrations"] == [{
+        "pod": "d/train", "node": "n0", "completed_ts": clk.time() - 5.0,
+        "source_node": "n9", "coordinator_downtime_s": 5.0, "step": 7,
+    }]
+    _assert_conserved(result, rows)
+
+
+def test_unacked_drain_stays_draining_to_the_reclaim(store):
+    clk = ManualClock()
+    t = _journal(store, clock=clk)
+    t.emit(tl.KIND_BIND_COMMIT, keys={"pod": "d/noack"})
+    clk.advance(5.0)
+    t.emit(tl.KIND_DRAIN_TRANSITION, state="draining",
+           trigger="maintenance:x")
+    clk.advance(6.0)  # the full deadline, never acked
+    t.emit(tl.KIND_POD_RECLAIMED, keys={"pod": "d/noack"})
+    rows = store.timeline_rows()
+    result = goodput.replay_goodput(rows, asof=clk.time())
+    entry = result["pods"]["d/noack"]
+    assert _states_of(entry) == ["productive", "draining"]
+    assert entry["states"]["draining"] == pytest.approx(6.0)
+    assert result["downtime_by_cause"]["maintenance_drain"] == (
+        pytest.approx(6.0)
+    )
+    _assert_conserved(result, rows)
+
+
+def test_cancelled_drain_closes_the_claim(store):
+    clk = ManualClock()
+    t = _journal(store, clock=clk)
+    t.emit(tl.KIND_BIND_COMMIT, keys={"pod": "d/p"})
+    clk.advance(1.0)
+    t.emit(tl.KIND_DRAIN_TRANSITION, state="draining", trigger="operator")
+    clk.advance(2.0)
+    t.emit(tl.KIND_DRAIN_TRANSITION, state="active", trigger="")
+    clk.advance(3.0)
+    result = goodput.replay_goodput(store.timeline_rows(), asof=clk.time())
+    entry = result["pods"]["d/p"]
+    assert _states_of(entry) == ["productive", "draining", "productive"]
+    assert entry["states"]["draining"] == pytest.approx(2.0)
+    assert result["downtime_by_cause"] == {"operator_drain": 2.0}
+    _assert_conserved(result)
+
+
+def test_throttle_unthrottle_and_evict_windows(store):
+    clk = ManualClock()
+    t = _journal(store, clock=clk)
+    t.emit(tl.KIND_BIND_COMMIT, keys={"pod": "d/hog"})
+    clk.advance(2.0)
+    t.emit(tl.KIND_THROTTLE, keys={"pod": "d/hog"}, action="throttle",
+           deadline_ts=clk.time() + 60)
+    clk.advance(3.0)
+    t.emit(tl.KIND_THROTTLE, keys={"pod": "d/hog"}, action="unthrottle")
+    clk.advance(1.0)
+    evict_seq = t.emit(tl.KIND_THROTTLE, keys={"pod": "d/hog"},
+                       action="evict")
+    clk.advance(2.0)
+    t.emit(tl.KIND_POD_RECLAIMED, keys={"pod": "d/hog"})
+    rows = store.timeline_rows()
+    result = goodput.replay_goodput(rows, asof=clk.time())
+    entry = result["pods"]["d/hog"]
+    assert _states_of(entry) == [
+        "productive", "throttled", "productive", "throttled",
+    ]
+    assert entry["states"]["throttled"] == pytest.approx(5.0)
+    assert result["downtime_by_cause"] == {
+        "qos_throttle": 3.0, "qos_evict": 2.0,
+    }
+    # the evict window's cause is the evict event itself
+    assert entry["intervals"][-1]["cause"]["seq"] == evict_seq
+    _assert_conserved(result, rows)
+
+
+def test_overlapping_claims_count_each_second_once(store):
+    """A drain lands on an already-throttled pod, then the handshake
+    acks mid-drain: every second belongs to exactly ONE state (the
+    highest-priority claim), so conservation still holds."""
+    clk = ManualClock()
+    t = _journal(store, clock=clk)
+    t.emit(tl.KIND_BIND_COMMIT, keys={"pod": "d/p"})
+    clk.advance(1.0)
+    t.emit(tl.KIND_THROTTLE, keys={"pod": "d/p"}, action="throttle")
+    clk.advance(1.0)
+    t.emit(tl.KIND_DRAIN_TRANSITION, state="draining",
+           trigger="preemption:spot")
+    clk.advance(2.0)
+    t.emit(tl.KIND_MIGRATION, keys={"pod": "d/p"}, action="recorded")
+    clk.advance(1.0)
+    t.emit(tl.KIND_MIGRATION, keys={"pod": "d/p"}, action="early_reclaim")
+    rows = store.timeline_rows()
+    result = goodput.replay_goodput(rows, asof=clk.time())
+    entry = result["pods"]["d/p"]
+    total = sum(entry["states"].values())
+    assert total == pytest.approx(entry["lifetime_s"])
+    # checkpointing (signal..ack) outranks the throttle for those 2s
+    assert entry["states"]["checkpointing"] == pytest.approx(2.0)
+    assert entry["states"]["migrating"] == pytest.approx(1.0)
+    assert entry["states"]["throttled"] == pytest.approx(1.0)
+    _assert_conserved(result, rows)
+
+
+def test_agent_restart_gap_is_unattributed_with_the_boot_as_cause(store):
+    clk = ManualClock()
+    t = _journal(store, clock=clk)
+    t.emit(tl.KIND_BIND_COMMIT, keys={"pod": "d/p"})
+    clk.advance(5.0)
+    t.emit(tl.KIND_REPARTITION, keys={"pod": "d/p"})  # last sign of life
+    clk.advance(30.0)  # the crash window
+    boot_seq = t.emit(tl.KIND_AGENT_STARTED, boot_id="b2")
+    clk.advance(5.0)
+    rows = store.timeline_rows()
+    result = goodput.replay_goodput(rows, asof=clk.time())
+    entry = result["pods"]["d/p"]
+    assert _states_of(entry) == [
+        "productive", "unattributed", "productive",
+    ]
+    assert entry["states"]["unattributed"] == pytest.approx(30.0)
+    gap = entry["intervals"][1]
+    assert gap["cause"]["seq"] == boot_seq
+    assert gap["cause"]["category"] == "agent_restart"
+    # the STATE is unattributed, but the rollup charges the restart —
+    # a crash window with a visible boot is not a mystery
+    assert result["downtime_by_cause"] == {"agent_restart": 30.0}
+    _assert_conserved(result, rows)
+
+
+def test_last_alive_anchor_shrinks_the_crash_window(store):
+    """The ledger heartbeats last_alive_ts into agent_state; a journal
+    that went quiet BEFORE the crash must charge only the
+    heartbeat-to-boot window, not the whole quiet stretch."""
+    clk = ManualClock()
+    t = _journal(store, clock=clk)
+    t.emit(tl.KIND_BIND_COMMIT, keys={"pod": "d/p"})
+    prev = clk.time()
+    clk.advance(100.0)
+    t.emit(tl.KIND_AGENT_STARTED, boot_id="b2")
+    clk.advance(1.0)
+    rows = store.timeline_rows()
+    result = goodput.replay_goodput(
+        rows, asof=clk.time(),
+        anchors={"node": "n0", "pods": {},
+                 "last_alive_ts": prev + 90.0},
+    )
+    entry = result["pods"]["d/p"]
+    assert entry["states"]["unattributed"] == pytest.approx(10.0)
+    assert entry["states"]["productive"] == pytest.approx(91.0)
+    _assert_conserved(result, rows)
+
+
+def test_reform_checkpointing_closed_by_the_ack_sidecar(store):
+    clk = ManualClock()
+    t = _journal(store, clock=clk)
+    t.emit(tl.KIND_BIND_COMMIT, keys={"pod": "d/m1", "slice": "S"})
+    clk.advance(5.0)
+    reform_seq = t.emit(
+        tl.KIND_SLICE_REFORMED, keys={"pod": "d/m1", "slice": "S"},
+        epoch=1, world_size=2,
+    )
+    ack_ts = clk.time() + 2.0
+    clk.advance(10.0)
+    rows = store.timeline_rows()
+    result = goodput.replay_goodput(
+        rows, asof=clk.time(), acks={"d/m1": ack_ts}
+    )
+    entry = result["pods"]["d/m1"]
+    assert _states_of(entry) == [
+        "productive", "checkpointing", "productive",
+    ]
+    assert entry["states"]["checkpointing"] == pytest.approx(2.0)
+    assert entry["intervals"][1]["cause"]["seq"] == reform_seq
+    assert result["downtime_by_cause"] == {"slice_reform": 2.0}
+    assert "S" in entry["slices"]
+    _assert_conserved(result, rows)
+
+
+def test_anchors_never_shadow_surviving_bind_events(store):
+    """Tick idempotence: replaying the SAME journal with the anchors
+    tick 1 would journal must reproduce tick 1's ledger exactly — a
+    pod whose bind events survived the ring keeps its queued window,
+    and a restarted agent's first tick matches the pre-restart one."""
+    clk = ManualClock()
+    t = _journal(store, clock=clk)
+    t.emit(tl.KIND_BIND_INTENT, keys={"pod": "d/p"})
+    clk.advance(3.0)
+    t.emit(tl.KIND_BIND_COMMIT, keys={"pod": "d/p"})
+    clk.advance(10.0)
+    rows = store.timeline_rows()
+    first = goodput.replay_goodput(rows, asof=clk.time())
+    anchors = {"node": "n0", "pods": {
+        pod: {"start": entry["live_start"]}
+        for pod, entry in first["pods"].items() if entry["live"]
+    }, "last_alive_ts": clk.time()}
+    second = goodput.replay_goodput(rows, asof=clk.time(),
+                                    anchors=anchors)
+    assert second["downtime_by_cause"] == first["downtime_by_cause"]
+    assert second["pods"]["d/p"]["states"] == first["pods"]["d/p"]["states"]
+    assert second["downtime_by_cause"] == {"bind_queue": 3.0}
+    _assert_conserved(second, rows)
+
+
+def test_stale_anchor_superseded_by_a_new_incarnation(store):
+    """A rebind whose prior reclaim the ring trimmed: the surviving
+    bind_intent ends the anchored life and starts a fresh one instead
+    of silently extending the old incarnation over the new bind."""
+    clk = ManualClock()
+    t = _journal(store, clock=clk)
+    old_start = clk.time()
+    clk.advance(100.0)
+    t.emit(tl.KIND_BIND_INTENT, keys={"pod": "d/p"})
+    clk.advance(2.0)
+    t.emit(tl.KIND_BIND_COMMIT, keys={"pod": "d/p"})
+    clk.advance(5.0)
+    rows = store.timeline_rows()
+    result = goodput.replay_goodput(
+        rows, asof=clk.time(),
+        anchors={"node": "n0", "pods": {"d/p": {"start": old_start}}},
+    )
+    entry = result["pods"]["d/p"]
+    # old incarnation 0..100 closed by the intent; new one 100..107
+    assert entry["lifetime_s"] == pytest.approx(107.0)
+    assert entry["states"]["queued"] == pytest.approx(2.0)
+    _assert_conserved(result, rows)
+
+
+def test_anchored_pod_survives_a_trimmed_ring(store):
+    """The ring evicted the pod's bind events; the journaled anchor
+    keeps the lifetime start, so conservation covers the WHOLE life."""
+    clk = ManualClock()
+    t = _journal(store, cap=3, clock=clk)
+    bind_ts = clk.time()
+    t.emit(tl.KIND_BIND_COMMIT, keys={"pod": "d/old"})
+    clk.advance(50.0)
+    for i in range(4):  # churn the bind out of the cap-3 ring
+        t.emit(tl.KIND_REPARTITION, keys={"pod": "d/other"})
+        clk.advance(1.0)
+    t.emit(tl.KIND_THROTTLE, keys={"pod": "d/old"}, action="throttle")
+    clk.advance(2.0)
+    rows = store.timeline_rows()
+    assert all(r["kind"] != tl.KIND_BIND_COMMIT for r in rows)
+    result = goodput.replay_goodput(
+        rows, asof=clk.time(),
+        anchors={"node": "n0", "pods": {"d/old": {"start": bind_ts}}},
+    )
+    entry = result["pods"]["d/old"]
+    assert entry["anchored"]
+    assert entry["lifetime_s"] == pytest.approx(56.0)
+    assert entry["states"]["throttled"] == pytest.approx(2.0)
+    _assert_conserved(result, rows)
+
+
+# -- the conservation property over random histories --------------------------
+
+
+def _random_history(seed):
+    """One randomized plausible node history driven through a REAL
+    ring-capped journal: pods bind (sometimes staying queued), drains
+    and throttles and migrations land in random interleavings, the
+    agent restarts mid-lifetime, and the small cap forces evictions."""
+    rng = random.Random(seed)
+    clk = ManualClock()
+    store = Storage(":memory:")
+    cap = rng.choice([6, 12, 40, 500])
+    t = tl.Timeline(store, node_name="n0", cap=cap, clock=clk)
+    pods = [f"d/p{i}" for i in range(rng.randint(1, 5))]
+    live = set()
+    for pod in pods:
+        if rng.random() < 0.8:
+            t.emit(tl.KIND_BIND_INTENT, keys={"pod": pod})
+            clk.advance(rng.uniform(0.0, 2.0))
+        if rng.random() < 0.9:
+            t.emit(tl.KIND_BIND_COMMIT, keys={"pod": pod})
+            live.add(pod)
+        clk.advance(rng.uniform(0.0, 3.0))
+    anchors = {}
+    for _ in range(rng.randint(5, 40)):
+        clk.advance(rng.uniform(0.0, 5.0))
+        roll = rng.random()
+        pod = rng.choice(pods)
+        if roll < 0.15:
+            t.emit(tl.KIND_DRAIN_TRANSITION, state="draining",
+                   trigger=rng.choice([
+                       "maintenance:x", "preemption:spot", "operator",
+                   ]))
+        elif roll < 0.25:
+            t.emit(tl.KIND_DRAIN_TRANSITION, state="active", trigger="")
+        elif roll < 0.40:
+            t.emit(tl.KIND_THROTTLE, keys={"pod": pod},
+                   action=rng.choice(["throttle", "unthrottle", "evict"]))
+        elif roll < 0.55:
+            t.emit(tl.KIND_MIGRATION, keys={"pod": pod},
+                   action=rng.choice([
+                       "recorded", "early_reclaim", "restore_stamped",
+                       "completed",
+                   ]))
+            if rng.random() < 0.3:
+                live.discard(pod)  # early_reclaim may have ended it
+        elif roll < 0.65:
+            t.emit(tl.KIND_SLICE_REFORMED,
+                   keys={"pod": pod, "slice": "S"}, epoch=1)
+        elif roll < 0.75 and pod in live:
+            t.emit(tl.KIND_POD_RECLAIMED, keys={"pod": pod})
+            live.discard(pod)
+        elif roll < 0.85:
+            # mid-lifetime agent restart, with a crash window before it
+            clk.advance(rng.uniform(0.0, 20.0))
+            t.emit(tl.KIND_AGENT_STARTED, boot_id=f"b{seed}")
+        else:
+            t.emit(tl.KIND_BIND_COMMIT, keys={"pod": pod})
+            live.add(pod)
+    if rng.random() < 0.5 and pods:
+        # an anchor for a pod whose bind may have been trimmed
+        anchors = {"node": "n0",
+                   "pods": {pods[0]: {"start": 999_999_990.0}},
+                   "last_alive_ts": clk.time() - rng.uniform(0, 5)}
+    clk.advance(rng.uniform(0.0, 5.0))
+    rows = store.timeline_rows()
+    store.close()
+    return rows, clk.time(), anchors
+
+
+@pytest.mark.parametrize("seed", range(25))
+def test_conservation_holds_for_any_replayed_sequence(seed):
+    rows, asof, anchors = _random_history(seed)
+    result = goodput.replay_goodput(rows, asof, anchors=anchors)
+    problems = goodput.verify_conservation(result, rows)
+    assert problems == [], f"seed {seed}: {problems}"
+    # and every cause id resolves through the timeline's own resolver
+    for entry in result["pods"].values():
+        for itv in entry["intervals"]:
+            cause = itv.get("cause")
+            if cause is None:
+                continue
+            assert tl.event_by_ref(
+                rows, cause["node"], cause["seq"]
+            ) is not None
+
+
+# -- the agent-side ledger: anchors, restart, export --------------------------
+
+
+class _Gauge:
+    def __init__(self):
+        self.values = {}
+
+    def set(self, value, **labels):
+        self.values[tuple(sorted(labels.items()))] = value
+
+    def labels(self, **labels):
+        outer, key = self, tuple(sorted(labels.items()))
+
+        class _Bound:
+            def set(self, value):  # noqa: ANN001
+                outer.values[key] = value
+        return _Bound()
+
+    def remove(self, **labels):
+        self.values.pop(tuple(sorted(labels.items())), None)
+
+
+class _Metrics:
+    def __init__(self):
+        self.goodput_ratio = _Gauge()
+        self.downtime_seconds = _Gauge()
+
+
+def test_ledger_tick_journals_anchors_and_survives_restart(store):
+    clk = ManualClock()
+    t = _journal(store, cap=3, clock=clk)
+    bind_ts = clk.time()
+    t.emit(tl.KIND_BIND_COMMIT, keys={"pod": "d/p"})
+    clk.advance(10.0)
+    metrics = _Metrics()
+    ledger = goodput.GoodputLedger(
+        store, node_name="n0", metrics=metrics, clock=clk,
+    )
+    ledger.tick()
+    assert metrics.goodput_ratio.values[(("pod", "d/p"),)] == (
+        pytest.approx(1.0)
+    )
+    # ...then the ring trims the bind commit and the process restarts
+    for _ in range(4):
+        clk.advance(1.0)
+        t.emit(tl.KIND_REPARTITION, keys={"pod": "d/other"})
+    clk.advance(1.0)
+    t.emit(tl.KIND_THROTTLE, keys={"pod": "d/p"}, action="throttle")
+    clk.advance(2.0)
+    reborn = goodput.GoodputLedger(store, node_name="n0", clock=clk)
+    reborn.resume()  # the boot path
+    result = reborn.tick()
+    entry = result["pods"]["d/p"]
+    assert entry["anchored"]
+    assert entry["lifetime_s"] == pytest.approx(clk.time() - bind_ts)
+    assert entry["states"]["throttled"] == pytest.approx(2.0)
+    _assert_conserved(result, store.timeline_rows())
+
+
+def test_ledger_removes_series_for_gone_pods(store):
+    clk = ManualClock()
+    t = _journal(store, clock=clk)
+    t.emit(tl.KIND_BIND_COMMIT, keys={"pod": "d/p"})
+    clk.advance(1.0)
+    metrics = _Metrics()
+    ledger = goodput.GoodputLedger(
+        store, node_name="n0", metrics=metrics, clock=clk,
+    )
+    ledger.tick()
+    assert (("pod", "d/p"),) in metrics.goodput_ratio.values
+    clk.advance(1.0)
+    t.emit(tl.KIND_POD_RECLAIMED, keys={"pod": "d/p"})
+    ledger.tick()
+    assert (("pod", "d/p"),) not in metrics.goodput_ratio.values
+    # the dead pod still counts in downtime totals (nothing here), and
+    # the cause gauge covers the whole closed vocabulary
+    assert metrics.downtime_seconds.values[(("cause", "unattributed"),)] == 0.0
+
+
+def test_ledger_status_filters_and_reports_conservation(store):
+    clk = ManualClock()
+    t = _journal(store, clock=clk)
+    t.emit(tl.KIND_BIND_COMMIT, keys={"pod": "d/a"})
+    t.emit(tl.KIND_BIND_COMMIT, keys={"pod": "d/b"})
+    clk.advance(5.0)
+    ledger = goodput.GoodputLedger(store, node_name="n0", clock=clk)
+    status = ledger.status(pod="a")  # bare name, like the other filters
+    assert set(status["pods"]) == {"d/a"}
+    assert status["conservation_problems"] == []
+    assert status["node"] == "n0"
+    assert status["ticks_total"] >= 1
+
+
+# -- dead-agent read path (node-doctor + doctor bundle) -----------------------
+
+
+def _write_dead_db(path):
+    clk = ManualClock()
+    with Storage(path) as s:
+        t = tl.Timeline(s, node_name="n0", cap=100, clock=clk)
+        t.emit(tl.KIND_BIND_COMMIT, keys={"pod": "d/p", "slice": "S"})
+        clk.advance(10.0)
+        t.emit(tl.KIND_DRAIN_TRANSITION, state="draining",
+               trigger="maintenance:x")
+        clk.advance(2.0)
+        t.emit(tl.KIND_MIGRATION, keys={"pod": "d/p"}, action="recorded")
+        clk.advance(1.0)
+        t.emit(tl.KIND_MIGRATION, keys={"pod": "d/p"},
+               action="early_reclaim")
+    return clk.time()
+
+
+def test_build_goodput_block_reads_a_dead_agents_db(tmp_path):
+    db = str(tmp_path / "dead.db")
+    end = _write_dead_db(db)
+    with Storage(db) as s:
+        block = goodput.build_goodput_block(s)
+    # asof defaulted to the knowledge horizon, not a live clock — a
+    # dead agent's silent hours never count as productive time
+    assert block["asof"] == pytest.approx(end)
+    assert block["conservation_problems"] == []
+    entry = block["pods"]["d/p"]
+    assert entry["states"]["checkpointing"] == pytest.approx(2.0)
+    assert block["downtime_by_cause"]["maintenance_drain"] == (
+        pytest.approx(2.0)
+    )
+    assert goodput.validate_goodput_block(block) == []
+
+
+def test_node_doctor_goodput_subcommand(tmp_path, capsys):
+    db = str(tmp_path / "dead.db")
+    _write_dead_db(db)
+    assert cli.main([
+        "node-doctor", "goodput", "--db-file", db, "--pod", "p",
+    ]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["entity"] == {"pod": "p"}
+    assert set(out["goodput"]["pods"]) == {"d/p"}
+    assert out["goodput"]["downtime_by_cause"]["maintenance_drain"] > 0
+    # missing db: explicit non-zero, not a stack trace
+    assert cli.main([
+        "node-doctor", "goodput", "--db-file", str(tmp_path / "nope.db"),
+    ]) == 1
+
+
+def test_validate_goodput_block_flags_breakage():
+    assert goodput.validate_goodput_block([]) == ["goodput must be an object"]
+    problems = goodput.validate_goodput_block({
+        "asof": 1.0,
+        "pods": {"d/p": {
+            "intervals": [{"state": "partying", "start": 0.0, "end": "x"}],
+            "states": {s: 0.0 for s in goodput.STATES if s != "queued"},
+            "lifetime_s": 1.0, "goodput_ratio": 1.0, "live": True,
+        }},
+        "downtime_by_cause": {"gremlins": "many"},
+    })
+    assert any("partying" in p for p in problems)
+    assert any(".end must be a number" in p for p in problems)
+    assert any("missing 'queued'" in p for p in problems)
+    assert any("gremlins" in p for p in problems)
+    assert any("must be a number" in p for p in problems)
+
+
+def test_select_pods_since_keeps_whole_partitions(store):
+    """A since-filter keeps or drops whole pods — clipping a partition
+    would break conservation, so it never does."""
+    clk = ManualClock()
+    t = _journal(store, clock=clk)
+    t.emit(tl.KIND_BIND_COMMIT, keys={"pod": "d/old"})
+    clk.advance(5.0)
+    t.emit(tl.KIND_POD_RECLAIMED, keys={"pod": "d/old"})
+    cut = clk.time() + 1.0
+    clk.advance(5.0)
+    t.emit(tl.KIND_BIND_COMMIT, keys={"pod": "d/new"})
+    clk.advance(5.0)
+    result = goodput.replay_goodput(store.timeline_rows(), asof=clk.time())
+    kept = goodput.select_pods(result, since=cut)
+    assert set(kept["pods"]) == {"d/new"}
+    _assert_conserved(kept)
+
+
+# -- relative --since plumbing (node-doctor timeline AND goodput) -------------
+
+
+def test_since_arg_accepts_epoch_and_relative_durations():
+    assert cli.since_arg("1700000000") == pytest.approx(1_700_000_000.0)
+    assert cli.since_arg("15m", _now=1000.0) == pytest.approx(100.0)
+    assert cli.since_arg("2h", _now=10_000.0) == pytest.approx(2800.0)
+    assert cli.since_arg("90s", _now=100.0) == pytest.approx(10.0)
+    assert cli.since_arg("1d", _now=100_000.0) == pytest.approx(13_600.0)
+    for junk in ("soon", "15 m", "h2", "-5m", "2w", "",
+                 "nan", "inf", "-inf", "1e999"):
+        with pytest.raises(Exception):
+            cli.since_arg(junk)
+
+
+@pytest.mark.parametrize("sub", ["timeline", "goodput"])
+def test_node_doctor_since_junk_exits_nonzero_with_usage(
+    tmp_path, sub, capsys,
+):
+    db = str(tmp_path / "dead.db")
+    _write_dead_db(db)
+    with pytest.raises(SystemExit) as exc:
+        cli.main([
+            "node-doctor", sub, "--db-file", db, "--since", "fortnight",
+        ])
+    assert exc.value.code != 0
+    err = capsys.readouterr().err
+    assert "usage" in err and "--since" in err
+    # and the relative form WORKS against the same db
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = cli.main([
+            "node-doctor", sub, "--db-file", db, "--since", "2h",
+        ])
+    assert rc == 0
+    json.loads(buf.getvalue())

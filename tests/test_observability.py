@@ -342,3 +342,179 @@ def test_bind_failure_trace_records_error(traced_cluster):
     ]
     assert bind_failed
     assert f"[trace {failed[0]['trace_id']}]" in bind_failed[0]["message"]
+
+
+# -- /debug index + unknown-path contract (ISSUE 15) --------------------------
+
+
+def _open_json(port, path):
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{port}{path}", timeout=10
+    ) as resp:
+        return json.loads(resp.read())
+
+
+def test_debug_index_lists_every_registered_route():
+    """/debug answers the route index — and the index is the SAME dict
+    the handler dispatches on, so a new endpoint that forgets to
+    register itself fails this pin, not a 3am triage session."""
+    from elastic_tpu_agent.metrics import DEBUG_ROUTES
+
+    metrics = AgentMetrics(registry=CollectorRegistry())
+    metrics.serve(0)
+    try:
+        payload = _open_json(metrics.http_port, "/debug")
+        assert payload["routes"] == DEBUG_ROUTES
+        # every advertised route actually dispatches (503 while its
+        # subsystem is unattached is fine; 404 means a stale index)
+        for route in DEBUG_ROUTES:
+            try:
+                _open_json(metrics.http_port, route)
+            except urllib.error.HTTPError as e:
+                assert e.code != 404, f"{route} advertised but unknown"
+    finally:
+        metrics.close()
+
+
+def test_unknown_debug_path_is_a_json_404_naming_the_routes():
+    from elastic_tpu_agent.metrics import DEBUG_ROUTES
+
+    metrics = AgentMetrics(registry=CollectorRegistry())
+    metrics.serve(0)
+    try:
+        with pytest.raises(urllib.error.HTTPError) as excinfo:
+            _open_json(metrics.http_port, "/debug/goodpoot")
+        assert excinfo.value.code == 404
+        body = json.loads(excinfo.value.read())
+        assert "/debug/goodpoot" in body["error"]
+        assert body["debug_routes"] == sorted(DEBUG_ROUTES)
+    finally:
+        metrics.close()
+
+
+def test_debug_goodput_endpoint_503_then_serves_the_ledger(tmp_path):
+    from elastic_tpu_agent import timeline as tl
+    from elastic_tpu_agent.common import ManualClock
+    from elastic_tpu_agent.goodput import GoodputLedger
+    from elastic_tpu_agent.storage import Storage
+
+    metrics = AgentMetrics(registry=CollectorRegistry())
+    metrics.serve(0)
+    try:
+        with pytest.raises(urllib.error.HTTPError) as excinfo:
+            _open_json(metrics.http_port, "/debug/goodput")
+        assert excinfo.value.code == 503
+        with Storage(str(tmp_path / "meta.db")) as store:
+            clk = ManualClock()
+            t = tl.Timeline(store, node_name="n0", cap=64, clock=clk)
+            t.emit(tl.KIND_BIND_COMMIT, keys={"pod": "d/a"})
+            t.emit(tl.KIND_BIND_COMMIT, keys={"pod": "d/b"})
+            clk.advance(4.0)
+            t.emit(tl.KIND_THROTTLE, keys={"pod": "d/b"},
+                   action="throttle")
+            clk.advance(1.0)
+            ledger = GoodputLedger(
+                store, node_name="n0", metrics=metrics, clock=clk,
+            )
+            ledger.tick()
+            metrics.attach_goodput(ledger)
+            payload = _open_json(metrics.http_port, "/debug/goodput")
+            assert set(payload["pods"]) == {"d/a", "d/b"}
+            assert payload["conservation_problems"] == []
+            assert payload["downtime_by_cause"] == {"qos_throttle": 1.0}
+            only_b = _open_json(metrics.http_port, "/debug/goodput?pod=b")
+            assert set(only_b["pods"]) == {"d/b"}
+            with pytest.raises(urllib.error.HTTPError) as excinfo:
+                _open_json(
+                    metrics.http_port, "/debug/goodput?since=yesterday"
+                )
+            assert excinfo.value.code == 400
+            # the tick exported the closed-vocabulary downtime gauge
+            scrape = urllib.request.urlopen(
+                f"http://127.0.0.1:{metrics.http_port}/metrics", timeout=10
+            ).read().decode()
+            assert (
+                'elastic_tpu_downtime_seconds_total{cause="qos_throttle"}'
+                " 1.0" in scrape
+            )
+            assert 'elastic_tpu_goodput_ratio{pod="d/a"} 1.0' in scrape
+    finally:
+        metrics.close()
+
+
+# -- Prometheus exposition-format conformance (promtool-style, in-repo) -------
+
+
+def test_fully_wired_scrape_is_exposition_conformant(tmp_path):
+    """Scrape an AgentMetrics with series driven into every labeled
+    family (including label values that NEED escaping) and lint the
+    payload: no duplicate series, HELP/TYPE on every family, label
+    escaping correct."""
+    from elastic_tpu_agent.metrics import lint_exposition
+
+    metrics = AgentMetrics(registry=CollectorRegistry())
+    metrics.serve(0)
+    try:
+        metrics.gc_reclaimed.inc()
+        metrics.allocate_latency.observe(0.01)
+        metrics.chip_duty_cycle.labels(chip="0").set(50.0)
+        metrics.pod_core_granted.set(50.0, pod='default/we"ird\\pod\n')
+        metrics.pod_core_used.set(25.0, pod='default/we"ird\\pod\n')
+        metrics.goodput_ratio.set(0.75, pod="default/train")
+        metrics.workload_tokens_per_s.set(123.4, pod="default/train")
+        for cause in ("maintenance_drain", "qos_throttle"):
+            metrics.downtime_seconds.labels(cause=cause).set(1.5)
+        metrics.drains_total.labels(
+            trigger="maintenance", outcome="drained_acked"
+        ).inc()
+        scrape = urllib.request.urlopen(
+            f"http://127.0.0.1:{metrics.http_port}/metrics", timeout=10
+        ).read().decode()
+        problems = lint_exposition(scrape)
+        assert problems == [], problems
+    finally:
+        metrics.close()
+
+
+def test_lint_exposition_catches_seeded_breakage():
+    from elastic_tpu_agent.metrics import lint_exposition
+
+    # a known-good family first: the lint is not just rejecting all
+    good = (
+        "# HELP x_total things\n"
+        "# TYPE x_total counter\n"
+        'x_total{pod="a"} 1\n'
+    )
+    assert lint_exposition(good) == []
+    assert any(
+        "duplicate series" in p
+        for p in lint_exposition(good + 'x_total{pod="a"} 2\n')
+    )
+    assert any(
+        "no HELP/TYPE" in p
+        for p in lint_exposition("orphan_metric 1\n")
+    )
+    assert any(
+        "has no HELP" in p
+        for p in lint_exposition(
+            "# TYPE y gauge\ny 1\n"
+        )
+    )
+    assert any(
+        "illegal escape" in p
+        for p in lint_exposition(
+            "# HELP z t\n# TYPE z gauge\n" 'z{pod="a\\d"} 1\n'
+        )
+    )
+    assert any(
+        "not a number" in p
+        for p in lint_exposition(
+            "# HELP w t\n# TYPE w gauge\nw banana\n"
+        )
+    )
+    assert any(
+        "duplicate TYPE" in p
+        for p in lint_exposition(
+            "# TYPE v gauge\n# TYPE v gauge\n# HELP v t\nv 1\n"
+        )
+    )

@@ -559,3 +559,83 @@ def test_doctor_bundle_pulls_live_agent(rig, tmp_path):
     finally:
         metrics.close()
         tracing.set_tracer(prev)
+
+
+# -- flight-recorder sidecar summaries (tokens/s; ISSUE 15) -------------------
+
+
+def test_flight_summary_reaches_metrics_and_leaves_with_bindings(tmp_path):
+    from elastic_tpu_agent.workloads.telemetry import write_flight_summary
+
+    op = StubOperator(str(tmp_path / "dev"), "v5litepod-4")
+    storage = Storage(str(tmp_path / "meta.db"))
+    metrics = AgentMetrics(registry=CollectorRegistry())
+    spec_dir = str(tmp_path / "alloc")
+    sampler = UtilizationSampler(
+        op, storage=storage, metrics=metrics, alloc_spec_dir=spec_dir,
+    )
+    try:
+        dev_hash = bind(storage, "train", [0], 50)
+        op.set_utilization({0: 40.0})
+        assert write_flight_summary(
+            spec_dir, dev_hash, tokens_per_s=1234.5, steps=100,
+            mean_step_ms=8.1, ts=1000.0,
+        )
+        result = sampler.sample_once(now=1000.0)
+        assert result["pods"]["default/train"]["tokens_per_s"] == 1234.5
+        scrape = generate_latest(metrics._registry).decode()
+        assert (
+            'elastic_tpu_workload_tokens_per_second{pod="default/train"}'
+            " 1234.5" in scrape
+        )
+        # the debug table carries the achieved rate next to granted/used
+        snap = sampler.allocations_snapshot()
+        assert snap["pods"][0]["tokens_per_s"] == 1234.5
+        # a STALE summary (older than the usage-report TTL) is ignored:
+        # the gauge must not freeze a dead workload's last rate
+        sampler.sample_once(now=1000.0 + sampler.usage_report_ttl_s + 1)
+        scrape = generate_latest(metrics._registry).decode()
+        assert "elastic_tpu_workload_tokens_per_second{" not in scrape
+        # fresh again, then the pod departs: series removed with the
+        # pod's bindings, like checkpoint-age
+        assert write_flight_summary(
+            spec_dir, dev_hash, tokens_per_s=99.0, ts=2000.0,
+        )
+        sampler.sample_once(now=2000.0)
+        assert "default/train" in str(
+            generate_latest(metrics._registry)
+        )
+        storage.delete("default", "train")
+        sampler.sample_once(now=2001.0)
+        scrape = generate_latest(metrics._registry).decode()
+        assert "elastic_tpu_workload_tokens_per_second{" not in scrape
+    finally:
+        storage.close()
+
+
+def test_flight_summary_junk_and_negative_rates_ignored(tmp_path):
+    from elastic_tpu_agent.common import FlightSummarySubdir
+    from elastic_tpu_agent.workloads.telemetry import write_flight_summary
+
+    op = StubOperator(str(tmp_path / "dev"), "v5litepod-4")
+    storage = Storage(str(tmp_path / "meta.db"))
+    spec_dir = str(tmp_path / "alloc")
+    sampler = UtilizationSampler(
+        op, storage=storage, alloc_spec_dir=spec_dir,
+    )
+    try:
+        dev_hash = bind(storage, "train", [0], 50)
+        op.set_utilization({0: 40.0})
+        assert write_flight_summary(
+            spec_dir, dev_hash, tokens_per_s=-5.0, ts=1000.0,
+        )
+        result = sampler.sample_once(now=1000.0)
+        assert result["pods"]["default/train"].get("tokens_per_s") is None
+        flight = os.path.join(spec_dir, FlightSummarySubdir,
+                              f"{dev_hash}.json")
+        with open(flight, "w") as f:
+            f.write("{not json")
+        result = sampler.sample_once(now=1000.0)
+        assert result["pods"]["default/train"].get("tokens_per_s") is None
+    finally:
+        storage.close()

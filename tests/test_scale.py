@@ -130,6 +130,12 @@ def _ok_report():
             "rss_delta_per_series_bytes": 5000.0,
             "trace_ring_bytes": 1_000_000,
         },
+        "goodput": {
+            "goodput_percent": 97.5,
+            "downtime_by_cause": {"maintenance_drain": 12.0},
+            "conservation_problems": [],
+            "unreachable_nodes": [],
+        },
     }
 
 
@@ -148,11 +154,17 @@ def test_scale_problems_flags_each_violation():
     report["reconcile_convergence_s"]["unconverged_nodes"] = ["sim-1"]
     report["amplification"]["kubelet_lists_per_bind"] = 5.0
     report["memory"]["rss_delta_per_series_bytes"] = 10 * 1024 * 1024
+    report["goodput"] = {
+        "goodput_percent": None,
+        "conservation_problems": ["p overlap at t=3"],
+    }
     problems = scale_problems(report)
-    assert len(problems) >= 5
+    assert len(problems) >= 7
     joined = "\n".join(problems)
     for needle in ("stored binds", "admission waves", "unconverged",
-                   "kubelet_lists_per_bind", "ceiling"):
+                   "kubelet_lists_per_bind", "ceiling",
+                   "goodput: fleet rollup missing",
+                   "goodput conservation: p overlap at t=3"):
         assert needle in joined, f"{needle!r} not flagged:\n{joined}"
 
 
