@@ -5,7 +5,7 @@ TAG ?= elastic-tpu-agent:latest
 # verify's tier-1 line uses pipefail, which /bin/sh (dash) lacks
 SHELL := /bin/bash
 
-.PHONY: all native sanitize test test-all verify doctor-smoke chaos-smoke bench-smoke crash-replay-smoke fleet-smoke scale-smoke slice-smoke drain-smoke migrate-smoke timeline-smoke serving-smoke qos-smoke goodput-smoke protos image bench clean
+.PHONY: all native sanitize test test-all verify doctor-smoke chaos-smoke bench-smoke crash-replay-smoke fleet-smoke scale-smoke slice-smoke drain-smoke migrate-smoke timeline-smoke serving-smoke qos-smoke goodput-smoke latency-smoke perf-gate protos image bench clean
 
 all: native test
 
@@ -196,8 +196,29 @@ qos-smoke:
 goodput-smoke:
 	JAX_PLATFORMS=cpu python3 bench.py --goodput-smoke
 
+# latency smoke: the critical-path observatory gate (bench.py
+# --latency-smoke): a 2-node fleet churns, then injects a maintenance
+# notice and a telemetry failure — the injected events must surface in
+# the detection-lag histograms with sane (never-negative) bounds, the
+# phase-attributed bind breakdown must account for measured totals
+# within the 15% residual bound with resolvable trace exemplars, the
+# continuous self-profiler must stay under its 1% measured-overhead
+# contract, and every fully-wired agent's /metrics must lint clean.
+latency-smoke:
+	JAX_PLATFORMS=cpu python3 bench.py --latency-smoke
+
+# perf gate: the perf-regression ledger (elastic_tpu_agent/
+# bench_history.py) — parse the committed BENCH_r*.json trajectory
+# into per-leg latency series, schema-validate every round, and fail
+# when the newest round regresses beyond tolerance against the
+# recent-median baseline. --self-test additionally seeds a synthetic
+# regression and fails unless the gate catches it on every tracked
+# series (the gate gating itself).
+perf-gate:
+	python3 -m elastic_tpu_agent.cli perf-gate --self-test
+
 T1_TIMEOUT ?= 870
-verify: doctor-smoke chaos-smoke bench-smoke crash-replay-smoke fleet-smoke scale-smoke slice-smoke drain-smoke migrate-smoke timeline-smoke serving-smoke qos-smoke goodput-smoke
+verify: doctor-smoke chaos-smoke bench-smoke crash-replay-smoke fleet-smoke scale-smoke slice-smoke drain-smoke migrate-smoke timeline-smoke serving-smoke qos-smoke goodput-smoke latency-smoke perf-gate
 	python -c "from prometheus_client import CollectorRegistry; \
 	  from elastic_tpu_agent.metrics import AgentMetrics; \
 	  AgentMetrics(registry=CollectorRegistry()); \

@@ -1,0 +1,230 @@
+"""Perf-regression ledger: the committed bench trajectory, read back.
+
+Every growth round commits a ``BENCH_r<NN>.json`` snapshot (bench.py),
+but until now nothing ever READ the history — a latency regression
+only surfaced if a human eyeballed two JSON files. This module parses
+the committed trajectory (plus any smoke-produced structural-latency
+records handed to it) into a schema-validated per-leg time series and
+gates on it: ``make perf-gate`` fails when the newest round's tracked
+latency regresses beyond tolerance against the recent trajectory.
+
+Gate rule (deliberately robust to noisy CI boxes): for each tracked
+lower-is-better series, the baseline is the **median of the last
+``window`` rounds before the newest**; the newest value regresses when
+it exceeds ``baseline * (1 + tolerance) + floor_ms``. The committed
+trajectory legitimately drifts as scenarios get harder (rounds add
+pods/host load), so the tolerance is wide — this gate catches
+"something doubled", not "something grew 5%".
+
+Like metrics.lint_exposition, everything returns a problems list
+(empty = clean) and carries a self-test that PROVES the gate trips on
+a seeded regression — a gate that cannot fail is not a gate.
+"""
+
+from __future__ import annotations
+
+import copy
+import glob
+import json
+import os
+import re
+import statistics
+from typing import Dict, List, Optional, Tuple
+
+# Tracked per-leg series: (name, path into a round's JSON). All
+# lower-is-better milliseconds, from the bench's own-pipeline block.
+TRACKED: Tuple[Tuple[str, Tuple[str, ...]], ...] = (
+    ("allocate_p50_ms", ("parsed", "extra", "ours", "allocate_p50_ms")),
+    ("prestart_p50_ms", ("parsed", "extra", "ours", "prestart_p50_ms")),
+    ("bind_p50_ms", ("parsed", "extra", "ours", "bind_p50_ms")),
+    ("bind_p99_ms", ("parsed", "extra", "ours", "bind_p99_ms")),
+)
+
+DEFAULT_TOLERANCE = 0.5   # +50% over the rolling-median baseline
+DEFAULT_FLOOR_MS = 0.25   # plus absolute slack: sub-ms jitter never trips
+DEFAULT_WINDOW = 3        # baseline = median of this many prior rounds
+MIN_ROUNDS = 2            # one round has no trajectory to regress against
+
+_ROUND_RE = re.compile(r"BENCH_r(\d+)\.json$")
+
+
+def _dig(data: dict, path: Tuple[str, ...]):
+    node = data
+    for key in path:
+        if not isinstance(node, dict):
+            return None
+        node = node.get(key)
+    return node
+
+
+def load_history(
+    root: str = ".", include: Optional[List[str]] = None
+) -> Tuple[List[dict], List[str]]:
+    """Load the committed BENCH_r*.json trajectory (plus any ``include``
+    files, e.g. a smoke's structural-latency record) into round dicts
+    ``{"n", "path", "data"}`` sorted by round number. Unreadable files
+    are problems, not crashes."""
+    problems: List[str] = []
+    rounds: List[dict] = []
+    paths = sorted(glob.glob(os.path.join(root, "BENCH_r*.json")))
+    for path in [*paths, *(include or [])]:
+        try:
+            with open(path) as f:
+                data = json.load(f)
+        except (OSError, ValueError) as e:
+            problems.append(f"{path}: unreadable ({e})")
+            continue
+        match = _ROUND_RE.search(os.path.basename(path))
+        n = data.get("n")
+        if not isinstance(n, int):
+            n = int(match.group(1)) if match else len(rounds) + 1
+        rounds.append({"n": n, "path": path, "data": data})
+    rounds.sort(key=lambda r: (r["n"], r["path"]))
+    return rounds, problems
+
+
+def validate_round(data: dict, path: str = "") -> List[str]:
+    """Schema-check one round snapshot; returns problems (empty =
+    valid). The schema is the shape bench.py has always written —
+    validated now so a malformed snapshot fails the gate loudly
+    instead of silently dropping out of the series."""
+    where = path or "<round>"
+    problems: List[str] = []
+    if not isinstance(data, dict):
+        return [f"{where}: round is not an object"]
+    if not isinstance(data.get("n"), int) or data["n"] < 1:
+        problems.append(f"{where}: 'n' must be a positive integer")
+    if not isinstance(data.get("cmd"), str) or not data.get("cmd"):
+        problems.append(f"{where}: 'cmd' must be a non-empty string")
+    if not isinstance(data.get("rc"), int):
+        problems.append(f"{where}: 'rc' must be an integer")
+    parsed = data.get("parsed")
+    if not isinstance(parsed, dict):
+        problems.append(f"{where}: 'parsed' block missing")
+        return problems
+    if not isinstance(parsed.get("metric"), str) or not parsed.get("metric"):
+        problems.append(f"{where}: parsed.metric must be a non-empty string")
+    if not isinstance(parsed.get("value"), (int, float)) or isinstance(
+        parsed.get("value"), bool
+    ):
+        problems.append(f"{where}: parsed.value must be a number")
+    extra = parsed.get("extra")
+    if extra is not None and not isinstance(extra, dict):
+        problems.append(f"{where}: parsed.extra must be an object")
+        extra = None
+    ours = (extra or {}).get("ours")
+    if ours is not None:
+        if not isinstance(ours, dict):
+            problems.append(f"{where}: parsed.extra.ours must be an object")
+        else:
+            for name, _path in TRACKED:
+                value = ours.get(_path[-1])
+                if value is None:
+                    problems.append(
+                        f"{where}: parsed.extra.ours.{_path[-1]} missing"
+                    )
+                elif not isinstance(value, (int, float)) or isinstance(
+                    value, bool
+                ) or value < 0:
+                    problems.append(
+                        f"{where}: parsed.extra.ours.{_path[-1]} must be a "
+                        "non-negative number"
+                    )
+    return problems
+
+
+def validate_history(rounds: List[dict]) -> List[str]:
+    problems: List[str] = []
+    seen_n: Dict[int, str] = {}
+    for r in rounds:
+        problems.extend(validate_round(r["data"], r["path"]))
+        prev = seen_n.get(r["n"])
+        if prev is not None:
+            problems.append(
+                f"{r['path']}: duplicate round n={r['n']} (also {prev})"
+            )
+        seen_n[r["n"]] = r["path"]
+    return problems
+
+
+def series(rounds: List[dict]) -> Dict[str, List[Tuple[int, float]]]:
+    """Per-leg time series: tracked metric name -> [(round n, value)].
+    Rounds missing a metric simply contribute no point (the gate
+    judges the series that exist)."""
+    out: Dict[str, List[Tuple[int, float]]] = {}
+    for r in rounds:
+        for name, path in TRACKED:
+            value = _dig(r["data"], path)
+            if isinstance(value, (int, float)) and not isinstance(
+                value, bool
+            ):
+                out.setdefault(name, []).append((r["n"], float(value)))
+    return out
+
+
+def perf_gate(
+    rounds: List[dict],
+    tolerance: float = DEFAULT_TOLERANCE,
+    floor_ms: float = DEFAULT_FLOOR_MS,
+    window: int = DEFAULT_WINDOW,
+) -> List[str]:
+    """The regression gate; returns problems (empty = trajectory
+    clean). Each tracked series' newest point is judged against the
+    median of the ``window`` points before it."""
+    problems: List[str] = []
+    if len(rounds) < MIN_ROUNDS:
+        return problems  # one point is a datum, not a trajectory
+    for name, points in sorted(series(rounds).items()):
+        if len(points) < MIN_ROUNDS:
+            continue
+        n, latest = points[-1]
+        prior = [v for _, v in points[:-1]][-max(1, window):]
+        baseline = statistics.median(prior)
+        limit = baseline * (1.0 + tolerance) + floor_ms
+        if latest > limit:
+            problems.append(
+                f"REGRESSION {name}: round {n} measured {latest:.3f}ms "
+                f"> {limit:.3f}ms allowed "
+                f"(baseline median {baseline:.3f}ms over last "
+                f"{len(prior)} round(s), tolerance +{tolerance:.0%} "
+                f"+ {floor_ms}ms)"
+            )
+    return problems
+
+
+def self_test(
+    rounds: List[dict],
+    tolerance: float = DEFAULT_TOLERANCE,
+    floor_ms: float = DEFAULT_FLOOR_MS,
+    window: int = DEFAULT_WINDOW,
+) -> List[str]:
+    """Prove the gate can fail: seed a synthetic regression (the newest
+    round's tracked latencies multiplied well past tolerance) and
+    assert the gate trips on every tracked series. Returns problems
+    with the GATE (empty = the gate demonstrably works)."""
+    if len(rounds) < MIN_ROUNDS:
+        return ["self-test needs at least two committed rounds"]
+    seeded = copy.deepcopy(rounds[-1])
+    seeded["n"] = rounds[-1]["n"] + 1
+    seeded["path"] = "<seeded-regression>"
+    factor = (1.0 + tolerance) * 4
+    ours = _dig(seeded["data"], ("parsed", "extra", "ours"))
+    if not isinstance(ours, dict):
+        return ["self-test: newest round has no parsed.extra.ours block"]
+    for name, path in TRACKED:
+        value = ours.get(path[-1])
+        if isinstance(value, (int, float)) and not isinstance(value, bool):
+            ours[path[-1]] = value * factor + 10 * floor_ms
+    tripped = perf_gate(
+        [*rounds, seeded], tolerance=tolerance,
+        floor_ms=floor_ms, window=window,
+    )
+    problems: List[str] = []
+    caught = {p.split()[1].rstrip(":") for p in tripped}
+    for name, path in TRACKED:
+        if path[-1] in ours and name not in caught:
+            problems.append(
+                f"self-test: seeded {factor:.1f}x regression on {name} "
+                "did NOT trip the gate"
+            )
+    return problems

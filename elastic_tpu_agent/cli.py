@@ -178,6 +178,17 @@ def parse_args(argv=None) -> argparse.Namespace:
                         "lingers this long so a bind's burst of "
                         "apiserver writes batches and same-object "
                         "updates dedup (0 = drain immediately)")
+    p.add_argument("--slow-span-ms", type=float, default=None,
+                   help="log + journal any trace span slower than this "
+                        "many milliseconds as a slow_span timeline event "
+                        "(default: tracer built-in, 250ms; also "
+                        "ELASTIC_TPU_SLOW_SPAN_MS)")
+    p.add_argument("--profile-hz", type=float, default=0.0,
+                   help="continuous self-profiler sampling rate in Hz "
+                        "(0 = off). Samples every thread's stack and "
+                        "serves the hottest stacks at /debug/profile; "
+                        "measured overhead is exported as "
+                        "elastic_tpu_profiler_overhead_ratio")
     p.add_argument("--crash-loop-threshold", type=int, default=5,
                    help="supervisor circuit breaker: crashes of one "
                         "subsystem within the sliding window before it is "
@@ -437,11 +448,59 @@ def parse_doctor_args(argv=None) -> argparse.Namespace:
     return p.parse_args(argv)
 
 
+def parse_profile_args(argv=None) -> argparse.Namespace:
+    p = argparse.ArgumentParser(
+        prog="elastic-tpu-agent node-doctor profile",
+        description="Fetch /debug/profile from a running agent and "
+                    "render the hottest stacks (continuous self-"
+                    "profiler; enable with --profile-hz on the agent).",
+    )
+    p.add_argument(
+        "--agent-url", required=True,
+        help="base URL of a running agent's observability endpoint "
+             "(e.g. http://127.0.0.1:9478)",
+    )
+    p.add_argument("--top", type=int, default=30,
+                   help="stacks to show (hottest first)")
+    p.add_argument("--json", action="store_true",
+                   help="emit the raw /debug/profile payload instead of "
+                        "the rendered view")
+    p.add_argument("--timeout", type=float, default=3.0,
+                   help="HTTP timeout in seconds")
+    return p.parse_args(argv)
+
+
+def profile_main(argv=None) -> int:
+    from .profiler import render_profile
+    from .sampler import _fetch_json
+
+    args = parse_profile_args(argv)
+    url = f"{args.agent_url.rstrip('/')}/debug/profile?top={args.top}"
+    try:
+        payload = _fetch_json(url, args.timeout)
+    except Exception as e:  # noqa: BLE001 - one fetch, report and exit
+        print(f"cannot fetch {url}: {e}", file=sys.stderr)
+        return 1
+    if "error" in payload and "samples_total" not in payload:
+        # The endpoint answers JSON on every status; a 503 here means
+        # the agent is up but the profiler isn't attached yet.
+        print(f"agent error: {payload['error']}", file=sys.stderr)
+        return 1
+    if args.json:
+        json.dump(payload, sys.stdout, indent=2, sort_keys=True)
+        sys.stdout.write("\n")
+    else:
+        sys.stdout.write(render_profile(payload, top=args.top) + "\n")
+    return 0
+
+
 def doctor_main(argv=None) -> int:
     if argv and argv[0] == "timeline":
         return timeline_main(argv[1:])
     if argv and argv[0] == "goodput":
         return goodput_main(argv[1:])
+    if argv and argv[0] == "profile":
+        return profile_main(argv[1:])
     from .sampler import (
         UtilizationSampler,
         build_diagnostics_bundle,
@@ -508,11 +567,84 @@ def doctor_main(argv=None) -> int:
     return 0
 
 
+def parse_perf_gate_args(argv=None) -> argparse.Namespace:
+    from . import bench_history as bh
+
+    p = argparse.ArgumentParser(
+        prog="elastic-tpu-agent perf-gate",
+        description="Perf-regression ledger: parse the committed "
+                    "BENCH_r*.json trajectory into per-leg time series "
+                    "and fail when a tracked latency regresses beyond "
+                    "tolerance against the recent-median baseline.",
+    )
+    p.add_argument("--root", default=".",
+                   help="directory holding BENCH_r*.json rounds")
+    p.add_argument("--include", action="append", default=[],
+                   metavar="FILE",
+                   help="extra bench JSON file(s) to fold into the "
+                        "history (repeatable; e.g. a fresh uncommitted "
+                        "round)")
+    p.add_argument("--tolerance", type=float,
+                   default=bh.DEFAULT_TOLERANCE,
+                   help="allowed fractional regression over the "
+                        "baseline median (0.5 = +50%%)")
+    p.add_argument("--floor-ms", type=float, default=bh.DEFAULT_FLOOR_MS,
+                   help="absolute slack added to every limit — keeps "
+                        "sub-millisecond legs from tripping on noise")
+    p.add_argument("--window", type=int, default=bh.DEFAULT_WINDOW,
+                   help="prior rounds whose median forms the baseline")
+    p.add_argument("--series", action="store_true",
+                   help="print the parsed per-leg time series before "
+                        "gating (debugging aid)")
+    p.add_argument("--self-test", action="store_true",
+                   help="also seed a synthetic regression on top of the "
+                        "real history and fail unless the gate catches "
+                        "it on every tracked series")
+    return p.parse_args(argv)
+
+
+def perf_gate_main(argv=None) -> int:
+    from . import bench_history as bh
+
+    args = parse_perf_gate_args(argv)
+    rounds, problems = bh.load_history(args.root, include=args.include)
+    problems.extend(bh.validate_history(rounds))
+    if not problems:
+        if args.series:
+            for name, points in sorted(bh.series(rounds).items()):
+                path = " ".join(
+                    f"r{n:02d}={v:.3f}" for n, v in points
+                )
+                print(f"# {name}: {path}", file=sys.stderr)
+        problems.extend(bh.perf_gate(
+            rounds, tolerance=args.tolerance,
+            floor_ms=args.floor_ms, window=args.window,
+        ))
+        if args.self_test:
+            problems.extend(bh.self_test(
+                rounds, tolerance=args.tolerance,
+                floor_ms=args.floor_ms, window=args.window,
+            ))
+    if problems:
+        for problem in problems:
+            print(f"PERF-GATE: {problem}", file=sys.stderr)
+        return 1
+    tracked = ", ".join(name for name, _ in bh.TRACKED)
+    print(
+        f"perf-gate OK: {len(rounds)} round(s), tracked [{tracked}]"
+        + (" + self-test" if args.self_test else ""),
+        file=sys.stderr,
+    )
+    return 0
+
+
 def main(argv=None) -> int:
     if argv is None:
         argv = sys.argv[1:]
     if argv and argv[0] == "node-doctor":
         return doctor_main(argv[1:])
+    if argv and argv[0] == "perf-gate":
+        return perf_gate_main(argv[1:])
     args = parse_args(argv)
     logging.basicConfig(
         level=logging.DEBUG if args.verbose else logging.INFO,
@@ -579,6 +711,8 @@ def main(argv=None) -> int:
             migration_period_s=args.migration_period,
             maintenance_poll_ttl_s=args.maintenance_poll_ttl,
             goodput_period_s=args.goodput_period,
+            slow_span_ms=args.slow_span_ms,
+            profile_hz=args.profile_hz,
             storage_batch_window_s=args.storage_batch_window,
             sink_flush_window_s=args.sink_flush_window,
             **(

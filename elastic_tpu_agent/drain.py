@@ -137,6 +137,7 @@ class DrainOrchestrator:
         rng=None,
         timeline=None,
         clock=None,
+        lag_tracker=None,
     ) -> None:
         self._operator = operator
         self._plugin = plugin
@@ -185,6 +186,22 @@ class DrainOrchestrator:
         self._annotated_pods: List[Tuple[str, str]] = []  # (ns, name)
         self._last_error: Optional[str] = None
         self._resumed = False
+        # DetectionLagTracker (latency.py): the drain is the one loop
+        # with a real two-stage story — origin (GCE announcement /
+        # preemption notice, stamped by the operator) -> detected
+        # (first-trip edge) -> repaired (drain actually started).
+        self._lag = lag_tracker
+
+    def _origin_ts(self, kind: str) -> Optional[float]:
+        """Injection origin from the operator when it records one (the
+        stub does); real GCE metadata carries no origin timestamp."""
+        fn = getattr(self._operator, "origin_ts", None)
+        if fn is None:
+            return None
+        try:
+            return fn(kind)
+        except Exception:  # noqa: BLE001 - accounting never breaks a poll
+            return None
 
     # -- admin seam -----------------------------------------------------------
 
@@ -224,6 +241,11 @@ class DrainOrchestrator:
         announced = value not in ("", "NONE")
         if announced and not self._maint_active:
             logger.warning("host maintenance imminent: %s", value)
+            if self._lag is not None:
+                self._lag.detected(
+                    "drain", TRIGGER_MAINTENANCE, key=self._node,
+                    origin_ts=self._origin_ts("maintenance"),
+                )
             if self._events is not None:
                 from .kube.events import ReasonMaintenanceImminent
 
@@ -268,6 +290,14 @@ class DrainOrchestrator:
         if preempted is not None:
             try:
                 if preempted():
+                    if self._lag is not None:
+                        # Dedup in the tracker keys on the origin, so
+                        # the latched notice re-asserting every poll
+                        # records exactly one detection.
+                        self._lag.detected(
+                            "drain", TRIGGER_PREEMPTION, key=self._node,
+                            origin_ts=self._origin_ts("preempted"),
+                        )
                     return TRIGGER_PREEMPTION
             except Exception:  # noqa: BLE001
                 logger.exception("preemption poll failed")
@@ -492,6 +522,16 @@ class DrainOrchestrator:
             self._set_state(DRAINING)
             self._journal()
         self._signal_residents()
+        if self._lag is not None:
+            # Repair = residents signalled: from here the workload knows
+            # and acts; the checkpoint handshake is its own story.
+            cls = trigger.split(":", 1)[0]
+            origin = self._origin_ts(
+                "preempted" if cls == TRIGGER_PREEMPTION else "maintenance"
+            ) if cls in (TRIGGER_PREEMPTION, TRIGGER_MAINTENANCE) else None
+            self._lag.repaired(
+                "drain", cls, key=self._node, origin_ts=origin
+            )
         faults.fire("drain.post_signal")
 
     def _signal_residents(self, residents=None) -> None:

@@ -607,6 +607,7 @@ class GoodputLedger:
         migration=None,
         period_s: float = DEFAULT_PERIOD_S,
         clock=None,
+        lag_tracker=None,
     ) -> None:
         self._storage = storage
         self._node = node_name
@@ -619,6 +620,11 @@ class GoodputLedger:
         self._last: Optional[dict] = None
         self._exported_pods: set = set()
         self.ticks_total = 0
+        # DetectionLagTracker (latency.py): the ledger's event source is
+        # the journal itself — lag = newest row's ts to the tick that
+        # replayed it, watermarked so each row generation counts once.
+        self._lag = lag_tracker
+        self._row_watermark = float("-inf")
 
     # -- restart durability ---------------------------------------------------
 
@@ -659,6 +665,16 @@ class GoodputLedger:
     def tick(self) -> dict:
         asof = self._clock.time()
         rows = self._storage.timeline_rows()
+        if self._lag is not None and rows:
+            try:
+                newest = max(float(e.get("ts", 0.0)) for e in rows)
+                if newest > self._row_watermark:
+                    self._row_watermark = newest
+                    self._lag.handled(
+                        "goodput", "journal_replay", origin_ts=newest
+                    )
+            except Exception:  # noqa: BLE001 - accounting never breaks
+                pass
         acks: Dict[str, float] = {}
         if self._migration is not None:
             try:

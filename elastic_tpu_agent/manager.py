@@ -131,6 +131,14 @@ class ManagerOptions:
     # Goodput ledger (goodput.py): journal-replay period for the per-pod
     # state partition + downtime-by-cause rollup (--goodput-period).
     goodput_period_s: float = 10.0
+    # Slow-span WARNING/timeline threshold override in milliseconds
+    # (--slow-span-ms; None = the tracer's default / the
+    # ELASTIC_TPU_SLOW_SPAN_MS env). Slow spans also land in the
+    # lifecycle timeline as slow_span events, keyed pod + trace.
+    slow_span_ms: Optional[float] = None
+    # Continuous sampling profiler (profiler.py): samples per second for
+    # the supervised sys._current_frames() walk (--profile-hz; 0 = off).
+    profile_hz: float = 0.0
     # Group-commit write batching (storage/batcher.py): >0 coalesces
     # storage commits into one flush per window — load-bearing writes
     # (bind checkpoints, intent journals, agent_state) still block until
@@ -255,6 +263,52 @@ class TPUManager:
                 self.metrics.healthy_chips.set(n)
             except Exception:  # noqa: BLE001 - discovery failure: gauge stays 0
                 logger.exception("chip discovery for metrics failed")
+        # Critical-path latency observatory (latency.py) + continuous
+        # profiler (profiler.py). The observatory listens on the
+        # process-wide tracer; in the fleet sim many agents share that
+        # tracer, so both the observatory and the slow-span handler
+        # filter on the trace's node attribute (stop() deregisters).
+        from .latency import BindLatencyObservatory, DetectionLagTracker
+        from .profiler import SamplingProfiler
+        from .tracing import get_tracer
+
+        self.lag_tracker = DetectionLagTracker(metrics=self.metrics)
+        self.latency = BindLatencyObservatory(
+            metrics=self.metrics, node_name=opts.node_name
+        )
+        self.profiler = SamplingProfiler(hz=opts.profile_hz)
+        tracer = get_tracer()
+        if opts.slow_span_ms is not None:
+            tracer.slow_span_s = max(0.0, opts.slow_span_ms / 1000.0)
+        tracer.add_listener(self.latency.observe_trace)
+
+        def _on_slow_span(tr, sp) -> None:
+            node = str(tr.attrs.get("node", ""))
+            if opts.node_name and node and node != opts.node_name:
+                return  # another sim agent's span on the shared tracer
+            pod = str(
+                tr.attrs.get("pod", "")
+                or ((tr.attrs.get("pods") or [""]) or [""])[0]
+            )
+            self.timeline.emit(
+                timeline_mod.KIND_SLOW_SPAN,
+                keys={"pod": pod, "trace": tr.trace_id},
+                span=sp.name,
+                duration_ms=round(sp.duration_s * 1000, 3),
+                threshold_ms=round(tracer.slow_span_s * 1000, 3),
+                op=tr.name,
+            )
+
+        self._on_slow_span = _on_slow_span
+        tracer.add_slow_span_listener(self._on_slow_span)
+        if self.metrics is not None and hasattr(
+            self.metrics, "attach_latency"
+        ):
+            self.metrics.attach_latency(self.latency, self.lag_tracker)
+        if self.metrics is not None and hasattr(
+            self.metrics, "attach_profiler"
+        ):
+            self.metrics.attach_profiler(self.profiler)
         self.crd_recorder = None
         if opts.enable_crd:
             from .crd_recorder import build_recorder
@@ -282,6 +336,7 @@ class TPUManager:
                 metrics=self.metrics,
                 alloc_spec_dir=opts.alloc_spec_dir,
                 period_s=opts.sampler_period_s,
+                lag_tracker=self.lag_tracker,
             )
             if self.metrics is not None and hasattr(
                 self.metrics, "attach_sampler"
@@ -373,6 +428,7 @@ class TPUManager:
             dry_run=opts.reconcile_dry_run,
             slice_reformer=self.slice_reformer,
             timeline=self.timeline,
+            lag_tracker=self.lag_tracker,
         )
         from .drain import DrainOrchestrator
 
@@ -393,6 +449,7 @@ class TPUManager:
             deadline_s=opts.drain_deadline_s,
             period_s=opts.drain_period_s,
             timeline=self.timeline,
+            lag_tracker=self.lag_tracker,
         )
         # While the drain has reclaimed bindings, kubelet's still-listed
         # assignments must not be replayed back by the reconciler.
@@ -419,6 +476,7 @@ class TPUManager:
                 alloc_spec_dir=opts.alloc_spec_dir,
                 period_s=opts.migration_period_s,
                 timeline=self.timeline,
+                lag_tracker=self.lag_tracker,
             )
             # Early-reclaimed residents' kubelet assignments must not be
             # replayed back; the drain classifies completions by ack.
@@ -443,6 +501,7 @@ class TPUManager:
                 node_name=opts.node_name,
                 period_s=opts.repartition_period_s,
                 evict_after_s=opts.qos_evict_after_s,
+                lag_tracker=self.lag_tracker,
             )
             # Evicted pods' kubelet assignments must not be replayed
             # back, and the overcommit alarm must judge usage against
@@ -494,6 +553,7 @@ class TPUManager:
             metrics=self.metrics,
             migration=self.migration,
             period_s=opts.goodput_period_s,
+            lag_tracker=self.lag_tracker,
         )
         if self.metrics is not None and hasattr(
             self.metrics, "attach_goodput"
@@ -778,6 +838,11 @@ class TPUManager:
             )
         if self.sampler is not None:
             self.supervisor.register("sampler", self.sampler.run, DEGRADED)
+        if self._opts.profile_hz > 0:
+            # Continuous self-profiler: DEGRADED — observability must never
+            # take binding down. A crashed profiler restarts with its stack
+            # table intact (same instance, table survives the respawn).
+            self.supervisor.register("profiler", self.profiler.run, DEGRADED)
         # Goodput ledger: DEGRADED — losing the SLI rollup must never
         # take binding down; the journal keeps accruing either way and
         # the next tick replays it all.
@@ -806,6 +871,15 @@ class TPUManager:
             return
         self._stopped = True
         self._stop.set()
+        # Detach the latency listeners from the process-global tracer:
+        # fleet-sim restarts construct a fresh manager per node and a
+        # stale listener would keep attributing the next incarnation's
+        # traces to this one's (dead) observatory.
+        from .tracing import get_tracer
+
+        tracer = get_tracer()
+        tracer.remove_listener(self.latency.observe_trace)
+        tracer.remove_slow_span_listener(self._on_slow_span)
         self.gc_queue.put(None)  # wake GC so it can observe stop
         # Join GC before stopping the recorder: an in-flight gc_once() may
         # still enqueue record_released, which would be silently dropped if

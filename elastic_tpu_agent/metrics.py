@@ -65,6 +65,15 @@ _RECONCILE_BUCKETS = (
     5.0, 10.0,
 )
 
+# Detection lag spans poll periods: sub-poll (origin landed mid-pass) to
+# minutes (a wedged loop limping on supervisor restarts). The low end
+# must resolve the <50ms target ROADMAP item 3 is judged against, the
+# high end the ~0.7s..multi-period reality being replaced.
+_DETECTION_LAG_BUCKETS = (
+    0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+    30.0, 60.0,
+)
+
 # Prometheus label values for the async observability sinks: the fleet
 # aggregator sums per-sink apiserver traffic, and "events"/"crd" read
 # better on a dashboard than the internal worker-thread names.
@@ -84,6 +93,10 @@ DEBUG_ROUTES = {
                        "(?pod=&slice=&chip=&node=&since=&kind=&limit=)",
     "/debug/goodput": "goodput ledger: per-pod state partition + "
                       "downtime by cause (?pod=&since=)",
+    "/debug/latency": "critical-path observatory: bind phase breakdown "
+                      "+ per-loop detection lag (?top=)",
+    "/debug/profile": "continuous sampling profiler: hottest stacks + "
+                      "measured overhead (?top=)",
 }
 
 
@@ -731,11 +744,73 @@ class AgentMetrics:
             "(list success or watch event); -1 before the first sync",
             **kw,
         )
+        # -- critical-path latency observatory (latency.py) ----------------
+        self.bind_phase_seconds = Histogram(
+            "elastic_tpu_bind_phase_seconds",
+            "Bind critical-path time attributed per phase (lock wait, "
+            "kubelet list, storage sync, spec write, sink enqueue, "
+            "sidecar; 'unattributed' is the residual vs the measured "
+            "total). Bucket exemplars (trace ids) are served at "
+            "/debug/latency since the text exposition cannot carry them.",
+            ["phase"],
+            buckets=_BUCKETS,
+            **kw,
+        )
+        self.detection_lag = Histogram(
+            "elastic_tpu_detection_lag_seconds",
+            "Divergence origin -> detection/repair latency per polled "
+            "loop (reconciler, drain, sampler, repartition, migration, "
+            "goodput) — the event-to-repair number ROADMAP item 3 must "
+            "move from ~0.7s to <50ms",
+            ["loop", "stage"],
+            buckets=_DETECTION_LAG_BUCKETS,
+            **kw,
+        )
+        self.detection_lag_clamped = Counter(
+            "elastic_tpu_detection_lag_clamped_total",
+            "Detection-lag observations whose origin timestamp was in "
+            "the future (clock skew) and were clamped to 0 instead of "
+            "exported negative",
+            **kw,
+        )
+        # -- metrics-server self-observability -----------------------------
+        self.scrape_duration = Histogram(
+            "elastic_tpu_scrape_duration_seconds",
+            "Wall time the observability HTTP handler spent answering a "
+            "request (all paths) — the scraper's own cost, measured",
+            buckets=_BUCKETS,
+            **kw,
+        )
+        self.scrape_requests = Counter(
+            "elastic_tpu_scrape_requests_total",
+            "Requests answered by the observability HTTP handler; path "
+            "label is the normalized route ('other' for unknown paths, "
+            "so cardinality stays bounded under scanner noise)",
+            ["path"],
+            **kw,
+        )
+        # -- continuous self-profiler (profiler.py) ------------------------
+        self.profiler_overhead = Gauge(
+            "elastic_tpu_profiler_overhead_ratio",
+            "Fraction of wall time the sampling profiler spends walking "
+            "stacks (its measured self-cost; the latency smoke pins it "
+            "<= 1%); 0 while disabled",
+            **kw,
+        )
+        self.profiler_samples = Gauge(
+            "elastic_tpu_profiler_samples_total",
+            "Stack-walk samples taken by the continuous profiler since "
+            "agent start",
+            **kw,
+        )
         self._sampler = None
         self._supervisor = None
         self._sitter = None
         self._timeline = None
         self._goodput = None
+        self._latency = None
+        self._lag = None
+        self._profiler = None
         self._httpd: Optional[ThreadingHTTPServer] = None
 
     def attach_sampler(self, sampler) -> None:
@@ -850,6 +925,31 @@ class AgentMetrics:
             )
         )
 
+    def attach_latency(self, observatory, lag_tracker=None) -> None:
+        """Point /debug/latency at the bind-phase observatory and (when
+        given) the detection-lag tracker; 503 until attached, like the
+        other late-bound debug surfaces."""
+        self._latency = observatory
+        if lag_tracker is not None:
+            self._lag = lag_tracker
+
+    def attach_profiler(self, profiler) -> None:
+        """Point /debug/profile at the continuous sampling profiler and
+        export its self-measured cost — the profiler's <=1% overhead
+        contract is only honest if the overhead itself is scraped."""
+        self._profiler = profiler
+
+        def _overhead() -> float:
+            try:
+                return float(profiler.overhead_ratio())
+            except Exception:  # noqa: BLE001 - scrape never breaks
+                return 0.0
+
+        self.profiler_overhead.set_function(_overhead)
+        self.profiler_samples.set_function(
+            lambda: float(profiler.samples_total)
+        )
+
     def register_sink(self, sink) -> None:
         """Export a live AsyncSink's internals as gauges. Uses
         set_function so the scrape always reads current state — no
@@ -936,8 +1036,33 @@ class AgentMetrics:
                 return False
 
             def do_GET(self):  # noqa: N802
+                # Self-observability: every request — scrape, debug
+                # dump, probe, scanner noise — is timed and counted.
+                # The path label is normalized to the known routes
+                # ('other' for everything else) so a port scanner
+                # cannot mint unbounded label values.
+                t0 = time.monotonic()
+                parsed = urlparse(self.path)
                 try:
-                    parsed = urlparse(self.path)
+                    self._route(parsed)
+                finally:
+                    try:
+                        norm = parsed.path.rstrip("/") or "/"
+                        if norm not in (
+                            "/metrics", "/healthz", "/debug",
+                        ) and norm not in DEBUG_ROUTES:
+                            norm = "other"
+                        agent_metrics.scrape_requests.labels(
+                            path=norm
+                        ).inc()
+                        agent_metrics.scrape_duration.observe(
+                            time.monotonic() - t0
+                        )
+                    except Exception:  # noqa: BLE001 - never kill a reply
+                        pass
+
+            def _route(self, parsed) -> None:
+                try:
                     if parsed.path == "/metrics":
                         self._reply(
                             200, CONTENT_TYPE_LATEST,
@@ -1031,6 +1156,61 @@ class AgentMetrics:
                         self._reply_json(
                             ledger.status(pod=pod, since=since)
                         )
+                    elif parsed.path == "/debug/latency":
+                        if not self._require_loopback():
+                            return
+                        latency = agent_metrics._latency
+                        if latency is None:
+                            self._reply_json(
+                                {"error": "latency observatory not "
+                                          "attached (agent starting)"},
+                                code=503,
+                            )
+                            return
+                        q = parse_qs(parsed.query)
+                        top = None
+                        if q.get("top"):
+                            try:
+                                top = max(1, int(q["top"][0]))
+                            except ValueError:
+                                self._reply_json(
+                                    {"error": "top must be an integer"},
+                                    code=400,
+                                )
+                                return
+                        lag = agent_metrics._lag
+                        self._reply_json({
+                            "bind": latency.status(top=top),
+                            "detection_lag": (
+                                lag.status() if lag is not None else None
+                            ),
+                            "slow_span_ms": round(
+                                tracer.slow_span_s * 1000, 3
+                            ),
+                        })
+                    elif parsed.path == "/debug/profile":
+                        if not self._require_loopback():
+                            return
+                        profiler = agent_metrics._profiler
+                        if profiler is None:
+                            self._reply_json(
+                                {"error": "profiler not attached "
+                                          "(agent starting)"},
+                                code=503,
+                            )
+                            return
+                        q = parse_qs(parsed.query)
+                        top = 30
+                        if q.get("top"):
+                            try:
+                                top = max(1, int(q["top"][0]))
+                            except ValueError:
+                                self._reply_json(
+                                    {"error": "top must be an integer"},
+                                    code=400,
+                                )
+                                return
+                        self._reply_json(profiler.status(top=top))
                     elif parsed.path in ("/debug", "/debug/"):
                         if not self._require_loopback():
                             return

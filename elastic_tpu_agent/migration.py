@@ -129,6 +129,7 @@ class MigrationCoordinator:
         rng=None,
         timeline=None,
         clock=None,
+        lag_tracker=None,
     ) -> None:
         self._storage = storage
         self._plugin = plugin
@@ -151,6 +152,9 @@ class MigrationCoordinator:
         self._rng = rng if rng is not None else random.Random()
         self._timeline = timeline
         self._clock = clock if clock is not None else SYSTEM_CLOCK
+        # DetectionLagTracker (latency.py): a NEW checkpoint ack's file
+        # "ts" is its origin; consuming it is detection+repair in one.
+        self._lag = lag_tracker
         self._lock = threading.Lock()
         # pod_key -> MigrationRecord dict (source role), journaled.
         self._records: Dict[str, dict] = {}
@@ -326,6 +330,7 @@ class MigrationCoordinator:
                 continue
             acks[pod_key] = ack
             with self._lock:
+                fresh = ts > self._acked.get(pod_key, 0.0)
                 self._acked[pod_key] = max(
                     ts, self._acked.get(pod_key, 0.0)
                 )
@@ -334,6 +339,13 @@ class MigrationCoordinator:
                     oldest = min(self._acked, key=self._acked.get)
                     self._acked.pop(oldest, None)
                     self._last_acks.pop(oldest, None)
+            if self._lag is not None and fresh:
+                # Only a strictly newer ack ts is a new event; the same
+                # file re-read next tick records nothing.
+                self._lag.handled(
+                    "migration", "checkpoint_ack", key=pod_key,
+                    origin_ts=ts,
+                )
             m = self._metrics
             if m is not None and hasattr(m, "workload_checkpoint_age"):
                 try:

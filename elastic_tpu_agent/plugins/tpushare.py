@@ -852,37 +852,44 @@ class _TPUSharePluginBase(_ListAndWatchMixin, rpc.DevicePluginServicer):
                 self._storage.journal_commit(intent_id)
         finally:
             locks.release_key(owner.pod_key)
-        if self._timeline is not None:
-            # Commit phase of the bind story: journaled AFTER the record
-            # checkpoint + journal_commit (a crash in between is exactly
-            # what the reconciler's intent resolution — and its own
-            # reconcile_repair event — narrates instead).
-            self._timeline.emit(
-                tl.KIND_BIND_COMMIT,
-                keys=self._bind_keys(
-                    owner, device, chip_indexes,
-                    slice_id=annotations.get(AnnotationSliceID, ""),
-                ),
-                resource=self.resource, intent_id=intent_id,
-                links=len(created),
-            )
-        if self._metrics is not None:
-            # O(1) COUNT(*) — the per-bind gauge update must not
-            # deserialize the whole store (it used to scan every row).
-            self._metrics.bound_allocations.set(self._storage.count())
-        if self._crd is not None:
-            self._crd.record_bound(
-                device.hash, self.resource, len(device.ids),
-                owner.namespace, owner.name, owner.container, chip_indexes,
-                trace_id=get_tracer().current_id(),
-            )
-        if self._events is not None:
-            self._events.pod_event(
-                owner.namespace, owner.name, ReasonBound,
-                f"bound {self.resource} ({len(device.ids)} units) to TPU "
-                f"chip(s) {','.join(str(i) for i in chip_indexes)}",
-                uid=pod.get("metadata", {}).get("uid", ""),
-            )
+        # The post-lock sink fan-out (timeline journal, gauge refresh,
+        # CRD + Event enqueue) is its own critical-path phase: the
+        # writes are async-queued but the ENQUEUE work runs on the bind
+        # thread, and the latency observatory attributes it.
+        with get_tracer().span("sink_enqueue"):
+            if self._timeline is not None:
+                # Commit phase of the bind story: journaled AFTER the
+                # record checkpoint + journal_commit (a crash in between
+                # is exactly what the reconciler's intent resolution —
+                # and its own reconcile_repair event — narrates instead).
+                self._timeline.emit(
+                    tl.KIND_BIND_COMMIT,
+                    keys=self._bind_keys(
+                        owner, device, chip_indexes,
+                        slice_id=annotations.get(AnnotationSliceID, ""),
+                    ),
+                    resource=self.resource, intent_id=intent_id,
+                    links=len(created),
+                )
+            if self._metrics is not None:
+                # O(1) COUNT(*) — the per-bind gauge update must not
+                # deserialize the whole store (it used to scan every row).
+                self._metrics.bound_allocations.set(self._storage.count())
+            if self._crd is not None:
+                self._crd.record_bound(
+                    device.hash, self.resource, len(device.ids),
+                    owner.namespace, owner.name, owner.container,
+                    chip_indexes,
+                    trace_id=get_tracer().current_id(),
+                )
+            if self._events is not None:
+                self._events.pod_event(
+                    owner.namespace, owner.name, ReasonBound,
+                    f"bound {self.resource} ({len(device.ids)} units) to "
+                    f"TPU chip(s) "
+                    f"{','.join(str(i) for i in chip_indexes)}",
+                    uid=pod.get("metadata", {}).get("uid", ""),
+                )
         logger.info(
             "bound %s %s -> %s chips %s",
             self.resource, device.hash, owner.pod_key, chip_indexes,

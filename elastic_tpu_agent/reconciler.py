@@ -137,6 +137,7 @@ class Reconciler:
         rng=None,
         slice_reformer=None,
         timeline=None,
+        lag_tracker=None,
     ) -> None:
         self._storage = storage
         self._operator = operator
@@ -192,6 +193,11 @@ class Reconciler:
         # re-attempted and warn-logged every pass forever.
         self._replay_backoff: Dict[tuple, tuple] = {}
         self._last_error: Optional[str] = None
+        # DetectionLagTracker (latency.py): each repair reports
+        # origin->repair latency when the divergence origin was marked
+        # (fault injectors / fleet sim stamp marks; unmarked divergences
+        # simply record nothing).
+        self._lag = lag_tracker
 
     # -- plumbing -------------------------------------------------------------
 
@@ -208,6 +214,15 @@ class Reconciler:
                 m.reconcile_repairs.labels(kind=kind).inc()
             except Exception:  # noqa: BLE001 - metrics never break repair
                 pass
+        if self._lag is not None:
+            # The reconciler both detects and repairs in one pass, so
+            # one call observes both stages; key resolution mirrors the
+            # timeline keys (pod first, then the device hash).
+            self._lag.handled(
+                "reconciler", kind,
+                key=(keys or {}).get("pod") or (keys or {}).get("hash")
+                or "",
+            )
         if emit and self._timeline is not None:
             from .timeline import KIND_RECONCILE_REPAIR
 

@@ -131,6 +131,7 @@ class RepartitionController:
         evict_after_s: float = DEFAULT_EVICT_AFTER_S,
         clock=None,
         rng=None,
+        lag_tracker=None,
     ) -> None:
         self._sampler = sampler
         self._storage = storage
@@ -152,6 +153,7 @@ class RepartitionController:
         self.evict_after_s = evict_after_s
         self._clock = clock if clock is not None else SYSTEM_CLOCK
         self._rng = rng if rng is not None else random.Random()
+        self._lag = lag_tracker  # DetectionLagTracker (latency.py)
         self._lock = threading.Lock()
         # Donation ledger: every executed move is an edge (or tops up an
         # existing one), so shrink-back knows exactly whose units went
@@ -482,6 +484,17 @@ class RepartitionController:
     def _emit_move(self, move: dict) -> None:
         m = self._metrics
         direction = move["direction"]
+        if self._lag is not None:
+            # A move's divergence originated at whichever pod's demand
+            # shift triggered it: the borrower under pressure (grow) or
+            # the donor going idle (shrink). Origins come from marks the
+            # sim/tests stamp at injection; unmarked moves record
+            # nothing.
+            self._lag.handled(
+                "repartition", "repartition",
+                key=move["borrower"] if direction == "grow"
+                else move["donor"],
+            )
         if m is not None and hasattr(m, "repartitions"):
             try:
                 m.repartitions.labels(direction=direction).inc()

@@ -117,6 +117,7 @@ class UtilizationSampler:
         overcommit_margin_percent: float = DEFAULT_OVERCOMMIT_MARGIN,
         overcommit_sustain_samples: int = DEFAULT_OVERCOMMIT_SUSTAIN,
         unhealthy_after_failures: int = DEFAULT_UNHEALTHY_AFTER_FAILURES,
+        lag_tracker=None,
     ) -> None:
         self._operator = operator
         self._storage = storage
@@ -209,6 +210,12 @@ class UtilizationSampler:
         self._last_sample_ts: Optional[float] = None
         self.samples_total = 0
         self.overcommit_episodes = 0
+        # DetectionLagTracker (latency.py): chip-health flags report
+        # lag from the injected telemetry-failure origin; usage reports
+        # report lag from the file's own "ts" stamp (written by the
+        # workload) — both only when the origin is strictly new.
+        self._lag = lag_tracker
+        self._report_ts: Dict[str, float] = {}  # pod key -> newest "ts"
 
     # -- the periodic loop ----------------------------------------------------
 
@@ -286,6 +293,20 @@ class UtilizationSampler:
                     )
                     self._flagged[idx] = reason
                     logger.warning("chip %d: %s", idx, reason)
+                    if self._lag is not None:
+                        origin = None
+                        fn = getattr(self._operator, "origin_ts", None)
+                        if fn is not None:
+                            try:
+                                origin = fn("utilization")
+                            except Exception:  # noqa: BLE001
+                                origin = None
+                        # Flagging IS the sampler's repair: downstream
+                        # (reconciler/plugin) acts on the flag.
+                        self._lag.handled(
+                            "sampler", "chip_unhealthy", key=str(idx),
+                            origin_ts=origin,
+                        )
                 self._last_chips[idx] = {"error": entry["error"]}
                 continue
             if self._fail_streak.pop(idx, 0) and idx in self._flagged:
@@ -429,6 +450,18 @@ class UtilizationSampler:
                     best_ts, best_duty = ts, duty
             if best_duty is not None:
                 out[key] = best_duty
+                if (
+                    self._lag is not None
+                    and best_ts is not None
+                    and best_ts > self._report_ts.get(key, float("-inf"))
+                ):
+                    # Only a strictly NEWER report counts: re-reading a
+                    # still-on-disk file next pass is not a new event.
+                    self._report_ts[key] = best_ts
+                    self._lag.handled(
+                        "sampler", "usage_report", key=key,
+                        origin_ts=best_ts,
+                    )
         return out
 
     def _read_flight_summaries(
@@ -1045,6 +1078,21 @@ def build_diagnostics_bundle(
                     bundle["reconcile"] = live["reconcile"]
             except Exception:  # noqa: BLE001 - traces were the hard part
                 pass
+            # Critical-path breakdown + self-profile: where the bind
+            # milliseconds went (per-phase p50/p99, slowest traces with
+            # their dominant phase) and what the agent itself was doing.
+            # Each is optional — a pre-observatory agent 404s/503s here
+            # and the bundle stays valid without the block.
+            for key, path in (
+                ("latency", "/debug/latency"),
+                ("profile", "/debug/profile"),
+            ):
+                try:
+                    bundle[key] = _fetch_json(
+                        f"{base}{path}", http_timeout_s
+                    )
+                except Exception:  # noqa: BLE001 - optional block
+                    pass
         except Exception as e:  # noqa: BLE001
             bundle["agent"]["reachable"] = False
             bundle["agent"]["error"] = str(e)
@@ -1314,6 +1362,42 @@ def validate_bundle(bundle: dict) -> List[str]:
         from .goodput import validate_goodput_block
 
         problems.extend(validate_goodput_block(bundle["goodput"]))
+    if "latency" in bundle:  # absent in pre-observatory bundles
+        latency = bundle["latency"]
+        expect(isinstance(latency, dict), "latency must be an object")
+        # A 503 from a just-started agent is captured verbatim as
+        # {"error": ...} — a valid (if empty-handed) block.
+        if isinstance(latency, dict) and "bind" in latency:
+            bind = latency["bind"]
+            expect(isinstance(bind, dict), "latency.bind must be an object")
+            if isinstance(bind, dict):
+                for field in ("observed_total", "phases", "slowest"):
+                    expect(field in bind, f"latency.bind missing {field!r}")
+                phases = bind.get("phases")
+                expect(isinstance(phases, dict),
+                       "latency.bind.phases must be an object")
+                for pname, ph in (
+                    phases.items() if isinstance(phases, dict) else []
+                ):
+                    if not isinstance(ph, dict):
+                        problems.append(
+                            f"latency.bind.phases[{pname!r}] must be an "
+                            "object"
+                        )
+                        continue
+                    for field in ("count", "p50_ms", "p99_ms"):
+                        expect(field in ph,
+                               f"latency.bind.phases[{pname!r}] missing "
+                               f"{field!r}")
+    if "profile" in bundle:  # absent in pre-profiler bundles
+        profile = bundle["profile"]
+        expect(isinstance(profile, dict), "profile must be an object")
+        if isinstance(profile, dict) and "top" in profile:
+            for field in ("enabled", "hz", "samples_total",
+                          "overhead_ratio"):
+                expect(field in profile, f"profile missing {field!r}")
+            expect(isinstance(profile.get("top"), list),
+                   "profile.top must be a list")
     if "subsystems" in bundle:  # absent only in pre-supervision bundles
         subsystems = bundle["subsystems"]
         expect(isinstance(subsystems, dict), "subsystems must be an object")

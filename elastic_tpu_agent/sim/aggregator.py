@@ -425,6 +425,68 @@ class FleetAggregator:
             },
         }
 
+    # -- critical-path latency (latency.py) -----------------------------------
+
+    def node_latency(self, node: str) -> dict:
+        """One node's /debug/latency payload: phase-attributed bind
+        breakdown + per-loop detection-lag classes."""
+        return json.loads(self._get(f"{self.targets[node]}/debug/latency"))
+
+    def fleet_detection_lag(self) -> dict:
+        """Fleet origin->repair lag per divergence class: every node's
+        recent detection-lag observations merged, with p50/p99 computed
+        over the merged sample — the number ROADMAP item 3 moves (the
+        ~0.7s poll-bound divergence-repair lag) measured end to end
+        from injected origin timestamps rather than driver stopwatches.
+
+        Each node's /debug/latency keeps a bounded per-class window of
+        recent observations (not the full history), so this is a rollup
+        of the recent fleet, same as the per-node blocks it merges."""
+        per_node = {}
+        unreachable = []
+        for node in sorted(self.targets):
+            try:
+                per_node[node] = self.node_latency(node)
+            except Exception:  # noqa: BLE001 - dead node: no lag block
+                unreachable.append(node)
+        merged: Dict[str, List[dict]] = {}
+        clamped_total = 0
+        open_marks = 0
+        for node, payload in per_node.items():
+            lag = payload.get("detection_lag") or {}
+            clamped_total += int(lag.get("clamped_total") or 0)
+            open_marks += int(lag.get("open_marks") or 0)
+            for cls, block in (lag.get("classes") or {}).items():
+                for entry in block.get("recent", []):
+                    merged.setdefault(cls, []).append(
+                        {**entry, "node": node}
+                    )
+        classes = {}
+        for cls, entries in sorted(merged.items()):
+            lags = sorted(e["lag_s"] for e in entries)
+
+            def q(p: float) -> Optional[float]:
+                if not lags:
+                    return None
+                idx = min(len(lags) - 1, int(round(p * (len(lags) - 1))))
+                return round(lags[idx], 6)
+
+            classes[cls] = {
+                "count": len(lags),
+                "p50_s": q(0.5),
+                "p99_s": q(0.99),
+                "max_s": round(lags[-1], 6) if lags else None,
+                "loops": sorted({e["loop"] for e in entries}),
+                "nodes": sorted({e["node"] for e in entries}),
+            }
+        return {
+            "nodes": sorted(per_node),
+            "unreachable": unreachable,
+            "classes": classes,
+            "clamped_total": clamped_total,
+            "open_marks": open_marks,
+        }
+
     # -- trace continuity -----------------------------------------------------
 
     def trace_lookup(self, trace_id: str) -> List[dict]:

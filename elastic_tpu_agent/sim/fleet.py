@@ -238,6 +238,8 @@ class FleetSim:
         goodput_period_s: float = 1.0,
         sampler_period_s: float = 10.0,
         repartition_period_s: float = 10.0,
+        slow_span_ms: Optional[float] = None,
+        profile_hz: float = 0.0,
     ) -> None:
         self.base_dir = base_dir
         self.n_nodes = nodes
@@ -281,6 +283,12 @@ class FleetSim:
         # background tick can't race their round-paced assertions.
         self.sampler_period_s = sampler_period_s
         self.repartition_period_s = repartition_period_s
+        # Latency observatory knobs (latency.py / profiler.py): the
+        # latency smoke lowers the slow-span threshold to exercise the
+        # slow_span journal path and turns the self-profiler on to pin
+        # its measured overhead.
+        self.slow_span_ms = slow_span_ms
+        self.profile_hz = profile_hz
         self.nodes: List[SimNode] = []
         self.apiserver = None
         self.api_url = ""
@@ -355,6 +363,8 @@ class FleetSim:
                 goodput_period_s=self.goodput_period_s,
                 sampler_period_s=self.sampler_period_s,
                 repartition_period_s=self.repartition_period_s,
+                slow_span_ms=self.slow_span_ms,
+                profile_hz=self.profile_hz,
                 **(
                     {"timeline_cap": self.timeline_cap}
                     if self.timeline_cap is not None else {}

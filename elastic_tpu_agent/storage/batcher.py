@@ -131,8 +131,14 @@ class GroupCommitBatcher:
         or the flusher never confirmed it."""
         import time
 
+        from ..tracing import get_tracer
+
         deadline = time.monotonic() + timeout_s
-        with self._cond:
+        # storage_flush_wait rides inside the bind's "checkpoint" span;
+        # the latency observatory attributes it innermost-first, so the
+        # durability stall shows up as storage_sync, not as mystery
+        # checkpoint time. No-op (two monotonic reads) without a trace.
+        with get_tracer().span("storage_flush_wait", gen=gen), self._cond:
             self.sync_waits_total += 1
             while self._committed_gen < gen and gen not in self._errors:
                 if self._stopping and not self._thread.is_alive():

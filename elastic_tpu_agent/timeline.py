@@ -97,6 +97,10 @@ KIND_MIGRATION = "migration"
 # supervision (supervisor.py)
 KIND_SUBSYSTEM_RESTART = "subsystem_restart"
 KIND_SUBSYSTEM_CRASH_LOOP = "subsystem_crash_loop"
+# latency outliers (tracing.py slow-span listener via manager.py):
+# keyed pod + trace so a stall lands in the causal journal next to the
+# bind or drain it delayed
+KIND_SLOW_SPAN = "slow_span"
 
 
 class Timeline:

@@ -8,6 +8,7 @@ with zero TPU hardware.
 
 from __future__ import annotations
 
+import time
 from typing import List, Optional
 
 from .operator import LinkingOperator, TPUChip
@@ -39,6 +40,24 @@ class StubOperator(LinkingOperator):
         self._utilization: dict = {}
         self._maintenance_event = "NONE"
         self._preempted = False
+        # Detection-lag origins (latency.py): every injection stamps
+        # WHEN the fault began, so the loop that eventually notices can
+        # report origin->detection latency instead of guessing. Tests
+        # and the fleet sim may set ``clock`` (common.Clock) to make the
+        # stamps skewable/deterministic; None uses the wall clock.
+        self.clock = None
+        self._origin_ts: dict = {}
+
+    def _stamp_origin(self, kind: str) -> None:
+        self._origin_ts[kind] = (
+            self.clock.time() if self.clock is not None else time.time()
+        )
+
+    def origin_ts(self, kind: str) -> Optional[float]:
+        """When the newest injection of ``kind`` ("maintenance",
+        "preempted", "unhealthy", "utilization") happened; None if it
+        never did."""
+        return self._origin_ts.get(kind)
 
     @property
     def topology(self) -> TopologyInfo:
@@ -55,6 +74,8 @@ class StubOperator(LinkingOperator):
     # -- fault injection (mirrors tpuvm healthy_indexes semantics) ------------
 
     def set_unhealthy(self, indexes) -> None:
+        if set(indexes) - self._unhealthy:
+            self._stamp_origin("unhealthy")
         self._unhealthy = set(indexes)
 
     def healthy_indexes(self) -> set:
@@ -67,6 +88,8 @@ class StubOperator(LinkingOperator):
         ("MIGRATE_ON_HOST_MAINTENANCE"/"TERMINATE_ON_HOST_MAINTENANCE";
         "NONE" clears it) — the drain orchestrator's trigger in chaos
         scenarios and the fleet sim."""
+        if event != "NONE" and event != self._maintenance_event:
+            self._stamp_origin("maintenance")
         self._maintenance_event = event
 
     def maintenance_event(self) -> str:
@@ -75,6 +98,8 @@ class StubOperator(LinkingOperator):
     def set_preempted(self, flag: bool) -> None:
         """Inject a spot/preemption notice (never clears on real GCE;
         tests may clear it to exercise state transitions)."""
+        if flag and not self._preempted:
+            self._stamp_origin("preempted")
         self._preempted = bool(flag)
 
     def preempted(self) -> bool:
@@ -103,6 +128,7 @@ class StubOperator(LinkingOperator):
     ) -> None:
         """Make the telemetry read fail for these chips (the sampler
         flags a chip unhealthy after a failure streak)."""
+        self._stamp_origin("utilization")
         for i in indexes:
             self._utilization[i] = {"error": reason}
 

@@ -146,6 +146,37 @@ class Tracer:
         self._ring: "deque[Trace]" = deque(maxlen=capacity)
         self._lock = threading.Lock()
         self.completed = 0  # lifetime count (ring only keeps the newest)
+        # Completed-trace listeners (latency observatory) and slow-span
+        # listeners (timeline slow_span events). Both fire on the
+        # recording thread and must never break it — failures are
+        # swallowed at WARNING. Lists, not sets: registration order is
+        # deterministic and callables need not be hashable.
+        self._listeners: List = []
+        self._slow_span_listeners: List = []
+
+    def add_listener(self, fn) -> None:
+        """Register ``fn(trace)``, called after every non-discarded
+        trace lands in the ring."""
+        with self._lock:
+            if fn not in self._listeners:
+                self._listeners.append(fn)
+
+    def remove_listener(self, fn) -> None:
+        with self._lock:
+            if fn in self._listeners:
+                self._listeners.remove(fn)
+
+    def add_slow_span_listener(self, fn) -> None:
+        """Register ``fn(trace, span)``, called when a span under an
+        active trace exceeds ``slow_span_s``."""
+        with self._lock:
+            if fn not in self._slow_span_listeners:
+                self._slow_span_listeners.append(fn)
+
+    def remove_slow_span_listener(self, fn) -> None:
+        with self._lock:
+            if fn in self._slow_span_listeners:
+                self._slow_span_listeners.remove(fn)
 
     def ring_bytes(self, sample: int = 16) -> int:
         """Approximate bytes held by the trace ring: the JSON-encoded
@@ -195,6 +226,14 @@ class Tracer:
                 with self._lock:
                     self._ring.append(tr)
                     self.completed += 1
+                    listeners = list(self._listeners)
+                for fn in listeners:
+                    try:
+                        fn(tr)
+                    except Exception:  # noqa: BLE001 - never load-bearing
+                        logger.warning(
+                            "trace listener %r failed", fn, exc_info=True
+                        )
 
     @contextlib.contextmanager
     def span(self, name: str, **attrs):
@@ -220,6 +259,16 @@ class Tracer:
                         tr.name,
                         f": {sp.error}" if sp.error else "",
                     )
+                    with self._lock:
+                        listeners = list(self._slow_span_listeners)
+                    for fn in listeners:
+                        try:
+                            fn(tr, sp)
+                        except Exception:  # noqa: BLE001
+                            logger.warning(
+                                "slow-span listener %r failed",
+                                fn, exc_info=True,
+                            )
 
     def current(self) -> Optional[Trace]:
         return _current_trace.get()

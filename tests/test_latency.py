@@ -1,0 +1,474 @@
+"""Critical-path latency observatory units (latency.py, profiler.py,
+bench_history.py): innermost-first span attribution, the bind
+observatory's checkability contract (phase sums + residual == measured
+totals, every populated bucket resolvable to a trace), detection-lag
+semantics under clock skew / restarts / origin re-reads, the sampling
+profiler's bounded table + measured overhead, and the perf-regression
+ledger's schema + gate + self-test."""
+
+import json
+import threading
+import time
+
+import pytest
+
+from elastic_tpu_agent import tracing
+from elastic_tpu_agent.common import ManualClock
+from elastic_tpu_agent.latency import (
+    PHASE_KUBELET_LIST,
+    PHASE_LOCK_WAIT,
+    PHASE_STORAGE_SYNC,
+    PHASE_UNATTRIBUTED,
+    PHASES,
+    BindLatencyObservatory,
+    DetectionLagTracker,
+    attribute_spans,
+)
+
+
+# -- attribute_spans: interval claiming ---------------------------------------
+
+
+def _span(name, offset_ms, duration_ms):
+    return {"name": name, "offset_ms": offset_ms, "duration_ms": duration_ms}
+
+
+def test_attribute_spans_basic_mapping():
+    phases = attribute_spans([
+        _span("bind_lock_wait", 0.0, 2.0),
+        _span("pod_lookup", 2.0, 3.0),
+        _span("checkpoint", 5.0, 4.0),
+    ])
+    assert phases[PHASE_LOCK_WAIT] == pytest.approx(0.002)
+    assert phases[PHASE_KUBELET_LIST] == pytest.approx(0.003)
+    assert phases[PHASE_STORAGE_SYNC] == pytest.approx(0.004)
+
+
+def test_nested_same_phase_spans_never_double_count():
+    """checkpoint wrapping storage_flush_wait: the inner span claims
+    its interval first; the outer contributes only the remainder, so
+    the phase total equals the OUTER wall time, not inner + outer."""
+    phases = attribute_spans([
+        _span("checkpoint", 0.0, 10.0),
+        _span("storage_flush_wait", 2.0, 6.0),
+    ])
+    assert phases[PHASE_STORAGE_SYNC] == pytest.approx(0.010)
+
+
+def test_nested_cross_phase_spans_partition_the_interval():
+    """A sink_enqueue nested inside checkpoint: the inner phase keeps
+    its time, the outer gets the remainder — sums equal wall time."""
+    phases = attribute_spans([
+        _span("checkpoint", 0.0, 10.0),
+        _span("sink_enqueue", 4.0, 2.0),
+    ])
+    assert phases["sink_enqueue"] == pytest.approx(0.002)
+    assert phases[PHASE_STORAGE_SYNC] == pytest.approx(0.008)
+    assert sum(phases.values()) == pytest.approx(0.010)
+
+
+def test_unmapped_spans_claim_nothing():
+    assert attribute_spans([_span("mystery_work", 0.0, 5.0)]) == {}
+
+
+def test_phase_sums_never_exceed_wall_time_with_pathological_nesting():
+    spans = [
+        _span("checkpoint", 0.0, 8.0),
+        _span("storage_flush_wait", 0.0, 8.0),  # identical interval
+        _span("write_alloc_spec", 2.0, 4.0),    # overlapping the above
+    ]
+    phases = attribute_spans(spans)
+    assert sum(phases.values()) <= 0.008 + 1e-9
+
+
+# -- BindLatencyObservatory ----------------------------------------------------
+
+
+def _bind_trace(tr, node="n0", pod="ns/p", lock_s=0.0, lookup_s=0.0):
+    with tr.trace("PreStartContainer", node=node, pod=pod):
+        with tr.span("bind_lock_wait"):
+            if lock_s:
+                time.sleep(lock_s)
+        with tr.span("locator_locate"):
+            if lookup_s:
+                time.sleep(lookup_s)
+
+
+def test_observatory_phases_plus_residual_account_for_totals():
+    tr = tracing.Tracer()
+    obs = BindLatencyObservatory(node_name="n0")
+    tr.add_listener(obs.observe_trace)
+    for _ in range(4):
+        _bind_trace(tr, lock_s=0.002, lookup_s=0.004)
+    status = obs.status()
+    assert status["observed_total"] == 4
+    # the checkability contract: per-trace, attributed phase time plus
+    # the residual equals the measured total exactly
+    for entry in status["slowest"]:
+        attributed = sum(entry["phases_ms"].values())
+        assert attributed + entry["residual_ms"] == pytest.approx(
+            entry["total_ms"], abs=0.005
+        )
+    # the breakdown carries every phase key plus the residual
+    assert set(status["phases"]) == {*PHASES, PHASE_UNATTRIBUTED}
+    assert status["phases"][PHASE_LOCK_WAIT]["count"] == 4
+    assert status["phases"][PHASE_KUBELET_LIST]["count"] == 4
+
+
+def test_observatory_exemplars_resolvable_per_populated_bucket():
+    tr = tracing.Tracer()
+    obs = BindLatencyObservatory(node_name="n0")
+    tr.add_listener(obs.observe_trace)
+    _bind_trace(tr, lock_s=0.002, lookup_s=0.004)
+    status = obs.status()
+    ring_ids = {t["trace_id"] for t in tr.dump(limit=10)}
+    saw_exemplar = False
+    for phase, block in status["phases"].items():
+        if not block["count"]:
+            continue
+        assert block["exemplars"], f"populated phase {phase} lacks exemplar"
+        for ex in block["exemplars"].values():
+            saw_exemplar = True
+            assert ex["trace_id"] in ring_ids  # resolvable, not invented
+            assert ex["ms"] >= 0
+    assert saw_exemplar
+
+
+def test_observatory_filters_foreign_nodes_and_errors():
+    """Fleet sims share one process tracer: traces stamped with another
+    node's name, other trace names, and errored traces are skipped."""
+    tr = tracing.Tracer()
+    obs = BindLatencyObservatory(node_name="n0")
+    tr.add_listener(obs.observe_trace)
+    _bind_trace(tr, node="n1")  # another agent's bind
+    with tr.trace("Allocate", node="n0"):  # wrong trace name
+        pass
+    with pytest.raises(RuntimeError):
+        with tr.trace("PreStartContainer", node="n0"):
+            raise RuntimeError("bind failed")
+    assert obs.status()["observed_total"] == 0
+    _bind_trace(tr, node="n0")
+    assert obs.status()["observed_total"] == 1
+
+
+# -- DetectionLagTracker -------------------------------------------------------
+
+
+def test_detection_lag_origin_to_repair():
+    clk = ManualClock()
+    lag = DetectionLagTracker(clock=clk)
+    lag.mark("maintenance", key="n0")
+    clk.advance(0.5)
+    assert lag.detected("drain", "maintenance", key="n0") == pytest.approx(0.5)
+    clk.advance(1.0)
+    assert lag.repaired("drain", "maintenance", key="n0") == pytest.approx(1.5)
+    st = lag.status()
+    assert st["classes"]["maintenance"]["count"] == 1
+    assert st["classes"]["maintenance"]["p99_s"] == pytest.approx(1.5)
+    assert st["open_marks"] == 0  # repair popped the mark
+
+
+def test_detection_lag_clock_skew_clamps_to_zero():
+    """An origin stamped by a clock AHEAD of the observer (skewed node,
+    NTP step) must never export a negative lag."""
+    clk = ManualClock()
+    lag = DetectionLagTracker(clock=clk)
+    got = lag.repaired("sampler", "usage_report", key="p", origin_ts=clk.time() + 30.0)
+    assert got == 0.0
+    st = lag.status()
+    assert st["clamped_total"] == 1
+    assert st["classes"]["usage_report"]["p50_s"] == 0.0
+    assert all(e["lag_s"] >= 0 for e in st["classes"]["usage_report"]["recent"])
+
+
+def test_detection_lag_same_origin_never_double_counts():
+    """Re-reading a still-on-disk origin (ack file, usage report, a
+    latched preemption notice re-asserting every poll) observes once."""
+    clk = ManualClock()
+    lag = DetectionLagTracker(clock=clk)
+    origin = clk.time()
+    clk.advance(0.2)
+    assert lag.handled("migration", "checkpoint_ack", key="p", origin_ts=origin) is not None
+    clk.advance(5.0)
+    for _ in range(3):  # the same ack file read on later polls
+        assert lag.handled("migration", "checkpoint_ack", key="p", origin_ts=origin) is None
+    st = lag.status()
+    assert st["classes"]["checkpoint_ack"]["count"] == 1
+    assert st["observations"] == {"detect": 1, "repair": 1}
+
+
+def test_detection_lag_restart_records_no_bogus_lag():
+    """A restarted agent (fresh tracker, marks lost) re-detecting a
+    pre-restart divergence without an origin records NOTHING — no
+    invented lag — while an origin that survives the restart (operator
+    injection, file ts) measures the true full window."""
+    clk = ManualClock()
+    before = DetectionLagTracker(clock=clk)
+    before.mark("quota_divergence", key="pod-a")
+    clk.advance(1.0)
+    # restart: a fresh tracker has no marks
+    after = DetectionLagTracker(clock=clk)
+    assert after.handled("reconciler", "quota_divergence", key="pod-a") is None
+    assert after.status()["classes"] == {}
+    # origin carried in a durable payload still measures across restart
+    durable_origin = clk.time() - 1.0
+    got = after.handled(
+        "sampler", "usage_report", key="pod-a", origin_ts=durable_origin
+    )
+    assert got == pytest.approx(1.0)
+    assert after.status()["clamped_total"] == 0
+
+
+def test_detection_lag_mark_first_stamp_wins():
+    clk = ManualClock()
+    lag = DetectionLagTracker(clock=clk)
+    lag.mark("maintenance", key="n0")
+    clk.advance(2.0)
+    lag.mark("maintenance", key="n0")  # re-asserted, must not shrink lag
+    clk.advance(1.0)
+    assert lag.repaired("drain", "maintenance", key="n0") == pytest.approx(3.0)
+
+
+def test_detection_lag_mark_table_bounded():
+    clk = ManualClock()
+    lag = DetectionLagTracker(clock=clk, max_marks=16)
+    for i in range(100):
+        lag.mark("leak", key=str(i))
+    assert lag.status()["open_marks"] <= 16
+
+
+# -- metrics export ------------------------------------------------------------
+
+
+def test_detection_lag_exports_loop_stage_histogram():
+    from prometheus_client import CollectorRegistry
+
+    from elastic_tpu_agent.metrics import AgentMetrics
+
+    m = AgentMetrics(registry=CollectorRegistry())
+    clk = ManualClock()
+    lag = DetectionLagTracker(metrics=m, clock=clk)
+    lag.mark("maintenance", key="n0")
+    clk.advance(0.3)
+    lag.repaired("drain", "maintenance", key="n0")
+    from prometheus_client import generate_latest
+
+    text = generate_latest(m._registry).decode()
+    assert 'elastic_tpu_detection_lag_seconds_count{loop="drain",stage="repair"} 1.0' in text
+
+
+def test_bind_phase_histogram_exported_with_residual():
+    from prometheus_client import CollectorRegistry, generate_latest
+
+    from elastic_tpu_agent.metrics import AgentMetrics
+
+    m = AgentMetrics(registry=CollectorRegistry())
+    tr = tracing.Tracer()
+    obs = BindLatencyObservatory(metrics=m, node_name="n0")
+    tr.add_listener(obs.observe_trace)
+    _bind_trace(tr, lock_s=0.001)
+    text = generate_latest(m._registry).decode()
+    assert 'elastic_tpu_bind_phase_seconds_count{phase="lock_wait"} 1.0' in text
+    assert 'phase="unattributed"' in text
+
+
+# -- SamplingProfiler ----------------------------------------------------------
+
+
+def test_profiler_samples_a_parked_thread():
+    from elastic_tpu_agent.profiler import SamplingProfiler
+
+    release = threading.Event()
+
+    def parked_for_profiler():
+        release.wait(10.0)
+
+    t = threading.Thread(target=parked_for_profiler, daemon=True,
+                         name="park-me")
+    t.start()
+    try:
+        prof = SamplingProfiler(hz=10.0)
+        for _ in range(3):
+            assert prof.sample_once() >= 1
+        status = prof.status(top=50)
+        assert status["samples_total"] == 3
+        flat = json.dumps(status["top"])
+        assert "parked_for_profiler" in flat
+        assert "park-me" in flat
+    finally:
+        release.set()
+
+
+def test_profiler_table_bounded_and_drops_counted():
+    from elastic_tpu_agent.profiler import SamplingProfiler
+
+    prof = SamplingProfiler(hz=10.0, max_stacks=16)  # 16 is the floor
+    # saturate the table with synthetic keys so the next live sample
+    # (of a parked helper thread) must drop instead of growing the table
+    with prof._lock:
+        for i in range(16):
+            prof._stacks[(f"synthetic-{i}", (f"frame-{i}",))] = 1
+    release = threading.Event()
+    t = threading.Thread(target=release.wait, args=(10.0,), daemon=True)
+    t.start()
+    try:
+        prof.sample_once()
+    finally:
+        release.set()
+    status = prof.status()
+    assert status["unique_stacks"] == 16
+    assert status["max_stacks"] == 16
+    assert status["dropped_stacks"] >= 1
+
+
+def test_profiler_overhead_measured_not_assumed():
+    from elastic_tpu_agent.profiler import SamplingProfiler
+
+    prof = SamplingProfiler(hz=10.0)
+    assert prof.overhead_ratio() == 0.0
+    prof.sample_once()
+    time.sleep(0.05)
+    ratio = prof.overhead_ratio()
+    assert 0.0 < ratio < 1.0
+
+
+def test_profiler_disabled_status_and_render():
+    from elastic_tpu_agent.profiler import SamplingProfiler, render_profile
+
+    prof = SamplingProfiler(hz=0.0)
+    status = prof.status()
+    assert status["enabled"] is False
+    assert "DISABLED" in render_profile(status)
+
+
+def test_profiler_run_paces_and_stops():
+    from elastic_tpu_agent.profiler import SamplingProfiler
+
+    prof = SamplingProfiler(hz=100.0)
+    stop = threading.Event()
+    t = threading.Thread(target=prof.run, args=(stop,), daemon=True)
+    t.start()
+    time.sleep(0.2)
+    stop.set()
+    t.join(timeout=5.0)
+    assert not t.is_alive()
+    assert prof.status()["samples_total"] >= 1
+
+
+# -- bench_history: the perf-regression ledger --------------------------------
+
+
+def _round(n, allocate=0.6, prestart=1.0, bind50=1.5, bind99=3.0):
+    return {
+        "n": n,
+        "cmd": "python3 bench.py",
+        "rc": 0,
+        "parsed": {
+            "metric": "allocate_p50_latency",
+            "value": allocate,
+            "unit": "ms",
+            "extra": {
+                "ours": {
+                    "allocate_p50_ms": allocate,
+                    "prestart_p50_ms": prestart,
+                    "bind_p50_ms": bind50,
+                    "bind_p99_ms": bind99,
+                },
+            },
+        },
+    }
+
+
+def _write_rounds(tmp_path, rounds):
+    for r in rounds:
+        (tmp_path / f"BENCH_r{r['n']:02d}.json").write_text(json.dumps(r))
+
+
+def test_bench_history_load_validate_series(tmp_path):
+    from elastic_tpu_agent import bench_history as bh
+
+    _write_rounds(tmp_path, [_round(1), _round(2, bind50=1.7), _round(3)])
+    rounds, problems = bh.load_history(str(tmp_path))
+    assert problems == []
+    assert [r["n"] for r in rounds] == [1, 2, 3]
+    assert bh.validate_history(rounds) == []
+    series = bh.series(rounds)
+    assert series["bind_p50_ms"] == [(1, 1.5), (2, 1.7), (3, 1.5)]
+
+
+def test_bench_history_schema_violations_reported(tmp_path):
+    from elastic_tpu_agent import bench_history as bh
+
+    bad = _round(1)
+    del bad["parsed"]["extra"]["ours"]["bind_p99_ms"]
+    bad["rc"] = "zero"
+    _write_rounds(tmp_path, [bad])
+    rounds, problems = bh.load_history(str(tmp_path))
+    problems.extend(bh.validate_history(rounds))
+    text = "\n".join(problems)
+    assert "bind_p99_ms" in text
+    assert "rc" in text
+
+
+def test_bench_history_duplicate_rounds_flagged(tmp_path):
+    from elastic_tpu_agent import bench_history as bh
+
+    _write_rounds(tmp_path, [_round(1)])
+    dup = _round(1)
+    (tmp_path / "BENCH_r99.json").write_text(json.dumps(dup))
+    rounds, problems = bh.load_history(str(tmp_path))
+    problems.extend(bh.validate_history(rounds))
+    assert any("duplicate" in p for p in problems)
+
+
+def test_perf_gate_passes_noisy_but_flat_trajectory(tmp_path):
+    from elastic_tpu_agent import bench_history as bh
+
+    _write_rounds(tmp_path, [
+        _round(1), _round(2, bind50=1.9), _round(3, bind50=1.4),
+        _round(4, bind50=2.0), _round(5, bind50=1.8),
+    ])
+    rounds, _ = bh.load_history(str(tmp_path))
+    assert bh.perf_gate(rounds) == []
+
+
+def test_perf_gate_trips_on_real_regression(tmp_path):
+    from elastic_tpu_agent import bench_history as bh
+
+    _write_rounds(tmp_path, [
+        _round(1), _round(2), _round(3),
+        _round(4, bind50=9.0),  # 6x the baseline median
+    ])
+    rounds, _ = bh.load_history(str(tmp_path))
+    problems = bh.perf_gate(rounds)
+    assert problems and "bind_p50_ms" in problems[0]
+    assert "REGRESSION" in problems[0]
+
+
+def test_perf_gate_floor_absorbs_submillisecond_noise(tmp_path):
+    from elastic_tpu_agent import bench_history as bh
+
+    # 0.10ms -> 0.16ms is +60% but inside the absolute floor: no trip
+    _write_rounds(tmp_path, [
+        _round(1, allocate=0.10), _round(2, allocate=0.10),
+        _round(3, allocate=0.16),
+    ])
+    rounds, _ = bh.load_history(str(tmp_path))
+    assert bh.perf_gate(rounds) == []
+
+
+def test_perf_gate_self_test_catches_seeded_regression(tmp_path):
+    from elastic_tpu_agent import bench_history as bh
+
+    _write_rounds(tmp_path, [_round(1), _round(2), _round(3)])
+    rounds, _ = bh.load_history(str(tmp_path))
+    assert bh.self_test(rounds) == []  # the seeded regression was caught
+
+
+def test_perf_gate_cli_roundtrip(tmp_path):
+    from elastic_tpu_agent.cli import main
+
+    _write_rounds(tmp_path, [_round(1), _round(2), _round(3)])
+    assert main(["perf-gate", "--root", str(tmp_path), "--self-test"]) == 0
+    _write_rounds(tmp_path, [_round(4, bind99=40.0)])
+    assert main(["perf-gate", "--root", str(tmp_path)]) == 1

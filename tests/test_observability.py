@@ -518,3 +518,143 @@ def test_lint_exposition_catches_seeded_breakage():
             "# TYPE v gauge\n# TYPE v gauge\n# HELP v t\nv 1\n"
         )
     )
+
+
+# -- /debug/latency + /debug/profile + scrape self-metrics (ISSUE 16) ---------
+
+
+def test_debug_latency_endpoint_503_then_serves_phase_breakdown():
+    """503 before attach; after attach the payload carries the bind
+    phase breakdown whose sums + residual equal the measured totals,
+    with a resolvable exemplar for every populated phase, the
+    detection-lag block, and the effective slow-span threshold."""
+    import time as _time
+
+    from elastic_tpu_agent.common import ManualClock
+    from elastic_tpu_agent.latency import (
+        PHASE_UNATTRIBUTED,
+        BindLatencyObservatory,
+        DetectionLagTracker,
+    )
+
+    prev = tracing.set_tracer(tracing.Tracer())
+    metrics = AgentMetrics(registry=CollectorRegistry())
+    metrics.serve(0)
+    tr = tracing.get_tracer()
+    obs = BindLatencyObservatory(metrics=metrics, node_name="n0")
+    tr.add_listener(obs.observe_trace)
+    try:
+        with pytest.raises(urllib.error.HTTPError) as excinfo:
+            _open_json(metrics.http_port, "/debug/latency")
+        assert excinfo.value.code == 503
+
+        clk = ManualClock()
+        lag = DetectionLagTracker(metrics=metrics, clock=clk)
+        lag.mark("maintenance", key="n0")
+        clk.advance(0.7)
+        lag.repaired("drain", "maintenance", key="n0")
+        for _ in range(3):
+            with tr.trace("PreStartContainer", node="n0", pod="d/p"):
+                with tr.span("bind_lock_wait"):
+                    _time.sleep(0.002)
+                with tr.span("locator_locate"):
+                    _time.sleep(0.003)
+        metrics.attach_latency(obs, lag)
+
+        payload = _open_json(metrics.http_port, "/debug/latency")
+        bind = payload["bind"]
+        assert bind["observed_total"] == 3
+        # phase sums + residual == measured total, per slowest entry
+        for entry in bind["slowest"]:
+            attributed = sum(entry["phases_ms"].values())
+            assert (
+                abs(attributed + entry["residual_ms"] - entry["total_ms"])
+                < 0.01
+            )
+        # every populated phase resolves to an exemplar trace id that
+        # /debug/traces can actually serve
+        for phase, block in bind["phases"].items():
+            if not block["count"]:
+                continue
+            assert block["exemplars"], phase
+            ex = next(iter(block["exemplars"].values()))
+            hits = _open_json(
+                metrics.http_port, f"/debug/traces?trace={ex['trace_id']}"
+            )["traces"]
+            assert hits and hits[0]["trace_id"] == ex["trace_id"]
+        assert bind["phases"][PHASE_UNATTRIBUTED]["share_of_total"] is not None
+        assert payload["detection_lag"]["classes"]["maintenance"]["count"] == 1
+        assert payload["slow_span_ms"] == pytest.approx(
+            tr.slow_span_s * 1000
+        )
+        # ?top= bounds the slowest table; bad values are a 400
+        small = _open_json(metrics.http_port, "/debug/latency?top=1")
+        assert len(small["bind"]["slowest"]) == 1
+        with pytest.raises(urllib.error.HTTPError) as excinfo:
+            _open_json(metrics.http_port, "/debug/latency?top=banana")
+        assert excinfo.value.code == 400
+    finally:
+        tr.remove_listener(obs.observe_trace)
+        metrics.close()
+        tracing.set_tracer(prev)
+
+
+def test_debug_profile_endpoint_503_then_serves_stacks():
+    from elastic_tpu_agent.profiler import SamplingProfiler
+
+    metrics = AgentMetrics(registry=CollectorRegistry())
+    metrics.serve(0)
+    try:
+        with pytest.raises(urllib.error.HTTPError) as excinfo:
+            _open_json(metrics.http_port, "/debug/profile")
+        assert excinfo.value.code == 503
+
+        prof = SamplingProfiler(hz=10.0)
+        prof.sample_once()  # the HTTP server thread is always sampleable
+        metrics.attach_profiler(prof)
+        payload = _open_json(metrics.http_port, "/debug/profile")
+        assert payload["enabled"] is True
+        assert payload["samples_total"] == 1
+        assert payload["overhead_ratio"] >= 0.0
+        assert isinstance(payload["top"], list)
+        # the attach wires the overhead + sample gauges into the scrape
+        scrape = urllib.request.urlopen(
+            f"http://127.0.0.1:{metrics.http_port}/metrics", timeout=10
+        ).read().decode()
+        assert "elastic_tpu_profiler_overhead_ratio" in scrape
+        assert "elastic_tpu_profiler_samples_total 1.0" in scrape
+    finally:
+        metrics.close()
+
+
+def test_scrape_self_metrics_count_and_time_every_request():
+    """Every HTTP request — scrape, debug route, scanner noise — lands
+    in elastic_tpu_scrape_requests_total under a bounded path label
+    ('other' for unknown paths) and in the scrape-duration histogram."""
+    metrics = AgentMetrics(registry=CollectorRegistry())
+    metrics.serve(0)
+    try:
+        def scrape_text():
+            return urllib.request.urlopen(
+                f"http://127.0.0.1:{metrics.http_port}/metrics", timeout=10
+            ).read().decode()
+
+        scrape_text()
+        _open_json(metrics.http_port, "/debug")
+        for path in ("/debug/goodpoot", "/totally/unknown"):
+            with pytest.raises(urllib.error.HTTPError):
+                _open_json(metrics.http_port, path)
+        text = scrape_text()
+        assert 'elastic_tpu_scrape_requests_total{path="/metrics"}' in text
+        assert 'elastic_tpu_scrape_requests_total{path="/debug"} 1.0' in text
+        # unknown paths collapse into 'other' — a scanner cannot mint
+        # unbounded label values
+        assert 'elastic_tpu_scrape_requests_total{path="other"} 2.0' in text
+        assert 'path="/debug/goodpoot"' not in text
+        assert "elastic_tpu_scrape_duration_seconds_count" in text
+        # and the self-metrics themselves stay exposition-conformant
+        from elastic_tpu_agent.metrics import lint_exposition
+
+        assert lint_exposition(text) == []
+    finally:
+        metrics.close()

@@ -10,6 +10,7 @@ with the typed error, and AsyncSink internals surface as gauges.
 import json
 import os
 import threading
+import time
 import urllib.error
 import urllib.request
 
@@ -516,3 +517,87 @@ def test_serving_engine_emits_flight_records():
     assert step_rec["emitted_tokens"] == 1
     assert step_rec["live_requests"] == 1
     assert step_rec["used_blocks"] >= 1
+
+
+# -- trace / slow-span listeners (the latency observatory's feed) --------------
+
+
+def test_trace_listener_fires_with_completed_trace():
+    tr = tracing.Tracer()
+    got = []
+    tr.add_listener(got.append)
+    with tr.trace("PreStartContainer", node="n0"):
+        with tr.span("bind_lock_wait"):
+            pass
+    assert len(got) == 1
+    done = got[0]
+    assert done.name == "PreStartContainer"
+    assert done.duration_s > 0  # fired AFTER completion, duration final
+    assert [sp.name for sp in done.spans] == ["bind_lock_wait"]
+    tr.remove_listener(got.append)
+    with tr.trace("PreStartContainer"):
+        pass
+    assert len(got) == 1  # removed listener no longer fires
+
+
+def test_trace_listener_exception_never_breaks_the_traced_call(caplog):
+    tr = tracing.Tracer()
+
+    def broken(trace):
+        raise RuntimeError("observatory crashed")
+
+    seen = []
+    tr.add_listener(broken)
+    tr.add_listener(seen.append)
+    with caplog.at_level("WARNING", logger="elastic_tpu_agent.tracing"):
+        with tr.trace("bind"):
+            pass  # must not raise despite the broken listener
+    assert len(seen) == 1  # later listeners still ran
+    assert any("listener" in r.message for r in caplog.records)
+
+
+def test_trace_listener_fires_for_errored_traces_too():
+    """A FAILED bind is exactly the trace the observatory must see (it
+    filters errors itself — the tracer does not pre-filter)."""
+    tr = tracing.Tracer()
+    got = []
+    tr.add_listener(got.append)
+    with pytest.raises(ValueError):
+        with tr.trace("PreStartContainer"):
+            raise ValueError("boom")
+    assert len(got) == 1 and got[0].error == "ValueError: boom"
+
+
+def test_slow_span_listener_fires_past_threshold_only():
+    tr = tracing.Tracer(slow_span_s=0.05)
+    hits = []
+    tr.add_slow_span_listener(lambda trace, span: hits.append(
+        (trace.name, span.name)
+    ))
+    with tr.trace("bind"):
+        with tr.span("fast"):
+            pass
+        with tr.span("crawl"):
+            time.sleep(0.06)
+    assert hits == [("bind", "crawl")]
+    # removal is membership-checked: once the registered callable is
+    # removed, further slow spans no longer fire it
+    for fn in list(tr._slow_span_listeners):
+        tr.remove_slow_span_listener(fn)
+    with tr.trace("bind"):
+        with tr.span("crawl2"):
+            time.sleep(0.06)
+    assert hits == [("bind", "crawl")]
+
+
+def test_slow_span_threshold_configurable_via_ms_knob():
+    """The --slow-span-ms plumbing: ManagerOptions.slow_span_ms becomes
+    the shared tracer's slow_span_s (milliseconds in, seconds stored)."""
+    tr = tracing.Tracer(slow_span_s=1.25)
+    assert tr.slow_span_s == 1.25
+    hits = []
+    tr.add_slow_span_listener(lambda t, s: hits.append(s.name))
+    with tr.trace("bind"):
+        with tr.span("quick"):
+            pass
+    assert hits == []  # nothing near 1.25s: listener never fired
