@@ -5,7 +5,7 @@ TAG ?= elastic-tpu-agent:latest
 # verify's tier-1 line uses pipefail, which /bin/sh (dash) lacks
 SHELL := /bin/bash
 
-.PHONY: all native sanitize test test-all verify doctor-smoke chaos-smoke bench-smoke crash-replay-smoke fleet-smoke scale-smoke slice-smoke drain-smoke migrate-smoke timeline-smoke serving-smoke qos-smoke goodput-smoke latency-smoke perf-gate protos image bench clean
+.PHONY: all native sanitize test test-all verify doctor-smoke chaos-smoke bench-smoke crash-replay-smoke fleet-smoke scale-smoke slice-smoke drain-smoke migrate-smoke timeline-smoke serving-smoke request-obs-smoke qos-smoke goodput-smoke latency-smoke perf-gate protos image bench clean
 
 all: native test
 
@@ -170,6 +170,15 @@ timeline-smoke:
 serving-smoke:
 	JAX_PLATFORMS=cpu python3 bench.py --serving-smoke
 
+# request-obs smoke: the request observatory end to end (bench.py
+# --request-obs-smoke): unified head-of-line stall attributed while a
+# disaggregated decode's TPOT rides through the same burst, stitched
+# handoff = one partition per id, cached-token attribution, the
+# /debug/requests endpoint contracts, and the fleet SLO rollup equal
+# to the per-node ledgers.
+request-obs-smoke:
+	JAX_PLATFORMS=cpu python3 bench.py --request-obs-smoke
+
 # qos smoke: the utilization-loop gate (bench.py --qos-smoke,
 # CPU-deterministic): two engines co-located on one stub chip under
 # phase-imbalanced load must decode measurably more aggregate tokens
@@ -218,7 +227,7 @@ perf-gate:
 	python3 -m elastic_tpu_agent.cli perf-gate --self-test
 
 T1_TIMEOUT ?= 870
-verify: doctor-smoke chaos-smoke bench-smoke crash-replay-smoke fleet-smoke scale-smoke slice-smoke drain-smoke migrate-smoke timeline-smoke serving-smoke qos-smoke goodput-smoke latency-smoke perf-gate
+verify: doctor-smoke chaos-smoke bench-smoke crash-replay-smoke fleet-smoke scale-smoke slice-smoke drain-smoke migrate-smoke timeline-smoke serving-smoke request-obs-smoke qos-smoke goodput-smoke latency-smoke perf-gate
 	python -c "from prometheus_client import CollectorRegistry; \
 	  from elastic_tpu_agent.metrics import AgentMetrics; \
 	  AgentMetrics(registry=CollectorRegistry()); \

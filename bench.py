@@ -2615,6 +2615,367 @@ def serving_smoke_main():
     return 0
 
 
+# -- request-level serving observatory (ISSUE 17) -----------------------------
+#
+# CPU-deterministic: the RequestObservatory's contracts driven through
+# REAL engines — per-request gap-free partitions, unified head-of-line
+# stall attribution vs disaggregated isolation, cross-role stitching
+# over the SharedKVPool, cached-token attribution, and the fleet SLO
+# rollup read back over HTTP and checked against the node ledgers.
+
+
+def _request_obs_model():
+    import jax
+    import jax.numpy as jnp
+
+    from elastic_tpu_agent.workloads.transformer import (
+        ModelConfig,
+        init_params,
+    )
+
+    cfg = ModelConfig(
+        vocab=97, d_model=32, n_heads=4, n_layers=2, d_ff=64,
+        max_seq=192, dtype=jnp.float32, attn="reference", pos="rope",
+    )
+    return cfg, init_params(cfg, jax.random.key(0))
+
+
+def run_request_obs_leg():
+    """Main-bench leg: shared-prefix serving through the request
+    observatory — per-request cached-vs-computed attribution, the
+    prefill-reduction ratio the perf gate tracks
+    (bench_history.TRACKED_RATIOS), the per-class SLO ledger, and the
+    conservation check. Deterministic, CPU-only."""
+    from elastic_tpu_agent.workloads.request_obs import (
+        RequestObservatory,
+    )
+    from elastic_tpu_agent.workloads.serving import ServingEngine
+
+    cfg, params = _request_obs_model()
+    system = [((7 * i) % 89) + 2 for i in range(56)]
+    tails = [[60 + i, 3 + i, 41 - i, 9 + i] for i in range(8)]
+
+    def run(prefix_cache, obs=None):
+        eng = ServingEngine(
+            params, cfg, slots=1, max_len=128,
+            prompt_buckets=(8, 64), block_size=8,
+            prefix_cache=prefix_cache, observatory=obs,
+        )
+        for i, tail in enumerate(tails):
+            rid = eng.admit(
+                system + tail, slo="ttft" if i % 2 else "batch"
+            )
+            eng.step()
+            eng.release(rid)
+        return eng
+
+    obs = RequestObservatory()
+    eng_on = run(True, obs)
+    eng_off = run(False)
+    st = obs.status()
+    return {
+        "requests": len(tails),
+        "prefill_reduction": round(
+            eng_off.prefilled_tokens_total
+            / max(1, eng_on.prefilled_tokens_total), 3
+        ),
+        "cached_tokens_attributed": sum(
+            r["cached_tokens"] for r in st["requests"]
+        ),
+        "classes": st["classes"],
+        "conservation": st["conservation"],
+        "finish_reasons": st["finish_reasons"],
+    }
+
+
+REQUEST_OBS_SMOKE_RESIDUAL_MAX_MS = 5.0
+
+
+def request_obs_smoke_main():
+    """`make request-obs-smoke` (CPU-only): (1) unified-mode prefill
+    burst stalls a live decode (stalled phase attributed, TPOT
+    inflated) while a disaggregated decode engine's TPOT is unaffected
+    by the same burst on its prefill peer, (2) the stitched handoff
+    yields exactly one partition per id with the handoff phase present,
+    (3) shared-prefix requests carry cached-token attribution, (4) the
+    fleet SLO rollup over HTTP equals the per-node ledgers, (5) the
+    /debug/requests endpoint contracts hold and exposition lint passes
+    on the new families. Exits nonzero with reasons."""
+    import urllib.error
+    import urllib.request
+
+    from prometheus_client import CollectorRegistry
+
+    from elastic_tpu_agent.metrics import AgentMetrics, lint_exposition
+    from elastic_tpu_agent.sim import FleetAggregator
+    from elastic_tpu_agent.workloads.request_obs import (
+        RequestObservatory,
+    )
+    from elastic_tpu_agent.workloads.serving import (
+        ServingEngine,
+        SharedKVPool,
+    )
+
+    problems = []
+    out = {}
+    cfg, params = _request_obs_model()
+    prompt = [((7 * i) % 89) + 2 for i in range(40)]
+
+    def fetch(url):
+        try:
+            with urllib.request.urlopen(url, timeout=5) as resp:
+                return resp.getcode(), resp.read()
+        except urllib.error.HTTPError as e:
+            return e.code, e.read()
+
+    # Metrics attach BEFORE the engines run, so the node ledgers and
+    # the scraped histograms cover the identical request set — the
+    # precondition for the fleet == per-node equality below.
+    uobs = RequestObservatory()
+    dobs = RequestObservatory()
+    servers, metrics = [], []
+    for obs in (uobs, dobs):
+        reg = CollectorRegistry()
+        m = AgentMetrics(registry=reg)
+        servers.append(m.serve(0, addr="127.0.0.1"))
+        metrics.append(m)
+    targets = {
+        f"node{i}": f"http://127.0.0.1:{s.server_address[1]}"
+        for i, s in enumerate(servers)
+    }
+    code, _ = fetch(f"{targets['node0']}/debug/requests")
+    if code != 503:
+        problems.append(
+            f"/debug/requests before attach returned {code}, want 503"
+        )
+    metrics[0].attach_requests(uobs)
+    metrics[1].attach_requests(dobs)
+
+    # -- (1) unified head-of-line vs disaggregated isolation ---------
+    uni = ServingEngine(
+        params, cfg, slots=4, max_len=128, prompt_buckets=(8, 64),
+        observatory=uobs,
+    )
+    warm = uni.admit(prompt)  # compile prefill+decode outside timing
+    uni.step()
+    uni.release(warm)
+    live = uni.admit(prompt[:8], slo="tpot")
+    uni.step()
+    burst = [uni.admit(prompt, slo="ttft") for _ in range(2)]
+    for _ in range(4):
+        uni.step()
+    for rid in (live, *burst):
+        uni.release(rid)
+    ust = uobs.status()
+    live_rec = next(
+        r for r in ust["requests"] if r["slo"] == "tpot"
+    )
+    out["unified"] = {
+        "stalled_ms": live_rec["phases_ms"].get("stalled", 0.0),
+        "tpot_ms": live_rec["tpot_ms"],
+        "burst_ttft_ms": [
+            r["ttft_ms"] for r in ust["requests"] if r["slo"] == "ttft"
+        ],
+    }
+    if not live_rec["phases_ms"].get("stalled"):
+        problems.append(
+            "unified: live decode shows no stalled attribution under "
+            "the synchronous admit burst"
+        )
+
+    pool = SharedKVPool(cfg, block_size=8, pool_blocks=64)
+    pre = ServingEngine(
+        params, cfg, slots=1, max_len=128, prompt_buckets=(8, 64),
+        role="prefill", pool=pool, observatory=dobs,
+    )
+    dec = ServingEngine(
+        params, cfg, slots=2, max_len=128, prompt_buckets=(8, 64),
+        role="decode", pool=pool, observatory=dobs,
+    )
+    dwarm = dec.admit(prompt[:8])
+    dec.step()
+    dec.release(dwarm)
+    dlive = dec.admit([5, 17, 42, 61, 3, 9, 12, 8], slo="tpot")
+    for _ in range(5):  # decode loop runs free of the prefill burst
+        dec.step()
+    for p_ in range(2):  # the SAME burst, absorbed by the prefill role
+        rid = pre.admit(prompt, slo="ttft")
+        pre.step()
+        pre.release(rid)
+    dec.release(dlive)
+    # the published burst handoffs: adopt one to pin stitching
+    srid = dec.admit(prompt)
+    dec.step()
+    dec.release(srid)
+    dst = dobs.status()
+    dlive_rec = next(
+        r for r in dst["requests"] if r["slo"] == "tpot"
+    )
+    stitched = [r for r in dst["requests"] if r["stitched"]]
+    out["disaggregated"] = {
+        "stalled_ms": dlive_rec["phases_ms"].get("stalled", 0.0),
+        "tpot_ms": dlive_rec["tpot_ms"],
+        "stitched": dst["stitched"],
+        "handoffs_adopted": dst["handoffs_adopted"],
+        "pending_handoff": dst["pending_handoff"],
+    }
+    if dlive_rec["phases_ms"].get("stalled"):
+        problems.append(
+            "disaggregated: decode request shows stalled time despite "
+            "the burst landing on the prefill role"
+        )
+    if (
+        dlive_rec["tpot_ms"] is None
+        or live_rec["tpot_ms"] is None
+        or dlive_rec["tpot_ms"] >= live_rec["tpot_ms"]
+    ):
+        problems.append(
+            f"disaggregated decode TPOT {dlive_rec['tpot_ms']}ms did "
+            f"not beat the stalled unified TPOT {live_rec['tpot_ms']}ms"
+        )
+
+    # -- (2) stitching: one partition per id, handoff its own phase --
+    if not stitched:
+        problems.append("no stitched partition after adoption")
+    else:
+        rec = stitched[0]
+        if "handoff" not in rec["phases_ms"]:
+            problems.append(
+                f"stitched partition missing handoff phase: "
+                f"{rec['phases_ms']}"
+            )
+        for phase in ("queued", "prefill", "decode"):
+            if phase not in rec["phases_ms"]:
+                problems.append(
+                    f"stitched partition missing {phase!r}: "
+                    f"{rec['phases_ms']}"
+                )
+    ids = [r["id"] for r in dst["requests"]]
+    if len(ids) != len(set(ids)):
+        problems.append(f"duplicate request ids in one ledger: {ids}")
+
+    # conservation: every finished partition sums to its wall time
+    for st_ in (ust, dst):
+        worst = st_["conservation"]["worst_residual_ms"]
+        if abs(worst) > REQUEST_OBS_SMOKE_RESIDUAL_MAX_MS:
+            problems.append(
+                f"conservation residual {worst}ms exceeds the "
+                f"{REQUEST_OBS_SMOKE_RESIDUAL_MAX_MS}ms bound"
+            )
+
+    # -- (3) shared-prefix cached-token attribution ------------------
+    cached = [
+        r["cached_tokens"] for r in dst["requests"] if r["stitched"]
+    ]
+    if not any(cached):
+        problems.append(
+            "stitched shared-prefix request carries no cached-token "
+            "attribution"
+        )
+    leg = run_request_obs_leg()
+    out["prefix_attribution"] = {
+        "prefill_reduction": leg["prefill_reduction"],
+        "cached_tokens_attributed": leg["cached_tokens_attributed"],
+    }
+    if leg["cached_tokens_attributed"] <= 0:
+        problems.append(
+            "shared-prefix leg attributed zero cached tokens"
+        )
+
+    # -- (4) + (5) HTTP surfaces: endpoint contracts, lint, fleet ----
+    try:
+        code, _ = fetch(f"{targets['node0']}/debug/requests?slo=junk")
+        if code != 400:
+            problems.append(
+                f"/debug/requests?slo=junk returned {code}, want 400"
+            )
+        code, _ = fetch(f"{targets['node0']}/debug/requests?limit=x")
+        if code != 400:
+            problems.append(
+                f"/debug/requests?limit=x returned {code}, want 400"
+            )
+        code, body = fetch(f"{targets['node0']}/debug/requests?limit=2")
+        payload = json.loads(body)
+        if code != 200 or len(payload.get("requests", [])) > 2:
+            problems.append(
+                f"/debug/requests?limit=2 contract broken: code {code}"
+            )
+        for node, target in targets.items():
+            _, text = fetch(f"{target}/metrics")
+            text = text.decode()
+            problems.extend(
+                f"{node}: {p}" for p in lint_exposition(text)
+            )
+            for family in (
+                "elastic_tpu_request_ttft_seconds",
+                "elastic_tpu_request_tpot_seconds",
+                "elastic_tpu_request_phase_seconds",
+                "elastic_tpu_request_slo_attainment_ratio",
+            ):
+                if family not in text:
+                    problems.append(
+                        f"{node}: family {family} missing from "
+                        "exposition"
+                    )
+
+        agg = FleetAggregator(targets)
+        fleet = agg.fleet_slo()
+        out["fleet_slo"] = {
+            "classes": {
+                slo: {
+                    "ttft_observed": c["ttft_observed"],
+                    "attainment": c["attainment"],
+                }
+                for slo, c in fleet["fleet"]["classes"].items()
+            },
+            "nodes": fleet["nodes"],
+        }
+        # rollup == per-node ledgers: merged observation counts are the
+        # sums, and fleet attainment matches the ledgers' weighted mean
+        for slo in ("ttft", "batch", "tpot"):
+            fleet_cls = fleet["fleet"]["classes"].get(slo)
+            node_total = sum(
+                n["classes"].get(slo, {}).get("ttft_observed", 0)
+                for n in fleet["per_node"].values()
+            )
+            if fleet_cls is None:
+                if node_total:
+                    problems.append(
+                        f"fleet_slo dropped class {slo!r} with "
+                        f"{node_total} node observations"
+                    )
+                continue
+            if fleet_cls["ttft_observed"] != node_total:
+                problems.append(
+                    f"fleet_slo {slo}: merged {fleet_cls['ttft_observed']} "
+                    f"observations != per-node sum {node_total}"
+                )
+        att_fleet = fleet["fleet"]["classes"]["ttft"]["attainment"]
+        n_a, n_b = (
+            o._class_finished["ttft"] for o in (uobs, dobs)
+        )
+        att_ledger = (
+            uobs._class_attained["ttft"] + dobs._class_attained["ttft"]
+        ) / max(1, n_a + n_b)
+        if att_fleet is None or abs(att_fleet - att_ledger) > 1e-3:
+            problems.append(
+                f"fleet ttft attainment {att_fleet} != per-node "
+                f"ledger rollup {round(att_ledger, 4)}"
+            )
+    finally:
+        for httpd in servers:
+            httpd.shutdown()
+            httpd.server_close()
+
+    print(json.dumps({"request_obs_smoke": out, "problems": problems}))
+    if problems:
+        for p in problems:
+            print(f"request-obs smoke FAILED: {p}", file=sys.stderr)
+        return 1
+    print("request-obs smoke: OK", file=sys.stderr)
+    return 0
+
+
 # -- QoS co-location smoke (ISSUE 12): live re-partitioning + the split ------
 #
 # CPU-deterministic (the PR 6 contract: emits {"skipped"/"failed"} when
@@ -3978,6 +4339,14 @@ def main():
             "reason": f"qos repartition leg failed: "
                       f"{type(e).__name__}: {e}",
         }
+    try:
+        request_obs = run_request_obs_leg()
+    except Exception as e:  # noqa: BLE001 - surfaced, not silence
+        request_obs = {
+            "skipped": True,
+            "reason": f"request obs leg failed: "
+                      f"{type(e).__name__}: {e}",
+        }
     tpu = run_tpu_throughput()
     # QoS co-location only makes sense when the chip is reachable at
     # all (its children would just burn the same init timeout)
@@ -4051,6 +4420,12 @@ def main():
             # controller loop end to end — present every round even
             # when the chip legs skip.
             "qos_repartition": qos_repartition,
+            # Request observatory round trip: shared-prefix serving
+            # with per-request cached-vs-computed attribution, the
+            # per-class SLO ledger, and the conservation check; the
+            # prefill_reduction ratio here is perf-gate-tracked
+            # (bench_history.TRACKED_RATIOS).
+            "request_obs": request_obs,
             "tpu": tpu,
             "qos_colocation": qos,
         },
@@ -4081,6 +4456,8 @@ if __name__ == "__main__":
         sys.exit(timeline_smoke_main())
     elif "--serving-smoke" in sys.argv:
         sys.exit(serving_smoke_main())
+    elif "--request-obs-smoke" in sys.argv:
+        sys.exit(request_obs_smoke_main())
     elif "--qos-smoke" in sys.argv:
         sys.exit(qos_smoke_main())
     elif "--latency-smoke" in sys.argv:

@@ -40,8 +40,22 @@ TRACKED: Tuple[Tuple[str, Tuple[str, ...]], ...] = (
     ("bind_p99_ms", ("parsed", "extra", "ours", "bind_p99_ms")),
 )
 
+# Serving-plane series: HIGHER-is-better ratios (prefix-cache prefill
+# reduction, live-repartition speedup). These legs entered the bench
+# later than the bind legs, so rounds without the leaf contribute no
+# point — the gate never retro-fails old history — but once a leg
+# publishes, a collapse in its ratio trips the gate exactly like a
+# bind-latency regression does.
+TRACKED_RATIOS: Tuple[Tuple[str, Tuple[str, ...]], ...] = (
+    ("serving_prefill_reduction",
+     ("parsed", "extra", "request_obs", "prefill_reduction")),
+    ("qos_live_speedup",
+     ("parsed", "extra", "qos_repartition", "live_speedup")),
+)
+
 DEFAULT_TOLERANCE = 0.5   # +50% over the rolling-median baseline
 DEFAULT_FLOOR_MS = 0.25   # plus absolute slack: sub-ms jitter never trips
+DEFAULT_FLOOR_RATIO = 0.05  # ratio-series absolute slack (unitless)
 DEFAULT_WINDOW = 3        # baseline = median of this many prior rounds
 MIN_ROUNDS = 2            # one round has no trajectory to regress against
 
@@ -147,13 +161,16 @@ def validate_history(rounds: List[dict]) -> List[str]:
     return problems
 
 
-def series(rounds: List[dict]) -> Dict[str, List[Tuple[int, float]]]:
+def series(
+    rounds: List[dict],
+    tracked: Tuple[Tuple[str, Tuple[str, ...]], ...] = TRACKED,
+) -> Dict[str, List[Tuple[int, float]]]:
     """Per-leg time series: tracked metric name -> [(round n, value)].
     Rounds missing a metric simply contribute no point (the gate
     judges the series that exist)."""
     out: Dict[str, List[Tuple[int, float]]] = {}
     for r in rounds:
-        for name, path in TRACKED:
+        for name, path in tracked:
             value = _dig(r["data"], path)
             if isinstance(value, (int, float)) and not isinstance(
                 value, bool
@@ -188,6 +205,23 @@ def perf_gate(
                 f"(baseline median {baseline:.3f}ms over last "
                 f"{len(prior)} round(s), tolerance +{tolerance:.0%} "
                 f"+ {floor_ms}ms)"
+            )
+    # serving ratio series: inverted trip (a COLLAPSED ratio is the
+    # regression), same rolling-median baseline
+    for name, points in sorted(series(rounds, TRACKED_RATIOS).items()):
+        if len(points) < MIN_ROUNDS:
+            continue
+        n, latest = points[-1]
+        prior = [v for _, v in points[:-1]][-max(1, window):]
+        baseline = statistics.median(prior)
+        limit = baseline * (1.0 - tolerance) - DEFAULT_FLOOR_RATIO
+        if latest < limit:
+            problems.append(
+                f"REGRESSION {name}: round {n} measured {latest:.3f}x "
+                f"< {limit:.3f}x allowed "
+                f"(baseline median {baseline:.3f}x over last "
+                f"{len(prior)} round(s), tolerance -{tolerance:.0%} "
+                f"- {DEFAULT_FLOOR_RATIO})"
             )
     return problems
 
@@ -227,4 +261,56 @@ def self_test(
                 f"self-test: seeded {factor:.1f}x regression on {name} "
                 "did NOT trip the gate"
             )
+    problems.extend(ratio_self_test(
+        rounds, tolerance=tolerance, window=window,
+    ))
     return problems
+
+
+def ratio_self_test(
+    rounds: List[dict],
+    tolerance: float = DEFAULT_TOLERANCE,
+    window: int = DEFAULT_WINDOW,
+) -> List[str]:
+    """Prove the inverted (higher-is-better) gate can fail too: seed a
+    collapsed serving ratio and assert it trips. Uses the committed
+    trajectory when it carries serving points; otherwise a synthetic
+    three-round trajectory — the committed history predates the
+    serving legs, and a gate whose failure mode is only provable on
+    future data is not yet a gate."""
+    name, path = TRACKED_RATIOS[0]  # serving_prefill_reduction
+    base = [r for r in rounds if isinstance(_dig(r["data"], path),
+                                            (int, float))]
+    if len(base) >= MIN_ROUNDS:
+        trajectory = base
+        seeded = copy.deepcopy(base[-1])
+        seeded["n"] = base[-1]["n"] + 1
+    else:
+        trajectory = []
+        for i, value in enumerate((4.0, 4.2, 4.1)):
+            data: dict = {}
+            node = data
+            for key in path[:-1]:
+                node = node.setdefault(key, {})
+            node[path[-1]] = value
+            trajectory.append({
+                "n": i + 1, "path": f"<synthetic-{i + 1}>",
+                "data": data,
+            })
+        seeded = copy.deepcopy(trajectory[-1])
+        seeded["n"] = trajectory[-1]["n"] + 1
+    seeded["path"] = "<seeded-ratio-regression>"
+    node = seeded["data"]
+    for key in path[:-1]:
+        node = node.setdefault(key, {})
+    collapsed = float(node[path[-1]]) * (1.0 - tolerance) / 4.0
+    node[path[-1]] = collapsed
+    tripped = perf_gate(
+        [*trajectory, seeded], tolerance=tolerance, window=window,
+    )
+    if not any(f"REGRESSION {name}" in p for p in tripped):
+        return [
+            f"self-test: seeded collapse of {name} to {collapsed:.3f}x "
+            "did NOT trip the gate"
+        ]
+    return []

@@ -611,7 +611,10 @@ def perf_gate_main(argv=None) -> int:
     problems.extend(bh.validate_history(rounds))
     if not problems:
         if args.series:
-            for name, points in sorted(bh.series(rounds).items()):
+            all_tracked = (*bh.TRACKED, *bh.TRACKED_RATIOS)
+            for name, points in sorted(
+                bh.series(rounds, all_tracked).items()
+            ):
                 path = " ".join(
                     f"r{n:02d}={v:.3f}" for n, v in points
                 )
@@ -629,7 +632,9 @@ def perf_gate_main(argv=None) -> int:
         for problem in problems:
             print(f"PERF-GATE: {problem}", file=sys.stderr)
         return 1
-    tracked = ", ".join(name for name, _ in bh.TRACKED)
+    tracked = ", ".join(
+        name for name, _ in (*bh.TRACKED, *bh.TRACKED_RATIOS)
+    )
     print(
         f"perf-gate OK: {len(rounds)} round(s), tracked [{tracked}]"
         + (" + self-test" if args.self_test else ""),

@@ -97,6 +97,9 @@ DEBUG_ROUTES = {
                       "+ per-loop detection lag (?top=)",
     "/debug/profile": "continuous sampling profiler: hottest stacks + "
                       "measured overhead (?top=)",
+    "/debug/requests": "request-level serving observatory: per-request "
+                       "partitions, SLO classes, step breakdown "
+                       "(?id=&slo=&limit=)",
 }
 
 
@@ -434,6 +437,20 @@ class AgentMetrics:
             max_series=max_pod_series,
             evicted=self.series_evicted,
         )
+        self.workload_ttft = BoundedLabeledGauge(
+            Gauge(
+                "elastic_tpu_workload_ttft_seconds",
+                "Median time-to-first-token a pod's flight recorder "
+                "published to its alloc-surface sidecar — the serving "
+                "latency the pod ACHIEVED, next to its tokens/s. Same "
+                "freshness rule as tokens/s: stale summaries drop the "
+                "series rather than freeze it.",
+                ["pod"],
+                **kw,
+            ),
+            max_series=max_pod_series,
+            evicted=self.series_evicted,
+        )
         self.drain_early_reclaims = Counter(
             "elastic_tpu_drain_early_reclaims_total",
             "Draining residents reclaimed BEFORE the deadline because "
@@ -557,6 +574,67 @@ class AgentMetrics:
             "elastic_tpu_serving_pool_adopted_tokens",
             "Prompt tokens adopted from shared-pool blocks another role "
             "prefilled (engine-lifetime count)",
+            **kw,
+        )
+        # Speculative decoding + MoE routing (workloads/speculative.py,
+        # workloads/moe.py): the bench-only workloads joining the
+        # observability plane. Absent blocks read as 0 — a plain engine
+        # needs no shape change.
+        self.serving_spec_drafted = Gauge(
+            "elastic_tpu_serving_spec_drafted_tokens",
+            "Draft-model tokens proposed by the speculative decode "
+            "loop (engine-lifetime count; 0 when speculation is off)",
+            **kw,
+        )
+        self.serving_spec_accepted = Gauge(
+            "elastic_tpu_serving_spec_accepted_tokens",
+            "Drafted tokens that survived target-model verification "
+            "(engine-lifetime count)",
+            **kw,
+        )
+        self.serving_spec_acceptance_rate = Gauge(
+            "elastic_tpu_serving_spec_acceptance_rate",
+            "accepted/drafted for the speculative decode loop — a "
+            "falling rate means the draft model stopped predicting the "
+            "target and the speedup is gone",
+            **kw,
+        )
+        self.serving_moe_imbalance = Gauge(
+            "elastic_tpu_serving_moe_expert_imbalance",
+            "max/mean expert load of the attached MoE router's observed "
+            "routing (1.0 = perfectly balanced; capacity overflow drops "
+            "rise with it)",
+            **kw,
+        )
+        self.serving_moe_dropped = Gauge(
+            "elastic_tpu_serving_moe_dropped_tokens",
+            "Tokens dropped by MoE expert-capacity overflow (observed-"
+            "lifetime count)",
+            **kw,
+        )
+        # -- request-level serving observatory (workloads/request_obs.py) --
+        # Gauges read at scrape via attach_requests; the TTFT/TPOT/phase
+        # histograms live with the other histograms below and are
+        # observed at source on request finish.
+        self.requests_live = Gauge(
+            "elastic_tpu_requests_live",
+            "Requests currently holding a slot on an attached serving "
+            "engine (open partitions, pending handoffs excluded)",
+            **kw,
+        )
+        self.requests_pending_handoff = Gauge(
+            "elastic_tpu_requests_pending_handoff",
+            "Disaggregated requests published by a prefill role and not "
+            "yet adopted by a decode role — a growing value means the "
+            "decode side stopped draining the handoff registry",
+            **kw,
+        )
+        self.request_slo_attainment = Gauge(
+            "elastic_tpu_request_slo_attainment_ratio",
+            "Fraction of finished requests in an SLO class that met "
+            "their target (ttft<=target, tpot<=target, batch=finished); "
+            "-1 until the class has finished requests",
+            ["slo"],
             **kw,
         )
         # -- self-memory accounting (ROADMAP item 1: bounded memory at
@@ -756,6 +834,33 @@ class AgentMetrics:
             buckets=_BUCKETS,
             **kw,
         )
+        self.request_ttft = Histogram(
+            "elastic_tpu_request_ttft_seconds",
+            "Measured time-to-first-token per finished serving request, "
+            "labeled by SLO class (fixed vocabulary ttft|tpot|batch — "
+            "junk annotations coerce to batch, never mint labels). For "
+            "stitched disaggregated requests this spans the handoff.",
+            ["slo"],
+            buckets=_BUCKETS,
+            **kw,
+        )
+        self.request_tpot = Histogram(
+            "elastic_tpu_request_tpot_seconds",
+            "Mean per-token decode interval per finished serving "
+            "request (>=2 tokens), labeled by SLO class",
+            ["slo"],
+            buckets=_BUCKETS,
+            **kw,
+        )
+        self.request_phase_seconds = Histogram(
+            "elastic_tpu_request_phase_seconds",
+            "Per-request time attributed per partition phase "
+            "(queued|prefill|decode|stalled|handoff); the per-request "
+            "conservation residual is served at /debug/requests",
+            ["phase"],
+            buckets=_BUCKETS,
+            **kw,
+        )
         self.detection_lag = Histogram(
             "elastic_tpu_detection_lag_seconds",
             "Divergence origin -> detection/repair latency per polled "
@@ -811,6 +916,7 @@ class AgentMetrics:
         self._latency = None
         self._lag = None
         self._profiler = None
+        self._requests = None
         self._httpd: Optional[ThreadingHTTPServer] = None
 
     def attach_sampler(self, sampler) -> None:
@@ -891,6 +997,55 @@ class AgentMetrics:
         self.serving_pool_adopted_tokens.set_function(
             read("shared_pool", "adopted_tokens")
         )
+        # Speculative + MoE blocks appear in stats() only when the
+        # engine runs those workloads; read() yields 0 otherwise.
+        self.serving_spec_drafted.set_function(
+            read("speculative", "drafted_tokens")
+        )
+        self.serving_spec_accepted.set_function(
+            read("speculative", "accepted_tokens")
+        )
+        self.serving_spec_acceptance_rate.set_function(
+            read("speculative", "acceptance_rate")
+        )
+        self.serving_moe_imbalance.set_function(
+            read("moe", "imbalance")
+        )
+        self.serving_moe_dropped.set_function(
+            read("moe", "dropped_tokens")
+        )
+
+    def attach_requests(self, observatory) -> None:
+        """Wire a RequestObservatory (workloads/request_obs.py) both
+        ways: the observatory observes its TTFT/TPOT/phase histograms
+        at source through us, and /debug/requests plus the request
+        gauges read its ledgers at scrape. 503 until attached, like
+        the other late-bound debug surfaces."""
+        self._requests = observatory
+        observatory.bind_metrics(self)
+
+        self.requests_live.set_function(
+            lambda: float(observatory.live_count)
+        )
+        self.requests_pending_handoff.set_function(
+            lambda: float(observatory.pending_handoff_count)
+        )
+
+        def attain(slo):
+            def _read() -> float:
+                try:
+                    v = observatory.attainment(slo)
+                    return -1.0 if v is None else float(v)
+                except Exception:  # noqa: BLE001 - scrape never breaks
+                    return -1.0
+            return _read
+
+        from .workloads.request_obs import SLO_CLASSES
+
+        for slo in SLO_CLASSES:
+            self.request_slo_attainment.labels(slo=slo).set_function(
+                attain(slo)
+            )
 
     def attach_storage(self, storage) -> None:
         """Export the checkpoint store's write/commit counters (group-
@@ -1211,6 +1366,50 @@ class AgentMetrics:
                                 )
                                 return
                         self._reply_json(profiler.status(top=top))
+                    elif parsed.path == "/debug/requests":
+                        if not self._require_loopback():
+                            return
+                        observatory = agent_metrics._requests
+                        if observatory is None:
+                            self._reply_json(
+                                {"error": "request observatory not "
+                                          "attached (agent starting)"},
+                                code=503,
+                            )
+                            return
+                        q = parse_qs(parsed.query)
+                        rid = None
+                        limit = None
+                        for name in ("id", "limit"):
+                            if q.get(name):
+                                try:
+                                    val = max(0, int(q[name][0]))
+                                except ValueError:
+                                    self._reply_json(
+                                        {"error": f"{name} must be "
+                                                  "an integer"},
+                                        code=400,
+                                    )
+                                    return
+                                if name == "id":
+                                    rid = val
+                                else:
+                                    limit = val
+                        slo = q.get("slo", [None])[0]
+                        if slo is not None:
+                            from .workloads.request_obs import (
+                                SLO_CLASSES,
+                            )
+                            if slo not in SLO_CLASSES:
+                                self._reply_json(
+                                    {"error": "slo must be one of "
+                                              + "|".join(SLO_CLASSES)},
+                                    code=400,
+                                )
+                                return
+                        self._reply_json(observatory.status(
+                            request_id=rid, slo=slo, limit=limit,
+                        ))
                     elif parsed.path in ("/debug", "/debug/"):
                         if not self._require_loopback():
                             return

@@ -482,6 +482,7 @@ class UtilizationSampler:
         for key, pod in grants.items():
             best_ts = None
             best = None
+            best_ttft = None
             for alloc_hash in pod["hashes"]:
                 path = os.path.join(flight_dir, f"{alloc_hash}.json")
                 try:
@@ -499,8 +500,21 @@ class UtilizationSampler:
                     continue
                 if best_ts is None or ts > best_ts:
                     best_ts, best = ts, rate
+                    # serving pods ride their median TTFT along; it
+                    # inherits the SAME freshness verdict as the rate
+                    ttft = summary.get("ttft_p50_s")
+                    try:
+                        best_ttft = (
+                            float(ttft)
+                            if ttft is not None and float(ttft) >= 0
+                            else None
+                        )
+                    except (ValueError, TypeError):
+                        best_ttft = None
             if best is not None:
                 pod["tokens_per_s"] = best
+                if best_ttft is not None:
+                    pod["ttft_p50_s"] = best_ttft
 
     # -- attribution + overcommit ---------------------------------------------
 
@@ -677,6 +691,13 @@ class UtilizationSampler:
                         # away rather than freezing a dead workload's
                         # last rate on the scrape
                         m.workload_tokens_per_s.remove(pod=key)
+                if hasattr(m, "workload_ttft"):
+                    # same stale-summary drop rule as tokens/s: the
+                    # TTFT series exists only while summaries are fresh
+                    if pod.get("ttft_p50_s") is not None:
+                        m.workload_ttft.set(pod["ttft_p50_s"], pod=key)
+                    elif hasattr(m.workload_ttft, "remove"):
+                        m.workload_ttft.remove(pod=key)
         except Exception:  # noqa: BLE001 - metrics must never break sampling
             logger.exception("sampler metrics export failed")
 
@@ -686,6 +707,7 @@ class UtilizationSampler:
             return
         for gauge_name in (
             "pod_core_granted", "pod_core_used", "workload_tokens_per_s",
+            "workload_ttft",
         ):
             gauge = getattr(m, gauge_name, None)
             if gauge is not None and hasattr(gauge, "remove"):
@@ -827,6 +849,7 @@ class UtilizationSampler:
                 "granted_core_percent": pod["granted_percent"],
                 "used_core_percent": pod.get("used_percent"),
                 "tokens_per_s": pod.get("tokens_per_s"),
+                "ttft_p50_s": pod.get("ttft_p50_s"),
                 "hbm_granted_bytes": pod["hbm_granted_bytes"],
                 "overcommit": pod.get("overcommit", False),
                 "last_trace_id": pod.get("last_trace_id", ""),
@@ -1086,6 +1109,7 @@ def build_diagnostics_bundle(
             for key, path in (
                 ("latency", "/debug/latency"),
                 ("profile", "/debug/profile"),
+                ("requests", "/debug/requests"),
             ):
                 try:
                     bundle[key] = _fetch_json(
@@ -1261,6 +1285,29 @@ def validate_bundle(bundle: dict) -> List[str]:
                         expect(field in sp,
                                "allocations.serving.shared_pool "
                                f"missing {field!r}")
+            if "speculative" in serving:
+                # present only when the engine runs a draft model
+                spec = serving["speculative"]
+                expect(isinstance(spec, dict),
+                       "allocations.serving.speculative must be an "
+                       "object")
+                if isinstance(spec, dict):
+                    for field in ("rounds", "drafted_tokens",
+                                  "accepted_tokens", "rejected_tokens"):
+                        expect(field in spec,
+                               "allocations.serving.speculative "
+                               f"missing {field!r}")
+            if "moe" in serving:
+                # present only when MoE routing stats are attached
+                moe = serving["moe"]
+                expect(isinstance(moe, dict),
+                       "allocations.serving.moe must be an object")
+                if isinstance(moe, dict):
+                    for field in ("tokens_routed", "dropped_tokens",
+                                  "imbalance"):
+                        expect(field in moe,
+                               "allocations.serving.moe "
+                               f"missing {field!r}")
     if isinstance(allocations, dict) and "repartition" in allocations:
         # absent in pre-repartition bundles and when no controller is
         # attached (sampler disabled / standalone node-doctor)
@@ -1389,6 +1436,40 @@ def validate_bundle(bundle: dict) -> List[str]:
                         expect(field in ph,
                                f"latency.bind.phases[{pname!r}] missing "
                                f"{field!r}")
+    if "requests" in bundle:  # absent in pre-request-observatory bundles
+        requests = bundle["requests"]
+        expect(isinstance(requests, dict), "requests must be an object")
+        # A 503 from a just-started agent is captured verbatim as
+        # {"error": ...} — a valid (if empty-handed) block.
+        if isinstance(requests, dict) and "classes" in requests:
+            for field in ("live", "finished", "classes", "phases",
+                          "conservation", "steps"):
+                expect(field in requests,
+                       f"requests missing {field!r}")
+            classes = requests.get("classes")
+            expect(isinstance(classes, dict),
+                   "requests.classes must be an object")
+            for cname, cls in (
+                classes.items() if isinstance(classes, dict) else []
+            ):
+                if not isinstance(cls, dict):
+                    problems.append(
+                        f"requests.classes[{cname!r}] must be an object"
+                    )
+                    continue
+                for field in ("finished", "attained", "attainment"):
+                    expect(field in cls,
+                           f"requests.classes[{cname!r}] missing "
+                           f"{field!r}")
+            conservation = requests.get("conservation")
+            if isinstance(conservation, dict):
+                for field in ("checked", "worst_residual_ms"):
+                    expect(field in conservation,
+                           f"requests.conservation missing {field!r}")
+            else:
+                problems.append(
+                    "requests.conservation must be an object"
+                )
     if "profile" in bundle:  # absent in pre-profiler bundles
         profile = bundle["profile"]
         expect(isinstance(profile, dict), "profile must be an object")

@@ -487,6 +487,135 @@ class FleetAggregator:
             "open_marks": open_marks,
         }
 
+    # -- request-level SLO (workloads/request_obs.py) -------------------------
+
+    def node_requests(self, node: str) -> dict:
+        """One node's /debug/requests payload: the request observatory's
+        per-class ledgers, phase breakdown, and conservation check."""
+        return json.loads(
+            self._get(f"{self.targets[node]}/debug/requests")
+        )
+
+    def fleet_slo(
+        self, targets: Optional[Dict[str, Dict[str, float]]] = None
+    ) -> dict:
+        """Fleet TTFT/TPOT percentiles and SLO attainment per class,
+        merged from every node's bounded request histograms — the SLI
+        the gateway PR routes against, living beside fleet_goodput.
+
+        Node histograms merge exactly (cumulative le -> count buckets
+        sum across nodes), so with one node the fleet numbers EQUAL the
+        node's own exposition — the equality the request-obs smoke
+        pins. Attainment per class is the cumulative bucket count at
+        the class target divided by total observations; targets default
+        to the observatory's (deliberately placed on bucket bounds so
+        this division is exact, not interpolated). ``batch`` has no
+        latency target — it attains by finishing."""
+        from ..workloads.request_obs import (
+            DEFAULT_SLO_TARGETS, SLO_CLASSES,
+        )
+
+        targets = targets or DEFAULT_SLO_TARGETS
+        scrapes: Dict[str, NodeScrape] = {}
+        unreachable = []
+        for node in sorted(self.targets):
+            try:
+                scrapes[node] = self.scrape_node(node)
+            except Exception:  # noqa: BLE001 - dead node: skip
+                unreachable.append(node)
+
+        def slo_buckets(
+            scrape: NodeScrape, name: str, slo: str
+        ) -> Dict[float, float]:
+            # NodeScrape.buckets() ignores non-le labels, which would
+            # sum the SLO classes together — filter by hand instead
+            out: Dict[float, float] = {}
+            for labels, value in scrape.samples.get(
+                f"{name}_bucket", []
+            ):
+                if labels.get("slo") == slo and "le" in labels:
+                    le = _parse_le(labels["le"])
+                    out[le] = out.get(le, 0.0) + value
+            return out
+
+        def merge(name: str, slo: str) -> Dict[float, float]:
+            merged: Dict[float, float] = {}
+            for scrape in scrapes.values():
+                for le, count in slo_buckets(scrape, name, slo).items():
+                    merged[le] = merged.get(le, 0.0) + count
+            return merged
+
+        def total(buckets: Dict[float, float]) -> float:
+            return max(buckets.values()) if buckets else 0.0
+
+        def attained_ratio(
+            buckets: Dict[float, float], target: float
+        ) -> Optional[float]:
+            n = total(buckets)
+            if n <= 0:
+                return None
+            # cumulative count at the largest bound <= target: exact
+            # when the target sits on a bound (the default targets do)
+            eligible = [le for le in buckets if le <= target]
+            if not eligible:
+                return 0.0
+            return round(buckets[max(eligible)] / n, 4)
+
+        classes = {}
+        for slo in SLO_CLASSES:
+            ttft = merge("elastic_tpu_request_ttft_seconds", slo)
+            tpot = merge("elastic_tpu_request_tpot_seconds", slo)
+            if not ttft and not tpot:
+                continue
+            tgt = targets.get(slo, {})
+            if "ttft_s" in tgt:
+                attainment = attained_ratio(ttft, tgt["ttft_s"])
+            elif "tpot_s" in tgt:
+                attainment = attained_ratio(tpot, tgt["tpot_s"])
+            else:
+                attainment = 1.0 if total(ttft) > 0 else None
+            classes[slo] = {
+                "ttft_observed": int(total(ttft)),
+                "tpot_observed": int(total(tpot)),
+                "ttft_p50_s": histogram_quantile(ttft, 0.5),
+                "ttft_p99_s": histogram_quantile(ttft, 0.99),
+                "tpot_p50_s": histogram_quantile(tpot, 0.5),
+                "tpot_p99_s": histogram_quantile(tpot, 0.99),
+                "attainment": attainment,
+                "target": dict(tgt),
+            }
+        per_node = {}
+        for node, scrape in scrapes.items():
+            node_classes = {}
+            for slo in SLO_CLASSES:
+                att = scrape.value(
+                    "elastic_tpu_request_slo_attainment_ratio",
+                    {"slo": slo}, default=-1.0,
+                )
+                count = scrape.value(
+                    "elastic_tpu_request_ttft_seconds_count",
+                    {"slo": slo}, default=0.0,
+                )
+                if att < 0 and count <= 0:
+                    continue
+                node_classes[slo] = {
+                    "attainment": att if att >= 0 else None,
+                    "ttft_observed": int(count),
+                }
+            per_node[node] = {
+                "live": scrape.value("elastic_tpu_requests_live"),
+                "pending_handoff": scrape.value(
+                    "elastic_tpu_requests_pending_handoff"
+                ),
+                "classes": node_classes,
+            }
+        return {
+            "nodes": sorted(scrapes),
+            "unreachable": unreachable,
+            "fleet": {"classes": classes},
+            "per_node": per_node,
+        }
+
     # -- trace continuity -----------------------------------------------------
 
     def trace_lookup(self, trace_id: str) -> List[dict]:

@@ -23,10 +23,11 @@ expert-parallel workload to schedule.
 from __future__ import annotations
 
 import math
-from typing import Dict, Tuple
+from typing import Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from .quantize import wdense
@@ -62,6 +63,81 @@ def expert_capacity(
     n_tokens: int, n_experts: int, capacity_factor: float
 ) -> int:
     return max(1, math.ceil(n_tokens * capacity_factor / n_experts))
+
+
+class MoeRoutingStats:
+    """Host-side routing observability for the MoE layer.
+
+    ``moe_mlp`` is a pure function on the compiled path, so routing
+    counters cannot live inside it without polluting the jaxpr.
+    Instead, callers hand each batch to ``observe()`` which re-runs the
+    (cheap, fp32, host-side) top-1 router on the SAME inputs and
+    accumulates expert load, capacity-overflow drops, and the load
+    imbalance — the ledger ``ServingEngine.stats()['moe']`` and the
+    ``elastic_tpu_serving_moe_*`` gauges read. Attach an instance as
+    ``engine.moe_stats`` (or call directly from a bench loop).
+    """
+
+    def __init__(self) -> None:
+        self.batches = 0
+        self.tokens_routed = 0
+        self.dropped_tokens = 0
+        self._expert_load: Optional[np.ndarray] = None
+        self._aux_loss_sum = 0.0
+
+    def observe(
+        self,
+        x: jax.Array,
+        params: Dict,
+        capacity_factor: float,
+        aux_loss: Optional[float] = None,
+    ) -> None:
+        """Recompute the top-1 routing decision for one batch [b, s, d]
+        (or [t, d]) and fold it into the ledgers."""
+        xt = np.asarray(x, dtype=np.float32)
+        if xt.ndim == 3:
+            xt = xt.reshape(-1, xt.shape[-1])
+        wg = np.asarray(params["wg"], dtype=np.float32)
+        n_experts = wg.shape[1]
+        t = xt.shape[0]
+        cap = expert_capacity(t, n_experts, capacity_factor)
+        logits = xt @ wg
+        expert_index = np.argmax(logits, axis=-1)
+        load = np.bincount(expert_index, minlength=n_experts)
+        if self._expert_load is None:
+            self._expert_load = np.zeros(n_experts, dtype=np.int64)
+        self._expert_load[: len(load)] += load
+        self.batches += 1
+        self.tokens_routed += t
+        self.dropped_tokens += int(np.maximum(load - cap, 0).sum())
+        if aux_loss is not None:
+            self._aux_loss_sum += float(aux_loss)
+
+    def stats(self) -> Dict:
+        load = self._expert_load
+        imbalance = None
+        if load is not None and load.sum() > 0:
+            imbalance = float(load.max() / max(load.mean(), 1e-9))
+        return {
+            "experts": 0 if load is None else int(len(load)),
+            "batches": self.batches,
+            "tokens_routed": self.tokens_routed,
+            "dropped_tokens": self.dropped_tokens,
+            "drop_rate": (
+                round(self.dropped_tokens / self.tokens_routed, 4)
+                if self.tokens_routed else None
+            ),
+            "imbalance": (
+                round(imbalance, 4) if imbalance is not None else None
+            ),
+            "expert_load": (
+                [] if load is None else [int(v) for v in load]
+            ),
+            "aux_loss_mean": (
+                round(self._aux_loss_sum / self.batches, 4)
+                if self.batches else None
+            ),
+        }
 
 
 def moe_mlp(

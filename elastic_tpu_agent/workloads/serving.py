@@ -45,6 +45,7 @@ from __future__ import annotations
 import functools
 import math
 import time
+from collections import OrderedDict
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -215,6 +216,46 @@ class SharedKVPool:
         # prefill->decode handoffs.
         self.adoptions = 0
         self.adopted_tokens = 0
+        # Cross-role request STITCHING (request_obs.py): a prefill-role
+        # engine publishes its observatory record here under the
+        # prompt's block-chain digests — the same keys the prefix cache
+        # uses — and the decode-role engine adopts it at the auto-cache
+        # hit that IS the handoff, so one request id spans both roles.
+        # Bounded: un-adopted publications age out LRU (the observatory
+        # separately closes their partitions as handoff_expired).
+        self._request_registry: "OrderedDict[bytes, object]" = (
+            OrderedDict()
+        )
+        self.max_registry_digests = 1024
+        self.published_requests = 0
+        self.adopted_requests = 0
+
+    def publish_request(self, digests, record) -> None:
+        """Publish a prefill-role request's observatory record under
+        every digest of its block chain (the decode side may cover a
+        shorter prefix than the publisher wrote, so any chain point
+        must adopt)."""
+        if not digests:
+            return
+        for d in digests:
+            self._request_registry[d] = record
+            self._request_registry.move_to_end(d)
+        self.published_requests += 1
+        while len(self._request_registry) > self.max_registry_digests:
+            self._request_registry.popitem(last=False)
+
+    def adopt_request(self, digest):
+        """Claim (and remove) the published record whose chain contains
+        ``digest``; a publication is adopted at most once."""
+        rec = self._request_registry.get(digest)
+        if rec is None:
+            return None
+        for d in [
+            k for k, v in self._request_registry.items() if v is rec
+        ]:
+            del self._request_registry[d]
+        self.adopted_requests += 1
+        return rec
 
     def compatible_with(self, cfg: ModelConfig) -> bool:
         return (
@@ -234,6 +275,8 @@ class SharedKVPool:
             "block_size": self.block_size,
             "adoptions": self.adoptions,
             "adopted_tokens": self.adopted_tokens,
+            "published_requests": self.published_requests,
+            "adopted_requests": self.adopted_requests,
             "prefix_cache": self.prefix_cache.stats(),
         }
 
@@ -269,6 +312,8 @@ def disaggregated_status(prefill: "ServingEngine",
         "shared_pool": {
             "adoptions": pool.adoptions,
             "adopted_tokens": pool.adopted_tokens,
+            "published_requests": pool.published_requests,
+            "adopted_requests": pool.adopted_requests,
         },
         "roles": {
             "prefill": {
@@ -401,6 +446,7 @@ class ServingEngine:
         role: str = "both",
         pool: Optional[SharedKVPool] = None,
         lifecycle=None,
+        observatory=None,
     ):
         # optional flight recorder (workloads/telemetry.py): every
         # admit/step emits a JSONL record tagged with the agent's
@@ -412,6 +458,23 @@ class ServingEngine:
         # are refused so the serving loop can finish in-flight streams
         # and ack (lifecycle.drain_serving) before the chips go away
         self._lifecycle = lifecycle
+        # optional RequestObservatory (workloads/request_obs.py): every
+        # admission gets a request id and a gap-free phase partition
+        # (queued|prefill|decode|stalled|handoff), TTFT/TPOT per SLO
+        # class, and prefix-cache / KV-byte attribution. Share ONE
+        # observatory across a disaggregated pair so stitched
+        # partitions live in one ledger.
+        self._observatory = observatory
+        if (
+            observatory is not None
+            and recorder is not None
+            and observatory.recorder is None
+        ):
+            observatory.recorder = recorder
+        self._obs_uid: Dict[int, int] = {}  # rid -> observatory uid
+        # requests force-finished for pool exhaustion (the observatory
+        # step breakdown reports these as evictions)
+        self._evictions_total = 0
         self.params = params
         self.cfg = cfg
         self.slots = slots
@@ -538,6 +601,15 @@ class ServingEngine:
         # over a shared pool these are prefill->decode handoffs.
         self.adoptions_total = 0
         self.adopted_tokens_total = 0
+        # Optional MoeRoutingStats (workloads/moe.py): engines serving
+        # MoE models can attach a host-side routing accumulator;
+        # stats() surfaces it so expert load/imbalance reach the
+        # serving gauges and the doctor bundle.
+        self.moe_stats = None
+        # speculative-mode accounting (populated by _step_speculative)
+        self.spec_rounds_total = 0
+        self.spec_drafted_total = 0
+        self.spec_accepted_total = 0
 
         self.kv_int8 = kv_int8
         if kv_int8 and draft_params is not None:
@@ -759,6 +831,21 @@ class ServingEngine:
     def used_blocks(self) -> int:
         return self._alloc.used
 
+    @property
+    def kv_block_bytes(self) -> int:
+        """HBM bytes one pool block holds across K+V and every layer
+        (int8 pools count their scales) — the unit of the observatory's
+        per-request KV occupancy attribution."""
+        pk = self._pool_k
+        if isinstance(pk, dict):
+            per = (
+                pk["q"].size * pk["q"].dtype.itemsize
+                + pk["s"].size * pk["s"].dtype.itemsize
+            )
+        else:
+            per = pk.size * pk.dtype.itemsize
+        return int(2 * per // max(1, self.pool_blocks))
+
     def stats(self) -> Dict:
         """Structured serving status: block-pool occupancy, prefill
         accounting and (when enabled) prefix-cache counters — the
@@ -789,7 +876,24 @@ class ServingEngine:
             out["shared_pool"] = {
                 "adoptions": self.shared_pool.adoptions,
                 "adopted_tokens": self.shared_pool.adopted_tokens,
+                "published_requests": self.shared_pool.published_requests,
+                "adopted_requests": self.shared_pool.adopted_requests,
             }
+        if self.draft_params is not None:
+            drafted = self.spec_drafted_total
+            out["speculative"] = {
+                "rounds": self.spec_rounds_total,
+                "gamma": self.gamma,
+                "drafted_tokens": drafted,
+                "accepted_tokens": self.spec_accepted_total,
+                "rejected_tokens": drafted - self.spec_accepted_total,
+                "acceptance_rate": (
+                    round(self.spec_accepted_total / drafted, 4)
+                    if drafted else None
+                ),
+            }
+        if self.moe_stats is not None:
+            out["moe"] = self.moe_stats.stats()
         return out
 
     # -- compiled programs -------------------------------------------
@@ -1107,6 +1211,13 @@ class ServingEngine:
         slot, seq, total = st["slot"], st["seq"], st["total"]
         bs = self.block_size
         start = st["next_pos"]
+        obs = self._observatory
+        ouid = self._obs_uid.get(rid)
+        if obs is not None and ouid is not None and start == st["start0"]:
+            # first chunk: the request leaves the queue — queued ends,
+            # prefill begins (and spans the inter-chunk waits until
+            # activation: that wait IS prefill latency to the client)
+            obs.prefill_start(ouid)
         chunk = np.zeros((bs,), np.int32)
         avail = min(bs, total - start)
         chunk[:avail] = seq[start:start + avail]
@@ -1147,6 +1258,15 @@ class ServingEngine:
         self._last = self._last.at[slot].set(first)
         self._slot_of[rid] = slot
         self._streams[rid] = [first]
+        if obs is not None and ouid is not None:
+            blocks = int(np.count_nonzero(self._table[slot]))
+            obs.prefill_done(
+                ouid, computed_tokens=total - st["start0"],
+                kv_blocks=blocks,
+                kv_bytes=blocks * self.kv_block_bytes,
+            )
+            if self.role != "prefill":
+                obs.first_token(ouid)
         if first in self._stop[rid]:
             self._finish(rid, "stop_token")
         elif self.role == "prefill":
@@ -1434,7 +1554,7 @@ class ServingEngine:
 
     def _claim_admission(
         self, prompt, prefix, temperature, top_k, top_p,
-        need_bucket: bool,
+        need_bucket: bool, slo: Optional[str] = None,
     ):
         """Shared admission control for admit() and enqueue():
         validate, claim a slot, resolve per-request sampling, and map
@@ -1553,12 +1673,39 @@ class ServingEngine:
                     # cross-role handoff accounting (SharedKVPool)
                     self.shared_pool.adoptions += 1
                     self.shared_pool.adopted_tokens += plen
+        # -- request observatory: the claim held, so the partition
+        # opens here. A decode-role auto hit over a shared pool first
+        # tries to ADOPT the record the prefill role published under
+        # the covered prefix's chain digest — that continues the SAME
+        # partition across the handoff instead of minting a new id.
+        ouid = None
+        obs = self._observatory
+        if obs is not None:
+            from .prefix_cache import chain_hashes
+
+            seq = np.concatenate([pref_tokens, prompt]).astype(np.int32)
+            digests = chain_hashes(seq, bs)
+            adopted = None
+            if auto_hit and self.shared_pool is not None and n_shared:
+                adopted = self.shared_pool.adopt_request(
+                    digests[n_shared - 1]
+                )
+            if adopted is not None:
+                ouid = obs.adopt(adopted, engine_key=id(self))
+            else:
+                ouid = obs.admit(id(self), slo=slo)
+            obs.prefill_done(
+                ouid,
+                cached_tokens=plen,
+                prefix_digest=digests[-1].hex() if digests else "",
+                chain_digests=tuple(digests),
+            )
         return dict(
             prompt=prompt, p=p, bucket=bucket,
             pref_blocks=pref_blocks, plen=plen,
             pref_tokens=pref_tokens, pref_padded=pref_padded,
             total=total, slot=slot, n_shared=n_shared,
-            temp=temp, tk=tk, tp=tp, auto_hit=auto_hit,
+            temp=temp, tk=tk, tp=tp, auto_hit=auto_hit, ouid=ouid,
         )
 
     def admit(
@@ -1569,6 +1716,7 @@ class ServingEngine:
         top_k: Optional[int] = None,
         top_p: Optional[float] = None,
         stop_tokens: Sequence[int] = (),
+        slo: Optional[str] = None,
     ) -> int:
         """Prefill a prompt (1-D int sequence) into a free slot;
         returns the request id. The first generated token is already in
@@ -1582,11 +1730,16 @@ class ServingEngine:
         program. ``stop_tokens``: emitting any of these auto-finishes
         the request in step() — the stop token IS appended to the
         stream (callers that want it hidden strip the tail), and the
-        slot frees without the caller polling."""
+        slot frees without the caller polling.
+
+        ``slo`` is the request-carried SLO-class annotation
+        ("ttft"|"tpot"|"batch", default batch) the request observatory
+        buckets TTFT/TPOT histograms by; it is accounting only and
+        never changes scheduling."""
         t0 = time.perf_counter() if self._recorder is not None else 0.0
         claim = self._claim_admission(
             prompt, prefix, temperature, top_k, top_p,
-            need_bucket=True,
+            need_bucket=True, slo=slo,
         )
         prompt, p, bucket = claim["prompt"], claim["p"], claim["bucket"]
         pref_blocks, plen = claim["pref_blocks"], claim["plen"]
@@ -1599,6 +1752,15 @@ class ServingEngine:
         temp, tk, tp = claim["temp"], claim["tk"], claim["tp"]
         bs = self.block_size
         nb_req = self._blocks_for(total + 1)
+
+        # synchronous prefill = the unified-mode head-of-line hazard:
+        # every live decode on this engine sits still until it lands.
+        # The observatory attributes that time to their ``stalled``
+        # phase (disaggregation exists to make this window vanish).
+        obs, ouid = self._observatory, claim["ouid"]
+        if obs is not None and ouid is not None:
+            obs.prefill_start(ouid)
+            obs.stall_begin(id(self))
 
         self._key, sub = jax.random.split(self._key)
         # sampling params ride in ONE traced f32 triple (top_k cast
@@ -1682,6 +1844,8 @@ class ServingEngine:
                     else pref_padded + bucket
                 ),
             )
+        if obs is not None and ouid is not None:
+            obs.stall_end(id(self))
         self._lengths = self._lengths.at[slot].set(total)
         self._host_len[slot] = total
         self._last = self._last.at[slot].set(first)
@@ -1690,6 +1854,18 @@ class ServingEngine:
         self._slot_of[rid] = slot
         self._streams[rid] = [int(first)]
         self._stop[rid] = frozenset(int(t) for t in stop_tokens)
+        if obs is not None and ouid is not None:
+            self._obs_uid[rid] = ouid
+            blocks = int(np.count_nonzero(self._table[slot]))
+            obs.prefill_done(
+                ouid, computed_tokens=p, kv_blocks=blocks,
+                kv_bytes=blocks * self.kv_block_bytes,
+            )
+            if self.role != "prefill":
+                # a prefill-role first token is a publication artifact,
+                # not the client-visible TTFT — the stitched record's
+                # decode side stamps that
+                obs.first_token(ouid)
         # the admission token itself may be a stop token
         if int(first) in self._stop[rid]:
             self._finish(rid, "stop_token")
@@ -1700,11 +1876,18 @@ class ServingEngine:
             # first token stays retrievable for the caller to compare).
             self._finish(rid, "prefilled")
         if self._recorder is not None:
+            from .request_obs import normalize_slo
+
             rec = dict(
                 rid=rid, prompt_len=p, prefix_len=plen, bucket=bucket,
                 duration_ms=round((time.perf_counter() - t0) * 1000, 3),
                 used_blocks=self.used_blocks,
+                # SLO class + observatory id: sidecar summaries join
+                # flight records against /debug/requests on these
+                slo=normalize_slo(slo),
             )
+            if ouid is not None:
+                rec["request_uid"] = ouid
             if claim["auto_hit"]:
                 rec["cached_tokens"] = plen
             if self._prefix_cache is not None:
@@ -1720,6 +1903,7 @@ class ServingEngine:
         top_k: Optional[int] = None,
         top_p: Optional[float] = None,
         stop_tokens: Sequence[int] = (),
+        slo: Optional[str] = None,
     ) -> int:
         """CHUNKED admission: claim a slot and blocks now, but run the
         prefill one block-sized chunk per step() — live decodes
@@ -1736,10 +1920,12 @@ class ServingEngine:
         why no tail copy exists on this path."""
         claim = self._claim_admission(
             prompt, prefix, temperature, top_k, top_p,
-            need_bucket=False,
+            need_bucket=False, slo=slo,
         )
         rid = self._next_rid
         self._next_rid += 1
+        if claim["ouid"] is not None:
+            self._obs_uid[rid] = claim["ouid"]
         self._stop[rid] = frozenset(int(t) for t in stop_tokens)
         self._pending.append(rid)
         self._pending_state[rid] = dict(
@@ -1766,12 +1952,16 @@ class ServingEngine:
         draft prefix + correction, so lists have variable length ≥ 1
         per step."""
         t0 = time.perf_counter() if self._recorder is not None else 0.0
+        obs = self._observatory
+        ot0 = obs.clock.monotonic() if obs is not None else 0.0
+        ev0 = self._evictions_total
         # one pending-prefill chunk per step (enqueue()): live decodes
         # never stall behind a long admission. A row activating here
         # SITS OUT this step's decode (it "settles"): its entry in the
         # returned dict is its activation token, never silently
         # overwritten by a same-step decode token.
         activated = self._pump_prefill() if self._pending else {}
+        ot1 = obs.clock.monotonic() if obs is not None else 0.0
         self._settling = {
             self._slot_of[r] for r in activated if r in self._slot_of
         }
@@ -1783,6 +1973,22 @@ class ServingEngine:
                 out = {**activated, **self._step_plain()}
         finally:
             self._settling = set()
+        if obs is not None:
+            ot2 = obs.clock.monotonic()
+            obs.step(
+                id(self),
+                live=len(self._slot_of),
+                slots=self.slots,
+                pending=len(self._pending),
+                activated=len(activated),
+                evicted=self._evictions_total - ev0,
+                emitted_tokens=sum(
+                    len(v) if isinstance(v, list) else 1
+                    for v in out.values()
+                ),
+                prefill_s=ot1 - ot0,
+                decode_s=ot2 - ot1,
+            )
         if self._recorder is not None:
             self._recorder.record(
                 "serving_step",
@@ -1858,6 +2064,10 @@ class ServingEngine:
             tok = int(toks[slot])
             self._streams[rid].append(tok)
             out[rid] = tok
+            if self._observatory is not None:
+                ouid = self._obs_uid.get(rid)
+                if ouid is not None:
+                    self._observatory.tokens_emitted(ouid, 1)
             # a row at max_len-1 can't take another write; a stop
             # token ends the stream without the caller polling
             if int(self._host_len[slot]) >= self.max_len - 1:
@@ -1933,10 +2143,15 @@ class ServingEngine:
         )
         committed = np.asarray(committed)
         n_emit = np.asarray(n_emit)
+        self.spec_rounds_total += 1
         out: Dict[int, List[int]] = {}
         for rid, slot in list(self._slot_of.items()):
             if slot in self._settling:
                 continue
+            # per-row speculative economics: gamma proposed, the
+            # committed prefix (n_emit - 1) survived verification
+            self.spec_drafted_total += g
+            self.spec_accepted_total += int(n_emit[slot]) - 1
             toks = committed[slot][: int(n_emit[slot])].tolist()
             self._host_len[slot] += int(n_emit[slot])
             # stop-token truncation: the stream ends AT the first
@@ -1960,6 +2175,30 @@ class ServingEngine:
         slot = self._slot_of.pop(rid)
         self._finished.add(rid)
         self.finish_reason[rid] = reason
+        if reason == "pool_exhausted":
+            self._evictions_total += 1
+        obs = self._observatory
+        ouid = self._obs_uid.pop(rid, None)
+        if obs is not None and ouid is not None:
+            # block count BEFORE _drop_row zeroes the table row
+            blocks = int(np.count_nonzero(self._table[slot]))
+            published = False
+            if reason == "prefilled" and self.shared_pool is not None:
+                # disaggregated handoff: keep the partition open (the
+                # handoff phase runs until a decode engine adopts the
+                # record off the shared pool's request registry)
+                rec = obs.handoff_begin(ouid)
+                if rec is not None and rec.chain_digests:
+                    self.shared_pool.publish_request(
+                        rec.chain_digests, rec
+                    )
+                    published = True
+            if not published:
+                obs.finish(
+                    ouid, reason,
+                    kv_blocks=blocks,
+                    kv_bytes=blocks * self.kv_block_bytes,
+                )
         self._drop_row(slot)
         self._free.append(slot)
         self._free.sort()
@@ -1980,6 +2219,9 @@ class ServingEngine:
         if rid in self._pending_state:
             st = self._pending_state.pop(rid)
             self._pending.remove(rid)
+            ouid = self._obs_uid.pop(rid, None)
+            if self._observatory is not None and ouid is not None:
+                self._observatory.finish(ouid, "cancelled")
             self._drop_row(st["slot"])
             self._free.append(st["slot"])
             self._free.sort()

@@ -52,6 +52,23 @@ class SpecStats(NamedTuple):
     drafted: jax.Array
     accepted: jax.Array
 
+    def stats(self) -> dict:
+        """Host-side observability summary (ServingEngine.stats()'s
+        'speculative' block uses the same shape): accepted/rejected
+        split plus the acceptance rate the spec gauges export."""
+        rounds = int(self.rounds)
+        drafted = int(self.drafted)
+        accepted = int(self.accepted)
+        return {
+            "rounds": rounds,
+            "drafted_tokens": drafted,
+            "accepted_tokens": accepted,
+            "rejected_tokens": drafted - accepted,
+            "acceptance_rate": (
+                round(accepted / drafted, 4) if drafted else None
+            ),
+        }
+
 
 def speculative_generate(
     params: Dict,

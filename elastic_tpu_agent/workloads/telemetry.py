@@ -267,6 +267,43 @@ class FlightRecorder:
                 out["prefix_cache_tokens_saved"] = sum(
                     r.get("cached_tokens", 0) for r in flagged
                 )
+        # request-level SLO rollup (RequestObservatory's request_finish
+        # records): TTFT/TPOT p50/p99 per SLO class, so a recorder file
+        # alone can answer "which class missed and by how much"
+        with self._lock:
+            finishes = [
+                r for r in self.records
+                if r.get("kind") == "request_finish"
+            ]
+        if finishes:
+            def q(vals, frac):
+                vs = sorted(vals)
+                idx = min(len(vs) - 1, int(round(frac * (len(vs) - 1))))
+                return round(vs[idx], 3)
+
+            classes = {}
+            for r in finishes:
+                classes.setdefault(r.get("slo", "batch"), []).append(r)
+            rollup = {}
+            for slo, recs in sorted(classes.items()):
+                entry = {"finished": len(recs)}
+                ttfts = [
+                    r["ttft_ms"] for r in recs
+                    if r.get("ttft_ms") is not None
+                ]
+                tpots = [
+                    r["tpot_ms"] for r in recs
+                    if r.get("tpot_ms") is not None
+                ]
+                if ttfts:
+                    entry["ttft_p50_ms"] = q(ttfts, 0.5)
+                    entry["ttft_p99_ms"] = q(ttfts, 0.99)
+                if tpots:
+                    entry["tpot_p50_ms"] = q(tpots, 0.5)
+                    entry["tpot_p99_ms"] = q(tpots, 0.99)
+                rollup[slo] = entry
+            out["request_finishes"] = len(finishes)
+            out["request_slo"] = rollup
         return out
 
     def close(self) -> None:
@@ -303,6 +340,7 @@ def write_flight_summary(
     tokens_per_s: float,
     steps: int = 0,
     mean_step_ms: Optional[float] = None,
+    ttft_p50_s: Optional[float] = None,
     ts: float = None,
 ) -> bool:
     """Publish a flight-recorder summary to the node agent.
@@ -329,6 +367,11 @@ def write_flight_summary(
         }
         if mean_step_ms is not None:
             payload["mean_step_ms"] = float(mean_step_ms)
+        if ttft_p50_s is not None:
+            # serving pods also publish their median TTFT; the sampler
+            # exports it as elastic_tpu_workload_ttft_seconds{pod}
+            # under the same staleness rule as tokens/s
+            payload["ttft_p50_s"] = float(ttft_p50_s)
         with open(tmp, "w") as f:
             json.dump(payload, f)
         os.replace(tmp, path)

@@ -1,0 +1,369 @@
+"""Request-level serving observatory (workloads/request_obs.py).
+
+The contract under test (ISSUE 17): every admission yields a gap-free
+phase partition where ``sum(phase_seconds) + residual == wall`` with
+residual ~0 — driven here under a ManualClock so every duration is
+exact arithmetic, under real engines through admit/evict/drain churn,
+and across a disaggregated handoff where one id must yield exactly ONE
+stitched partition. Cardinality stays bounded no matter what callers
+send (10k requests, junk SLO annotations), and /debug/requests holds
+its 503-before-attach / 400-on-junk contracts.
+"""
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from elastic_tpu_agent.common import ManualClock
+from elastic_tpu_agent.workloads.request_obs import (
+    DEFAULT_MAX_FINISHED,
+    PHASES,
+    SLO_CLASSES,
+    RequestObservatory,
+    normalize_slo,
+)
+
+
+# -- conservation under a manual clock ----------------------------------------
+
+
+def test_partition_is_gap_free_and_conserves_wall_time():
+    clock = ManualClock()
+    obs = RequestObservatory(clock=clock)
+    uid = obs.admit("eng", slo="ttft")
+    clock.advance(0.5)            # queued
+    obs.prefill_start(uid)
+    clock.advance(2.0)            # prefill
+    obs.first_token(uid)
+    clock.advance(1.0)            # decode
+    obs.stall_begin("eng")
+    clock.advance(4.0)            # stalled
+    obs.stall_end("eng")
+    clock.advance(1.5)            # decode again
+    obs.tokens_emitted(uid, 9)
+    rec = obs.finish(uid, "released")
+
+    assert rec.phase_seconds == {
+        "queued": 0.5, "prefill": 2.0, "decode": 2.5, "stalled": 4.0,
+    }
+    assert rec.wall_s == 9.0
+    assert rec.residual_s == 0.0  # exact: ManualClock arithmetic
+    assert sum(rec.phase_seconds.values()) + rec.residual_s == rec.wall_s
+    assert rec.ttft_s == 2.5
+    assert rec.tpot_s == pytest.approx(6.5 / 9)   # 10 tokens, 9 gaps
+    st = obs.status()
+    assert st["conservation"] == {
+        "checked": 1, "worst_residual_ms": 0.0,
+    }
+
+
+def test_stall_window_flips_only_decoding_requests_on_that_engine():
+    clock = ManualClock()
+    obs = RequestObservatory(clock=clock)
+    decoding = obs.admit("A")
+    obs.prefill_start(decoding)
+    obs.first_token(decoding)
+    prefilling = obs.admit("A")       # same engine, still in prefill
+    obs.prefill_start(prefilling)
+    elsewhere = obs.admit("B")        # different engine entirely
+    obs.prefill_start(elsewhere)
+    obs.first_token(elsewhere)
+
+    obs.stall_begin("A")
+    obs.stall_begin("A")              # nested: inner end must not resume
+    clock.advance(3.0)
+    obs.stall_end("A")
+    clock.advance(1.0)
+    obs.stall_end("A")
+    clock.advance(1.0)
+    recs = {
+        uid: obs.finish(uid)
+        for uid in (decoding, prefilling, elsewhere)
+    }
+    assert recs[decoding].phase_seconds["stalled"] == 4.0
+    assert recs[decoding].phase_seconds["decode"] == 1.0
+    assert "stalled" not in recs[prefilling].phase_seconds
+    assert "stalled" not in recs[elsewhere].phase_seconds
+    assert recs[elsewhere].phase_seconds["decode"] == 5.0
+    for rec in recs.values():
+        assert rec.residual_s == 0.0
+
+
+def test_stitched_handoff_is_one_partition_with_handoff_phase():
+    clock = ManualClock()
+    pre_obs = RequestObservatory(clock=clock)
+    dec_obs = RequestObservatory(clock=clock)
+
+    uid = obs_uid = pre_obs.admit("pre", slo="ttft")
+    pre_obs.prefill_start(uid)
+    clock.advance(2.0)
+    pre_obs.prefill_done(uid, computed_tokens=40,
+                         chain_digests=(b"d0", b"d1"))
+    rec = pre_obs.handoff_begin(uid)
+    assert rec is not None
+    assert pre_obs.pending_handoff_count == 1
+    clock.advance(0.25)               # in flight between roles
+
+    dec_obs.adopt(rec, engine_key="dec")
+    assert pre_obs.pending_handoff_count == 0   # migrated, not copied
+    clock.advance(0.75)               # tail prefill on the decode role
+    dec_obs.first_token(rec.uid)
+    clock.advance(1.0)
+    dec_obs.tokens_emitted(rec.uid, 4)
+    done = dec_obs.finish(rec.uid, "released")
+
+    # ONE partition spans both roles: prefill accumulates across them,
+    # the handoff is its own phase, and nothing was double-counted.
+    assert done.stitched
+    assert done.phase_seconds["handoff"] == 0.25
+    assert done.phase_seconds["prefill"] == 2.75
+    assert done.phase_seconds["decode"] == 1.0
+    assert done.residual_s == 0.0
+    assert done.ttft_s == 3.0          # the latency the client saw
+    assert pre_obs.finished_total == 0
+    assert dec_obs.finished_total == 1
+    assert dec_obs.stitched_total == 1
+    # the id lives in exactly one ledger's history
+    pre_ids = [r["id"] for r in pre_obs.status()["requests"]]
+    dec_ids = [r["id"] for r in dec_obs.status()["requests"]]
+    assert obs_uid not in pre_ids
+    assert dec_ids.count(done.uid) == 1
+
+
+# -- bounded cardinality under hostile input ----------------------------------
+
+
+def test_ten_thousand_requests_with_junk_slo_stay_bounded():
+    clock = ManualClock()
+    obs = RequestObservatory(clock=clock)
+    for i in range(10_000):
+        uid = obs.admit("eng", slo=f"junk-{i}")  # attacker-controlled
+        obs.prefill_start(uid)
+        clock.advance(0.001)
+        obs.first_token(uid)
+        obs.finish(uid)
+        obs.step("eng", live=1, slots=4, emitted_tokens=1)
+    assert obs.slo_coerced == 10_000
+    assert obs.finished_total == 10_000
+    st = obs.status()
+    # junk never mints classes/phases/labels; history deques stay bounded
+    assert set(st["classes"]) <= set(SLO_CLASSES)
+    assert set(st["phases"]) <= set(PHASES)
+    assert len(st["requests"]) <= DEFAULT_MAX_FINISHED
+    assert len(obs._finished) == DEFAULT_MAX_FINISHED
+    assert st["steps"]["count"] == 10_000
+    assert len(obs._steps) == obs._steps.maxlen
+    assert normalize_slo("junk-1") == "batch"
+
+
+def test_unadopted_handoffs_expire_rather_than_leak():
+    clock = ManualClock()
+    obs = RequestObservatory(clock=clock, max_pending_handoff=8)
+    for i in range(50):
+        uid = obs.admit("pre")
+        obs.prefill_start(uid)
+        obs.prefill_done(uid, chain_digests=(bytes([i]),))
+        obs.handoff_begin(uid)
+        clock.advance(0.1)
+    assert obs.pending_handoff_count == 8
+    assert obs.finish_reasons["handoff_expired"] == 42
+    # expired partitions still conserve: handoff time is attributed
+    expired = [
+        r for r in obs._finished
+        if r.finish_reason == "handoff_expired"
+    ]
+    assert expired and all(r.residual_s == 0.0 for r in expired)
+
+
+# -- /debug/requests + metrics label space ------------------------------------
+
+
+def _get(url):
+    try:
+        with urllib.request.urlopen(url, timeout=5) as resp:
+            return resp.getcode(), json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def test_debug_requests_endpoint_contracts():
+    from prometheus_client import CollectorRegistry
+
+    from elastic_tpu_agent.metrics import AgentMetrics
+
+    registry = CollectorRegistry()
+    m = AgentMetrics(registry=registry)
+    httpd = m.serve(0, addr="127.0.0.1")
+    base = f"http://127.0.0.1:{httpd.server_address[1]}"
+    try:
+        code, body = _get(f"{base}/debug/requests")
+        assert code == 503            # observatory not attached yet
+        assert "error" in body
+
+        clock = ManualClock()
+        obs = RequestObservatory(clock=clock)
+        m.attach_requests(obs)
+        for i, slo in enumerate(("ttft", "tpot", "nonsense")):
+            uid = obs.admit("eng", slo=slo)
+            obs.prefill_start(uid)
+            clock.advance(0.1)
+            obs.first_token(uid)
+            clock.advance(0.02 * (i + 1))
+            obs.tokens_emitted(uid, 3)
+            obs.finish(uid)
+
+        for query in ("?slo=junk", "?id=abc", "?limit=x"):
+            code, body = _get(f"{base}/debug/requests{query}")
+            assert code == 400, query
+            assert "error" in body
+
+        code, body = _get(f"{base}/debug/requests?slo=ttft&limit=1")
+        assert code == 200
+        assert len(body["requests"]) == 1
+        assert body["requests"][0]["slo"] == "ttft"
+        code, body = _get(f"{base}/debug/requests")
+        assert body["slo_coerced"] == 1
+        assert body["conservation"]["worst_residual_ms"] == 0.0
+
+        # histogram label space is the fixed vocabulary, junk and all
+        from prometheus_client import generate_latest
+
+        text = generate_latest(registry).decode()
+        for line in text.splitlines():
+            if "elastic_tpu_request_ttft_seconds" in line and 'slo="' in line:
+                slo = line.split('slo="')[1].split('"')[0]
+                assert slo in SLO_CLASSES
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+
+
+# -- real engines: churn, drain, stitching ------------------------------------
+
+
+@pytest.fixture(scope="module")
+def setup():
+    import jax
+
+    from elastic_tpu_agent.workloads.transformer import init_params
+
+    cfg = _cfg()
+    params = init_params(cfg, jax.random.key(0))
+    return cfg, params
+
+
+def _cfg():
+    import jax.numpy as jnp
+
+    from elastic_tpu_agent.workloads.transformer import ModelConfig
+
+    return ModelConfig(
+        vocab=97, d_model=32, n_heads=4, n_layers=2, d_ff=64,
+        max_seq=192, dtype=jnp.float32, attn="reference", pos="rope",
+    )
+
+
+PROMPT = [((7 * i) % 89) + 2 for i in range(40)]
+
+
+def test_engine_conservation_under_churn_and_drain(setup):
+    from elastic_tpu_agent.workloads.lifecycle import drain_serving
+    from elastic_tpu_agent.workloads.serving import ServingEngine
+
+    cfg, params = setup
+    obs = RequestObservatory()
+    eng = ServingEngine(
+        params, cfg, slots=2, max_len=64, prompt_buckets=(8, 64),
+        observatory=obs,
+    )
+    released = eng.admit(PROMPT, slo="ttft")
+    eng.step()
+    eng.release(released)                       # explicit release
+    cancelled = eng.enqueue(PROMPT, slo="tpot")
+    eng.release(cancelled)                      # cancel mid-prefill
+    eng.admit(PROMPT[:8])                       # rides to drain
+    eng.enqueue(PROMPT[:8])
+    summary = drain_serving(eng)                # churn ends in a drain
+
+    assert summary["live_requests"] == 0
+    st = obs.status()
+    # every admission's partition closed through finish() — no leaks
+    assert st["live"] == 0
+    assert st["finished"] == 4
+    assert st["finish_reasons"].get("cancelled") == 1
+    assert sum(st["finish_reasons"].values()) == 4
+    # gap-free by construction even on the real clock
+    assert abs(st["conservation"]["worst_residual_ms"]) < 1.0
+    for rec in st["requests"]:
+        assert rec["wall_ms"] is not None
+        total = sum(rec["phases_ms"].values()) + rec["residual_ms"]
+        assert total == pytest.approx(rec["wall_ms"], abs=0.01)
+    assert st["steps"]["count"] > 0
+
+
+def test_engine_stitching_one_partition_per_id(setup):
+    from elastic_tpu_agent.workloads.serving import (
+        ServingEngine,
+        SharedKVPool,
+    )
+
+    cfg, params = setup
+    pool = SharedKVPool(cfg, block_size=8, pool_blocks=64)
+    obs = RequestObservatory()
+    pre = ServingEngine(
+        params, cfg, slots=1, max_len=128, prompt_buckets=(8, 64),
+        role="prefill", pool=pool, observatory=obs,
+    )
+    dec = ServingEngine(
+        params, cfg, slots=2, max_len=128, prompt_buckets=(8, 64),
+        role="decode", pool=pool, observatory=obs,
+    )
+    rp = pre.admit(PROMPT, slo="ttft")
+    pre.release(rp)
+    assert obs.pending_handoff_count == 1       # published, awaiting
+    rd = dec.admit(PROMPT)
+    for _ in range(3):
+        dec.step()
+    dec.release(rd)
+
+    st = obs.status()
+    assert obs.pending_handoff_count == 0
+    assert st["stitched"] == 1
+    assert st["handoffs_published"] == 1
+    assert st["handoffs_adopted"] == 1
+    stitched = [r for r in st["requests"] if r["stitched"]]
+    assert len(stitched) == 1                   # ONE partition, one id
+    rec = stitched[0]
+    assert rec["slo"] == "ttft"                 # annotation survives
+    # every FULL published block is adopted (the unaligned tail block
+    # stays private to the prefill role)
+    assert rec["cached_tokens"] >= len(PROMPT) - 8
+    for phase in ("prefill", "handoff", "decode"):
+        assert phase in rec["phases_ms"], rec["phases_ms"]
+    ids = [r["id"] for r in st["requests"]]
+    assert len(ids) == len(set(ids))
+    assert abs(st["conservation"]["worst_residual_ms"]) < 1.0
+
+
+def test_serving_admit_records_carry_slo_and_request_uid(setup):
+    from elastic_tpu_agent.workloads.serving import ServingEngine
+    from elastic_tpu_agent.workloads.telemetry import FlightRecorder
+
+    cfg, params = setup
+    rec = FlightRecorder(path=None, trace_id="req-obs-t")
+    obs = RequestObservatory(recorder=rec)
+    eng = ServingEngine(
+        params, cfg, slots=1, max_len=64, prompt_buckets=(8, 64),
+        recorder=rec, observatory=obs,
+    )
+    rid = eng.admit(PROMPT[:8], slo="ttft")
+    eng.step()
+    eng.release(rid)
+    admits = [r for r in rec.records if r["kind"] == "serving_admit"]
+    assert admits and admits[0]["slo"] == "ttft"
+    finishes = [r for r in rec.records if r["kind"] == "request_finish"]
+    assert finishes and finishes[0]["slo"] == "ttft"
+    # the join key: admit's request_uid IS the finish's request_id
+    assert admits[0]["request_uid"] == finishes[0]["request_id"]
