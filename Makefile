@@ -5,7 +5,7 @@ TAG ?= elastic-tpu-agent:latest
 # verify's tier-1 line uses pipefail, which /bin/sh (dash) lacks
 SHELL := /bin/bash
 
-.PHONY: all native sanitize test test-all verify doctor-smoke chaos-smoke bench-smoke crash-replay-smoke fleet-smoke scale-smoke slice-smoke drain-smoke migrate-smoke timeline-smoke serving-smoke request-obs-smoke qos-smoke goodput-smoke latency-smoke perf-gate protos image bench clean
+.PHONY: all native sanitize test test-all verify doctor-smoke chaos-smoke bench-smoke crash-replay-smoke fleet-smoke scale-smoke slice-smoke drain-smoke migrate-smoke timeline-smoke serving-smoke request-obs-smoke qos-smoke goodput-smoke latency-smoke chaos-matrix-smoke perf-gate protos image bench clean
 
 all: native test
 
@@ -205,6 +205,20 @@ qos-smoke:
 goodput-smoke:
 	JAX_PLATFORMS=cpu python3 bench.py --goodput-smoke
 
+# chaos-matrix smoke: the serve-the-ugly-day gate (bench.py
+# --chaos-matrix-smoke): seeded replayable traffic (diurnal load,
+# flash crowds, prefix-hostile prompts, train/serve tenancy) replayed
+# through a live 2-node fleet's real admission paths while a seeded
+# chaos program overlaps apiserver brownouts, storage flush faults,
+# kubelet socket flaps and maintenance drains. Schedule generation
+# must be deterministic (generated twice, identical digests), every
+# compound scenario must end with zero conservation problems and
+# goodput/SLO above the floors, and a sabotaged known-bad run must
+# TRIP the checker. Failing scenarios print a one-line repro
+# (--trace-seed/--chaos-seed/--scenario).
+chaos-matrix-smoke:
+	JAX_PLATFORMS=cpu python3 bench.py --chaos-matrix-smoke
+
 # latency smoke: the critical-path observatory gate (bench.py
 # --latency-smoke): a 2-node fleet churns, then injects a maintenance
 # notice and a telemetry failure — the injected events must surface in
@@ -227,7 +241,7 @@ perf-gate:
 	python3 -m elastic_tpu_agent.cli perf-gate --self-test
 
 T1_TIMEOUT ?= 870
-verify: doctor-smoke chaos-smoke bench-smoke crash-replay-smoke fleet-smoke scale-smoke slice-smoke drain-smoke migrate-smoke timeline-smoke serving-smoke request-obs-smoke qos-smoke goodput-smoke latency-smoke perf-gate
+verify: doctor-smoke chaos-smoke bench-smoke crash-replay-smoke fleet-smoke scale-smoke slice-smoke drain-smoke migrate-smoke timeline-smoke serving-smoke request-obs-smoke qos-smoke goodput-smoke latency-smoke chaos-matrix-smoke perf-gate
 	python -c "from prometheus_client import CollectorRegistry; \
 	  from elastic_tpu_agent.metrics import AgentMetrics; \
 	  AgentMetrics(registry=CollectorRegistry()); \

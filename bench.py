@@ -2040,6 +2040,209 @@ def goodput_smoke_main():
     return 0
 
 
+# -- chaos matrix: trace-driven traffic under compound faults -----------------
+#
+# The robustness gate for sim/traffic.py + sim/chaos.py: seeded
+# replayable traffic (diurnal load, flash crowds, prefix-hostile
+# prompts, train/serve tenancy) driven through the REAL admission paths
+# of a live 2-node FleetSim while a seeded chaos program overlaps
+# apiserver brownouts, storage flush faults, kubelet socket flaps,
+# maintenance drains and QoS throttles on top of it. Scored by fleet
+# goodput + per-class SLO attainment; judged by the compound
+# conservation invariants in scale_problems(). Every verdict is
+# reproducible from (trace_seed, chaos_seed) — a failing scenario
+# prints a one-line repro command.
+
+# Floors the smoke applies on top of the conservation invariants: the
+# fleet must stay mostly productive through the ugly day and the
+# latency classes must mostly meet their targets even while the chaos
+# program runs. Deliberately loose — this is a robustness gate, not a
+# perf gate; the perf story lives in the goodput/latency legs.
+CHAOS_SMOKE_BOUNDS = {
+    "min_goodput_percent": 10.0,
+    "min_slo_attainment": 0.9,
+}
+
+
+def _cli_arg(flag, default, cast):
+    """`--flag value` lookup in sys.argv (bench convention is flat
+    argv scanning, not argparse)."""
+    if flag in sys.argv:
+        i = sys.argv.index(flag)
+        if i + 1 < len(sys.argv):
+            return cast(sys.argv[i + 1])
+    return default
+
+
+def _chaos_matrix(trace_seed, chaos_seed, scenario=None, bounds=None):
+    """Build the matrix, optionally filtered to one named scenario —
+    the filtered spec keeps its original index so its sub-seeds (and
+    therefore its trace and program) match the full-matrix run the
+    repro line came from."""
+    from elastic_tpu_agent.sim import ChaosMatrix
+
+    matrix = ChaosMatrix(trace_seed=trace_seed, chaos_seed=chaos_seed)
+    if scenario is not None:
+        keep = [
+            dict(spec, index=i)
+            for i, spec in enumerate(matrix.scenarios)
+            if spec["name"] == scenario
+        ]
+        if not keep:
+            names = [s["name"] for s in matrix.scenarios]
+            raise ValueError(
+                f"unknown chaos scenario {scenario!r}; have {names}"
+            )
+        matrix.scenarios = keep
+    if bounds:
+        for spec in matrix.scenarios:
+            merged = dict(bounds)
+            merged.update(spec.get("bounds") or {})
+            spec["bounds"] = merged
+    return matrix
+
+
+def _chaos_scenario_summary(report):
+    """Flatten one scenario report to the fields a bench reader
+    compares across rounds (full reports stay in the smoke output)."""
+    gp = report.get("goodput", {})
+    # report["slo"] is the fleet classes dict keyed by SLO class
+    slo = report.get("slo", {})
+    comp = report.get("compound", {})
+    return {
+        "scenario": report.get("scenario"),
+        "repro": report.get("repro"),
+        "trace_digest": (report.get("trace") or {}).get("digest"),
+        "program_digest": (report.get("program") or {}).get("digest"),
+        "goodput_percent": gp.get("goodput_percent"),
+        "slo_attainment": {
+            cls: (v or {}).get("attainment")
+            for cls, v in slo.items()
+        },
+        "streams": (comp.get("streams") or {}).get("admitted"),
+        "handoffs_adopted": (comp.get("handoffs") or {}).get("adopted"),
+        "problems": report.get("problems", []),
+    }
+
+
+def run_chaos_leg(trace_seed=1, chaos_seed=1):
+    """One bounded compound scenario (the first matrix entry) for
+    main()'s extra block: real traffic, real faults, conservation
+    judged — small enough to ride every bench round."""
+    matrix = _chaos_matrix(trace_seed, chaos_seed,
+                           scenario="brownout-flash-crowd",
+                           bounds=CHAOS_SMOKE_BOUNDS)
+    t0 = time.monotonic()
+    with tempfile.TemporaryDirectory(prefix="etpu-chaos-leg-") as td:
+        out = matrix.run(os.path.join(td, "m"))
+    leg = _chaos_scenario_summary(out["scenarios"][0])
+    leg["trace_seed"] = trace_seed
+    leg["chaos_seed"] = chaos_seed
+    leg["schedule_digest"] = out["schedule_digest"]
+    leg["wall_s"] = round(time.monotonic() - t0, 3)
+    leg["problems"] = out["problems"]
+    return leg
+
+
+def chaos_matrix_smoke_main():
+    """`make chaos-matrix-smoke` / `bench.py --chaos-matrix-smoke`:
+    the serve-the-ugly-day gate.
+
+    - determinism: the full matrix schedule (every trace + chaos
+      program) is generated twice and must digest identically;
+    - every compound scenario runs against a live fleet and must end
+      with ZERO conservation problems, goodput above the floor and SLO
+      attainment above the floor;
+    - known-bad self-test: a sabotaged run (client-visible stream
+      drops) must TRIP the checker — a gate that cannot fail is not a
+      gate;
+    - a failing scenario prints its one-line repro
+      (`--trace-seed/--chaos-seed/--scenario` are honored here for
+      exactly that replay).
+    """
+    trace_seed = _cli_arg("--trace-seed", 1, int)
+    chaos_seed = _cli_arg("--chaos-seed", 1, int)
+    scenario = _cli_arg("--scenario", None, str)
+    try:
+        matrix = _chaos_matrix(trace_seed, chaos_seed, scenario,
+                               bounds=CHAOS_SMOKE_BOUNDS)
+        digest_a = matrix.schedule_digest()
+        digest_b = _chaos_matrix(
+            trace_seed, chaos_seed, scenario,
+            bounds=CHAOS_SMOKE_BOUNDS,
+        ).schedule_digest()
+        t0 = time.monotonic()
+        with tempfile.TemporaryDirectory(prefix="etpu-chaos-") as td:
+            out = matrix.run(os.path.join(td, "m"))
+            self_test = matrix.self_test(os.path.join(td, "st"))
+        wall_s = round(time.monotonic() - t0, 3)
+    except Exception as e:  # noqa: BLE001 - the gate reports, never hides
+        print(json.dumps({"chaos_matrix_smoke": {
+            "error": f"{type(e).__name__}: {e}",
+        }}))
+        print(f"chaos-matrix smoke FAILED: {type(e).__name__}: {e}",
+              file=sys.stderr)
+        return 1
+
+    problems = list(out["problems"])
+    if digest_a != digest_b:
+        problems.append(
+            f"schedule generation not deterministic: "
+            f"{digest_a} != {digest_b}"
+        )
+    if not self_test["tripped"]:
+        problems.append(
+            "known-bad self-test did NOT trip: sabotaged stream "
+            "accounting produced zero problems"
+        )
+    print(json.dumps({"chaos_matrix_smoke": {
+        "trace_seed": trace_seed,
+        "chaos_seed": chaos_seed,
+        "scenario_filter": scenario,
+        "schedule_digest": digest_a,
+        "schedule_deterministic": digest_a == digest_b,
+        "wall_s": wall_s,
+        "scenarios": [
+            _chaos_scenario_summary(r) for r in out["scenarios"]
+        ],
+        "self_test": self_test,
+        "problems": problems,
+    }}))
+    if problems:
+        for p in problems:
+            print(f"chaos-matrix smoke FAILED: {p}", file=sys.stderr)
+        for r in out["scenarios"]:
+            if r.get("problems"):
+                print(f"chaos-matrix repro: {r['repro']}",
+                      file=sys.stderr)
+        return 1
+    print("chaos-matrix smoke: OK", file=sys.stderr)
+    return 0
+
+
+def chaos_main():
+    """`bench.py --chaos`: just the chaos leg (the single bounded
+    scenario that rides main()'s extra.chaos), as its own JSON doc."""
+    trace_seed = _cli_arg("--trace-seed", 1, int)
+    chaos_seed = _cli_arg("--chaos-seed", 1, int)
+    try:
+        leg = run_chaos_leg(trace_seed, chaos_seed)
+    except Exception as e:  # noqa: BLE001
+        print(json.dumps({"chaos": {
+            "error": f"{type(e).__name__}: {e}",
+        }}))
+        print(f"chaos leg FAILED: {type(e).__name__}: {e}",
+              file=sys.stderr)
+        return 1
+    print(json.dumps({"chaos": leg}))
+    if leg["problems"]:
+        for p in leg["problems"]:
+            print(f"chaos leg FAILED: {p}", file=sys.stderr)
+        return 1
+    print("chaos leg: OK", file=sys.stderr)
+    return 0
+
+
 # -- lifecycle timeline: churn + reform + drain as ONE story ------------------
 #
 # The observability gate for timeline.py: a 4-node fleet where nodes
@@ -4347,6 +4550,15 @@ def main():
             "reason": f"request obs leg failed: "
                       f"{type(e).__name__}: {e}",
         }
+    try:
+        chaos_leg = run_chaos_leg()
+        if chaos_leg.get("problems"):
+            chaos_leg["failed"] = True
+    except Exception as e:  # noqa: BLE001 - surfaced, not silence
+        chaos_leg = {
+            "skipped": True,
+            "reason": f"chaos leg failed: {type(e).__name__}: {e}",
+        }
     tpu = run_tpu_throughput()
     # QoS co-location only makes sense when the chip is reachable at
     # all (its children would just burn the same init timeout)
@@ -4426,6 +4638,11 @@ def main():
             # prefill_reduction ratio here is perf-gate-tracked
             # (bench_history.TRACKED_RATIOS).
             "request_obs": request_obs,
+            # One compound-chaos scenario under live traffic: seeded
+            # trace + overlapping fault program, conservation
+            # invariants judged, reproducible from the seeds in the
+            # embedded repro line.
+            "chaos": chaos_leg,
             "tpu": tpu,
             "qos_colocation": qos,
         },
@@ -4452,6 +4669,10 @@ if __name__ == "__main__":
         sys.exit(migrate_main())
     elif "--goodput-smoke" in sys.argv:
         sys.exit(goodput_smoke_main())
+    elif "--chaos-matrix-smoke" in sys.argv:
+        sys.exit(chaos_matrix_smoke_main())
+    elif "--chaos" in sys.argv:
+        sys.exit(chaos_main())
     elif "--timeline-smoke" in sys.argv:
         sys.exit(timeline_smoke_main())
     elif "--serving-smoke" in sys.argv:

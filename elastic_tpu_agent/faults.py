@@ -26,18 +26,50 @@ Specs (``<kind>[:<arg>]``):
   for — e.g. ``drain.preempt-notice=notice:1`` makes the drain
   orchestrator see exactly one spot-preemption notice.
 
+Brownout kinds (chaos-matrix material, sim/chaos.py): deterministic
+one-shots cannot express a *flaky* dependency — a disk that fails one
+write in three, an RPC that is slow by a different amount every call, a
+dependency that is only broken for a while. These kinds are seeded, so
+a chaos program replayed from the same seed trips the same calls:
+
+- ``prob:P:SEED`` — raise FaultError with probability ``P`` per fire,
+  decided by a private ``random.Random(SEED)`` stream (``prob:0.3:7``).
+  Fires that do not trip consume nothing; ``fired`` counts trips only.
+- ``delay-range:LO:HI:SEED`` — sleep a uniform duration in ``[LO, HI]``
+  seconds per fire, drawn from the seeded stream
+  (``delay-range:0.001:0.05:7``) — jittery-slow, not fixed-slow.
+- ``window:START:DUR`` — raise FaultError only while the registry
+  clock's monotonic time is within ``[armed_at+START, armed_at+START+
+  DUR)`` — a brownout that begins and ends on schedule. Outside the
+  window the point is a no-op (and never expires); chaos programs
+  disarm it explicitly. The registry's ``clock`` attribute is the
+  injectable time source (tests hand in a ManualClock).
+
 Arming is test-only: production deployments never set the env knob, and
 an unarmed ``fire()`` is a dict-emptiness check. Points are plain
 dotted names (``sitter.relist``, ``storage.save``, ``gc.sweep``, ...);
 firing an unknown point is always safe.
+
+Full spec grammar::
+
+    spec      := "raise" | "raise-once" | "raise:" N
+               | "delay:" SECONDS
+               | "die-thread" [":" N]
+               | "notice" [":" N]
+               | "prob:" P [":" SEED]
+               | "delay-range:" LO ":" HI [":" SEED]
+               | "window:" START ":" DUR
 """
 
 from __future__ import annotations
 
 import logging
+import random
 import threading
 import time
 from typing import Dict, Optional
+
+from .common import SYSTEM_CLOCK
 
 logger = logging.getLogger(__name__)
 
@@ -55,13 +87,23 @@ class DieThread(BaseException):
 
 
 class _Fault:
-    __slots__ = ("kind", "arg", "remaining", "fired")
+    __slots__ = (
+        "kind", "arg", "remaining", "fired",
+        "rng", "lo", "hi", "win_start", "win_dur", "armed_at",
+    )
 
     def __init__(self, kind: str, arg: Optional[float], remaining: Optional[int]):
         self.kind = kind
         self.arg = arg
         self.remaining = remaining  # None = unlimited
         self.fired = 0
+        # seeded-kind state (prob / delay-range / window)
+        self.rng: Optional[random.Random] = None
+        self.lo = 0.0
+        self.hi = 0.0
+        self.win_start = 0.0
+        self.win_dur = 0.0
+        self.armed_at = 0.0  # registry clock at arm(); window anchor
 
 
 def _parse_spec(spec: str) -> _Fault:
@@ -83,22 +125,61 @@ def _parse_spec(spec: str) -> _Fault:
     if kind == "notice":
         n = int(arg) if arg else None
         return _Fault("notice", None, n)
+    if kind == "prob":
+        parts = arg.split(":") if arg else []
+        if not parts or not parts[0]:
+            raise ValueError("prob fault needs a probability: prob:0.3:7")
+        p = float(parts[0])
+        if not 0.0 <= p <= 1.0:
+            raise ValueError(f"prob fault probability out of [0,1]: {p}")
+        fault = _Fault("prob", p, None)
+        fault.rng = random.Random(int(parts[1]) if len(parts) > 1 else 0)
+        return fault
+    if kind == "delay-range":
+        parts = arg.split(":") if arg else []
+        if len(parts) < 2:
+            raise ValueError(
+                "delay-range fault needs bounds: delay-range:0.001:0.05:7"
+            )
+        fault = _Fault("delay-range", None, None)
+        fault.lo, fault.hi = float(parts[0]), float(parts[1])
+        if fault.hi < fault.lo:
+            raise ValueError(
+                f"delay-range bounds inverted: {fault.lo} > {fault.hi}"
+            )
+        fault.rng = random.Random(int(parts[2]) if len(parts) > 2 else 0)
+        return fault
+    if kind == "window":
+        parts = arg.split(":") if arg else []
+        if len(parts) != 2:
+            raise ValueError("window fault needs start:dur: window:1.0:2.5")
+        fault = _Fault("window", None, None)
+        fault.win_start, fault.win_dur = float(parts[0]), float(parts[1])
+        if fault.win_dur < 0:
+            raise ValueError(f"window duration negative: {fault.win_dur}")
+        return fault
     raise ValueError(
         f"unknown fault spec {spec!r} "
-        "(want raise[-once|:N] | delay:S | die-thread[:N] | notice[:N])"
+        "(want raise[-once|:N] | delay:S | die-thread[:N] | notice[:N] | "
+        "prob:P[:SEED] | delay-range:LO:HI[:SEED] | window:START:DUR)"
     )
 
 
 class FaultRegistry:
-    """Thread-safe map of failpoint name -> armed behavior."""
+    """Thread-safe map of failpoint name -> armed behavior.
 
-    def __init__(self) -> None:
+    ``clock`` is the injectable time source ``window`` kinds anchor to
+    (monotonic); chaos tests hand in a ManualClock and advance it."""
+
+    def __init__(self, clock=SYSTEM_CLOCK) -> None:
         self._lock = threading.Lock()
         self._armed: Dict[str, _Fault] = {}
         self.total_fired = 0
+        self.clock = clock
 
     def arm(self, point: str, spec: str) -> None:
         fault = _parse_spec(spec)
+        fault.armed_at = self.clock.monotonic()
         with self._lock:
             self._armed[point] = fault
         logger.warning("FAULT ARMED (test-only): %s=%s", point, spec)
@@ -160,6 +241,20 @@ class FaultRegistry:
             fault = self._armed.get(point)
             if fault is None or fault.kind == "notice":
                 return
+            # Seeded/windowed kinds decide whether this call trips at
+            # all BEFORE any charge is consumed: a prob fire that does
+            # not trip (or a window fire outside the window) must leave
+            # ``fired`` counting trips only — that is what chaos
+            # verdicts assert against.
+            if fault.kind == "prob":
+                if fault.rng.random() >= fault.arg:
+                    return
+            elif fault.kind == "window":
+                dt = self.clock.monotonic() - fault.armed_at
+                if not (
+                    fault.win_start <= dt < fault.win_start + fault.win_dur
+                ):
+                    return
             fault.fired += 1
             self.total_fired += 1
             if fault.remaining is not None:
@@ -167,8 +262,10 @@ class FaultRegistry:
                 if fault.remaining <= 0:
                     del self._armed[point]
             kind, arg = fault.kind, fault.arg
+            if kind == "delay-range":
+                arg = fault.lo + fault.rng.random() * (fault.hi - fault.lo)
         # act outside the lock: delay must not serialize other points
-        if kind == "delay":
+        if kind in ("delay", "delay-range"):
             time.sleep(arg)
             return
         if kind == "die-thread":

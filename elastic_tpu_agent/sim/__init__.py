@@ -15,8 +15,19 @@ scraping every agent's /metrics endpoint.
   histogram buckets for fleet-level quantiles, computes per-bind request
   amplification, tracks per-node reconcile convergence, and follows
   admission-stamped trace ids to whichever node bound the pod.
+- traffic.py: TraceGenerator — seeded, replayable request/pod arrival
+  traces (diurnal load, flash crowds, prefix-cache-hostile prompts,
+  mixed train/serve tenancy); same seed ⇒ byte-identical trace.
+- chaos.py: ChaosMatrix — overlapping fault programs (brownouts, flaky
+  disks, drains, kubelet flaps, throttles) replayed over live traffic,
+  scored by fleet goodput + SLO attainment with the compound
+  conservation invariants judged by scale_problems().
 """
 
 from .aggregator import FleetAggregator, histogram_quantile  # noqa: F401
+from .chaos import (  # noqa: F401
+    ChaosMatrix, ChaosProgram, OpCursor, ScenarioRunner, repro_line,
+)
 from .fleet import FleetSim  # noqa: F401
 from .scale import ScaleHarness, scale_problems  # noqa: F401
+from .traffic import Trace, TraceCursor, TraceGenerator  # noqa: F401

@@ -490,10 +490,18 @@ class ScaleHarness:
 
 
 def scale_problems(report: dict, bounds: Optional[dict] = None) -> List[str]:
-    """Structural assertions over a scale report (shared by `make
-    scale-smoke` and tests): every bind lands, every node converges,
-    request amplification stays within bound, memory holds its
-    documented ceiling. Returns problems (empty = the run held)."""
+    """Structural assertions over a scale OR chaos report (shared by
+    `make scale-smoke`, `make chaos-matrix-smoke` and tests).
+
+    Scale reports (ScaleHarness.report()): every bind lands, every node
+    converges, request amplification stays within bound, memory holds
+    its documented ceiling. Chaos reports (sim/chaos.py ScenarioRunner)
+    carry a ``compound`` block instead, judged by the compound-scenario
+    invariants: no stream drops or resets client-visibly, no bind
+    double-lands, goodput/request-phase conservation holds through
+    arbitrary fault overlap, every handoff is adopted, every open
+    intent resolves, and no node replays a reclaimed bind. Returns
+    problems (empty = the run held)."""
     b = {
         # kubelet Lists per bind: the fleet leg measures ~0.9; 2.0 is
         # the regression alarm, not the target.
@@ -509,9 +517,23 @@ def scale_problems(report: dict, bounds: Optional[dict] = None) -> List[str]:
         # the trace ring is capacity-bounded; its bytes must stay small
         # against the process (64 MiB is far past any healthy ring).
         "trace_ring_bytes": 64 * 1024 * 1024,
+        # compound-scenario invariants (chaos reports): request-phase
+        # conservation residual ceiling, and optional score floors a
+        # smoke can raise (None = not enforced).
+        "worst_residual_s": 0.05,
+        "min_goodput_percent": None,
+        "min_slo_attainment": None,
         **(bounds or {}),
     }
     problems: List[str] = []
+    if "compound" in report:
+        problems += _compound_problems(report, b)
+        gp = report.get("goodput", {})
+        if gp.get("goodput_percent") is None:
+            problems.append("goodput: fleet rollup missing")
+        for p in gp.get("conservation_problems", []):
+            problems.append(f"goodput conservation: {p}")
+        return problems
     phases = report.get("phases", {})
     adm = phases.get("admission_waves", {})
     if adm.get("bound") != adm.get("admitted") or adm.get("errors"):
@@ -576,4 +598,85 @@ def scale_problems(report: dict, bounds: Optional[dict] = None) -> List[str]:
         problems.append("goodput: fleet rollup missing")
     for p in gp.get("conservation_problems", []):
         problems.append(f"goodput conservation: {p}")
+    return problems
+
+
+def _compound_problems(report: dict, b: dict) -> List[str]:
+    """The compound-scenario invariant set (chaos reports): what must
+    hold through ARBITRARY fault overlap, judged after recovery."""
+    problems: List[str] = []
+    c = report["compound"]
+    streams = c.get("streams", {})
+    if streams.get("admitted") != streams.get("finished"):
+        problems.append(
+            f"stream conservation: {streams.get('admitted')} admitted "
+            f"!= {streams.get('finished')} finished"
+        )
+    for key in ("live_leftover", "pending_handoff_leftover"):
+        if streams.get(key):
+            problems.append(f"streams: {streams[key]} {key}")
+    if streams.get("client_visible_drops"):
+        problems.append(
+            f"client-visible stream drops: "
+            f"{streams['client_visible_drops']} "
+            f"(reasons: {streams.get('finish_reasons')})"
+        )
+    h = c.get("handoffs", {})
+    if h.get("published") != h.get("adopted", 0) + h.get("expired", 0):
+        problems.append(
+            f"handoffs: {h.get('published')} published != "
+            f"{h.get('adopted')} adopted + {h.get('expired')} expired"
+        )
+    if h.get("expired"):
+        problems.append(f"handoffs: {h['expired']} expired unadopted")
+    residual = abs(c.get("worst_residual_s") or 0.0)
+    if residual > b["worst_residual_s"]:
+        problems.append(
+            f"request-phase conservation: worst residual {residual}s > "
+            f"{b['worst_residual_s']}s"
+        )
+    tokens = c.get("tokens", {})
+    if tokens.get("emitted") != tokens.get("accounted"):
+        problems.append(
+            f"token conservation: {tokens.get('emitted')} emitted != "
+            f"{tokens.get('accounted')} accounted"
+        )
+    binds = c.get("binds", {})
+    if binds.get("double_lands"):
+        problems.append(f"bind double-lands: {binds['double_lands']}")
+    if binds.get("records_missing"):
+        problems.append(
+            f"serve binds missing after recovery: "
+            f"{binds['records_missing']}"
+        )
+    if c.get("open_intents"):
+        problems.append(
+            f"open intents unresolved: {c['open_intents']}"
+        )
+    rec = report.get("recovery", {})
+    if rec.get("binds_never_landed"):
+        problems.append(
+            f"binds never landed: {rec['binds_never_landed']}"
+        )
+    if rec.get("reclaimed_bind_replays"):
+        problems.append(
+            f"reclaimed binds replayed: "
+            f"{rec['reclaimed_bind_replays']}"
+        )
+    if rec.get("reclaim_error"):
+        problems.append(f"reclaim: {rec['reclaim_error']}")
+    for p in rec.get("problems", []) or []:
+        problems.append(f"recovery: {p}")
+    floor = b.get("min_goodput_percent")
+    gp = (report.get("goodput") or {}).get("goodput_percent")
+    if floor is not None and (gp is None or gp < floor):
+        problems.append(f"goodput {gp}% < floor {floor}%")
+    att_floor = b.get("min_slo_attainment")
+    if att_floor is not None:
+        for slo, block in (report.get("slo") or {}).items():
+            att = block.get("attainment")
+            if att is not None and att < att_floor:
+                problems.append(
+                    f"SLO {slo} attainment {att} < floor {att_floor}"
+                )
     return problems

@@ -36,6 +36,8 @@ import logging
 import threading
 from typing import Callable, Dict, Optional
 
+from .. import faults
+
 logger = logging.getLogger(__name__)
 
 # How long a sync writer will wait for its covering group commit before
@@ -196,6 +198,11 @@ class GroupCommitBatcher:
             self._sync_pending = False
         err: Optional[BaseException] = None
         try:
+            # Chaos seam (sim/chaos.py): a failed flush here exercises
+            # the whole-transaction rollback + sync-waiter error path —
+            # the "disk refused the group commit" story. Arm with e.g.
+            # ``storage.batch_flush=prob:0.3:7`` for a flaky disk.
+            faults.fire("storage.batch_flush")
             self._commit_fn()
         except BaseException as e:  # noqa: BLE001 - surfaced to waiters
             err = e

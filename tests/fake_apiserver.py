@@ -15,14 +15,24 @@ Hardened for thousand-pod fleets (scale harness, sim/scale.py):
   (``pod_list``, ``pod_list_pages``, ``pod_watch``, ``pod_get``,
   ``event_post``, ``crd_*``, ...), so request amplification is
   assertable AT THE SOURCE rather than inferred from client-side
-  counters.
+  counters;
+- first-class BROWNOUT injection (``set_brownout``/``clear_brownout``):
+  a seeded per-operation error rate + latency window, togglable
+  mid-run, replacing the ad-hoc monkeypatching chaos tests used to do.
+  Browned requests answer 503 ServiceUnavailable (the real apiserver's
+  overload answer, which KubeClient surfaces as KubeError — NEVER
+  NotFound, so GC cannot misread an outage as deletion) and are
+  counted under ``<op>_failed`` while served ones keep counting under
+  ``<op>`` — failed-vs-served is distinguishable at the source.
 """
 
 from __future__ import annotations
 
 import json
 import queue
+import random
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, List, Optional, Tuple
 from urllib.parse import parse_qs, urlparse
@@ -59,6 +69,63 @@ class FakeAPIServer:
         # age out.
         self._list_snapshots: Dict[int, Tuple[list, str]] = {}
         self._snap_seq = 0
+        # Active brownout (None = healthy). Set/replaced/cleared under
+        # the lock so a mid-run toggle takes effect on the next request.
+        self._brownout: Optional[dict] = None
+
+    # -- brownout injection (chaos-matrix seam, sim/chaos.py) -----------------
+
+    def set_brownout(
+        self,
+        ops=None,
+        error_rate: float = 0.0,
+        latency_s: float = 0.0,
+        seed: int = 0,
+    ) -> None:
+        """Brown the apiserver out: every subsequent request whose
+        operation kind is in ``ops`` (None = every kind except
+        ``pod_watch``) is delayed ``latency_s`` and then fails with 503
+        with probability ``error_rate``, decided by a private
+        ``random.Random(seed)`` stream — same seed, same request
+        sequence, same failures. Replaces any active brownout
+        (togglable mid-run); ``clear_brownout()`` heals instantly."""
+        if not 0.0 <= error_rate <= 1.0:
+            raise ValueError(f"error_rate out of [0,1]: {error_rate}")
+        with self._lock:
+            self._brownout = {
+                "ops": frozenset(ops) if ops is not None else None,
+                "error_rate": float(error_rate),
+                "latency_s": max(0.0, float(latency_s)),
+                "rng": random.Random(seed),
+                "failed": 0,
+                "delayed": 0,
+            }
+
+    def clear_brownout(self) -> Optional[dict]:
+        """End the brownout; returns its stats (failed/delayed counts)."""
+        with self._lock:
+            b, self._brownout = self._brownout, None
+            if b is None:
+                return None
+            return {"failed": b["failed"], "delayed": b["delayed"]}
+
+    def _brownout_decide(self, kind: str) -> Tuple[float, bool]:
+        """(delay_s, fail) for one request of ``kind`` under the active
+        brownout — (0, False) when healthy or the kind isn't browned.
+        The rng draw happens under the lock: concurrent handler threads
+        consume the seeded stream in arrival order, which is as
+        deterministic as a threaded server can be (single-threaded
+        drivers get exact replay)."""
+        with self._lock:
+            b = self._brownout
+            if b is None or (b["ops"] is not None and kind not in b["ops"]):
+                return 0.0, False
+            fail = b["rng"].random() < b["error_rate"]
+            if fail:
+                b["failed"] += 1
+            if b["latency_s"] > 0:
+                b["delayed"] += 1
+            return b["latency_s"], fail
 
     def _snapshot_page(self, node: str, cont: str, limit: int):
         """(keys_page, rv, next_continue) for one paginated pod LIST."""
@@ -188,6 +255,26 @@ class FakeAPIServer:
                 self.end_headers()
                 self.wfile.write(raw)
 
+            def _gate(self, kind: str) -> bool:
+                """Count one request of ``kind``, applying the active
+                brownout: delay first (slow apiserver), then 503 with
+                the browned probability. True = this request was
+                answered with the failure and the caller must return;
+                False = proceed (counted as served)."""
+                delay_s, fail = outer._brownout_decide(kind)
+                if delay_s > 0:
+                    time.sleep(delay_s)
+                if fail:
+                    outer._count(kind + "_failed")
+                    self._json(503, {
+                        "kind": "Status", "code": 503,
+                        "reason": "ServiceUnavailable",
+                        "message": "injected brownout",
+                    })
+                    return True
+                outer._count(kind)
+                return False
+
             def do_GET(self):  # noqa: N802
                 parsed = urlparse(self.path)
                 params = {k: v[0] for k, v in parse_qs(parsed.query).items()}
@@ -198,7 +285,8 @@ class FakeAPIServer:
                     if params.get("watch") == "true":
                         outer._count("pod_watch")
                         return self._watch(node, params)
-                    outer._count("pod_list_pages")
+                    if self._gate("pod_list_pages"):
+                        return
                     cont = params.get("continue", "")
                     if not cont:
                         # pages of one logical LIST count once
@@ -234,7 +322,8 @@ class FakeAPIServer:
                     and parts[4] == "pods"
                 ):
                     ns, name = parts[3], parts[5]
-                    outer._count("pod_get")
+                    if self._gate("pod_get"):
+                        return
                     with outer._lock:
                         pod = outer._pods.get((ns, name))
                     if pod is None:
@@ -242,7 +331,8 @@ class FakeAPIServer:
                     return self._json(200, pod)
                 # /api/v1/nodes/{name}
                 if len(parts) == 4 and parts[:3] == ["api", "v1", "nodes"]:
-                    outer._count("node_get")
+                    if self._gate("node_get"):
+                        return
                     with outer._lock:
                         node_obj = outer._nodes.get(parts[3])
                     if node_obj is None:
@@ -251,7 +341,8 @@ class FakeAPIServer:
                 # /apis/elasticgpu.io/v1alpha1/elastictpus[/name]
                 if self._crd_parts(parts) is not None:
                     name = self._crd_parts(parts)
-                    outer._count("crd_list" if name == "" else "crd_get")
+                    if self._gate("crd_list" if name == "" else "crd_get"):
+                        return
                     with outer._lock:
                         if name == "":
                             items = list(outer._crds.values())
@@ -312,7 +403,8 @@ class FakeAPIServer:
                     and parts[4] == "events"
                 ):
                     obj = self._read_body()
-                    outer._count("event_post")
+                    if self._gate("event_post"):
+                        return
                     with outer._lock:
                         outer._rv += 1
                         obj.setdefault("metadata", {})[
@@ -324,7 +416,8 @@ class FakeAPIServer:
                 # rejects POST-to-named-resource and duplicate creates.
                 if self._crd_parts(parts) == "":
                     obj = self._read_body()
-                    outer._count("crd_create")
+                    if self._gate("crd_create"):
+                        return
                     # Status subresource semantics (the CRD declares
                     # `subresources: status: {}`): a real apiserver DROPS
                     # status on main-endpoint creates.
@@ -376,7 +469,8 @@ class FakeAPIServer:
                 if status_name:
                     # PUT /status: only the status field is applied.
                     obj = self._read_body()
-                    outer._count("crd_status_update")
+                    if self._gate("crd_status_update"):
+                        return
                     err = updated = None
                     with outer._lock:
                         existing = outer._crds.get(status_name)
@@ -397,7 +491,8 @@ class FakeAPIServer:
                 name = self._crd_parts(parts)
                 if name:
                     obj = self._read_body()
-                    outer._count("crd_update")
+                    if self._gate("crd_update"):
+                        return
                     err = None
                     with outer._lock:
                         prior = outer._crds.get(name)
@@ -435,7 +530,8 @@ class FakeAPIServer:
                 ):
                     ns, name = parts[3], parts[5]
                     patch = self._read_body()
-                    outer._count("pod_patch")
+                    if self._gate("pod_patch"):
+                        return
                     with outer._lock:
                         pod = outer._pods.get((ns, name))
                         if pod is None:
@@ -464,7 +560,8 @@ class FakeAPIServer:
                 parts = [p for p in urlparse(self.path).path.split("/") if p]
                 name = self._crd_parts(parts)
                 if name:
-                    outer._count("crd_delete")
+                    if self._gate("crd_delete"):
+                        return
                     with outer._lock:
                         outer._crds.pop(name, None)
                     return self._json(200, {"kind": "Status", "code": 200})

@@ -1,0 +1,485 @@
+"""Serve-the-ugly-day units: trace determinism, chaos scheduling,
+the compound-invariant checker, and the pinned regressions behind them.
+
+Fast tier (pure data + tiny rigs, no fleet):
+- byte-identical trace/program generation from one seed, and the
+  cursors (TraceCursor/OpCursor) stepped on a ManualClock — nothing in
+  schedule-land may read a real clock;
+- the compound-invariant checker (sim/scale.py) judged against
+  handcrafted reports: a clean report passes, every violation class
+  trips;
+- repro-line plumbing: any violating scenario must carry the exact
+  one-liner that rebuilds its (trace, program) pair;
+- two PINNED regressions (seed in the test name, repro in the
+  comment): brownout 503s must surface as KubeError and never be
+  misread as NotFound, and a failed group commit must roll back
+  cleanly and land exactly once on retry.
+
+Slow tier (a real 2-node FleetSim, same budget reasoning as
+test_fleet.py — `make chaos-matrix-smoke` is the build-time gate):
+- the compound scenario the issue names: a maintenance drain during
+  slice reform during a QoS throttle, under live trace traffic, with
+  the FULL invariant set asserted;
+- the sabotaged known-bad run: the checker must trip and emit the
+  repro line (a gate that cannot fail is not a gate).
+"""
+
+import tempfile
+import time
+
+import pytest
+
+from elastic_tpu_agent import faults
+from elastic_tpu_agent.common import EnvSliceEpoch, ManualClock
+from elastic_tpu_agent.kube.client import KubeClient, KubeError
+from elastic_tpu_agent.sim import (
+    ChaosMatrix,
+    ChaosProgram,
+    FleetSim,
+    OpCursor,
+    ScenarioRunner,
+    TraceCursor,
+    TraceGenerator,
+    repro_line,
+    scale_problems,
+)
+from elastic_tpu_agent.storage import Storage, StorageError
+from elastic_tpu_agent.types import AllocationRecord, Device, PodInfo
+
+from fake_apiserver import FakeAPIServer, make_pod
+
+
+# -- trace generation: the determinism contract -------------------------------
+
+
+def test_trace_same_seed_is_byte_identical():
+    a = TraceGenerator(seed=7, duration_s=1.5, base_rps=20.0).generate()
+    b = TraceGenerator(seed=7, duration_s=1.5, base_rps=20.0).generate()
+    assert a.lines() == b.lines()
+    assert a.digest() == b.digest()
+    # and the digest actually discriminates
+    c = TraceGenerator(seed=8, duration_s=1.5, base_rps=20.0).generate()
+    assert c.digest() != a.digest()
+
+
+def test_trace_mixes_tenancy_and_slo_classes():
+    t = TraceGenerator(
+        seed=11, duration_s=2.0, base_rps=30.0, train_pods=2,
+    ).generate()
+    reqs = t.requests()
+    assert len(reqs) > 10
+    assert {e["kind"] for e in t.pod_events()} == {
+        "pod_admit", "pod_delete",
+    }
+    assert len({r["slo"] for r in reqs}) >= 2
+    # every rid unique, times inside the window, events time-sorted
+    assert len({r["rid"] for r in reqs}) == len(reqs)
+    ts = [e["t"] for e in t.events]
+    assert ts == sorted(ts)
+    assert all(0.0 <= x <= t.meta["duration_s"] for x in ts)
+
+
+def test_hostile_chains_share_only_the_root_block():
+    t = TraceGenerator(
+        seed=3, duration_s=2.0, base_rps=30.0, hostile_fraction=1.0,
+    ).generate()
+    chains = [r["chain"] for r in t.requests()]
+    assert len(chains) > 5
+    assert len({c[0] for c in chains}) == 1  # shared root
+    assert len({c[1] for c in chains}) == len(chains)  # instant divergence
+
+
+def test_trace_cursor_paces_on_a_manual_clock():
+    trace = TraceGenerator(seed=5, duration_s=2.0, base_rps=15.0).generate()
+    clock = ManualClock()
+    cur = TraceCursor(trace)
+    seen = []
+    while not cur.exhausted:
+        clock.advance(0.25)
+        batch = list(cur.due(clock.monotonic()))
+        assert all(e["t"] <= clock.monotonic() for e in batch)
+        seen.extend(batch)
+    assert seen == trace.events  # consumed exactly once, in order
+
+
+# -- chaos programs: seeded overlap, scheduled on a manual clock --------------
+
+
+def test_program_same_seed_is_byte_identical_and_overlapping():
+    a = ChaosProgram.generate(seed=42, duration_s=3.0, nodes=2)
+    b = ChaosProgram.generate(seed=42, duration_s=3.0, nodes=2)
+    assert a.lines() == b.lines()
+    assert a.digest() == b.digest()
+    assert a.meta["overlapping_pairs"] >= 1  # compound by construction
+    assert "apiserver_brownout" in a.meta["kinds"]
+    assert ChaosProgram.generate(seed=43, duration_s=3.0).digest() != \
+        a.digest()
+
+
+def test_op_cursor_runs_the_start_stop_timeline_on_a_manual_clock():
+    prog = ChaosProgram.generate(
+        seed=9, duration_s=2.0, nodes=2, include_throttle=True,
+    )
+    ops = prog.ops()
+    assert [o["t"] for o in ops] == sorted(o["t"] for o in ops)
+    # every windowed action opens before it closes
+    windows = {}
+    for o in ops:
+        windows.setdefault(o["id"], []).append(o["op"])
+    for phases in windows.values():
+        assert phases in (["start"], ["start", "stop"])
+
+    clock = ManualClock()
+    cur = OpCursor(prog.ops())
+    fired = []
+    while not cur.exhausted:
+        clock.advance(0.1)
+        for op in cur.due(clock.monotonic()):
+            assert op["t"] <= clock.monotonic()
+            fired.append((op["op"], op["id"]))
+    assert len(fired) == len(ops)
+    # a stop never fires before its start
+    for i, a in enumerate(prog.actions):
+        if a.get("duration_s"):
+            assert fired.index(("start", i)) < fired.index(("stop", i))
+
+
+def test_repro_line_names_the_exact_bench_invocation():
+    line = repro_line(1001, 2001, "drain-under-hostile-prefix")
+    assert line == (
+        "python bench.py --chaos-matrix-smoke --trace-seed 1001 "
+        "--chaos-seed 2001 --scenario drain-under-hostile-prefix"
+    )
+
+
+def test_matrix_schedule_digest_is_reproducible():
+    # generation-only: no fleet is started here
+    a = ChaosMatrix(trace_seed=3, chaos_seed=4).schedule_digest()
+    b = ChaosMatrix(trace_seed=3, chaos_seed=4).schedule_digest()
+    assert a == b
+    assert ChaosMatrix(trace_seed=3, chaos_seed=5).schedule_digest() != a
+
+
+# -- the compound-invariant checker, judged in isolation ----------------------
+
+
+def _clean_report():
+    """The shape ScenarioRunner._score emits, with every ledger
+    balanced — the checker must stay silent on this."""
+    return {
+        "scenario": "unit",
+        "repro": repro_line(1, 1, "unit"),
+        "goodput": {
+            "goodput_percent": 97.5,
+            "conservation_problems": [],
+            "unreachable_nodes": [],
+        },
+        "slo": {"ttft": {"attainment": 1.0}, "tpot": {"attainment": 0.98}},
+        "compound": {
+            "streams": {
+                "admitted": 10, "finished": 10, "live_leftover": 0,
+                "pending_handoff_leftover": 0, "client_visible_drops": 0,
+                "finish_reasons": {"released": 10},
+            },
+            "handoffs": {"published": 2, "adopted": 2, "expired": 0},
+            "worst_residual_s": 0.001,
+            "tokens": {"emitted": 500, "accounted": 500},
+            "binds": {
+                "serve_pods": 4, "double_lands": 0,
+                "records_missing": 0, "bind_errors_during_faults": 1,
+            },
+            "open_intents": 0,
+            "throttled": {},
+        },
+        "recovery": {
+            "binds_never_landed": [], "reclaimed_bind_replays": [],
+        },
+    }
+
+
+def test_checker_passes_a_balanced_compound_report():
+    assert scale_problems(_clean_report()) == []
+
+
+def test_checker_trips_every_compound_violation_class():
+    bad = _clean_report()
+    bad["compound"]["streams"]["client_visible_drops"] = 3
+    bad["compound"]["streams"]["finished"] = 7
+    bad["compound"]["handoffs"]["expired"] = 1
+    bad["compound"]["tokens"]["accounted"] = 400
+    bad["compound"]["binds"]["double_lands"] = 1
+    bad["compound"]["open_intents"] = 2
+    bad["recovery"]["reclaimed_bind_replays"] = ["train/t-0"]
+    bad["goodput"]["conservation_problems"] = ["pod x: gap 0.2s"]
+    problems = scale_problems(bad)
+    text = "\n".join(problems)
+    for needle in (
+        "drops", "finished", "expired", "token conservation", "double",
+        "intent",
+        "replay", "conservation",
+    ):
+        assert needle in text, f"checker missed {needle!r}: {problems}"
+
+
+def test_checker_enforces_goodput_and_slo_floors():
+    r = _clean_report()
+    r["goodput"]["goodput_percent"] = 40.0
+    r["slo"]["tpot"]["attainment"] = 0.5
+    problems = scale_problems(r, {
+        "min_goodput_percent": 90.0, "min_slo_attainment": 0.9,
+    })
+    text = "\n".join(problems)
+    assert "goodput" in text and "tpot" in text
+    # floors default to off: the same report is clean without bounds
+    assert scale_problems(r) == []
+
+
+# -- pinned regression: brownout 503 is an OUTAGE, never a deletion -----------
+
+
+def test_brownout_503_surfaces_as_kube_error_never_notfound_seed_20260807():
+    """PINNED (seed=20260807, error_rate=1.0): during an apiserver
+    brownout every get must raise KubeError — get_pod returning None
+    (the NotFound contract) would let the GC read an outage as "pod
+    deleted" and reclaim live bindings. Repro: FakeAPIServer +
+    set_brownout(error_rate=1.0, seed=20260807), then GET an existing
+    pod."""
+    api = FakeAPIServer()
+    base = api.start()
+    try:
+        api.upsert_pod(make_pod("default", "alive", "node-a"))
+        client = KubeClient(base)
+        assert client.get_pod("default", "alive") is not None
+
+        api.set_brownout(error_rate=1.0, seed=20260807)
+        with pytest.raises(KubeError):
+            client.get_pod("default", "alive")
+        # even a pod that truly doesn't exist must NOT report NotFound
+        # mid-brownout: the 503 wins over the 404
+        with pytest.raises(KubeError):
+            client.get_pod("default", "ghost")
+
+        api.clear_brownout()
+        assert client.get_pod("default", "alive") is not None
+        counts = api.request_counts
+        assert counts.get("pod_get_failed", 0) >= 2  # failures split out
+        assert counts.get("pod_get", 0) >= 2  # served before/after
+    finally:
+        api.stop()
+
+
+def test_brownout_failure_sequence_replays_from_its_seed():
+    """Same seed, same request sequence ⇒ the same requests fail: the
+    brownout is part of the chaos determinism contract, not noise."""
+    def run_once():
+        api = FakeAPIServer()
+        base = api.start()
+        try:
+            api.upsert_pod(make_pod("default", "p", "node-a"))
+            client = KubeClient(base)
+            api.set_brownout(error_rate=0.5, seed=99)
+            outcomes = []
+            for _ in range(12):
+                try:
+                    client.get_pod("default", "p")
+                    outcomes.append("ok")
+                except KubeError:
+                    outcomes.append("503")
+            return outcomes
+        finally:
+            api.stop()
+
+    a, b = run_once(), run_once()
+    assert a == b
+    assert "503" in a and "ok" in a  # genuinely mixed at 0.5
+
+
+# -- pinned regression: flaky group commit rolls back, lands once -------------
+
+
+def _pod_info(name):
+    return PodInfo(
+        namespace="train",
+        name=name,
+        allocations={
+            "jax": {
+                "elasticgpu.io/tpu-core": AllocationRecord(
+                    device=Device(("d1",), "elasticgpu.io/tpu-core"),
+                    chip_indexes=[0],
+                    created_node_ids=[],
+                )
+            }
+        },
+    )
+
+
+def test_flush_fault_rolls_back_then_lands_once_on_retry_seed_20260807():
+    """PINNED (storage.batch_flush raise-once, batch_window_s=0.02):
+    a failed group commit must surface as StorageError with the write
+    ROLLED BACK — nothing partially landed — and the retry must land
+    the record exactly once (the no-double-land half of the chaos bind
+    invariant). Repro: arm storage.batch_flush=raise-once on a batched
+    store, save, retry."""
+    with tempfile.TemporaryDirectory(prefix="etpu-flush") as tmp:
+        path = f"{tmp}/meta.db"
+        store = Storage(path, batch_window_s=0.02)
+        try:
+            faults.get_registry().arm("storage.batch_flush", "raise-once")
+            with pytest.raises(StorageError):
+                store.save(_pod_info("flaky"))
+            # rolled back: a second connection sees NOTHING
+            reader = Storage(path)
+            try:
+                assert reader.load("train", "flaky") is None
+            finally:
+                reader.close()
+            # the fault was raise-once: the retry lands, exactly once
+            store.save(_pod_info("flaky"))
+            reader = Storage(path)
+            try:
+                assert reader.load("train", "flaky") is not None
+                assert reader.count() == 1
+            finally:
+                reader.close()
+        finally:
+            faults.get_registry().disarm()
+            store.close()
+
+
+# -- the compound scenario itself (slow tier: real 2-node fleet) --------------
+#
+# Budget reasoning mirrors test_fleet.py: a live fleet costs seconds of
+# fixture on the 1-CPU CI box and the fast tier runs within sight of
+# its timeout; `make chaos-matrix-smoke` (part of `make verify`) is the
+# build-time gate that executes compound scenarios every round.
+
+chaos_tier = pytest.mark.slow
+
+
+def _chaos_fleet(tmp):
+    return FleetSim(
+        tmp,
+        nodes=2,
+        reconcile_period_s=0.5,
+        slice_membership_ttl_s=0.25,
+        drain_deadline_s=30.0,
+        drain_period_s=0.25,
+        migration_period_s=0.1,
+        goodput_period_s=3600.0,
+        enable_sampler=True,
+        sampler_period_s=3600.0,
+        repartition_period_s=3600.0,
+        storage_batch_window_s=0.004,
+        sink_flush_window_s=0.02,
+    )
+
+
+@chaos_tier
+def test_compound_drain_during_reform_during_throttle():
+    """The issue's named worst case: node 1 takes a maintenance drain
+    (forcing slice reform on the survivor) while node 0's QoS loop is
+    mid-throttle, under live trace traffic and the standing brownout/
+    flush/delay faults — and every compound invariant still holds."""
+    from elastic_tpu_agent.slice_env import ordered_worker_hostnames
+
+    with tempfile.TemporaryDirectory(prefix="etpu-cx") as tmp:
+        sim = _chaos_fleet(tmp)
+        sim.start()
+        try:
+            # a live slice across both nodes: the drain must reform it
+            slice_refs = sim.admit_slice("cx", [0, 1])
+            sim.wait_synced(slice_refs)
+            for ref in slice_refs:
+                sim.bind_pod(ref)
+
+            trace = TraceGenerator(
+                seed=1001, duration_s=2.0, base_rps=10.0, train_pods=1,
+            ).generate()
+            # Handcrafted program (ChaosProgram is pure data; the same
+            # ops/executor path as generate()): the drain must OUTLAST
+            # the survivor's reform-detection latency — generate()'s
+            # windows are tempo-sized for the smoke and can close
+            # before the reform lands, which proves nothing either
+            # way. Drain on node 1 overlaps the throttle on node 0,
+            # the brownout and the flaky group commit: drain DURING
+            # reform DURING throttle.
+            program = ChaosProgram(2001, [
+                {"kind": "failpoint", "t": 0.2, "duration_s": 1.5,
+                 "point": "storage.batch_flush", "spec": "prob:0.1:11"},
+                {"kind": "apiserver_brownout", "t": 0.3,
+                 "duration_s": 1.2, "error_rate": 0.2,
+                 "latency_s": 0.001, "seed": 7},
+                {"kind": "throttle", "t": 0.4, "duration_s": 2.2,
+                 "node": 0},
+                {"kind": "maintenance_drain", "t": 0.5,
+                 "duration_s": 2.5, "node": 1},
+                {"kind": "kubelet_flap", "t": 1.0, "node": 0},
+            ], {"chaos_seed": 2001, "duration_s": 3.0, "nodes": 2})
+            assert program.overlaps() >= 3  # genuinely compound
+
+            runner = ScenarioRunner(
+                sim, trace, program, name="drain-reform-throttle",
+            )
+            report = runner.run()
+
+            # full invariant set, with loose floors on top
+            problems = scale_problems(report, {
+                "min_goodput_percent": 10.0,
+                "min_slo_attainment": 0.5,
+            })
+            assert problems == [], problems
+
+            comp = report["compound"]
+            streams = comp["streams"]
+            assert streams["admitted"] > 0
+            assert streams["admitted"] == streams["finished"]
+            assert streams["client_visible_drops"] == 0
+            assert comp["handoffs"]["published"] == \
+                comp["handoffs"]["adopted"]
+            assert comp["binds"]["double_lands"] == 0
+            assert comp["open_intents"] == 0
+            assert comp["throttled"].get("node-0") is True  # clamp seen
+            assert report["repro"] == repro_line(
+                1001, 2001, "drain-reform-throttle"
+            )
+
+            # the drain really reformed the slice: the survivor's
+            # stamped env reached a post-reform epoch
+            survivor = slice_refs[0]
+            surviving_order, _ = ordered_worker_hostnames(
+                [sim.nodes[0].name]
+            )
+            deadline = time.monotonic() + 15.0
+            epoch = -1
+            while time.monotonic() < deadline:
+                env = sim.slice_env_of(survivor)
+                epoch = int(env.get(EnvSliceEpoch, -1))
+                if epoch >= 1:
+                    break
+                time.sleep(0.1)
+            assert epoch >= 1, f"slice never reformed: epoch={epoch}"
+        finally:
+            faults.get_registry().disarm()
+            sim.stop()
+
+
+@chaos_tier
+def test_sabotaged_run_trips_the_checker_with_a_repro_line():
+    """Known-bad self-test: sabotaged stream accounting (every finish
+    a client-visible drop) MUST produce violations, and the verdict
+    must carry the exact repro line — the checker checking itself."""
+    matrix = ChaosMatrix(trace_seed=1, chaos_seed=1)
+    matrix.scenarios = [{
+        "name": "self-test",
+        "index": 0,
+        "trace": {
+            "duration_s": 1.0, "base_rps": 10.0,
+            "flash_crowds": 0, "train_pods": 0,
+        },
+        "program": {"duration_s": 1.0, "include_drain": False},
+    }]
+    with tempfile.TemporaryDirectory(prefix="etpu-st") as tmp:
+        verdict = matrix.self_test(tmp)
+    assert verdict["tripped"]
+    assert any("drops" in p for p in verdict["problems"])
+    assert verdict["repro"] == repro_line(1, 1, "self-test")

@@ -220,3 +220,65 @@ def test_concurrent_binds_with_gc_churn(cluster):
             assert operator.resolve(link_id) == chip
             expected_links.add(link_id)
     assert set(operator.list_links()) == expected_links
+
+
+# -- seeded/windowed failpoint grammar (chaos-matrix vocabulary) --------------
+#
+# The chaos programs (sim/chaos.py) compose faults from specs like
+# `prob:0.1:7` and `delay-range:0.001:0.02:7`; these pin the grammar
+# and the seeded/windowed semantics on an injectable clock, because
+# "same seed => same trips" is what makes a chaos verdict replayable.
+
+from elastic_tpu_agent import faults
+from elastic_tpu_agent.common import ManualClock
+
+
+def test_prob_fault_is_seeded_and_counts_trips_only():
+    def trips(seed):
+        reg = faults.FaultRegistry()
+        reg.arm("p", f"prob:0.3:{seed}")
+        out = []
+        for i in range(50):
+            try:
+                reg.fire("p")
+                out.append(False)
+            except faults.FaultError:
+                out.append(True)
+        assert reg.fired("p") == sum(out)  # non-trips never counted
+        return out
+
+    assert trips(7) == trips(7)  # same seed, same draws
+    assert trips(7) != trips(8)
+    assert 0 < sum(trips(7)) < 50  # genuinely probabilistic at 0.3
+
+
+def test_delay_range_fault_sleeps_within_bounds():
+    reg = faults.FaultRegistry()
+    reg.arm("d", "delay-range:0.001:0.01:7")
+    import time as _time
+    for _ in range(5):
+        t0 = _time.perf_counter()
+        reg.fire("d")  # never raises; sleeps a seeded uniform draw
+        assert _time.perf_counter() - t0 >= 0.0005
+    assert reg.fired("d") == 5
+
+
+def test_window_fault_trips_only_inside_its_window():
+    clock = ManualClock()
+    reg = faults.FaultRegistry(clock=clock)
+    reg.arm("w", "window:1.0:2.0")  # armed_at anchors the window
+    reg.fire("w")  # t=0: before the window — silent
+    clock.advance(1.5)
+    with pytest.raises(faults.FaultError):
+        reg.fire("w")  # t=1.5: inside
+    clock.advance(2.0)
+    reg.fire("w")  # t=3.5: expired — silent again
+    assert reg.fired("w") == 1
+
+
+def test_bad_specs_are_rejected_loudly():
+    reg = faults.FaultRegistry()
+    for bad in ("prob:", "prob:1.5:3", "delay-range:0.5:0.1",
+                "window:1.0", "no-such-kind"):
+        with pytest.raises(ValueError):
+            reg.arm("x", bad)
