@@ -5,7 +5,7 @@ TAG ?= elastic-tpu-agent:latest
 # verify's tier-1 line uses pipefail, which /bin/sh (dash) lacks
 SHELL := /bin/bash
 
-.PHONY: all native sanitize test test-all verify doctor-smoke chaos-smoke bench-smoke crash-replay-smoke fleet-smoke scale-smoke slice-smoke drain-smoke migrate-smoke timeline-smoke serving-smoke request-obs-smoke qos-smoke goodput-smoke latency-smoke chaos-matrix-smoke perf-gate protos image bench clean
+.PHONY: all native sanitize test test-all verify doctor-smoke chaos-smoke bench-smoke crash-replay-smoke fleet-smoke event-smoke scale-smoke slice-smoke drain-smoke migrate-smoke timeline-smoke serving-smoke request-obs-smoke qos-smoke goodput-smoke latency-smoke chaos-matrix-smoke perf-gate protos image bench clean
 
 all: native test
 
@@ -93,6 +93,15 @@ crash-replay-smoke:
 # stopped scaling past one node) fails the build, not a dashboard.
 fleet-smoke:
 	JAX_PLATFORMS=cpu python3 bench.py --fleet-smoke
+
+# event smoke: the event-driven core gate (bench.py --event-smoke) —
+# 2-node fleet, kill a bound pod's checkpoint record: the store's own
+# delete notification must drive event-to-repair p50 under 50ms, a
+# bus-suppressed (dropped) notification must still be caught by the
+# stretched safety-net sweep, and the poll-only fallback must heal the
+# same divergence with events disabled entirely.
+event-smoke:
+	JAX_PLATFORMS=cpu python3 bench.py --event-smoke
 
 # scale smoke: the thousand-pod scale-harness gate (bench.py
 # --scale-smoke): 8 in-process agents x 64 pods driven through the full
@@ -241,7 +250,7 @@ perf-gate:
 	python3 -m elastic_tpu_agent.cli perf-gate --self-test
 
 T1_TIMEOUT ?= 870
-verify: doctor-smoke chaos-smoke bench-smoke crash-replay-smoke fleet-smoke scale-smoke slice-smoke drain-smoke migrate-smoke timeline-smoke serving-smoke request-obs-smoke qos-smoke goodput-smoke latency-smoke chaos-matrix-smoke perf-gate
+verify: doctor-smoke chaos-smoke bench-smoke crash-replay-smoke fleet-smoke event-smoke scale-smoke slice-smoke drain-smoke migrate-smoke timeline-smoke serving-smoke request-obs-smoke qos-smoke goodput-smoke latency-smoke chaos-matrix-smoke perf-gate
 	python -c "from prometheus_client import CollectorRegistry; \
 	  from elastic_tpu_agent.metrics import AgentMetrics; \
 	  AgentMetrics(registry=CollectorRegistry()); \

@@ -556,6 +556,7 @@ def run_fleet(
     slice_scenario=True,
     drain_scenario=True,
     migrate_scenario=True,
+    event_leg=True,
 ):
     from elastic_tpu_agent.sim import FleetAggregator, FleetSim
 
@@ -670,6 +671,22 @@ def run_fleet(
                 "skipped": True,
                 "reason": "migration scenario disabled for this run",
             }
+        # Event-driven core A/B (ISSUE 19): its own pair of small sims
+        # — the injection deletes live checkpoint records, so it must
+        # not share the fleet churn's nodes. Same skip/fail contract.
+        if event_leg:
+            try:
+                events_report = run_event_leg()
+            except Exception as e:  # noqa: BLE001 - failure, not a skip
+                events_report = {
+                    "failed": True,
+                    "error": f"{type(e).__name__}: {e}",
+                }
+        else:
+            events_report = {
+                "skipped": True,
+                "reason": "event leg disabled for this run",
+            }
         fleet = rollup["fleet"]
         return {
             "nodes": nodes,
@@ -695,6 +712,10 @@ def run_fleet(
             # drain-to-resume downtime vs the deadline baseline (or an
             # explicit skip/fail)
             "migration": migration_report,
+            # event-driven core: same-run event vs poll repair A/B,
+            # detection-lag trigger split, churn bind p99 (or an
+            # explicit skip/fail)
+            "events": events_report,
             "driver": driver,
             "stored_binds": stored,
             "per_node": rollup["per_node"],
@@ -745,6 +766,9 @@ def fleet_smoke_main():
             slice_scenario=False,
             drain_scenario=False,
             migrate_scenario=False,
+            # `make event-smoke` owns the event-core gate; keep this
+            # one focused (and its runtime bounded).
+            event_leg=False,
         )
     except Exception as e:  # noqa: BLE001
         print(json.dumps({"fleet_smoke": {
@@ -803,6 +827,274 @@ def fleet_smoke_main():
             print(f"fleet smoke FAILED: {p}", file=sys.stderr)
         return 1
     print("fleet smoke: OK", file=sys.stderr)
+    return 0
+
+
+# -- event-driven core: event vs poll repair A/B (ISSUE 19) -------------------
+#
+# The tentpole measurement: the same lost-record divergence injected
+# into an events-on fleet and a poll-only fleet, stopwatched from
+# injection to the reconciler's replayed bind. With events on, the
+# store's own delete notification triggers a targeted pass within the
+# debounce window; poll-only waits out the jittered sweep. The leg also
+# reports the detection-lag trigger split (satellite: the {trigger}
+# label on elastic_tpu_detection_lag_seconds) and a driver-side churn
+# bind p99 for the perf-gate `bind_churn_p99_ms` series.
+
+EVENT_LEG_NODES = 2
+EVENT_LEG_PODS_PER_NODE = 8
+EVENT_LEG_TRIALS = 5
+EVENT_REPAIR_TARGET_MS = 50.0
+EVENT_LEG_PERIOD_S = 1.0
+EVENT_LEG_SAFETY_FACTOR = 4.0
+
+
+def _await_record(node, ref, timeout_s=15.0, poll_s=0.001):
+    """Milliseconds until the pod's checkpoint record reappears (the
+    reconciler replaying the still-listed kubelet assignment); None on
+    timeout."""
+    t0 = time.perf_counter()
+    deadline = t0 + timeout_s
+    while time.perf_counter() < deadline:
+        if node.manager.storage.load(ref.namespace, ref.name) is not None:
+            return (time.perf_counter() - t0) * 1000.0
+        time.sleep(poll_s)
+    return None
+
+
+def _lost_record_trials(sim, refs, trials, settle_s=0.15, timeout_s=15.0):
+    """Delete bound pods' checkpoint records one at a time and measure
+    record-gone -> record-replayed. Marks the divergence origin so the
+    detection-lag tracker prices the same repair under its {trigger}
+    split. Returns (lags_ms, failures)."""
+    lags, failures = [], []
+    for i in range(trials):
+        ref = refs[i % len(refs)]
+        node = sim.nodes[ref.node_idx]
+        node.manager.lag_tracker.mark("replayed_bind", key=ref.pod_key)
+        node.manager.storage.delete(ref.namespace, ref.name)
+        ms = _await_record(node, ref, timeout_s=timeout_s)
+        if ms is None:
+            failures.append(ref.pod_key)
+        else:
+            lags.append(ms)
+        # Clear the reconciler's event min-interval pacing between
+        # trials so each one measures a cold event->pass wake, not the
+        # tail of the previous pass's pacing window.
+        time.sleep(settle_s)
+    return lags, failures
+
+
+def _lag_trigger_split(sim, cls="replayed_bind"):
+    """Merged {trigger: {count, p50_s}} for one divergence class across
+    the fleet's detection-lag trackers."""
+    merged = {}
+    for node in sim.nodes:
+        try:
+            st = node.manager.lag_tracker.status()
+        except Exception:  # noqa: BLE001 - introspection only
+            continue
+        triggers = (st.get("classes", {}).get(cls) or {}).get("triggers", {})
+        for trig, s in triggers.items():
+            agg = merged.setdefault(trig, {"count": 0, "p50_s": []})
+            agg["count"] += s.get("count", 0)
+            if s.get("p50_s") is not None:
+                agg["p50_s"].append(s["p50_s"])
+    for trig, agg in merged.items():
+        vals = agg["p50_s"]
+        agg["p50_s"] = round(sum(vals) / len(vals), 6) if vals else None
+    return merged
+
+
+def _pctl(sorted_vals, q):
+    if not sorted_vals:
+        return None
+    idx = min(len(sorted_vals) - 1, int(q * len(sorted_vals)))
+    return round(sorted_vals[idx], 3)
+
+
+def run_event_leg(
+    nodes=EVENT_LEG_NODES,
+    pods_per_node=EVENT_LEG_PODS_PER_NODE,
+    trials=EVENT_LEG_TRIALS,
+    reconcile_period_s=EVENT_LEG_PERIOD_S,
+    safety_net_factor=EVENT_LEG_SAFETY_FACTOR,
+    safety_net_check=False,
+):
+    """Same-run event vs poll repair A/B on two small fleets.
+
+    With ``safety_net_check`` (the event smoke), the events-on fleet
+    additionally proves the backstop: one store-delete notification is
+    suppressed at the bus (the chaos seam), and the stretched periodic
+    sweep must still repair the divergence."""
+    from elastic_tpu_agent.sim import FleetSim
+    from elastic_tpu_agent import events as events_mod
+
+    report = {"nodes": nodes, "pods_per_node": pods_per_node,
+              "trials": trials,
+              "reconcile_period_s": reconcile_period_s,
+              "safety_net_factor": safety_net_factor}
+
+    # Phase A: events ON, safety net stretched (the production shape).
+    with tempfile.TemporaryDirectory(prefix="etpu-evt-a") as tmp:
+        sim = FleetSim(
+            tmp, nodes=nodes, reconcile_period_s=reconcile_period_s,
+            enable_events=True, event_safety_net_factor=safety_net_factor,
+        )
+        try:
+            sim.start()
+            refs = sim.admit_pods(pods_per_node)
+            sim.wait_synced(refs)
+            churn = sim.churn(refs, workers_per_node=2)
+            lags, failures = _lost_record_trials(sim, refs, trials)
+            lags.sort()
+            node0 = sim.nodes[0]
+            report["event"] = {
+                "repair_p50_ms": _pctl(lags, 0.5),
+                "repair_p99_ms": _pctl(lags, 0.99),
+                "repair_ms": [round(v, 3) for v in lags],
+                "failures": failures,
+                "bus": node0.manager.bus.stats(),
+                "reconciler_events": (
+                    node0.manager.reconciler.status().get("events")
+                ),
+            }
+            report["bind_churn_p99_ms"] = churn["bind_p99_ms"]
+            report["bind_churn_p50_ms"] = churn["bind_p50_ms"]
+            report["detection_lag_triggers"] = _lag_trigger_split(sim)
+            if safety_net_check:
+                # Drop the very notification the repair above rode on:
+                # the divergence becomes invisible to the bus, and only
+                # the stretched periodic sweep can catch it.
+                ref = refs[0]
+                node = sim.nodes[ref.node_idx]
+                node.manager.bus.suppress(events_mod.STORE_BIND, 1)
+                node.manager.storage.delete(ref.namespace, ref.name)
+                budget_s = (
+                    reconcile_period_s * safety_net_factor * 1.25 + 10.0
+                )
+                ms = _await_record(node, ref, timeout_s=budget_s)
+                report["safety_net"] = {
+                    "suppressed": node.manager.bus.stats()[
+                        "suppressed_total"
+                    ],
+                    "repair_ms": round(ms, 3) if ms is not None else None,
+                    "budget_s": round(budget_s, 3),
+                    "caught": ms is not None,
+                }
+        finally:
+            sim.stop()
+
+    # Phase B: events OFF — the exact pre-event polling shape.
+    with tempfile.TemporaryDirectory(prefix="etpu-evt-b") as tmp:
+        sim = FleetSim(
+            tmp, nodes=nodes, reconcile_period_s=reconcile_period_s,
+            enable_events=False,
+        )
+        try:
+            sim.start()
+            refs = sim.admit_pods(pods_per_node)
+            sim.wait_synced(refs)
+            sim.churn(refs, workers_per_node=2)
+            # Fewer trials: each one waits out a real poll period.
+            n = max(2, trials - 2)
+            lags, failures = _lost_record_trials(
+                sim, refs, n,
+                timeout_s=reconcile_period_s * 4 + 10.0,
+            )
+            lags.sort()
+            report["poll"] = {
+                "repair_p50_ms": _pctl(lags, 0.5),
+                "repair_p99_ms": _pctl(lags, 0.99),
+                "repair_ms": [round(v, 3) for v in lags],
+                "failures": failures,
+                "bus": None,
+            }
+        finally:
+            sim.stop()
+    ep, pp = (report["event"]["repair_p50_ms"],
+              report["poll"]["repair_p50_ms"])
+    report["event_to_repair_ms"] = ep
+    report["poll_to_repair_ms"] = pp
+    report["speedup"] = (
+        round(pp / ep, 2) if ep and pp else None
+    )
+    return report
+
+
+def event_smoke_main():
+    """`make event-smoke` / `bench.py --event-smoke`: the event-driven
+    core gate on a 2-node fleet.
+
+    - kill a bound pod's checkpoint record -> the store's own delete
+      notification triggers a targeted reconcile pass; event-to-repair
+      p50 must beat EVENT_REPAIR_TARGET_MS (vs a multi-second poll
+      period);
+    - safety net: one suppressed notification (bus.suppress, the chaos
+      seam) must still be repaired by the stretched periodic sweep;
+    - poll-only equivalence: the same divergence heals with events
+      disabled entirely (the correctness baseline);
+    - the detection-lag {trigger} split must show the event passes.
+    """
+    problems = []
+    try:
+        r = run_event_leg(safety_net_check=True)
+    except Exception as e:  # noqa: BLE001
+        print(json.dumps({"event_smoke": {
+            "error": f"{type(e).__name__}: {e}",
+        }}))
+        print(f"event smoke FAILED: {type(e).__name__}: {e}",
+              file=sys.stderr)
+        return 1
+    ev = r["event"]
+    if ev["failures"]:
+        problems.append(
+            f"event-mode repairs never landed for {ev['failures']}"
+        )
+    p50 = ev["repair_p50_ms"]
+    if p50 is None or p50 >= EVENT_REPAIR_TARGET_MS:
+        problems.append(
+            f"event-to-repair p50 {p50}ms misses the "
+            f"<{EVENT_REPAIR_TARGET_MS}ms target (trials: "
+            f"{ev['repair_ms']})"
+        )
+    sn = r.get("safety_net") or {}
+    if not sn.get("caught"):
+        problems.append(
+            "safety-net sweep did NOT repair the suppressed-event "
+            f"divergence within {sn.get('budget_s')}s"
+        )
+    if not sn.get("suppressed"):
+        problems.append(
+            "bus.suppress consumed no event — the dropped-event "
+            "injection never armed"
+        )
+    po = r["poll"]
+    if po["failures"]:
+        problems.append(
+            f"poll-only repairs never landed for {po['failures']} — "
+            "the fallback mode is not equivalent"
+        )
+    trig = r.get("detection_lag_triggers", {})
+    if not (trig.get("event") or {}).get("count"):
+        problems.append(
+            "detection-lag trigger split shows no event-attributed "
+            f"repairs: {trig}"
+        )
+    # Sanity, not a perf gate: events must not be SLOWER than the poll
+    # baseline (a wiring regression would show exactly that).
+    if (p50 is not None and po["repair_p50_ms"] is not None
+            and p50 > po["repair_p50_ms"]):
+        problems.append(
+            f"event-mode p50 {p50}ms is slower than poll-only "
+            f"{po['repair_p50_ms']}ms"
+        )
+    print(json.dumps({"event_smoke": r, "problems": problems}))
+    if problems:
+        for p in problems:
+            print(f"event smoke FAILED: {p}", file=sys.stderr)
+        return 1
+    print("event smoke: OK", file=sys.stderr)
     return 0
 
 
@@ -2074,14 +2366,16 @@ def _cli_arg(flag, default, cast):
     return default
 
 
-def _chaos_matrix(trace_seed, chaos_seed, scenario=None, bounds=None):
+def _chaos_matrix(trace_seed, chaos_seed, scenario=None, bounds=None,
+                  enable_events=True):
     """Build the matrix, optionally filtered to one named scenario —
     the filtered spec keeps its original index so its sub-seeds (and
     therefore its trace and program) match the full-matrix run the
     repro line came from."""
     from elastic_tpu_agent.sim import ChaosMatrix
 
-    matrix = ChaosMatrix(trace_seed=trace_seed, chaos_seed=chaos_seed)
+    matrix = ChaosMatrix(trace_seed=trace_seed, chaos_seed=chaos_seed,
+                         enable_events=enable_events)
     if scenario is not None:
         keep = [
             dict(spec, index=i)
@@ -2175,6 +2469,16 @@ def chaos_matrix_smoke_main():
         with tempfile.TemporaryDirectory(prefix="etpu-chaos-") as td:
             out = matrix.run(os.path.join(td, "m"))
             self_test = matrix.self_test(os.path.join(td, "st"))
+            # Poll-only spot check (ISSUE 19): the first scenario again
+            # with the event bus disabled — the periodic sweeps are the
+            # correctness backstop, so every invariant must hold with
+            # events off too.
+            poll_matrix = _chaos_matrix(
+                trace_seed, chaos_seed,
+                scenario or matrix.scenarios[0]["name"],
+                bounds=CHAOS_SMOKE_BOUNDS, enable_events=False,
+            )
+            poll_out = poll_matrix.run(os.path.join(td, "p"))
         wall_s = round(time.monotonic() - t0, 3)
     except Exception as e:  # noqa: BLE001 - the gate reports, never hides
         print(json.dumps({"chaos_matrix_smoke": {
@@ -2185,6 +2489,8 @@ def chaos_matrix_smoke_main():
         return 1
 
     problems = list(out["problems"])
+    for p in poll_out["problems"]:
+        problems.append(f"poll-only mode: {p}")
     if digest_a != digest_b:
         problems.append(
             f"schedule generation not deterministic: "
@@ -2205,13 +2511,16 @@ def chaos_matrix_smoke_main():
         "scenarios": [
             _chaos_scenario_summary(r) for r in out["scenarios"]
         ],
+        "poll_only": [
+            _chaos_scenario_summary(r) for r in poll_out["scenarios"]
+        ],
         "self_test": self_test,
         "problems": problems,
     }}))
     if problems:
         for p in problems:
             print(f"chaos-matrix smoke FAILED: {p}", file=sys.stderr)
-        for r in out["scenarios"]:
+        for r in out["scenarios"] + poll_out["scenarios"]:
             if r.get("problems"):
                 print(f"chaos-matrix repro: {r['repro']}",
                       file=sys.stderr)
@@ -4572,6 +4881,23 @@ def main():
             }
     else:
         qos = {"skipped": True, "reason": "chip unreachable this round"}
+    # Headline event-core series for the perf gate, lifted out of the
+    # fleet leg's A/B (the full report stays under extra.fleet.events).
+    ev = fleet.get("events") if isinstance(fleet, dict) else None
+    if isinstance(ev, dict) and not ev.get("skipped") and not ev.get(
+        "failed"
+    ):
+        event_core = {
+            "event_to_repair_ms": ev.get("event_to_repair_ms"),
+            "poll_to_repair_ms": ev.get("poll_to_repair_ms"),
+            "bind_churn_p99_ms": ev.get("bind_churn_p99_ms"),
+            "speedup": ev.get("speedup"),
+        }
+    else:
+        event_core = {
+            "skipped": True,
+            "reason": "fleet event leg unavailable this round",
+        }
     vs_baseline = ref["bind_p50_ms"] / ours["bind_p50_ms"]
     load_ratio = probe_s / _HOST_PROBE_REF_S
     # Headline = the RATIO: both sides of it ran in this process under
@@ -4643,6 +4969,10 @@ def main():
             # invariants judged, reproducible from the seeds in the
             # embedded repro line.
             "chaos": chaos_leg,
+            # Event-driven core headline numbers lifted from the fleet
+            # leg's A/B for the perf gate (bench_history tracks
+            # event_to_repair_ms and bind_churn_p99_ms here).
+            "event_core": event_core,
             "tpu": tpu,
             "qos_colocation": qos,
         },
@@ -4659,6 +4989,8 @@ if __name__ == "__main__":
         sys.exit(churn_smoke_main())
     elif "--fleet-smoke" in sys.argv:
         sys.exit(fleet_smoke_main())
+    elif "--event-smoke" in sys.argv:
+        sys.exit(event_smoke_main())
     elif "--slice-smoke" in sys.argv:
         sys.exit(slice_smoke_main())
     elif "--drain-smoke" in sys.argv:

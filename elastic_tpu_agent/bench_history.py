@@ -53,6 +53,20 @@ TRACKED_RATIOS: Tuple[Tuple[str, Tuple[str, ...]], ...] = (
      ("parsed", "extra", "qos_repartition", "live_speedup")),
 )
 
+# Event-core series: lower-is-better milliseconds from the fleet
+# bench's event leg (bench.py run_event_leg). Like the ratio series
+# these entered the bench after the committed history began, so they
+# are tolerant-of-missing — rounds that predate the event core simply
+# contribute no point and are NOT schema errors — but once published,
+# an event-to-repair or churn-bind-p99 blowup trips the gate exactly
+# like a bind-latency regression does.
+TRACKED_EVENT: Tuple[Tuple[str, Tuple[str, ...]], ...] = (
+    ("event_to_repair_ms",
+     ("parsed", "extra", "event_core", "event_to_repair_ms")),
+    ("bind_churn_p99_ms",
+     ("parsed", "extra", "event_core", "bind_churn_p99_ms")),
+)
+
 DEFAULT_TOLERANCE = 0.5   # +50% over the rolling-median baseline
 DEFAULT_FLOOR_MS = 0.25   # plus absolute slack: sub-ms jitter never trips
 DEFAULT_FLOOR_RATIO = 0.05  # ratio-series absolute slack (unitless)
@@ -191,7 +205,7 @@ def perf_gate(
     problems: List[str] = []
     if len(rounds) < MIN_ROUNDS:
         return problems  # one point is a datum, not a trajectory
-    for name, points in sorted(series(rounds).items()):
+    for name, points in sorted(series(rounds, TRACKED + TRACKED_EVENT).items()):
         if len(points) < MIN_ROUNDS:
             continue
         n, latest = points[-1]
@@ -264,6 +278,9 @@ def self_test(
     problems.extend(ratio_self_test(
         rounds, tolerance=tolerance, window=window,
     ))
+    problems.extend(event_self_test(
+        rounds, tolerance=tolerance, floor_ms=floor_ms, window=window,
+    ))
     return problems
 
 
@@ -314,3 +331,55 @@ def ratio_self_test(
             "did NOT trip the gate"
         ]
     return []
+
+
+def event_self_test(
+    rounds: List[dict],
+    tolerance: float = DEFAULT_TOLERANCE,
+    floor_ms: float = DEFAULT_FLOOR_MS,
+    window: int = DEFAULT_WINDOW,
+) -> List[str]:
+    """Prove the event-core gate can fail: for each event series, seed
+    a blown-up latency and assert it trips. Uses the committed
+    trajectory once it carries event-core points; until then a
+    synthetic three-round trajectory — same rationale as
+    ratio_self_test: a gate whose failure mode is only provable on
+    future data is not yet a gate."""
+    problems: List[str] = []
+    for name, path in TRACKED_EVENT:
+        base = [r for r in rounds if isinstance(_dig(r["data"], path),
+                                                (int, float))]
+        if len(base) >= MIN_ROUNDS:
+            trajectory = base
+            seeded = copy.deepcopy(base[-1])
+            seeded["n"] = base[-1]["n"] + 1
+        else:
+            trajectory = []
+            for i, value in enumerate((20.0, 22.0, 21.0)):
+                data: dict = {}
+                node = data
+                for key in path[:-1]:
+                    node = node.setdefault(key, {})
+                node[path[-1]] = value
+                trajectory.append({
+                    "n": i + 1, "path": f"<synthetic-{i + 1}>",
+                    "data": data,
+                })
+            seeded = copy.deepcopy(trajectory[-1])
+            seeded["n"] = trajectory[-1]["n"] + 1
+        seeded["path"] = "<seeded-event-regression>"
+        node = seeded["data"]
+        for key in path[:-1]:
+            node = node.setdefault(key, {})
+        blown = float(node[path[-1]]) * (1.0 + tolerance) * 4 + 10 * floor_ms
+        node[path[-1]] = blown
+        tripped = perf_gate(
+            [*trajectory, seeded], tolerance=tolerance,
+            floor_ms=floor_ms, window=window,
+        )
+        if not any(f"REGRESSION {name}" in p for p in tripped):
+            problems.append(
+                f"self-test: seeded blowup of {name} to {blown:.3f}ms "
+                "did NOT trip the gate"
+            )
+    return problems

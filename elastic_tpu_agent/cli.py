@@ -178,6 +178,18 @@ def parse_args(argv=None) -> argparse.Namespace:
                         "lingers this long so a bind's burst of "
                         "apiserver writes batches and same-object "
                         "updates dedup (0 = drain immediately)")
+    p.add_argument("--no-event-bus", action="store_true",
+                   help="disable the in-process event bus (events.py): "
+                        "every loop reverts to its pre-event jittered "
+                        "poll at the base period (poll-only fallback "
+                        "mode — the correctness baseline the safety-net "
+                        "sweep preserves)")
+    p.add_argument("--event-safety-net-factor", type=float, default=10.0,
+                   help="how much a loop stretches its periodic "
+                        "safety-net sweep while the event bus is "
+                        "healthy and the loop is quiet (events.py; "
+                        "clamped to >= 1). The sweep remains the "
+                        "correctness backstop for dropped events")
     p.add_argument("--slow-span-ms", type=float, default=None,
                    help="log + journal any trace span slower than this "
                         "many milliseconds as a slow_span timeline event "
@@ -611,7 +623,9 @@ def perf_gate_main(argv=None) -> int:
     problems.extend(bh.validate_history(rounds))
     if not problems:
         if args.series:
-            all_tracked = (*bh.TRACKED, *bh.TRACKED_RATIOS)
+            all_tracked = (
+                *bh.TRACKED, *bh.TRACKED_RATIOS, *bh.TRACKED_EVENT,
+            )
             for name, points in sorted(
                 bh.series(rounds, all_tracked).items()
             ):
@@ -633,7 +647,8 @@ def perf_gate_main(argv=None) -> int:
             print(f"PERF-GATE: {problem}", file=sys.stderr)
         return 1
     tracked = ", ".join(
-        name for name, _ in (*bh.TRACKED, *bh.TRACKED_RATIOS)
+        name for name, _ in
+        (*bh.TRACKED, *bh.TRACKED_RATIOS, *bh.TRACKED_EVENT)
     )
     print(
         f"perf-gate OK: {len(rounds)} round(s), tracked [{tracked}]"
@@ -720,6 +735,8 @@ def main(argv=None) -> int:
             profile_hz=args.profile_hz,
             storage_batch_window_s=args.storage_batch_window,
             sink_flush_window_s=args.sink_flush_window,
+            enable_event_bus=not args.no_event_bus,
+            event_safety_net_factor=args.event_safety_net_factor,
             **(
                 {"timeline_cap": args.timeline_cap}
                 if args.timeline_cap is not None else {}

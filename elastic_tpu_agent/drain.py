@@ -138,6 +138,8 @@ class DrainOrchestrator:
         timeline=None,
         clock=None,
         lag_tracker=None,
+        bus=None,
+        event_safety_net_factor: float = 1.0,
     ) -> None:
         self._operator = operator
         self._plugin = plugin
@@ -156,6 +158,27 @@ class DrainOrchestrator:
         self._rng = rng if rng is not None else random.Random()
         self._timeline = timeline
         self._clock = clock if clock is not None else SYSTEM_CLOCK
+        # Event bus (events.py): record deletes / agent_state writes /
+        # pod deltas wake a mid-drain tick immediately (a drain whose
+        # last resident exits converges on the event, not the next
+        # period). The IDLE tick keeps its base period regardless of
+        # the factor — maintenance triggers come from the metadata
+        # poll, which no bus event can carry — and mid-drain stretched
+        # waits are capped at the reclaim deadline (see run()).
+        self._bus = bus
+        self.event_safety_net_factor = max(1.0, float(
+            event_safety_net_factor
+        ))
+        self._event_sub = None
+        if bus is not None:
+            from . import events as bus_events
+
+            self._event_sub = bus.subscribe(
+                "drain",
+                (bus_events.POD_DELTA, bus_events.STORE_BIND,
+                 bus_events.STORE_STATE),
+            )
+        self.event_ticks_total = 0
         # Wall-clock phase anchors ("cordon", "signaled"), journaled so
         # a mid-drain restart keeps measuring from the real start; the
         # observed set is journaled too — a restart after Drained must
@@ -984,8 +1007,51 @@ class DrainOrchestrator:
         consecutive_failures = 0
         while True:
             delay = self.period_s * (0.75 + 0.5 * self._rng.random())
-            if stop.wait(delay):
-                return
+            sub = self._event_sub
+            with self._lock:
+                state, deadline_ts = self.state, self.deadline_ts
+            if (
+                sub is not None and state != ACTIVE
+                and self._bus.healthy()
+            ):
+                # Mid-lifecycle the resident set drives the state
+                # machine, and resident changes arrive as store events
+                # — the sweep can stretch. Never past the reclaim
+                # deadline though: the deadline is a contract, not a
+                # divergence events could flag.
+                delay *= self.event_safety_net_factor
+                if deadline_ts is not None:
+                    to_deadline = deadline_ts - self._clock.time()
+                    delay = max(0.05, min(delay, to_deadline + 0.05))
+            if sub is None:
+                if stop.wait(delay):
+                    return
+            else:
+                end = time.monotonic() + delay
+                while True:
+                    remaining = end - time.monotonic()
+                    if remaining <= 0:
+                        break  # periodic tick
+                    trigger = sub.wait_trigger(stop, remaining)
+                    if trigger == "stop":
+                        return
+                    if trigger == "poll":
+                        break
+                    if state == ACTIVE:
+                        # No lifecycle in progress: pod/bind churn is
+                        # irrelevant here and must not turn the idle
+                        # metadata poll into an event-rate hammer —
+                        # drain the burst and keep waiting out the
+                        # SAME period (events never starve the tick).
+                        sub.drain()
+                        if stop.wait(0.05):
+                            return
+                        continue
+                    if stop.wait(0.01):  # coalesce the burst
+                        return
+                    sub.drain()
+                    self.event_ticks_total += 1
+                    break
             try:
                 self.tick()
                 consecutive_failures = 0

@@ -32,6 +32,7 @@ import time
 from abc import ABC, abstractmethod
 from typing import Dict, Optional
 
+from .. import events as events_mod
 from ..rpc import PodResourcesClient
 from ..tracing import get_tracer
 from ..types import Device, PodContainer, device_hash
@@ -80,13 +81,20 @@ class PodResourcesSnapshotSource:
     # serializing misses one stalled List at a time.
     STALL_WAIT_TIMEOUT_S = 6.0
 
-    def __init__(self, client: PodResourcesClient, metrics=None) -> None:
+    def __init__(self, client: PodResourcesClient, metrics=None,
+                 bus=None) -> None:
         self._client = client
         # Optional AgentMetrics: every List issued is counted in
         # elastic_tpu_kubelet_list_total so per-bind kubelet request
         # amplification is measured at the source (fleet aggregator),
         # not inferred from locator stats after the fact.
         self._metrics = metrics
+        # Optional events.EventBus: every installed List is diffed
+        # against the previous one and the per-hash deltas published on
+        # ASSIGNMENT_DELTA, so subscribed loops (reconciler, sampler
+        # join) react to kubelet-side assignment changes instead of
+        # rediscovering them on their next sweep.
+        self._bus = bus
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
         # resource -> device-set hash -> owner
@@ -249,13 +257,27 @@ class PodResourcesSnapshotSource:
                 sp.set(pods=len(resp.pod_resources))
             fresh, assign = self._build_index(resp)
             install = self._capped(fresh)
+            deltas = None
             with self._cond:
                 if seq > self._installed_seq:
+                    if self._bus is not None and self._installed_seq > 0:
+                        deltas = self._assignment_deltas(
+                            self._last_assign, assign
+                        )
                     self._installed_seq = seq
                     self._snapshot = install
                     self._last_full = fresh
                     self._last_assign = assign
                 self._done_seq = max(self._done_seq, seq)
+            if deltas:
+                # Published OUTSIDE the cond: publish fans out to
+                # subscriber queues (their own locks) and must never
+                # extend the snapshot critical section.
+                for kind, resource, hsh, owner in deltas:
+                    self._bus.publish(
+                        events_mod.ASSIGNMENT_DELTA, kind=kind, key=hsh,
+                        payload={"resource": resource, "owner": owner},
+                    )
             return fresh
         finally:
             # ANY exit — including a parse failure after a successful
@@ -265,6 +287,33 @@ class PodResourcesSnapshotSource:
                 self._refresh_active -= 1
                 self._refreshing -= 1
                 self._cond.notify_all()
+
+    @staticmethod
+    def _assignment_deltas(old: Dict[str, Dict[str, tuple]],
+                           new: Dict[str, Dict[str, tuple]]) -> list:
+        """Per-hash diff between two kubelet assignment snapshots:
+        ``(kind, resource, hash, "ns/pod/container")`` tuples with kind
+        in added/removed/owner-changed. O(assignments); bounded by node
+        pod count."""
+        deltas = []
+        for resource in set(old) | set(new):
+            before = old.get(resource, {})
+            after = new.get(resource, {})
+            for hsh in set(before) | set(after):
+                b, a = before.get(hsh), after.get(hsh)
+                if b is None and a is not None:
+                    kind, owner = "added", a[0]
+                elif b is not None and a is None:
+                    kind, owner = "removed", b[0]
+                elif b is not None and a is not None and b[0] != a[0]:
+                    kind, owner = "owner-changed", a[0]
+                else:
+                    continue
+                deltas.append((
+                    kind, resource, hsh,
+                    f"{owner.pod_key}/{owner.container}",
+                ))
+        return deltas
 
     def invalidate(self) -> None:
         with self._lock:

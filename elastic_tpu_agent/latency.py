@@ -19,9 +19,12 @@ Two complementary ledgers, both dependency-free and bounded:
   timestamps (stub operator), file payload timestamps (usage reports,
   checkpoint acks), journal rows, or explicit :meth:`mark` calls from
   tests and the fleet sim. Surfaced as
-  ``elastic_tpu_detection_lag_seconds{loop,stage}`` and rolled up
-  per divergence class by the fleet aggregator — the number the
-  event-driven refactor must move from ~0.7s to <50ms.
+  ``elastic_tpu_detection_lag_seconds{loop,stage,trigger}`` and rolled
+  up per divergence class by the fleet aggregator; ``trigger`` says
+  what woke the observing pass (``event`` = targeted event-bus pass,
+  ``poll`` = periodic safety-net sweep), making the event-driven
+  core's <50ms event-to-repair claim directly comparable against the
+  ~0.7s poll baseline per loop.
 
 Design constraints (same as tracing.py):
 - stdlib only; importable everywhere the agent runs;
@@ -395,31 +398,35 @@ class DetectionLagTracker:
 
     def detected(
         self, loop: str, cls: str, key: str = "",
-        origin_ts: Optional[float] = None,
+        origin_ts: Optional[float] = None, trigger: str = "poll",
     ) -> Optional[float]:
         return self._observe(loop, STAGE_DETECT, cls, key, origin_ts,
-                             clear=False)
+                             clear=False, trigger=trigger)
 
     def repaired(
         self, loop: str, cls: str, key: str = "",
-        origin_ts: Optional[float] = None,
+        origin_ts: Optional[float] = None, trigger: str = "poll",
     ) -> Optional[float]:
         return self._observe(loop, STAGE_REPAIR, cls, key, origin_ts,
-                             clear=True)
+                             clear=True, trigger=trigger)
 
     def handled(
         self, loop: str, cls: str, key: str = "",
-        origin_ts: Optional[float] = None,
+        origin_ts: Optional[float] = None, trigger: str = "poll",
     ) -> Optional[float]:
         """Detection and repair collapsed into one call — for loops
-        whose single pass both notices and resolves the divergence."""
-        self._observe(loop, STAGE_DETECT, cls, key, origin_ts, clear=False)
+        whose single pass both notices and resolves the divergence.
+        ``trigger`` records what woke the pass ("event" = targeted
+        event-bus pass, "poll" = the periodic sweep) so event-vs-poll
+        lag is directly comparable per loop."""
+        self._observe(loop, STAGE_DETECT, cls, key, origin_ts, clear=False,
+                      trigger=trigger)
         return self._observe(loop, STAGE_REPAIR, cls, key, origin_ts,
-                             clear=True)
+                             clear=True, trigger=trigger)
 
     def _observe(
         self, loop: str, stage: str, cls: str, key: str,
-        origin_ts: Optional[float], clear: bool,
+        origin_ts: Optional[float], clear: bool, trigger: str = "poll",
     ) -> Optional[float]:
         try:
             cls, key = str(cls), str(key)
@@ -451,12 +458,13 @@ class DetectionLagTracker:
                         cls, deque(maxlen=self._recent_cap)
                     ).append({
                         "lag_s": round(lag, 6), "loop": str(loop), "ts": now,
+                        "trigger": str(trigger),
                     })
             m = self._metrics
             if m is not None and hasattr(m, "detection_lag"):
                 try:
                     m.detection_lag.labels(
-                        loop=str(loop), stage=stage
+                        loop=str(loop), stage=stage, trigger=str(trigger)
                     ).observe(lag)
                     if lag == 0.0 and origin > now and hasattr(
                         m, "detection_lag_clamped"
@@ -485,12 +493,23 @@ class DetectionLagTracker:
         block = {}
         for cls, entries in sorted(classes.items()):
             lags = [e["lag_s"] for e in entries]
+            triggers: Dict[str, list] = {}
+            for e in entries:
+                triggers.setdefault(e.get("trigger", "poll"), []).append(
+                    e["lag_s"]
+                )
             block[cls] = {
                 "count": len(lags),
                 "p50_s": _quantile(lags, 0.5),
                 "p99_s": _quantile(lags, 0.99),
                 "max_s": max(lags) if lags else None,
                 "loops": sorted({e["loop"] for e in entries}),
+                # event-vs-poll comparability (satellite of the event
+                # core): per-trigger count + p50 of the same class
+                "triggers": {
+                    t: {"count": len(ls), "p50_s": _quantile(ls, 0.5)}
+                    for t, ls in sorted(triggers.items())
+                },
                 "recent": entries[-20:],
             }
         return {

@@ -150,6 +150,17 @@ class ManagerOptions:
     # event sinks linger after waking so a bind's burst of apiserver
     # writes batches/dedups into one drain. CLI --sink-flush-window.
     sink_flush_window_s: float = 0.0
+    # Event-driven core (events.py): an in-process bus carries pod
+    # deltas (apiserver watch), assignment deltas (kubelet List diffs)
+    # and store-change notifications (bind/intent/state commits) to the
+    # reconciler, drain, repartition, migration and sampler loops, which
+    # run targeted passes on relevant events. The jittered periodic
+    # sweep stays as the correctness backstop, stretched by
+    # event_safety_net_factor while the bus is healthy and the loop is
+    # quiet. False = exact pre-event polling (poll-only fallback mode).
+    # CLI --no-event-bus / --event-safety-net-factor.
+    enable_event_bus: bool = True
+    event_safety_net_factor: float = 10.0
     # test seams
     kube_client: Optional[KubeClient] = None
     operator: object = None
@@ -210,8 +221,18 @@ def build_operator(opts: ManagerOptions):
 class TPUManager:
     def __init__(self, opts: ManagerOptions) -> None:
         self._opts = opts
+        # Event bus first: the storage layer publishes store-change
+        # notifications from its commit path, so the bus must exist
+        # before the first write. None = poll-only fallback mode; every
+        # consumer degenerates to the pre-event jittered sweep.
+        self.bus = None
+        if opts.enable_event_bus:
+            from . import events as events_mod
+
+            self.bus = events_mod.EventBus()
         self.storage = Storage(
-            opts.db_path, batch_window_s=opts.storage_batch_window_s
+            opts.db_path, batch_window_s=opts.storage_batch_window_s,
+            bus=self.bus,
         )
         # The lifecycle timeline rides the checkpoint db (one fsync
         # domain, one hostPath) and is handed to every subsystem that
@@ -235,6 +256,7 @@ class TPUManager:
             self.client,
             opts.node_name,
             on_delete=self.gc_queue.put,
+            bus=self.bus,
         )
         self.operator = build_operator(opts)
         self.metrics = opts.metrics
@@ -337,6 +359,7 @@ class TPUManager:
                 alloc_spec_dir=opts.alloc_spec_dir,
                 period_s=opts.sampler_period_s,
                 lag_tracker=self.lag_tracker,
+                bus=self.bus,
             )
             if self.metrics is not None and hasattr(
                 self.metrics, "attach_sampler"
@@ -358,7 +381,7 @@ class TPUManager:
         self.pr_client = pr_client
         if opts.shared_locator_snapshot:
             shared_source = PodResourcesSnapshotSource(
-                pr_client, metrics=self.metrics
+                pr_client, metrics=self.metrics, bus=self.bus
             )
             # The reconciler diffs against the same snapshot layer the
             # locators use, so its periodic List rides the single-flight
@@ -368,8 +391,11 @@ class TPUManager:
                 res, source=shared_source
             )
         else:
+            # Only the reconciler's source publishes assignment deltas;
+            # the per-resource locator sources stay silent so one
+            # kubelet change is one event, not one per cache.
             self.locator_source = PodResourcesSnapshotSource(
-                pr_client, metrics=self.metrics
+                pr_client, metrics=self.metrics, bus=self.bus
             )
             locator_factory = lambda res: KubeletDeviceLocator(  # noqa: E731
                 res,
@@ -429,6 +455,8 @@ class TPUManager:
             slice_reformer=self.slice_reformer,
             timeline=self.timeline,
             lag_tracker=self.lag_tracker,
+            bus=self.bus,
+            event_safety_net_factor=opts.event_safety_net_factor,
         )
         from .drain import DrainOrchestrator
 
@@ -450,6 +478,8 @@ class TPUManager:
             period_s=opts.drain_period_s,
             timeline=self.timeline,
             lag_tracker=self.lag_tracker,
+            bus=self.bus,
+            event_safety_net_factor=opts.event_safety_net_factor,
         )
         # While the drain has reclaimed bindings, kubelet's still-listed
         # assignments must not be replayed back by the reconciler.
@@ -477,6 +507,8 @@ class TPUManager:
                 period_s=opts.migration_period_s,
                 timeline=self.timeline,
                 lag_tracker=self.lag_tracker,
+                bus=self.bus,
+                event_safety_net_factor=opts.event_safety_net_factor,
             )
             # Early-reclaimed residents' kubelet assignments must not be
             # replayed back; the drain classifies completions by ack.
@@ -502,6 +534,8 @@ class TPUManager:
                 period_s=opts.repartition_period_s,
                 evict_after_s=opts.qos_evict_after_s,
                 lag_tracker=self.lag_tracker,
+                bus=self.bus,
+                event_safety_net_factor=opts.event_safety_net_factor,
             )
             # Evicted pods' kubelet assignments must not be replayed
             # back, and the overcommit alarm must judge usage against
@@ -540,6 +574,8 @@ class TPUManager:
             self.sampler.drain_status_fn = self.drain.status
             if self.migration is not None:
                 self.sampler.migration_status_fn = self.migration.status
+            if self.bus is not None:
+                self.sampler.event_bus_stats_fn = self.bus.stats
         # Goodput ledger (goodput.py): replays the timeline journal into
         # per-pod productive/downtime partitions with causal attribution
         # — the SLI the drain/migration/repartition machinery above is

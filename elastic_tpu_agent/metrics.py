@@ -863,11 +863,14 @@ class AgentMetrics:
         )
         self.detection_lag = Histogram(
             "elastic_tpu_detection_lag_seconds",
-            "Divergence origin -> detection/repair latency per polled "
+            "Divergence origin -> detection/repair latency per control "
             "loop (reconciler, drain, sampler, repartition, migration, "
-            "goodput) — the event-to-repair number ROADMAP item 3 must "
-            "move from ~0.7s to <50ms",
-            ["loop", "stage"],
+            "goodput) — the event-to-repair number ROADMAP item 3 moves "
+            "from ~0.7s to <50ms. trigger=event|poll records what woke "
+            "the observing pass (targeted event-bus pass vs the "
+            "periodic safety-net sweep), so event-vs-poll lag is "
+            "directly comparable per loop",
+            ["loop", "stage", "trigger"],
             buckets=_DETECTION_LAG_BUCKETS,
             **kw,
         )

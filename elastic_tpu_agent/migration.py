@@ -130,6 +130,8 @@ class MigrationCoordinator:
         timeline=None,
         clock=None,
         lag_tracker=None,
+        bus=None,
+        event_safety_net_factor: float = 1.0,
     ) -> None:
         self._storage = storage
         self._plugin = plugin
@@ -190,6 +192,27 @@ class MigrationCoordinator:
         self._completed: List[dict] = []  # bounded recent completions
         self._last_error: Optional[str] = None
         self._resumed = False
+        # Event bus (events.py): pod deltas, bind commits and drain
+        # agent_state writes wake a tick early (a drain starting is a
+        # STORE_STATE event, so ack consumption begins on the
+        # transition, not the next period). The sweep stretches only
+        # while the handshake is completely quiet — no records, no
+        # consumed acks, no inbound verifications — because checkpoint
+        # acks arrive as FILES, which no bus event can carry.
+        self._bus = bus
+        self.event_safety_net_factor = max(1.0, float(
+            event_safety_net_factor
+        ))
+        self._event_sub = None
+        if bus is not None:
+            from . import events as bus_events
+
+            self._event_sub = bus.subscribe(
+                "migration",
+                (bus_events.POD_DELTA, bus_events.STORE_BIND,
+                 bus_events.STORE_STATE),
+            )
+        self.event_ticks_total = 0
 
     # -- journaled state ------------------------------------------------------
 
@@ -987,10 +1010,32 @@ class MigrationCoordinator:
         if not self._resumed:
             self.resume()
         consecutive_failures = 0
+        sub = self._event_sub
         while True:
             delay = self.period_s * (0.75 + 0.5 * self._rng.random())
-            if stop.wait(delay):
-                return
+            if sub is not None and self._bus.healthy():
+                # Stretch only while the handshake is completely quiet:
+                # checkpoint acks arrive as files, not events, so any
+                # in-flight work keeps the base cadence.
+                with self._lock:
+                    quiet = (not self._records and not self._acked
+                             and not self._inbound)
+                if quiet:
+                    delay *= self.event_safety_net_factor
+            if sub is None:
+                if stop.wait(delay):
+                    return
+            else:
+                trig = sub.wait_trigger(stop, delay)
+                if trig == "stop":
+                    return
+                if trig == "event":
+                    # Brief coalesce window so a burst (drain journal
+                    # write + bind commit) costs one tick, not several.
+                    if stop.wait(0.02):
+                        return
+                    sub.drain()
+                    self.event_ticks_total += 1
             try:
                 self.tick()
                 consecutive_failures = 0

@@ -217,6 +217,12 @@ class _ListAndWatchMixin:
         self._law_cond = threading.Condition()
         self._law_version = 0
         self._stopped = False
+        # Coalesced broadcasts: rapid health flips (flapping chip, burst
+        # of notify calls) bump the version many times but often settle
+        # on an identical device list — each stream dedups on a
+        # (device-id, health) signature and skips the redundant yield,
+        # so kubelet never reprocesses an update that changes nothing.
+        self._law_dedup_total = 0
 
     def notify_devices_changed(self) -> None:
         with self._law_cond:
@@ -233,6 +239,7 @@ class _ListAndWatchMixin:
 
     def ListAndWatch(self, request, context):  # noqa: N802, ARG002
         version = -1
+        sent_sig = None
         while True:
             with self._law_cond:
                 while self._law_version == version and not self._stopped:
@@ -242,7 +249,16 @@ class _ListAndWatchMixin:
                 if self._stopped:
                     return
                 version = self._law_version
-            yield dp.ListAndWatchResponse(devices=self._device_list())
+            devices = self._device_list()
+            sig = tuple((d.ID, d.health) for d in devices)
+            if sig == sent_sig:
+                # A->B->A flip settled back before this stream caught
+                # up: nothing to tell kubelet.
+                with self._law_cond:
+                    self._law_dedup_total += 1
+                continue
+            sent_sig = sig
+            yield dp.ListAndWatchResponse(devices=devices)
 
 
 class _TPUSharePluginBase(_ListAndWatchMixin, rpc.DevicePluginServicer):
@@ -280,6 +296,18 @@ class _TPUSharePluginBase(_ListAndWatchMixin, rpc.DevicePluginServicer):
         self._binds_inflight = 0
         self._binds_total = 0
         self._bind_failures_total = 0
+        # Bind fast path: the identity-independent part of an alloc
+        # spec (device paths, visibility env, host topology facts) is
+        # pre-materialized per chip-index set — rendering a spec then
+        # substitutes pod identity instead of recomputing topology on
+        # every bind. Chip paths are fixed at discovery, so entries
+        # never go stale; the cap only bounds a pathological
+        # combination explosion.
+        self._spec_templates: Dict[tuple, Dict] = {}
+        self._spec_template_cap = 256
+        self._spec_template_hits = 0
+        self._spec_template_misses = 0
+        self._host_facts: Optional[tuple] = None
 
     # -- health ---------------------------------------------------------------
 
@@ -505,11 +533,16 @@ class _TPUSharePluginBase(_ListAndWatchMixin, rpc.DevicePluginServicer):
         """Bind-pipeline introspection for /debug/allocations and the
         node-doctor bundle."""
         with self._inflight_lock:
-            return {
+            out = {
                 "inflight": self._binds_inflight,
                 "binds_total": self._binds_total,
                 "bind_failures_total": self._bind_failures_total,
+                "spec_template_hits": self._spec_template_hits,
+                "spec_template_misses": self._spec_template_misses,
             }
+        with self._law_cond:
+            out["law_dedup_total"] = self._law_dedup_total
+        return out
 
     def _lookup_pod(self, owner) -> Optional[dict]:
         with get_tracer().span(
@@ -903,14 +936,47 @@ class _TPUSharePluginBase(_ListAndWatchMixin, rpc.DevicePluginServicer):
 
     def _host_slice_facts(self):
         """(topology, worker_id, hostnames) from the operator when it knows
-        them (tpu-vm/stub operators do; exclusive wrapper may not)."""
-        op = self._operator
-        topo = getattr(op, "topology", None)
-        worker_id = op.worker_id() if hasattr(op, "worker_id") else 0
-        hostnames = (
-            op.worker_hostnames() if hasattr(op, "worker_hostnames") else []
-        )
-        return topo, worker_id, hostnames
+        them (tpu-vm/stub operators do; exclusive wrapper may not).
+        Cached after the first probe: host identity is fixed for the
+        agent's lifetime, and the per-bind operator round-trips were
+        pure recompute on the hot path."""
+        if self._host_facts is None:
+            op = self._operator
+            topo = getattr(op, "topology", None)
+            worker_id = op.worker_id() if hasattr(op, "worker_id") else 0
+            hostnames = (
+                op.worker_hostnames()
+                if hasattr(op, "worker_hostnames") else []
+            )
+            self._host_facts = (topo, worker_id, hostnames)
+        return self._host_facts
+
+    def _spec_template(self, chip_indexes: List[int]) -> Dict:
+        """The identity-independent spec skeleton for one chip-index
+        set: device paths + visibility env. Benign races just build the
+        same template twice."""
+        key = tuple(chip_indexes)
+        tpl = self._spec_templates.get(key)
+        if tpl is None:
+            visible = ",".join(str(p) for p in range(len(chip_indexes)))
+            tpl = {
+                "device_paths": [
+                    self._chips[i].device_path for i in chip_indexes
+                ],
+                "base_env": {
+                    EnvTPUVisibleChips: visible,
+                    EnvTPUVisibleDevices: visible,
+                },
+            }
+            if len(self._spec_templates) >= self._spec_template_cap:
+                self._spec_templates.clear()
+            self._spec_templates[key] = tpl
+            with self._inflight_lock:
+                self._spec_template_misses += 1
+        else:
+            with self._inflight_lock:
+                self._spec_template_hits += 1
+        return tpl
 
     def _spec_payload(
         self,
@@ -920,11 +986,8 @@ class _TPUSharePluginBase(_ListAndWatchMixin, rpc.DevicePluginServicer):
         annotations: Dict,
         pod: Optional[dict] = None,
     ) -> Dict:
-        visible = ",".join(str(p) for p in range(len(chip_indexes)))
-        env = {
-            EnvTPUVisibleChips: visible,
-            EnvTPUVisibleDevices: visible,
-        }
+        tpl = self._spec_template(chip_indexes)
+        env = dict(tpl["base_env"])
         env.update(qos_env(annotations, pod_spec=pod, **self._qos_kwargs(device)))
         topo, worker_id, hostnames = self._host_slice_facts()
         if self._slices is not None:
@@ -979,9 +1042,7 @@ class _TPUSharePluginBase(_ListAndWatchMixin, rpc.DevicePluginServicer):
             "pod": owner.name,
             "container": owner.container,
             "chip_indexes": chip_indexes,
-            "device_paths": [
-                self._chips[i].device_path for i in chip_indexes
-            ],
+            "device_paths": list(tpl["device_paths"]),
             "env": env,
         }
 

@@ -51,12 +51,21 @@ import threading
 import time
 from typing import Dict, List, Optional
 
+from . import events as bus_events
 from . import faults
 from .storage.store import StorageError
 from .tracing import get_tracer
 from .types import Device, PodContainer, parse_pod_key
 
 logger = logging.getLogger(__name__)
+
+# Event-triggered pass pacing: a burst of bus events (one churny bind
+# emits several store notifications) coalesces behind one short
+# debounce, and event-triggered passes never start closer together than
+# the min interval — a fleet-wide churn storm degrades to ~20 targeted
+# passes/s/node, not one pass per event.
+EVENT_DEBOUNCE_S = 0.01
+EVENT_MIN_INTERVAL_S = 0.05
 
 DEFAULT_PERIOD_S = 30.0
 
@@ -138,6 +147,8 @@ class Reconciler:
         slice_reformer=None,
         timeline=None,
         lag_tracker=None,
+        bus=None,
+        event_safety_net_factor: float = 1.0,
     ) -> None:
         self._storage = storage
         self._operator = operator
@@ -198,6 +209,32 @@ class Reconciler:
         # (fault injectors / fleet sim stamp marks; unmarked divergences
         # simply record nothing).
         self._lag = lag_tracker
+        # Event-driven core (events.py): pod deltas, kubelet assignment
+        # deltas and store-change notifications trigger a pass NOW
+        # instead of waiting out the jittered period; while the bus is
+        # healthy the periodic sweep is demoted to a safety net
+        # (period x event_safety_net_factor) but NEVER removed — it
+        # remains the correctness backstop for dropped events.
+        self._bus = bus
+        self.event_safety_net_factor = max(1.0, float(
+            event_safety_net_factor
+        ))
+        self._event_sub = None
+        if bus is not None:
+            self._event_sub = bus.subscribe(
+                "reconciler",
+                (bus_events.POD_DELTA, bus_events.ASSIGNMENT_DELTA,
+                 bus_events.STORE_BIND, bus_events.STORE_INTENT),
+            )
+        self._event_passes_total = 0
+        # What woke the pass currently running ("event" | "poll"):
+        # _count threads it into detection-lag attribution.
+        self._pass_trigger = "poll"
+        # Pod keys whose store records were seen DELETED by the batch
+        # of events that triggered the current pass — commit-ordered
+        # proof of a persistent divergence, exempt from two-pass
+        # confirmation for this one pass.
+        self._event_evidence: set = set()
 
     # -- plumbing -------------------------------------------------------------
 
@@ -222,6 +259,7 @@ class Reconciler:
                 "reconciler", kind,
                 key=(keys or {}).get("pod") or (keys or {}).get("hash")
                 or "",
+                trigger=self._pass_trigger,
             )
         if emit and self._timeline is not None:
             from .timeline import KIND_RECONCILE_REPAIR
@@ -281,7 +319,8 @@ class Reconciler:
     # -- one pass -------------------------------------------------------------
 
     def reconcile_once(
-        self, boot: bool = False, now: Optional[float] = None
+        self, boot: bool = False, now: Optional[float] = None,
+        trigger: str = "poll",
     ) -> dict:
         """One full convergence pass; returns the per-class report.
 
@@ -289,9 +328,12 @@ class Reconciler:
         device-plugin servers register (no binds can be in flight), so
         every repair acts immediately and the CRD inventory is reconciled
         too. Periodic passes confirm absence-based repairs across two
-        passes and honor ``dry_run``.
+        passes and honor ``dry_run``. ``trigger`` records what woke the
+        pass ("event" = targeted event-bus wakeup, "poll" = periodic
+        sweep) for detection-lag attribution.
         """
         faults.fire("reconciler.tick")
+        self._pass_trigger = str(trigger)
         t_pass = time.monotonic()
         active = boot or not self.dry_run
         report = _new_report(boot, self.dry_run and not boot)
@@ -1013,11 +1055,17 @@ class Reconciler:
                     self._candidate(ukey)
                     report["divergences_observed"] += 1
                     continue
-                if not boot and not self._confirmed(ukey):
+                if not boot and not self._confirmed(ukey) and (
+                    owner.pod_key not in self._event_evidence
+                ):
                     # kubelet assigns devices BEFORE PreStartContainer
                     # runs; a fresh assignment is normally seconds from
                     # binding itself. Only replay ones that stay unbound
-                    # across two passes.
+                    # across two passes — UNLESS the triggering events
+                    # included this pod's store-delete notification: the
+                    # store itself confirmed the record is gone (an
+                    # in-flight bind never emits a delete), so waiting a
+                    # second pass adds nothing but lag.
                     continue
                 failures, next_run = self._replay_backoff.get(ukey, (0, 0))
                 if not boot and self._runs_total < next_run:
@@ -1257,16 +1305,83 @@ class Reconciler:
     def run(self, stop: threading.Event) -> None:
         """Supervised loop: jittered pacing around ``period_s`` (0.75x -
         1.25x, so a fleet of agents never thunders onto the kubelet in
-        lockstep after a node-pool-wide restart)."""
+        lockstep after a node-pool-wide restart).
+
+        With an event bus the wait doubles as an event trigger: bus
+        events start a targeted pass immediately (debounced), and the
+        periodic sweep stretches to ``period_s x
+        event_safety_net_factor`` while the bus is healthy — unless the
+        LAST pass left work outstanding (pending confirmations,
+        failures, observed divergences), in which case the next sweep
+        comes at the base period regardless (two-pass confirmation must
+        never wait out a stretched safety net)."""
         consecutive_failures = 0
+        last_event_pass = 0.0
+        outstanding = False
         while True:
-            delay = self.period_s * (0.75 + 0.5 * self._rng.random())
-            if stop.wait(delay):
-                return
+            # Evidence lives for exactly one pass: cleared before the
+            # wait, set only when this iteration drains store-delete
+            # notifications.
+            self._event_evidence = set()
+            factor = 1.0
+            sub = self._event_sub
+            if (
+                sub is not None and not outstanding
+                and self._bus.healthy()
+            ):
+                factor = self.event_safety_net_factor
+            delay = self.period_s * factor * (
+                0.75 + 0.5 * self._rng.random()
+            )
+            if sub is None:
+                if stop.wait(delay):
+                    return
+                trigger = "poll"
+            else:
+                trigger = sub.wait_trigger(stop, delay)
+                if trigger == "stop":
+                    return
+                if trigger == "event":
+                    # Debounce the burst, and pace event-triggered
+                    # passes at least EVENT_MIN_INTERVAL_S apart.
+                    since = time.monotonic() - last_event_pass
+                    pace = max(EVENT_DEBOUNCE_S,
+                               EVENT_MIN_INTERVAL_S - since)
+                    if stop.wait(pace):
+                        return
+                    drained = sub.drain()
+                    last_event_pass = time.monotonic()
+                    if drained and all(
+                        e.topic == bus_events.BUS_WAKE for e in drained
+                    ):
+                        # Pure bus-health wake (watch died/recovered):
+                        # run the sweep NOW at poll attribution — the
+                        # no-gap fallback — and recompute the stretch
+                        # on the next iteration.
+                        trigger = "poll"
+                    else:
+                        with self._lock:
+                            self._event_passes_total += 1
+                        # A store-delete notification is commit-ordered
+                        # proof the pod's record is GONE — not an
+                        # in-flight bind racing the kubelet List — so
+                        # the pass it triggers may replay that pod
+                        # without the two-pass confirmation wait.
+                        self._event_evidence = {
+                            e.key for e in drained
+                            if e.topic == bus_events.STORE_BIND
+                            and e.kind == "delete"
+                        }
             with get_tracer().trace("reconcile") as tr:
                 try:
-                    report = self.reconcile_once()
+                    report = self.reconcile_once(trigger=trigger)
                     consecutive_failures = 0
+                    outstanding = bool(
+                        report["pending_confirmation"]
+                        or report["sweep_failures"]
+                        or report["replay_failures"]
+                        or report["divergences_observed"]
+                    )
                 except Exception as e:  # noqa: BLE001
                     # One-off failures (apiserver blip, transient sqlite
                     # lock) are absorbed without burning a supervisor
@@ -1274,6 +1389,7 @@ class Reconciler:
                     # the supervisor — otherwise the node silently loses
                     # all self-repair while /healthz reads healthy.
                     consecutive_failures += 1
+                    outstanding = True  # failed pass: retry at base period
                     with self._lock:
                         self._last_error = f"{type(e).__name__}: {e}"
                     if consecutive_failures >= 3:
@@ -1314,9 +1430,22 @@ class Reconciler:
             intents = self._storage.open_intents_brief()
         except Exception:  # noqa: BLE001 - storage may already be closed
             intents = []
+        sub = self._event_sub
+        events_block = None
+        if sub is not None:
+            events_block = {
+                "safety_net_factor": self.event_safety_net_factor,
+                "bus_healthy": self._bus.healthy(),
+                "subscription": sub.stats(),
+            }
         with self._lock:
+            if events_block is not None:
+                events_block["event_passes_total"] = (
+                    self._event_passes_total
+                )
             return {
                 "period_s": self.period_s,
+                "events": events_block,
                 "dry_run": self.dry_run,
                 "runs_total": self._runs_total,
                 "last_run_ts": self._last_run_ts,

@@ -58,6 +58,7 @@ from __future__ import annotations
 import logging
 import random
 import threading
+import time
 from typing import Dict, List, Optional, Tuple
 
 from . import faults
@@ -132,6 +133,8 @@ class RepartitionController:
         clock=None,
         rng=None,
         lag_tracker=None,
+        bus=None,
+        event_safety_net_factor: float = 1.0,
     ) -> None:
         self._sampler = sampler
         self._storage = storage
@@ -199,6 +202,26 @@ class RepartitionController:
         # a throttle is the pod accepting the move).
         self.migration = None
         self._resumed = False
+        # Event bus (events.py): pod deltas and store-change events wake
+        # a tick early (an evicted pod vanishing, a new tenant binding).
+        # The sweep stretches only while fractional sharing is DISABLED
+        # (tick is a no-op then) and the bus is healthy — with sharing
+        # live, enforcement deadlines and usage-driven decisions keep
+        # the base cadence, since sampler pressure is not event-visible.
+        self._bus = bus
+        self.event_safety_net_factor = max(1.0, float(
+            event_safety_net_factor
+        ))
+        self._event_sub = None
+        if bus is not None:
+            from . import events as bus_events
+
+            self._event_sub = bus.subscribe(
+                "repartition",
+                (bus_events.POD_DELTA, bus_events.STORE_BIND,
+                 bus_events.ASSIGNMENT_DELTA),
+            )
+        self.event_ticks_total = 0
 
     # -- derived quota state ---------------------------------------------------
 
@@ -1149,11 +1172,38 @@ class RepartitionController:
         discipline, including the 3-strikes escalation."""
         self.resume()
         consecutive_failures = 0
+        last_tick = 0.0
         while True:
             delay = self.period_s * (0.75 + 0.5 * self._rng.random())
-            if stop.wait(delay):
-                return
+            sub = self._event_sub
+            if (
+                sub is not None and self._bus.healthy()
+                and not self._fractional()
+            ):
+                # Exclusive mode: the tick has no units to move, the
+                # sweep is purely a safety net — stretch it.
+                delay *= self.event_safety_net_factor
+            if sub is None:
+                if stop.wait(delay):
+                    return
+            else:
+                trigger = sub.wait_trigger(stop, delay)
+                if trigger == "stop":
+                    return
+                if trigger == "event":
+                    # Coalesce the burst AND pace event ticks: a churn
+                    # storm degrades to ~4 extra ticks per period, not
+                    # one tick per event.
+                    min_gap = min(1.0, self.period_s / 4.0)
+                    pace = max(0.02, min_gap - (
+                        time.monotonic() - last_tick
+                    ))
+                    if stop.wait(pace):
+                        return
+                    sub.drain()
+                    self.event_ticks_total += 1
             try:
+                last_tick = time.monotonic()
                 self.tick()
                 consecutive_failures = 0
             except Exception as e:  # noqa: BLE001

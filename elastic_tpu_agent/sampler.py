@@ -118,12 +118,27 @@ class UtilizationSampler:
         overcommit_sustain_samples: int = DEFAULT_OVERCOMMIT_SUSTAIN,
         unhealthy_after_failures: int = DEFAULT_UNHEALTHY_AFTER_FAILURES,
         lag_tracker=None,
+        bus=None,
     ) -> None:
         self._operator = operator
         self._storage = storage
         self._metrics = metrics
         self._alloc_spec_dir = alloc_spec_dir
         self.period_s = period_s
+        # Event bus (events.py): assignment/bind deltas trigger an
+        # EARLY sample so the pod<->allocation join reflects a new or
+        # departed tenant immediately. Telemetry cadence itself stays
+        # at period_s — the sampling period is the product here, so
+        # the sweep is never stretched for this loop.
+        self._event_sub = None
+        if bus is not None:
+            from . import events as bus_events
+
+            self._event_sub = bus.subscribe(
+                "sampler",
+                (bus_events.ASSIGNMENT_DELTA, bus_events.STORE_BIND),
+            )
+        self.event_samples_total = 0
         self.overcommit_margin = overcommit_margin_percent
         self.overcommit_sustain = max(1, overcommit_sustain_samples)
         self.unhealthy_after = max(1, unhealthy_after_failures)
@@ -169,6 +184,12 @@ class UtilizationSampler:
         # `migration` block of /debug/allocations and the doctor bundle
         # — "are we actually checkpointing?" from one scrape.
         self.migration_status_fn: Optional[Callable[[], dict]] = None
+        # Also manager-set: () -> event-bus stats (EventBus.stats():
+        # published-by-topic, per-subscriber depth/drops, degraded
+        # sources) — the `event_bus` block of /debug/allocations and
+        # the doctor bundle. A dropped-event gap is triaged from this
+        # plus the detection-lag trigger split (docs/operations.md).
+        self.event_bus_stats_fn: Optional[Callable[[], dict]] = None
         # Also manager-set: (pod_key) -> signed core-percent delta the
         # repartition controller currently applies on top of the pod's
         # base grant. The overcommit detector judges usage against the
@@ -227,14 +248,34 @@ class UtilizationSampler:
         return t
 
     def run(self, stop: threading.Event) -> None:
-        """Blocking sample loop until ``stop`` (supervised entry point)."""
+        """Blocking sample loop until ``stop`` (supervised entry point).
+        With an event bus, assignment/bind deltas cut the wait short so
+        the join pass runs immediately (coalesced behind a short
+        debounce); the cadence otherwise stays period_s."""
         while not stop.is_set():
             try:
                 self.sample_once()
             except Exception:  # noqa: BLE001 - sampling must never wedge
                 logger.exception("utilization sample failed")
-            if stop.wait(self.period_s):
+            last = time.monotonic()
+            sub = self._event_sub
+            if sub is None:
+                if stop.wait(self.period_s):
+                    return
+                continue
+            trigger = sub.wait_trigger(stop, self.period_s)
+            if trigger == "stop":
                 return
+            if trigger == "event":
+                # Pace event-triggered samples: a churn storm of bind
+                # deltas coalesces to at most one extra join pass per
+                # min_gap, never a sample per event.
+                min_gap = min(0.5, self.period_s / 4.0)
+                gap = min_gap - (time.monotonic() - last)
+                if gap > 0 and stop.wait(gap):
+                    return
+                sub.drain()
+                self.event_samples_total += 1
 
     # -- one sample -----------------------------------------------------------
 
@@ -905,6 +946,11 @@ class UtilizationSampler:
         if self.serving_status_fn is not None:
             try:
                 out["serving"] = self.serving_status_fn()
+            except Exception:  # noqa: BLE001 - introspection only
+                pass
+        if self.event_bus_stats_fn is not None:
+            try:
+                out["event_bus"] = self.event_bus_stats_fn()
             except Exception:  # noqa: BLE001 - introspection only
                 pass
         return out

@@ -813,11 +813,16 @@ class ChaosMatrix:
         scenarios: Optional[List[dict]] = None,
         nodes: int = 2,
         serve_pods_per_node: int = 2,
+        enable_events: bool = True,
     ) -> None:
         self.trace_seed = trace_seed
         self.chaos_seed = chaos_seed
         self.nodes = nodes
         self.serve_pods_per_node = serve_pods_per_node
+        # Poll-only mode (events.py disabled): the matrix must stay
+        # green either way — the periodic sweeps remain the correctness
+        # backstop, events are only an acceleration.
+        self.enable_events = enable_events
         self.scenarios = scenarios or self.default_scenarios()
 
     def default_scenarios(self) -> List[dict]:
@@ -909,6 +914,7 @@ class ChaosMatrix:
             repartition_period_s=3600.0,
             storage_batch_window_s=0.004,  # flush faults need batching
             sink_flush_window_s=0.02,
+            enable_events=self.enable_events,
         )
         os.makedirs(os.path.join(base_dir, f"s{i}"), exist_ok=True)
         try:

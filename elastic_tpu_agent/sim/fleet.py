@@ -240,6 +240,8 @@ class FleetSim:
         repartition_period_s: float = 10.0,
         slow_span_ms: Optional[float] = None,
         profile_hz: float = 0.0,
+        enable_events: bool = True,
+        event_safety_net_factor: float = 1.0,
     ) -> None:
         self.base_dir = base_dir
         self.n_nodes = nodes
@@ -289,6 +291,14 @@ class FleetSim:
         # its measured overhead.
         self.slow_span_ms = slow_span_ms
         self.profile_hz = profile_hz
+        # Event-driven core (events.py): enable_events=False is the
+        # poll-only fallback A/B baseline. The safety-net factor
+        # defaults to 1.0 IN THE SIM (production default is 10x):
+        # existing scenarios time their assertions against the base
+        # periods, and a stretched sweep must be opted into by the
+        # scenarios that prove the stretch.
+        self.enable_events = enable_events
+        self.event_safety_net_factor = event_safety_net_factor
         self.nodes: List[SimNode] = []
         self.apiserver = None
         self.api_url = ""
@@ -365,6 +375,8 @@ class FleetSim:
                 repartition_period_s=self.repartition_period_s,
                 slow_span_ms=self.slow_span_ms,
                 profile_hz=self.profile_hz,
+                enable_event_bus=self.enable_events,
+                event_safety_net_factor=self.event_safety_net_factor,
                 **(
                     {"timeline_cap": self.timeline_cap}
                     if self.timeline_cap is not None else {}

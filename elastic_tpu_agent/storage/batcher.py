@@ -74,9 +74,17 @@ class GroupCommitBatcher:
         window_s: float,
         name: str = "storage",
         lock=None,
+        on_commit: Optional[Callable[[], None]] = None,
     ) -> None:
         self._commit_fn = commit_fn
         self._rollback_fn = rollback_fn
+        # Post-commit hook, called on the flusher thread AFTER a group
+        # commit durably lands (and after waiters are released): the
+        # owning Storage publishes its store-change notifications here,
+        # so subscribers only ever hear about state that is already on
+        # disk. Exceptions are contained — observability must never
+        # fail a commit that already succeeded.
+        self._on_commit = on_commit
         # The OWNER's statement lock (Storage._lock): writers execute
         # their statements and call mark_dirty under it. The failure
         # path must hold it too — a rollback discards EVERY uncommitted
@@ -220,6 +228,11 @@ class GroupCommitBatcher:
             # waits one more flush.
             self._committed_gen = gen
             self._cond.notify_all()
+        if self._on_commit is not None:
+            try:
+                self._on_commit()
+            except Exception:  # noqa: BLE001 - never fail a landed commit
+                logger.exception("%s: post-commit hook failed", self._name)
 
     def _fail_flush(self, gen: int, batched: int, err: BaseException) -> None:
         """A failed commit rolls back the WHOLE open transaction — not

@@ -18,6 +18,7 @@ import threading
 import time
 from typing import Callable, Iterator, Optional, Tuple
 
+from .. import events as events_mod
 from .. import faults
 from ..common import StripedLockSet
 from ..types import PodInfo
@@ -39,6 +40,38 @@ BUSY_TIMEOUT_MS = 5000
 # for transient "database is locked" errors before the write becomes a
 # StorageError.
 _LOCKED_RETRY_DELAY_S = 0.05
+
+# sqlite3's per-connection compiled-statement cache is keyed by the SQL
+# text; the bind checkpoint/mutate path runs the same handful of
+# statements thousands of times per churn burst, so the hot SQL lives
+# here as module constants (one string object each — guaranteed cache
+# hits) and the connection's cache is sized so cold diagnostics queries
+# can never evict the hot set. Uses are counted per statement in
+# write_stats()["prepared_uses"].
+_STMT_CACHE_SIZE = 256
+_SQL_SAVE_POD = (
+    "INSERT INTO pods(key, value) VALUES(?, ?) "
+    "ON CONFLICT(key) DO UPDATE SET value=excluded.value"
+)
+_SQL_DELETE_POD = "DELETE FROM pods WHERE key=?"
+_SQL_INSERT_INTENT = (
+    "INSERT INTO bind_intents"
+    "(pod_key, container, resource, hash, payload, "
+    "created_ts) VALUES(?, ?, ?, ?, ?, ?)"
+)
+_SQL_DELETE_INTENT = "DELETE FROM bind_intents WHERE id=?"
+_SQL_UPSERT_STATE = (
+    "INSERT INTO agent_state(key, value, updated_ts) "
+    "VALUES(?, ?, ?) ON CONFLICT(key) DO UPDATE SET "
+    "value=excluded.value, updated_ts=excluded.updated_ts"
+)
+_PREPARED = {
+    _SQL_SAVE_POD: "save_pod",
+    _SQL_DELETE_POD: "delete_pod",
+    _SQL_INSERT_INTENT: "insert_intent",
+    _SQL_DELETE_INTENT: "delete_intent",
+    _SQL_UPSERT_STATE: "upsert_state",
+}
 
 
 _SCHEMA = """
@@ -112,11 +145,24 @@ class Storage:
     save / load / load_or_create / delete / for_each / close.
     """
 
-    def __init__(self, path: str, batch_window_s: float = 0.0) -> None:
+    def __init__(self, path: str, batch_window_s: float = 0.0,
+                 bus=None) -> None:
         directory = os.path.dirname(os.path.abspath(path))
         os.makedirs(directory, exist_ok=True)
         self._path = path
         self._lock = threading.RLock()
+        # Optional events.EventBus: store-change notifications (bind
+        # commits on STORE_BIND, intent open/close on STORE_INTENT,
+        # agent_state writes on STORE_STATE). Notes accumulate under
+        # _lock at statement time and publish only AFTER the covering
+        # commit lands — inline for unbatched commits, from the
+        # group-commit batcher's flush path under batching — so a
+        # subscriber never hears about a write that later rolls back.
+        # Notifications are delivery HINTS for event-driven loops, not
+        # a replication log: consumers re-verify against the store.
+        self._bus = bus
+        self._pending_notes: list = []
+        self._stmt_uses: dict = {}
         # Per-key striping for composite read-modify-writes (mutate()):
         # the sqlite connection itself stays serialized under self._lock,
         # but two RMWs for DIFFERENT pods never wait on each other's
@@ -145,7 +191,10 @@ class Storage:
         # orphaned rows recovery exists for.
         self._inflight_intents: set = set()
         try:
-            self._db = sqlite3.connect(path, check_same_thread=False)
+            self._db = sqlite3.connect(
+                path, check_same_thread=False,
+                cached_statements=_STMT_CACHE_SIZE,
+            )
             self._db.execute("PRAGMA journal_mode=WAL")
             self._db.execute("PRAGMA synchronous=NORMAL")
             self._db.execute(f"PRAGMA busy_timeout={BUSY_TIMEOUT_MS}")
@@ -177,6 +226,7 @@ class Storage:
                 # rollback took with it (RLock, so the rollback callback
                 # may re-take it).
                 lock=self._lock,
+                on_commit=self._publish_batch_notes,
             )
 
     # -- group-commit plumbing (flusher-thread side) --------------------------
@@ -208,6 +258,37 @@ class Storage:
             self._cache_complete = False
             self._timeline_rows_cache = None
             self._timeline_cap_stored = None
+            # Notes for statements that just rolled back must never
+            # publish — the events would describe state that does not
+            # exist on disk.
+            self._pending_notes = []
+
+    # -- store-change notifications (events.EventBus) -------------------------
+
+    def _note_locked(self, topic: str, kind: str, key: str) -> None:
+        """(lock held) Queue one store-change notification for the
+        commit that will cover the statement just executed."""
+        if self._bus is not None:
+            self._pending_notes.append((topic, kind, key))
+
+    def _publish_notes_locked(self) -> None:
+        """(lock held) Publish+clear pending notes — the unbatched
+        post-commit path (publish only fans out to subscriber queues;
+        it cannot re-enter storage)."""
+        if not self._pending_notes:
+            return
+        notes, self._pending_notes = self._pending_notes, []
+        for topic, kind, key in notes:
+            self._bus.publish(topic, kind=kind, key=key)
+
+    def _publish_batch_notes(self) -> None:
+        """Group-commit flush path (batcher ``on_commit``, flusher
+        thread): everything the landed commit covered publishes in one
+        burst, outside the statement lock."""
+        with self._lock:
+            notes, self._pending_notes = self._pending_notes, []
+        for topic, kind, key in notes:
+            self._bus.publish(topic, kind=kind, key=key)
 
     def _commit_locked(self, sync: bool = True) -> Optional[int]:
         """(lock held) Commit this write, or hand it to the group-commit
@@ -219,6 +300,7 @@ class Storage:
         if self._batcher is None:
             self._db.commit()
             self.commits_total += 1
+            self._publish_notes_locked()
             return None
         return self._batcher.mark_dirty(sync=sync)
 
@@ -240,6 +322,10 @@ class Storage:
                 "batching": self._batcher is not None,
                 "writes_total": self.writes_total,
                 "commits_total": self.commits_total,
+                # Hot-statement reuse counts: every entry here rode the
+                # connection's compiled-statement cache (the prepared-
+                # statement seam; see _PREPARED).
+                "prepared_uses": dict(self._stmt_uses),
             }
         if self._batcher is not None:
             b = self._batcher.stats()
@@ -258,16 +344,25 @@ class Storage:
         )
 
     def _write(
-        self, what: str, sql: str, params: tuple, sync: bool = True
+        self, what: str, sql: str, params: tuple, sync: bool = True,
+        note: Optional[tuple] = None,
     ) -> Optional[int]:
         """Execute (+commit, or join the group-commit batch) under the
         lock, retrying ONCE on a transient lock error (a concurrent
         writer on another connection — e.g. a node-doctor run against
         the live db — outlasting busy_timeout). Returns the batch token
-        for :meth:`_sync_wait` (None when the commit already ran)."""
+        for :meth:`_sync_wait` (None when the commit already ran).
+        ``note`` is a ``(topic, kind, key)`` store-change notification
+        queued between execute and commit, so it publishes exactly when
+        (and only if) the statement's covering commit lands."""
+        stmt = _PREPARED.get(sql)
+        if stmt is not None:
+            self._stmt_uses[stmt] = self._stmt_uses.get(stmt, 0) + 1
         for attempt in (1, 2):
             try:
                 self._db.execute(sql, params)
+                if note is not None:
+                    self._note_locked(*note)
                 return self._commit_locked(sync=sync)
             except sqlite3.Error as e:
                 transient = self._is_transient_lock(e) and attempt == 1
@@ -280,6 +375,10 @@ class Storage:
                         self._db.rollback()  # clear the failed statement
                     except sqlite3.Error:
                         pass
+                    # Unbatched, pending notes can only be our own (any
+                    # earlier write flushed its notes at commit) — drop
+                    # them with the rolled-back statement.
+                    self._pending_notes = []
                 if not transient:
                     raise StorageError(f"{what}: {e}") from e
                 logger.warning(
@@ -330,9 +429,9 @@ class Storage:
         self._check_foreign_writes()
         token = self._write(
             f"save {pod.key}",
-            "INSERT INTO pods(key, value) VALUES(?, ?) "
-            "ON CONFLICT(key) DO UPDATE SET value=excluded.value",
+            _SQL_SAVE_POD,
             (pod.key, value),
+            note=(events_mod.STORE_BIND, "save", pod.key),
         )
         # Cache a snapshot parsed back from the persisted JSON — never
         # the caller's object, which the caller may keep mutating.
@@ -404,8 +503,10 @@ class Storage:
             self._check_foreign_writes()
             token = self._write(
                 f"delete {namespace}/{name}",
-                "DELETE FROM pods WHERE key=?",
+                _SQL_DELETE_POD,
                 (f"{namespace}/{name}",),
+                note=(events_mod.STORE_BIND, "delete",
+                      f"{namespace}/{name}"),
             )
             self._cache.pop(f"{namespace}/{name}", None)
         self._sync_wait(f"delete {namespace}/{name}", token)
@@ -449,15 +550,18 @@ class Storage:
         faults.fire("storage.journal")
         value = json.dumps(payload, sort_keys=True)
         with self._lock:
+            self._stmt_uses["insert_intent"] = (
+                self._stmt_uses.get("insert_intent", 0) + 1
+            )
             for attempt in (1, 2):
                 try:
                     cur = self._db.execute(
-                        "INSERT INTO bind_intents"
-                        "(pod_key, container, resource, hash, payload, "
-                        "created_ts) VALUES(?, ?, ?, ?, ?, ?)",
+                        _SQL_INSERT_INTENT,
                         (pod_key, container, resource, alloc_hash, value,
                          time.time()),
                     )
+                    self._note_locked(events_mod.STORE_INTENT, "open",
+                                      str(cur.lastrowid))
                     token = self._commit_locked()
                     intent_id = cur.lastrowid
                     self._inflight_intents.add(intent_id)
@@ -469,6 +573,7 @@ class Storage:
                             self._db.rollback()
                         except sqlite3.Error:
                             pass
+                        self._pending_notes = []
                     if not transient:
                         raise StorageError(
                             f"journal intent {pod_key}/{container}: {e}"
@@ -499,9 +604,10 @@ class Storage:
         with self._lock:
             self._write(
                 f"journal commit {intent_id}",
-                "DELETE FROM bind_intents WHERE id=?",
+                _SQL_DELETE_INTENT,
                 (intent_id,),
                 sync=False,
+                note=(events_mod.STORE_INTENT, "close", str(intent_id)),
             )
             self._inflight_intents.discard(intent_id)
 
@@ -596,10 +702,9 @@ class Storage:
         with self._lock:
             token = self._write(
                 f"save_state {key}",
-                "INSERT INTO agent_state(key, value, updated_ts) "
-                "VALUES(?, ?, ?) ON CONFLICT(key) DO UPDATE SET "
-                "value=excluded.value, updated_ts=excluded.updated_ts",
+                _SQL_UPSERT_STATE,
                 (key, json.dumps(value, sort_keys=True), time.time()),
+                note=(events_mod.STORE_STATE, "save", key),
             )
         # Lifecycle journals are written BEFORE their side effects run —
         # that ordering only means something if the row is durable first.
@@ -632,6 +737,7 @@ class Storage:
                 f"delete_state {key}",
                 "DELETE FROM agent_state WHERE key=?",
                 (key,),
+                note=(events_mod.STORE_STATE, "delete", key),
             )
         self._sync_wait(f"delete_state {key}", token)
 

@@ -48,7 +48,7 @@ class Cluster:
 
     def __init__(
         self, tmp_path, node="node-a", operator_kind="stub:v5litepod-4",
-        metrics=None,
+        metrics=None, **opt_overrides,
     ):
         self.node = node
         self.apiserver = FakeAPIServer()
@@ -68,6 +68,7 @@ class Cluster:
             alloc_spec_dir=str(tmp_path / "alloc"),
             kube_client=KubeClient(url),
             metrics=metrics,
+            **opt_overrides,
         )
         self.manager = TPUManager(self.opts)
 

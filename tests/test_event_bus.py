@@ -1,0 +1,355 @@
+"""Event-bus semantics + the no-gap poll fallback (events.py design
+contract, pinned clause by clause).
+
+The bus is the agent's poll-to-push seam: sources publish, loops run
+targeted passes, and the jittered periodic sweep is demoted to a
+stretched safety net. That only holds if the bus itself can never hurt
+the hot path — so these tests pin the load-bearing invariants:
+
+- publishers never block and never see a subscriber failure (bounded
+  queues drop-oldest with counted drops; callback exceptions are
+  isolated),
+- ordering is deterministic under ManualClock (global monotone seq,
+  injected-clock timestamps),
+- degraded mode is loud (BUS_WAKE broadcast on aggregate transitions
+  only) and the watch-stream-dies-during-brownout path collapses loops
+  back to their base sweep period with NO repair gap,
+- poll-only mode (bus disabled) converges to the same repaired end
+  state as event mode — the bus is an accelerator, never a
+  correctness dependency.
+"""
+
+import threading
+import time
+
+import pytest
+
+from elastic_tpu_agent import events
+from elastic_tpu_agent.common import (
+    AnnotationAssumed,
+    ManualClock,
+    ResourceTPUCore,
+    container_annotation,
+)
+from elastic_tpu_agent.plugins.tpushare import core_device_id
+from elastic_tpu_agent.types import Device
+
+from test_e2e import Cluster, wait_until
+from fake_apiserver import make_pod
+
+
+# -- bounded queues: overflow drops oldest, counted, never blocks -------------
+
+
+def test_overflow_drops_oldest_counted_never_blocks():
+    bus = events.EventBus(clock=ManualClock())
+    sub = bus.subscribe("slow", (events.POD_DELTA,), cap=4)
+    for i in range(10):
+        # publish returns the fan-out count and NEVER raises/blocks,
+        # full mailbox or not
+        assert bus.publish(events.POD_DELTA, "added", f"ns/p{i}") == 1
+    assert sub.drops == 6
+    drained = sub.drain()
+    # the mailbox holds the NEWEST cap events (drop-oldest semantics:
+    # a slow consumer keeps the freshest picture plus a drop count)
+    assert [e.key for e in drained] == ["ns/p6", "ns/p7", "ns/p8", "ns/p9"]
+    assert sub.pending() == 0
+    stats = bus.stats()
+    assert stats["drops_total"] == 6
+    assert stats["published_by_topic"][events.POD_DELTA] == 10
+
+
+def test_queue_cap_floor_is_one():
+    bus = events.EventBus(clock=ManualClock())
+    sub = bus.subscribe("tiny", (events.STORE_BIND,), cap=0)
+    bus.publish(events.STORE_BIND, "save", "a")
+    bus.publish(events.STORE_BIND, "save", "b")
+    assert sub.cap == 1
+    assert [e.key for e in sub.drain()] == ["b"]
+    assert sub.drops == 1
+
+
+# -- callback mode: subscriber exceptions never reach the publisher -----------
+
+
+def test_callback_exception_isolated_from_publisher():
+    bus = events.EventBus(clock=ManualClock())
+    seen = []
+
+    def boom(event):
+        raise RuntimeError("subscriber bug")
+
+    bad = bus.subscribe("bad", (events.POD_DELTA,), callback=boom)
+    good = bus.subscribe("good", (events.POD_DELTA,),
+                         callback=lambda e: seen.append(e.key))
+    # the crashing callback is counted, the publisher is untouched and
+    # the OTHER subscriber still gets every event
+    assert bus.publish(events.POD_DELTA, "added", "ns/x") == 2
+    assert bus.publish(events.POD_DELTA, "deleted", "ns/x") == 2
+    assert bad.callback_errors == 2
+    assert good.callback_errors == 0
+    assert seen == ["ns/x", "ns/x"]
+    assert bad.stats()["mode"] == "callback"
+
+
+# -- ManualClock determinism: monotone seq, injected timestamps ---------------
+
+
+def test_manualclock_deterministic_ordering():
+    clock = ManualClock(start=100.0)
+    bus = events.EventBus(clock=clock)
+    sub = bus.subscribe("all", events.ALL_TOPICS)
+    bus.publish(events.POD_DELTA, "added", "a")
+    clock.advance(1.5)
+    bus.publish(events.STORE_BIND, "save", "b")
+    clock.advance(0.5)
+    bus.publish(events.ASSIGNMENT_DELTA, "removed", "c")
+    drained = sub.drain()
+    assert [e.seq for e in drained] == [1, 2, 3]
+    assert [e.ts for e in drained] == [100.0, 101.5, 102.0]
+    assert [(e.topic, e.kind, e.key) for e in drained] == [
+        (events.POD_DELTA, "added", "a"),
+        (events.STORE_BIND, "save", "b"),
+        (events.ASSIGNMENT_DELTA, "removed", "c"),
+    ]
+
+
+def test_unknown_topic_rejected():
+    bus = events.EventBus(clock=ManualClock())
+    with pytest.raises(ValueError):
+        bus.subscribe("typo", ("pod.deltas",))
+
+
+def test_topic_filter_and_unsubscribe():
+    bus = events.EventBus(clock=ManualClock())
+    binds = bus.subscribe("binds", (events.STORE_BIND,))
+    pods = bus.subscribe("pods", (events.POD_DELTA,))
+    bus.publish(events.STORE_BIND, "save", "x")
+    assert binds.pending() == 1 and pods.pending() == 0
+    binds.close()
+    assert bus.publish(events.STORE_BIND, "save", "y") == 0
+    assert binds.pending() == 1  # nothing delivered after close
+    assert len(bus.stats()["subscribers"]) == 1
+
+
+# -- degraded mode: BUS_WAKE broadcast on AGGREGATE transitions only ----------
+
+
+def test_bus_wake_broadcast_on_aggregate_degraded_transitions():
+    bus = events.EventBus(clock=ManualClock())
+    # disjoint topic filters: BUS_WAKE must reach BOTH regardless
+    a = bus.subscribe("a", (events.POD_DELTA,))
+    b = bus.subscribe("b", (events.STORE_BIND,))
+    assert bus.healthy()
+
+    bus.set_degraded("sitter-watch", True)
+    assert not bus.healthy()
+    assert bus.degraded_sources() == ["sitter-watch"]
+    for sub in (a, b):
+        (wake,) = sub.drain()
+        assert (wake.topic, wake.kind, wake.key) == (
+            events.BUS_WAKE, "degraded", "sitter-watch")
+
+    # a SECOND source degrading is not a healthy->degraded transition:
+    # no extra broadcast (loops already collapsed their periods)
+    bus.set_degraded("kubelet-list", True)
+    assert a.pending() == 0 and b.pending() == 0
+
+    # partial recovery: still degraded in aggregate, still no broadcast
+    bus.set_degraded("sitter-watch", False)
+    assert not bus.healthy()
+    assert a.pending() == 0 and b.pending() == 0
+
+    # LAST source healing is the recovered transition: broadcast again
+    bus.set_degraded("kubelet-list", False)
+    assert bus.healthy()
+    for sub in (a, b):
+        (wake,) = sub.drain()
+        assert (wake.kind, wake.key) == ("recovered", "kubelet-list")
+
+
+def test_set_degraded_idempotent():
+    bus = events.EventBus(clock=ManualClock())
+    sub = bus.subscribe("s", (events.POD_DELTA,))
+    bus.set_degraded("src", True)
+    bus.set_degraded("src", True)  # repeat: no transition, no wake
+    assert len(sub.drain()) == 1
+    bus.set_degraded("src", False)
+    bus.set_degraded("src", False)
+    assert len(sub.drain()) == 1
+
+
+# -- chaos seam: suppress() swallows counted publishes ------------------------
+
+
+def test_suppress_seam_swallows_counted_publishes():
+    bus = events.EventBus(clock=ManualClock())
+    sub = bus.subscribe("s", (events.STORE_BIND, events.POD_DELTA))
+    bus.suppress(events.STORE_BIND, count=2)
+    assert bus.publish(events.STORE_BIND, "delete", "a") == 0
+    assert bus.publish(events.STORE_BIND, "delete", "b") == 0
+    # other topics unaffected while the suppression is armed
+    assert bus.publish(events.POD_DELTA, "added", "c") == 1
+    # armed count exhausted: third bind publish flows again
+    assert bus.publish(events.STORE_BIND, "save", "d") == 1
+    assert bus.suppressed_total == 2
+    assert [e.key for e in sub.drain()] == ["c", "d"]
+    assert bus.stats()["suppressed_total"] == 2
+
+
+# -- wait_trigger: stop / event / poll --------------------------------------
+
+
+def test_wait_trigger_returns_poll_on_timeout():
+    bus = events.EventBus(clock=ManualClock())
+    sub = bus.subscribe("s", (events.POD_DELTA,))
+    t0 = time.monotonic()
+    assert sub.wait_trigger(threading.Event(), 0.05) == "poll"
+    assert time.monotonic() - t0 < 2.0
+
+
+def test_wait_trigger_fires_immediately_on_pending_events():
+    bus = events.EventBus(clock=ManualClock())
+    sub = bus.subscribe("s", (events.POD_DELTA,))
+    bus.publish(events.POD_DELTA, "added", "x")
+    t0 = time.monotonic()
+    # a LONG timeout must not matter: undrained events fire at once
+    assert sub.wait_trigger(threading.Event(), 30.0) == "event"
+    assert time.monotonic() - t0 < 1.0
+    sub.drain()
+
+
+def test_wait_trigger_honors_stop():
+    bus = events.EventBus(clock=ManualClock())
+    sub = bus.subscribe("s", (events.POD_DELTA,))
+    stop = threading.Event()
+    stop.set()
+    assert sub.wait_trigger(stop, 30.0) == "stop"
+
+
+def test_wait_trigger_wakes_on_concurrent_publish():
+    bus = events.EventBus(clock=ManualClock())
+    sub = bus.subscribe("s", (events.STORE_BIND,))
+    result = []
+    t = threading.Thread(
+        target=lambda: result.append(
+            sub.wait_trigger(threading.Event(), 10.0))
+    )
+    t.start()
+    time.sleep(0.05)
+    bus.publish(events.STORE_BIND, "save", "x")
+    t.join(timeout=5.0)
+    assert result == ["event"]
+
+
+# -- integration: poll-only fallback equivalence ------------------------------
+
+POD = "event-pod"
+CHIPS = [core_device_id(1, 0), core_device_id(1, 1)]
+
+
+def _bind_pod(c, pod_name=POD, chips="2"):
+    c.apiserver.upsert_pod(
+        make_pod(
+            "default", pod_name, c.node,
+            annotations={
+                AnnotationAssumed: "true",
+                container_annotation("jax"): chips,
+            },
+            containers=[{"name": "jax"}],
+        )
+    )
+    assert wait_until(
+        lambda: c.manager.sitter.get_pod("default", pod_name) is not None
+    )
+    c.kubelet.assign("default", pod_name, "jax", ResourceTPUCore, CHIPS)
+    c.manager.plugin.core._bind(Device(CHIPS, ResourceTPUCore))
+    assert c.manager.storage.load("default", pod_name) is not None
+
+
+@pytest.mark.parametrize("enable_bus", [True, False])
+def test_lost_record_repaired_in_event_and_poll_only_modes(
+    tmp_path, enable_bus
+):
+    """The bus is an accelerator, never a correctness dependency: a
+    deleted store record (kubelet assignment surviving) is replayed to
+    the same repaired state whether the bus is on or off (poll-only
+    fallback mode, the chaos matrix's second leg)."""
+    c = Cluster(
+        tmp_path,
+        enable_event_bus=enable_bus,
+        reconcile_period_s=0.4,
+        event_safety_net_factor=1.0,
+    )
+    c.start()
+    try:
+        assert (c.manager.bus is not None) == enable_bus
+        _bind_pod(c)
+        c.manager.storage.delete("default", POD)
+        assert wait_until(
+            lambda: c.manager.storage.load("default", POD) is not None,
+            timeout=15.0,
+        ), f"lost record never replayed (enable_bus={enable_bus})"
+        repaired = c.manager.storage.load("default", POD)
+        device = repaired.device_of("jax", ResourceTPUCore)
+        assert device is not None
+        assert sorted(device.ids) == sorted(CHIPS)
+    finally:
+        c.stop()
+
+
+# -- pinned regression: watch dies during brownout -> no repair gap -----------
+
+
+def test_brownout_watch_death_falls_back_to_sweep_no_gap_seed_20260807(
+    tmp_path,
+):
+    """Watch stream dies during an apiserver brownout: the sitter flips
+    the bus degraded (BUS_WAKE broadcast), every loop collapses back to
+    its base sweep period, and a lost store record is STILL repaired
+    promptly — far inside the stretched safety-net period the loops
+    were using while healthy. Seeded brownout: same seed, same failure
+    sequence."""
+    c = Cluster(
+        tmp_path,
+        reconcile_period_s=0.4,
+        # stretched sweep would be 20s: a repair landing in a few
+        # seconds proves the loop fell back to its 0.4s base period
+        event_safety_net_factor=50.0,
+    )
+    # short watch windows so the brownout kills the stream quickly
+    c.manager.sitter._relist_s = 1.0
+    c.start()
+    try:
+        assert c.manager.bus is not None
+        _bind_pod(c)
+        assert wait_until(c.manager.bus.healthy, timeout=10.0)
+
+        c.apiserver.set_brownout(error_rate=1.0, seed=20260807)
+        assert wait_until(
+            lambda: not c.manager.bus.healthy(), timeout=20.0
+        ), "sitter never reported its dead watch stream"
+        assert "sitter-watch" in c.manager.bus.degraded_sources()
+
+        # mid-brownout repair: pod deltas are NOT flowing, so only the
+        # (collapsed) periodic sweep can catch this
+        t0 = time.monotonic()
+        c.manager.storage.delete("default", POD)
+        assert wait_until(
+            lambda: c.manager.storage.load("default", POD) is not None,
+            timeout=10.0,
+        ), "no repair while degraded: the poll fallback has a gap"
+        took = time.monotonic() - t0
+        # stretched period is 20s; base-period two-pass repair is ~1s
+        assert took < 8.0, (
+            f"repair took {took:.1f}s mid-brownout -- loop still "
+            "sleeping its stretched safety-net period"
+        )
+
+        c.apiserver.clear_brownout()
+        assert wait_until(c.manager.bus.healthy, timeout=20.0), (
+            "bus never recovered after the brownout cleared"
+        )
+    finally:
+        c.apiserver.clear_brownout()
+        c.stop()

@@ -254,7 +254,31 @@ def test_detection_lag_exports_loop_stage_histogram():
     from prometheus_client import generate_latest
 
     text = generate_latest(m._registry).decode()
-    assert 'elastic_tpu_detection_lag_seconds_count{loop="drain",stage="repair"} 1.0' in text
+    assert 'elastic_tpu_detection_lag_seconds_count{loop="drain",stage="repair",trigger="poll"} 1.0' in text
+
+
+def test_detection_lag_trigger_label_separates_event_from_poll():
+    from prometheus_client import CollectorRegistry, generate_latest
+
+    from elastic_tpu_agent.metrics import AgentMetrics
+
+    m = AgentMetrics(registry=CollectorRegistry())
+    clk = ManualClock()
+    lag = DetectionLagTracker(metrics=m, clock=clk)
+    lag.mark("lost-record", key="a")
+    clk.advance(0.01)
+    lag.repaired("reconciler", "lost-record", key="a", trigger="event")
+    lag.mark("lost-record", key="b")
+    clk.advance(0.5)
+    lag.repaired("reconciler", "lost-record", key="b", trigger="poll")
+    text = generate_latest(m._registry).decode()
+    assert 'loop="reconciler",stage="repair",trigger="event"} 1.0' in text
+    assert 'loop="reconciler",stage="repair",trigger="poll"} 1.0' in text
+    # status() splits the same class per trigger for the fleet rollup
+    cls = lag.status()["classes"]["lost-record"]
+    assert cls["triggers"]["event"]["count"] == 1
+    assert cls["triggers"]["poll"]["count"] == 1
+    assert cls["triggers"]["event"]["p50_s"] < cls["triggers"]["poll"]["p50_s"]
 
 
 def test_bind_phase_histogram_exported_with_residual():
@@ -463,6 +487,41 @@ def test_perf_gate_self_test_catches_seeded_regression(tmp_path):
     _write_rounds(tmp_path, [_round(1), _round(2), _round(3)])
     rounds, _ = bh.load_history(str(tmp_path))
     assert bh.self_test(rounds) == []  # the seeded regression was caught
+
+
+def test_perf_gate_trips_on_event_core_regression(tmp_path):
+    from elastic_tpu_agent import bench_history as bh
+
+    # event_core is tolerant-of-missing: rounds 1-2 predate the event
+    # leg and must not be schema errors; once the series publishes, a
+    # blowup trips the gate like any other lower-is-better latency.
+    rounds = [_round(1), _round(2)]
+    for n, e2r in ((3, 20.0), (4, 22.0), (5, 180.0)):
+        r = _round(n)
+        r["parsed"]["extra"]["event_core"] = {
+            "event_to_repair_ms": e2r,
+            "bind_churn_p99_ms": 5.0,
+        }
+        rounds.append(r)
+    _write_rounds(tmp_path, rounds)
+    loaded, problems = bh.load_history(str(tmp_path))
+    problems.extend(bh.validate_history(loaded))
+    assert problems == []
+    tripped = bh.perf_gate(loaded)
+    assert any("REGRESSION event_to_repair_ms" in p for p in tripped)
+    assert not any("bind_churn_p99_ms" in p for p in tripped)
+
+
+def test_perf_gate_event_self_test_catches_seeded_blowup(tmp_path):
+    from elastic_tpu_agent import bench_history as bh
+
+    # with no committed event-core points the self-test proves the
+    # gate on a synthetic trajectory (a gate only provable on future
+    # data is not yet a gate)
+    _write_rounds(tmp_path, [_round(1), _round(2), _round(3)])
+    rounds, _ = bh.load_history(str(tmp_path))
+    assert bh.event_self_test(rounds) == []
+    assert bh.self_test(rounds) == []  # composite still green
 
 
 def test_perf_gate_cli_roundtrip(tmp_path):
