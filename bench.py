@@ -1732,6 +1732,20 @@ MIGRATE_NODES = 4
 MIGRATE_DEADLINE_S = 10.0
 MIGRATE_SMOKE_TIMEOUT_S = 90.0
 
+# Pre-copy leg (ISSUE 20): a 4 MiB synthetic state shipped at 3 MiB/s
+# makes the full-vs-delta downtime difference MEASURABLE — a full
+# checkpoint pauses ~1.3s to ship everything, a pre-copy cutover pauses
+# only for the last dirty delta (tens of ms). The dirty rate is tuned
+# so rounds converge well under the ship bandwidth.
+PRECOPY_NODES = 2
+PRECOPY_DEADLINE_S = 8.0
+PRECOPY_STATE_BYTES = 4 << 20
+PRECOPY_SHIP_BPS = 3 * (1 << 20)
+PRECOPY_DIRTY_FRACTION = 0.01
+PRECOPY_TICK_S = 0.05
+PRECOPY_DOWNTIME_BUDGET_MS = 300.0
+PRECOPY_DELTA_RATIO_BUDGET = 0.25
+
 
 def run_migrate_scenario(sim, ckpt_root, timeout_s=60.0):
     """Drive the verified-migration chaos scenario on a RUNNING FleetSim
@@ -1976,6 +1990,159 @@ def run_migrate_scenario(sim, ckpt_root, timeout_s=60.0):
     }
 
 
+def run_precopy_scenario(sim, ckpt_root, timeout_s=60.0):
+    """Drive the sub-second-migration scenario (ISSUE 20 acceptance) on
+    a RUNNING 2-node FleetSim: node 1 hosts a pre-copy training pod and
+    a full-checkpoint baseline pod carrying IDENTICAL state sizes over
+    the same simulated storage bandwidth. A maintenance drain makes the
+    baseline pause for the whole state ship (~1.3s) while the pre-copy
+    pod streams delta rounds live and pauses only for the final delta
+    at the coordinator's cutover — that pause must be < 300ms AND the
+    final delta < 25% of the full state. The replacement on node 0 then
+    restores from the delta chain, the destination verifies the chain
+    digest before deleting the record, and the resume step must be >=
+    the acked cutover step."""
+    from elastic_tpu_agent.crd import ElasticTPUClient
+    from elastic_tpu_agent.kube.client import KubeClient
+    from elastic_tpu_agent.migration import migration_object_name
+    from elastic_tpu_agent.workloads.checkpointing import DeltaCheckpointer
+
+    problems = []
+    victim_idx, dest_idx = 1, 0
+    pre = sim.admit_pod("train", "pre", victim_idx, chip=1)
+    base = sim.admit_pod("train", "base", victim_idx, chip=2)
+    sim.wait_synced([pre, base])
+    sim.bind_pod(pre)
+    sim.bind_pod(base)
+    pre_dir = os.path.join(ckpt_root, "pre")
+    w_pre = sim.start_workload(
+        pre, pre_dir, tick_s=PRECOPY_TICK_S, precopy=True,
+        state_bytes=PRECOPY_STATE_BYTES,
+        dirty_fraction=PRECOPY_DIRTY_FRACTION,
+        precopy_interval_ticks=2, ship_bps=PRECOPY_SHIP_BPS,
+    )
+    w_base = sim.start_workload(
+        base, os.path.join(ckpt_root, "base"), tick_s=PRECOPY_TICK_S,
+        state_bytes=PRECOPY_STATE_BYTES,
+        dirty_fraction=PRECOPY_DIRTY_FRACTION,
+        ship_bps=PRECOPY_SHIP_BPS,
+    )
+    time.sleep(0.3)  # a few training steps before the trigger
+
+    sim.trigger_maintenance(victim_idx)
+    if not w_base.exited.wait(timeout_s):
+        problems.append("baseline workload never finished its drain")
+    if not w_pre.exited.wait(timeout_s):
+        problems.append("pre-copy workload never reached cutover")
+
+    downtime_ms = w_pre.pause_ms
+    baseline_ms = w_base.pause_ms
+    ratio = None
+    if w_pre.final_delta_bytes is not None and w_pre.full_bytes:
+        ratio = w_pre.final_delta_bytes / w_pre.full_bytes
+    if downtime_ms is None:
+        problems.append("pre-copy cutover never measured a pause")
+    else:
+        if downtime_ms >= PRECOPY_DOWNTIME_BUDGET_MS:
+            problems.append(
+                f"cutover downtime {downtime_ms:.1f}ms >= "
+                f"{PRECOPY_DOWNTIME_BUDGET_MS:.0f}ms budget"
+            )
+        if baseline_ms is not None and downtime_ms >= baseline_ms:
+            problems.append(
+                f"cutover downtime {downtime_ms:.1f}ms not better than "
+                f"the full-checkpoint baseline {baseline_ms:.1f}ms"
+            )
+    if ratio is None:
+        problems.append("pre-copy never recorded a final delta")
+    elif ratio >= PRECOPY_DELTA_RATIO_BUDGET:
+        problems.append(
+            f"final delta {ratio:.3f} of full state >= "
+            f"{PRECOPY_DELTA_RATIO_BUDGET} budget"
+        )
+    if w_pre.precopy_rounds < 2:
+        problems.append(
+            f"only {w_pre.precopy_rounds} pre-copy round(s) ran before "
+            "cutover (want streaming rounds, not a degenerate pause)"
+        )
+
+    # Source-side chain check: what the destination will verify.
+    chain_report = DeltaCheckpointer(pre_dir).verify()
+    if not chain_report.get("ok"):
+        problems.append(
+            "source delta chain failed verification: "
+            + "; ".join(chain_report.get("problems") or ["unknown"])
+        )
+    elif w_pre.final_chain and chain_report.get("chain") != w_pre.final_chain:
+        problems.append(
+            f"delta chain {chain_report.get('chain')} != workload's "
+            f"cutover chain {w_pre.final_chain}"
+        )
+
+    # Replacement on node 0 restores FROM THE DELTA CHAIN; the
+    # destination coordinator verifies the chain digest against the
+    # record before completing (and only then deletes the record).
+    sim.delete_pods([pre])
+    rep = sim.admit_pod("train", "pre", dest_idx, chip=1)
+    sim.wait_synced([rep])
+    sim.bind_pod(rep)
+    w_rep = sim.start_workload(
+        rep, pre_dir, tick_s=PRECOPY_TICK_S, resume_wait_s=20.0,
+        precopy=True, state_bytes=PRECOPY_STATE_BYTES,
+        dirty_fraction=PRECOPY_DIRTY_FRACTION,
+    )
+    completion = None
+    try:
+        completion = sim.wait_migration_completed(
+            dest_idx, "train/pre", timeout_s=timeout_s
+        )
+    except RuntimeError as e:
+        problems.append(f"pre-copy resume verification: {e}")
+    if completion is not None:
+        if completion.get("mode") != "precopy":
+            problems.append(
+                f"completion mode {completion.get('mode')!r} != "
+                "'precopy' (record lost the pre-copy metadata)"
+            )
+        if (
+            w_rep.resumed_step is None or w_pre.saved_step is None
+            or w_rep.resumed_step < w_pre.saved_step
+        ):
+            problems.append(
+                f"replacement resumed at step {w_rep.resumed_step} < "
+                f"acked cutover step {w_pre.saved_step}"
+            )
+    crd = ElasticTPUClient(KubeClient(sim.api_url))
+    record_name = migration_object_name("train", "pre")
+    wait_until = time.monotonic() + 10.0
+    while time.monotonic() < wait_until and crd.get(record_name) is not None:
+        time.sleep(0.05)
+    if crd.get(record_name) is not None:
+        problems.append("verified pre-copy MigrationRecord not deleted")
+
+    for w in (w_pre, w_base, w_rep):
+        w.stop()
+    return {
+        "migration_downtime_ms": (
+            round(downtime_ms, 1) if downtime_ms is not None else None
+        ),
+        "full_checkpoint_baseline_ms": (
+            round(baseline_ms, 1) if baseline_ms is not None else None
+        ),
+        "migration_delta_bytes_ratio": (
+            round(ratio, 4) if ratio is not None else None
+        ),
+        "precopy_rounds": w_pre.precopy_rounds,
+        "final_delta_bytes": w_pre.final_delta_bytes,
+        "full_state_bytes": w_pre.full_bytes,
+        "chain_verified": bool(chain_report.get("ok")),
+        "acked_step": w_pre.saved_step,
+        "resumed_step": w_rep.resumed_step,
+        "completion": completion,
+        "problems": problems,
+    }
+
+
 def run_migrate_leg(timeout_s=MIGRATE_SMOKE_TIMEOUT_S):
     """A self-contained migrate leg (own small FleetSim + scratch
     checkpoint 'PVC'): used by `bench.py --migrate`, `make
@@ -2004,9 +2171,38 @@ def run_migrate_leg(timeout_s=MIGRATE_SMOKE_TIMEOUT_S):
                 r["fleet_goodput"] = {
                     "failed": True, "error": f"{type(e).__name__}: {e}",
                 }
-            return r
         finally:
             sim.stop()
+        # Pre-copy vs full-checkpoint downtime, on its own small fleet
+        # (same smoke run, isolated drain dynamics): headline numbers
+        # ride at the top level so the perf gate can track them.
+        sim2 = FleetSim(
+            os.path.join(tmp, "p"), nodes=PRECOPY_NODES,
+            reconcile_period_s=0.5, slice_membership_ttl_s=0.25,
+            drain_deadline_s=PRECOPY_DEADLINE_S, drain_period_s=0.25,
+            migration_period_s=0.1,
+        )
+        try:
+            sim2.start()
+            p = run_precopy_scenario(
+                sim2, os.path.join(tmp, "pvc2"), timeout_s=timeout_s
+            )
+        except Exception as e:  # noqa: BLE001 - explicit, not silence
+            p = {
+                "failed": True, "error": f"{type(e).__name__}: {e}",
+                "problems": [f"precopy leg crashed: {type(e).__name__}: {e}"],
+            }
+        finally:
+            sim2.stop()
+        r["precopy"] = p
+        r["migration_downtime_ms"] = p.get("migration_downtime_ms")
+        r["migration_delta_bytes_ratio"] = p.get(
+            "migration_delta_bytes_ratio"
+        )
+        r["problems"] = r["problems"] + [
+            f"precopy: {x}" for x in p.get("problems", [])
+        ]
+        return r
 
 
 def migrate_smoke_main():
@@ -4898,6 +5094,29 @@ def main():
             "skipped": True,
             "reason": "fleet event leg unavailable this round",
         }
+    # Headline migration series for the perf gate, lifted out of the
+    # fleet leg's pre-copy scenario (the full report stays under
+    # extra.fleet.migration).
+    mig = fleet.get("migration") if isinstance(fleet, dict) else None
+    if isinstance(mig, dict) and isinstance(
+        mig.get("migration_downtime_ms"), (int, float)
+    ):
+        precopy = mig.get("precopy") or {}
+        migration_core = {
+            "migration_downtime_ms": mig.get("migration_downtime_ms"),
+            "migration_delta_bytes_ratio": mig.get(
+                "migration_delta_bytes_ratio"
+            ),
+            "full_checkpoint_baseline_ms": precopy.get(
+                "full_checkpoint_baseline_ms"
+            ),
+            "precopy_rounds": precopy.get("precopy_rounds"),
+        }
+    else:
+        migration_core = {
+            "skipped": True,
+            "reason": "fleet migration leg unavailable this round",
+        }
     vs_baseline = ref["bind_p50_ms"] / ours["bind_p50_ms"]
     load_ratio = probe_s / _HOST_PROBE_REF_S
     # Headline = the RATIO: both sides of it ran in this process under
@@ -4973,6 +5192,11 @@ def main():
             # leg's A/B for the perf gate (bench_history tracks
             # event_to_repair_ms and bind_churn_p99_ms here).
             "event_core": event_core,
+            # Pre-copy migration headline numbers lifted from the
+            # fleet leg's migration scenario for the perf gate
+            # (bench_history tracks migration_downtime_ms and
+            # migration_delta_bytes_ratio here).
+            "migration_core": migration_core,
             "tpu": tpu,
             "qos_colocation": qos,
         },

@@ -67,6 +67,22 @@ TRACKED_EVENT: Tuple[Tuple[str, Tuple[str, ...]], ...] = (
      ("parsed", "extra", "event_core", "bind_churn_p99_ms")),
 )
 
+# Migration series: lower-is-better, from the bench's migration_core
+# block (lifted out of the fleet leg's pre-copy scenario, ISSUE 20).
+# ``migration_downtime_ms`` is the cutover pause — the headline the
+# sub-second-migration work exists to keep small — and
+# ``migration_delta_bytes_ratio`` is final-delta/full-state, whose
+# blowup means delta streaming degraded back toward shipping full
+# checkpoints. Both tolerant-of-missing like the other late-entry
+# series; the ratio uses DEFAULT_FLOOR_RATIO for slack (a 0.25ms floor
+# would swamp a unitless ~0.04 ratio).
+TRACKED_MIGRATION: Tuple[Tuple[str, Tuple[str, ...]], ...] = (
+    ("migration_downtime_ms",
+     ("parsed", "extra", "migration_core", "migration_downtime_ms")),
+    ("migration_delta_bytes_ratio",
+     ("parsed", "extra", "migration_core", "migration_delta_bytes_ratio")),
+)
+
 DEFAULT_TOLERANCE = 0.5   # +50% over the rolling-median baseline
 DEFAULT_FLOOR_MS = 0.25   # plus absolute slack: sub-ms jitter never trips
 DEFAULT_FLOOR_RATIO = 0.05  # ratio-series absolute slack (unitless)
@@ -220,6 +236,27 @@ def perf_gate(
                 f"{len(prior)} round(s), tolerance +{tolerance:.0%} "
                 f"+ {floor_ms}ms)"
             )
+    # migration series: lower-is-better like the latency series, but
+    # the bytes ratio is unitless so its absolute slack is
+    # DEFAULT_FLOOR_RATIO, not the millisecond floor
+    for name, points in sorted(series(rounds, TRACKED_MIGRATION).items()):
+        if len(points) < MIN_ROUNDS:
+            continue
+        n, latest = points[-1]
+        prior = [v for _, v in points[:-1]][-max(1, window):]
+        baseline = statistics.median(prior)
+        is_ms = name.endswith("_ms")
+        floor = floor_ms if is_ms else DEFAULT_FLOOR_RATIO
+        unit = "ms" if is_ms else "x"
+        limit = baseline * (1.0 + tolerance) + floor
+        if latest > limit:
+            problems.append(
+                f"REGRESSION {name}: round {n} measured "
+                f"{latest:.3f}{unit} > {limit:.3f}{unit} allowed "
+                f"(baseline median {baseline:.3f}{unit} over last "
+                f"{len(prior)} round(s), tolerance +{tolerance:.0%} "
+                f"+ {floor}{unit})"
+            )
     # serving ratio series: inverted trip (a COLLAPSED ratio is the
     # regression), same rolling-median baseline
     for name, points in sorted(series(rounds, TRACKED_RATIOS).items()):
@@ -281,6 +318,9 @@ def self_test(
     problems.extend(event_self_test(
         rounds, tolerance=tolerance, floor_ms=floor_ms, window=window,
     ))
+    problems.extend(migration_self_test(
+        rounds, tolerance=tolerance, floor_ms=floor_ms, window=window,
+    ))
     return problems
 
 
@@ -331,6 +371,64 @@ def ratio_self_test(
             "did NOT trip the gate"
         ]
     return []
+
+
+def migration_self_test(
+    rounds: List[dict],
+    tolerance: float = DEFAULT_TOLERANCE,
+    floor_ms: float = DEFAULT_FLOOR_MS,
+    window: int = DEFAULT_WINDOW,
+) -> List[str]:
+    """Prove the migration gate can fail: seed a cutover-downtime
+    blowup (pre-copy silently degrading to a full-checkpoint pause)
+    and a delta-bytes-ratio blowup (delta streaming shipping most of
+    the state again) and assert each trips. Uses the committed
+    trajectory once it carries migration_core points; until then a
+    synthetic three-round trajectory — same rationale as the other
+    late-entry series' self-tests."""
+    problems: List[str] = []
+    synthetic = {
+        "migration_downtime_ms": (180.0, 220.0, 200.0),
+        "migration_delta_bytes_ratio": (0.12, 0.15, 0.13),
+    }
+    for name, path in TRACKED_MIGRATION:
+        base = [r for r in rounds if isinstance(_dig(r["data"], path),
+                                                (int, float))]
+        if len(base) >= MIN_ROUNDS:
+            trajectory = base
+            seeded = copy.deepcopy(base[-1])
+            seeded["n"] = base[-1]["n"] + 1
+        else:
+            trajectory = []
+            for i, value in enumerate(synthetic[name]):
+                data: dict = {}
+                node = data
+                for key in path[:-1]:
+                    node = node.setdefault(key, {})
+                node[path[-1]] = value
+                trajectory.append({
+                    "n": i + 1, "path": f"<synthetic-{i + 1}>",
+                    "data": data,
+                })
+            seeded = copy.deepcopy(trajectory[-1])
+            seeded["n"] = trajectory[-1]["n"] + 1
+        seeded["path"] = "<seeded-migration-regression>"
+        node = seeded["data"]
+        for key in path[:-1]:
+            node = node.setdefault(key, {})
+        floor = floor_ms if name.endswith("_ms") else DEFAULT_FLOOR_RATIO
+        blown = float(node[path[-1]]) * (1.0 + tolerance) * 4 + 10 * floor
+        node[path[-1]] = blown
+        tripped = perf_gate(
+            [*trajectory, seeded], tolerance=tolerance,
+            floor_ms=floor_ms, window=window,
+        )
+        if not any(f"REGRESSION {name}" in p for p in tripped):
+            problems.append(
+                f"self-test: seeded blowup of {name} to {blown:.3f} "
+                "did NOT trip the gate"
+            )
+    return problems
 
 
 def event_self_test(

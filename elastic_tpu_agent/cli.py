@@ -127,6 +127,12 @@ def parse_args(argv=None) -> argparse.Namespace:
     p.add_argument("--drain-period", type=float, default=2.0,
                    help="seconds between drain-orchestrator trigger "
                         "polls (jittered 0.75x-1.25x)")
+    p.add_argument("--preemption-notice", type=float, default=30.0,
+                   help="spot preemption notice window (seconds): a "
+                        "preemption-triggered drain clamps its budget "
+                        "to min(--drain-deadline, this) so checkpoint "
+                        "cutover always beats the platform reclaim; "
+                        "0 disables the clamp")
     p.add_argument("--goodput-period", type=float, default=10.0,
                    help="seconds between goodput-ledger journal replays "
                         "(per-pod productive/downtime partition + "
@@ -625,6 +631,7 @@ def perf_gate_main(argv=None) -> int:
         if args.series:
             all_tracked = (
                 *bh.TRACKED, *bh.TRACKED_RATIOS, *bh.TRACKED_EVENT,
+                *bh.TRACKED_MIGRATION,
             )
             for name, points in sorted(
                 bh.series(rounds, all_tracked).items()
@@ -648,7 +655,8 @@ def perf_gate_main(argv=None) -> int:
         return 1
     tracked = ", ".join(
         name for name, _ in
-        (*bh.TRACKED, *bh.TRACKED_RATIOS, *bh.TRACKED_EVENT)
+        (*bh.TRACKED, *bh.TRACKED_RATIOS, *bh.TRACKED_EVENT,
+         *bh.TRACKED_MIGRATION)
     )
     print(
         f"perf-gate OK: {len(rounds)} round(s), tracked [{tracked}]"
@@ -723,6 +731,7 @@ def main(argv=None) -> int:
             reconcile_dry_run=args.reconcile_dry_run,
             slice_membership_ttl_s=args.slice_membership_ttl,
             drain_deadline_s=args.drain_deadline,
+            preemption_notice_s=args.preemption_notice,
             drain_period_s=args.drain_period,
             enable_repartition=not args.no_repartition,
             repartition_period_s=args.repartition_period,

@@ -126,6 +126,15 @@ AllocSidecarSubdirs = (UsageReportSubdir, AckSubdir, FlightSummarySubdir)
 EnvRestoreDir = "ELASTIC_TPU_RESTORE_DIR"
 EnvRestoreStep = "ELASTIC_TPU_RESTORE_STEP"
 EnvRestoreTrace = "ELASTIC_TPU_RESTORE_TRACE"
+# Pre-copy cutover signal (migration.py -> workloads/lifecycle.py): a
+# draining workload that streams delta checkpoints (kind="precopy" acks)
+# keeps training until the coordinator stamps this env into its alloc
+# specs — the value is the cutover generation ("<drain trigger>:<round>")
+# so repeated cutovers within one agent lifetime each fire their own
+# signal edge. On the edge the workload pauses, ships the FINAL delta
+# and writes its ordinary kind="checkpoint" ack; downtime is the final
+# delta, not the full state.
+EnvCutover = "ELASTIC_TPU_CUTOVER"
 
 # -- Container env contract ---------------------------------------------------
 # Env carrying the allocation hash into the container; the OCI hook resolves

@@ -107,6 +107,11 @@ TRIGGER_PREEMPTION = "preemption"
 TRIGGER_OPERATOR = "operator"
 
 DEFAULT_DEADLINE_S = 300.0
+# A spot/preemptible host gives roughly this much warning before the
+# platform reclaims it (GCE's notice window): a preemption-triggered
+# drain clamps its budget to the notice — a 300s --drain-deadline is a
+# promise the host cannot keep, and cutover MUST beat the reclaim.
+DEFAULT_PREEMPTION_NOTICE_S = 30.0
 DEFAULT_PERIOD_S = 2.0
 # How long one GET /api/v1/nodes/<name> answer (the drain-annotation
 # read) stays fresh: the tick period is 2s but a fleet of agents must
@@ -132,6 +137,7 @@ class DrainOrchestrator:
         metrics=None,
         node_name: str = "",
         deadline_s: float = DEFAULT_DEADLINE_S,
+        preemption_notice_s: float = DEFAULT_PREEMPTION_NOTICE_S,
         period_s: float = DEFAULT_PERIOD_S,
         node_poll_ttl_s: float = DEFAULT_NODE_POLL_TTL_S,
         rng=None,
@@ -151,6 +157,7 @@ class DrainOrchestrator:
         self._metrics = metrics
         self._node = node_name
         self.deadline_s = deadline_s
+        self.preemption_notice_s = max(0.0, float(preemption_notice_s))
         self.period_s = period_s
         self.node_poll_ttl_s = node_poll_ttl_s
         self._node_ann_asserted = False
@@ -507,11 +514,24 @@ class DrainOrchestrator:
 
     # -- the lifecycle --------------------------------------------------------
 
+    def _drain_budget_s(self, trigger: str) -> float:
+        """The drain/pre-copy budget for this trigger: the configured
+        deadline, CLAMPED to the preemption notice window when the host
+        itself is going away — a deadline longer than the notice is a
+        promise the platform will break mid-checkpoint."""
+        if (
+            trigger.split(":", 1)[0] == TRIGGER_PREEMPTION
+            and self.preemption_notice_s > 0.0
+        ):
+            return min(self.deadline_s, self.preemption_notice_s)
+        return self.deadline_s
+
     def _start_drain(self, trigger: str) -> None:
         now = self._clock.time()
+        budget_s = self._drain_budget_s(trigger)
         with self._lock:
             self.trigger = trigger
-            self.deadline_ts = now + self.deadline_s
+            self.deadline_ts = now + budget_s
             self._drains_total += 1
             self._stamped_pods = []
             self._annotated_pods = []
@@ -525,8 +545,10 @@ class DrainOrchestrator:
         faults.fire("drain.pre_cordon")
         self._plugin.set_cordoned(True)
         logger.warning(
-            "drain: node cordoned (trigger %s, deadline in %.0fs)",
-            trigger, self.deadline_s,
+            "drain: node cordoned (trigger %s, deadline in %.0fs%s)",
+            trigger, budget_s,
+            (" — clamped to the preemption notice"
+             if budget_s < self.deadline_s else ""),
         )
         if self._events is not None:
             from .kube.events import ReasonNodeDraining
@@ -536,7 +558,7 @@ class DrainOrchestrator:
                     ReasonNodeDraining,
                     f"draining TPU workloads ({trigger}): chips "
                     "unschedulable, residents signalled to checkpoint; "
-                    f"bindings reclaimed in {self.deadline_s:.0f}s",
+                    f"bindings reclaimed in {budget_s:.0f}s",
                     type_="Warning",
                 )
             except Exception:  # noqa: BLE001
@@ -932,8 +954,15 @@ class DrainOrchestrator:
                 self.trigger, trigger,
             )
             upgraded_from = self.trigger
+            # The upgraded drain inherits the SHORTER horizon: the
+            # preemption notice started ticking NOW, so the existing
+            # (maintenance-sized) deadline is clamped to the notice
+            # window — never extended.
+            clamp_ts = self._clock.time() + self._drain_budget_s(trigger)
             with self._lock:
                 self.trigger = trigger
+                if self.deadline_ts is None or clamp_ts < self.deadline_ts:
+                    self.deadline_ts = clamp_ts
                 self._journal()
             if self._timeline is not None:
                 from .timeline import KIND_DRAIN_TRANSITION
@@ -1091,6 +1120,7 @@ class DrainOrchestrator:
                 "deadline_ts": self.deadline_ts,
                 "deadline_in_s": deadline_in,
                 "deadline_s": self.deadline_s,
+                "preemption_notice_s": self.preemption_notice_s,
                 "drains_total": self._drains_total,
                 "outcome": self.outcome,
                 "acked_pods": list(self._acked_pods),

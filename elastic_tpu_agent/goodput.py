@@ -104,6 +104,12 @@ CAUSE_OPERATOR_DRAIN = "operator_drain"
 CAUSE_QOS_THROTTLE = "qos_throttle"
 CAUSE_QOS_EVICT = "qos_evict"
 CAUSE_MIGRATION = "migration"
+# Pre-copy migrations split the old blanket "migration" price: the
+# streaming rounds run WHILE the workload trains (priced productive,
+# surfaced as precopy_s on the migrations list), and only the residual
+# pause→final-delta→restore window is downtime, under its own label.
+CAUSE_MIGRATION_PRECOPY = "migration_precopy"
+CAUSE_MIGRATION_CUTOVER = "migration_cutover"
 CAUSE_SLICE_REFORM = "slice_reform"
 CAUSE_AGENT_RESTART = "agent_restart"
 CAUSE_BIND_QUEUE = "bind_queue"
@@ -112,6 +118,7 @@ CAUSE_UNATTRIBUTED = "unattributed"
 CAUSES = (
     CAUSE_MAINTENANCE, CAUSE_PREEMPTION, CAUSE_OPERATOR_DRAIN,
     CAUSE_QOS_THROTTLE, CAUSE_QOS_EVICT, CAUSE_MIGRATION,
+    CAUSE_MIGRATION_PRECOPY, CAUSE_MIGRATION_CUTOVER,
     CAUSE_SLICE_REFORM, CAUSE_AGENT_RESTART, CAUSE_BIND_QUEUE,
     CAUSE_UNATTRIBUTED,
 )
@@ -142,6 +149,13 @@ def cause_category(event: Optional[dict]) -> str:
             else CAUSE_QOS_THROTTLE
         )
     if kind == tl.KIND_MIGRATION:
+        if attrs.get("action") in ("precopy_round", "cutover_signaled"):
+            return CAUSE_MIGRATION_PRECOPY
+        if attrs.get("action") == "cutover":
+            # the replay-synthesized anchor for the pause→final-delta→
+            # ack residual of a pre-copy migration; the surrounding
+            # MIGRATING window stays plain "migration"
+            return CAUSE_MIGRATION_CUTOVER
         return CAUSE_MIGRATION
     if kind == tl.KIND_SLICE_REFORMED:
         return CAUSE_SLICE_REFORM
@@ -187,7 +201,7 @@ class _Life:
     """One incarnation of a pod key: bind (or anchor) to reclaim."""
 
     __slots__ = ("start", "end", "committed", "claims", "queue_cause",
-                 "slices", "anchored")
+                 "slices", "anchored", "precopy_s")
 
     def __init__(self, start, committed, queue_cause=None,
                  anchored=False) -> None:
@@ -198,6 +212,9 @@ class _Life:
         self.queue_cause = queue_cause
         self.slices: set = set()
         self.anchored = anchored
+        # seconds of pre-copy streaming priced PRODUCTIVE (cutover
+        # re-anchoring; see the KIND_MIGRATION "recorded" branch)
+        self.precopy_s = 0.0
 
     def open_claim(self, state, start, cause) -> _Claim:
         claim = _Claim(state, start, cause)
@@ -413,8 +430,28 @@ def replay_goodput(
                         # the checkpoint the signal asked for: signal ..
                         # ack, attributed to the TRIGGER (maintenance,
                         # preemption, throttle), not to the handshake
+                        ck_start, ck_cause = signal.start, signal.cause
+                        cut_ts = attrs.get("cutover_ts")
+                        if (
+                            attrs.get("mode") == "precopy"
+                            and isinstance(cut_ts, (int, float))
+                        ):
+                            # pre-copy streamed WHILE training: the
+                            # window before cutover stays PRODUCTIVE
+                            # (the drain claim re-anchors at cutover);
+                            # only the residual pause→final-delta→ack
+                            # is downtime, under migration_cutover
+                            cut = min(
+                                max(float(cut_ts), signal.start), ts
+                            )
+                            life.precopy_s += cut - signal.start
+                            signal.start = cut
+                            ck_start = cut
+                            ck_cause = dict(
+                                ev, attrs={**attrs, "action": "cutover"}
+                            )
                         life.open_claim(
-                            CHECKPOINTING, signal.start, signal.cause
+                            CHECKPOINTING, ck_start, ck_cause
                         ).end = ts
                     if life.open_of(MIGRATING) is None:
                         life.open_claim(MIGRATING, ts, ev)
@@ -437,6 +474,8 @@ def replay_goodput(
                         "source_node": attrs.get("source_node"),
                         "coordinator_downtime_s": attrs.get("downtime_s"),
                         "step": attrs.get("step"),
+                        "mode": attrs.get("mode", "full"),
+                        "precopy": attrs.get("precopy"),
                     })
             elif kind == tl.KIND_SLICE_REFORMED:
                 if pod in lives:
@@ -476,6 +515,7 @@ def replay_goodput(
                 "live_start": None,
                 "slices": set(),
                 "anchored": False,
+                "precopy_s": 0.0,
             })
             for life in pod_lives:
                 intervals = _partition(life, asof)
@@ -491,6 +531,7 @@ def replay_goodput(
                     entry["live_start"] = life.start
                 entry["slices"] |= life.slices
                 entry["anchored"] = entry["anchored"] or life.anchored
+                entry["precopy_s"] += life.precopy_s
     downtime: Dict[str, float] = {}
     for pod, entry in pods_out.items():
         entry["slices"] = sorted(entry["slices"])
@@ -499,6 +540,7 @@ def replay_goodput(
         }
         lifetime = entry["lifetime_s"]
         entry["lifetime_s"] = round(lifetime, 6)
+        entry["precopy_s"] = round(entry["precopy_s"], 6)
         entry["goodput_ratio"] = (
             round(entry["states"][PRODUCTIVE] / lifetime, 6)
             if lifetime > 0 else None

@@ -106,6 +106,11 @@ class ManagerOptions:
     # period (jittered 0.75x-1.25x). --drain-deadline / --drain-period.
     drain_deadline_s: float = 300.0
     drain_period_s: float = 2.0
+    # Preemption notice window (--preemption-notice): a spot host gives
+    # this much warning before the platform reclaims it, so a
+    # preemption-triggered drain's budget (and the pre-copy cutover
+    # margin derived from it) is clamped to min(deadline, notice).
+    preemption_notice_s: float = 30.0
     # Dynamic fractional re-partitioning (repartition.py): live quota
     # renegotiation for pods that opt in via elasticgpu.io/repartition,
     # with throttle -> evict escalation for sustained overcommit.
@@ -475,6 +480,7 @@ class TPUManager:
             metrics=self.metrics,
             node_name=opts.node_name,
             deadline_s=opts.drain_deadline_s,
+            preemption_notice_s=opts.preemption_notice_s,
             period_s=opts.drain_period_s,
             timeline=self.timeline,
             lag_tracker=self.lag_tracker,

@@ -418,8 +418,9 @@ class AgentMetrics:
             "Non-productive pod-seconds attributed to each cause by the "
             "goodput ledger's journal replay (maintenance_drain, "
             "preemption, operator_drain, qos_throttle, qos_evict, "
-            "migration, slice_reform, agent_restart, bind_queue, "
-            "unattributed) — the fleet aggregator sums this per cause",
+            "migration, migration_precopy, migration_cutover, "
+            "slice_reform, agent_restart, bind_queue, unattributed) — "
+            "the fleet aggregator sums this per cause",
             ["cause"],
             **kw,
         )

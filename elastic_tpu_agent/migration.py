@@ -59,6 +59,7 @@ from . import faults
 from .common import (
     SYSTEM_CLOCK,
     AckSubdir,
+    EnvCutover,
     EnvRestoreDir,
     EnvRestoreStep,
     EnvRestoreTrace,
@@ -70,6 +71,19 @@ from .types import PodContainer
 logger = logging.getLogger(__name__)
 
 DEFAULT_PERIOD_S = 2.0
+# Pre-copy round cap: a workload whose delta never converges (every
+# step dirties everything) must still cut over well before the drain
+# deadline — the cap bounds wasted streaming, the deadline margin
+# below bounds wall time.
+DEFAULT_PRECOPY_MAX_ROUNDS = 16
+# Fraction of the drain budget reserved for the cutover itself (pause
+# + final delta + reclaim): when now crosses deadline - margin the
+# coordinator stops waiting for convergence and cuts over.
+DEFAULT_PRECOPY_CUTOVER_MARGIN_FRAC = 0.25
+# A round whose delta shrank by less than this vs the previous round
+# means pre-copy has converged — further rounds just re-ship the same
+# working set, so cut over now while the delta is small.
+PRECOPY_CONVERGED_RATIO = 0.9
 # How long a locally-bound pod's "is there a record for me?" apiserver
 # miss stays cached: a record published AFTER the replacement bound is
 # still found, without per-tick GETs for every ordinary pod.
@@ -126,6 +140,10 @@ class MigrationCoordinator:
         alloc_spec_dir: str = "",
         period_s: float = DEFAULT_PERIOD_S,
         record_recheck_s: float = DEFAULT_RECORD_RECHECK_S,
+        precopy_max_rounds: int = DEFAULT_PRECOPY_MAX_ROUNDS,
+        precopy_cutover_margin_frac: float = (
+            DEFAULT_PRECOPY_CUTOVER_MARGIN_FRAC
+        ),
         rng=None,
         timeline=None,
         clock=None,
@@ -151,6 +169,10 @@ class MigrationCoordinator:
         self._alloc_dir = alloc_spec_dir
         self.period_s = period_s
         self.record_recheck_s = record_recheck_s
+        self.precopy_max_rounds = max(1, int(precopy_max_rounds))
+        self.precopy_cutover_margin_frac = max(
+            0.0, min(0.9, float(precopy_cutover_margin_frac))
+        )
         self._rng = rng if rng is not None else random.Random()
         self._timeline = timeline
         self._clock = clock if clock is not None else SYSTEM_CLOCK
@@ -172,6 +194,14 @@ class MigrationCoordinator:
         # journaled: {"record", "stage": restamped|verified,
         # "restamp_ts"}.
         self._inbound: Dict[str, dict] = {}
+        # pod_key -> pre-copy round journal (source role), journaled:
+        # {"rounds": [{round, step, delta_bytes, total_bytes, ts,
+        # chain}], "stage": streaming|cutover, "started_ts",
+        # "cutover_ts", "cutover_reason"}. A crash mid-pre-copy resumes
+        # exactly where the journal left off — a streaming entry keeps
+        # consuming round acks, a cutover entry re-stamps the cutover
+        # signal until the final checkpoint ack lands.
+        self._precopy: Dict[str, dict] = {}
         # Destination-role record discovery is ONE apiserver LIST (all
         # Migrated-phase objects), refreshed at most once per tick and
         # only while an unresolved resident needs a snapshot FRESHER
@@ -188,6 +218,8 @@ class MigrationCoordinator:
         self._early_reclaims_total = 0
         self._records_published_total = 0
         self._completed_total = 0
+        self._precopy_rounds_total = 0
+        self._cutovers_total = 0
         self._verify_failures_total = 0
         self._completed: List[dict] = []  # bounded recent completions
         self._last_error: Optional[str] = None
@@ -222,9 +254,12 @@ class MigrationCoordinator:
             "migrated": dict(self._migrated),
             "acked": dict(self._acked),
             "inbound": {k: dict(v) for k, v in self._inbound.items()},
+            "precopy": {k: dict(v) for k, v in self._precopy.items()},
             "early_reclaims_total": self._early_reclaims_total,
             "records_published_total": self._records_published_total,
             "completed_total": self._completed_total,
+            "precopy_rounds_total": self._precopy_rounds_total,
+            "cutovers_total": self._cutovers_total,
         })
 
     def resume(self) -> None:
@@ -251,6 +286,9 @@ class MigrationCoordinator:
                 self._inbound = {
                     k: dict(v) for k, v in (st.get("inbound") or {}).items()
                 }
+                self._precopy = {
+                    k: dict(v) for k, v in (st.get("precopy") or {}).items()
+                }
                 self._early_reclaims_total = int(
                     st.get("early_reclaims_total", 0)
                 )
@@ -258,6 +296,10 @@ class MigrationCoordinator:
                     st.get("records_published_total", 0)
                 )
                 self._completed_total = int(st.get("completed_total", 0))
+                self._precopy_rounds_total = int(
+                    st.get("precopy_rounds_total", 0)
+                )
+                self._cutovers_total = int(st.get("cutovers_total", 0))
             if self._records or self._migrated or self._inbound:
                 logger.warning(
                     "migration: resumed %d record(s), %d suppressed "
@@ -353,11 +395,20 @@ class MigrationCoordinator:
                 continue
             acks[pod_key] = ack
             with self._lock:
-                fresh = ts > self._acked.get(pod_key, 0.0)
-                self._acked[pod_key] = max(
-                    ts, self._acked.get(pod_key, 0.0)
-                )
-                self._last_acks[pod_key] = ack
+                if ack.get("kind") == "precopy":
+                    # A pre-copy ROUND is streaming progress, not a
+                    # restorable cutover point: it must never feed
+                    # _acked, or the early-reclaim pass and the drain's
+                    # outcome classifier would treat a still-training
+                    # workload as checkpoint-complete.
+                    self._last_acks[pod_key] = ack
+                    fresh = False
+                else:
+                    fresh = ts > self._acked.get(pod_key, 0.0)
+                    self._acked[pod_key] = max(
+                        ts, self._acked.get(pod_key, 0.0)
+                    )
+                    self._last_acks[pod_key] = ack
                 while len(self._acked) > MAX_RETAINED_ACKS:
                     oldest = min(self._acked, key=self._acked.get)
                     self._acked.pop(oldest, None)
@@ -387,7 +438,7 @@ class MigrationCoordinator:
         env = self._spec_env(res["hashes"])
         pod = self._sitter.get_pod(res["namespace"], res["name"])
         uid = str(((pod or {}).get("metadata") or {}).get("uid", ""))
-        return {
+        record = {
             "name": migration_object_name(res["namespace"], res["name"]),
             "pod": pod_key,
             "uid": uid,
@@ -406,6 +457,25 @@ class MigrationCoordinator:
             "published": False,
             "reclaimed": False,
         }
+        with self._lock:
+            pc = self._precopy.get(pod_key)
+        if pc is not None:
+            # The cutover ack closed a pre-copy stream: the record
+            # carries the chain contract (digest = the delta chain the
+            # destination must reassemble and verify) plus the round
+            # stats the bench and the goodput ledger price with.
+            record["mode"] = "precopy"
+            record["precopy"] = {
+                "rounds": len(pc.get("rounds") or []),
+                "started_ts": pc.get("started_ts"),
+                "cutover_ts": pc.get("cutover_ts"),
+                "cutover_reason": pc.get("cutover_reason"),
+                "final_delta_bytes": ack.get("delta_bytes"),
+                "full_bytes": ack.get("full_bytes")
+                or ack.get("total_bytes"),
+                "cutover_ms": ack.get("cutover_ms"),
+            }
+        return record
 
     def _record_manifest(self, record: dict):
         from .crd import ElasticTPU, PhaseMigrated
@@ -432,8 +502,9 @@ class MigrationCoordinator:
                 k: record[k] for k in (
                     "pod", "uid", "source_node", "reason", "step",
                     "checkpoint_dir", "digest", "ack_kind", "ack_ts",
-                    "trace", "topology_env", "recorded_ts",
-                )
+                    "trace", "topology_env", "recorded_ts", "mode",
+                    "precopy",
+                ) if k in record
             },
         )
 
@@ -547,6 +618,8 @@ class MigrationCoordinator:
                 checkpoint_dir=record["checkpoint_dir"],
                 digest=record["digest"],
                 reason=record["reason"],
+                mode=record.get("mode", "full"),
+                cutover_ts=(record.get("precopy") or {}).get("cutover_ts"),
             )
         if self._events is not None:
             from .kube.events import ReasonMigrationRecorded
@@ -562,6 +635,197 @@ class MigrationCoordinator:
                 )
             except Exception:  # noqa: BLE001 - observability only
                 pass
+
+    # -- pipelined pre-copy (source role) --------------------------------------
+
+    def _cutover_reason(self, pc: dict, now: float) -> Optional[str]:
+        """Why this pre-copy stream should cut over NOW, or None to
+        keep streaming: the round cap, delta convergence (the delta
+        stopped shrinking — more rounds just re-ship the working set),
+        or deadline pressure (the reserved cutover margin of the drain
+        budget has arrived; Funky's pre-copy semantics — bounded
+        rounds, guaranteed cutover before the host goes away)."""
+        rounds = pc.get("rounds") or []
+        if len(rounds) >= self.precopy_max_rounds:
+            return "rounds"
+        if len(rounds) >= 3:
+            # round 0 ships the full baseline; convergence is judged on
+            # delta-vs-delta only, so at least two true delta rounds.
+            try:
+                last = float(rounds[-1].get("delta_bytes") or 0.0)
+                prev = float(rounds[-2].get("delta_bytes") or 0.0)
+            except (TypeError, ValueError):
+                last = prev = 0.0
+            if prev > 0.0 and last >= PRECOPY_CONVERGED_RATIO * prev:
+                return "converged"
+        drain = self._drain
+        deadline_ts = getattr(drain, "deadline_ts", None)
+        if deadline_ts:
+            started = drain.started_ts()
+            budget = max(0.0, deadline_ts - (
+                started if started is not None else now
+            ))
+            margin = self.precopy_cutover_margin_frac * budget
+            if now >= deadline_ts - margin:
+                return "deadline"
+        return None
+
+    def _stamp_cutover(self, pod_key: str, res: dict, pc: dict) -> bool:
+        """Restamp ``ELASTIC_TPU_CUTOVER`` into the pod's alloc specs —
+        the signal that ends streaming: pause, final delta, ack. The
+        token encodes reason+round so a fresh drain (new pre-copy
+        stream) produces a NEW edge on the workload side. Re-asserted
+        every tick until the final ack lands, like every other stamp."""
+        from .plugins import restamp_owner_env
+
+        plugin = self._spec_plugin()
+        if plugin is None:
+            return False
+        token = (
+            f"{pc.get('cutover_reason', 'cutover')}:"
+            f"{len(pc.get('rounds') or [])}:"
+            f"{pc.get('cutover_ts') or 0:.3f}"
+        )
+        ok = False
+        for container, records in res["containers"].items():
+            owner = PodContainer(res["namespace"], res["name"], container)
+            try:
+                if restamp_owner_env(
+                    plugin, owner, records, {EnvCutover: token}
+                ):
+                    ok = True
+            except Exception:  # noqa: BLE001 - retried next tick
+                logger.exception(
+                    "migration: cutover stamp for %s failed", pod_key
+                )
+        return ok
+
+    def _precopy_pass(self, residents, acks: Dict[str, dict]) -> None:
+        """Drive pipelined pre-copy while the node is DRAINING: journal
+        every round ack a workload streams (training CONTINUES under
+        it), decide cutover (convergence / round cap / deadline
+        margin), then stamp the cutover signal until the final
+        checkpoint ack arrives and the normal early-reclaim pass takes
+        over. A workload that never acks pre-copy is simply never in
+        this map — the full-checkpoint handshake runs unchanged."""
+        from .drain import DRAINING
+
+        drain = self._drain
+        if drain is None or drain.state != DRAINING:
+            with self._lock:
+                if self._precopy:
+                    # a cancelled/finished drain invalidates in-flight
+                    # streams; the next drain starts a fresh chain
+                    self._precopy.clear()
+                    self._journal_locked()
+            return
+        started = drain.started_ts()
+        now = self._clock.time()
+        by_key = dict(residents)
+        for pod_key, ack in acks.items():
+            if ack.get("kind") != "precopy":
+                continue
+            res = by_key.get(pod_key)
+            if res is None:
+                continue
+            try:
+                ts = float(ack.get("ts", 0.0))
+            except (TypeError, ValueError):
+                continue
+            if started is not None and ts < started:
+                continue  # a stale stream from a previous drain
+            try:
+                round_ = int(ack.get("round", 0))
+            except (TypeError, ValueError):
+                round_ = 0
+            with self._lock:
+                pc = self._precopy.get(pod_key)
+                pc = dict(pc) if pc is not None else {
+                    "rounds": [],
+                    "stage": "streaming",
+                    "started_ts": ts,
+                    "trigger": drain.trigger,
+                    "cutover_ts": None,
+                    "cutover_reason": None,
+                }
+            if pc["stage"] != "cutover" and round_ not in {
+                r.get("round") for r in pc["rounds"]
+            }:
+                faults.fire("migration.pre_copy_round")
+                pc["rounds"] = (pc["rounds"] + [{
+                    "round": round_,
+                    "step": ack.get("step"),
+                    "delta_bytes": ack.get("delta_bytes"),
+                    "total_bytes": ack.get("total_bytes"),
+                    "chain": ack.get("digest", ""),
+                    "ts": ts,
+                }])[-64:]
+                with self._lock:
+                    self._precopy[pod_key] = pc
+                    self._precopy_rounds_total += 1
+                    self._journal_locked()  # round durable BEFORE effects
+                faults.fire("migration.pre_copy_journal")
+                if self._timeline is not None:
+                    from .timeline import KIND_MIGRATION
+
+                    self._timeline.emit(
+                        KIND_MIGRATION,
+                        keys={"pod": pod_key},
+                        action="precopy_round",
+                        round=round_,
+                        step=ack.get("step"),
+                        delta_bytes=ack.get("delta_bytes"),
+                        total_bytes=ack.get("total_bytes"),
+                    )
+                logger.warning(
+                    "migration: %s pre-copy round %d durable (step %s, "
+                    "%s delta bytes); training continues",
+                    pod_key, round_, ack.get("step"),
+                    ack.get("delta_bytes"),
+                )
+            if pc["stage"] != "cutover":
+                reason = self._cutover_reason(pc, now)
+                if reason is not None:
+                    pc["stage"] = "cutover"
+                    pc["cutover_ts"] = now
+                    pc["cutover_reason"] = reason
+                    with self._lock:
+                        self._precopy[pod_key] = pc
+                        self._cutovers_total += 1
+                        self._journal_locked()  # BEFORE the stamp effect
+                    faults.fire("migration.pre_copy_cutover")
+                    if self._timeline is not None:
+                        from .timeline import KIND_MIGRATION
+
+                        self._timeline.emit(
+                            KIND_MIGRATION,
+                            keys={"pod": pod_key},
+                            action="cutover_signaled",
+                            reason=reason,
+                            rounds=len(pc["rounds"]),
+                            deadline_ts=drain.deadline_ts,
+                        )
+                    logger.warning(
+                        "migration: %s pre-copy cutover (%s) after %d "
+                        "round(s); pause + final delta requested",
+                        pod_key, reason, len(pc["rounds"]),
+                    )
+        # Re-assert the cutover stamp for every stream already in the
+        # cutover stage — idempotent, survives drift rebinds AND the
+        # crash window between the cutover journal and the first stamp.
+        with self._lock:
+            cutting = [
+                k for k, v in self._precopy.items()
+                if v.get("stage") == "cutover"
+            ]
+        for pod_key in cutting:
+            res = by_key.get(pod_key)
+            if res is None:
+                continue
+            with self._lock:
+                pc = self._precopy.get(pod_key)
+            if pc is not None:
+                self._stamp_cutover(pod_key, res, pc)
 
     # -- early drain completion (source role) ---------------------------------
 
@@ -579,6 +843,8 @@ class MigrationCoordinator:
         trigger = drain.trigger
         by_key = dict(residents)
         for pod_key, ack in acks.items():
+            if ack.get("kind") == "precopy":
+                continue  # still streaming: reclaim only on the final ack
             res = by_key.get(pod_key)
             if res is None:
                 continue
@@ -607,6 +873,9 @@ class MigrationCoordinator:
                 with self._lock:
                     self._records[pod_key] = record
                     self._migrated[pod_key] = record["uid"]
+                    # the record absorbed the pre-copy stats; the live
+                    # stream entry's job is done
+                    self._precopy.pop(pod_key, None)
                     self._early_reclaims_total += 1
                     self._journal_locked()  # BEFORE the reclaim side effect
                 faults.fire("migration.post_record")
@@ -742,6 +1011,7 @@ class MigrationCoordinator:
                     action="restore_stamped",
                     step=record.get("step"),
                     source_node=record.get("source_node"),
+                    mode=record.get("mode", "full"),
                 )
             logger.warning(
                 "migration: %s has a published record (step %s from "
@@ -811,6 +1081,36 @@ class MigrationCoordinator:
                 f"resumed at world size {got_world}, current slice "
                 f"world is {expected_world}"
             )
+        if record.get("mode") == "precopy":
+            # A pre-copy record's digest IS the delta chain contract:
+            # before the record may be deleted, the destination proves
+            # it reassembled exactly the blocks the source shipped —
+            # every manifest block present, every block's content
+            # digest intact, and the chain over them equal to what the
+            # source acked at cutover. A torn final delta fails here
+            # and the record (the durable copy) stays for the retry.
+            want_chain = str(record.get("digest") or "")
+            try:
+                from .workloads.checkpointing import DeltaCheckpointer
+
+                report = DeltaCheckpointer(
+                    str(record.get("checkpoint_dir") or "")
+                ).verify()
+            except Exception as e:  # noqa: BLE001 - storage blip
+                report = {
+                    "ok": False, "chain": "",
+                    "problems": [f"chain verify unreadable: {e}"],
+                }
+            if not report.get("ok"):
+                problems.append(
+                    "delta chain verification failed: "
+                    + "; ".join(report.get("problems") or ["unknown"])
+                )
+            elif want_chain and report.get("chain") != want_chain:
+                problems.append(
+                    f"delta chain {report.get('chain')} != recorded "
+                    f"{want_chain}"
+                )
         if problems:
             # One failing ack is ONE incident: the same unchanged ack is
             # re-read every tick, and without this dedup the failure
@@ -843,6 +1143,8 @@ class MigrationCoordinator:
             "world_size": expected_world,
             "source_node": record.get("source_node"),
             "trace": record.get("trace", ""),
+            "mode": record.get("mode", "full"),
+            "precopy": record.get("precopy"),
             "verified_ts": self._clock.time(),
             "downtime_s": (
                 round(self._clock.time() - float(record["ack_ts"]), 3)
@@ -872,6 +1174,8 @@ class MigrationCoordinator:
                 world_size=expected_world,
                 source_node=record.get("source_node"),
                 downtime_s=completion["downtime_s"],
+                mode=record.get("mode", "full"),
+                precopy=record.get("precopy"),
             )
         if self._events is not None:
             from .kube.events import ReasonMigrationCompleted
@@ -981,6 +1285,11 @@ class MigrationCoordinator:
             for k in inbound_stale:
                 self._inbound.pop(k, None)
                 dropped = True
+            for k in [
+                k for k in self._precopy if k not in resident_keys
+            ]:
+                self._precopy.pop(k, None)
+                dropped = True
             if dropped:
                 self._journal_locked()
         m = self._metrics
@@ -999,6 +1308,7 @@ class MigrationCoordinator:
         if residents is None:
             return  # storage unanswerable: retry next tick
         acks = self._consume_acks(residents)
+        self._precopy_pass(residents, acks)
         self._drain_early_pass(residents, acks)
         self._publish_pending()
         self._inbound_pass(residents)
@@ -1019,7 +1329,7 @@ class MigrationCoordinator:
                 # in-flight work keeps the base cadence.
                 with self._lock:
                     quiet = (not self._records and not self._acked
-                             and not self._inbound)
+                             and not self._inbound and not self._precopy)
                 if quiet:
                     delay *= self.event_safety_net_factor
             if sub is None:
@@ -1091,11 +1401,25 @@ class MigrationCoordinator:
                     }
                     for k, v in sorted(self._inbound.items())
                 },
+                "precopy": {
+                    k: {
+                        "stage": v.get("stage"),
+                        "rounds": len(v.get("rounds") or []),
+                        "last_delta_bytes": (
+                            (v.get("rounds") or [{}])[-1].get("delta_bytes")
+                        ),
+                        "cutover_ts": v.get("cutover_ts"),
+                        "cutover_reason": v.get("cutover_reason"),
+                    }
+                    for k, v in sorted(self._precopy.items())
+                },
                 "suppressed_pods": sorted(self._migrated),
                 "recent_completions": list(self._completed),
                 "early_reclaims_total": self._early_reclaims_total,
                 "records_published_total": self._records_published_total,
                 "completed_total": self._completed_total,
+                "precopy_rounds_total": self._precopy_rounds_total,
+                "cutovers_total": self._cutovers_total,
                 "verify_failures_total": self._verify_failures_total,
                 "last_error": self._last_error,
             }

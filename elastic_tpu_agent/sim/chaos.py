@@ -161,6 +161,7 @@ class ChaosProgram:
         intensity: float = 1.0,
         include_drain: bool = True,
         include_throttle: bool = False,
+        include_preemption: bool = False,
     ) -> "ChaosProgram":
         """Draw a schedule of overlapping fault windows from one seeded
         stream. Windows are long relative to the scenario (30-60%), so
@@ -201,8 +202,25 @@ class ChaosProgram:
         })
         if include_drain and nodes >= 2:
             t, d = window(0.25, 0.45)
+            drain_node = rng.randrange(1, nodes)
             acts.append({
                 "kind": "maintenance_drain", "t": t, "duration_s": d,
+                "node": drain_node,
+            })
+            if include_preemption:
+                # the migration killer compound: the host backing the
+                # DRAINING node rings a spot-preemption notice mid-
+                # window, so the pre-copy/cutover budget clamps to the
+                # shorter horizon while its streams are handing off
+                acts.append({
+                    "kind": "preemption",
+                    "t": round(t + d * rng.uniform(0.25, 0.5), 6),
+                    "node": drain_node,
+                })
+        elif include_preemption and nodes >= 2:
+            t, _ = window(0.25, 0.45)
+            acts.append({
+                "kind": "preemption", "t": t,
                 "node": rng.randrange(1, nodes),
             })
         if include_throttle:
@@ -847,6 +865,27 @@ class ChaosMatrix:
                 },
                 "program": {
                     "duration_s": 3.0, "include_drain": True,
+                },
+            },
+            {
+                # A spot-preemption notice rings on the node ALREADY
+                # mid-migration (draining, streams handing off) while a
+                # flash crowd runs — the live-migration acceptance
+                # scenario: zero client-visible drops/resets, every
+                # handoff adopted, and goodput/SLO floors hold.
+                "name": "preemption-during-migration",
+                "trace": {
+                    "duration_s": 3.0, "base_rps": 20.0,
+                    "flash_crowds": 1, "hostile_fraction": 0.3,
+                    "train_pods": 2,
+                },
+                "program": {
+                    "duration_s": 3.0, "include_drain": True,
+                    "include_preemption": True,
+                },
+                "bounds": {
+                    "min_goodput_percent": 25.0,
+                    "min_slo_attainment": 0.5,
                 },
             },
         ]

@@ -128,6 +128,11 @@ class SimWorkload:
         tick_s: float = 0.02,
         resume_wait_s: float = 0.0,
         exit_on_drain: bool = True,
+        precopy: bool = False,
+        state_bytes: int = 0,
+        dirty_fraction: float = 0.05,
+        precopy_interval_ticks: int = 2,
+        ship_bps: float = 0.0,
     ) -> None:
         from ..workloads.lifecycle import LifecycleWatcher
 
@@ -135,10 +140,38 @@ class SimWorkload:
         self.tick_s = tick_s
         self.resume_wait_s = resume_wait_s
         self.exit_on_drain = exit_on_drain
+        # Pre-copy mode (ISSUE 20): the workload carries a synthetic
+        # mutable parameter blob; on a drain it STREAMS delta rounds
+        # through a DeltaCheckpointer while training continues, then
+        # pauses only for the final delta at the coordinator's cutover
+        # signal. ship_bps simulates shared-storage bandwidth — the
+        # sleep per shipped byte is what makes the full-vs-delta
+        # downtime difference measurable; pipelined ships keep ticking
+        # steps under the sleep, paused ships are pure downtime.
+        self.precopy = precopy
+        self.dirty_fraction = max(0.0, min(1.0, dirty_fraction))
+        self.precopy_interval_ticks = max(1, int(precopy_interval_ticks))
+        self.ship_bps = float(ship_bps)
+        if precopy and state_bytes <= 0:
+            state_bytes = 1 << 20
+        self._state = bytearray(state_bytes)
+        self._delta = None
+        if state_bytes > 0:
+            from ..workloads.checkpointing import DeltaCheckpointer
+
+            self._delta = DeltaCheckpointer(ckpt_dir, block_size=4096)
         self.step = 0
         self.saved_step: Optional[int] = None
         self.resumed_step: Optional[int] = None
         self.last_signal = None
+        # Measured by whichever checkpoint path ran on the drain: how
+        # long training was PAUSED shipping state (the downtime the
+        # bench compares full-checkpoint vs pre-copy cutover on).
+        self.pause_ms: Optional[float] = None
+        self.precopy_rounds = 0
+        self.final_delta_bytes: Optional[int] = None
+        self.full_bytes: Optional[int] = None
+        self.final_chain: str = ""
         self.exited = threading.Event()
         self.watcher = LifecycleWatcher(
             alloc_spec_dir, alloc_hash, poll_interval_s=0.0
@@ -165,6 +198,95 @@ class SimWorkload:
             _json.dump({"step": self.step}, f)
         self.saved_step = self.step
 
+    def _mutate(self) -> None:
+        """Dirty a deterministic, step-dependent subset of state blocks
+        — the working set a pre-copy round has to re-ship."""
+        if not self._state:
+            return
+        bs = 4096
+        n_blocks = max(1, len(self._state) // bs)
+        dirty = max(1, int(n_blocks * self.dirty_fraction))
+        stamp = (self.step & 0xFFFFFFFFFFFFFFFF).to_bytes(8, "little")
+        for i in range(dirty):
+            off = ((self.step * 31 + i * 7) % n_blocks) * bs
+            self._state[off:off + 8] = stamp
+
+    def _ship(self, n_bytes, pause: bool) -> None:
+        """Model shipping ``n_bytes`` to shared storage at ship_bps.
+        ``pause=True`` stops training for the duration (downtime);
+        ``pause=False`` pipelines — steps keep ticking under the
+        transfer, which is the whole point of pre-copy."""
+        if self.ship_bps <= 0.0 or not n_bytes:
+            return
+        end = time.monotonic() + float(n_bytes) / self.ship_bps
+        while not self._stop.is_set():
+            left = end - time.monotonic()
+            if left <= 0:
+                return
+            if pause:
+                time.sleep(min(0.005, left))
+            else:
+                self.step += 1
+                self._mutate()
+                self._stop.wait(min(self.tick_s, left))
+
+    def _precopy_drain(self, sig) -> None:
+        """The pre-copy half of the lifecycle contract: stream delta
+        rounds (kind="precopy" acks) while training continues, pause at
+        the coordinator's cutover signal, ship ONLY the final delta,
+        then write the ordinary cutover ack the early-reclaim pass
+        completes the drain on."""
+        from ..workloads.lifecycle import SIGNAL_CUTOVER
+
+        os.makedirs(self.ckpt_dir, exist_ok=True)
+        round_ = 0
+        cut = None
+        while not self._stop.is_set() and cut is None:
+            summary = self._delta.save(
+                self.step, bytes(self._state), round_=round_
+            )
+            self._ship(summary["delta_bytes"], pause=False)
+            self.watcher.ack_precopy(
+                summary["step"], round_, checkpoint_dir=self.ckpt_dir,
+                delta_bytes=summary["delta_bytes"],
+                total_bytes=summary["total_bytes"],
+                digest=summary["chain"], signal=sig.value,
+            )
+            self.precopy_rounds = round_ + 1
+            round_ += 1
+            for _ in range(self.precopy_interval_ticks):
+                if self._stop.is_set():
+                    break
+                self.step += 1
+                self._mutate()
+                got = self.watcher.poll(force=True)
+                if got is not None and got.kind == SIGNAL_CUTOVER:
+                    cut = got
+                    break
+                self._stop.wait(self.tick_s)
+        # Cutover: training PAUSES here; everything below is downtime.
+        t0 = time.monotonic()
+        summary = self._delta.save(
+            self.step, bytes(self._state), round_=round_
+        )
+        self._ship(summary["delta_bytes"], pause=True)
+        self._save()
+        self.pause_ms = (time.monotonic() - t0) * 1000.0
+        self.precopy_rounds = round_ + 1
+        self.final_delta_bytes = summary["delta_bytes"]
+        self.full_bytes = summary["total_bytes"]
+        self.final_chain = summary["chain"]
+        self.watcher.ack(
+            self.step, checkpoint_dir=self.ckpt_dir,
+            signal=sig.value, epoch=sig.epoch, digest=summary["chain"],
+            extra={
+                "precopy_rounds": round_,
+                "delta_bytes": summary["delta_bytes"],
+                "full_bytes": summary["total_bytes"],
+                "cutover_ms": round(self.pause_ms, 3),
+            },
+        )
+
     def _maybe_resume(self) -> None:
         import json as _json
 
@@ -172,13 +294,31 @@ class SimWorkload:
         while not self._stop.is_set():
             req = self.watcher.restore_request()
             if req:
-                try:
-                    with open(os.path.join(
-                        req["checkpoint_dir"], "state.json"
-                    )) as f:
-                        self.step = int(_json.load(f)["step"])
-                except (OSError, ValueError, KeyError, TypeError):
-                    self.step = int(req.get("step") or 0)
+                step = None
+                if self._delta is not None:
+                    # a pre-copy source left a delta chain: reassemble
+                    # (and implicitly verify digests) before trusting it
+                    try:
+                        from ..workloads.checkpointing import (
+                            DeltaCheckpointer,
+                        )
+
+                        payload, manifest = DeltaCheckpointer(
+                            req["checkpoint_dir"], block_size=4096
+                        ).load()
+                        self._state = bytearray(payload)
+                        step = int(manifest["step"])
+                    except (ValueError, OSError, KeyError, TypeError):
+                        step = None
+                if step is None:
+                    try:
+                        with open(os.path.join(
+                            req["checkpoint_dir"], "state.json"
+                        )) as f:
+                            step = int(_json.load(f)["step"])
+                    except (OSError, ValueError, KeyError, TypeError):
+                        step = int(req.get("step") or 0)
+                self.step = step
                 self.resumed_step = self.step
                 self.watcher.ack_resume(
                     self.step, checkpoint_dir=req["checkpoint_dir"]
@@ -197,10 +337,30 @@ class SimWorkload:
         self._maybe_resume()
         while not self._stop.is_set():
             self.step += 1
+            if self._state:
+                self._mutate()
             sig = self.watcher.poll(force=True)
             if sig is not None:
                 self.last_signal = sig
+                if self.precopy and sig.kind == SIGNAL_DRAIN:
+                    self._precopy_drain(sig)
+                    if self.exit_on_drain:
+                        break
+                    self._stop.wait(self.tick_s)
+                    continue
+                t0 = time.monotonic()
                 self._save()
+                if self._state and self._delta is not None:
+                    # full-checkpoint baseline: the WHOLE state ships
+                    # inside the pause window
+                    summary = self._delta.save(
+                        self.step, bytes(self._state), round_=0
+                    )
+                    self._ship(summary["total_bytes"], pause=True)
+                    self.full_bytes = summary["total_bytes"]
+                    self.final_chain = summary["chain"]
+                if sig.kind == SIGNAL_DRAIN:
+                    self.pause_ms = (time.monotonic() - t0) * 1000.0
                 self.watcher.ack(
                     self.step, checkpoint_dir=self.ckpt_dir,
                     signal=sig.value, epoch=sig.epoch,
@@ -230,6 +390,7 @@ class FleetSim:
         slice_membership_ttl_s: float = 1.0,
         operator_kinds: Optional[List[str]] = None,
         drain_deadline_s: float = 5.0,
+        preemption_notice_s: Optional[float] = None,
         drain_period_s: float = 0.5,
         migration_period_s: float = 0.25,
         timeline_cap: Optional[int] = None,
@@ -261,6 +422,10 @@ class FleetSim:
         # Drain lifecycle pacing: sim deadlines are seconds, not the
         # production 300s — chaos scenarios assert reclaim-on-deadline.
         self.drain_deadline_s = drain_deadline_s
+        # Preemption-notice clamp (drain.py): None = the production
+        # default; sim deadlines are already shorter than the default
+        # notice, so only clamp-specific scenarios set this.
+        self.preemption_notice_s = preemption_notice_s
         self.drain_period_s = drain_period_s
         # Migration-coordinator tick (migration.py): sim scenarios
         # assert ack-to-early-reclaim latency in fractions of the
@@ -367,6 +532,10 @@ class FleetSim:
                 slice_membership_ttl_s=self.slice_membership_ttl_s,
                 drain_deadline_s=self.drain_deadline_s,
                 drain_period_s=self.drain_period_s,
+                **(
+                    {"preemption_notice_s": self.preemption_notice_s}
+                    if self.preemption_notice_s is not None else {}
+                ),
                 migration_period_s=self.migration_period_s,
                 storage_batch_window_s=self.storage_batch_window_s,
                 sink_flush_window_s=self.sink_flush_window_s,
@@ -602,6 +771,11 @@ class FleetSim:
         tick_s: float = 0.02,
         resume_wait_s: float = 0.0,
         exit_on_drain: bool = True,
+        precopy: bool = False,
+        state_bytes: int = 0,
+        dirty_fraction: float = 0.05,
+        precopy_interval_ticks: int = 2,
+        ship_bps: float = 0.0,
     ) -> SimWorkload:
         """Run a stub workload (REAL LifecycleWatcher) inside ``ref``'s
         binding; the pod must be bound first (the hash comes from its
@@ -612,7 +786,10 @@ class FleetSim:
         return SimWorkload(
             self.nodes[ref.node_idx].opts.alloc_spec_dir, alloc_hash,
             ckpt_dir, tick_s=tick_s, resume_wait_s=resume_wait_s,
-            exit_on_drain=exit_on_drain,
+            exit_on_drain=exit_on_drain, precopy=precopy,
+            state_bytes=state_bytes, dirty_fraction=dirty_fraction,
+            precopy_interval_ticks=precopy_interval_ticks,
+            ship_bps=ship_bps,
         ).start()
 
     def migration_status(self, idx: int) -> Dict:

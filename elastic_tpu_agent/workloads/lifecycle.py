@@ -57,6 +57,7 @@ DEFAULT_POLL_INTERVAL_S = 1.0
 
 # Signal kinds, in escalation order.
 SIGNAL_DRAIN = "drain"        # ELASTIC_TPU_DRAIN appeared/changed
+SIGNAL_CUTOVER = "cutover"    # ELASTIC_TPU_CUTOVER stamped (pre-copy end)
 SIGNAL_THROTTLE = "throttle"  # ELASTIC_TPU_THROTTLE deadline armed
 SIGNAL_REFORM = "reform"      # ELASTIC_TPU_SLICE_EPOCH bumped
 
@@ -138,6 +139,7 @@ def write_checkpoint_ack(
     epoch: Optional[int] = None,
     digest: Optional[str] = None,
     ts: Optional[float] = None,
+    extra: Optional[Dict] = None,
 ) -> bool:
     """Publish the workload's checkpoint acknowledgement to the agent.
 
@@ -148,13 +150,24 @@ def write_checkpoint_ack(
     usage-report pattern — one writer per hash, crash debris reclaimed
     by the next write and the spec GC), never raises. Returns True when
     the ack landed.
+
+    ``extra`` merges additional JSON-safe fields into the payload
+    without shadowing the contract keys — the pre-copy protocol rides
+    here (``round``/``delta_bytes``/``total_bytes`` on ``kind="precopy"``
+    acks, ``precopy_rounds``/``full_bytes``/``cutover_ms`` on the final
+    cutover ack).
     """
     from ..common import AckSubdir
 
     ack_dir = os.path.join(alloc_spec_dir, AckSubdir)
     path = os.path.join(ack_dir, f"{alloc_hash}.json")
     tmp = f"{path}.tmp"
-    payload = {
+    payload = {}
+    if extra:
+        payload.update({
+            k: v for k, v in extra.items() if isinstance(k, str)
+        })
+    payload.update({
         "ts": time.time() if ts is None else ts,
         "kind": kind,
         "step": step,
@@ -164,7 +177,7 @@ def write_checkpoint_ack(
             else (checkpoint_digest(checkpoint_dir) if checkpoint_dir
                   else "")
         ),
-    }
+    })
     if signal:
         payload["signal"] = signal
     if world_size is not None:
@@ -249,6 +262,7 @@ class LifecycleWatcher:
         self._next_poll = 0.0
         self._seen_drain: Optional[str] = None
         self._drain_active = False  # env carries a drain stamp NOW
+        self._seen_cutover: Optional[str] = None
         self._seen_throttle: Optional[str] = None
         self._seen_epoch: Optional[int] = None
         self._epoch_armed = False  # first sighting sets the baseline
@@ -318,6 +332,7 @@ class LifecycleWatcher:
 
     def _detect(self, env: Dict[str, str]) -> Optional[Signal]:
         from ..common import (
+            EnvCutover,
             EnvDrain,
             EnvDrainDeadline,
             EnvSliceEpoch,
@@ -335,6 +350,18 @@ class LifecycleWatcher:
             )
         if not drain:
             self._seen_drain = None  # cancelled drain re-arms the edge
+        # Cutover outranks everything below: it arrives only mid-drain
+        # (the drain edge already fired) and ends the pre-copy stream —
+        # the workload must pause, ship the final delta and ack NOW.
+        cutover = env.get(EnvCutover)
+        if cutover and cutover != self._seen_cutover:
+            self._seen_cutover = cutover
+            return Signal(
+                SIGNAL_CUTOVER, value=cutover,
+                deadline_ts=_env_float(env, EnvDrainDeadline), env=env,
+            )
+        if not cutover:
+            self._seen_cutover = None  # cancelled drain re-arms the edge
         throttle = env.get(EnvThrottle)
         if throttle and throttle != self._seen_throttle:
             self._seen_throttle = throttle
@@ -414,6 +441,8 @@ class LifecycleWatcher:
         world_size: Optional[int] = None,
         epoch: Optional[int] = None,
         ts: Optional[float] = None,
+        digest: Optional[str] = None,
+        extra: Optional[Dict] = None,
     ) -> bool:
         """Write this pod's ack file (see :func:`write_checkpoint_ack`);
         ``world_size`` defaults from the CURRENT stamped env."""
@@ -424,11 +453,40 @@ class LifecycleWatcher:
         ok = write_checkpoint_ack(
             self.alloc_spec_dir, self.alloc_hash, step,
             checkpoint_dir=checkpoint_dir, kind=kind, signal=signal,
-            world_size=world_size, epoch=epoch, ts=ts,
+            world_size=world_size, epoch=epoch, ts=ts, digest=digest,
+            extra=extra,
         )
         if ok:
             self.acks_written += 1
         return ok
+
+    def ack_precopy(
+        self,
+        step: Optional[int],
+        round_: int,
+        checkpoint_dir: str = "",
+        delta_bytes: Optional[int] = None,
+        total_bytes: Optional[int] = None,
+        digest: Optional[str] = None,
+        signal: str = "",
+        ts: Optional[float] = None,
+    ) -> bool:
+        """One pre-copy ROUND acknowledgement: the delta for ``round_``
+        is durable on shared storage but the workload is STILL TRAINING
+        — the coordinator must not reclaim on it (only journal progress
+        and decide when to cut over). ``digest`` is the round's chain
+        digest from :class:`~.checkpointing.DeltaCheckpointer`."""
+        return self.ack(
+            step, checkpoint_dir=checkpoint_dir, kind="precopy",
+            signal=signal, ts=ts, digest=digest or "",
+            extra={
+                "round": int(round_),
+                **({"delta_bytes": int(delta_bytes)}
+                   if delta_bytes is not None else {}),
+                **({"total_bytes": int(total_bytes)}
+                   if total_bytes is not None else {}),
+            },
+        )
 
     def ack_resume(
         self, step: Optional[int], checkpoint_dir: str = "",
@@ -447,6 +505,7 @@ def drain_serving(
     watcher: Optional[LifecycleWatcher] = None,
     signal: Optional[Signal] = None,
     max_steps: int = 100_000,
+    handoff: bool = False,
 ) -> dict:
     """Drain a ServingEngine's in-flight requests (the serving
     workload's answer to a drain signal: there is no optimizer state to
@@ -456,9 +515,32 @@ def drain_serving(
     (each step advances every live decode and pumps one pending-prefill
     chunk), then writes a ``kind="drained"`` ack through ``watcher``.
     Returns a summary; never raises past the step loop's own errors.
+
+    ``handoff=True`` (shared-pool engines only) is the live-migration
+    drain: instead of decoding every open stream to completion inside
+    the drain window, each one is PUBLISHED through the pool's
+    mid-stream registry (``engine.publish_stream``) for a destination
+    engine to adopt and continue — pending prefills are pumped to
+    activation first so nothing is cancelled. The ack's ``extra``
+    carries ``handoff_streams`` so the coordinator can reconcile
+    published == adopted.
     """
     drained_tokens = 0
     steps = 0
+    published = 0
+    if handoff and getattr(engine, "shared_pool", None) is not None:
+        while steps < max_steps and (
+            engine.stats()["pending_prefills"]
+        ):
+            out = engine.step()
+            drained_tokens += sum(
+                len(v) if isinstance(v, list) else 1
+                for v in out.values()
+            )
+            steps += 1
+        for rid in list(engine._slot_of):
+            engine.publish_stream(rid)
+            published += 1
     while steps < max_steps:
         stats = engine.stats()
         if not stats["live_requests"] and not stats["pending_prefills"]:
@@ -472,10 +554,14 @@ def drain_serving(
         "steps": steps,
         "drained_tokens": drained_tokens,
         "live_requests": engine.stats()["live_requests"],
+        "handoff_streams": published,
     }
     if watcher is not None and watcher.enabled:
         watcher.ack(
             None, kind="drained",
             signal=signal.value if signal is not None else "",
+            extra=(
+                {"handoff_streams": published} if published else None
+            ),
         )
     return summary

@@ -127,6 +127,15 @@ def main(argv=None) -> int:
     )
     parser.add_argument("--checkpoint-every", type=int, default=10)
     parser.add_argument(
+        "--precopy-every", type=int, default=5,
+        help="pre-copy migration (workloads/checkpointing.py "
+             "DeltaCheckpointer): on a drain signal, stream a delta "
+             "snapshot every N steps WHILE TRAINING CONTINUES and "
+             "pause only for the final delta at the coordinator's "
+             "cutover signal; 0 = classic checkpoint-and-exit on the "
+             "drain signal",
+    )
+    parser.add_argument(
         "--profile-dir", default="",
         help="capture a JAX/XLA profiler trace of the timed steps "
              "(open with tensorboard or xprof)",
@@ -434,7 +443,12 @@ def main(argv=None) -> int:
     # instead of at the deadline. A replacement pod finds the
     # destination agent's ELASTIC_TPU_RESTORE_DIR stamp, restores from
     # the migrated checkpoint and acks the resume for verification.
-    from .lifecycle import SIGNAL_DRAIN, SIGNAL_REFORM, LifecycleWatcher
+    from .lifecycle import (
+        SIGNAL_CUTOVER,
+        SIGNAL_DRAIN,
+        SIGNAL_REFORM,
+        LifecycleWatcher,
+    )
 
     watcher = LifecycleWatcher()
     restore_req = watcher.restore_request() if watcher.enabled else None
@@ -469,7 +483,28 @@ def main(argv=None) -> int:
         from .checkpointing import TrainCheckpointer
 
         ckpt = TrainCheckpointer(ckpt_dir)
-        if ckpt.latest_step is not None:
+        # A pre-copy source leaves a delta CHAIN (workloads/
+        # checkpointing.DeltaCheckpointer) whose final round is newer
+        # than any periodic orbax save: prefer it when present, fall
+        # back to orbax on a torn/corrupt chain (the chain digests make
+        # torn detectable, never silently restorable).
+        from .checkpointing import DeltaCheckpointer, bytes_to_tree
+
+        delta_ck = DeltaCheckpointer(ckpt_dir)
+        delta_step = delta_ck.latest_step
+        if delta_step is not None and (
+            ckpt.latest_step is None or delta_step >= ckpt.latest_step
+        ):
+            try:
+                payload, manifest = delta_ck.load()
+                params, opt_state = bytes_to_tree(
+                    payload, (params, opt_state)
+                )
+                start_step = int(manifest["step"]) + 1
+                resumed = True
+            except (ValueError, OSError):
+                delta_step = None  # torn chain: orbax below
+        if not resumed and ckpt.latest_step is not None:
             params, opt_state, start_step = ckpt.restore(params, opt_state)
             start_step += 1
             resumed = True
@@ -518,6 +553,16 @@ def main(argv=None) -> int:
     last_saved_step = None
     eval_hist = []
     eval_s = 0.0  # eval wall time, subtracted from step accounting
+    # Pre-copy migration (ISSUE 20): on a drain signal, instead of the
+    # classic checkpoint-and-exit, keep training and stream delta
+    # snapshots (changed blocks only, digest-chained) every
+    # --precopy-every steps; pause only when the coordinator stamps
+    # ELASTIC_TPU_CUTOVER — or, as a workload-side safety net, when
+    # the drain deadline's final quarter arrives with no stamp.
+    precopy = {
+        "active": False, "round": 0, "delta": None, "sig": None,
+        "deadline_ts": None, "seen_ts": None,
+    }
     try:
         for step in range(start_step, start_step + args.steps):
             with recorder.step(step, tokens=tokens_per_step):
@@ -542,7 +587,23 @@ def main(argv=None) -> int:
                     duration_ms=round(ev_dt * 1000, 3),
                 )
             sig = watcher.poll()
-            if sig is not None and sig.kind in (SIGNAL_DRAIN, SIGNAL_REFORM):
+            if (
+                sig is not None and sig.kind == SIGNAL_DRAIN
+                and args.precopy_every > 0 and ckpt is not None
+                and not precopy["active"]
+            ):
+                # pre-copy drain: training CONTINUES; deltas stream
+                # below until the cutover signal ends the round trip
+                from .checkpointing import DeltaCheckpointer
+
+                precopy.update(
+                    active=True, sig=sig, round=0,
+                    deadline_ts=sig.deadline_ts, seen_ts=time.time(),
+                    delta=DeltaCheckpointer(ckpt_dir),
+                )
+            elif sig is not None and sig.kind in (
+                SIGNAL_DRAIN, SIGNAL_REFORM
+            ):
                 # checkpoint-and-exit: a drain means the chips go away;
                 # a reform means the world size changed and the process
                 # must restart to re-form the mesh. Either way the save
@@ -550,8 +611,52 @@ def main(argv=None) -> int:
                 # checkpoint is durable (after ckpt.wait()).
                 lifecycle_sig["sig"] = sig
                 preempted["flag"] = True
+            if precopy["active"] and not preempted["flag"]:
+                cut = sig is not None and sig.kind == SIGNAL_CUTOVER
+                if not cut and precopy["deadline_ts"]:
+                    budget = max(
+                        0.0, precopy["deadline_ts"] - precopy["seen_ts"]
+                    )
+                    cut = time.time() >= (
+                        precopy["deadline_ts"] - 0.25 * budget
+                    )
+                if cut:
+                    # cutover: training pauses HERE; only the blocks
+                    # dirtied since the last streamed round ship inside
+                    # the pause window (a full orbax save would put the
+                    # whole state back on the critical path)
+                    from .checkpointing import tree_to_bytes
+
+                    t_cut = time.perf_counter()
+                    summary = precopy["delta"].save(
+                        step, tree_to_bytes((params, opt_state)),
+                        round_=precopy["round"],
+                    )
+                    precopy["final"] = summary
+                    precopy["cutover_ms"] = round(
+                        (time.perf_counter() - t_cut) * 1000, 3
+                    )
+                    last_saved_step = step
+                    lifecycle_sig["sig"] = precopy["sig"]
+                    preempted["flag"] = True
+                elif (step + 1) % max(1, args.precopy_every) == 0:
+                    from .checkpointing import tree_to_bytes
+
+                    summary = precopy["delta"].save(
+                        step, tree_to_bytes((params, opt_state)),
+                        round_=precopy["round"],
+                    )
+                    watcher.ack_precopy(
+                        step, precopy["round"], checkpoint_dir=ckpt_dir,
+                        delta_bytes=summary["delta_bytes"],
+                        total_bytes=summary["total_bytes"],
+                        digest=summary["chain"],
+                        signal=precopy["sig"].value,
+                    )
+                    precopy["round"] += 1
             if ckpt is not None and (
-                preempted["flag"] or (every > 0 and (step + 1) % every == 0)
+                (preempted["flag"] and precopy.get("final") is None)
+                or (every > 0 and (step + 1) % every == 0)
             ):
                 if args.ema_decay > 0:
                     from .transformer import ema_params
@@ -575,13 +680,41 @@ def main(argv=None) -> int:
     dt = time.perf_counter() - t0 - eval_s
     if ckpt is not None:
         ckpt.wait()
+        if precopy["active"] and precopy.get("final") is None and ran:
+            # the step budget ran out mid-stream with no cutover stamp:
+            # close the stream with a final delta anyway so the agent
+            # gets its cutover ack instead of waiting out the deadline
+            from .checkpointing import tree_to_bytes
+
+            t_cut = time.perf_counter()
+            precopy["final"] = precopy["delta"].save(
+                step, tree_to_bytes((params, opt_state)),
+                round_=precopy["round"],
+            )
+            precopy["cutover_ms"] = round(
+                (time.perf_counter() - t_cut) * 1000, 3
+            )
+            last_saved_step = step
+            lifecycle_sig["sig"] = lifecycle_sig["sig"] or precopy["sig"]
         sig = lifecycle_sig["sig"]
         if sig is not None and last_saved_step is not None:
+            digest = None
+            extra = None
+            if precopy.get("final") is not None:
+                summary = precopy["final"]
+                digest = summary["chain"]
+                extra = {
+                    "precopy_rounds": precopy["round"],
+                    "delta_bytes": summary["delta_bytes"],
+                    "full_bytes": summary["total_bytes"],
+                    "cutover_ms": precopy["cutover_ms"],
+                }
             # the checkpoint is durable (wait() returned) — only now is
             # the ack honest: the agent reclaims the chips on it
             watcher.ack(
                 last_saved_step, checkpoint_dir=ckpt_dir,
                 signal=sig.value, epoch=sig.epoch,
+                digest=digest, extra=extra,
             )
         ckpt.close()
 
@@ -600,6 +733,7 @@ def main(argv=None) -> int:
             lifecycle_sig["sig"].kind if lifecycle_sig["sig"] else None
         ),
         "resumed_from_migration": restore_req is not None,
+        "precopy_rounds": precopy["round"] if precopy["active"] else 0,
     }
     if eval_hist:
         report["eval"] = eval_hist

@@ -229,6 +229,20 @@ class SharedKVPool:
         self.max_registry_digests = 1024
         self.published_requests = 0
         self.adopted_requests = 0
+        # Mid-stream handoff registry (live migration): a DRAINING
+        # engine publishes each OPEN stream's block chain + generation
+        # cursor here (``ServingEngine.publish_stream``) and a peer
+        # engine on the same pool claims and continues it
+        # (``adopt_stream``) — same refcounted blocks, zero bytes
+        # copied, zero client-visible resets. Each record owns one
+        # refcount per block (taken at publish, inherited at adopt),
+        # so a published stream survives its source slot's release.
+        self._stream_registry: "OrderedDict[int, dict]" = OrderedDict()
+        self._next_handoff_id = 0
+        self.max_pending_streams = 256
+        self.published_streams = 0
+        self.adopted_streams = 0
+        self.expired_streams = 0
 
     def publish_request(self, digests, record) -> None:
         """Publish a prefill-role request's observatory record under
@@ -257,6 +271,58 @@ class SharedKVPool:
         self.adopted_requests += 1
         return rec
 
+    def publish_stream(self, record: dict) -> int:
+        """Register a mid-stream handoff record (built by
+        ``ServingEngine.publish_stream``; the record already holds one
+        block refcount per entry of its chain). Overflow expires the
+        OLDEST pending record — its block refs drop and its open
+        observatory partition (if any) closes as ``handoff_expired``,
+        so an un-adopted publication can neither leak pool blocks nor
+        leak a live request partition."""
+        hid = self._next_handoff_id
+        self._next_handoff_id += 1
+        record["handoff_id"] = hid
+        self._stream_registry[hid] = record
+        self.published_streams += 1
+        while len(self._stream_registry) > self.max_pending_streams:
+            _, stale = self._stream_registry.popitem(last=False)
+            for bid in stale.get("blocks", ()):
+                self.allocator.drop(bid)
+            stale_obs = stale.get("obs")
+            if stale_obs is not None:
+                stale_obs.owner.finish(stale_obs.uid, "handoff_expired")
+            self.expired_streams += 1
+        return hid
+
+    def claim_stream(self, handoff_id: Optional[int] = None):
+        """Claim (and remove) a pending mid-stream handoff record —
+        oldest first, or a specific one by id. Returns None when
+        nothing is pending. The claimer inherits the record's block
+        refcounts; if it cannot seat the stream it MUST hand the
+        record back via ``restore_stream`` (not drop it)."""
+        if handoff_id is None:
+            if not self._stream_registry:
+                return None
+            _, rec = self._stream_registry.popitem(last=False)
+        else:
+            rec = self._stream_registry.pop(handoff_id, None)
+            if rec is None:
+                return None
+        self.adopted_streams += 1
+        return rec
+
+    def restore_stream(self, record: dict) -> None:
+        """Return a claimed-but-unseatable record to the FRONT of the
+        registry (it stays oldest) and un-count the claim."""
+        hid = record["handoff_id"]
+        self._stream_registry[hid] = record
+        self._stream_registry.move_to_end(hid, last=False)
+        self.adopted_streams -= 1
+
+    @property
+    def pending_streams(self) -> int:
+        return len(self._stream_registry)
+
     def compatible_with(self, cfg: ModelConfig) -> bool:
         return (
             cfg.n_layers == self.cfg.n_layers
@@ -277,6 +343,10 @@ class SharedKVPool:
             "adopted_tokens": self.adopted_tokens,
             "published_requests": self.published_requests,
             "adopted_requests": self.adopted_requests,
+            "published_streams": self.published_streams,
+            "adopted_streams": self.adopted_streams,
+            "expired_streams": self.expired_streams,
+            "pending_streams": self.pending_streams,
             "prefix_cache": self.prefix_cache.stats(),
         }
 
@@ -657,6 +727,14 @@ class ServingEngine:
         self._row_topk = np.zeros((slots,), np.int32)
         self._row_topp = np.zeros((slots,), np.float32)
         self._stop: Dict[int, frozenset] = {}  # rid -> stop-token set
+        # shared-pool engines keep each live request's REAL token
+        # history (prefix + prompt, host int32) so a mid-stream
+        # handoff (publish_stream) can rebuild the block hash chain
+        # without re-deriving tokens from KV bytes
+        self._seq_tokens: Dict[int, np.ndarray] = {}
+        # mid-stream handoffs this engine published / adopted
+        self.stream_handoffs_out = 0
+        self.stream_handoffs_in = 0
         # chunked admissions mid-prefill (enqueue()): FIFO of rids;
         # per-rid host state in _pending_state. _settling holds slots
         # whose request activated THIS step (they sit the decode out)
@@ -869,6 +947,8 @@ class ServingEngine:
             "role": self.role,
             "adoptions_total": self.adoptions_total,
             "adopted_tokens_total": self.adopted_tokens_total,
+            "stream_handoffs_out": self.stream_handoffs_out,
+            "stream_handoffs_in": self.stream_handoffs_in,
         }
         if self._prefix_cache is not None:
             out["prefix_cache"] = self._prefix_cache.stats()
@@ -878,6 +958,10 @@ class ServingEngine:
                 "adopted_tokens": self.shared_pool.adopted_tokens,
                 "published_requests": self.shared_pool.published_requests,
                 "adopted_requests": self.shared_pool.adopted_requests,
+                "published_streams": self.shared_pool.published_streams,
+                "adopted_streams": self.shared_pool.adopted_streams,
+                "expired_streams": self.shared_pool.expired_streams,
+                "pending_streams": self.shared_pool.pending_streams,
             }
         if self.draft_params is not None:
             drafted = self.spec_drafted_total
@@ -1258,6 +1342,10 @@ class ServingEngine:
         self._last = self._last.at[slot].set(first)
         self._slot_of[rid] = slot
         self._streams[rid] = [first]
+        if self.shared_pool is not None:
+            self._seq_tokens[rid] = np.asarray(
+                seq[:total], np.int32
+            ).copy()
         if obs is not None and ouid is not None:
             blocks = int(np.count_nonzero(self._table[slot]))
             obs.prefill_done(
@@ -1854,6 +1942,10 @@ class ServingEngine:
         self._slot_of[rid] = slot
         self._streams[rid] = [int(first)]
         self._stop[rid] = frozenset(int(t) for t in stop_tokens)
+        if self.shared_pool is not None:
+            self._seq_tokens[rid] = np.concatenate(
+                [pref_tokens, prompt]
+            ).astype(np.int32)
         if obs is not None and ouid is not None:
             self._obs_uid[rid] = ouid
             blocks = int(np.count_nonzero(self._table[slot]))
@@ -2202,6 +2294,7 @@ class ServingEngine:
         self._drop_row(slot)
         self._free.append(slot)
         self._free.sort()
+        self._seq_tokens.pop(rid, None)
 
     def stream(self, rid: int) -> List[int]:
         """Tokens generated so far (admission's first token onward);
@@ -2226,6 +2319,7 @@ class ServingEngine:
             self._free.append(st["slot"])
             self._free.sort()
             self._stop.pop(rid, None)
+            self._seq_tokens.pop(rid, None)
             return []
         if rid in self._slot_of:
             self._finish(rid)
@@ -2233,3 +2327,143 @@ class ServingEngine:
         self._stop.pop(rid, None)
         self.finish_reason.pop(rid, None)
         return self._streams.pop(rid)
+
+    # -- mid-stream handoff (live migration) -------------------------
+    #
+    # The cross-role request registry hands a request from prefill to
+    # decode at a phase boundary. Live migration needs the harder
+    # version: hand an OPEN stream — KV blocks, generation cursor,
+    # sampling state, emitted tokens — from a draining engine to a
+    # peer on the same SharedKVPool mid-decode, so the client sees one
+    # uninterrupted stream instead of a reset. Blocks move by
+    # refcount, never by copy: positions [0, host_len) stay the exact
+    # K/V bytes the source wrote, so a greedy adopted stream is
+    # bit-identical to the stream the source would have produced
+    # (pinned in tests/test_serving.py).
+
+    def publish_stream(self, rid: int) -> dict:
+        """Publish a LIVE request's in-flight decode state through the
+        shared pool's stream registry and release its slot here. The
+        record carries the slot's block chain (one registry-owned
+        refcount per block), the generation cursor, the real token
+        history, the emitted stream, per-request sampling and stop
+        state, and the open observatory partition. The source's rid
+        finishes as ``handoff`` — its stream stays readable, nothing
+        client-visible resets."""
+        if self.shared_pool is None:
+            raise ValueError(
+                "publish_stream needs a SharedKVPool (the registry IS "
+                "the transport; solo engines have no peer to adopt)"
+            )
+        if rid in self._pending_state:
+            raise ValueError(
+                f"request {rid} is still prefilling; pump step() until "
+                "it activates (or release() to cancel) before handoff"
+            )
+        if rid not in self._slot_of:
+            raise ValueError(f"request {rid} is not live")
+        slot = self._slot_of[rid]
+        hl = int(self._host_len[slot])
+        full = np.concatenate([
+            self._seq_tokens[rid],
+            np.asarray(self._streams[rid], np.int32),
+        ]).astype(np.int32)
+        # KV positions [0, hl) back full[:hl]; the newest stream
+        # token's K/V is written on its feed-back step, so it travels
+        # as data (``last``), not as pool bytes
+        n_blocks = self._blocks_for(hl)
+        blocks = [int(self._table[slot, j]) for j in range(n_blocks)]
+        for bid in blocks:
+            self._alloc.share(bid)
+        from .prefix_cache import chain_hashes
+
+        obs_rec = None
+        ouid = self._obs_uid.get(rid)
+        if self._observatory is not None and ouid is not None:
+            obs_rec = self._observatory.handoff_begin(ouid)
+        record = {
+            "kind": "stream",
+            "blocks": blocks,
+            "host_len": hl,
+            "tokens": full,
+            "stream": list(self._streams[rid]),
+            "last": int(full[hl]) if hl < len(full) else int(full[-1]),
+            "temp": float(self._row_temp[slot]),
+            "topk": int(self._row_topk[slot]),
+            "topp": float(self._row_topp[slot]),
+            "stop": tuple(int(t) for t in self._stop.get(rid, ())),
+            "digests": tuple(chain_hashes(full[:hl], self.block_size)),
+            "obs": obs_rec,
+        }
+        self.shared_pool.publish_stream(record)
+        self.stream_handoffs_out += 1
+        # the partition continues at the adopter: drop our uid mapping
+        # BEFORE _finish so the source side doesn't close it
+        self._obs_uid.pop(rid, None)
+        self._finish(rid, "handoff")
+        return record
+
+    def adopt_stream(self, record: Optional[dict] = None) -> Optional[int]:
+        """Adopt a mid-stream handoff from the shared pool (oldest
+        pending record, or one the caller already claimed): seat it in
+        a free slot, inherit the record's block refcounts (zero bytes
+        copied), restore cursor/sampling/stop/stream state, and
+        continue decoding. Returns the new rid, or None when nothing
+        is pending. On a seating failure (no slot, pool dry for the
+        write block) the record goes BACK to the registry front and
+        the admission-control ValueError raises — a failed adoption
+        never strands or leaks the stream."""
+        if self.shared_pool is None:
+            raise ValueError("adopt_stream needs a SharedKVPool")
+        claimed = record is None
+        if claimed:
+            record = self.shared_pool.claim_stream()
+            if record is None:
+                return None
+        if not self._free:
+            self.shared_pool.restore_stream(record)
+            raise ValueError("no free slot; release() one first")
+        slot = self._free.pop(0)
+        hl = int(record["host_len"])
+        blocks = record["blocks"]
+        for j, bid in enumerate(blocks):
+            # inherit the registry's refcount — no share(), no copy
+            self._table[slot, j] = bid
+        try:
+            # the next decode write's block may be fresh (hl on a
+            # block boundary); allocate it privately
+            self._ensure_blocks(slot, hl + 1)
+        except RuntimeError as e:
+            # roll back WITHOUT _drop_row: the inherited refs belong
+            # to the record, which goes back to the registry intact
+            for j in range(len(blocks), self.max_blocks):
+                bid = int(self._table[slot, j])
+                if bid != _JUNK:
+                    self._alloc.drop(bid)
+            self._table[slot, :] = _JUNK
+            self._free.append(slot)
+            self._free.sort()
+            self.shared_pool.restore_stream(record)
+            raise ValueError(str(e)) from e
+        rid = self._next_rid
+        self._next_rid += 1
+        self._slot_of[rid] = slot
+        self._streams[rid] = list(record["stream"])
+        self._stop[rid] = frozenset(record["stop"])
+        self._row_temp[slot] = record["temp"]
+        self._row_topk[slot] = record["topk"]
+        self._row_topp[slot] = record["topp"]
+        self._lengths = self._lengths.at[slot].set(hl)
+        self._host_len[slot] = hl
+        self._last = self._last.at[slot].set(int(record["last"]))
+        tokens = np.asarray(record["tokens"], np.int32)
+        self._seq_tokens[rid] = tokens[
+            : len(tokens) - len(record["stream"])
+        ].copy()
+        self.stream_handoffs_in += 1
+        obs_rec = record.get("obs")
+        if self._observatory is not None and obs_rec is not None:
+            self._obs_uid[rid] = self._observatory.adopt(
+                obs_rec, engine_key=id(self)
+            )
+        return rid

@@ -275,3 +275,167 @@ def test_shared_pool_and_role_rejections(setup):
         mesh = make_serving_mesh(mp=2, n_devices=2)
         with pytest.raises(ValueError, match="mesh"):
             ServingEngine(params, cfg, mesh=mesh, pool=pool)
+
+
+# -- mid-stream handoff: live migration of open streams (ISSUE 20) ------------
+
+
+def _decode_pair(cfg, params, pool_blocks=64, slots=2):
+    pool = SharedKVPool(cfg, block_size=8, pool_blocks=pool_blocks)
+    src = ServingEngine(
+        params, cfg, slots=slots, max_len=128,
+        prompt_buckets=(8, 64), role="decode", pool=pool,
+    )
+    dst = ServingEngine(
+        params, cfg, slots=slots, max_len=128,
+        prompt_buckets=(8, 64), role="decode", pool=pool,
+    )
+    return pool, src, dst
+
+
+def test_midstream_handoff_is_bit_identical(setup):
+    """Publish a LIVE stream mid-generation and adopt it on another
+    engine: the continued stream must equal the solo reference bit for
+    bit — the KV blocks move by refcount, the cursor and the pending
+    last token travel in the record, nothing is recomputed."""
+    cfg, params = setup
+    uni = ServingEngine(
+        params, cfg, slots=2, max_len=128, prompt_buckets=(8, 64),
+    )
+    ru = uni.admit(PROMPT)
+    for _ in range(40):
+        uni.step()
+    want = uni.release(ru)
+
+    pool, src, dst = _decode_pair(cfg, params)
+    rs = src.admit(PROMPT)
+    for _ in range(10):
+        src.step()
+    record = src.publish_stream(rs)
+    assert record["kind"] == "stream"
+    assert src.stats()["live_requests"] == 0  # source seat freed
+    assert pool.pending_streams == 1
+    rd = dst.adopt_stream()
+    assert rd is not None
+    for _ in range(30):
+        dst.step()
+    assert dst.release(rd) == want
+    assert pool.published_streams == 1
+    assert pool.adopted_streams == 1
+    assert pool.expired_streams == 0
+    assert src.stream_handoffs_out == 1
+    assert dst.stream_handoffs_in == 1
+
+
+def test_chained_handoff_stays_exact(setup):
+    """dst -> src again: a stream can migrate twice and stay exact."""
+    cfg, params = setup
+    uni = ServingEngine(
+        params, cfg, slots=2, max_len=128, prompt_buckets=(8, 64),
+    )
+    ru = uni.admit(PROMPT)
+    for _ in range(24):
+        uni.step()
+    want = uni.release(ru)
+
+    pool, src, dst = _decode_pair(cfg, params)
+    rs = src.admit(PROMPT)
+    for _ in range(7):
+        src.step()
+    src.publish_stream(rs)
+    rd = dst.adopt_stream()
+    for _ in range(9):
+        dst.step()
+    dst.publish_stream(rd)
+    rs2 = src.adopt_stream()
+    for _ in range(8):
+        src.step()
+    assert src.release(rs2) == want
+    assert pool.published_streams == 2
+    assert pool.adopted_streams == 2
+
+
+def test_adopt_without_free_slot_restores_stream(setup):
+    """A destination with no free seat must fail CLEAN: the record goes
+    back to the FRONT of the registry (no drop, no leak) and a later
+    adopter still gets an exact stream."""
+    cfg, params = setup
+    pool, src, dst = _decode_pair(cfg, params, slots=1)
+    rs = src.admit(PROMPT)
+    for _ in range(5):
+        src.step()
+    src.publish_stream(rs)
+    blocker = dst.admit([3, 5, 7])
+    with pytest.raises(ValueError):
+        dst.adopt_stream()
+    assert pool.pending_streams == 1  # restored, not lost
+    assert pool.adopted_streams == 0
+    dst.release(blocker)
+    rd = dst.adopt_stream()
+    assert rd is not None
+    for _ in range(4):
+        dst.step()
+    stream = dst.release(rd)
+    uni = ServingEngine(
+        params, cfg, slots=1, max_len=128, prompt_buckets=(8, 64),
+    )
+    ru = uni.admit(PROMPT)
+    for _ in range(9):
+        uni.step()
+    assert stream == uni.release(ru)
+
+
+def test_registry_overflow_expires_oldest_and_frees_blocks(setup):
+    """A bounded registry: overflow drops the OLDEST record, returning
+    its block refs to the pool — an abandoned handoff must not pin KV
+    forever."""
+    cfg, params = setup
+    pool, src, dst = _decode_pair(cfg, params, slots=2)
+    pool.max_pending_streams = 1
+    r1 = src.admit(PROMPT)
+    r2 = src.admit([11, 13, 17, 19] * 6)
+    for _ in range(4):
+        src.step()
+    src.publish_stream(r1)
+    used_with_one = pool.used_blocks
+    src.publish_stream(r2)  # evicts r1's record
+    assert pool.pending_streams == 1
+    assert pool.expired_streams == 1
+    assert pool.used_blocks < used_with_one + 4  # r1's blocks freed
+    rd = dst.adopt_stream()
+    assert rd is not None  # the survivor is r2's stream
+    assert dst.adopt_stream() is None  # registry drained
+
+
+def test_drain_serving_handoff_publishes_all_live_streams(setup):
+    """drain_serving(handoff=True) is the live-migration drain: pending
+    prefills are pumped to activation, every live stream is published
+    (none decoded to completion in the drain window), and the summary
+    carries handoff_streams for the coordinator's published==adopted
+    reconciliation."""
+    from elastic_tpu_agent.workloads.lifecycle import drain_serving
+
+    cfg, params = setup
+    pool, src, dst = _decode_pair(cfg, params, slots=2)
+    ra = src.admit(PROMPT)
+    rb = src.enqueue([23, 29, 31, 37] * 4)
+    for _ in range(3):
+        src.step()
+    summary = drain_serving(src, handoff=True)
+    assert summary["handoff_streams"] == 2
+    assert src.stats()["live_requests"] == 0
+    assert src.stats()["pending_prefills"] == 0
+    assert pool.pending_streams == 2
+    got = set()
+    while True:
+        rid = dst.adopt_stream()
+        if rid is None:
+            break
+        got.add(rid)
+    assert got == {ra, rb}
+    for _ in range(6):
+        dst.step()
+    assert len(dst.release(ra)) > 0
+    assert len(dst.release(rb)) > 0
+    assert pool.published_streams == 2
+    assert pool.adopted_streams == 2

@@ -699,3 +699,80 @@ def test_check_is_false_for_raise_kind():
             faults.fire("unit.raise")
     finally:
         reg.disarm("unit.raise")
+
+
+# -- preemption-aware deadline clamp (ISSUE 20) -------------------------------
+
+
+def test_preemption_deadline_clamped_to_notice_window(cluster):
+    """A preemption drain's budget is min(deadline, notice): a drain
+    deadline longer than the platform's preemption notice is a promise
+    the platform will break mid-checkpoint. Maintenance drains keep the
+    full deadline; a zero notice disables the clamp."""
+    drain = cluster.manager.drain
+    drain.deadline_s = 600.0
+    drain.preemption_notice_s = 30.0
+    assert drain._drain_budget_s("preemption") == 30.0
+    assert drain._drain_budget_s("preemption:notice") == 30.0
+    assert drain._drain_budget_s("maintenance:TERMINATE") == 600.0
+    # a deadline already inside the notice window is never stretched
+    drain.deadline_s = 10.0
+    assert drain._drain_budget_s("preemption") == 10.0
+    # notice 0 = platform gives no bound: the configured deadline rules
+    drain.preemption_notice_s = 0.0
+    drain.deadline_s = 600.0
+    assert drain._drain_budget_s("preemption") == 600.0
+
+    # end to end: the stamped deadline is the CLAMPED one
+    drain.preemption_notice_s = 30.0
+    _bind_pod(cluster, "clamped-0")
+    t0 = time.time()
+    cluster.manager.operator.set_preempted(True)
+    assert drain.tick() == DRAINING
+    assert drain.trigger.startswith("preemption")
+    budget = drain.deadline_ts - t0
+    assert 25.0 < budget <= 31.0, (
+        f"preemption drain budget {budget:.1f}s not clamped to the "
+        "30s notice"
+    )
+    env = _spec_env(cluster, "clamped-0")
+    stamped = float(env[EnvDrainDeadline])
+    assert abs(stamped - drain.deadline_ts) < 1.0
+
+
+def test_preemption_upgrade_clamps_deadline_never_extends(cluster):
+    """A preemption notice arriving MID-maintenance-drain clamps the
+    inherited deadline to the notice window — and never extends an
+    already-sooner deadline."""
+    _bind_pod(cluster, "upg-0")
+    drain = cluster.manager.drain
+    op = cluster.manager.operator
+    drain.deadline_s = 600.0
+    drain.preemption_notice_s = 30.0
+    op.set_maintenance_event("MIGRATE_ON_HOST_MAINTENANCE")
+    assert drain.tick() == DRAINING
+    long_deadline = drain.deadline_ts
+    assert long_deadline - time.time() > 500.0
+    op.set_preempted(True)
+    assert drain.tick() == DRAINING
+    assert drain.trigger == "preemption"
+    assert drain.deadline_ts < long_deadline
+    assert drain.deadline_ts - time.time() <= 30.5
+
+
+def test_preemption_upgrade_keeps_sooner_deadline(cluster):
+    """Inverse clamp direction: when the existing maintenance deadline
+    is already SOONER than the preemption notice, the upgrade keeps it
+    — the clamp only ever shortens."""
+    _bind_pod(cluster, "keep-0")
+    drain = cluster.manager.drain
+    op = cluster.manager.operator
+    drain.deadline_s = 60.0
+    drain.preemption_notice_s = 600.0
+    op.set_maintenance_event("MIGRATE_ON_HOST_MAINTENANCE")
+    assert drain.tick() == DRAINING
+    d0 = drain.deadline_ts
+    op.set_preempted(True)
+    assert drain.tick() == DRAINING
+    assert drain.trigger == "preemption"
+    assert drain.deadline_ts == d0

@@ -138,7 +138,81 @@ def test_destination_restore_window_is_migrating(store):
     assert result["migrations"] == [{
         "pod": "d/train", "node": "n0", "completed_ts": clk.time() - 5.0,
         "source_node": "n9", "coordinator_downtime_s": 5.0, "step": 7,
+        "mode": "full", "precopy": None,
     }]
+    _assert_conserved(result, rows)
+
+
+def test_precopy_stream_window_is_productive_cutover_is_downtime(store):
+    """The ISSUE-20 split of the migration cause: a pre-copy drain's
+    streaming window (drain signal -> cutover) stays PRODUCTIVE —
+    training ticked under the transfer — and only the cutover pause
+    (cutover_ts -> recorded) is downtime, charged to migration_cutover.
+    The early-reclaim tail stays plain migration."""
+    clk = ManualClock()
+    t = _journal(store, clock=clk)
+    t.emit(tl.KIND_BIND_COMMIT, keys={"pod": "d/train"})
+    clk.advance(10.0)
+    t.emit(
+        tl.KIND_DRAIN_TRANSITION, state="draining", **{"from": "cordoned"},
+        trigger="maintenance:TERMINATE_ON_HOST_MAINTENANCE",
+    )
+    # three streamed rounds: training continues under the transfer
+    for round_ in range(3):
+        clk.advance(1.0)
+        t.emit(tl.KIND_MIGRATION, keys={"pod": "d/train"},
+               action="precopy_round", round=round_,
+               delta_bytes=100_000, total_bytes=4_000_000)
+    cutover_ts = clk.time()
+    t.emit(tl.KIND_MIGRATION, keys={"pod": "d/train"},
+           action="cutover_signaled", reason="converged", rounds=3)
+    clk.advance(0.2)  # the PAUSE: final delta only
+    t.emit(tl.KIND_MIGRATION, keys={"pod": "d/train"}, action="recorded",
+           step=7, mode="precopy", cutover_ts=cutover_ts)
+    clk.advance(1.0)
+    t.emit(tl.KIND_MIGRATION, keys={"pod": "d/train"},
+           action="early_reclaim")
+    rows = store.timeline_rows()
+    result = goodput.replay_goodput(rows, asof=clk.time())
+    entry = result["pods"]["d/train"]
+    assert _states_of(entry) == ["productive", "checkpointing", "migrating"]
+    # 10s pre-drain + 3s of streamed rounds are ONE productive run
+    assert entry["states"]["productive"] == pytest.approx(13.0)
+    assert entry["states"]["checkpointing"] == pytest.approx(0.2)
+    assert entry["states"]["migrating"] == pytest.approx(1.0)
+    assert entry["precopy_s"] == pytest.approx(3.0)
+    # the pause is charged to the cutover, NOT the drain trigger
+    ckpt = entry["intervals"][1]
+    assert ckpt["cause"]["category"] == "migration_cutover"
+    assert result["downtime_by_cause"] == {
+        "migration_cutover": pytest.approx(0.2),
+        "migration": pytest.approx(1.0),
+    }
+    assert "maintenance_drain" not in result["downtime_by_cause"]
+    _assert_conserved(result, rows)
+
+
+def test_full_mode_recorded_keeps_drain_attribution(store):
+    """Without pre-copy metadata the old attribution stands: the whole
+    signal->recorded window is CHECKPOINTING charged to the drain
+    trigger — the split never rewrites full-checkpoint stories."""
+    clk = ManualClock()
+    t = _journal(store, clock=clk)
+    t.emit(tl.KIND_BIND_COMMIT, keys={"pod": "d/train"})
+    clk.advance(5.0)
+    t.emit(
+        tl.KIND_DRAIN_TRANSITION, state="draining", **{"from": "cordoned"},
+        trigger="preemption",
+    )
+    clk.advance(2.0)
+    t.emit(tl.KIND_MIGRATION, keys={"pod": "d/train"}, action="recorded",
+           step=3, mode="full")
+    rows = store.timeline_rows()
+    result = goodput.replay_goodput(rows, asof=clk.time())
+    entry = result["pods"]["d/train"]
+    assert entry["states"]["checkpointing"] == pytest.approx(2.0)
+    assert entry["precopy_s"] == 0.0
+    assert result["downtime_by_cause"] == {"preemption": pytest.approx(2.0)}
     _assert_conserved(result, rows)
 
 

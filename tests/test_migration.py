@@ -27,6 +27,7 @@ from elastic_tpu_agent import faults
 from elastic_tpu_agent.common import (
     AckSubdir,
     AnnotationAssumed,
+    EnvCutover,
     EnvRestoreDir,
     EnvRestoreStep,
     ResourceTPUCore,
@@ -57,10 +58,10 @@ MIGRATION_FAILPOINTS = ["migration.pre_ack", "migration.post_record"]
 # -- harness ------------------------------------------------------------------
 
 
-def _make_cluster(tmp_path, name="mig", metrics=None):
+def _make_cluster(tmp_path, name="mig", metrics=None, **overrides):
     d = tmp_path / name
     d.mkdir()
-    c = Cluster(d, metrics=metrics)
+    c = Cluster(d, metrics=metrics, **overrides)
     # Park the supervised loops: these tests drive tick() manually.
     c.manager.drain.period_s = 3600.0
     c.manager.migration.period_s = 3600.0
@@ -461,7 +462,8 @@ def test_publish_record_without_ack_returns_false(cluster):
 
 
 def _publish_record(cluster, ns, name, step=50, world=None,
-                    checkpoint_dir="/pvc/job", trace="trace-xyz"):
+                    checkpoint_dir="/pvc/job", trace="trace-xyz",
+                    **payload_extra):
     crd = ElasticTPUClient(cluster.opts.kube_client)
     payload = {
         "pod": f"{ns}/{name}", "uid": "old-uid",
@@ -471,6 +473,7 @@ def _publish_record(cluster, ns, name, step=50, world=None,
         "ack_ts": time.time(), "trace": trace,
         "topology_env": {}, "recorded_ts": time.time(),
     }
+    payload.update(payload_extra)
     crd.create(ElasticTPU(
         name=migration_object_name(ns, name),
         claim_namespace=ns, claim_name=name,
@@ -822,3 +825,182 @@ def test_migration_state_survives_restart_before_publish(tmp_path):
         ) is not None
     finally:
         c.stop()
+
+
+# -- crash replay over the pre-copy failpoints (ISSUE 20) ---------------------
+
+PRECOPY_FAILPOINTS = [
+    "migration.pre_copy_round",
+    "migration.pre_copy_journal",
+    "migration.pre_copy_cutover",
+]
+
+# (round, delta_bytes): a full round-0 baseline then shrinking-to-flat
+# deltas — round 3's delta >= 0.9 * round 2's trips "converged" the
+# tick it lands.
+_PRECOPY_ROUNDS = [
+    (0, 4_000_000), (1, 400_000), (2, 300_000), (3, 295_000),
+]
+
+
+def _precopy_ack(c, pod_name, step, round_, delta_bytes,
+                 total=4_000_000, chain="ch"):
+    ok = write_checkpoint_ack(
+        c.opts.alloc_spec_dir, _hash_of(c, pod_name), step,
+        checkpoint_dir="/pvc/p", kind="precopy", digest=chain,
+        extra={"round": round_, "delta_bytes": delta_bytes,
+               "total_bytes": total},
+    )
+    assert ok
+
+
+def _restart_manager(c):
+    c.manager.stop()
+    mgr2 = TPUManager(c.opts)
+    mgr2.drain.period_s = 3600.0
+    mgr2.migration.period_s = 3600.0
+    if mgr2.repartition is not None:
+        mgr2.repartition.period_s = 3600.0
+    mgr2.operator.set_maintenance_event("TERMINATE_ON_HOST_MAINTENANCE")
+    mgr2.run(block=False)
+    c.manager = mgr2
+    return mgr2
+
+
+@pytest.mark.parametrize("failpoint", PRECOPY_FAILPOINTS)
+def test_kill_at_precopy_failpoints_converges(tmp_path, failpoint):
+    """Die at each pre-copy failpoint mid-stream, restart the manager
+    over the surviving journal, and the stream must converge: every
+    round journaled exactly once (a torn round is resumed, a journaled
+    one deduped), exactly one cutover, exactly one published record
+    carrying the chain contract — never a double restore."""
+    # Event bus OFF: a store/drain event would wake the parked
+    # supervised migration loop, which then races the manual tick()s
+    # for the armed failpoint (the ack gets consumed — and the round
+    # journaled or the cutover decided — before this thread ticks).
+    c = _make_cluster(
+        tmp_path, name=f"pcf{PRECOPY_FAILPOINTS.index(failpoint)}",
+        enable_event_bus=False,
+    )
+    try:
+        _bind_pod(c, "pre-0")
+        drain = c.manager.drain
+        drain.deadline_s = 3600.0
+        c.manager.operator.set_maintenance_event(
+            "TERMINATE_ON_HOST_MAINTENANCE"
+        )
+        assert drain.tick() == DRAINING
+        # the cutover failpoint only fires on the tick that decides
+        # convergence (round 3); the round/journal ones on round 0
+        die_round = 3 if failpoint == "migration.pre_copy_cutover" else 0
+        for round_, delta in _PRECOPY_ROUNDS:
+            _precopy_ack(c, "pre-0", 10 + round_, round_, delta)
+            if round_ == die_round:
+                with faults.armed(failpoint, "die-thread:1"):
+                    with pytest.raises(faults.DieThread):
+                        c.manager.migration.tick()
+                mgr2 = _restart_manager(c)
+                assert mgr2.drain.state in (DRAINING, "cordoned")
+                mgr2.drain.tick()
+            c.manager.migration.tick()
+        st = c.manager.migration.status()
+        pc = st["precopy"]["default/pre-0"]
+        assert pc["rounds"] == 4
+        assert pc["last_delta_bytes"] == 295_000
+        assert pc["stage"] == "cutover"
+        assert pc["cutover_reason"] == "converged"
+        assert st["precopy_rounds_total"] == 4
+        assert st["cutovers_total"] == 1
+        # the cutover stamp reached the pod's spec env
+        env = _spec_env(c, "pre-0")
+        assert env[EnvCutover].startswith("converged:")
+        # the final (paused) delta ack closes the stream: early reclaim
+        # plus a record carrying the pre-copy chain contract
+        _ack(c, "pre-0", step=20, checkpoint_dir="/pvc/p",
+             digest="chain-final",
+             extra={"precopy_rounds": 4, "delta_bytes": 295_000,
+                    "full_bytes": 4_000_000, "cutover_ms": 55.0})
+        c.manager.migration.tick()
+        assert c.manager.storage.load("default", "pre-0") is None
+        assert c.manager.crd_recorder.flush()
+        c.manager.migration.tick()
+        st = c.manager.migration.status()
+        rec = st["records"]["default/pre-0"]
+        assert rec["published"] is True and rec["reclaimed"] is True
+        assert rec["digest"] == "chain-final"
+        assert st["early_reclaims_total"] == 1
+        assert st["precopy"] == {}  # stream closed by the cutover ack
+        # the published record carries the chain contract + round stats
+        crd = ElasticTPUClient(c.opts.kube_client)
+        obj = crd.get(migration_object_name("default", "pre-0"))
+        assert obj is not None
+        assert obj.migration["mode"] == "precopy"
+        assert obj.migration["digest"] == "chain-final"
+        assert obj.migration["precopy"]["rounds"] == 4
+        assert obj.migration["precopy"]["cutover_reason"] == "converged"
+        # never double-restore on the source side: the reconciler must
+        # not replay the reclaimed bind
+        c.manager.reconciler.reconcile_once()
+        report = c.manager.reconciler.reconcile_once()
+        assert report["replayed_binds"] == 0
+        assert c.manager.drain.tick() == DRAINED
+        assert c.manager.drain.status()["outcome"] == "drained_acked"
+    finally:
+        c.stop()
+
+
+def test_torn_delta_chain_blocks_completion_until_repaired(
+    cluster, tmp_path
+):
+    """A torn final delta (missing block) must NOT verify at the
+    destination: the completion is refused and the record — the durable
+    copy — survives for the retry. Once the chain is whole again, a
+    fresh resume ack completes; the state is restored exactly once."""
+    from elastic_tpu_agent.workloads.checkpointing import (
+        DeltaCheckpointer,
+    )
+
+    ck = str(tmp_path / "chain")
+    d = DeltaCheckpointer(ck, block_size=64)
+    summary = d.save(3, bytes(range(256)) * 8, round_=0)
+    _publish_record(
+        cluster, "default", "job-9", step=3, checkpoint_dir=ck,
+        mode="precopy", digest=summary["chain"],
+        precopy={"rounds": 1, "cutover_reason": "converged"},
+    )
+    # tear the chain: delete one block (keep its bytes for the repair)
+    victim_digest = d.read_manifest(3)["blocks"][0]
+    victim_path = os.path.join(ck, "blocks", f"{victim_digest}.bin")
+    with open(victim_path, "rb") as f:
+        victim_bytes = f.read()
+    os.unlink(victim_path)
+
+    _bind_pod(cluster, "job-9")
+    mig = cluster.manager.migration
+    mig.tick()
+    assert mig.status()["inbound"]["default/job-9"]["stage"] == "restamped"
+    write_checkpoint_ack(
+        cluster.opts.alloc_spec_dir, _hash_of(cluster, "job-9"),
+        3, kind="resume", world_size=1, checkpoint_dir=ck,
+    )
+    mig.tick()
+    st = mig.status()
+    assert st["completed_total"] == 0
+    assert st["verify_failures_total"] >= 1
+    crd = ElasticTPUClient(cluster.opts.kube_client)
+    assert crd.get(migration_object_name("default", "job-9")) is not None
+
+    # repair the chain; a FRESH resume ack verifies and completes
+    with open(victim_path, "wb") as f:
+        f.write(victim_bytes)
+    write_checkpoint_ack(
+        cluster.opts.alloc_spec_dir, _hash_of(cluster, "job-9"),
+        4, kind="resume", world_size=1, checkpoint_dir=ck,
+    )
+    mig.tick()
+    st = mig.status()
+    assert st["completed_total"] == 1
+    done = st["recent_completions"][0]
+    assert done["mode"] == "precopy"
+    assert done["precopy"]["rounds"] == 1
+    assert crd.get(migration_object_name("default", "job-9")) is None
